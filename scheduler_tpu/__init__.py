@@ -1,0 +1,40 @@
+"""tpu-batch-scheduler: a TPU-native batch/gang scheduling framework.
+
+A brand-new framework with the capabilities of the Volcano scheduler (kube-batch,
+reference: kevin-wangzefeng/scheduler): gang scheduling of PodGroups across weighted
+Queues with DRF / proportional fairness, priority, preemption, reclaim, backfill and
+pluggable predicates / node scoring — redesigned TPU-first.
+
+Architecture (two cooperating halves):
+
+* Host framework (this package): cluster-state cache with event ingestion, the
+  per-cycle scheduling Session with Action/Plugin registries and tiered dispatch,
+  YAML configuration, metrics and the CLI.  The reference's pointer-web data model
+  (JobInfo.TaskStatusIndex, NodeInfo.Tasks) is re-expressed as dense index arrays +
+  resource matrices so that snapshots are *already* device-shaped.
+* Device engine (``scheduler_tpu.ops``): the per-Session hot loops — predicate
+  masking, node scoring, bin-packed placement, fairness shares, gang readiness —
+  as batched JAX/XLA kernels (jit/pjit, ``lax.scan``/``lax.while_loop``, Pallas for
+  the innermost packing kernel), sharded over a ``jax.sharding.Mesh`` on the node
+  axis for multi-chip scale.
+
+Layer map mirrors SURVEY.md §1 (reference layers → here):
+
+* ``apis``        — the API object model (PodGroup/Queue/Pod/Node; reference
+                     ``pkg/apis/scheduling/v1alpha1``)
+* ``api``         — scheduler data model (Resource vectors, Task/Job/Node/Queue
+                     infos, snapshot tensors; reference ``pkg/scheduler/api``)
+* ``cache``       — cluster-state mirror + event handlers (``pkg/scheduler/cache``)
+* ``framework``   — Session / plugin dispatch / Statement (``pkg/scheduler/framework``)
+* ``actions``     — enqueue, allocate, backfill, preempt, reclaim
+* ``plugins``     — gang, drf, proportion, priority, predicates, nodeorder,
+                     conformance, binpack, tpu-scorer
+* ``ops``         — the JAX device kernels (the TPU replacement for the reference's
+                     16-goroutine host sweeps, ``pkg/scheduler/util``)
+* ``parallel``    — meshes, shardings and collectives for multi-chip operation
+* ``models``      — placement solver models (sequential-parity scan, wavefront
+                     relaxation, LP-relaxed bin-pack) and synthetic workload models
+* ``utils``       — priority queue, metrics, logging, assertions
+"""
+
+__version__ = "0.1.0"

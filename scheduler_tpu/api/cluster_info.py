@@ -1,0 +1,27 @@
+"""ClusterInfo: the frozen snapshot triple a Session schedules against
+(reference ``pkg/scheduler/api/cluster_info.go``)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from scheduler_tpu.api.job_info import JobInfo
+from scheduler_tpu.api.node_info import NodeInfo
+from scheduler_tpu.api.queue_info import QueueInfo
+from scheduler_tpu.api.vocab import ResourceVocabulary
+
+
+class ClusterInfo:
+    __slots__ = ("jobs", "nodes", "queues", "vocab")
+
+    def __init__(self, vocab: ResourceVocabulary) -> None:
+        self.vocab = vocab
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+
+    def __repr__(self) -> str:
+        return (
+            f"ClusterInfo(jobs={len(self.jobs)}, nodes={len(self.nodes)}, "
+            f"queues={len(self.queues)})"
+        )

@@ -1,0 +1,234 @@
+"""Task and Job info: the scheduler's working view of pods and gangs.
+
+Reference: ``pkg/scheduler/api/job_info.go`` (TaskInfo :36-93, JobInfo :127-418).
+The status-indexed task maps and gang arithmetic (ReadyTaskNum/ValidTaskNum/
+Ready/Pipelined) are the contract the gang plugin relies on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from scheduler_tpu.api.resource import ResourceVec
+from scheduler_tpu.api.types import TaskStatus, allocated_status, get_task_status
+from scheduler_tpu.api.unschedule_info import FitErrors
+from scheduler_tpu.api.vocab import ResourceVocabulary
+from scheduler_tpu.apis.objects import PodGroup, PodSpec
+
+
+def pod_resource_without_init(pod: PodSpec, vocab: ResourceVocabulary) -> ResourceVec:
+    """Sum of container requests (reference GetPodResourceWithoutInitContainers)."""
+    total = ResourceVec.empty(vocab)
+    for c in pod.containers:
+        total.add(ResourceVec.from_dict(c, vocab))
+    return total
+
+
+def pod_resource_request(pod: PodSpec, vocab: ResourceVocabulary) -> ResourceVec:
+    """Effective request: max(sum(containers), max(init_containers))
+    (reference ``pod_info.go:53-76``)."""
+    total = pod_resource_without_init(pod, vocab)
+    for ic in pod.init_containers:
+        total.set_max(ResourceVec.from_dict(ic, vocab))
+    return total
+
+
+def job_id_for_pod(pod: PodSpec) -> str:
+    """JobID of the PodGroup a pod belongs to (reference getJobID: namespace/group)."""
+    if pod.group_name:
+        return f"{pod.namespace}/{pod.group_name}"
+    return ""
+
+
+class TaskInfo:
+    """One schedulable task (pod) as seen by a Session."""
+
+    __slots__ = (
+        "uid",
+        "job",
+        "name",
+        "namespace",
+        "resreq",
+        "init_resreq",
+        "node_name",
+        "status",
+        "priority",
+        "pod",
+        "volume_ready",
+    )
+
+    def __init__(self, pod: PodSpec, vocab: ResourceVocabulary) -> None:
+        self.uid: str = pod.uid
+        self.job: str = job_id_for_pod(pod)
+        self.name: str = pod.name
+        self.namespace: str = pod.namespace
+        self.resreq: ResourceVec = pod_resource_without_init(pod, vocab)
+        self.init_resreq: ResourceVec = pod_resource_request(pod, vocab)
+        self.node_name: str = pod.node_name
+        self.status: TaskStatus = get_task_status(pod)
+        self.priority: int = pod.priority
+        self.pod: PodSpec = pod
+        self.volume_ready: bool = False
+
+    @property
+    def creation_timestamp(self) -> float:
+        return self.pod.creation_timestamp
+
+    def clone(self) -> "TaskInfo":
+        t = TaskInfo.__new__(TaskInfo)
+        t.uid = self.uid
+        t.job = self.job
+        t.name = self.name
+        t.namespace = self.namespace
+        t.resreq = self.resreq.clone()
+        t.init_resreq = self.init_resreq.clone()
+        t.node_name = self.node_name
+        t.status = self.status
+        t.priority = self.priority
+        t.pod = self.pod
+        t.volume_ready = self.volume_ready
+        return t
+
+    def __repr__(self) -> str:
+        return (
+            f"Task({self.namespace}/{self.name} uid={self.uid} job={self.job} "
+            f"status={self.status.name} node={self.node_name!r})"
+        )
+
+
+class JobInfo:
+    """A gang job: all tasks of one PodGroup plus scheduling aggregates."""
+
+    def __init__(self, uid: str, vocab: ResourceVocabulary) -> None:
+        self.uid: str = uid
+        self.vocab = vocab
+        self.name: str = ""
+        self.namespace: str = ""
+        self.queue: str = ""
+        self.priority: int = 0
+        self.min_available: int = 0
+        self.pod_group: Optional[PodGroup] = None
+
+        self.tasks: Dict[str, TaskInfo] = {}
+        self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
+
+        self.allocated: ResourceVec = ResourceVec.empty(vocab)
+        self.total_request: ResourceVec = ResourceVec.empty(vocab)
+
+        self.creation_timestamp: float = 0.0
+
+        # Why scheduling failed, for status conditions (job_info.go:150-157).
+        self.nodes_fit_errors: Dict[str, FitErrors] = {}  # task uid -> FitErrors
+        self.nodes_fit_delta: Dict[str, ResourceVec] = {}  # node -> shortfall
+        self.job_fit_errors: str = ""
+
+    # -- PodGroup binding ---------------------------------------------------
+
+    def set_pod_group(self, pg: PodGroup) -> None:
+        self.name = pg.name
+        self.namespace = pg.namespace
+        self.min_available = pg.min_member
+        self.queue = pg.queue
+        self.creation_timestamp = pg.creation_timestamp
+        self.pod_group = pg
+
+    def unset_pod_group(self) -> None:
+        self.pod_group = None
+
+    # -- task CRUD (status-indexed, job_info.go:238-292) --------------------
+
+    def _add_to_index(self, ti: TaskInfo) -> None:
+        self.task_status_index.setdefault(ti.status, {})[ti.uid] = ti
+
+    def _delete_from_index(self, ti: TaskInfo) -> None:
+        bucket = self.task_status_index.get(ti.status)
+        if bucket is not None:
+            bucket.pop(ti.uid, None)
+            if not bucket:
+                del self.task_status_index[ti.status]
+
+    def add_task_info(self, ti: TaskInfo) -> None:
+        self.tasks[ti.uid] = ti
+        self._add_to_index(ti)
+        if allocated_status(ti.status):
+            self.allocated.add(ti.resreq)
+        self.total_request.add(ti.resreq)
+
+    def delete_task_info(self, ti: TaskInfo) -> None:
+        task = self.tasks.get(ti.uid)
+        if task is None:
+            raise KeyError(f"task {ti.namespace}/{ti.name} not in job {self.uid}")
+        if allocated_status(task.status):
+            self.allocated.sub(task.resreq)
+        self.total_request.sub(task.resreq)
+        del self.tasks[task.uid]
+        self._delete_from_index(task)
+
+    def update_task_status(self, ti: TaskInfo, status: TaskStatus) -> None:
+        """Move a task between status buckets, maintaining the allocated aggregate."""
+        task = self.tasks.get(ti.uid)
+        if task is None:
+            raise KeyError(f"task {ti.uid} not in job {self.uid}")
+        self._delete_from_index(task)
+        if allocated_status(task.status):
+            self.allocated.sub(task.resreq)
+        task.status = status
+        ti.status = status
+        if allocated_status(status):
+            self.allocated.add(task.resreq)
+        self._add_to_index(task)
+
+    # -- gang arithmetic (job_info.go:367-418) ------------------------------
+
+    def ready_task_num(self) -> int:
+        return sum(
+            len(tasks)
+            for status, tasks in self.task_status_index.items()
+            if allocated_status(status) or status == TaskStatus.SUCCEEDED
+        )
+
+    def waiting_task_num(self) -> int:
+        return len(self.task_status_index.get(TaskStatus.PIPELINED, {}))
+
+    def valid_task_num(self) -> int:
+        return sum(
+            len(tasks)
+            for status, tasks in self.task_status_index.items()
+            if allocated_status(status)
+            or status
+            in (TaskStatus.SUCCEEDED, TaskStatus.PIPELINED, TaskStatus.PENDING)
+        )
+
+    def ready(self) -> bool:
+        return self.ready_task_num() >= self.min_available
+
+    def pipelined(self) -> bool:
+        return self.waiting_task_num() + self.ready_task_num() >= self.min_available
+
+    def fit_error(self) -> str:
+        """Histogram of task statuses for unschedulable messages (job_info.go:344-364)."""
+        reasons = {str(status): len(tasks) for status, tasks in self.task_status_index.items()}
+        reasons["minAvailable"] = self.min_available
+        sorted_strs = sorted(f"{v} {k}" for k, v in reasons.items())
+        return "job is not ready, {}.".format(", ".join(sorted_strs))
+
+    # -- clone (job_info.go:295-329) ----------------------------------------
+
+    def clone(self) -> "JobInfo":
+        job = JobInfo(self.uid, self.vocab)
+        job.name = self.name
+        job.namespace = self.namespace
+        job.queue = self.queue
+        job.priority = self.priority
+        job.min_available = self.min_available
+        job.pod_group = self.pod_group
+        job.creation_timestamp = self.creation_timestamp
+        for task in self.tasks.values():
+            job.add_task_info(task.clone())
+        return job
+
+    def __repr__(self) -> str:
+        return (
+            f"Job({self.namespace}/{self.name} uid={self.uid} queue={self.queue} "
+            f"minAvailable={self.min_available} tasks={len(self.tasks)})"
+        )

@@ -1,0 +1,152 @@
+"""Node info: per-node resource accounting and the task state machine.
+
+Reference: ``pkg/scheduler/api/node_info.go``.  The add/remove state machine keyed
+on task status (:165-222) is what makes pipelining onto releasing resources work:
+
+* RELEASING task: counted in Releasing, subtracted from Idle, added to Used.
+* PIPELINED task: subtracted from Releasing only (it consumes resources that a
+  releasing task will free), not from Idle.
+* any other (allocated-ish) status: subtracted from Idle, added to Used.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from scheduler_tpu.api.job_info import TaskInfo
+from scheduler_tpu.api.resource import ResourceVec
+from scheduler_tpu.api.types import TaskStatus
+from scheduler_tpu.api.vocab import ResourceVocabulary
+from scheduler_tpu.apis.objects import NodeSpec
+
+
+class NodeState:
+    READY = "Ready"
+    NOT_READY = "NotReady"
+
+
+class NodeInfo:
+    def __init__(self, vocab: ResourceVocabulary, node: Optional[NodeSpec] = None) -> None:
+        self.vocab = vocab
+        self.name: str = node.name if node else ""
+        self.node: Optional[NodeSpec] = None
+
+        self.releasing: ResourceVec = ResourceVec.empty(vocab)
+        self.idle: ResourceVec = ResourceVec.empty(vocab)
+        self.used: ResourceVec = ResourceVec.empty(vocab)
+        self.allocatable: ResourceVec = ResourceVec.empty(vocab)
+        self.capability: ResourceVec = ResourceVec.empty(vocab)
+
+        self.tasks: Dict[str, TaskInfo] = {}
+
+        self.state_phase: str = NodeState.NOT_READY
+        self.state_reason: str = "UnInitialized"
+
+        if node is not None:
+            self.set_node(node)
+
+    def ready(self) -> bool:
+        return self.state_phase == NodeState.READY
+
+    def _set_node_state(self, node: Optional[NodeSpec], allocatable: Optional[ResourceVec]) -> None:
+        if node is None or allocatable is None:
+            self.state_phase, self.state_reason = NodeState.NOT_READY, "UnInitialized"
+            return
+        if not self.used.less_equal(allocatable):
+            # Drift between cache and cluster (OutOfSync, node_info.go:110-134).
+            self.state_phase, self.state_reason = NodeState.NOT_READY, "OutOfSync"
+            return
+        self.state_phase, self.state_reason = NodeState.READY, ""
+
+    def set_node(self, node: NodeSpec) -> None:
+        """(Re)initialize accounting from the node object (node_info.go:137-162).
+
+        Deliberate divergence from the reference SetNode, which neither resets
+        Releasing nor special-cases pipelined tasks (so repeated node updates
+        inflate Releasing there): here accounting is rebuilt as a clean fold of
+        the same state machine ``add_task`` applies, keeping the two paths
+        consistent by construction.
+        """
+        allocatable = ResourceVec.from_dict(node.allocatable, self.vocab)
+        self._set_node_state(node, allocatable)
+        if not self.ready():
+            return
+
+        self.name = node.name
+        self.node = node
+        self.allocatable = allocatable
+        self.capability = ResourceVec.from_dict(node.capacity, self.vocab)
+        self.releasing = ResourceVec.empty(self.vocab)
+        self.idle = allocatable.clone()
+        self.used = ResourceVec.empty(self.vocab)
+
+        for task in self.tasks.values():
+            if task.status == TaskStatus.RELEASING:
+                self.releasing.add(task.resreq)
+                self.idle.sub(task.resreq)
+            elif task.status == TaskStatus.PIPELINED:
+                self.releasing.sub(task.resreq)
+            else:
+                self.idle.sub(task.resreq)
+            self.used.add(task.resreq)
+
+    def add_task(self, task: TaskInfo) -> None:
+        """Account a task onto this node (node_info.go:165-196).
+
+        Holds a clone so later status changes don't corrupt node accounting.
+        """
+        if task.uid in self.tasks:
+            raise ValueError(f"task {task.namespace}/{task.name} already on node {self.name}")
+
+        ti = task.clone()
+        if self.node is not None:
+            if ti.status == TaskStatus.RELEASING:
+                self.releasing.add(ti.resreq)
+                self.idle.sub(ti.resreq)
+            elif ti.status == TaskStatus.PIPELINED:
+                self.releasing.sub(ti.resreq)
+            else:
+                self.idle.sub(ti.resreq)
+            self.used.add(ti.resreq)
+        self.tasks[ti.uid] = ti
+
+    def remove_task(self, ti: TaskInfo) -> None:
+        task = self.tasks.get(ti.uid)
+        if task is None:
+            raise KeyError(f"task {ti.namespace}/{ti.name} not on node {self.name}")
+        if self.node is not None:
+            if task.status == TaskStatus.RELEASING:
+                self.releasing.sub(task.resreq)
+                self.idle.add(task.resreq)
+            elif task.status == TaskStatus.PIPELINED:
+                self.releasing.add(task.resreq)
+            else:
+                self.idle.add(task.resreq)
+            self.used.sub(task.resreq)
+        del self.tasks[task.uid]
+
+    def update_task(self, ti: TaskInfo) -> None:
+        self.remove_task(ti)
+        self.add_task(ti)
+
+    @property
+    def pods_limit(self) -> int:
+        return self.allocatable.max_task_num
+
+    def clone(self) -> "NodeInfo":
+        n = NodeInfo(self.vocab)
+        n.name = self.name
+        n.node = self.node
+        n.state_phase = self.state_phase
+        n.state_reason = self.state_reason
+        n.allocatable = self.allocatable.clone()
+        n.capability = self.capability.clone()
+        n.releasing = self.releasing.clone()
+        n.idle = self.idle.clone()
+        n.used = self.used.clone()
+        for task in self.tasks.values():
+            n.tasks[task.uid] = task.clone()
+        return n
+
+    def __repr__(self) -> str:
+        return f"Node({self.name} idle=<{self.idle}> used=<{self.used}> tasks={len(self.tasks)})"

@@ -1,0 +1,1 @@
+"""Infra utilities: assertions, priority queue, logging, metrics."""

@@ -1,0 +1,18 @@
+"""Test configuration.
+
+Must run before any jax import: forces the CPU backend with 8 virtual devices so
+multi-chip sharding tests run anywhere, and turns resource-arithmetic assertion
+violations into hard errors (the reference runs unit tests with the cache mutation
+detector + PANIC_ON_ERROR for the same reason).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("PANIC_ON_ERROR", "true")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,91 @@
+"""Shared fixture builders (reference ``pkg/scheduler/util/test_utils.go:34-92``)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from scheduler_tpu.api import ResourceVocabulary
+from scheduler_tpu.apis import NodeSpec, PodGroup, PodSpec, Queue
+from scheduler_tpu.apis.objects import GROUP_NAME_ANNOTATION, PodPhase
+
+
+def build_resource_list(cpu_milli: float, memory: float, **scalars: float) -> Dict[str, float]:
+    rl = {"cpu": cpu_milli, "memory": memory}
+    rl.update({k.replace("__", "/").replace("_", "."): v for k, v in scalars.items()})
+    return rl
+
+
+def build_pod(
+    namespace: str = "default",
+    name: str = "pod",
+    nodename: str = "",
+    phase: str = PodPhase.PENDING,
+    req: Optional[Dict[str, float]] = None,
+    groupname: str = "",
+    labels: Optional[Dict[str, str]] = None,
+    selector: Optional[Dict[str, str]] = None,
+    priority: int = 0,
+    uid: str = "",
+) -> PodSpec:
+    annotations = {GROUP_NAME_ANNOTATION: groupname} if groupname else {}
+    pod = PodSpec(
+        name=name,
+        namespace=namespace,
+        containers=[dict(req)] if req else [],
+        node_name=nodename,
+        phase=phase,
+        priority=priority,
+        labels=dict(labels or {}),
+        annotations=annotations,
+        node_selector=dict(selector or {}),
+    )
+    if uid:
+        pod.uid = uid
+    return pod
+
+
+def build_node(
+    name: str,
+    alloc: Dict[str, float],
+    labels: Optional[Dict[str, str]] = None,
+    pods: int = 110,
+) -> NodeSpec:
+    allocatable = dict(alloc)
+    allocatable.setdefault("pods", pods)
+    return NodeSpec(name=name, allocatable=allocatable, labels=dict(labels or {}))
+
+
+def build_pod_group(
+    name: str,
+    namespace: str = "default",
+    queue: str = "default",
+    min_member: int = 1,
+    min_resources: Optional[Dict[str, float]] = None,
+    phase: str = "Inqueue",
+) -> PodGroup:
+    pg = PodGroup(
+        name=name,
+        namespace=namespace,
+        queue=queue,
+        min_member=min_member,
+        min_resources=min_resources,
+    )
+    pg.status.phase = phase
+    return pg
+
+
+def build_queue(name: str, weight: int = 1, capability: Optional[Dict[str, float]] = None) -> Queue:
+    return Queue(name=name, weight=weight, capability=dict(capability or {}))
+
+
+def make_vocab(*scalars: str) -> ResourceVocabulary:
+    return ResourceVocabulary(scalars)
+
+
+# Canonical unit helpers.
+def cpu(cores: float) -> float:
+    return cores * 1000.0
+
+
+def gi(gibi: float) -> float:
+    return gibi * 1024.0 * 1024.0 * 1024.0

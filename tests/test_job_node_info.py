@@ -1,0 +1,166 @@
+"""JobInfo / NodeInfo accounting tests (model: api/job_info_test.go, node_info_test.go)."""
+
+import pytest
+
+from scheduler_tpu.api import JobInfo, NodeInfo, TaskInfo, TaskStatus
+from tests.fixtures import build_node, build_pod, build_pod_group, make_vocab
+
+
+def task(vocab, name="p1", req=None, phase="Pending", nodename="", groupname="pg1"):
+    pod = build_pod(name=name, req=req or {"cpu": 1000, "memory": 100}, phase=phase,
+                    nodename=nodename, groupname=groupname)
+    return TaskInfo(pod, vocab)
+
+
+class TestTaskInfo:
+    def test_resreq_from_containers(self):
+        vocab = make_vocab()
+        pod = build_pod(req={"cpu": 1000, "memory": 200})
+        pod.containers.append({"cpu": 500})
+        ti = TaskInfo(pod, vocab)
+        assert ti.resreq.milli_cpu == 1500
+        assert ti.resreq.memory == 200
+
+    def test_init_container_max_rule(self):
+        vocab = make_vocab()
+        pod = build_pod(req={"cpu": 1000})
+        pod.init_containers.append({"cpu": 4000})
+        ti = TaskInfo(pod, vocab)
+        assert ti.resreq.milli_cpu == 1000       # without init containers
+        assert ti.init_resreq.milli_cpu == 4000  # max(sum(containers), max(init))
+
+    def test_status_derivation(self):
+        vocab = make_vocab()
+        assert task(vocab, phase="Pending").status == TaskStatus.PENDING
+        assert task(vocab, phase="Pending", nodename="n1").status == TaskStatus.BOUND
+        assert task(vocab, phase="Running", nodename="n1").status == TaskStatus.RUNNING
+        assert task(vocab, phase="Succeeded").status == TaskStatus.SUCCEEDED
+
+    def test_job_id(self):
+        vocab = make_vocab()
+        assert task(vocab, groupname="pg9").job == "default/pg9"
+        assert task(vocab, groupname="").job == ""
+
+
+class TestJobInfo:
+    def test_add_delete_task(self):
+        vocab = make_vocab()
+        job = JobInfo("default/pg1", vocab)
+        t1 = task(vocab, "p1")
+        t2 = task(vocab, "p2", phase="Running", nodename="n1")
+        job.add_task_info(t1)
+        job.add_task_info(t2)
+
+        assert len(job.tasks) == 2
+        assert set(job.task_status_index) == {TaskStatus.PENDING, TaskStatus.RUNNING}
+        assert job.total_request.milli_cpu == 2000
+        assert job.allocated.milli_cpu == 1000  # only running task is allocated
+
+        job.delete_task_info(t2)
+        assert job.allocated.milli_cpu == 0
+        assert job.total_request.milli_cpu == 1000
+
+    def test_update_task_status_moves_buckets(self):
+        vocab = make_vocab()
+        job = JobInfo("default/pg1", vocab)
+        t = task(vocab)
+        job.add_task_info(t)
+        job.update_task_status(t, TaskStatus.ALLOCATED)
+        assert TaskStatus.PENDING not in job.task_status_index
+        assert t.uid in job.task_status_index[TaskStatus.ALLOCATED]
+        assert job.allocated.milli_cpu == 1000
+
+    def test_gang_arithmetic(self):
+        vocab = make_vocab()
+        job = JobInfo("default/pg1", vocab)
+        job.set_pod_group(build_pod_group("pg1", min_member=3))
+        t1, t2, t3 = (task(vocab, f"p{i}") for i in range(3))
+        for t in (t1, t2, t3):
+            job.add_task_info(t)
+
+        assert job.valid_task_num() == 3
+        assert job.ready_task_num() == 0
+        assert not job.ready()
+
+        job.update_task_status(t1, TaskStatus.ALLOCATED)
+        job.update_task_status(t2, TaskStatus.ALLOCATED)
+        assert job.ready_task_num() == 2
+        assert not job.ready()
+
+        job.update_task_status(t3, TaskStatus.PIPELINED)
+        assert job.waiting_task_num() == 1
+        assert job.pipelined()       # 2 ready + 1 pipelined >= 3
+        assert not job.ready()
+
+        job.update_task_status(t3, TaskStatus.ALLOCATED)
+        assert job.ready()
+
+    def test_clone(self):
+        vocab = make_vocab()
+        job = JobInfo("default/pg1", vocab)
+        job.set_pod_group(build_pod_group("pg1", min_member=2))
+        job.add_task_info(task(vocab))
+        c = job.clone()
+        assert c.uid == job.uid and len(c.tasks) == 1
+        c.update_task_status(next(iter(c.tasks.values())), TaskStatus.ALLOCATED)
+        # original untouched
+        assert job.ready_task_num() == 0
+
+
+class TestNodeInfo:
+    def test_set_node_accounting(self):
+        vocab = make_vocab()
+        ni = NodeInfo(vocab, build_node("n1", {"cpu": 8000, "memory": 1000}))
+        assert ni.ready()
+        assert ni.idle.milli_cpu == 8000
+        assert ni.pods_limit == 110
+
+    def test_add_remove_task_state_machine(self):
+        vocab = make_vocab()
+        ni = NodeInfo(vocab, build_node("n1", {"cpu": 8000, "memory": 1000}))
+
+        running = task(vocab, "r", phase="Running", nodename="n1")
+        ni.add_task(running)
+        assert ni.idle.milli_cpu == 7000
+        assert ni.used.milli_cpu == 1000
+
+        releasing = task(vocab, "rel", phase="Running", nodename="n1")
+        releasing.status = TaskStatus.RELEASING
+        ni.add_task(releasing)
+        assert ni.releasing.milli_cpu == 1000
+        assert ni.idle.milli_cpu == 6000
+
+        # pipelined task consumes from releasing, not idle
+        pipelined = task(vocab, "pip")
+        pipelined.status = TaskStatus.PIPELINED
+        ni.add_task(pipelined)
+        assert ni.releasing.milli_cpu == 0
+        assert ni.idle.milli_cpu == 6000
+        assert ni.used.milli_cpu == 3000
+
+        ni.remove_task(pipelined)
+        assert ni.releasing.milli_cpu == 1000
+        ni.remove_task(releasing)
+        assert ni.idle.milli_cpu == 7000
+        ni.remove_task(running)
+        assert ni.idle.milli_cpu == 8000
+        assert ni.used.milli_cpu == 0
+
+    def test_duplicate_add_raises(self):
+        vocab = make_vocab()
+        ni = NodeInfo(vocab, build_node("n1", {"cpu": 8000, "memory": 1000}))
+        t = task(vocab, phase="Running", nodename="n1")
+        ni.add_task(t)
+        with pytest.raises(ValueError):
+            ni.add_task(t)
+
+    def test_out_of_sync_detection(self):
+        vocab = make_vocab()
+        node = build_node("n1", {"cpu": 8000, "memory": 1000})
+        ni = NodeInfo(vocab, node)
+        big = task(vocab, req={"cpu": 6000, "memory": 100}, phase="Running", nodename="n1")
+        ni.add_task(big)
+        # node shrank below usage -> OutOfSync
+        ni.set_node(build_node("n1", {"cpu": 4000, "memory": 1000}))
+        assert not ni.ready()
+        assert ni.state_reason == "OutOfSync"

@@ -1,0 +1,178 @@
+"""ResourceVec semantics tests (model: reference api/resource_info_test.go)."""
+
+import numpy as np
+import pytest
+
+from scheduler_tpu.api import ResourceVec, ResourceVocabulary, res_min, share
+from scheduler_tpu.api.vocab import MIN_MEMORY, MIN_MILLI_CPU
+from scheduler_tpu.utils.assertions import AssertionViolation
+
+GPU = "nvidia.com/gpu"
+
+
+def vec(cpu=0.0, mem=0.0, vocab=None, **scalars):
+    v = vocab or ResourceVocabulary([GPU])
+    d = {"cpu": cpu, "memory": mem}
+    d.update(scalars)
+    return ResourceVec.from_dict({k: val for k, val in d.items()}, v)
+
+
+class TestConstruction:
+    def test_from_dict_canonical_units(self):
+        vocab = ResourceVocabulary([GPU])
+        r = ResourceVec.from_dict(
+            {"cpu": 2000, "memory": 1024, GPU: 1000, "pods": 110}, vocab
+        )
+        assert r.milli_cpu == 2000
+        assert r.memory == 1024
+        assert r.get(GPU) == 1000
+        assert r.max_task_num == 110
+
+    def test_unknown_scalar_registers(self):
+        vocab = ResourceVocabulary()
+        r = ResourceVec.from_dict({"example.com/foo": 500}, vocab)
+        assert r.get("example.com/foo") == 500
+        assert "example.com/foo" in vocab
+
+    def test_vocab_growth_pads_existing_vectors(self):
+        vocab = ResourceVocabulary()
+        a = ResourceVec.from_dict({"cpu": 1000}, vocab)
+        b = ResourceVec.from_dict({"cpu": 1000, GPU: 2000}, vocab)
+        # a was created before GPU existed; operations still line up.
+        a.add(b)
+        assert a.get(GPU) == 2000
+        assert a.milli_cpu == 2000
+
+    def test_clone_is_independent(self):
+        a = vec(cpu=1000)
+        b = a.clone()
+        b.multi(2)
+        assert a.milli_cpu == 1000
+        assert b.milli_cpu == 2000
+
+
+class TestArithmetic:
+    def test_add(self):
+        a = vec(cpu=1000, mem=100)
+        a.add(vec(cpu=500, mem=50, vocab=a.vocab))
+        assert a.milli_cpu == 1500 and a.memory == 150
+
+    def test_sub(self):
+        a = vec(cpu=1000, mem=100)
+        a.sub(vec(cpu=400, mem=40, vocab=a.vocab))
+        assert a.milli_cpu == 600 and a.memory == 60
+
+    def test_sub_insufficient_asserts(self):
+        a = vec(cpu=100)
+        with pytest.raises(AssertionViolation):
+            a.sub(vec(cpu=1000, vocab=a.vocab))
+
+    def test_multi(self):
+        a = vec(cpu=1000, mem=100)
+        a.multi(1.2)
+        assert a.milli_cpu == pytest.approx(1200)
+
+    def test_set_max(self):
+        a = vec(cpu=1000, mem=10)
+        a.set_max(vec(cpu=500, mem=20, vocab=a.vocab))
+        assert a.milli_cpu == 1000 and a.memory == 20
+
+    def test_fit_delta_marks_shortfall_negative(self):
+        vocab = ResourceVocabulary([GPU])
+        avail = vec(cpu=1000, mem=0, vocab=vocab)
+        req = vec(cpu=2000, vocab=vocab)
+        avail.fit_delta(req)
+        assert avail.milli_cpu == 1000 - 2000 - MIN_MILLI_CPU
+        # memory untouched: request had no memory
+        assert avail.memory == 0
+
+    def test_diff(self):
+        a = vec(cpu=1000, mem=10)
+        b = vec(cpu=400, mem=20, vocab=a.vocab)
+        inc, dec = a.diff(b)
+        assert inc.milli_cpu == 600 and inc.memory == 0
+        assert dec.milli_cpu == 0 and dec.memory == 10
+
+
+class TestComparisons:
+    def test_less_equal_epsilon(self):
+        # within epsilon counts as equal (resource_info.go:253-276)
+        a = vec(cpu=1005, mem=100)
+        b = vec(cpu=1000, mem=100, vocab=a.vocab)
+        assert a.less_equal(b)  # |1000-1005| < 10
+        a2 = vec(cpu=1020, vocab=a.vocab)
+        assert not a2.less_equal(b)
+
+    def test_less_equal_memory_epsilon(self):
+        a = vec(mem=MIN_MEMORY - 1)
+        b = vec(mem=0, vocab=a.vocab)
+        assert a.less_equal(b)
+
+    def test_less_nil_map_quirk(self):
+        # Reference Less: both scalar maps nil -> false even when cpu/mem strictly
+        # less (resource_info.go:231-236); nil vs present -> true.
+        a = vec(cpu=999, mem=99)
+        b = vec(cpu=1000, mem=100, vocab=a.vocab)
+        assert not a.has_scalars and not b.has_scalars
+        assert not a.less(b)
+        c = vec(cpu=1000, mem=100, vocab=a.vocab, **{GPU: 1000})
+        assert a.less(c)      # nil vs present
+        assert not c.less(a)  # cpu/mem not strictly less the other way
+
+    def test_less_strict_with_scalars(self):
+        a = vec(cpu=999, mem=99, **{GPU: 100})
+        b = vec(cpu=1000, mem=100, vocab=a.vocab, **{GPU: 200})
+        assert a.less(b)
+        assert not b.less(a)
+        # equality is not less (no epsilon in Less)
+        assert not a.less(a.clone())
+
+    def test_less_requires_both_dims(self):
+        a = vec(cpu=999, mem=200, **{GPU: 10})
+        b = vec(cpu=1000, mem=100, vocab=a.vocab, **{GPU: 20})
+        assert not a.less(b)
+
+    def test_less_scalar_participates_when_nonzero(self):
+        vocab = ResourceVocabulary([GPU])
+        a = ResourceVec.from_dict({"cpu": 100, "memory": 10, GPU: 1000}, vocab)
+        b = ResourceVec.from_dict({"cpu": 200, "memory": 20}, vocab)
+        assert not a.less(b)  # gpu 1000 !< 0
+        c = ResourceVec.from_dict({"cpu": 200, "memory": 20, GPU: 2000}, vocab)
+        assert a.less(c)
+
+    def test_is_empty(self):
+        assert vec(cpu=9, mem=MIN_MEMORY - 1).is_empty()
+        assert not vec(cpu=10).is_empty()
+        vocab = ResourceVocabulary([GPU])
+        assert not ResourceVec.from_dict({GPU: 10}, vocab).is_empty()
+        assert ResourceVec.from_dict({GPU: 9}, vocab).is_empty()
+
+    def test_is_zero(self):
+        r = vec(cpu=5, mem=MIN_MEMORY * 2)
+        assert r.is_zero("cpu")
+        assert not r.is_zero("memory")
+        assert r.is_zero(GPU)
+
+
+class TestHelpers:
+    def test_share(self):
+        assert share(0, 0) == 0
+        assert share(5, 0) == 1
+        assert share(1, 4) == 0.25
+
+    def test_res_min(self):
+        a = vec(cpu=100, mem=200)
+        b = vec(cpu=200, mem=100, vocab=a.vocab)
+        m = res_min(a, b)
+        assert m.milli_cpu == 100 and m.memory == 100
+
+    def test_to_dict_roundtrip(self):
+        vocab = ResourceVocabulary([GPU])
+        d = {"cpu": 2000.0, "memory": 1024.0, GPU: 3000.0, "pods": 10.0}
+        r = ResourceVec.from_dict(d, vocab)
+        assert r.to_dict() == d
+
+    def test_array_view_is_dense(self):
+        vocab = ResourceVocabulary([GPU])
+        r = ResourceVec.from_dict({"cpu": 1, "memory": 2, GPU: 3}, vocab)
+        np.testing.assert_array_equal(r.array, [1.0, 2.0, 3.0])

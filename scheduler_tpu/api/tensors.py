@@ -1,0 +1,284 @@
+"""Snapshot tensors: the dense struct-of-arrays encoding of a scheduling Session.
+
+This is the host↔device boundary of the framework.  The reference walks pointer
+webs (JobInfo.TaskStatusIndex, NodeInfo.Tasks) with 16 goroutines
+(``util/scheduler_helper.go:34-129``); here the same information is laid out as
+resource matrices and index vectors so one jitted kernel can sweep every
+(task, node) pair on the MXU:
+
+* nodes  → ``NodeTensors``: [N, R] idle/releasing/used/allocatable matrices +
+  pod-count rows + a [N, L] label-pair membership mask.
+* tasks  → ``TaskTensors``: [T, R] request matrices, job index vector, priority /
+  creation vectors, [T, L] selector requirement mask.
+* jobs   → ``JobTensors``: min_available / queue index / priority vectors.
+
+Label vocabulary: every distinct (key, value) label pair seen on nodes or in
+selectors gets one column; "task selector ⊆ node labels" then compiles to a
+boolean matmul (see ``ops.predicates``).  Builders emit exact-size arrays; the
+device engine pads them to power-of-two buckets (``bucket``) at transfer time so
+XLA recompiles only when the cluster outgrows a capacity, not on every size
+change.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from scheduler_tpu.api.job_info import JobInfo, TaskInfo
+from scheduler_tpu.api.node_info import NodeInfo
+from scheduler_tpu.api.vocab import ResourceVocabulary
+
+
+def bucket(n: int, minimum: int = 8) -> int:
+    """Next power-of-two capacity — used by the device engine to pad tensor
+    shapes so XLA's compilation cache keys stay stable across small size drift."""
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+class LabelVocab:
+    """Append-only (key, value) label-pair vocabulary shared by one snapshot."""
+
+    def __init__(self) -> None:
+        self._index: Dict[Tuple[str, str], int] = {}
+
+    def index(self, key: str, value: str) -> int:
+        pair = (key, value)
+        idx = self._index.get(pair)
+        if idx is None:
+            idx = len(self._index)
+            self._index[pair] = idx
+        return idx
+
+    def lookup(self, key: str, value: str) -> Optional[int]:
+        return self._index.get((key, value))
+
+    @property
+    def size(self) -> int:
+        return len(self._index)
+
+
+@dataclass
+class NodeTensors:
+    names: List[str]
+    index: Dict[str, int]
+    idle: np.ndarray          # f64 [N, R]
+    releasing: np.ndarray     # f64 [N, R]
+    used: np.ndarray          # f64 [N, R]
+    allocatable: np.ndarray   # f64 [N, R]
+    pods_limit: np.ndarray    # i32 [N]
+    task_count: np.ndarray    # i32 [N]
+    ready: np.ndarray         # bool [N]
+    unschedulable: np.ndarray  # bool [N]
+    labels: np.ndarray        # bool [N, L]
+
+    @property
+    def count(self) -> int:
+        return len(self.names)
+
+
+@dataclass
+class TaskTensors:
+    uids: List[str]
+    index: Dict[str, int]
+    resreq: np.ndarray        # f64 [T, R]
+    init_resreq: np.ndarray   # f64 [T, R]
+    job_idx: np.ndarray       # i32 [T]  (into JobTensors)
+    priority: np.ndarray      # i32 [T]
+    creation: np.ndarray      # f64 [T]
+    best_effort: np.ndarray   # bool [T] (init_resreq below every epsilon)
+    selector: np.ndarray      # bool [T, L] required label pairs
+    has_unknown_selector: np.ndarray  # bool [T]: selector references a pair no node has
+
+    @property
+    def count(self) -> int:
+        return len(self.uids)
+
+
+@dataclass
+class JobTensors:
+    uids: List[str]
+    index: Dict[str, int]
+    min_available: np.ndarray  # i32 [J]
+    queue_idx: np.ndarray      # i32 [J]
+    priority: np.ndarray       # i32 [J]
+    creation: np.ndarray       # f64 [J]
+
+
+@dataclass
+class SnapshotTensors:
+    vocab: ResourceVocabulary
+    label_vocab: LabelVocab
+    min_thresholds: np.ndarray  # f64 [R]
+    nodes: NodeTensors
+    tasks: TaskTensors
+    jobs: JobTensors
+    queue_names: List[str] = field(default_factory=list)
+
+
+def build_node_tensors(
+    nodes: Sequence[NodeInfo],
+    vocab: ResourceVocabulary,
+    label_vocab: LabelVocab,
+) -> NodeTensors:
+    n = len(nodes)
+    r = vocab.size
+    idle = np.zeros((n, r))
+    releasing = np.zeros((n, r))
+    used = np.zeros((n, r))
+    allocatable = np.zeros((n, r))
+    pods_limit = np.zeros(n, dtype=np.int32)
+    task_count = np.zeros(n, dtype=np.int32)
+    ready = np.zeros(n, dtype=bool)
+    unschedulable = np.zeros(n, dtype=bool)
+
+    # First pass registers every node label pair so the mask width is final.
+    for ni in nodes:
+        if ni.node is not None:
+            for k, v in ni.node.labels.items():
+                label_vocab.index(k, v)
+            # hostname is an implicit label for topology/affinity matching
+            label_vocab.index("kubernetes.io/hostname", ni.name)
+
+    labels = np.zeros((n, label_vocab.size), dtype=bool)
+    names: List[str] = []
+    for i, ni in enumerate(nodes):
+        names.append(ni.name)
+        idle[i] = _fit(ni.idle.array, r)
+        releasing[i] = _fit(ni.releasing.array, r)
+        used[i] = _fit(ni.used.array, r)
+        allocatable[i] = _fit(ni.allocatable.array, r)
+        pods_limit[i] = ni.pods_limit
+        task_count[i] = len(ni.tasks)
+        ready[i] = ni.ready()
+        if ni.node is not None:
+            unschedulable[i] = ni.node.unschedulable
+            for k, v in ni.node.labels.items():
+                labels[i, label_vocab.index(k, v)] = True
+            labels[i, label_vocab.index("kubernetes.io/hostname", ni.name)] = True
+
+    return NodeTensors(
+        names=names,
+        index={name: i for i, name in enumerate(names)},
+        idle=idle,
+        releasing=releasing,
+        used=used,
+        allocatable=allocatable,
+        pods_limit=pods_limit,
+        task_count=task_count,
+        ready=ready,
+        unschedulable=unschedulable,
+        labels=labels,
+    )
+
+
+def _fit(arr: np.ndarray, r: int) -> np.ndarray:
+    if arr.shape[0] == r:
+        return arr
+    out = np.zeros(r)
+    out[: arr.shape[0]] = arr
+    return out
+
+
+def build_task_tensors(
+    tasks: Sequence[TaskInfo],
+    jobs: JobTensors,
+    vocab: ResourceVocabulary,
+    label_vocab: LabelVocab,
+) -> TaskTensors:
+    t = len(tasks)
+    r = vocab.size
+    mins = vocab.min_thresholds()
+    resreq = np.zeros((t, r))
+    init_resreq = np.zeros((t, r))
+    job_idx = np.full(t, -1, dtype=np.int32)
+    priority = np.zeros(t, dtype=np.int32)
+    creation = np.zeros(t)
+    selector = np.zeros((t, label_vocab.size), dtype=bool)
+    has_unknown = np.zeros(t, dtype=bool)
+
+    uids: List[str] = []
+    for i, ti in enumerate(tasks):
+        uids.append(ti.uid)
+        resreq[i] = _fit(ti.resreq.array, r)
+        init_resreq[i] = _fit(ti.init_resreq.array, r)
+        job_idx[i] = jobs.index.get(ti.job, -1)
+        priority[i] = ti.priority
+        creation[i] = ti.creation_timestamp
+        for k, v in ti.pod.node_selector.items():
+            idx = label_vocab.lookup(k, v)
+            if idx is None:
+                # No node carries this pair: the selector can never match.
+                has_unknown[i] = True
+            else:
+                selector[i, idx] = True
+
+    best_effort = np.all(init_resreq < mins[None, :], axis=1)
+
+    return TaskTensors(
+        uids=uids,
+        index={uid: i for i, uid in enumerate(uids)},
+        resreq=resreq,
+        init_resreq=init_resreq,
+        job_idx=job_idx,
+        priority=priority,
+        creation=creation,
+        best_effort=best_effort,
+        selector=selector,
+        has_unknown_selector=has_unknown,
+    )
+
+
+def build_job_tensors(jobs: Sequence[JobInfo], queue_names: List[str]) -> JobTensors:
+    j = len(jobs)
+    queue_index = {name: i for i, name in enumerate(queue_names)}
+    min_available = np.zeros(j, dtype=np.int32)
+    queue_idx = np.full(j, -1, dtype=np.int32)
+    priority = np.zeros(j, dtype=np.int32)
+    creation = np.zeros(j)
+    uids: List[str] = []
+    for i, job in enumerate(jobs):
+        uids.append(job.uid)
+        min_available[i] = job.min_available
+        queue_idx[i] = queue_index.get(job.queue, -1)
+        priority[i] = job.priority
+        creation[i] = job.creation_timestamp
+    return JobTensors(
+        uids=uids,
+        index={uid: i for i, uid in enumerate(uids)},
+        min_available=min_available,
+        queue_idx=queue_idx,
+        priority=priority,
+        creation=creation,
+    )
+
+
+def build_snapshot_tensors(
+    nodes: Iterable[NodeInfo],
+    jobs: Iterable[JobInfo],
+    tasks: Sequence[TaskInfo],
+    queue_names: List[str],
+    vocab: ResourceVocabulary,
+) -> SnapshotTensors:
+    """Encode one session's world.  ``tasks`` picks which tasks get rows (usually
+    the pending tasks the current action cares about), in the caller's order."""
+    label_vocab = LabelVocab()
+    node_list = sorted(nodes, key=lambda n: n.name)
+    job_list = list(jobs)
+    node_tensors = build_node_tensors(node_list, vocab, label_vocab)
+    job_tensors = build_job_tensors(job_list, queue_names)
+    task_tensors = build_task_tensors(tasks, job_tensors, vocab, label_vocab)
+    return SnapshotTensors(
+        vocab=vocab,
+        label_vocab=label_vocab,
+        min_thresholds=vocab.min_thresholds(),
+        nodes=node_tensors,
+        tasks=task_tensors,
+        jobs=job_tensors,
+        queue_names=list(queue_names),
+    )

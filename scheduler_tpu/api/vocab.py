@@ -39,12 +39,13 @@ class ResourceVocabulary:
     registering a new scalar resource mid-flight is cheap and safe.
     """
 
-    __slots__ = ("_index", "_names", "_mins")
+    __slots__ = ("_index", "_names", "_mins", "_mins_arr")
 
     def __init__(self, scalar_names: Iterable[str] = ()) -> None:
         self._index: Dict[str, int] = {RESOURCE_CPU: CPU, RESOURCE_MEMORY: MEMORY}
         self._names: List[str] = [RESOURCE_CPU, RESOURCE_MEMORY]
         self._mins: List[float] = [MIN_MILLI_CPU, MIN_MEMORY]
+        self._mins_arr: np.ndarray = np.asarray(self._mins, dtype=np.float64)
         for name in scalar_names:
             self.register(name)
 
@@ -66,6 +67,7 @@ class ResourceVocabulary:
             self._index[name] = dim
             self._names.append(name)
             self._mins.append(MIN_MILLI_SCALAR)
+            self._mins_arr = np.asarray(self._mins, dtype=np.float64)
         return dim
 
     def dim(self, name: str) -> int:
@@ -76,8 +78,8 @@ class ResourceVocabulary:
         return name in self._index
 
     def min_thresholds(self) -> np.ndarray:
-        """Per-dimension epsilon vector [R] (float64)."""
-        return np.asarray(self._mins, dtype=np.float64)
+        """Per-dimension epsilon vector [R] (float64, cached — treat as read-only)."""
+        return self._mins_arr
 
     def __repr__(self) -> str:
         return f"ResourceVocabulary({self._names!r})"

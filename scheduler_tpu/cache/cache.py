@@ -1,0 +1,397 @@
+"""SchedulerCache: mutable mirror of cluster state + side-effect executors.
+
+Reference: ``pkg/scheduler/cache/cache.go`` and ``event_handlers.go``.  Events
+arrive through the ``add_*/update_*/delete_*`` methods (the reference's informer
+callbacks — here invoked directly by an adapter, the test harness, or the synthetic
+workload driver); the scheduler only ever sees a deep-cloned ``snapshot()``.
+Snapshot isolation is the consistency model: decisions are made on a frozen copy;
+drift self-heals on the next cycle.
+
+Bind/evict mutate local state synchronously, then fire the Binder/Evictor
+asynchronously; failures roll the local mutation back (the standalone analogue of
+the reference's errTasks resync queue, ``cache.go:559-581``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from scheduler_tpu.api.cluster_info import ClusterInfo
+from scheduler_tpu.api.job_info import JobInfo, TaskInfo, job_id_for_pod
+from scheduler_tpu.api.node_info import NodeInfo
+from scheduler_tpu.api.queue_info import QueueInfo
+from scheduler_tpu.api.types import TaskStatus
+from scheduler_tpu.api.unschedule_info import ALL_NODE_UNAVAILABLE
+from scheduler_tpu.api.vocab import ResourceVocabulary
+from scheduler_tpu.apis.objects import (
+    GROUP_NAME_ANNOTATION,
+    NodeSpec,
+    PodGroup,
+    PodGroupPhase,
+    PodSpec,
+    Queue,
+)
+from scheduler_tpu.cache.fakes import FakeBinder, FakeEvictor, FakeStatusUpdater, FakeVolumeBinder
+from scheduler_tpu.cache.interface import Binder, Cache, Evictor, StatusUpdater, VolumeBinder
+
+logger = logging.getLogger("scheduler_tpu.cache")
+
+
+def shadow_pod_group_name(pod: PodSpec) -> str:
+    """Name of the synthesized PodGroup for a bare pod (reference cache/util.go:30-63)."""
+    return f"podgroup-{pod.uid}"
+
+
+class SchedulerCache(Cache):
+    def __init__(
+        self,
+        scheduler_name: str = "volcano",
+        default_queue: str = "default",
+        vocab: Optional[ResourceVocabulary] = None,
+        binder: Optional[Binder] = None,
+        evictor: Optional[Evictor] = None,
+        status_updater: Optional[StatusUpdater] = None,
+        volume_binder: Optional[VolumeBinder] = None,
+        async_io: bool = True,
+    ) -> None:
+        self.scheduler_name = scheduler_name
+        self.default_queue = default_queue
+        self.vocab = vocab if vocab is not None else ResourceVocabulary()
+
+        self.mutex = threading.RLock()
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.priority_classes: Dict[str, int] = {}
+
+        self.binder = binder if binder is not None else FakeBinder()
+        self.evictor = evictor if evictor is not None else FakeEvictor()
+        self.status_updater = status_updater if status_updater is not None else FakeStatusUpdater()
+        self.volume_binder = volume_binder if volume_binder is not None else FakeVolumeBinder()
+
+        self._async_io = async_io
+        self._io_pool: Optional[ThreadPoolExecutor] = None
+        self._running = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def run(self) -> None:
+        if self._async_io and self._io_pool is None:
+            self._io_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="cache-io")
+        self._running = True
+
+    def stop(self) -> None:
+        if self._io_pool is not None:
+            self._io_pool.shutdown(wait=True)
+            self._io_pool = None
+        self._running = False
+
+    def client(self):
+        return None
+
+    def _submit_io(self, fn, *args) -> None:
+        if self._io_pool is not None:
+            self._io_pool.submit(fn, *args)
+        else:
+            fn(*args)
+
+    # -- job/node accessors --------------------------------------------------
+
+    def _get_or_create_job(self, pod: PodSpec) -> Optional[JobInfo]:
+        """Find the pod's job, synthesizing a shadow PodGroup for bare pods owned
+        by this scheduler (event_handlers.go:42-67)."""
+        job_id = job_id_for_pod(pod)
+        if not job_id:
+            if pod.scheduler_name != self.scheduler_name:
+                return None
+            # Bare pod scheduled by us: synthesize a single-member gang.
+            pg = PodGroup(
+                name=shadow_pod_group_name(pod),
+                namespace=pod.namespace,
+                min_member=1,
+                queue=self.default_queue,
+            )
+            pg.status.phase = PodGroupPhase.INQUEUE
+            job_id = f"{pg.namespace}/{pg.name}"
+            pod.annotations = dict(pod.annotations)
+            pod.annotations[GROUP_NAME_ANNOTATION] = pg.name
+            job = self.jobs.get(job_id)
+            if job is None:
+                job = JobInfo(job_id, self.vocab)
+                self.jobs[job_id] = job
+            if job.pod_group is None:
+                job.set_pod_group(pg)
+            return job
+
+        job = self.jobs.get(job_id)
+        if job is None:
+            job = JobInfo(job_id, self.vocab)
+            self.jobs[job_id] = job
+        return job
+
+    def _get_or_create_node(self, name: str) -> NodeInfo:
+        node = self.nodes.get(name)
+        if node is None:
+            node = NodeInfo(self.vocab)  # un-initialized placeholder (node=None)
+            node.name = name
+            self.nodes[name] = node
+        return node
+
+    # -- pod events ----------------------------------------------------------
+
+    def add_pod(self, pod: PodSpec) -> None:
+        with self.mutex:
+            self._add_pod_locked(pod)
+
+    def _add_pod_locked(self, pod: PodSpec) -> None:
+        job = self._get_or_create_job(pod)
+        if job is None:
+            return  # not ours
+        task = TaskInfo(pod, self.vocab)
+        task.job = job.uid
+        job.add_task_info(task)
+        if pod.node_name:
+            self._get_or_create_node(pod.node_name).add_task(task)
+
+    def update_pod(self, pod: PodSpec) -> None:
+        with self.mutex:
+            self._delete_pod_locked(pod)
+            self._add_pod_locked(pod)
+
+    def delete_pod(self, pod: PodSpec) -> None:
+        with self.mutex:
+            self._delete_pod_locked(pod)
+
+    def _delete_pod_locked(self, pod: PodSpec) -> None:
+        job_id = job_id_for_pod(pod)
+        if not job_id:
+            # May have been adopted via a shadow PodGroup.
+            job_id = f"{pod.namespace}/{shadow_pod_group_name(pod)}"
+        job = self.jobs.get(job_id)
+        if job is not None:
+            task = job.tasks.get(pod.uid)
+            if task is not None:
+                job.delete_task_info(task)
+                if task.node_name and task.node_name in self.nodes:
+                    try:
+                        self.nodes[task.node_name].remove_task(task)
+                    except KeyError:
+                        pass
+            self._gc_job(job)
+
+    def _gc_job(self, job: JobInfo) -> None:
+        """Drop finished/empty jobs (the reference's deletedJobs GC queue)."""
+        if not job.tasks and job.pod_group is None:
+            self.jobs.pop(job.uid, None)
+
+    # -- node events ---------------------------------------------------------
+
+    def add_node(self, node: NodeSpec) -> None:
+        with self.mutex:
+            ni = self._get_or_create_node(node.name)
+            ni.set_node(node)
+
+    def update_node(self, node: NodeSpec) -> None:
+        with self.mutex:
+            ni = self._get_or_create_node(node.name)
+            ni.set_node(node)
+
+    def delete_node(self, node: NodeSpec) -> None:
+        with self.mutex:
+            self.nodes.pop(node.name, None)
+
+    # -- podgroup events ------------------------------------------------------
+
+    def add_pod_group(self, pg: PodGroup) -> None:
+        with self.mutex:
+            job_id = f"{pg.namespace}/{pg.name}"
+            job = self.jobs.get(job_id)
+            if job is None:
+                job = JobInfo(job_id, self.vocab)
+                self.jobs[job_id] = job
+            job.set_pod_group(pg)
+
+    def update_pod_group(self, pg: PodGroup) -> None:
+        self.add_pod_group(pg)
+
+    def delete_pod_group(self, pg: PodGroup) -> None:
+        with self.mutex:
+            job_id = f"{pg.namespace}/{pg.name}"
+            job = self.jobs.get(job_id)
+            if job is not None:
+                job.unset_pod_group()
+                self._gc_job(job)
+
+    # -- queue events ---------------------------------------------------------
+
+    def add_queue(self, queue: Queue) -> None:
+        with self.mutex:
+            self.queues[queue.name] = QueueInfo(queue)
+
+    def update_queue(self, queue: Queue) -> None:
+        self.add_queue(queue)
+
+    def delete_queue(self, queue: Queue) -> None:
+        with self.mutex:
+            self.queues.pop(queue.name, None)
+
+    # -- priority classes ------------------------------------------------------
+
+    def add_priority_class(self, name: str, value: int) -> None:
+        with self.mutex:
+            self.priority_classes[name] = value
+
+    def delete_priority_class(self, name: str) -> None:
+        with self.mutex:
+            self.priority_classes.pop(name, None)
+
+    # -- snapshot (cache.go:584-654) -------------------------------------------
+
+    def snapshot(self) -> ClusterInfo:
+        with self.mutex:
+            info = ClusterInfo(self.vocab)
+            for name, node in self.nodes.items():
+                info.nodes[name] = node.clone()
+            for name, queue in self.queues.items():
+                info.queues[name] = queue.clone()
+            for job_id, job in self.jobs.items():
+                if job.pod_group is None:
+                    logger.debug("job %s skipped in snapshot: missing PodGroup", job_id)
+                    continue
+                clone = job.clone()
+                if clone.pod_group is not None:
+                    pc = self.priority_classes.get(clone.pod_group.priority_class_name)
+                    if pc is not None:
+                        clone.priority = pc
+                    # Sessions mutate PodGroup status; give them their own copy.
+                    pg = PodGroup(**{
+                        "name": clone.pod_group.name,
+                        "namespace": clone.pod_group.namespace,
+                        "min_member": clone.pod_group.min_member,
+                        "queue": clone.pod_group.queue,
+                        "priority_class_name": clone.pod_group.priority_class_name,
+                        "min_resources": clone.pod_group.min_resources,
+                    })
+                    pg.uid = clone.pod_group.uid
+                    pg.creation_timestamp = clone.pod_group.creation_timestamp
+                    pg.status = clone.pod_group.status.clone()
+                    clone.pod_group = pg
+                info.jobs[job_id] = clone
+            return info
+
+    # -- scheduling side effects (cache.go:404-487) -----------------------------
+
+    def _find_job_and_task(self, ti: TaskInfo):
+        job = self.jobs.get(ti.job)
+        if job is None:
+            raise KeyError(f"failed to find job {ti.job}")
+        task = job.tasks.get(ti.uid)
+        if task is None:
+            raise KeyError(f"failed to find task {ti.uid} in job {ti.job}")
+        return job, task
+
+    def bind(self, ti: TaskInfo, hostname: str) -> None:
+        """Update local state, then dispatch the bind asynchronously."""
+        with self.mutex:
+            job, task = self._find_job_and_task(ti)
+            node = self.nodes.get(hostname)
+            if node is None:
+                raise KeyError(f"failed to find node {hostname}")
+            job.update_task_status(task, TaskStatus.BINDING)
+            task.node_name = hostname
+            node.add_task(task)
+
+        def do_bind() -> None:
+            try:
+                self.binder.bind(task.pod, hostname)
+                with self.mutex:
+                    task.pod.node_name = hostname
+            except Exception:
+                logger.exception("bind of %s to %s failed; resyncing", task.uid, hostname)
+                self._resync_failed_bind(task, hostname)
+
+        self._submit_io(do_bind)
+
+    def _resync_failed_bind(self, ti: TaskInfo, hostname: str) -> None:
+        with self.mutex:
+            try:
+                job, task = self._find_job_and_task(ti)
+            except KeyError:
+                return
+            node = self.nodes.get(hostname)
+            if node is not None and task.uid in node.tasks:
+                node.remove_task(task)
+            task.node_name = ""
+            job.update_task_status(task, TaskStatus.PENDING)
+
+    def evict(self, ti: TaskInfo, reason: str) -> None:
+        """Mark releasing locally, then dispatch the eviction asynchronously."""
+        with self.mutex:
+            job, task = self._find_job_and_task(ti)
+            job.update_task_status(task, TaskStatus.RELEASING)
+            if task.node_name and task.node_name in self.nodes:
+                node = self.nodes[task.node_name]
+                if task.uid in node.tasks:
+                    node.update_task(task)
+
+        def do_evict() -> None:
+            try:
+                self.evictor.evict(task.pod)
+            except Exception:
+                logger.exception("evict of %s failed; resyncing", task.uid)
+                with self.mutex:
+                    try:
+                        job2, task2 = self._find_job_and_task(ti)
+                    except KeyError:
+                        return
+                    job2.update_task_status(task2, TaskStatus.RUNNING)
+                    if task2.node_name and task2.node_name in self.nodes:
+                        node2 = self.nodes[task2.node_name]
+                        if task2.uid in node2.tasks:
+                            node2.update_task(task2)
+
+        self._submit_io(do_evict)
+
+    def update_job_status(self, job: JobInfo, update_pg: bool = True) -> Optional[JobInfo]:
+        """Record unschedulable events and push a recomputed PodGroup status
+        (reference cache.go UpdateJobStatus + defaultStatusUpdater)."""
+        self.record_job_status_event(job)
+        if update_pg:
+            with self.mutex:
+                cached = self.jobs.get(job.uid)
+                if cached is not None and cached.pod_group is not None:
+                    cached.pod_group.status = job.pod_group.status.clone()
+            self.status_updater.update_pod_group(job)
+        return job
+
+    def record_job_status_event(self, job: JobInfo) -> None:
+        """Emit unschedulable conditions for unscheduled tasks (cache.go:500-525)."""
+        base_msg = job.job_fit_errors or ALL_NODE_UNAVAILABLE
+        for status, tasks in job.task_status_index.items():
+            if status != TaskStatus.PENDING:
+                continue
+            for task in tasks.values():
+                fe = job.nodes_fit_errors.get(task.uid)
+                msg = fe.error() if fe is not None else base_msg
+                self.status_updater.update_pod_condition(
+                    task.pod,
+                    {"type": "PodScheduled", "status": "False",
+                     "reason": "Unschedulable", "message": msg},
+                )
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        self.volume_binder.allocate_volumes(task, hostname)
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        self.volume_binder.bind_volumes(task)
+
+    # -- convenience for tests / harnesses -------------------------------------
+
+    def wait_io(self) -> None:
+        """Drain pending async bind/evict IO (replaces sleeps in tests)."""
+        if self._io_pool is not None:
+            self._io_pool.shutdown(wait=True)
+            self._io_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="cache-io")

@@ -1,0 +1,67 @@
+"""Fake side-effect interfaces for cluster-free testing
+(reference ``pkg/scheduler/util/test_utils.go:95-163``).
+
+FakeBinder/FakeEvictor record intents into lists + a queue.Queue "channel" so
+tests can wait on them with a timeout, exactly like the reference's Go channels.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List
+
+from scheduler_tpu.cache.interface import Binder, Evictor, StatusUpdater, VolumeBinder
+
+
+class FakeBinder(Binder):
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.binds: dict = {}
+        self.channel: "queue.Queue[str]" = queue.Queue()
+
+    def bind(self, pod, hostname: str) -> None:
+        with self.lock:
+            key = f"{pod.namespace}/{pod.name}"
+            self.binds[key] = hostname
+            self.channel.put(key)
+
+    def wait(self, n: int, timeout: float = 3.0) -> List[str]:
+        """Block until n binds were recorded (or raise queue.Empty)."""
+        return [self.channel.get(timeout=timeout) for _ in range(n)]
+
+
+class FakeEvictor(Evictor):
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.evicts: List[str] = []
+        self.channel: "queue.Queue[str]" = queue.Queue()
+
+    def evict(self, pod) -> None:
+        with self.lock:
+            key = f"{pod.namespace}/{pod.name}"
+            self.evicts.append(key)
+            self.channel.put(key)
+
+    def wait(self, n: int, timeout: float = 3.0) -> List[str]:
+        return [self.channel.get(timeout=timeout) for _ in range(n)]
+
+
+class FakeStatusUpdater(StatusUpdater):
+    def __init__(self) -> None:
+        self.pod_conditions: List = []
+        self.pod_group_updates: List = []
+
+    def update_pod_condition(self, pod, condition) -> None:
+        self.pod_conditions.append((pod, condition))
+
+    def update_pod_group(self, job) -> None:
+        self.pod_group_updates.append(job)
+
+
+class FakeVolumeBinder(VolumeBinder):
+    def allocate_volumes(self, task, hostname: str) -> None:
+        pass
+
+    def bind_volumes(self, task) -> None:
+        pass

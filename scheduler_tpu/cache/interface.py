@@ -1,0 +1,77 @@
+"""The cache seam: Cache plus its side-effect interfaces
+(reference ``pkg/scheduler/cache/interface.go:27-78``).
+
+Binder/Evictor/StatusUpdater/VolumeBinder are the only places the scheduler
+touches the outside world; swapping fakes in makes every action testable without
+a cluster — the reference's key test pattern (SURVEY.md §4b) preserved here.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:
+    from scheduler_tpu.api.cluster_info import ClusterInfo
+    from scheduler_tpu.api.job_info import JobInfo, TaskInfo
+    from scheduler_tpu.apis.objects import PodGroupCondition, PodSpec
+
+
+class Binder(abc.ABC):
+    @abc.abstractmethod
+    def bind(self, pod: "PodSpec", hostname: str) -> None: ...
+
+
+class Evictor(abc.ABC):
+    @abc.abstractmethod
+    def evict(self, pod: "PodSpec") -> None: ...
+
+
+class StatusUpdater(abc.ABC):
+    """Pushes pod conditions and PodGroup status back to the system of record."""
+
+    @abc.abstractmethod
+    def update_pod_condition(self, pod: "PodSpec", condition) -> None: ...
+
+    @abc.abstractmethod
+    def update_pod_group(self, job: "JobInfo") -> None: ...
+
+
+class VolumeBinder(abc.ABC):
+    @abc.abstractmethod
+    def allocate_volumes(self, task: "TaskInfo", hostname: str) -> None: ...
+
+    @abc.abstractmethod
+    def bind_volumes(self, task: "TaskInfo") -> None: ...
+
+
+class Cache(abc.ABC):
+    """What a Session needs from the cluster-state mirror (interface.go:27-56)."""
+
+    @abc.abstractmethod
+    def run(self) -> None: ...
+
+    @abc.abstractmethod
+    def snapshot(self) -> "ClusterInfo": ...
+
+    @abc.abstractmethod
+    def bind(self, task: "TaskInfo", hostname: str) -> None: ...
+
+    @abc.abstractmethod
+    def evict(self, task: "TaskInfo", reason: str) -> None: ...
+
+    @abc.abstractmethod
+    def update_job_status(self, job: "JobInfo", update_pg: bool = True) -> Optional["JobInfo"]: ...
+
+    @abc.abstractmethod
+    def record_job_status_event(self, job: "JobInfo") -> None: ...
+
+    @abc.abstractmethod
+    def allocate_volumes(self, task: "TaskInfo", hostname: str) -> None: ...
+
+    @abc.abstractmethod
+    def bind_volumes(self, task: "TaskInfo") -> None: ...
+
+    @abc.abstractmethod
+    def client(self):
+        """Handle to the backing API client (None for fake-backed caches)."""

@@ -1,0 +1,74 @@
+"""OpenSession / CloseSession (reference ``framework/framework.go:30-63``)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List
+
+from scheduler_tpu.conf import Tier
+from scheduler_tpu.framework.arguments import Arguments
+from scheduler_tpu.framework.job_updater import JobUpdater
+from scheduler_tpu.framework.registry import get_plugin_builder
+from scheduler_tpu.framework.session import Session
+from scheduler_tpu.utils import metrics
+
+logger = logging.getLogger("scheduler_tpu.framework")
+
+
+def open_session(cache, tiers: List[Tier]) -> Session:
+    """Snapshot the cache into a new Session and open every configured plugin.
+
+    Note on JobValid: the reference runs a JobValid sweep inside openSession
+    (session.go:107-124), but at that point no plugin has registered a
+    jobValidFns entry yet (plugins open *after* openSession returns,
+    framework.go:31-49), so the sweep never drops anything; the real validation
+    happens per-job inside each action (e.g. allocate.go:53).  We skip the dead
+    sweep and keep the per-action checks.
+    """
+    ssn = Session(cache, tiers)
+
+    snapshot = cache.snapshot()
+    ssn.jobs = snapshot.jobs
+    for job in ssn.jobs.values():
+        if job.pod_group is not None and job.pod_group.status.conditions:
+            ssn.pod_group_status[job.uid] = job.pod_group.status.clone()
+    ssn.nodes = snapshot.nodes
+    ssn.queues = snapshot.queues
+
+    for tier in tiers:
+        for option in tier.plugins:
+            if option.name in ssn.plugins:
+                continue
+            builder = get_plugin_builder(option.name)
+            if builder is None:
+                logger.error("failed to get plugin %s", option.name)
+                continue
+            ssn.plugins[option.name] = builder(Arguments.of(option.arguments))
+
+    for plugin in ssn.plugins.values():
+        start = time.monotonic()
+        plugin.on_session_open(ssn)
+        metrics.update_plugin_duration(plugin.name(), "OnSessionOpen", time.monotonic() - start)
+
+    logger.debug(
+        "open session %s with %d jobs and %d queues", ssn.uid, len(ssn.jobs), len(ssn.queues)
+    )
+    return ssn
+
+
+def close_session(ssn: Session) -> None:
+    """Plugin close hooks + job status push-back (framework.go:55-63)."""
+    for plugin in ssn.plugins.values():
+        start = time.monotonic()
+        plugin.on_session_close(ssn)
+        metrics.update_plugin_duration(plugin.name(), "OnSessionClose", time.monotonic() - start)
+
+    JobUpdater(ssn).update_all()
+
+    ssn.jobs = {}
+    ssn.nodes = {}
+    ssn.queues = {}
+    ssn.plugins = {}
+    ssn.event_handlers = []
+    logger.debug("close session %s", ssn.uid)

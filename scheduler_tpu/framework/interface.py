@@ -1,0 +1,63 @@
+"""Action / Plugin interfaces and session events
+(reference ``framework/interface.go:20-42``, ``event.go:24-32``)."""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Callable, Optional
+
+if TYPE_CHECKING:
+    from scheduler_tpu.api.job_info import TaskInfo
+    from scheduler_tpu.framework.session import Session
+
+
+class Action(abc.ABC):
+    """One scheduling pass over a Session (enqueue/allocate/backfill/preempt/reclaim)."""
+
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    def initialize(self) -> None:
+        pass
+
+    @abc.abstractmethod
+    def execute(self, ssn: "Session") -> None: ...
+
+    def uninitialize(self) -> None:
+        pass
+
+
+class Plugin(abc.ABC):
+    """A policy: registers callbacks into the Session on open."""
+
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def on_session_open(self, ssn: "Session") -> None: ...
+
+    def on_session_close(self, ssn: "Session") -> None:
+        pass
+
+
+@dataclass
+class Event:
+    task: "TaskInfo"
+
+
+@dataclass
+class EventHandler:
+    """Callbacks fired on session allocate/deallocate so plugins keep shares live."""
+
+    allocate_func: Optional[Callable[[Event], None]] = None
+    deallocate_func: Optional[Callable[[Event], None]] = None
+
+
+@dataclass
+class ValidateResult:
+    """Result of a JobValid check (reference api/types.go ValidateResult)."""
+
+    passed: bool
+    reason: str = ""
+    message: str = ""

@@ -1,0 +1,81 @@
+"""Job status push-back at session close (reference ``framework/job_updater.go``).
+
+Recomputes each job's PodGroup status, diffs against the snapshot-time status
+(with the reference's jittered time-based condition dedup) and pushes changes
+through the cache.  The reference fans this across 16 workers; here the push is
+a cheap in-process call, so a thread pool is used only above a size threshold.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import TYPE_CHECKING
+
+from scheduler_tpu.apis.objects import PodGroupStatus
+
+if TYPE_CHECKING:
+    from scheduler_tpu.framework.session import Session
+
+JOB_UPDATER_WORKERS = 16
+_JOB_CONDITION_UPDATE_TIME = 60.0       # seconds (job_updater.go:20-22)
+_JOB_CONDITION_UPDATE_JITTER = 30.0
+
+
+def _time_jitter_after(last: float) -> bool:
+    interval = _JOB_CONDITION_UPDATE_TIME + random.uniform(0, _JOB_CONDITION_UPDATE_JITTER)
+    return time.time() - last > interval
+
+
+def is_pod_group_status_updated(new: PodGroupStatus, old: PodGroupStatus) -> bool:
+    """Has the status meaningfully changed (job_updater.go:55-100)?
+
+    Condition churn is deduped: an Unschedulable condition with only a new
+    transition id/time counts as changed only after the jittered refresh window.
+    """
+    if (
+        new.phase != old.phase
+        or new.running != old.running
+        or new.succeeded != old.succeeded
+        or new.failed != old.failed
+    ):
+        return True
+
+    new_conds = {c.type: c for c in new.conditions}
+    old_conds = {c.type: c for c in old.conditions}
+    if set(new_conds) != set(old_conds):
+        return True
+    for ctype, nc in new_conds.items():
+        oc = old_conds[ctype]
+        if nc.status != oc.status or nc.reason != oc.reason or nc.message != oc.message:
+            return True
+        if nc.transition_id != oc.transition_id:
+            # Same content, new transition: refresh only periodically.
+            if _time_jitter_after(oc.last_transition_time):
+                return True
+    return False
+
+
+class JobUpdater:
+    def __init__(self, ssn: "Session") -> None:
+        self.ssn = ssn
+        self.job_queue = [job for job in ssn.jobs.values() if job.pod_group is not None]
+
+    def _update_job(self, job) -> None:
+        from scheduler_tpu.framework.session import job_status
+
+        ssn = self.ssn
+        job.pod_group.status = job_status(ssn, job)
+        old = ssn.pod_group_status.get(job.uid)
+        update_pg = old is None or is_pod_group_status_updated(job.pod_group.status, old)
+        ssn.cache.update_job_status(job, update_pg)
+
+    def update_all(self) -> None:
+        jobs = self.job_queue
+        if len(jobs) > 64:
+            with ThreadPoolExecutor(max_workers=JOB_UPDATER_WORKERS) as pool:
+                list(pool.map(self._update_job, jobs))
+        else:
+            for job in jobs:
+                self._update_job(job)

@@ -1,0 +1,445 @@
+"""The scheduling Session: one cycle's frozen world + plugin dispatch + mutations.
+
+Reference: ``pkg/scheduler/framework/session.go`` (state + mutation ops) and
+``session_plugins.go`` (tiered dispatch).  The dispatch semantics are the plugin
+contract and are preserved exactly:
+
+* ``reclaimable``/``preemptable``: per tier, *intersection* of every enabled
+  plugin's victim list; first tier that produced a non-None list wins
+  (session_plugins.go:100-182).
+* ``job_ready``/``job_pipelined``/``job_enqueueable``: veto-AND across all tiers.
+* ``job_order``/``queue_order``/``task_order``: first nonzero comparison wins;
+  fallback orders by creation timestamp then UID.
+* ``predicate``: error short-circuit across tiers.
+* ``node_order`` family: additive across tiers.
+* ``overused``: first True wins.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+from typing import Callable, Dict, List, Optional, TYPE_CHECKING
+
+from scheduler_tpu.api.job_info import JobInfo, TaskInfo
+from scheduler_tpu.api.node_info import NodeInfo
+from scheduler_tpu.api.queue_info import QueueInfo
+from scheduler_tpu.api.types import ALLOCATED_STATUSES, TaskStatus
+from scheduler_tpu.apis.objects import (
+    PodGroupCondition,
+    PodGroupPhase,
+    PodGroupStatus,
+    POD_GROUP_UNSCHEDULABLE_TYPE,
+)
+from scheduler_tpu.conf import Tier
+from scheduler_tpu.framework.interface import Event, EventHandler, Plugin, ValidateResult
+
+if TYPE_CHECKING:
+    from scheduler_tpu.cache.interface import Cache
+    from scheduler_tpu.framework.statement import Statement
+
+logger = logging.getLogger("scheduler_tpu.session")
+
+_session_counter = itertools.count(1)
+
+
+class Session:
+    def __init__(self, cache: "Cache", tiers: Optional[List[Tier]] = None) -> None:
+        self.uid: str = f"ssn-{next(_session_counter)}"
+        self.cache = cache
+        self.tiers: List[Tier] = tiers or []
+
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+
+        self.pod_group_status: Dict[str, PodGroupStatus] = {}
+
+        self.plugins: Dict[str, Plugin] = {}
+        self.event_handlers: List[EventHandler] = []
+
+        self.job_order_fns: Dict[str, Callable] = {}
+        self.queue_order_fns: Dict[str, Callable] = {}
+        self.task_order_fns: Dict[str, Callable] = {}
+        self.predicate_fns: Dict[str, Callable] = {}
+        self.node_order_fns: Dict[str, Callable] = {}
+        self.batch_node_order_fns: Dict[str, Callable] = {}
+        self.node_map_fns: Dict[str, Callable] = {}
+        self.node_reduce_fns: Dict[str, Callable] = {}
+        self.preemptable_fns: Dict[str, Callable] = {}
+        self.reclaimable_fns: Dict[str, Callable] = {}
+        self.overused_fns: Dict[str, Callable] = {}
+        self.job_ready_fns: Dict[str, Callable] = {}
+        self.job_pipelined_fns: Dict[str, Callable] = {}
+        self.job_valid_fns: Dict[str, Callable] = {}
+        self.job_enqueueable_fns: Dict[str, Callable] = {}
+
+        # Device-engine handles installed by plugins (TPU-native extension):
+        # plugins contribute mask/score tensor builders here instead of (or in
+        # addition to) per-task host callbacks; actions fuse them into one kernel.
+        self.device_predicates: List = []
+        self.device_scorers: List = []
+
+    # -- registration (Add*Fn) ----------------------------------------------
+
+    def add_job_order_fn(self, name: str, fn: Callable) -> None:
+        self.job_order_fns[name] = fn
+
+    def add_queue_order_fn(self, name: str, fn: Callable) -> None:
+        self.queue_order_fns[name] = fn
+
+    def add_task_order_fn(self, name: str, fn: Callable) -> None:
+        self.task_order_fns[name] = fn
+
+    def add_predicate_fn(self, name: str, fn: Callable) -> None:
+        self.predicate_fns[name] = fn
+
+    def add_node_order_fn(self, name: str, fn: Callable) -> None:
+        self.node_order_fns[name] = fn
+
+    def add_batch_node_order_fn(self, name: str, fn: Callable) -> None:
+        self.batch_node_order_fns[name] = fn
+
+    def add_node_map_fn(self, name: str, fn: Callable) -> None:
+        self.node_map_fns[name] = fn
+
+    def add_node_reduce_fn(self, name: str, fn: Callable) -> None:
+        self.node_reduce_fns[name] = fn
+
+    def add_preemptable_fn(self, name: str, fn: Callable) -> None:
+        self.preemptable_fns[name] = fn
+
+    def add_reclaimable_fn(self, name: str, fn: Callable) -> None:
+        self.reclaimable_fns[name] = fn
+
+    def add_overused_fn(self, name: str, fn: Callable) -> None:
+        self.overused_fns[name] = fn
+
+    def add_job_ready_fn(self, name: str, fn: Callable) -> None:
+        self.job_ready_fns[name] = fn
+
+    def add_job_pipelined_fn(self, name: str, fn: Callable) -> None:
+        self.job_pipelined_fns[name] = fn
+
+    def add_job_valid_fn(self, name: str, fn: Callable) -> None:
+        self.job_valid_fns[name] = fn
+
+    def add_job_enqueueable_fn(self, name: str, fn: Callable) -> None:
+        self.job_enqueueable_fns[name] = fn
+
+    def add_event_handler(self, eh: EventHandler) -> None:
+        self.event_handlers.append(eh)
+
+    def add_device_predicate(self, builder) -> None:
+        self.device_predicates.append(builder)
+
+    def add_device_scorer(self, builder) -> None:
+        self.device_scorers.append(builder)
+
+    # -- tiered dispatch ------------------------------------------------------
+
+    def _victims(self, fns: Dict[str, Callable], enabled_key: str, subject, candidates):
+        """Victim aggregation, mirroring session_plugins.go:100-182 exactly.
+
+        Plugin fns return a list of victims or ``None`` (the Go nil slice).  The
+        FIRST enabled fn anywhere initializes the victim set — even to None —
+        and every later enabled fn across ALL tiers intersects into it (the
+        reference's ``init`` flag outlives the tier loop); an empty intersection
+        collapses back to None (Go's nil intersection slice).  After each tier,
+        a non-None set decides and lower tiers are never consulted.
+        """
+        victims: Optional[list] = None
+        init = False
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not getattr(plugin, enabled_key)():
+                    continue
+                fn = fns.get(plugin.name)
+                if fn is None:
+                    continue
+                cand = fn(subject, candidates)
+                if not init:
+                    victims = None if cand is None else list(cand)
+                    init = True
+                else:
+                    cand_uids = {c.uid for c in (cand or [])}
+                    inter = [v for v in (victims or []) if v.uid in cand_uids]
+                    victims = inter if inter else None
+            if victims is not None:
+                return victims
+        return []
+
+    def reclaimable(self, reclaimer: TaskInfo, reclaimees: List[TaskInfo]) -> List[TaskInfo]:
+        return self._victims(self.reclaimable_fns, "reclaimable_enabled", reclaimer, reclaimees)
+
+    def preemptable(self, preemptor: TaskInfo, preemptees: List[TaskInfo]) -> List[TaskInfo]:
+        return self._victims(self.preemptable_fns, "preemptable_enabled", preemptor, preemptees)
+
+    def overused(self, queue: QueueInfo) -> bool:
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                fn = self.overused_fns.get(plugin.name)
+                if fn is not None and fn(queue):
+                    return True
+        return False
+
+    def _veto_and(self, fns: Dict[str, Callable], enabled_key: str, obj) -> bool:
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not getattr(plugin, enabled_key)():
+                    continue
+                fn = fns.get(plugin.name)
+                if fn is not None and not fn(obj):
+                    return False
+        return True
+
+    def job_ready(self, job: JobInfo) -> bool:
+        return self._veto_and(self.job_ready_fns, "job_ready_enabled", job)
+
+    def job_pipelined(self, job: JobInfo) -> bool:
+        return self._veto_and(self.job_pipelined_fns, "job_pipelined_enabled", job)
+
+    def job_enqueueable(self, job: JobInfo) -> bool:
+        # No enable flag for enqueueable in the reference (session_plugins.go:262-278).
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                fn = self.job_enqueueable_fns.get(plugin.name)
+                if fn is not None and not fn(job):
+                    return False
+        return True
+
+    def job_valid(self, job: JobInfo) -> Optional[ValidateResult]:
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                fn = self.job_valid_fns.get(plugin.name)
+                if fn is None:
+                    continue
+                vr = fn(job)
+                if vr is not None and not vr.passed:
+                    return vr
+        return None
+
+    def _ordered(self, fns: Dict[str, Callable], enabled_key: str, l, r) -> Optional[bool]:
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not getattr(plugin, enabled_key)():
+                    continue
+                fn = fns.get(plugin.name)
+                if fn is None:
+                    continue
+                j = fn(l, r)
+                if j != 0:
+                    return j < 0
+        return None
+
+    def job_order_fn(self, l: JobInfo, r: JobInfo) -> bool:
+        res = self._ordered(self.job_order_fns, "job_order_enabled", l, r)
+        if res is not None:
+            return res
+        if l.creation_timestamp == r.creation_timestamp:
+            return l.uid < r.uid
+        return l.creation_timestamp < r.creation_timestamp
+
+    def queue_order_fn(self, l: QueueInfo, r: QueueInfo) -> bool:
+        res = self._ordered(self.queue_order_fns, "queue_order_enabled", l, r)
+        if res is not None:
+            return res
+        if l.creation_timestamp == r.creation_timestamp:
+            return l.uid < r.uid
+        return l.creation_timestamp < r.creation_timestamp
+
+    def task_compare_fns(self, l: TaskInfo, r: TaskInfo) -> int:
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not plugin.task_order_enabled():
+                    continue
+                fn = self.task_order_fns.get(plugin.name)
+                if fn is None:
+                    continue
+                j = fn(l, r)
+                if j != 0:
+                    return j
+        return 0
+
+    def task_order_fn(self, l: TaskInfo, r: TaskInfo) -> bool:
+        res = self.task_compare_fns(l, r)
+        if res != 0:
+            return res < 0
+        if l.creation_timestamp == r.creation_timestamp:
+            return l.uid < r.uid
+        return l.creation_timestamp < r.creation_timestamp
+
+    def predicate_fn(self, task: TaskInfo, node: NodeInfo) -> None:
+        """Raises FitError on the first failing predicate (error short-circuit)."""
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not plugin.predicate_enabled():
+                    continue
+                fn = self.predicate_fns.get(plugin.name)
+                if fn is not None:
+                    fn(task, node)  # raises on failure
+
+    def node_order_fn(self, task: TaskInfo, node: NodeInfo) -> float:
+        score = 0.0
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not plugin.node_order_enabled():
+                    continue
+                fn = self.node_order_fns.get(plugin.name)
+                if fn is not None:
+                    score += fn(task, node)
+        return score
+
+    def batch_node_order_fn(self, task: TaskInfo, nodes: List[NodeInfo]) -> Dict[str, float]:
+        scores: Dict[str, float] = {}
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not plugin.node_order_enabled():
+                    continue
+                fn = self.batch_node_order_fns.get(plugin.name)
+                if fn is None:
+                    continue
+                for node_name, s in fn(task, nodes).items():
+                    scores[node_name] = scores.get(node_name, 0.0) + s
+        return scores
+
+    def node_order_map_fn(self, task: TaskInfo, node: NodeInfo):
+        """(per-plugin map scores, summed order score) for one node."""
+        node_score_map: Dict[str, float] = {}
+        priority_score = 0.0
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not plugin.node_order_enabled():
+                    continue
+                fn = self.node_order_fns.get(plugin.name)
+                if fn is not None:
+                    priority_score += fn(task, node)
+                mfn = self.node_map_fns.get(plugin.name)
+                if mfn is not None:
+                    node_score_map[plugin.name] = mfn(task, node)
+        return node_score_map, priority_score
+
+    def node_order_reduce_fn(self, task: TaskInfo, plugin_node_scores: Dict[str, Dict[str, float]]) -> Dict[str, float]:
+        node_scores: Dict[str, float] = {}
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not plugin.node_order_enabled():
+                    continue
+                rfn = self.node_reduce_fns.get(plugin.name)
+                if rfn is None:
+                    continue
+                reduced = rfn(task, plugin_node_scores.get(plugin.name, {}))
+                for host, s in reduced.items():
+                    node_scores[host] = node_scores.get(host, 0.0) + s
+        return node_scores
+
+    # -- mutation ops (session.go:199-363) ------------------------------------
+
+    def statement(self) -> "Statement":
+        from scheduler_tpu.framework.statement import Statement
+
+        return Statement(self)
+
+    def _fire_allocate(self, task: TaskInfo) -> None:
+        for eh in self.event_handlers:
+            if eh.allocate_func is not None:
+                eh.allocate_func(Event(task))
+
+    def _fire_deallocate(self, task: TaskInfo) -> None:
+        for eh in self.event_handlers:
+            if eh.deallocate_func is not None:
+                eh.deallocate_func(Event(task))
+
+    def pipeline(self, task: TaskInfo, hostname: str) -> None:
+        """Assign onto releasing resources; session-state only (session.go:199-239)."""
+        job = self.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job} when pipelining")
+        job.update_task_status(task, TaskStatus.PIPELINED)
+        task.node_name = hostname
+        node = self.nodes.get(hostname)
+        if node is None:
+            raise KeyError(f"failed to find node {hostname}")
+        node.add_task(task)
+        self._fire_allocate(task)
+
+    def allocate(self, task: TaskInfo, hostname: str) -> None:
+        """Assign onto idle resources; dispatches the whole job once gang-ready
+        (session.go:242-297)."""
+        self.cache.allocate_volumes(task, hostname)
+        job = self.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job} when allocating")
+        job.update_task_status(task, TaskStatus.ALLOCATED)
+        task.node_name = hostname
+        node = self.nodes.get(hostname)
+        if node is None:
+            raise KeyError(f"failed to find node {hostname}")
+        node.add_task(task)
+        self._fire_allocate(task)
+
+        if self.job_ready(job):
+            for t in list(job.task_status_index.get(TaskStatus.ALLOCATED, {}).values()):
+                self._dispatch(t)
+
+    def _dispatch(self, task: TaskInfo) -> None:
+        """Bind an allocated task through the cache (session.go:299-323)."""
+        self.cache.bind_volumes(task)
+        self.cache.bind(task, task.node_name)
+        job = self.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job} when dispatching")
+        job.update_task_status(task, TaskStatus.BINDING)
+
+    def evict(self, reclaimee: TaskInfo, reason: str) -> None:
+        """Evict through the cache immediately (session.go:326-363)."""
+        self.cache.evict(reclaimee, reason)
+        job = self.jobs.get(reclaimee.job)
+        if job is None:
+            raise KeyError(f"failed to find job {reclaimee.job} when evicting")
+        job.update_task_status(reclaimee, TaskStatus.RELEASING)
+        node = self.nodes.get(reclaimee.node_name)
+        if node is not None:
+            node.update_task(reclaimee)
+        self._fire_deallocate(reclaimee)
+
+    def update_job_condition(self, job_info: JobInfo, cond: PodGroupCondition) -> None:
+        job = self.jobs.get(job_info.uid)
+        if job is None:
+            raise KeyError(f"failed to find job {job_info.namespace}/{job_info.name}")
+        conds = job.pod_group.status.conditions
+        for i, c in enumerate(conds):
+            if c.type == cond.type:
+                conds[i] = cond
+                return
+        conds.append(cond)
+
+
+def job_status(ssn: Session, job: JobInfo) -> PodGroupStatus:
+    """Recompute a job's PodGroup status at session close (session.go:151-189)."""
+    status = job.pod_group.status
+
+    unschedulable = any(
+        c.type == POD_GROUP_UNSCHEDULABLE_TYPE
+        and c.status == "True"
+        and c.transition_id == ssn.uid
+        for c in status.conditions
+    )
+
+    if job.task_status_index.get(TaskStatus.RUNNING) and unschedulable:
+        status.phase = PodGroupPhase.UNKNOWN
+    else:
+        allocated = sum(
+            len(tasks)
+            for st, tasks in job.task_status_index.items()
+            if st in ALLOCATED_STATUSES
+        )
+        if allocated >= job.pod_group.min_member:
+            status.phase = PodGroupPhase.RUNNING
+        elif job.pod_group.status.phase != PodGroupPhase.INQUEUE:
+            status.phase = PodGroupPhase.PENDING
+
+    status.running = len(job.task_status_index.get(TaskStatus.RUNNING, {}))
+    status.failed = len(job.task_status_index.get(TaskStatus.FAILED, {}))
+    status.succeeded = len(job.task_status_index.get(TaskStatus.SUCCEEDED, {}))
+    return status

@@ -1,0 +1,170 @@
+"""In-process metrics registry with Prometheus text exposition.
+
+Replaces the reference's 10 Prometheus collectors under namespace ``volcano``
+(``pkg/scheduler/metrics/metrics.go:26-121``).  Metric names and label sets are
+kept identical so dashboards written for the reference keep working; the
+exposition format is served by the scheduler daemon's /metrics endpoint.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+NAMESPACE = "volcano"
+
+# Exponential buckets 5ms * 2^k, 10 buckets — metrics.go:41.
+_LATENCY_BUCKETS_MS = [5.0 * (2 ** k) for k in range(10)]
+
+_lock = threading.Lock()
+
+
+class _Histogram:
+    def __init__(self, name: str, help_text: str, buckets_ms: List[float]) -> None:
+        self.name = name
+        self.help = help_text
+        self.buckets = buckets_ms
+        self.counts: Dict[Tuple, List[int]] = defaultdict(lambda: [0] * (len(buckets_ms) + 1))
+        self.sums: Dict[Tuple, float] = defaultdict(float)
+        self.totals: Dict[Tuple, int] = defaultdict(int)
+
+    def observe(self, value_ms: float, labels: Tuple = ()) -> None:
+        with _lock:
+            row = self.counts[labels]
+            for i, b in enumerate(self.buckets):
+                if value_ms <= b:
+                    row[i] += 1
+                    break
+            else:
+                row[-1] += 1
+            self.sums[labels] += value_ms
+            self.totals[labels] += 1
+
+
+class _Counter:
+    def __init__(self, name: str, help_text: str) -> None:
+        self.name = name
+        self.help = help_text
+        self.values: Dict[Tuple, float] = defaultdict(float)
+
+    def inc(self, labels: Tuple = (), by: float = 1.0) -> None:
+        with _lock:
+            self.values[labels] += by
+
+
+class _Gauge:
+    def __init__(self, name: str, help_text: str) -> None:
+        self.name = name
+        self.help = help_text
+        self.values: Dict[Tuple, float] = defaultdict(float)
+
+    def set(self, value: float, labels: Tuple = ()) -> None:
+        with _lock:
+            self.values[labels] = value
+
+
+e2e_latency = _Histogram(
+    f"{NAMESPACE}_e2e_scheduling_latency_milliseconds", "E2E scheduling latency", _LATENCY_BUCKETS_MS
+)
+plugin_latency = _Histogram(
+    f"{NAMESPACE}_plugin_scheduling_latency_microseconds", "Plugin latency", _LATENCY_BUCKETS_MS
+)
+action_latency = _Histogram(
+    f"{NAMESPACE}_action_scheduling_latency_microseconds", "Action latency", _LATENCY_BUCKETS_MS
+)
+task_latency = _Histogram(
+    f"{NAMESPACE}_task_scheduling_latency_milliseconds", "Task scheduling latency", _LATENCY_BUCKETS_MS
+)
+schedule_attempts = _Counter(
+    f"{NAMESPACE}_schedule_attempts_total", "Scheduling attempts by result"
+)
+preemption_victims = _Gauge(f"{NAMESPACE}_pod_preemption_victims", "Current preemption victims")
+preemption_attempts = _Counter(
+    f"{NAMESPACE}_total_preemption_attempts", "Total preemption attempts"
+)
+unschedule_task_count = _Gauge(
+    f"{NAMESPACE}_unschedule_task_count", "Unschedulable tasks per job"
+)
+unschedule_job_count = _Gauge(f"{NAMESPACE}_unschedule_job_count", "Unschedulable jobs")
+job_retry_counts = _Counter(f"{NAMESPACE}_job_retry_counts", "Job retries")
+
+_LABEL_NAMES = {
+    plugin_latency.name: ("plugin", "OnSession"),
+    action_latency.name: ("action",),
+    schedule_attempts.name: ("result",),
+    unschedule_task_count.name: ("job_id",),
+    job_retry_counts.name: ("job_id",),
+}
+
+
+def update_e2e_duration(seconds: float) -> None:
+    e2e_latency.observe(seconds * 1000.0)
+
+
+def update_plugin_duration(plugin: str, on_session: str, seconds: float) -> None:
+    plugin_latency.observe(seconds * 1e6, (plugin, on_session))
+
+
+def update_action_duration(action: str, seconds: float) -> None:
+    action_latency.observe(seconds * 1e6, (action,))
+
+
+def update_task_schedule_duration(seconds: float) -> None:
+    task_latency.observe(seconds * 1000.0)
+
+
+def register_schedule_attempt(result: str) -> None:
+    schedule_attempts.inc((result,))
+
+
+def update_preemption_victims_count(count: int) -> None:
+    preemption_victims.set(count)
+
+
+def register_preemption_attempts() -> None:
+    preemption_attempts.inc()
+
+
+def update_unschedule_task_count(job_id: str, count: int) -> None:
+    unschedule_task_count.set(count, (job_id,))
+
+
+def update_unschedule_job_count(count: int) -> None:
+    unschedule_job_count.set(count)
+
+
+def register_job_retries(job_id: str) -> None:
+    job_retry_counts.inc((job_id,))
+
+
+def _fmt_labels(metric_name: str, labels: Tuple) -> str:
+    if not labels:
+        return ""
+    names = _LABEL_NAMES.get(metric_name, tuple(f"label{i}" for i in range(len(labels))))
+    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, labels))
+    return "{" + inner + "}"
+
+
+def render_prometheus() -> str:
+    """Text exposition of every collector."""
+    out: List[str] = []
+    with _lock:
+        for h in (e2e_latency, plugin_latency, action_latency, task_latency):
+            out.append(f"# HELP {h.name} {h.help}")
+            out.append(f"# TYPE {h.name} histogram")
+            for labels, total in h.totals.items():
+                lbl = _fmt_labels(h.name, labels)
+                out.append(f"{h.name}_count{lbl} {total}")
+                out.append(f"{h.name}_sum{lbl} {h.sums[labels]}")
+        for c in (schedule_attempts, preemption_attempts, job_retry_counts):
+            out.append(f"# HELP {c.name} {c.help}")
+            out.append(f"# TYPE {c.name} counter")
+            for labels, v in c.values.items():
+                out.append(f"{c.name}{_fmt_labels(c.name, labels)} {v}")
+        for g in (preemption_victims, unschedule_task_count, unschedule_job_count):
+            out.append(f"# HELP {g.name} {g.help}")
+            out.append(f"# TYPE {g.name} gauge")
+            for labels, v in g.values.items():
+                out.append(f"{g.name}{_fmt_labels(g.name, labels)} {v}")
+    return "\n".join(out) + "\n"

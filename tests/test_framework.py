@@ -1,0 +1,341 @@
+"""Framework tests: conf parsing, tiered dispatch semantics, statement rollback."""
+
+import pytest
+
+from scheduler_tpu.api import TaskStatus
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import (
+    DEFAULT_SCHEDULER_CONF,
+    PluginOption,
+    Tier,
+    parse_scheduler_conf,
+)
+from scheduler_tpu.framework import Arguments, Session, open_session
+from scheduler_tpu.framework.interface import ValidateResult
+from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
+
+
+class TestConf:
+    def test_default_conf(self):
+        conf = parse_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        assert conf.actions == ["enqueue", "allocate", "backfill"]
+        assert len(conf.tiers) == 2
+        assert [p.name for p in conf.tiers[0].plugins] == ["priority", "gang", "conformance"]
+
+    def test_enable_flags_default_true(self):
+        conf = parse_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        p = conf.tiers[0].plugins[0]
+        assert p.job_order_enabled() and p.predicate_enabled()
+
+    def test_explicit_disable(self):
+        conf = parse_scheduler_conf(
+            """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: drf
+    enabledPreemptable: false
+    arguments:
+      drf.weight: "2"
+"""
+        )
+        p = conf.tiers[0].plugins[0]
+        assert not p.preemptable_enabled()
+        assert p.job_order_enabled()
+        assert Arguments.of(p.arguments).get_int("drf.weight", 1) == 2
+
+
+class TestArguments:
+    def test_typed_getters(self):
+        args = Arguments.of({"a": "5", "b": "true", "c": "nope", "d": "1.5"})
+        assert args.get_int("a", 0) == 5
+        assert args.get_bool("b", False) is True
+        assert args.get_int("c", 7) == 7
+        assert args.get_float("d", 0.0) == 1.5
+        assert args.get_bool("missing", True) is True
+
+
+def _tiers(*plugin_names_per_tier):
+    return [Tier(plugins=[PluginOption(name=n) for n in names]) for names in plugin_names_per_tier]
+
+
+def _make_cache():
+    vocab = make_vocab()
+    cache = SchedulerCache(vocab=vocab, async_io=False)
+    return cache, vocab
+
+
+def _session_with(tiers):
+    cache, _ = _make_cache()
+    return Session(cache, tiers)
+
+
+class TestDispatchSemantics:
+    def test_victim_intersection_within_tier(self):
+        ssn = _session_with(_tiers(["a", "b"]))
+
+        class T:  # tiny victim stand-in
+            def __init__(self, uid):
+                self.uid = uid
+
+        t1, t2, t3 = T("1"), T("2"), T("3")
+        ssn.add_preemptable_fn("a", lambda preemptor, cands: [t1, t2])
+        ssn.add_preemptable_fn("b", lambda preemptor, cands: [t2, t3])
+        assert [v.uid for v in ssn.preemptable(None, [t1, t2, t3])] == ["2"]
+
+    def test_tier_early_exit(self):
+        ssn = _session_with(_tiers(["a"], ["b"]))
+
+        class T:
+            def __init__(self, uid):
+                self.uid = uid
+
+        t1, t2 = T("1"), T("2")
+        ssn.add_preemptable_fn("a", lambda *_: [t1])
+        ssn.add_preemptable_fn("b", lambda *_: [t2])
+        # tier 1 produced victims -> tier 2 never consulted
+        assert [v.uid for v in ssn.preemptable(None, [t1, t2])] == ["1"]
+
+    def test_victim_none_initializes_and_poisons_intersection(self):
+        # session_plugins.go:100-139: the init flag outlives the tier loop — a
+        # None (Go nil) from the first enabled plugin initializes the set, later
+        # plugins intersect into it, and nil never "decides" a tier.
+        ssn = _session_with(_tiers(["a", "b"], ["c"]))
+
+        class T:
+            def __init__(self, uid):
+                self.uid = uid
+
+        t1 = T("1")
+        ssn.add_preemptable_fn("a", lambda *_: None)
+        ssn.add_preemptable_fn("b", lambda *_: [t1])   # intersected with nil -> nil
+        ssn.add_preemptable_fn("c", lambda *_: [t1])   # also intersected (init persists)
+        assert ssn.preemptable(None, [t1]) == []
+
+        # But a real first answer decides at its tier boundary.
+        ssn2 = _session_with(_tiers(["a"], ["b"]))
+        ssn2.add_preemptable_fn("a", lambda *_: [t1])
+        ssn2.add_preemptable_fn("b", lambda *_: None)
+        assert [v.uid for v in ssn2.preemptable(None, [t1])] == ["1"]
+
+    def test_veto_and(self):
+        ssn = _session_with(_tiers(["a", "b"]))
+        ssn.add_job_ready_fn("a", lambda job: True)
+        ssn.add_job_ready_fn("b", lambda job: False)
+        assert not ssn.job_ready(object())
+        ssn.job_ready_fns["b"] = lambda job: True
+        assert ssn.job_ready(object())
+
+    def test_first_nonzero_ordering(self):
+        ssn = _session_with(_tiers(["a", "b"]))
+
+        class J:
+            def __init__(self, uid, ts):
+                self.uid = uid
+                self.creation_timestamp = ts
+
+        l, r = J("l", 1.0), J("r", 2.0)
+        ssn.add_job_order_fn("a", lambda x, y: 0)      # abstains
+        ssn.add_job_order_fn("b", lambda x, y: 1)      # says l after r
+        assert ssn.job_order_fn(l, r) is False
+        ssn.job_order_fns["b"] = lambda x, y: -1
+        assert ssn.job_order_fn(l, r) is True
+
+    def test_ordering_fallback_creation_time(self):
+        ssn = _session_with(_tiers(["a"]))
+
+        class J:
+            def __init__(self, uid, ts):
+                self.uid = uid
+                self.creation_timestamp = ts
+
+        assert ssn.job_order_fn(J("x", 1.0), J("y", 2.0)) is True
+        assert ssn.job_order_fn(J("x", 2.0), J("y", 1.0)) is False
+        assert ssn.job_order_fn(J("a", 1.0), J("b", 1.0)) is True  # uid tiebreak
+
+    def test_node_order_additive(self):
+        ssn = _session_with(_tiers(["a", "b"]))
+        ssn.add_node_order_fn("a", lambda t, n: 2.0)
+        ssn.add_node_order_fn("b", lambda t, n: 3.0)
+        assert ssn.node_order_fn(None, None) == 5.0
+
+    def test_disabled_plugin_skipped(self):
+        tiers = [Tier(plugins=[PluginOption(name="a", enabled_node_order=False)])]
+        ssn = _session_with(tiers)
+        ssn.add_node_order_fn("a", lambda t, n: 2.0)
+        assert ssn.node_order_fn(None, None) == 0.0
+
+    def test_job_valid_first_failure(self):
+        ssn = _session_with(_tiers(["a", "b"]))
+        ssn.add_job_valid_fn("a", lambda job: None)
+        ssn.add_job_valid_fn("b", lambda job: ValidateResult(False, "r", "m"))
+        vr = ssn.job_valid(object())
+        assert vr is not None and not vr.passed and vr.reason == "r"
+
+
+class TestCacheEvents:
+    def test_pod_group_and_pods_form_job(self):
+        cache, _ = _make_cache()
+        cache.add_queue(build_queue("default"))
+        cache.add_pod_group(build_pod_group("pg1", min_member=2))
+        for i in range(2):
+            cache.add_pod(build_pod(name=f"p{i}", req={"cpu": 1000, "memory": 100}, groupname="pg1"))
+
+        snap = cache.snapshot()
+        job = snap.jobs["default/pg1"]
+        assert len(job.tasks) == 2
+        assert job.min_available == 2
+        assert job.total_request.milli_cpu == 2000
+
+    def test_bound_pod_accounts_on_node(self):
+        cache, _ = _make_cache()
+        cache.add_node(build_node("n1", {"cpu": 4000, "memory": 1000}))
+        cache.add_pod_group(build_pod_group("pg1"))
+        cache.add_pod(
+            build_pod(name="p0", req={"cpu": 1000, "memory": 100}, groupname="pg1",
+                      nodename="n1", phase="Running")
+        )
+        snap = cache.snapshot()
+        assert snap.nodes["n1"].idle.milli_cpu == 3000
+        assert snap.nodes["n1"].used.milli_cpu == 1000
+
+    def test_shadow_pod_group_for_bare_pod(self):
+        cache, _ = _make_cache()
+        pod = build_pod(name="bare", req={"cpu": 100, "memory": 10})
+        pod.scheduler_name = "volcano"
+        cache.add_pod(pod)
+        snap = cache.snapshot()
+        assert len(snap.jobs) == 1
+        job = next(iter(snap.jobs.values()))
+        assert job.min_available == 1
+
+    def test_foreign_bare_pod_ignored(self):
+        cache, _ = _make_cache()
+        pod = build_pod(name="foreign", req={"cpu": 100, "memory": 10})
+        pod.scheduler_name = "default-scheduler"
+        cache.add_pod(pod)
+        assert not cache.snapshot().jobs
+
+    def test_delete_pod_and_job_gc(self):
+        cache, _ = _make_cache()
+        pod = build_pod(name="p0", req={"cpu": 100, "memory": 10}, groupname="pg1")
+        cache.add_pod(pod)
+        assert "default/pg1" in cache.jobs
+        cache.delete_pod(pod)
+        # no pod_group object -> job GCed once empty
+        assert "default/pg1" not in cache.jobs
+
+    def test_snapshot_isolation(self):
+        cache, _ = _make_cache()
+        cache.add_node(build_node("n1", {"cpu": 4000, "memory": 1000}))
+        snap = cache.snapshot()
+        snap.nodes["n1"].idle.sub(snap.nodes["n1"].idle.clone())
+        # cache unaffected by snapshot mutation
+        assert cache.nodes["n1"].idle.milli_cpu == 4000
+
+    def test_update_pod_rebinds(self):
+        cache, _ = _make_cache()
+        cache.add_node(build_node("n1", {"cpu": 4000, "memory": 1000}))
+        cache.add_pod_group(build_pod_group("pg1"))
+        pod = build_pod(name="p0", req={"cpu": 1000, "memory": 100}, groupname="pg1")
+        cache.add_pod(pod)
+        assert cache.snapshot().nodes["n1"].idle.milli_cpu == 4000
+        pod.node_name = "n1"
+        pod.phase = "Running"
+        cache.update_pod(pod)
+        snap = cache.snapshot()
+        assert snap.nodes["n1"].idle.milli_cpu == 3000
+        job = snap.jobs["default/pg1"]
+        assert job.ready_task_num() == 1
+
+    def test_priority_class_resolution(self):
+        cache, _ = _make_cache()
+        cache.add_priority_class("high", 1000)
+        pg = build_pod_group("pg1")
+        pg.priority_class_name = "high"
+        cache.add_pod_group(pg)
+        cache.add_pod(build_pod(name="p0", req={"cpu": 100, "memory": 10}, groupname="pg1"))
+        assert cache.snapshot().jobs["default/pg1"].priority == 1000
+
+
+class TestSessionMutations:
+    def _setup(self):
+        cache, _ = _make_cache()
+        cache.run()
+        cache.add_queue(build_queue("default"))
+        cache.add_node(build_node("n1", {"cpu": 4000, "memory": 1000}))
+        cache.add_pod_group(build_pod_group("pg1", min_member=2))
+        pods = [
+            build_pod(name=f"p{i}", req={"cpu": 1000, "memory": 100}, groupname="pg1")
+            for i in range(2)
+        ]
+        for p in pods:
+            cache.add_pod(p)
+        ssn = open_session(cache, _tiers([]))
+        return cache, ssn
+
+    def test_allocate_dispatches_when_gang_ready(self):
+        cache, ssn = self._setup()
+        # no gang plugin -> job_ready always true -> dispatch immediately
+        job = ssn.jobs["default/pg1"]
+        tasks = list(job.task_status_index[TaskStatus.PENDING].values())
+        ssn.allocate(tasks[0], "n1")
+        assert cache.binder.wait(1) == ["default/p0"]
+        assert ssn.nodes["n1"].idle.milli_cpu == 3000
+
+    def test_statement_discard_restores_state(self):
+        # Realistic preempt shape: evict a running victim, pipeline the preemptor
+        # onto the freed (releasing) resources, then discard everything.
+        cache, _ = _make_cache()
+        cache.run()
+        cache.add_queue(build_queue("default"))
+        cache.add_node(build_node("n1", {"cpu": 2000, "memory": 1000}))
+        cache.add_pod_group(build_pod_group("pgv", min_member=1))
+        cache.add_pod_group(build_pod_group("pgp", min_member=1))
+        victim_pod = build_pod(name="victim", req={"cpu": 2000, "memory": 100},
+                               groupname="pgv", nodename="n1", phase="Running")
+        preemptor_pod = build_pod(name="preemptor", req={"cpu": 2000, "memory": 100},
+                                  groupname="pgp")
+        cache.add_pod(victim_pod)
+        cache.add_pod(preemptor_pod)
+        ssn = open_session(cache, _tiers([]))
+        victim = next(iter(ssn.jobs["default/pgv"].tasks.values()))
+        preemptor = next(iter(ssn.jobs["default/pgp"].tasks.values()))
+
+        stmt = ssn.statement()
+        stmt.evict(victim, "preempt")
+        assert ssn.nodes["n1"].releasing.milli_cpu == 2000
+        stmt.pipeline(preemptor, "n1")
+        assert preemptor.status == TaskStatus.PIPELINED
+        assert ssn.jobs["default/pgp"].waiting_task_num() == 1
+        assert ssn.nodes["n1"].releasing.milli_cpu == 0
+
+        stmt.discard()
+        assert preemptor.status == TaskStatus.PENDING
+        assert victim.status == TaskStatus.RUNNING
+        assert ssn.jobs["default/pgp"].waiting_task_num() == 0
+        assert ssn.nodes["n1"].releasing.milli_cpu == 0
+        assert ssn.nodes["n1"].idle.milli_cpu == 0
+        # nothing escaped to the cache
+        assert not cache.evictor.evicts
+
+    def test_statement_evict_commit_hits_cache(self):
+        cache, _ = self._setup()[0], None
+        # separate setup with a running task to evict
+        cache2, _ = _make_cache()
+        cache2.run()
+        cache2.add_queue(build_queue("default"))
+        cache2.add_node(build_node("n1", {"cpu": 4000, "memory": 1000}))
+        cache2.add_pod_group(build_pod_group("pg2", min_member=1))
+        pod = build_pod(name="victim", req={"cpu": 1000, "memory": 100}, groupname="pg2",
+                        nodename="n1", phase="Running")
+        cache2.add_pod(pod)
+        ssn = open_session(cache2, _tiers([]))
+        victim = next(iter(ssn.jobs["default/pg2"].tasks.values()))
+
+        stmt = ssn.statement()
+        stmt.evict(victim, "preempt")
+        assert victim.status == TaskStatus.RELEASING
+        assert ssn.nodes["n1"].releasing.milli_cpu == 1000
+        stmt.commit()
+        assert cache2.evictor.wait(1) == ["default/victim"]

@@ -1,0 +1,90 @@
+"""Snapshot tensor round-trip tests."""
+
+import numpy as np
+
+from scheduler_tpu.api import NodeInfo, TaskInfo, JobInfo
+from scheduler_tpu.api.tensors import build_snapshot_tensors
+from tests.fixtures import build_node, build_pod, build_pod_group, make_vocab
+
+GPU = "nvidia.com/gpu"
+
+
+def _world():
+    vocab = make_vocab(GPU)
+    nodes = [
+        NodeInfo(vocab, build_node("n1", {"cpu": 8000, "memory": 1000, GPU: 8000},
+                                   labels={"zone": "a"})),
+        NodeInfo(vocab, build_node("n2", {"cpu": 4000, "memory": 500}, labels={"zone": "b"})),
+    ]
+    job = JobInfo("default/pg1", vocab)
+    job.set_pod_group(build_pod_group("pg1", min_member=2))
+    tasks = []
+    for i in range(2):
+        pod = build_pod(name=f"p{i}", req={"cpu": 1000, "memory": 100}, groupname="pg1",
+                        selector={"zone": "a"} if i == 0 else None)
+        ti = TaskInfo(pod, vocab)
+        job.add_task_info(ti)
+        tasks.append(ti)
+    return vocab, nodes, [job], tasks
+
+
+def test_round_trip_shapes_and_values():
+    vocab, nodes, jobs, tasks = _world()
+    st = build_snapshot_tensors(nodes, jobs, tasks, ["default"], vocab)
+
+    assert st.nodes.count == 2
+    assert st.tasks.count == 2
+    n1 = st.nodes.index["n1"]
+    np.testing.assert_array_equal(st.nodes.idle[n1], [8000.0, 1000.0, 8000.0])
+    assert st.nodes.pods_limit[n1] == 110
+    assert st.nodes.ready.all()
+
+    t0 = st.tasks.index[tasks[0].uid]
+    np.testing.assert_array_equal(st.tasks.resreq[t0], [1000.0, 100.0, 0.0])
+    assert st.tasks.job_idx[t0] == st.jobs.index["default/pg1"]
+    assert st.jobs.min_available[st.jobs.index["default/pg1"]] == 2
+    assert st.jobs.queue_idx[0] == 0
+
+
+def test_selector_encoding():
+    vocab, nodes, jobs, tasks = _world()
+    st = build_snapshot_tensors(nodes, jobs, tasks, ["default"], vocab)
+
+    t0 = st.tasks.index[tasks[0].uid]
+    zone_a = st.label_vocab.lookup("zone", "a")
+    assert zone_a is not None
+    assert st.tasks.selector[t0, zone_a]
+    # selector ⊆ node labels via boolean algebra
+    n1, n2 = st.nodes.index["n1"], st.nodes.index["n2"]
+    sel = st.tasks.selector[t0]
+    assert not np.any(sel & ~st.nodes.labels[n1])   # matches n1
+    assert np.any(sel & ~st.nodes.labels[n2])       # fails n2
+
+
+def test_unknown_selector_flagged():
+    vocab, nodes, jobs, _ = _world()
+    job = jobs[0]
+    pod = build_pod(name="px", req={"cpu": 100, "memory": 10}, groupname="pg1",
+                    selector={"zone": "mars"})
+    ti = TaskInfo(pod, vocab)
+    job.add_task_info(ti)
+    st = build_snapshot_tensors(nodes, jobs, [ti], ["default"], vocab)
+    assert st.tasks.has_unknown_selector[0]
+
+
+def test_best_effort_detection():
+    vocab, nodes, jobs, _ = _world()
+    pod = build_pod(name="be", req={"cpu": 5, "memory": 10}, groupname="pg1")
+    ti = TaskInfo(pod, vocab)
+    jobs[0].add_task_info(ti)
+    st = build_snapshot_tensors(nodes, jobs, [ti], ["default"], vocab)
+    assert st.tasks.best_effort[0]
+
+
+def test_hostname_implicit_label():
+    vocab, nodes, jobs, tasks = _world()
+    st = build_snapshot_tensors(nodes, jobs, tasks, ["default"], vocab)
+    idx = st.label_vocab.lookup("kubernetes.io/hostname", "n1")
+    assert idx is not None
+    assert st.nodes.labels[st.nodes.index["n1"], idx]
+    assert not st.nodes.labels[st.nodes.index["n2"], idx]

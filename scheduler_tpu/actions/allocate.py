@@ -1,0 +1,199 @@
+"""Allocate: the main scheduling pass (reference ``actions/allocate/allocate.go``).
+
+Control flow preserved from the reference: queues and jobs pop through live
+priority heaps (so DRF/proportion share updates reorder between pops), a job pop
+places tasks until the first infeasible task (job leaves the rotation, fit
+errors recorded) or until the gang goes ready (job re-queued), and the queue is
+re-pushed after every pop.
+
+The inner task loop runs in one of two engines:
+
+* **device** (default when every plugin is device-capable): the whole
+  fit→score→select→update pipeline for a job pop is one ``lax.scan`` call on the
+  TPU (``ops.placement``); node state stays on device across pops.
+* **host** (fallback): the reference's per-task predicate/prioritize/select
+  sweep using the session's host callbacks.
+
+Both engines apply results through ``ssn.allocate``/``ssn.pipeline`` so event
+handlers, gang dispatch and cache bind semantics are identical.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict, List, Optional
+
+from scheduler_tpu.api.job_info import JobInfo, TaskInfo
+from scheduler_tpu.api.types import TaskStatus
+from scheduler_tpu.api.unschedule_info import FitError, FitErrors, NODE_RESOURCE_FIT_FAILED
+from scheduler_tpu.apis.objects import PodGroupPhase
+from scheduler_tpu.framework.interface import Action
+from scheduler_tpu.utils.priority_queue import PriorityQueue
+from scheduler_tpu.utils.scheduler_helper import (
+    get_node_list,
+    predicate_nodes,
+    prioritize_nodes,
+    select_best_node,
+)
+
+logger = logging.getLogger("scheduler_tpu.actions.allocate")
+
+
+def _device_enabled() -> bool:
+    return os.environ.get("SCHEDULER_TPU_DEVICE", "1") not in ("0", "false")
+
+
+class AllocateAction(Action):
+    def name(self) -> str:
+        return "allocate"
+
+    def execute(self, ssn) -> None:
+        queues = PriorityQueue(ssn.queue_order_fn)
+        jobs_map: Dict[str, PriorityQueue] = {}
+
+        candidates: List[JobInfo] = []
+        for job in ssn.jobs.values():
+            if job.pod_group is not None and job.pod_group.status.phase == PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.passed:
+                logger.debug("job %s skips allocate: %s", job.uid, vr.message)
+                continue
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                logger.warning("skip job %s: queue %s not found", job.uid, job.queue)
+                continue
+            # The reference pushes the queue once per job — duplicates drive the
+            # round-robin rotation (allocate.go:58-63).
+            queues.push(queue)
+            jobs_map.setdefault(job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
+            candidates.append(job)
+
+        logger.debug("allocating over %d queues", len(jobs_map))
+
+        engine = None
+        if _device_enabled() and candidates:
+            from scheduler_tpu.ops.allocator import DeviceAllocator
+
+            if DeviceAllocator.supported(ssn):
+                engine = DeviceAllocator(ssn, candidates)
+
+        pending_tasks: Dict[str, PriorityQueue] = {}
+        all_nodes = get_node_list(ssn.nodes)
+
+        def host_predicate(task: TaskInfo, node) -> None:
+            # Resource pre-predicate: fits idle OR releasing (allocate.go:80-93).
+            if not task.init_resreq.less_equal(node.idle) and not task.init_resreq.less_equal(
+                node.releasing
+            ):
+                raise FitError(task.name, node.name, NODE_RESOURCE_FIT_FAILED)
+            ssn.predicate_fn(task, node)
+
+        while not queues.empty():
+            queue = queues.pop()
+            if ssn.overused(queue):
+                logger.debug("queue %s is overused, skipping", queue.name)
+                continue
+
+            jobs = jobs_map.get(queue.uid)
+            if jobs is None or jobs.empty():
+                continue
+
+            job = jobs.pop()
+            if job.uid not in pending_tasks:
+                tasks = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
+                    if task.resreq.is_empty():
+                        continue  # BestEffort handled by backfill
+                    tasks.push(task)
+                pending_tasks[job.uid] = tasks
+            tasks = pending_tasks[job.uid]
+
+            if engine is not None:
+                self._run_device_pop(ssn, engine, job, tasks, jobs)
+            else:
+                self._run_host_pop(ssn, job, tasks, jobs, all_nodes, host_predicate)
+
+            queues.push(queue)
+
+    # -- device engine -------------------------------------------------------
+
+    def _run_device_pop(self, ssn, engine, job: JobInfo, tasks: PriorityQueue, jobs: PriorityQueue) -> None:
+        ordered: List[TaskInfo] = []
+        while not tasks.empty():
+            ordered.append(tasks.pop())
+        if not ordered:
+            return
+
+        rows = engine.place_job(job, ordered)
+        if rows is None:
+            # Unknown job_ready semantics — shouldn't happen with builtins.
+            logger.warning("device engine refused job %s; tasks left pending", job.uid)
+            for t in ordered:
+                tasks.push(t)
+            return
+
+        consumed = 0
+        requeue_job = False
+        for task, node_name, pipelined, failed in rows:
+            consumed += 1
+            if failed:
+                fe = FitErrors()
+                fe.set_node_error("*", FitError(task.name, "*", NODE_RESOURCE_FIT_FAILED))
+                job.nodes_fit_errors[task.uid] = fe
+                break
+            if pipelined:
+                ssn.pipeline(task, node_name)
+            else:
+                ssn.allocate(task, node_name)
+            # The reference checks JobReady after every placement, pipeline or
+            # allocate (allocate.go:184-187).
+            if ssn.job_ready(job):
+                requeue_job = True
+                break
+
+        for t in ordered[consumed:]:
+            tasks.push(t)
+        if requeue_job:
+            jobs.push(job)
+
+    # -- host engine ----------------------------------------------------------
+
+    def _run_host_pop(self, ssn, job, tasks, jobs, all_nodes, predicate) -> None:
+        while not tasks.empty():
+            task = tasks.pop()
+
+            if job.nodes_fit_delta:
+                job.nodes_fit_delta = {}
+
+            passing, fit_errors = predicate_nodes(task, all_nodes, predicate)
+            if not passing:
+                job.nodes_fit_errors[task.uid] = fit_errors
+                break
+
+            node_scores = prioritize_nodes(
+                task,
+                passing,
+                ssn.batch_node_order_fn,
+                ssn.node_order_map_fn,
+                ssn.node_order_reduce_fn,
+            )
+            node = select_best_node(node_scores)
+
+            if task.init_resreq.less_equal(node.idle):
+                ssn.allocate(task, node.name)
+            else:
+                delta = node.idle.clone()
+                delta.fit_delta(task.init_resreq)
+                job.nodes_fit_delta[node.name] = delta
+                if task.init_resreq.less_equal(node.releasing):
+                    ssn.pipeline(task, node.name)
+
+            if ssn.job_ready(job):
+                jobs.push(job)
+                break
+
+
+def new() -> AllocateAction:
+    return AllocateAction()

@@ -1,0 +1,10 @@
+"""Registers the builtin actions (reference ``actions/factory.go:29-35``)."""
+
+from scheduler_tpu.actions import allocate
+from scheduler_tpu.framework.registry import register_action
+
+register_action(allocate.new())
+
+
+def register_all() -> None:
+    """Idempotent explicit hook (import already registers everything)."""

@@ -77,8 +77,14 @@ class Session:
         # Device-engine handles installed by plugins (TPU-native extension):
         # plugins contribute mask/score tensor builders here instead of (or in
         # addition to) per-task host callbacks; actions fuse them into one kernel.
-        self.device_predicates: List = []
-        self.device_scorers: List = []
+        self.device_predicates: Dict[str, Callable] = {}
+        self.device_scorers: Dict[str, Callable] = {}
+        self.device_score_weights: Dict[str, float] = {}
+        # Plugins whose host node-order callbacks are fully represented by the
+        # dynamic scorer weights above (so the device path may be used).
+        self.device_weighted_plugins: set = set()
+        # Dynamic (in-scan) gates a plugin turned on, e.g. "pod_count".
+        self.device_dynamic_gates: set = set()
 
     # -- registration (Add*Fn) ----------------------------------------------
 
@@ -130,11 +136,11 @@ class Session:
     def add_event_handler(self, eh: EventHandler) -> None:
         self.event_handlers.append(eh)
 
-    def add_device_predicate(self, builder) -> None:
-        self.device_predicates.append(builder)
+    def add_device_predicate(self, name: str, builder: Callable) -> None:
+        self.device_predicates[name] = builder
 
-    def add_device_scorer(self, builder) -> None:
-        self.device_scorers.append(builder)
+    def add_device_scorer(self, name: str, builder: Callable) -> None:
+        self.device_scorers[name] = builder
 
     # -- tiered dispatch ------------------------------------------------------
 

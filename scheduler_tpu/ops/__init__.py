@@ -1,0 +1,19 @@
+"""Device kernels: the TPU replacement for the reference's host hot loops.
+
+The reference spends its cycle time in three Go sweeps (SURVEY.md §3.2): the
+per-task predicate scan over all nodes (``util/scheduler_helper.go:34-64``), the
+per-task priority scan (``:67-129``) and the per-allocation accounting fanout.
+Here those become:
+
+* ``predicates``  — boolean mask kernels over [T, N]: label-selector matching as
+  a boolean matmul, pod-count/readiness masks, epsilon-exact resource fit.
+* ``scoring``     — batched node scoring: least-requested / balanced-allocation
+  computed from the live idle matrix, static affinity scores added in.
+* ``placement``   — the placement engine: a ``lax.scan`` over one job's tasks in
+  priority order, carrying the idle/releasing matrices (exact sequential parity
+  with the reference's task loop), and a batched wavefront mode for bulk loads.
+* ``device``      — transfer helpers: bucket padding, unit scaling, dtype policy.
+"""
+
+from scheduler_tpu.ops.device import DevicePolicy, pad_rows, scale_columns
+from scheduler_tpu.ops.placement import JobPlacementSpec, PlacementResult, sequential_place_job

@@ -1,0 +1,204 @@
+"""DeviceAllocator: binds a Session to the placement engine.
+
+Builds the session's snapshot tensors once per action execution, uploads padded
+device arrays, then serves per-job placement calls that thread the node state
+(idle/releasing/task counts) functionally from job to job — the host never
+re-uploads node state inside an action, which is what keeps the 100k-task cycle
+inside the latency budget (SURVEY.md §7.4.6).
+
+Plugins participate through three session-level registries instead of per-task
+host callbacks:
+
+* ``ssn.device_predicates[name](st) -> bool [T, N]`` static mask contributions
+* ``ssn.device_scorers[name](st) -> f32 [T, N]`` static score contributions
+* ``ssn.device_score_weights`` weights for the idle-dependent dynamic scorers
+
+``supported()`` refuses sessions where some plugin registered a host predicate
+or node-order callback without a device counterpart — those fall back to the
+host path, so custom plugins stay correct, just not accelerated.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_tpu.api.job_info import JobInfo, TaskInfo
+from scheduler_tpu.api.tensors import SnapshotTensors, build_snapshot_tensors, bucket
+from scheduler_tpu.api.types import TaskStatus
+from scheduler_tpu.ops.device import DevicePolicy, pad_rows, scale_columns
+from scheduler_tpu.ops.placement import (
+    JobPlacementSpec,
+    NodeState,
+    PlacementResult,
+    sequential_place_job,
+)
+from scheduler_tpu.ops.predicates import base_static_mask
+from scheduler_tpu.utils.scheduler_helper import task_sort_key as _task_sort_key
+
+logger = logging.getLogger("scheduler_tpu.ops.allocator")
+
+
+class DeviceAllocator:
+    def __init__(self, ssn, jobs: Sequence[JobInfo]) -> None:
+        self.ssn = ssn
+        vocab = next(iter(ssn.nodes.values())).vocab if ssn.nodes else None
+        if vocab is None:
+            raise ValueError("cannot build a device allocator without nodes")
+        self.policy = DevicePolicy(vocab)
+
+        # Pending, non-best-effort tasks of every candidate job, in task order.
+        self.tasks: List[TaskInfo] = []
+        for job in jobs:
+            pending = list(job.task_status_index.get(TaskStatus.PENDING, {}).values())
+            pending.sort(key=_task_sort_key(ssn))
+            for t in pending:
+                if not t.resreq.is_empty():
+                    self.tasks.append(t)
+
+        node_list = sorted(ssn.nodes.values(), key=lambda n: n.name)
+        self.st: SnapshotTensors = build_snapshot_tensors(
+            node_list, jobs, self.tasks, sorted(ssn.queues), vocab
+        )
+
+        n = self.st.nodes.count
+        r = vocab.size
+        self.n_bucket = bucket(max(n, 1))
+        scale = self.policy.column_scale(r)
+
+        def prep(mat: np.ndarray) -> jnp.ndarray:
+            return jnp.asarray(pad_rows(scale_columns(mat, scale), self.n_bucket))
+
+        self.node_names = self.st.nodes.names
+        self.state = NodeState(
+            idle=prep(self.st.nodes.idle),
+            releasing=prep(self.st.nodes.releasing),
+            task_count=jnp.asarray(
+                pad_rows(self.st.nodes.task_count.astype(np.int32), self.n_bucket)
+            ),
+            allocatable=prep(self.st.nodes.allocatable),
+            # pad nodes get pods_limit 0 -> never feasible
+            pods_limit=jnp.asarray(
+                pad_rows(self.st.nodes.pods_limit.astype(np.int32), self.n_bucket)
+            ),
+            mins=jnp.asarray(self.policy.scaled_mins(r).astype(np.float32)),
+        )
+
+        # Static [T, N] predicate mask: node-ready gate AND every device
+        # predicate a plugin registered (selector/taint enforcement lives in the
+        # predicates plugin, matching the reference's plugin split).
+        t_count = max(self.st.tasks.count, 1)
+        base = np.asarray(
+            base_static_mask(t_count, jnp.asarray(self.st.nodes.ready))
+        )
+        for name, builder in ssn.device_predicates.items():
+            contribution = np.asarray(builder(self.st))
+            base = base & contribution
+        self.static_mask = np.asarray(
+            pad_rows(base.T.astype(bool), self.n_bucket, fill=False)
+        ).T  # pad the node axis
+
+        score = np.zeros((t_count, n), dtype=np.float32)
+        for name, builder in ssn.device_scorers.items():
+            score = score + np.asarray(builder(self.st), dtype=np.float32)
+        self.static_score = np.asarray(pad_rows(score.T, self.n_bucket, fill=0.0)).T
+
+        w = ssn.device_score_weights
+        self.weights: Tuple[float, float, float] = (
+            float(w.get("least_requested", 0.0)),
+            float(w.get("balanced", 0.0)),
+            float(w.get("binpack", 0.0)),
+        )
+
+        scaled_init = scale_columns(self.st.tasks.init_resreq, scale) if self.st.tasks.count else np.zeros((0, r), np.float32)
+        scaled_req = scale_columns(self.st.tasks.resreq, scale) if self.st.tasks.count else np.zeros((0, r), np.float32)
+        self._init_resreq = scaled_init
+        self._resreq = scaled_req
+
+    # -- capability probe ----------------------------------------------------
+
+    @staticmethod
+    def supported(ssn) -> bool:
+        """Every host predicate/node-order callback has a device counterpart."""
+        for name in ssn.predicate_fns:
+            if name not in ssn.device_predicates:
+                return False
+        scoring_fns = set(ssn.node_order_fns) | set(ssn.batch_node_order_fns) | set(ssn.node_map_fns)
+        for name in scoring_fns:
+            if name not in ssn.device_scorers and name not in ssn.device_weighted_plugins:
+                return False
+        return bool(ssn.nodes)
+
+    # -- placement -----------------------------------------------------------
+
+    def ready_deficit(self, job: JobInfo) -> Optional[int]:
+        """Allocations still needed before the JobReady break fires.
+
+        gang registered: min_available - ready_task_num (≤ 0 means the job is
+        already ready, so the first placement of any kind stops the pop); no
+        job_ready fns: JobReady is vacuously true -> deficit 0.  Any other
+        job_ready plugin -> unknown semantics, caller must fall back.
+        """
+        fns = set(self.ssn.job_ready_fns)
+        if not fns:
+            return 0
+        if fns == {"gang"}:
+            return job.min_available - job.ready_task_num()
+        return None
+
+    def place_job(self, job: JobInfo, tasks: List[TaskInfo]) -> Optional[List[Tuple[TaskInfo, Optional[str], bool, bool]]]:
+        """Run the placement scan for one job pop.
+
+        Returns [(task, node_name | None, pipelined, failed)] rows in task order,
+        covering only the prefix the scan actually processed (up to the ready
+        break / first failure), or None if this job needs the host fallback.
+        """
+        deficit = self.ready_deficit(job)
+        if deficit is None or not tasks:
+            return None
+
+        if deficit <= 0:
+            # The ready break fires on the first placement (or first failure),
+            # so scanning more than one task is wasted device work — without
+            # this, draining a gang-ready job's T-task tail costs O(T^2).
+            tasks = tasks[:1]
+
+        idxs = [self.st.tasks.index[t.uid] for t in tasks]
+        t_bucket = bucket(len(idxs))
+        sel = np.asarray(idxs, dtype=np.int64)
+
+        def take(mat: np.ndarray, fill=0.0) -> np.ndarray:
+            return pad_rows(mat[sel], t_bucket, fill=fill)
+
+        spec = JobPlacementSpec(
+            init_resreq=jnp.asarray(take(self._init_resreq)),
+            resreq=jnp.asarray(take(self._resreq)),
+            static_mask=jnp.asarray(take(self.static_mask, fill=False)),
+            static_score=jnp.asarray(take(self.static_score)),
+            valid=jnp.asarray(
+                pad_rows(np.ones(len(idxs), dtype=bool), t_bucket, fill=False)
+            ),
+            ready_deficit=jnp.asarray(deficit, dtype=jnp.int32),
+        )
+        self.state, result = sequential_place_job(
+            self.state,
+            spec,
+            self.weights,
+            enforce_pod_count="pod_count" in self.ssn.device_dynamic_gates,
+        )
+
+        out: List[Tuple[TaskInfo, Optional[str], bool, bool]] = []
+        for i, task in enumerate(tasks):
+            chosen = int(result.chosen[i])
+            failed = bool(result.failed[i])
+            pipelined = bool(result.pipelined[i])
+            if failed:
+                out.append((task, None, False, True))
+                break
+            if chosen < 0:
+                break  # scan stopped before this task (ready break fired)
+            out.append((task, self.node_names[chosen], pipelined, False))
+        return out

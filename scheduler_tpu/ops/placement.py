@@ -1,0 +1,185 @@
+"""The placement engine: one job's task loop as a single device scan.
+
+The reference allocates task-by-task, re-reading node idle state after every
+placement (``actions/allocate/allocate.go:95-192``) — a sequential feedback loop
+that a naive batched argmax would violate (two tasks double-booking one node's
+last slot).  Here that loop IS the kernel: a ``lax.scan`` over the job's pending
+tasks in task order, carrying the idle/releasing matrices and per-node task
+counts.  Each step fuses the whole per-task pipeline the reference runs as three
+16-goroutine sweeps:
+
+  fit (idle | releasing, epsilon-exact) & static predicate row & pod-count
+  -> dynamic node score (least-requested / balanced / binpack from live idle)
+  -> argmax -> allocate (idle -= req) or pipeline (releasing -= req)
+
+Reference parity notes:
+* stop conditions mirror allocate.go: first task with no feasible node stops the
+  job (``failed`` marks it, host records FitErrors); the JobReady break at
+  allocate.go:184-187 is modeled as a ``ready_deficit`` — the number of further
+  *allocations* after which the job becomes gang-ready.  The break check runs
+  after every placement, so once the deficit is covered (or was already ≤ 0),
+  the next placement of any kind stops the pop — exactly the reference, where a
+  pipeline onto an already-ready job still triggers the break.
+* SelectBestNode picks uniformly among top scorers (scheduler_helper.go:147-158);
+  we take the lowest-index top scorer instead — deterministic, same score class.
+* pipelined placements don't count toward the ready quota (JobReady counts
+  allocated tasks only, job_info.go:367-375).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_tpu.ops.predicates import fit_mask
+from scheduler_tpu.ops.scoring import dynamic_score
+
+
+@dataclass
+class NodeState:
+    """Device-resident node state threaded through placements within one action."""
+
+    idle: jnp.ndarray         # f32 [N, R] (device units)
+    releasing: jnp.ndarray    # f32 [N, R]
+    task_count: jnp.ndarray   # i32 [N]
+    allocatable: jnp.ndarray  # f32 [N, R]
+    pods_limit: jnp.ndarray   # i32 [N]
+    mins: jnp.ndarray         # f32 [R] scaled epsilon thresholds
+
+
+@dataclass
+class JobPlacementSpec:
+    """One job's pending tasks, in task order, padded to a bucket size."""
+
+    init_resreq: jnp.ndarray  # f32 [T, R] fit requests (InitResreq)
+    resreq: jnp.ndarray       # f32 [T, R] accounting requests (Resreq)
+    static_mask: jnp.ndarray  # bool [T, N] session-static predicates per task
+    static_score: jnp.ndarray  # f32 [T, N] session-static score contributions
+    valid: jnp.ndarray        # bool [T] real task vs padding
+    ready_deficit: jnp.ndarray  # i32 scalar: allocations still needed for readiness
+
+
+@dataclass
+class PlacementResult:
+    chosen: np.ndarray     # i32 [T] node index or -1
+    pipelined: np.ndarray  # bool [T]
+    failed: np.ndarray     # bool [T] first infeasible task (host records FitErrors)
+
+
+@functools.partial(jax.jit, static_argnames=("weights", "enforce_pod_count"))
+def _place_scan(
+    idle: jnp.ndarray,
+    releasing: jnp.ndarray,
+    task_count: jnp.ndarray,
+    allocatable: jnp.ndarray,
+    pods_limit: jnp.ndarray,
+    mins: jnp.ndarray,
+    init_resreq: jnp.ndarray,
+    resreq: jnp.ndarray,
+    static_mask: jnp.ndarray,
+    static_score: jnp.ndarray,
+    valid: jnp.ndarray,
+    ready_deficit: jnp.ndarray,
+    weights: Tuple[float, float, float],
+    enforce_pod_count: bool,
+):
+    n = idle.shape[0]
+
+    def step(carry, xs):
+        idle, releasing, task_count, n_alloc, stopped = carry
+        init_req, req, smask, sscore, is_valid = xs
+
+        fit_idle = fit_mask(init_req, idle, mins)
+        fit_rel = fit_mask(init_req, releasing, mins)
+        feasible = (fit_idle | fit_rel) & smask
+        if enforce_pod_count:
+            # The pod-count predicate belongs to the predicates plugin
+            # (predicates.go:162-166); without it the host path doesn't check
+            # it either, so the gate is trace-time conditional.
+            feasible = feasible & (task_count < pods_limit)
+        any_feasible = jnp.any(feasible)
+
+        score = sscore + dynamic_score(init_req, idle, allocatable, *weights)
+        masked_score = jnp.where(feasible, score, -jnp.inf)
+        best = jnp.argmax(masked_score)
+
+        active = (~stopped) & is_valid
+        placed = active & any_feasible
+        alloc_here = placed & fit_idle[best]
+        pipe_here = placed & ~fit_idle[best] & fit_rel[best]
+
+        delta = jnp.zeros_like(idle).at[best].set(req)
+        idle = idle - delta * alloc_here
+        releasing = releasing - delta * pipe_here
+        task_count = task_count + ((jnp.arange(n) == best) & (alloc_here | pipe_here))
+
+        n_alloc = n_alloc + alloc_here
+        failed = active & ~any_feasible
+        # JobReady break: checked after every placement, counting allocations
+        # against the remaining gang deficit (pipelines never cover deficit).
+        became_ready = (alloc_here | pipe_here) & (n_alloc >= ready_deficit)
+        stopped = stopped | failed | became_ready
+
+        chosen = jnp.where(alloc_here | pipe_here, best, -1)
+        return (idle, releasing, task_count, n_alloc, stopped), (chosen, pipe_here, failed)
+
+    init = (
+        idle,
+        releasing,
+        task_count,
+        jnp.zeros((), dtype=jnp.int32),
+        jnp.zeros((), dtype=bool),
+    )
+    xs = (init_resreq, resreq, static_mask, static_score, valid)
+    (idle, releasing, task_count, _, _), (chosen, pipelined, failed) = jax.lax.scan(
+        step, init, xs
+    )
+    return idle, releasing, task_count, chosen, pipelined, failed
+
+
+def sequential_place_job(
+    state: NodeState,
+    spec: JobPlacementSpec,
+    weights: Tuple[float, float, float] = (0.0, 0.0, 0.0),
+    enforce_pod_count: bool = False,
+) -> Tuple[NodeState, PlacementResult]:
+    """Place one job's tasks sequentially on device; returns updated node state.
+
+    ``weights`` = (least_requested, balanced_allocation, binpack) scorer weights;
+    static at trace time so disabled scorers compile away.
+    """
+    idle, releasing, task_count, chosen, pipelined, failed = _place_scan(
+        state.idle,
+        state.releasing,
+        state.task_count,
+        state.allocatable,
+        state.pods_limit,
+        state.mins,
+        spec.init_resreq,
+        spec.resreq,
+        spec.static_mask,
+        spec.static_score,
+        spec.valid,
+        spec.ready_deficit,
+        weights,
+        enforce_pod_count,
+    )
+    new_state = NodeState(
+        idle=idle,
+        releasing=releasing,
+        task_count=task_count,
+        allocatable=state.allocatable,
+        pods_limit=state.pods_limit,
+        mins=state.mins,
+    )
+    result = PlacementResult(
+        chosen=np.asarray(chosen),
+        pipelined=np.asarray(pipelined),
+        failed=np.asarray(failed),
+    )
+    return new_state, result

@@ -1,0 +1,102 @@
+"""Predicate mask kernels: the reference's predicate stack as [T, N] booleans.
+
+Reference behaviors covered (``plugins/predicates/predicates.go:154-299``):
+node selector / node affinity label matching, taints vs tolerations, pod-count
+limits, node readiness/unschedulable gates.  Label logic is vocabulary-encoded
+(see ``api.tensors.LabelVocab``): "every required pair present on the node"
+compiles to a boolean matmul on the MXU instead of a per-(task, node) string-set
+walk.
+
+Resource fit is separate (``fit_mask``) because it reads the *live* idle matrix
+inside the placement scan; the label/taint/count masks are static for a session.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fit_mask(req: jnp.ndarray, avail: jnp.ndarray, mins: jnp.ndarray) -> jnp.ndarray:
+    """Epsilon-exact LessEqual of one request against many availability rows.
+
+    req [R], avail [N, R], mins [R] -> bool [N].  Mirrors
+    ``Resource.LessEqual`` (resource_info.go:253-276): per dim,
+    req < avail or |avail - req| < min.
+    """
+    return jnp.all((req[None, :] < avail) | (jnp.abs(avail - req[None, :]) < mins[None, :]), axis=-1)
+
+
+def fit_mask_batch(req: jnp.ndarray, avail: jnp.ndarray, mins: jnp.ndarray) -> jnp.ndarray:
+    """Batched fit: req [T, R] x avail [N, R] -> bool [T, N]."""
+    a = avail[None, :, :]
+    r = req[:, None, :]
+    return jnp.all((r < a) | (jnp.abs(a - r) < mins[None, None, :]), axis=-1)
+
+
+def selector_mask(task_selector: jnp.ndarray, node_labels: jnp.ndarray) -> jnp.ndarray:
+    """Required-label matching as a matmul: [T, L] x [N, L] -> bool [T, N].
+
+    A (task, node) pair passes iff no required pair is missing on the node:
+    violations = selector @ (1 - labels)^T; pass where violations == 0.
+    The [T, L] x [L, N] product is the MXU-friendly core of the predicate stage.
+    """
+    if task_selector.shape[1] == 0:
+        return jnp.ones((task_selector.shape[0], node_labels.shape[0]), dtype=bool)
+    sel = task_selector.astype(jnp.float32)
+    missing = (~node_labels).astype(jnp.float32)
+    violations = sel @ missing.T
+    return violations == 0
+
+
+def taint_mask(node_taints: jnp.ndarray, task_tolerations: jnp.ndarray) -> jnp.ndarray:
+    """Taint/toleration matching: [N, K] taint membership x [T, K] toleration
+    membership -> bool [T, N]; a pair passes iff every taint on the node is
+    tolerated: untolerated = (1 - tolerations) @ taints^T == 0."""
+    if node_taints.shape[1] == 0:
+        return jnp.ones((task_tolerations.shape[0], node_taints.shape[0]), dtype=bool)
+    untol = (~task_tolerations).astype(jnp.float32)
+    taints = node_taints.astype(jnp.float32)
+    violations = untol @ taints.T
+    return violations == 0
+
+
+def node_gate_mask(
+    ready: jnp.ndarray,
+    unschedulable: jnp.ndarray,
+    check_unschedulable: bool = True,
+) -> jnp.ndarray:
+    """Per-node admission gate [N] (CheckNodeCondition / unschedulable flag)."""
+    gate = ready
+    if check_unschedulable:
+        gate = gate & ~unschedulable
+    return gate
+
+
+def pod_count_mask(task_count: jnp.ndarray, pods_limit: jnp.ndarray) -> jnp.ndarray:
+    """Pod-number predicate [N] (predicates.go:162-166)."""
+    return task_count < pods_limit
+
+
+def base_static_mask(n_tasks: int, node_ready: jnp.ndarray) -> jnp.ndarray:
+    """The plugin-independent static mask -> bool [T, N]: only the node-ready
+    gate.  Selector/taint/unschedulable/pod-affinity enforcement belongs to the
+    predicates *plugin* (as in the reference — without it configured, a pod's
+    node selector is NOT honored), which contributes its own mask via
+    ``ssn.add_device_predicate``."""
+    return jnp.broadcast_to(node_ready[None, :], (n_tasks, node_ready.shape[0]))
+
+
+@jax.jit
+def plugin_predicate_mask(
+    task_selector: jnp.ndarray,
+    has_unknown_selector: jnp.ndarray,
+    node_labels: jnp.ndarray,
+    node_unschedulable: jnp.ndarray,
+) -> jnp.ndarray:
+    """The predicates plugin's session-static mask -> bool [T, N]: label
+    selector matching + the unschedulable-node gate (predicates.go:169-231)."""
+    mask = selector_mask(task_selector, node_labels)
+    mask = mask & ~has_unknown_selector[:, None]
+    mask = mask & ~node_unschedulable[None, :]
+    return mask

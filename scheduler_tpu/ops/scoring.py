@@ -1,0 +1,83 @@
+"""Node scoring kernels (the reference's nodeorder plugin on device).
+
+Reference: ``plugins/nodeorder/nodeorder.go:188-247`` wraps the upstream k8s
+priority functions; the two resource-driven ones are reproduced from their k8s
+definitions so they can read the *live* idle matrix inside the placement scan:
+
+* least_requested: score = Σ_dims ((capacity - requested) / capacity) * 10 / #dims
+  — favors empty nodes, spreading load.
+* balanced_allocation: 10 - |cpu_fraction - memory_fraction| * 10 — penalizes
+  lopsided usage.
+
+Static contributions (preferred node affinity, inter-pod affinity) are computed
+once per session as a [T, N] matrix and added to the dynamic score.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from scheduler_tpu.api.vocab import CPU, MEMORY
+
+
+def least_requested_score(
+    req: jnp.ndarray, idle: jnp.ndarray, allocatable: jnp.ndarray
+) -> jnp.ndarray:
+    """req [R], idle [N, R], allocatable [N, R] -> score [N] in [0, 10].
+
+    k8s LeastRequestedPriority over cpu+memory: requested = allocatable - idle
+    (+ the incoming request), score per dim = (alloc - requested) / alloc * 10.
+    """
+    requested = allocatable - idle + req[None, :]
+    safe_alloc = jnp.where(allocatable > 0, allocatable, 1.0)
+    frac = jnp.clip((allocatable - requested) / safe_alloc, 0.0, 1.0)
+    cpu_mem = jnp.stack([frac[:, CPU], frac[:, MEMORY]], axis=-1)
+    return jnp.mean(cpu_mem, axis=-1) * 10.0
+
+
+def balanced_allocation_score(
+    req: jnp.ndarray, idle: jnp.ndarray, allocatable: jnp.ndarray
+) -> jnp.ndarray:
+    """req [R], idle [N, R], allocatable [N, R] -> score [N] in [0, 10].
+
+    k8s BalancedResourceAllocation: 10 - |cpuFraction - memoryFraction| * 10,
+    fractions of requested/allocatable after placing the request.
+    """
+    requested = allocatable - idle + req[None, :]
+    safe_alloc = jnp.where(allocatable > 0, allocatable, 1.0)
+    frac = jnp.clip(requested / safe_alloc, 0.0, 1.0)
+    diff = jnp.abs(frac[:, CPU] - frac[:, MEMORY])
+    return (1.0 - diff) * 10.0
+
+
+def binpack_score(
+    req: jnp.ndarray, idle: jnp.ndarray, allocatable: jnp.ndarray
+) -> jnp.ndarray:
+    """MostRequested-style packing score [N]: favor fuller nodes so gangs and
+    large future jobs find holes — the score used by the 10k-node bench config.
+    """
+    requested = allocatable - idle + req[None, :]
+    safe_alloc = jnp.where(allocatable > 0, allocatable, 1.0)
+    frac = jnp.clip(requested / safe_alloc, 0.0, 1.0)
+    cpu_mem = jnp.stack([frac[:, CPU], frac[:, MEMORY]], axis=-1)
+    return jnp.mean(cpu_mem, axis=-1) * 10.0
+
+
+def dynamic_score(
+    req: jnp.ndarray,
+    idle: jnp.ndarray,
+    allocatable: jnp.ndarray,
+    least_requested_weight: float,
+    balanced_weight: float,
+    binpack_weight: float,
+) -> jnp.ndarray:
+    """Weighted sum of the idle-dependent scorers; weights of 0 fold away at trace
+    time (they are Python floats, so XLA never sees disabled scorers)."""
+    score = jnp.zeros(idle.shape[0], dtype=jnp.float32)
+    if least_requested_weight:
+        score = score + least_requested_weight * least_requested_score(req, idle, allocatable)
+    if balanced_weight:
+        score = score + balanced_weight * balanced_allocation_score(req, idle, allocatable)
+    if binpack_weight:
+        score = score + binpack_weight * binpack_score(req, idle, allocatable)
+    return score

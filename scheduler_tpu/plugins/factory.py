@@ -1,0 +1,11 @@
+"""Registers the builtin plugins (reference ``plugins/factory.go:33-42``)."""
+
+from scheduler_tpu.framework.registry import register_plugin_builder
+from scheduler_tpu.plugins import gang, priority
+
+register_plugin_builder("gang", gang.new)
+register_plugin_builder("priority", priority.new)
+
+
+def register_all() -> None:
+    """Idempotent explicit hook (import already registers everything)."""

@@ -1,0 +1,102 @@
+"""Host-path scheduling helpers (reference ``pkg/scheduler/util/scheduler_helper.go``).
+
+These back the *fallback* path used when a session carries plugins without
+device counterparts; the accelerated path lives in ``scheduler_tpu.ops``.  The
+reference parallelizes these sweeps across 16 goroutines; under the GIL plain
+loops are faster for the fallback's scale, so the fan-out stays in the device
+engine where it belongs.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+from typing import Callable, Dict, List, Tuple
+
+from scheduler_tpu.api.job_info import TaskInfo
+from scheduler_tpu.api.node_info import NodeInfo
+from scheduler_tpu.api.unschedule_info import FitErrors
+
+
+def get_node_list(nodes: Dict[str, NodeInfo]) -> List[NodeInfo]:
+    return sorted(nodes.values(), key=lambda n: n.name)
+
+
+def predicate_nodes(
+    task: TaskInfo,
+    nodes: List[NodeInfo],
+    fn: Callable[[TaskInfo, NodeInfo], None],
+) -> Tuple[List[NodeInfo], FitErrors]:
+    """All nodes passing ``fn`` (which raises on failure), plus the failures
+    (scheduler_helper.go:34-64)."""
+    passing: List[NodeInfo] = []
+    errors = FitErrors()
+    for node in nodes:
+        try:
+            fn(task, node)
+        except Exception as err:  # FitError or plugin-raised failure
+            errors.set_node_error(node.name, err)
+        else:
+            passing.append(node)
+    return passing, errors
+
+
+def prioritize_nodes(
+    task: TaskInfo,
+    nodes: List[NodeInfo],
+    batch_fn: Callable,
+    map_fn: Callable,
+    reduce_fn: Callable,
+) -> Dict[NodeInfo, float]:
+    """Map/reduce + batch scoring merge (scheduler_helper.go:67-129)."""
+    plugin_scores: Dict[str, Dict[str, float]] = {}
+    order_scores: Dict[NodeInfo, float] = {}
+    for node in nodes:
+        per_plugin, score = map_fn(task, node)
+        order_scores[node] = score
+        for plugin, s in per_plugin.items():
+            plugin_scores.setdefault(plugin, {})[node.name] = s
+
+    reduced = reduce_fn(task, plugin_scores)
+    batch = batch_fn(task, nodes)
+
+    result: Dict[NodeInfo, float] = {}
+    for node in nodes:
+        result[node] = (
+            order_scores.get(node, 0.0)
+            + reduced.get(node.name, 0.0)
+            + batch.get(node.name, 0.0)
+        )
+    return result
+
+
+def sort_nodes(node_scores: Dict[NodeInfo, float]) -> List[NodeInfo]:
+    """Nodes best-first (scheduler_helper.go:131-145)."""
+    return [n for n, _ in sorted(node_scores.items(), key=lambda kv: -kv[1])]
+
+
+def select_best_node(node_scores: Dict[NodeInfo, float]) -> NodeInfo:
+    """Uniform pick among the top-scoring nodes (scheduler_helper.go:147-158)."""
+    best_score = None
+    best: List[NodeInfo] = []
+    for node, score in node_scores.items():
+        if best_score is None or score > best_score:
+            best_score = score
+            best = [node]
+        elif score == best_score:
+            best.append(node)
+    return random.choice(best)
+
+
+def task_sort_key(ssn) -> Callable:
+    """Sort key equivalent of the session's task_order_fn for list.sort()."""
+
+    def cmp(l: TaskInfo, r: TaskInfo) -> int:
+        res = ssn.task_compare_fns(l, r)
+        if res != 0:
+            return res
+        if l.creation_timestamp != r.creation_timestamp:
+            return -1 if l.creation_timestamp < r.creation_timestamp else 1
+        return -1 if l.uid < r.uid else (1 if l.uid > r.uid else 0)
+
+    return functools.cmp_to_key(cmp)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import logging
 import os
+from collections import deque
 from typing import Dict, List, Optional
 
 from scheduler_tpu.api.job_info import JobInfo, TaskInfo
@@ -35,6 +36,7 @@ from scheduler_tpu.utils.scheduler_helper import (
     predicate_nodes,
     prioritize_nodes,
     select_best_node,
+    task_sort_key,
 )
 
 logger = logging.getLogger("scheduler_tpu.actions.allocate")
@@ -79,7 +81,12 @@ class AllocateAction(Action):
             if DeviceAllocator.supported(ssn):
                 engine = DeviceAllocator(ssn, candidates)
 
+        # Host path keeps the reference's per-job PriorityQueue; the device path
+        # uses a sorted deque + cursor instead — the scan consumes tasks strictly
+        # in task order, and repeated pops of a gang-ready job would otherwise
+        # drain/re-push the whole heap each time (O(T^2 log T) on a big tail).
         pending_tasks: Dict[str, PriorityQueue] = {}
+        ordered_pending: Dict[str, deque] = {}
         all_nodes = get_node_list(ssn.nodes)
 
         def host_predicate(task: TaskInfo, node) -> None:
@@ -101,37 +108,46 @@ class AllocateAction(Action):
                 continue
 
             job = jobs.pop()
-            if job.uid not in pending_tasks:
-                tasks = PriorityQueue(ssn.task_order_fn)
-                for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
-                    if task.resreq.is_empty():
-                        continue  # BestEffort handled by backfill
-                    tasks.push(task)
-                pending_tasks[job.uid] = tasks
-            tasks = pending_tasks[job.uid]
-
             if engine is not None:
-                self._run_device_pop(ssn, engine, job, tasks, jobs)
+                if job.uid not in ordered_pending:
+                    eligible = [
+                        t
+                        for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
+                        if not t.resreq.is_empty()  # BestEffort handled by backfill
+                    ]
+                    eligible.sort(key=task_sort_key(ssn))
+                    ordered_pending[job.uid] = deque(eligible)
+                self._run_device_pop(ssn, engine, job, ordered_pending[job.uid], jobs)
             else:
-                self._run_host_pop(ssn, job, tasks, jobs, all_nodes, host_predicate)
+                if job.uid not in pending_tasks:
+                    tasks = PriorityQueue(ssn.task_order_fn)
+                    for task in job.task_status_index.get(TaskStatus.PENDING, {}).values():
+                        if task.resreq.is_empty():
+                            continue
+                        tasks.push(task)
+                    pending_tasks[job.uid] = tasks
+                self._run_host_pop(ssn, job, pending_tasks[job.uid], jobs, all_nodes, host_predicate)
 
             queues.push(queue)
 
     # -- device engine -------------------------------------------------------
 
-    def _run_device_pop(self, ssn, engine, job: JobInfo, tasks: PriorityQueue, jobs: PriorityQueue) -> None:
-        ordered: List[TaskInfo] = []
-        while not tasks.empty():
-            ordered.append(tasks.pop())
-        if not ordered:
+    def _run_device_pop(self, ssn, engine, job: JobInfo, pending: deque, jobs: PriorityQueue) -> None:
+        if not pending:
             return
+
+        # When the gang is already ready the scan stops after one placement, so
+        # hand it a single task; otherwise the remaining ordered tail.
+        deficit = engine.ready_deficit(job)
+        if deficit is not None and deficit <= 0:
+            ordered: List[TaskInfo] = [pending[0]]
+        else:
+            ordered = list(pending)
 
         rows = engine.place_job(job, ordered)
         if rows is None:
             # Unknown job_ready semantics — shouldn't happen with builtins.
             logger.warning("device engine refused job %s; tasks left pending", job.uid)
-            for t in ordered:
-                tasks.push(t)
             return
 
         consumed = 0
@@ -153,8 +169,8 @@ class AllocateAction(Action):
                 requeue_job = True
                 break
 
-        for t in ordered[consumed:]:
-            tasks.push(t)
+        for _ in range(consumed):
+            pending.popleft()
         if requeue_job:
             jobs.push(job)
 

@@ -63,6 +63,36 @@ class LabelVocab:
         return len(self._index)
 
 
+class TaintVocab:
+    """Append-only (key, value, effect) taint vocabulary for one snapshot.
+
+    Only scheduling-relevant effects (NoSchedule / NoExecute) get columns; a
+    node's taint membership row and a task's toleration-coverage row over the
+    same columns turn PodToleratesNodeTaints into a boolean matmul.
+    """
+
+    SCHEDULING_EFFECTS = ("NoSchedule", "NoExecute")
+
+    def __init__(self) -> None:
+        self._index: Dict[Tuple[str, str, str], int] = {}
+        self.taints: List = []  # Taint object per column
+
+    def index(self, taint) -> Optional[int]:
+        if taint.effect not in self.SCHEDULING_EFFECTS:
+            return None
+        key = (taint.key, taint.value, taint.effect)
+        idx = self._index.get(key)
+        if idx is None:
+            idx = len(self._index)
+            self._index[key] = idx
+            self.taints.append(taint)
+        return idx
+
+    @property
+    def size(self) -> int:
+        return len(self._index)
+
+
 @dataclass
 class NodeTensors:
     names: List[str]
@@ -76,6 +106,7 @@ class NodeTensors:
     ready: np.ndarray         # bool [N]
     unschedulable: np.ndarray  # bool [N]
     labels: np.ndarray        # bool [N, L]
+    taints: np.ndarray        # bool [N, K] taint membership
 
     @property
     def count(self) -> int:
@@ -94,6 +125,7 @@ class TaskTensors:
     best_effort: np.ndarray   # bool [T] (init_resreq below every epsilon)
     selector: np.ndarray      # bool [T, L] required label pairs
     has_unknown_selector: np.ndarray  # bool [T]: selector references a pair no node has
+    tolerated: np.ndarray     # bool [T, K] taint columns this task tolerates
 
     @property
     def count(self) -> int:
@@ -114,6 +146,7 @@ class JobTensors:
 class SnapshotTensors:
     vocab: ResourceVocabulary
     label_vocab: LabelVocab
+    taint_vocab: TaintVocab
     min_thresholds: np.ndarray  # f64 [R]
     nodes: NodeTensors
     tasks: TaskTensors
@@ -125,6 +158,7 @@ def build_node_tensors(
     nodes: Sequence[NodeInfo],
     vocab: ResourceVocabulary,
     label_vocab: LabelVocab,
+    taint_vocab: TaintVocab,
 ) -> NodeTensors:
     n = len(nodes)
     r = vocab.size
@@ -137,15 +171,18 @@ def build_node_tensors(
     ready = np.zeros(n, dtype=bool)
     unschedulable = np.zeros(n, dtype=bool)
 
-    # First pass registers every node label pair so the mask width is final.
+    # First pass registers every node label pair / taint so mask widths are final.
     for ni in nodes:
         if ni.node is not None:
             for k, v in ni.node.labels.items():
                 label_vocab.index(k, v)
             # hostname is an implicit label for topology/affinity matching
             label_vocab.index("kubernetes.io/hostname", ni.name)
+            for taint in ni.node.taints:
+                taint_vocab.index(taint)
 
     labels = np.zeros((n, label_vocab.size), dtype=bool)
+    taints = np.zeros((n, taint_vocab.size), dtype=bool)
     names: List[str] = []
     for i, ni in enumerate(nodes):
         names.append(ni.name)
@@ -161,6 +198,10 @@ def build_node_tensors(
             for k, v in ni.node.labels.items():
                 labels[i, label_vocab.index(k, v)] = True
             labels[i, label_vocab.index("kubernetes.io/hostname", ni.name)] = True
+            for taint in ni.node.taints:
+                col = taint_vocab.index(taint)
+                if col is not None:
+                    taints[i, col] = True
 
     return NodeTensors(
         names=names,
@@ -174,6 +215,7 @@ def build_node_tensors(
         ready=ready,
         unschedulable=unschedulable,
         labels=labels,
+        taints=taints,
     )
 
 
@@ -190,6 +232,7 @@ def build_task_tensors(
     jobs: JobTensors,
     vocab: ResourceVocabulary,
     label_vocab: LabelVocab,
+    taint_vocab: TaintVocab,
 ) -> TaskTensors:
     t = len(tasks)
     r = vocab.size
@@ -201,6 +244,7 @@ def build_task_tensors(
     creation = np.zeros(t)
     selector = np.zeros((t, label_vocab.size), dtype=bool)
     has_unknown = np.zeros(t, dtype=bool)
+    tolerated = np.zeros((t, taint_vocab.size), dtype=bool)
 
     uids: List[str] = []
     for i, ti in enumerate(tasks):
@@ -217,6 +261,9 @@ def build_task_tensors(
                 has_unknown[i] = True
             else:
                 selector[i, idx] = True
+        for col, taint in enumerate(taint_vocab.taints):
+            if any(tol.tolerates(taint) for tol in ti.pod.tolerations):
+                tolerated[i, col] = True
 
     best_effort = np.all(init_resreq < mins[None, :], axis=1)
 
@@ -231,6 +278,7 @@ def build_task_tensors(
         best_effort=best_effort,
         selector=selector,
         has_unknown_selector=has_unknown,
+        tolerated=tolerated,
     )
 
 
@@ -268,14 +316,16 @@ def build_snapshot_tensors(
     """Encode one session's world.  ``tasks`` picks which tasks get rows (usually
     the pending tasks the current action cares about), in the caller's order."""
     label_vocab = LabelVocab()
+    taint_vocab = TaintVocab()
     node_list = sorted(nodes, key=lambda n: n.name)
     job_list = list(jobs)
-    node_tensors = build_node_tensors(node_list, vocab, label_vocab)
+    node_tensors = build_node_tensors(node_list, vocab, label_vocab, taint_vocab)
     job_tensors = build_job_tensors(job_list, queue_names)
-    task_tensors = build_task_tensors(tasks, job_tensors, vocab, label_vocab)
+    task_tensors = build_task_tensors(tasks, job_tensors, vocab, label_vocab, taint_vocab)
     return SnapshotTensors(
         vocab=vocab,
         label_vocab=label_vocab,
+        taint_vocab=taint_vocab,
         min_thresholds=vocab.min_thresholds(),
         nodes=node_tensors,
         tasks=task_tensors,

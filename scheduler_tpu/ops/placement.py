@@ -104,7 +104,10 @@ def _place_scan(
             feasible = feasible & (task_count < pods_limit)
         any_feasible = jnp.any(feasible)
 
-        score = sscore + dynamic_score(init_req, idle, allocatable, *weights)
+        # Scoring uses the accounting request (resreq), matching the host
+        # nodeorder/binpack formulas and the k8s priority functions; only the
+        # FIT check uses init_resreq.
+        score = sscore + dynamic_score(req, idle, allocatable, *weights)
         masked_score = jnp.where(feasible, score, -jnp.inf)
         best = jnp.argmax(masked_score)
 

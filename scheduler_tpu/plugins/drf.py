@@ -1,0 +1,120 @@
+"""DRF plugin: Dominant Resource Fairness across jobs
+(reference ``plugins/drf/drf.go``).
+
+A job's share = max over resource dims of allocated/clusterTotal; jobs order by
+lower share, and a preemptor may take from a preemptee whose post-eviction share
+stays >= the preemptor's post-allocation share (within shareDelta).  Shares stay
+live through session allocate/deallocate event handlers.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Dict
+
+from scheduler_tpu.api.job_info import JobInfo, TaskInfo
+from scheduler_tpu.api.resource import ResourceVec, share as share_fn
+from scheduler_tpu.api.types import allocated_status
+from scheduler_tpu.framework.arguments import Arguments
+from scheduler_tpu.framework.interface import EventHandler, Plugin
+
+logger = logging.getLogger("scheduler_tpu.plugins.drf")
+
+SHARE_DELTA = 0.000001
+
+
+class _DrfAttr:
+    __slots__ = ("share", "allocated")
+
+    def __init__(self, allocated: ResourceVec) -> None:
+        self.allocated = allocated
+        self.share = 0.0
+
+
+class DrfPlugin(Plugin):
+    def __init__(self, arguments: Arguments) -> None:
+        self.arguments = arguments
+        self.total_resource: ResourceVec = None  # type: ignore[assignment]
+        self.job_attrs: Dict[str, _DrfAttr] = {}
+
+    def name(self) -> str:
+        return "drf"
+
+    def _calculate_share(self, allocated: ResourceVec) -> float:
+        res = 0.0
+        for rn in self.total_resource.resource_names():
+            s = share_fn(allocated.get(rn), self.total_resource.get(rn))
+            if s > res:
+                res = s
+        return res
+
+    def _update_share(self, attr: _DrfAttr) -> None:
+        attr.share = self._calculate_share(attr.allocated)
+
+    def on_session_open(self, ssn) -> None:
+        vocab = next(iter(ssn.jobs.values())).vocab if ssn.jobs else None
+        if vocab is None:
+            return
+        self.total_resource = ResourceVec.empty(vocab)
+        for node in ssn.nodes.values():
+            self.total_resource.add(node.allocatable)
+
+        for job in ssn.jobs.values():
+            attr = _DrfAttr(ResourceVec.empty(vocab))
+            for status, tasks in job.task_status_index.items():
+                if allocated_status(status):
+                    for t in tasks.values():
+                        attr.allocated.add(t.resreq)
+            self._update_share(attr)
+            self.job_attrs[job.uid] = attr
+
+        def preemptable_fn(preemptor: TaskInfo, preemptees):
+            victims = None
+            latt = self.job_attrs[preemptor.job]
+            lalloc = latt.allocated.clone().add(preemptor.resreq)
+            ls = self._calculate_share(lalloc)
+
+            allocations: Dict[str, ResourceVec] = {}
+            for preemptee in preemptees:
+                if preemptee.job not in allocations:
+                    ratt = self.job_attrs[preemptee.job]
+                    allocations[preemptee.job] = ratt.allocated.clone()
+                ralloc = allocations[preemptee.job].sub(preemptee.resreq)
+                rs = self._calculate_share(ralloc)
+                if ls < rs or math.isclose(ls, rs, abs_tol=SHARE_DELTA):
+                    victims = victims or []
+                    victims.append(preemptee)
+            logger.debug("DRF victims: %s", victims)
+            return victims
+
+        ssn.add_preemptable_fn(self.name(), preemptable_fn)
+
+        def job_order_fn(l: JobInfo, r: JobInfo) -> int:
+            ls = self.job_attrs[l.uid].share
+            rs = self.job_attrs[r.uid].share
+            if ls == rs:
+                return 0
+            return -1 if ls < rs else 1
+
+        ssn.add_job_order_fn(self.name(), job_order_fn)
+
+        def on_allocate(event) -> None:
+            attr = self.job_attrs[event.task.job]
+            attr.allocated.add(event.task.resreq)
+            self._update_share(attr)
+
+        def on_deallocate(event) -> None:
+            attr = self.job_attrs[event.task.job]
+            attr.allocated.sub(event.task.resreq)
+            self._update_share(attr)
+
+        ssn.add_event_handler(EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate))
+
+    def on_session_close(self, ssn) -> None:
+        self.total_resource = None  # type: ignore[assignment]
+        self.job_attrs = {}
+
+
+def new(arguments: Arguments) -> DrfPlugin:
+    return DrfPlugin(arguments)

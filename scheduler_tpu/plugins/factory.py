@@ -1,10 +1,25 @@
 """Registers the builtin plugins (reference ``plugins/factory.go:33-42``)."""
 
 from scheduler_tpu.framework.registry import register_plugin_builder
-from scheduler_tpu.plugins import gang, priority
+from scheduler_tpu.plugins import (
+    binpack,
+    conformance,
+    drf,
+    gang,
+    nodeorder,
+    predicates,
+    priority,
+    proportion,
+)
 
 register_plugin_builder("gang", gang.new)
 register_plugin_builder("priority", priority.new)
+register_plugin_builder("drf", drf.new)
+register_plugin_builder("proportion", proportion.new)
+register_plugin_builder("predicates", predicates.new)
+register_plugin_builder("nodeorder", nodeorder.new)
+register_plugin_builder("conformance", conformance.new)
+register_plugin_builder("binpack", binpack.new)
 
 
 def register_all() -> None:

@@ -1,0 +1,103 @@
+"""Nodeorder plugin: soft node scoring (reference ``plugins/nodeorder/nodeorder.go``).
+
+Arg-weighted priorities: least-requested, balanced-resource-allocation, and
+preferred node affinity (``nodeaffinity.weight``/``leastrequested.weight``/
+``balancedresource.weight``; defaults 1 like nodeorder.go:96-140).
+
+Host path registers a node_order_fn computing exactly the formulas in
+``ops.scoring``; the device path declares the least-requested/balanced weights
+for the in-scan dynamic scorer and contributes preferred-node-affinity as a
+static [T, N] score matrix — so both engines rank nodes identically.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict
+
+import numpy as np
+
+from scheduler_tpu.api.job_info import TaskInfo
+from scheduler_tpu.api.node_info import NodeInfo
+from scheduler_tpu.framework.arguments import Arguments
+from scheduler_tpu.framework.interface import Plugin
+from scheduler_tpu.plugins.util import balanced_allocation_host, least_requested_host
+
+logger = logging.getLogger("scheduler_tpu.plugins.nodeorder")
+
+NODE_AFFINITY_WEIGHT = "nodeaffinity.weight"
+POD_AFFINITY_WEIGHT = "podaffinity.weight"
+LEAST_REQUESTED_WEIGHT = "leastrequested.weight"
+BALANCED_RESOURCE_WEIGHT = "balancedresource.weight"
+
+
+def node_affinity_preferred_score(task: TaskInfo, node_labels: Dict[str, str]) -> float:
+    aff = task.pod.affinity
+    if aff is None or not aff.node_preferred:
+        return 0.0
+    score = 0.0
+    for weight, reqs in aff.node_preferred:
+        if all(r.matches(node_labels) for r in reqs):
+            score += weight
+    return score
+
+
+class NodeOrderPlugin(Plugin):
+    def __init__(self, arguments: Arguments) -> None:
+        self.arguments = arguments
+        self.w_node_affinity = arguments.get_float(NODE_AFFINITY_WEIGHT, 1.0)
+        self.w_least_requested = arguments.get_float(LEAST_REQUESTED_WEIGHT, 1.0)
+        self.w_balanced = arguments.get_float(BALANCED_RESOURCE_WEIGHT, 1.0)
+
+    def name(self) -> str:
+        return "nodeorder"
+
+    def on_session_open(self, ssn) -> None:
+        w_lr, w_bal, w_aff = self.w_least_requested, self.w_balanced, self.w_node_affinity
+
+        def node_order_fn(task: TaskInfo, node: NodeInfo) -> float:
+            score = 0.0
+            if w_lr:
+                score += w_lr * least_requested_host(task, node)
+            if w_bal:
+                score += w_bal * balanced_allocation_host(task, node)
+            if w_aff and node.node is not None:
+                score += w_aff * node_affinity_preferred_score(task, node.node.labels)
+            return score
+
+        ssn.add_node_order_fn(self.name(), node_order_fn)
+
+        # Device: dynamic weights for idle-dependent scorers; static matrix for
+        # preferred node affinity.
+        ssn.device_score_weights["least_requested"] = (
+            ssn.device_score_weights.get("least_requested", 0.0) + w_lr
+        )
+        ssn.device_score_weights["balanced"] = (
+            ssn.device_score_weights.get("balanced", 0.0) + w_bal
+        )
+        ssn.device_weighted_plugins.add(self.name())
+
+        if w_aff:
+            task_index: Dict[str, TaskInfo] = {}
+            for job in ssn.jobs.values():
+                task_index.update(job.tasks)
+
+            def affinity_scorer(st) -> np.ndarray:
+                score = np.zeros((st.tasks.count, st.nodes.count), dtype=np.float32)
+                node_specs = [ssn.nodes[name].node for name in st.nodes.names]
+                for i, uid in enumerate(st.tasks.uids):
+                    task = task_index.get(uid)
+                    if task is None or task.pod.affinity is None or not task.pod.affinity.node_preferred:
+                        continue
+                    for j, spec in enumerate(node_specs):
+                        if spec is not None:
+                            score[i, j] = w_aff * node_affinity_preferred_score(
+                                task, spec.labels
+                            )
+                return score
+
+            ssn.add_device_scorer(self.name(), affinity_scorer)
+
+
+def new(arguments: Arguments) -> NodeOrderPlugin:
+    return NodeOrderPlugin(arguments)

@@ -1,0 +1,241 @@
+"""Predicates plugin: hard feasibility constraints
+(reference ``plugins/predicates/predicates.go``).
+
+Host path (exact, always registered): pod-count limit, node readiness /
+unschedulable, node selector + required node affinity, taints vs tolerations,
+host-port conflicts, optional memory/disk/PID pressure gates (via arguments),
+and required inter-pod (anti-)affinity.
+
+Device path: registers a [T, N] static-mask builder (selector + affinity +
+taints + unschedulable + pressure) and turns on the in-scan pod-count gate.
+Host ports and inter-pod affinity depend on placements made *during* the scan,
+which the static mask can't see — when any pending task uses them this plugin
+withholds its device builder, which forces the allocator's exact host fallback
+(``DeviceAllocator.supported``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from scheduler_tpu.api.job_info import TaskInfo
+from scheduler_tpu.api.node_info import NodeInfo
+from scheduler_tpu.api.types import TaskStatus
+from scheduler_tpu.api.unschedule_info import (
+    FitError,
+    NODE_POD_NUMBER_EXCEEDED,
+)
+from scheduler_tpu.apis.objects import Affinity, NodeSpec, PodSpec
+from scheduler_tpu.framework.arguments import Arguments
+from scheduler_tpu.framework.interface import Plugin
+
+logger = logging.getLogger("scheduler_tpu.plugins.predicates")
+
+MEMORY_PRESSURE_ARG = "predicate.MemoryPressureEnable"
+DISK_PRESSURE_ARG = "predicate.DiskPressureEnable"
+PID_PRESSURE_ARG = "predicate.PIDPressureEnable"
+
+_PRESSURE_CONDITIONS = {
+    MEMORY_PRESSURE_ARG: "MemoryPressure",
+    DISK_PRESSURE_ARG: "DiskPressure",
+    PID_PRESSURE_ARG: "PIDPressure",
+}
+
+
+def node_selector_matches(pod: PodSpec, node: NodeSpec) -> bool:
+    """PodMatchNodeSelector: selector map + required node affinity terms."""
+    for k, v in pod.node_selector.items():
+        if node.labels.get(k) != v:
+            return False
+    aff: Optional[Affinity] = pod.affinity
+    if aff is not None and aff.node_required:
+        # OR over term groups, AND within a group.
+        if not any(
+            all(req.matches(node.labels) for req in group) for group in aff.node_required
+        ):
+            return False
+    return True
+
+
+def tolerates_node_taints(pod: PodSpec, node: NodeSpec) -> bool:
+    for taint in node.taints:
+        if taint.effect not in ("NoSchedule", "NoExecute"):
+            continue
+        if not any(tol.tolerates(taint) for tol in pod.tolerations):
+            return False
+    return True
+
+
+def host_ports_free(pod: PodSpec, node: NodeInfo) -> bool:
+    if not pod.host_ports:
+        return True
+    used = set()
+    for task in node.tasks.values():
+        used.update(task.pod.host_ports)
+    return not (set(pod.host_ports) & used)
+
+
+class PredicatesPlugin(Plugin):
+    def __init__(self, arguments: Arguments) -> None:
+        self.arguments = arguments
+        self.pressure_checks: List[str] = [
+            cond
+            for arg, cond in _PRESSURE_CONDITIONS.items()
+            if arguments.get_bool(arg, False)
+        ]
+
+    def name(self) -> str:
+        return "predicates"
+
+    # -- pod (anti-)affinity over the live session state ----------------------
+
+    @staticmethod
+    def _pods_in_topology_domain(ssn, node: NodeInfo, topology_key: str):
+        """All tasks on nodes sharing this node's topology value."""
+        if node.node is None:
+            return
+        value = node.node.labels.get(topology_key)
+        if topology_key == "kubernetes.io/hostname" and value is None:
+            value = node.name
+        for other in ssn.nodes.values():
+            if other.node is None:
+                continue
+            other_val = other.node.labels.get(topology_key)
+            if topology_key == "kubernetes.io/hostname" and other_val is None:
+                other_val = other.name
+            if other_val is not None and other_val == value:
+                yield from other.tasks.values()
+
+    @classmethod
+    def _term_matches_some_pod(cls, ssn, term, task: TaskInfo, node: NodeInfo) -> bool:
+        namespaces = term.namespaces or [task.namespace]
+        for other in cls._pods_in_topology_domain(ssn, node, term.topology_key):
+            if other.uid == task.uid:
+                continue
+            if other.namespace not in namespaces:
+                continue
+            labels = other.pod.labels
+            if all(labels.get(k) == v for k, v in term.label_selector.items()):
+                return True
+        return False
+
+    def _pod_affinity_ok(self, ssn, task: TaskInfo, node: NodeInfo) -> bool:
+        aff = task.pod.affinity
+        if aff is None:
+            return True
+        for term in aff.pod_affinity:
+            if not self._term_matches_some_pod(ssn, term, task, node):
+                return False
+        for term in aff.pod_anti_affinity:
+            if self._term_matches_some_pod(ssn, term, task, node):
+                return False
+        return True
+
+    # -- session wiring --------------------------------------------------------
+
+    def on_session_open(self, ssn) -> None:
+        plugin = self
+
+        def predicate(task: TaskInfo, node: NodeInfo) -> None:
+            # NodePodNumber (predicates.go:162-166)
+            if len(node.tasks) >= node.pods_limit:
+                raise FitError(task.name, node.name, NODE_POD_NUMBER_EXCEEDED)
+            if node.node is None:
+                raise FitError(task.name, node.name, "node(s) not ready")
+            if node.node.unschedulable:
+                raise FitError(task.name, node.name, "node(s) were unschedulable")
+            for cond in plugin.pressure_checks:
+                if node.node.conditions.get(cond) == "True":
+                    raise FitError(task.name, node.name, f"node(s) had {cond}")
+            if not node_selector_matches(task.pod, node.node):
+                raise FitError(task.name, node.name, "node(s) didn't match node selector")
+            if not tolerates_node_taints(task.pod, node.node):
+                raise FitError(
+                    task.name, node.name, "node(s) had taints that the pod didn't tolerate"
+                )
+            if not host_ports_free(task.pod, node):
+                raise FitError(task.name, node.name, "node(s) didn't have free ports")
+            if not plugin._pod_affinity_ok(ssn, task, node):
+                raise FitError(
+                    task.name, node.name, "node(s) didn't satisfy inter-pod (anti-)affinity"
+                )
+
+        ssn.add_predicate_fn(self.name(), predicate)
+
+        # Device path: only when nothing scan-dynamic beyond pod counts is used.
+        uses_dynamic = False
+        for job in ssn.jobs.values():
+            for t in job.task_status_index.get(TaskStatus.PENDING, {}).values():
+                aff = t.pod.affinity
+                if t.pod.host_ports or (aff and (aff.pod_affinity or aff.pod_anti_affinity)):
+                    uses_dynamic = True
+                    break
+            if uses_dynamic:
+                break
+
+        if not uses_dynamic:
+            ssn.add_device_predicate(self.name(), self._device_mask_builder(ssn))
+            ssn.device_dynamic_gates.add("pod_count")
+
+    def _device_mask_builder(self, ssn):
+        pressure_checks = list(self.pressure_checks)
+
+        def build(st) -> np.ndarray:
+            import jax.numpy as jnp
+
+            from scheduler_tpu.ops.predicates import plugin_predicate_mask, taint_mask
+
+            t = st.tasks.count
+            if t == 0:
+                return np.ones((0, st.nodes.count), dtype=bool)
+            mask = np.array(  # np.array copies: jax outputs are read-only views
+                plugin_predicate_mask(
+                    jnp.asarray(st.tasks.selector),
+                    jnp.asarray(st.tasks.has_unknown_selector),
+                    jnp.asarray(st.nodes.labels),
+                    jnp.asarray(st.nodes.unschedulable),
+                )
+            )
+            mask &= np.asarray(
+                taint_mask(jnp.asarray(st.nodes.taints), jnp.asarray(st.tasks.tolerated))
+            )
+            # Required node affinity terms (host-evaluated, static per session).
+            task_by_uid: Dict[str, TaskInfo] = {}
+            for job in ssn.jobs.values():
+                task_by_uid.update(job.tasks)
+            node_specs = [ssn.nodes[name].node for name in st.nodes.names]
+            for i, uid in enumerate(st.tasks.uids):
+                task = task_by_uid.get(uid)
+                if task is None or task.pod.affinity is None or not task.pod.affinity.node_required:
+                    continue
+                for j, spec in enumerate(node_specs):
+                    if spec is not None and not node_selector_matches(
+                        _affinity_only_pod(task.pod), spec
+                    ):
+                        mask[i, j] = False
+            # Pressure gates.
+            if pressure_checks:
+                ok = np.ones(st.nodes.count, dtype=bool)
+                for j, spec in enumerate(node_specs):
+                    if spec is not None and any(
+                        spec.conditions.get(c) == "True" for c in pressure_checks
+                    ):
+                        ok[j] = False
+                mask &= ok[None, :]
+            return mask
+
+        return build
+
+
+def _affinity_only_pod(pod: PodSpec) -> PodSpec:
+    """View of the pod with only affinity (selector already on the device mask)."""
+    clone = PodSpec(name=pod.name, namespace=pod.namespace)
+    clone.affinity = pod.affinity
+    return clone
+
+
+def new(arguments: Arguments) -> PredicatesPlugin:
+    return PredicatesPlugin(arguments)

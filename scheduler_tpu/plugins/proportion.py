@@ -1,0 +1,188 @@
+"""Proportion plugin: weighted fair queue shares by iterative water-filling
+(reference ``plugins/proportion/proportion.go``).
+
+Each round splits the remaining cluster capacity across unmet queues by weight;
+a queue whose deserved share covers its request is capped at the request and
+leaves the pool.  Registers queue order (lower share first), Reclaimable (victim
+ok if its queue stays >= deserved), Overused, JobEnqueueable (queue capability
+quota), and share-tracking event handlers.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from scheduler_tpu.api.job_info import TaskInfo
+from scheduler_tpu.api.queue_info import QueueInfo
+from scheduler_tpu.api.resource import ResourceVec, res_min, share as share_fn
+from scheduler_tpu.api.types import TaskStatus, allocated_status
+from scheduler_tpu.framework.arguments import Arguments
+from scheduler_tpu.framework.interface import EventHandler, Plugin
+
+logger = logging.getLogger("scheduler_tpu.plugins.proportion")
+
+
+class _QueueAttr:
+    __slots__ = ("queue_id", "name", "weight", "share", "deserved", "allocated", "request")
+
+    def __init__(self, queue: QueueInfo, vocab) -> None:
+        self.queue_id = queue.uid
+        self.name = queue.name
+        self.weight = queue.weight
+        self.share = 0.0
+        self.deserved = ResourceVec.empty(vocab)
+        self.allocated = ResourceVec.empty(vocab)
+        self.request = ResourceVec.empty(vocab)
+
+
+class ProportionPlugin(Plugin):
+    def __init__(self, arguments: Arguments) -> None:
+        self.arguments = arguments
+        self.total_resource: Optional[ResourceVec] = None
+        self.queue_attrs: Dict[str, _QueueAttr] = {}
+
+    def name(self) -> str:
+        return "proportion"
+
+    def _update_share(self, attr: _QueueAttr) -> None:
+        res = 0.0
+        for rn in attr.deserved.resource_names():
+            s = share_fn(attr.allocated.get(rn), attr.deserved.get(rn))
+            if s > res:
+                res = s
+        attr.share = res
+
+    def on_session_open(self, ssn) -> None:
+        if not ssn.jobs:
+            return
+        vocab = next(iter(ssn.jobs.values())).vocab
+        self.total_resource = ResourceVec.empty(vocab)
+        for node in ssn.nodes.values():
+            self.total_resource.add(node.allocatable)
+
+        # Build per-queue aggregates from jobs' tasks.
+        for job in ssn.jobs.values():
+            if job.queue not in self.queue_attrs:
+                queue = ssn.queues.get(job.queue)
+                if queue is None:
+                    continue
+                self.queue_attrs[job.queue] = _QueueAttr(queue, vocab)
+            attr = self.queue_attrs[job.queue]
+            for status, tasks in job.task_status_index.items():
+                if allocated_status(status):
+                    for t in tasks.values():
+                        attr.allocated.add(t.resreq)
+                        attr.request.add(t.resreq)
+                elif status == TaskStatus.PENDING:
+                    for t in tasks.values():
+                        attr.request.add(t.resreq)
+
+        # Water-filling (proportion.go:101-154).
+        remaining = self.total_resource.clone()
+        meet: set = set()
+        while True:
+            total_weight = sum(
+                attr.weight for attr in self.queue_attrs.values() if attr.queue_id not in meet
+            )
+            if total_weight == 0:
+                break
+
+            increased = ResourceVec.empty(vocab)
+            decreased = ResourceVec.empty(vocab)
+            for attr in self.queue_attrs.values():
+                if attr.queue_id in meet:
+                    continue
+                old_deserved = attr.deserved.clone()
+                attr.deserved.add(remaining.clone().multi(attr.weight / total_weight))
+                if attr.request.less(attr.deserved):
+                    attr.deserved = res_min(attr.deserved, attr.request)
+                    meet.add(attr.queue_id)
+                self._update_share(attr)
+                inc, dec = attr.deserved.diff(old_deserved)
+                increased.add(inc)
+                decreased.add(dec)
+
+            remaining.sub(increased).add(decreased)
+            if remaining.is_empty():
+                break
+
+        def queue_order_fn(l: QueueInfo, r: QueueInfo) -> int:
+            ls = self.queue_attrs[l.uid].share
+            rs = self.queue_attrs[r.uid].share
+            if ls == rs:
+                return 0
+            return -1 if ls < rs else 1
+
+        ssn.add_queue_order_fn(self.name(), queue_order_fn)
+
+        def reclaimable_fn(reclaimer: TaskInfo, reclaimees):
+            victims = None
+            allocations: Dict[str, ResourceVec] = {}
+            for reclaimee in reclaimees:
+                job = ssn.jobs[reclaimee.job]
+                attr = self.queue_attrs[job.queue]
+                if job.queue not in allocations:
+                    allocations[job.queue] = attr.allocated.clone()
+                allocated = allocations[job.queue]
+                if allocated.less(reclaimee.resreq):
+                    logger.debug(
+                        "not enough resource to reclaim %s from queue %s",
+                        reclaimee.uid, job.queue,
+                    )
+                    continue
+                allocated.sub(reclaimee.resreq)
+                if attr.deserved.less_equal(allocated):
+                    victims = victims or []
+                    victims.append(reclaimee)
+            return victims
+
+        ssn.add_reclaimable_fn(self.name(), reclaimable_fn)
+
+        def overused_fn(queue: QueueInfo) -> bool:
+            attr = self.queue_attrs[queue.uid]
+            overused = attr.deserved.less_equal(attr.allocated)
+            if overused:
+                logger.debug("queue %s overused: deserved <%s> allocated <%s>",
+                             queue.name, attr.deserved, attr.allocated)
+            return overused
+
+        ssn.add_overused_fn(self.name(), overused_fn)
+
+        def job_enqueueable_fn(job) -> bool:
+            queue = ssn.queues.get(job.queue)
+            attr = self.queue_attrs.get(job.queue)
+            if queue is None or attr is None:
+                return True
+            # No capability set -> always enqueue (proportion.go:216-227).
+            if not queue.queue.capability:
+                return True
+            if job.pod_group is None or job.pod_group.min_resources is None:
+                return True
+            pg_resource = ResourceVec.from_dict(job.pod_group.min_resources, vocab)
+            capability = ResourceVec.from_dict(queue.queue.capability, vocab)
+            return pg_resource.clone().add(attr.allocated).less_equal(capability)
+
+        ssn.add_job_enqueueable_fn(self.name(), job_enqueueable_fn)
+
+        def on_allocate(event) -> None:
+            job = ssn.jobs[event.task.job]
+            attr = self.queue_attrs[job.queue]
+            attr.allocated.add(event.task.resreq)
+            self._update_share(attr)
+
+        def on_deallocate(event) -> None:
+            job = ssn.jobs[event.task.job]
+            attr = self.queue_attrs[job.queue]
+            attr.allocated.sub(event.task.resreq)
+            self._update_share(attr)
+
+        ssn.add_event_handler(EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate))
+
+    def on_session_close(self, ssn) -> None:
+        self.total_resource = None
+        self.queue_attrs = {}
+
+
+def new(arguments: Arguments) -> ProportionPlugin:
+    return ProportionPlugin(arguments)

@@ -1,0 +1,319 @@
+"""Plugin behavior tests: predicates, nodeorder, binpack, drf, proportion, conformance."""
+
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.api import TaskStatus
+from scheduler_tpu.apis.objects import Affinity, NodeSelectorRequirement, PodAffinityTerm, Taint, Toleration
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import close_session, get_action, open_session
+from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
+
+FULL_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def fresh_cache(**kw):
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False, **kw)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    return cache
+
+
+def run_allocate(cache, conf_str=FULL_CONF):
+    conf = parse_scheduler_conf(conf_str)
+    ssn = open_session(cache, conf.tiers)
+    get_action("allocate").execute(ssn)
+    close_session(ssn)
+    return ssn
+
+
+@pytest.mark.parametrize("engine", ["device", "host"])
+class TestPredicatesPlugin:
+    @pytest.fixture(autouse=True)
+    def _engine(self, engine, monkeypatch):
+        monkeypatch.setenv("SCHEDULER_TPU_DEVICE", "1" if engine == "device" else "0")
+
+    def test_node_selector_enforced(self):
+        cache = fresh_cache()
+        cache.add_node(build_node("n0", {"cpu": 4000, "memory": 1024**3}, labels={"zone": "a"}))
+        cache.add_node(build_node("n1", {"cpu": 4000, "memory": 1024**3}, labels={"zone": "b"}))
+        cache.add_pod_group(build_pod_group("pg1", min_member=1))
+        cache.add_pod(build_pod(name="picky", req={"cpu": 100, "memory": 1024**2},
+                                groupname="pg1", selector={"zone": "b"}))
+        run_allocate(cache)
+        assert cache.binder.binds == {"default/picky": "n1"}
+
+    def test_impossible_selector_unschedulable(self):
+        cache = fresh_cache()
+        cache.add_node(build_node("n0", {"cpu": 4000, "memory": 1024**3}))
+        cache.add_pod_group(build_pod_group("pg1", min_member=1))
+        cache.add_pod(build_pod(name="p", req={"cpu": 100, "memory": 1024**2},
+                                groupname="pg1", selector={"zone": "mars"}))
+        run_allocate(cache)
+        assert cache.binder.binds == {}
+
+    def test_taints_respected_unless_tolerated(self):
+        cache = fresh_cache()
+        tainted = build_node("n0", {"cpu": 4000, "memory": 1024**3})
+        tainted.taints.append(Taint(key="dedicated", value="ml", effect="NoSchedule"))
+        cache.add_node(tainted)
+        cache.add_node(build_node("n1", {"cpu": 4000, "memory": 1024**3}))
+
+        cache.add_pod_group(build_pod_group("pg1", min_member=2))
+        plain = build_pod(name="plain", req={"cpu": 100, "memory": 1024**2}, groupname="pg1")
+        tolerant = build_pod(name="tolerant", req={"cpu": 100, "memory": 1024**2}, groupname="pg1")
+        tolerant.tolerations.append(Toleration(key="dedicated", operator="Equal", value="ml"))
+        # make the tolerant pod unable to fit n1 so it must use the tainted node
+        tolerant.node_selector = {}
+        cache.add_pod(plain)
+        cache.add_pod(tolerant)
+        run_allocate(cache)
+        assert cache.binder.binds["default/plain"] == "n1"
+        assert len(cache.binder.binds) == 2
+
+    def test_unschedulable_node_skipped(self):
+        cache = fresh_cache()
+        cordoned = build_node("n0", {"cpu": 4000, "memory": 1024**3})
+        cordoned.unschedulable = True
+        cache.add_node(cordoned)
+        cache.add_node(build_node("n1", {"cpu": 4000, "memory": 1024**3}))
+        cache.add_pod_group(build_pod_group("pg1", min_member=1))
+        cache.add_pod(build_pod(name="p", req={"cpu": 100, "memory": 1024**2}, groupname="pg1"))
+        run_allocate(cache)
+        assert cache.binder.binds == {"default/p": "n1"}
+
+    def test_pod_count_limit(self):
+        cache = fresh_cache()
+        cache.add_node(build_node("n0", {"cpu": 8000, "memory": 1024**3}, pods=1))
+        cache.add_node(build_node("n1", {"cpu": 8000, "memory": 1024**3}, pods=110))
+        cache.add_pod_group(build_pod_group("pg1", min_member=2))
+        for i in range(2):
+            cache.add_pod(build_pod(name=f"p{i}", req={"cpu": 100, "memory": 1024**2}, groupname="pg1"))
+        run_allocate(cache)
+        # n0 takes at most one pod
+        nodes = sorted(cache.binder.binds.values())
+        assert len(cache.binder.binds) == 2
+        assert nodes.count("n0") <= 1
+
+    def test_memory_pressure_gate(self):
+        conf = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+  - name: predicates
+    arguments:
+      predicate.MemoryPressureEnable: "true"
+"""
+        cache = fresh_cache()
+        stressed = build_node("n0", {"cpu": 4000, "memory": 1024**3})
+        stressed.conditions["MemoryPressure"] = "True"
+        cache.add_node(stressed)
+        cache.add_node(build_node("n1", {"cpu": 4000, "memory": 1024**3}))
+        cache.add_pod_group(build_pod_group("pg1", min_member=1))
+        cache.add_pod(build_pod(name="p", req={"cpu": 100, "memory": 1024**2}, groupname="pg1"))
+        run_allocate(cache, conf)
+        assert cache.binder.binds == {"default/p": "n1"}
+
+
+class TestHostOnlyPredicates:
+    """Host ports and inter-pod affinity force the exact host fallback."""
+
+    def test_host_port_conflict(self):
+        cache = fresh_cache()
+        cache.add_node(build_node("n0", {"cpu": 8000, "memory": 1024**3}))
+        cache.add_node(build_node("n1", {"cpu": 8000, "memory": 1024**3}))
+        cache.add_pod_group(build_pod_group("pg1", min_member=2))
+        for i in range(2):
+            pod = build_pod(name=f"web-{i}", req={"cpu": 100, "memory": 1024**2}, groupname="pg1")
+            pod.host_ports = [8080]
+            cache.add_pod(pod)
+        run_allocate(cache)
+        assert len(cache.binder.binds) == 2
+        assert set(cache.binder.binds.values()) == {"n0", "n1"}  # forced apart
+
+    def test_pod_anti_affinity(self):
+        cache = fresh_cache()
+        cache.add_node(build_node("n0", {"cpu": 8000, "memory": 1024**3}))
+        cache.add_node(build_node("n1", {"cpu": 8000, "memory": 1024**3}))
+        cache.add_pod_group(build_pod_group("pg1", min_member=2))
+        for i in range(2):
+            pod = build_pod(name=f"w{i}", req={"cpu": 100, "memory": 1024**2}, groupname="pg1",
+                            labels={"app": "db"})
+            pod.affinity = Affinity(pod_anti_affinity=[PodAffinityTerm(label_selector={"app": "db"})])
+            cache.add_pod(pod)
+        run_allocate(cache)
+        assert set(cache.binder.binds.values()) == {"n0", "n1"}
+
+    def test_pod_affinity_colocates(self):
+        cache = fresh_cache()
+        cache.add_node(build_node("n0", {"cpu": 8000, "memory": 1024**3}))
+        cache.add_node(build_node("n1", {"cpu": 8000, "memory": 1024**3}))
+        # an existing anchor pod on n1
+        cache.add_pod_group(build_pod_group("anchor-pg", min_member=1))
+        anchor = build_pod(name="anchor", req={"cpu": 100, "memory": 1024**2},
+                           groupname="anchor-pg", nodename="n1", phase="Running",
+                           labels={"app": "cachesvc"})
+        cache.add_pod(anchor)
+        cache.add_pod_group(build_pod_group("pg1", min_member=1))
+        follower = build_pod(name="follower", req={"cpu": 100, "memory": 1024**2}, groupname="pg1")
+        follower.affinity = Affinity(pod_affinity=[PodAffinityTerm(label_selector={"app": "cachesvc"})])
+        cache.add_pod(follower)
+        run_allocate(cache)
+        assert cache.binder.binds == {"default/follower": "n1"}
+
+
+@pytest.mark.parametrize("engine", ["device", "host"])
+class TestScoringPlugins:
+    @pytest.fixture(autouse=True)
+    def _engine(self, engine, monkeypatch):
+        monkeypatch.setenv("SCHEDULER_TPU_DEVICE", "1" if engine == "device" else "0")
+        import scheduler_tpu.utils.scheduler_helper as helper
+        monkeypatch.setattr(helper.random, "choice", lambda seq: seq[0])
+
+    def test_least_requested_spreads(self):
+        # nodeorder's least-requested favors the emptier node (e2e nodeorder.go:138).
+        cache = fresh_cache()
+        cache.add_node(build_node("busy", {"cpu": 8000, "memory": 1024**3}))
+        cache.add_node(build_node("idle", {"cpu": 8000, "memory": 1024**3}))
+        cache.add_pod_group(build_pod_group("warm", min_member=1))
+        cache.add_pod(build_pod(name="existing", req={"cpu": 4000, "memory": 1024**2},
+                                groupname="warm", nodename="busy", phase="Running"))
+        cache.add_pod_group(build_pod_group("pg1", min_member=1))
+        cache.add_pod(build_pod(name="new", req={"cpu": 100, "memory": 1024**2}, groupname="pg1"))
+        run_allocate(cache)
+        assert cache.binder.binds == {"default/new": "idle"}
+
+    def test_binpack_packs(self):
+        conf = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+  - name: binpack
+"""
+        cache = fresh_cache()
+        cache.add_node(build_node("fuller", {"cpu": 8000, "memory": 1024**3}))
+        cache.add_node(build_node("empty", {"cpu": 8000, "memory": 1024**3}))
+        cache.add_pod_group(build_pod_group("warm", min_member=1))
+        cache.add_pod(build_pod(name="existing", req={"cpu": 4000, "memory": 1024**2},
+                                groupname="warm", nodename="fuller", phase="Running"))
+        cache.add_pod_group(build_pod_group("pg1", min_member=1))
+        cache.add_pod(build_pod(name="new", req={"cpu": 100, "memory": 1024**2}, groupname="pg1"))
+        run_allocate(cache, conf)
+        assert cache.binder.binds == {"default/new": "fuller"}
+
+    def test_preferred_node_affinity(self):
+        cache = fresh_cache()
+        cache.add_node(build_node("plain", {"cpu": 8000, "memory": 1024**3}))
+        cache.add_node(build_node("ssd", {"cpu": 8000, "memory": 1024**3},
+                                  labels={"disk": "ssd"}))
+        cache.add_pod_group(build_pod_group("pg1", min_member=1))
+        pod = build_pod(name="p", req={"cpu": 100, "memory": 1024**2}, groupname="pg1")
+        pod.affinity = Affinity(node_preferred=[
+            (100, [NodeSelectorRequirement(key="disk", operator="In", values=["ssd"])])
+        ])
+        cache.add_pod(pod)
+        run_allocate(cache)
+        assert cache.binder.binds == {"default/p": "ssd"}
+
+
+class TestFairnessPlugins:
+    def test_proportion_deserved_weighted_split(self):
+        from scheduler_tpu.framework import Session
+        from scheduler_tpu.conf import Tier, PluginOption
+        cache = fresh_cache()
+        cache.add_queue(build_queue("gold", weight=3))
+        cache.add_queue(build_queue("silver", weight=1))
+        cache.add_node(build_node("n0", {"cpu": 4000, "memory": 4 * 1024**3}))
+        for q in ("gold", "silver"):
+            cache.add_pod_group(build_pod_group(f"{q}-pg", min_member=1, queue=q))
+            for i in range(8):
+                cache.add_pod(build_pod(name=f"{q}-{i}", req={"cpu": 1000, "memory": 1024**2},
+                                        groupname=f"{q}-pg"))
+        conf = parse_scheduler_conf(
+            'actions: "allocate"\ntiers:\n- plugins:\n  - name: proportion\n'
+        )
+        ssn = open_session(cache, conf.tiers)
+        pp = ssn.plugins["proportion"]
+        assert pp.queue_attrs["gold"].deserved.milli_cpu == pytest.approx(3000)
+        assert pp.queue_attrs["silver"].deserved.milli_cpu == pytest.approx(1000)
+        close_session(ssn)
+
+    def test_proportion_overused_queue_skipped(self):
+        cache = fresh_cache()
+        cache.add_queue(build_queue("greedy", weight=1))
+        cache.add_queue(build_queue("starved", weight=1))
+        cache.add_node(build_node("n0", {"cpu": 4000, "memory": 4 * 1024**3}))
+        # greedy already uses 3/4 of the cluster: deserved=2000 < allocated=3000
+        cache.add_pod_group(build_pod_group("g-pg", min_member=1, queue="greedy"))
+        for i in range(3):
+            cache.add_pod(build_pod(name=f"g{i}", req={"cpu": 1000, "memory": 1024**2},
+                                    groupname="g-pg", nodename="n0", phase="Running"))
+        cache.add_pod(build_pod(name="g-pending", req={"cpu": 1000, "memory": 1024**2},
+                                groupname="g-pg"))
+        cache.add_pod_group(build_pod_group("s-pg", min_member=1, queue="starved"))
+        cache.add_pod(build_pod(name="s-pending", req={"cpu": 1000, "memory": 1024**2},
+                                groupname="s-pg"))
+        conf = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+  - name: proportion
+"""
+        run_allocate(cache, conf)
+        # only the starved queue's pod lands; greedy's pending pod is skipped
+        assert list(cache.binder.binds) == ["default/s-pending"]
+
+    def test_drf_orders_by_dominant_share(self):
+        cache = fresh_cache()
+        cache.add_node(build_node("n0", {"cpu": 10000, "memory": 10 * 1024**3}))
+        # hungry job already holds 40% cpu; light job holds nothing
+        cache.add_pod_group(build_pod_group("hungry", min_member=1))
+        cache.add_pod(build_pod(name="h-run", req={"cpu": 4000, "memory": 1024**2},
+                                groupname="hungry", nodename="n0", phase="Running"))
+        cache.add_pod(build_pod(name="h-pend", req={"cpu": 1000, "memory": 1024**2},
+                                groupname="hungry"))
+        cache.add_pod_group(build_pod_group("light", min_member=1))
+        cache.add_pod(build_pod(name="l-pend", req={"cpu": 1000, "memory": 1024**2},
+                                groupname="light"))
+        conf = parse_scheduler_conf('actions: "allocate"\ntiers:\n- plugins:\n  - name: drf\n')
+        ssn = open_session(cache, conf.tiers)
+        hungry = ssn.jobs["default/hungry"]
+        light = ssn.jobs["default/light"]
+        # light job has lower share -> orders first
+        assert ssn.job_order_fn(light, hungry) is True
+        assert ssn.job_order_fn(hungry, light) is False
+        close_session(ssn)
+
+    def test_conformance_protects_critical(self):
+        from scheduler_tpu.conf import PluginOption, Tier
+        from scheduler_tpu.framework import Session
+        cache = fresh_cache()
+        cache.add_node(build_node("n0", {"cpu": 1000, "memory": 1024**3}))
+        cache.add_pod_group(build_pod_group("pg-sys", namespace="kube-system", min_member=1))
+        critical = build_pod(name="kube-proxy", namespace="kube-system",
+                             req={"cpu": 100, "memory": 1024**2}, groupname="pg-sys",
+                             nodename="n0", phase="Running")
+        cache.add_pod(critical)
+        conf = parse_scheduler_conf('actions: "allocate"\ntiers:\n- plugins:\n  - name: conformance\n')
+        ssn = open_session(cache, conf.tiers)
+        job_id = "kube-system/pg-sys"
+        victim = next(iter(ssn.jobs[job_id].tasks.values()))
+        assert ssn.preemptable(None, [victim]) == []
+        close_session(ssn)

@@ -1,0 +1,57 @@
+"""Backfill: place zero-request (BestEffort) tasks wherever predicates pass
+(reference ``actions/backfill/backfill.go``)."""
+
+from __future__ import annotations
+
+import logging
+
+from scheduler_tpu.api.types import TaskStatus
+from scheduler_tpu.api.unschedule_info import FitErrors
+from scheduler_tpu.apis.objects import PodGroupPhase
+from scheduler_tpu.framework.interface import Action
+from scheduler_tpu.utils.scheduler_helper import get_node_list
+
+logger = logging.getLogger("scheduler_tpu.actions.backfill")
+
+
+class BackfillAction(Action):
+    def name(self) -> str:
+        return "backfill"
+
+    def execute(self, ssn) -> None:
+        nodes = get_node_list(ssn.nodes)
+        for job in list(ssn.jobs.values()):
+            if job.pod_group is not None and job.pod_group.status.phase == PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.passed:
+                continue
+
+            for task in list(job.task_status_index.get(TaskStatus.PENDING, {}).values()):
+                if not task.init_resreq.is_empty():
+                    continue  # only BestEffort tasks backfill
+                allocated = False
+                fe = FitErrors()
+                for node in nodes:
+                    try:
+                        ssn.predicate_fn(task, node)
+                    except Exception as err:
+                        logger.debug("backfill predicate failed for %s on %s: %s",
+                                     task.uid, node.name, err)
+                        fe.set_node_error(node.name, err)
+                        continue
+                    try:
+                        ssn.allocate(task, node.name)
+                    except Exception as err:
+                        logger.error("backfill bind of %s on %s failed: %s",
+                                     task.uid, node.name, err)
+                        fe.set_node_error(node.name, err)
+                        continue
+                    allocated = True
+                    break
+                if not allocated:
+                    job.nodes_fit_errors[task.uid] = fe
+
+
+def new() -> BackfillAction:
+    return BackfillAction()

@@ -1,9 +1,13 @@
 """Registers the builtin actions (reference ``actions/factory.go:29-35``)."""
 
-from scheduler_tpu.actions import allocate
+from scheduler_tpu.actions import allocate, backfill, enqueue, preempt, reclaim
 from scheduler_tpu.framework.registry import register_action
 
+register_action(enqueue.new())
 register_action(allocate.new())
+register_action(backfill.new())
+register_action(preempt.new())
+register_action(reclaim.new())
 
 
 def register_all() -> None:

@@ -1,0 +1,193 @@
+"""Preempt: intra-queue eviction for starved high-priority jobs
+(reference ``actions/preempt/preempt.go``).
+
+Phase 1: within each queue, jobs with pending tasks preempt Running tasks of
+*other* jobs in the same queue, under a Statement — evictions commit only once
+the preemptor job is gang-pipelined, otherwise everything rolls back.  Phase 2:
+intra-job task preemption (higher-priority pending tasks of a job evict its own
+lower-priority running tasks), committed per task.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional
+
+from scheduler_tpu.api.job_info import JobInfo, TaskInfo
+from scheduler_tpu.api.resource import ResourceVec
+from scheduler_tpu.api.types import TaskStatus
+from scheduler_tpu.apis.objects import PodGroupPhase
+from scheduler_tpu.framework.interface import Action
+from scheduler_tpu.framework.statement import Statement
+from scheduler_tpu.utils import metrics
+from scheduler_tpu.utils.priority_queue import PriorityQueue
+from scheduler_tpu.utils.scheduler_helper import (
+    get_node_list,
+    predicate_nodes,
+    prioritize_nodes,
+    sort_nodes,
+)
+
+logger = logging.getLogger("scheduler_tpu.actions.preempt")
+
+
+class PreemptAction(Action):
+    def name(self) -> str:
+        return "preempt"
+
+    def execute(self, ssn) -> None:
+        preemptors_map: Dict[str, PriorityQueue] = {}
+        preemptor_tasks: Dict[str, PriorityQueue] = {}
+        under_request: List[JobInfo] = []
+        queues = {}
+
+        for job in ssn.jobs.values():
+            if job.pod_group is not None and job.pod_group.status.phase == PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.passed:
+                continue
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                continue
+            queues.setdefault(queue.uid, queue)
+
+            if job.task_status_index.get(TaskStatus.PENDING):
+                preemptors_map.setdefault(job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
+                under_request.append(job)
+                tasks = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index[TaskStatus.PENDING].values():
+                    tasks.push(task)
+                preemptor_tasks[job.uid] = tasks
+
+        # Phase 1: preemption between jobs within a queue.
+        for queue in queues.values():
+            while True:
+                preemptors = preemptors_map.get(queue.uid)
+                if preemptors is None or preemptors.empty():
+                    break
+                preemptor_job = preemptors.pop()
+
+                stmt = ssn.statement()
+                assigned = False
+                while True:
+                    if preemptor_tasks[preemptor_job.uid].empty():
+                        logger.debug("no preemptor task in job %s", preemptor_job.uid)
+                        break
+                    preemptor = preemptor_tasks[preemptor_job.uid].pop()
+
+                    def job_filter(task: TaskInfo) -> bool:
+                        if task.status != TaskStatus.RUNNING:
+                            return False
+                        job = ssn.jobs.get(task.job)
+                        if job is None:
+                            return False
+                        # Preempt other jobs within the same queue.
+                        return job.queue == preemptor_job.queue and preemptor.job != task.job
+
+                    if self._preempt(ssn, stmt, preemptor, job_filter):
+                        assigned = True
+
+                    if ssn.job_pipelined(preemptor_job):
+                        stmt.commit()
+                        break
+
+                if not ssn.job_pipelined(preemptor_job):
+                    stmt.discard()
+                    continue
+
+                if assigned:
+                    preemptors.push(preemptor_job)
+
+            # Phase 2: preemption between tasks within one job.
+            for job in under_request:
+                while True:
+                    tasks = preemptor_tasks.get(job.uid)
+                    if tasks is None or tasks.empty():
+                        break
+                    preemptor = tasks.pop()
+
+                    stmt = ssn.statement()
+                    assigned = self._preempt(
+                        ssn,
+                        stmt,
+                        preemptor,
+                        lambda task: task.status == TaskStatus.RUNNING
+                        and preemptor.job == task.job,
+                    )
+                    stmt.commit()
+                    if not assigned:
+                        break
+
+    def _preempt(
+        self,
+        ssn,
+        stmt: Statement,
+        preemptor: TaskInfo,
+        task_filter: Optional[Callable[[TaskInfo], bool]],
+    ) -> bool:
+        """One preemptor's hunt for a node (reference preempt.go:180-260)."""
+        assigned = False
+        all_nodes = get_node_list(ssn.nodes)
+
+        passing, _ = predicate_nodes(preemptor, all_nodes, ssn.predicate_fn)
+        node_scores = prioritize_nodes(
+            preemptor,
+            passing,
+            ssn.batch_node_order_fn,
+            ssn.node_order_map_fn,
+            ssn.node_order_reduce_fn,
+        )
+
+        for node in sort_nodes(node_scores):
+            logger.debug("considering task %s on node %s", preemptor.uid, node.name)
+
+            preemptees = [
+                task.clone()
+                for task in node.tasks.values()
+                if task_filter is None or task_filter(task)
+            ]
+            victims = ssn.preemptable(preemptor, preemptees)
+            metrics.update_preemption_victims_count(len(victims))
+
+            if not self._validate_victims(victims, preemptor.init_resreq):
+                logger.debug("no validated victims on node %s", node.name)
+                continue
+
+            # Evict cheapest victims first (reverse task order, preempt.go:219-224).
+            victims_queue = PriorityQueue(lambda l, r: not ssn.task_order_fn(l, r))
+            for victim in victims:
+                victims_queue.push(victim)
+
+            preempted = ResourceVec.empty(preemptor.resreq.vocab)
+            resreq = preemptor.init_resreq.clone()
+            while not victims_queue.empty():
+                preemptee = victims_queue.pop()
+                logger.info("preempting task %s for %s", preemptee.uid, preemptor.uid)
+                stmt.evict(preemptee, "preempt")
+                preempted.add(preemptee.resreq)
+                if resreq.less_equal(preempted):
+                    break
+
+            metrics.register_preemption_attempts()
+
+            if preemptor.init_resreq.less_equal(preempted):
+                stmt.pipeline(preemptor, node.name)
+                assigned = True
+                break
+
+        return assigned
+
+    @staticmethod
+    def _validate_victims(victims: List[TaskInfo], resreq: ResourceVec) -> bool:
+        """Victims exist and could cover the request (preempt.go:262-277)."""
+        if not victims:
+            return False
+        total = ResourceVec.empty(resreq.vocab)
+        for v in victims:
+            total.add(v.resreq)
+        return not total.less(resreq)
+
+
+def new() -> PreemptAction:
+    return PreemptAction()

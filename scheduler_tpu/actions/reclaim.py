@@ -1,0 +1,130 @@
+"""Reclaim: cross-queue eviction to enforce weighted queue shares
+(reference ``actions/reclaim/reclaim.go``).
+
+For a starved queue's pending task, Running tasks of *other* queues are
+candidate reclaimees per node; the Reclaimable dispatch (proportion: victim's
+queue must stay >= its deserved share; gang: victim's gang must survive) picks
+victims, which are evicted directly — no Statement — then the task pipelines
+onto the freed resources.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List
+
+from scheduler_tpu.api.job_info import JobInfo
+from scheduler_tpu.api.resource import ResourceVec
+from scheduler_tpu.api.types import TaskStatus
+from scheduler_tpu.apis.objects import PodGroupPhase
+from scheduler_tpu.framework.interface import Action
+from scheduler_tpu.utils.priority_queue import PriorityQueue
+from scheduler_tpu.utils.scheduler_helper import get_node_list
+
+logger = logging.getLogger("scheduler_tpu.actions.reclaim")
+
+
+class ReclaimAction(Action):
+    def name(self) -> str:
+        return "reclaim"
+
+    def execute(self, ssn) -> None:
+        queues = PriorityQueue(ssn.queue_order_fn)
+        queue_seen: set = set()
+        preemptors_map: Dict[str, PriorityQueue] = {}
+        preemptor_tasks: Dict[str, PriorityQueue] = {}
+
+        for job in ssn.jobs.values():
+            if job.pod_group is not None and job.pod_group.status.phase == PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.passed:
+                continue
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                logger.error("failed to find queue %s for job %s", job.queue, job.uid)
+                continue
+            if queue.uid not in queue_seen:
+                queue_seen.add(queue.uid)
+                queues.push(queue)
+
+            if job.task_status_index.get(TaskStatus.PENDING):
+                preemptors_map.setdefault(job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
+                tasks = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index[TaskStatus.PENDING].values():
+                    tasks.push(task)
+                preemptor_tasks[job.uid] = tasks
+
+        while not queues.empty():
+            queue = queues.pop()
+            if ssn.overused(queue):
+                logger.debug("queue %s is overused, skipping reclaim", queue.name)
+                continue
+
+            jobs = preemptors_map.get(queue.uid)
+            if jobs is None or jobs.empty():
+                continue
+            job = jobs.pop()
+
+            tasks = preemptor_tasks.get(job.uid)
+            if tasks is None or tasks.empty():
+                continue
+            task = tasks.pop()
+
+            assigned = False
+            for node in get_node_list(ssn.nodes):
+                try:
+                    ssn.predicate_fn(task, node)
+                except Exception:
+                    continue
+
+                resreq = task.init_resreq.clone()
+                reclaimed = ResourceVec.empty(resreq.vocab)
+
+                reclaimees = []
+                for candidate in node.tasks.values():
+                    if candidate.status != TaskStatus.RUNNING:
+                        continue
+                    owner = ssn.jobs.get(candidate.job)
+                    if owner is None:
+                        continue
+                    if owner.queue != job.queue:
+                        reclaimees.append(candidate.clone())
+
+                victims = ssn.reclaimable(task, reclaimees)
+                if not victims:
+                    logger.debug("no reclaim victims on node %s", node.name)
+                    continue
+
+                total = ResourceVec.empty(resreq.vocab)
+                for v in victims:
+                    total.add(v.resreq)
+                if total.less(resreq):
+                    logger.debug("not enough reclaimable resource on node %s", node.name)
+                    continue
+
+                for reclaimee in victims:
+                    logger.info("reclaiming task %s for %s", reclaimee.uid, task.uid)
+                    try:
+                        ssn.evict(reclaimee, "reclaim")
+                    except Exception:
+                        logger.exception("failed to reclaim %s", reclaimee.uid)
+                        continue
+                    reclaimed.add(reclaimee.resreq)
+                    if resreq.less_equal(reclaimed):
+                        break
+
+                if task.init_resreq.less_equal(reclaimed):
+                    try:
+                        ssn.pipeline(task, node.name)
+                    except Exception:
+                        logger.exception("failed to pipeline %s on %s", task.uid, node.name)
+                    assigned = True
+                    break
+
+            if assigned:
+                queues.push(queue)
+
+
+def new() -> ReclaimAction:
+    return ReclaimAction()

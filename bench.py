@@ -1,0 +1,95 @@
+"""Driver benchmark: one OpenSession allocate cycle on synthetic hollow nodes.
+
+Scenario = BASELINE.json config #3 (binpack + drf, mixed CPU/mem requests,
+gang PodGroups) at a scale set by env:
+
+  SCHEDULER_TPU_BENCH_NODES  (default 10000)
+  SCHEDULER_TPU_BENCH_PODS   (default 100000)
+
+Prints ONE JSON line: pods scheduled per second of session-cycle wall time,
+with vs_baseline = value / 100_000 (the north-star target of one 100k-pod
+cycle per second, BASELINE.md).
+
+A warmup cycle at the same node-bucket / task-bucket shapes runs first so jit
+compilation (cached across calls) is excluded from the measured cycle, matching
+how the steady-state scheduler loop runs (compile once, re-run every period).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def one_cycle(n_nodes: int, n_pods: int, tasks_per_job: int) -> tuple[int, float]:
+    import scheduler_tpu.actions  # noqa: F401  registry side effects
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.framework import close_session, get_action, open_session
+    from scheduler_tpu.harness import make_synthetic_cluster
+
+    conf = parse_scheduler_conf(
+        """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+"""
+    )
+    cluster = make_synthetic_cluster(n_nodes, n_pods, tasks_per_job=tasks_per_job)
+
+    start = time.perf_counter()
+    ssn = open_session(cluster.cache, conf.tiers)
+    get_action("allocate").execute(ssn)
+    close_session(ssn)
+    elapsed = time.perf_counter() - start
+
+    binds = len(cluster.cache.binder.binds)
+    return binds, elapsed
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv
+    n_nodes = int(os.environ.get("SCHEDULER_TPU_BENCH_NODES", 100 if smoke else 10_000))
+    n_pods = int(os.environ.get("SCHEDULER_TPU_BENCH_PODS", 500 if smoke else 100_000))
+    tasks_per_job = int(os.environ.get("SCHEDULER_TPU_BENCH_GANG", 100))
+
+    # Warmup at the same bucket shapes: same node count (fixes the node bucket)
+    # and one full-size gang (fixes the task bucket), tiny pod count.
+    one_cycle(n_nodes, min(tasks_per_job, n_pods), tasks_per_job)
+
+    binds, elapsed = one_cycle(n_nodes, n_pods, tasks_per_job)
+    if binds == 0:
+        print(json.dumps({"metric": "pods_per_sec", "value": 0.0, "unit": "pods/s",
+                          "vs_baseline": 0.0, "error": "no binds"}))
+        sys.exit(1)
+
+    pods_per_sec = binds / elapsed
+    print(json.dumps({
+        "metric": "pods_per_sec",
+        "value": round(pods_per_sec, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(pods_per_sec / 100_000.0, 4),
+        "detail": {
+            "nodes": n_nodes,
+            "pods": n_pods,
+            "binds": binds,
+            "cycle_seconds": round(elapsed, 3),
+            "backend": _backend(),
+        },
+    }))
+
+
+def _backend() -> str:
+    import jax
+
+    return str(jax.devices()[0])
+
+
+if __name__ == "__main__":
+    main()

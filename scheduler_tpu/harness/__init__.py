@@ -1,0 +1,3 @@
+from scheduler_tpu.harness.synthetic import SyntheticCluster, make_synthetic_cluster
+
+__all__ = ["SyntheticCluster", "make_synthetic_cluster"]

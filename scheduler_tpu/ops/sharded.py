@@ -1,0 +1,194 @@
+"""Multi-chip placement: the sequential scan with the node axis sharded over a mesh.
+
+The node axis is this framework's big data axis (SURVEY.md §5: the honest
+analogue of sequence parallelism — the reference shards its node sweeps over 16
+goroutines, ``util/scheduler_helper.go:62,94``).  Here each chip owns a
+contiguous shard of the node tensors (idle / releasing / task counts /
+allocatable / static masks and scores) and the per-task selection becomes a
+two-level argmax:
+
+  local: fit + score + argmax over the chip's node shard          (no comms)
+  global: all_gather of one (score, index, fit bits) candidate
+          per chip over ICI, replicated winner reduction          (D tiny scalars)
+
+Only the winning chip mutates its idle/releasing rows, so node state never
+leaves the chips between tasks — per task, the only ICI traffic is the D
+candidate tuples.  The session-static [T, N] predicate mask and score matrices
+are likewise computed sharded: the label-selector matmul ([T, L] x [L, Nshard])
+runs on each chip's MXU against its own node shard.
+
+Written with ``shard_map`` + explicit ``all_gather`` (rather than relying on
+GSPMD to infer the collective from an argmax over a sharded axis) so the
+comm pattern is pinned: one small all-gather per scan step, riding ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from scheduler_tpu.ops.predicates import fit_mask, selector_mask
+from scheduler_tpu.ops.scoring import dynamic_score
+
+NODE_AXIS = "nodes"
+
+
+def node_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [N, ...] node-major tensors: rows split over the mesh."""
+    return NamedSharding(mesh, P(NODE_AXIS))
+
+
+def task_node_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [T, N] matrices: node (trailing) axis split over the mesh."""
+    return NamedSharding(mesh, P(None, NODE_AXIS))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "weights", "enforce_pod_count")
+)
+def sharded_place_scan(
+    idle: jnp.ndarray,          # f32 [N, R]  sharded P(nodes)
+    releasing: jnp.ndarray,     # f32 [N, R]  sharded P(nodes)
+    task_count: jnp.ndarray,    # i32 [N]     sharded P(nodes)
+    allocatable: jnp.ndarray,   # f32 [N, R]  sharded P(nodes)
+    pods_limit: jnp.ndarray,    # i32 [N]     sharded P(nodes)
+    mins: jnp.ndarray,          # f32 [R]     replicated
+    init_resreq: jnp.ndarray,   # f32 [T, R]  replicated
+    resreq: jnp.ndarray,        # f32 [T, R]  replicated
+    static_mask: jnp.ndarray,   # bool [T, N] sharded P(None, nodes)
+    static_score: jnp.ndarray,  # f32 [T, N]  sharded P(None, nodes)
+    valid: jnp.ndarray,         # bool [T]    replicated
+    ready_deficit: jnp.ndarray,  # i32 scalar replicated
+    *,
+    mesh: Mesh,
+    weights: Tuple[float, float, float],
+    enforce_pod_count: bool,
+):
+    """Same contract as ``placement._place_scan`` but node-sharded over ``mesh``.
+
+    Returns (idle, releasing, task_count, chosen, pipelined, failed) with the
+    node tensors still sharded and the per-task outputs replicated.
+    """
+
+    def shard_fn(idle, releasing, task_count, allocatable, pods_limit, mins,
+                 init_resreq, resreq, static_mask, static_score, valid,
+                 ready_deficit):
+        n_local = idle.shape[0]
+        shard = jax.lax.axis_index(NODE_AXIS)
+        offset = shard * n_local
+        neg_inf = jnp.float32(-jnp.inf)
+
+        def step(carry, xs):
+            idle, releasing, task_count, n_alloc, stopped = carry
+            init_req, req, smask, sscore, is_valid = xs
+
+            fit_idle = fit_mask(init_req, idle, mins)
+            fit_rel = fit_mask(init_req, releasing, mins)
+            feasible = (fit_idle | fit_rel) & smask
+            if enforce_pod_count:
+                feasible = feasible & (task_count < pods_limit)
+
+            score = sscore + dynamic_score(req, idle, allocatable, *weights)
+            masked_score = jnp.where(feasible, score, neg_inf)
+            lbest = jnp.argmax(masked_score)
+            lscore = masked_score[lbest]
+
+            # One candidate tuple per chip, packed into a single f32[4] gather;
+            # the global index rides as a float (exact below 2^24 nodes).
+            # Replicated winner reduction: argmax ties break to the lowest
+            # shard, and the local argmax ties to the lowest local row —
+            # together, lowest global index, matching the single-chip kernel's
+            # deterministic SelectBestNode.
+            cand = jnp.stack([
+                lscore,
+                (lbest + offset).astype(jnp.float32),
+                fit_idle[lbest].astype(jnp.float32),
+                fit_rel[lbest].astype(jnp.float32),
+            ])
+            all_cand = jax.lax.all_gather(cand, NODE_AXIS)  # [D, 4]
+
+            winner = jnp.argmax(all_cand[:, 0])
+            any_feasible = all_cand[winner, 0] > neg_inf
+            g_best = all_cand[winner, 1].astype(jnp.int32)
+            fit_i_best = all_cand[winner, 2] > 0
+            fit_r_best = all_cand[winner, 3] > 0
+
+            active = (~stopped) & is_valid
+            placed = active & any_feasible
+            alloc_here = placed & fit_i_best
+            pipe_here = placed & ~fit_i_best & fit_r_best
+
+            # Only the owning shard's rows change; others add a zero delta.
+            l_idx = g_best - offset
+            in_shard = (l_idx >= 0) & (l_idx < n_local)
+            row = jnp.clip(l_idx, 0, n_local - 1)
+            delta = jnp.zeros_like(idle).at[row].set(req) * in_shard
+            idle = idle - delta * alloc_here
+            releasing = releasing - delta * pipe_here
+            task_count = task_count + (
+                (jnp.arange(n_local) == row) & in_shard & (alloc_here | pipe_here)
+            )
+
+            n_alloc = n_alloc + alloc_here
+            failed = active & ~any_feasible
+            became_ready = (alloc_here | pipe_here) & (n_alloc >= ready_deficit)
+            stopped = stopped | failed | became_ready
+
+            chosen = jnp.where(alloc_here | pipe_here, g_best, -1)
+            return (idle, releasing, task_count, n_alloc, stopped), (
+                chosen,
+                pipe_here,
+                failed,
+            )
+
+        init = (
+            idle,
+            releasing,
+            task_count,
+            jnp.zeros((), dtype=jnp.int32),
+            jnp.zeros((), dtype=bool),
+        )
+        xs = (init_resreq, resreq, static_mask, static_score, valid)
+        (idle, releasing, task_count, _, _), (chosen, pipelined, failed) = (
+            jax.lax.scan(step, init, xs)
+        )
+        return idle, releasing, task_count, chosen, pipelined, failed
+
+    return shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+            P(), P(), P(), P(None, NODE_AXIS), P(None, NODE_AXIS), P(), P(),
+        ),
+        out_specs=(
+            P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(), P(), P(),
+        ),
+        check_vma=False,
+    )(idle, releasing, task_count, allocatable, pods_limit, mins,
+      init_resreq, resreq, static_mask, static_score, valid, ready_deficit)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def sharded_selector_mask(
+    task_selector: jnp.ndarray,  # bool [T, L] sharded P(tasks) if 2D mesh
+    node_labels: jnp.ndarray,    # bool [N, L] sharded P(nodes)
+    *,
+    mesh: Mesh,
+) -> jnp.ndarray:
+    """Session-static label-selector mask, sharded: each chip multiplies its
+    task rows against its node shard's label matrix on the MXU, producing the
+    [T, N] mask already laid out in the scan's P(None, nodes) sharding."""
+
+    return shard_map(
+        selector_mask,
+        mesh=mesh,
+        in_specs=(P(), P(NODE_AXIS)),
+        out_specs=P(None, NODE_AXIS),
+        check_vma=False,
+    )(task_selector, node_labels)

@@ -9,10 +9,18 @@ detector + PANIC_ON_ERROR for the same reason).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("PANIC_ON_ERROR", "true")
+
+# The image's sitecustomize may import jax at interpreter start (registering a
+# TPU plugin and freezing jax_platforms from the launch env), which makes the
+# env vars above too late.  jax.config.update still wins because backends
+# initialize lazily on first use.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

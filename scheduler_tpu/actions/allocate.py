@@ -46,6 +46,10 @@ def _device_enabled() -> bool:
     return os.environ.get("SCHEDULER_TPU_DEVICE", "1") not in ("0", "false")
 
 
+def _fused_enabled() -> bool:
+    return os.environ.get("SCHEDULER_TPU_FUSED", "1") not in ("0", "false")
+
+
 class AllocateAction(Action):
     def name(self) -> str:
         return "allocate"
@@ -77,7 +81,13 @@ class AllocateAction(Action):
         engine = None
         if _device_enabled() and candidates:
             from scheduler_tpu.ops.allocator import DeviceAllocator
+            from scheduler_tpu.ops.fused import FusedAllocator
 
+            if _fused_enabled() and FusedAllocator.supported(ssn):
+                # Whole-action fusion: queue/job selection AND every task
+                # placement in one device program, one readback.
+                self._run_fused(ssn, candidates)
+                return
             if DeviceAllocator.supported(ssn):
                 engine = DeviceAllocator(ssn, candidates)
 
@@ -129,6 +139,25 @@ class AllocateAction(Action):
                 self._run_host_pop(ssn, job, pending_tasks[job.uid], jobs, all_nodes, host_predicate)
 
             queues.push(queue)
+
+    # -- fused engine --------------------------------------------------------
+
+    def _run_fused(self, ssn, candidates: List[JobInfo]) -> None:
+        from scheduler_tpu.ops.fused import FusedAllocator
+
+        engine = FusedAllocator(ssn, candidates)
+        results = engine.run()
+        for job in candidates:
+            for task, node_name, pipelined, failed in results.get(job.uid, []):
+                if failed:
+                    fe = FitErrors()
+                    fe.set_node_error("*", FitError(task.name, "*", NODE_RESOURCE_FIT_FAILED))
+                    job.nodes_fit_errors[task.uid] = fe
+                    break
+                if pipelined:
+                    ssn.pipeline(task, node_name)
+                else:
+                    ssn.allocate(task, node_name)
 
     # -- device engine -------------------------------------------------------
 

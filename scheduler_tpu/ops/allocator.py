@@ -42,6 +42,60 @@ from scheduler_tpu.utils.scheduler_helper import task_sort_key as _task_sort_key
 logger = logging.getLogger("scheduler_tpu.ops.allocator")
 
 
+def gang_ready_active(ssn) -> bool:
+    """True iff gang's job_ready veto is actually consulted: registered AND
+    enabled in some tier.  When it isn't, ``ssn.job_ready`` is vacuously true
+    and the allocate ready-break fires after every placement (deficit 0), so
+    pops place one task then re-select — both device engines must mirror that."""
+    if "gang" not in ssn.job_ready_fns:
+        return False
+    return any(
+        p.name == "gang" and p.job_ready_enabled()
+        for tier in ssn.tiers
+        for p in tier.plugins
+    )
+
+
+def collect_pending(job: JobInfo, sort_key) -> List[TaskInfo]:
+    """A job's pending, non-best-effort tasks in task order (allocate.go:119-133)."""
+    pending = [
+        t
+        for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
+        if not t.resreq.is_empty()
+    ]
+    pending.sort(key=sort_key)
+    return pending
+
+
+def score_weights(ssn) -> Tuple[float, float, float]:
+    """(least_requested, balanced, binpack) weights for the dynamic scorers."""
+    w = ssn.device_score_weights
+    return (
+        float(w.get("least_requested", 0.0)),
+        float(w.get("balanced", 0.0)),
+        float(w.get("binpack", 0.0)),
+    )
+
+
+def node_state_from_tensors(st: SnapshotTensors, policy: DevicePolicy, n_bucket: int) -> NodeState:
+    """Padded, unit-scaled device NodeState from host snapshot tensors."""
+    r = policy.vocab.size
+    scale = policy.column_scale(r)
+
+    def prep(mat: np.ndarray) -> jnp.ndarray:
+        return jnp.asarray(pad_rows(scale_columns(mat, scale), n_bucket))
+
+    return NodeState(
+        idle=prep(st.nodes.idle),
+        releasing=prep(st.nodes.releasing),
+        task_count=jnp.asarray(pad_rows(st.nodes.task_count.astype(np.int32), n_bucket)),
+        allocatable=prep(st.nodes.allocatable),
+        # pad nodes get pods_limit 0 -> never feasible under the pod-count gate
+        pods_limit=jnp.asarray(pad_rows(st.nodes.pods_limit.astype(np.int32), n_bucket)),
+        mins=jnp.asarray(policy.scaled_mins(r).astype(np.float32)),
+    )
+
+
 class DeviceAllocator:
     def __init__(self, ssn, jobs: Sequence[JobInfo]) -> None:
         self.ssn = ssn
@@ -51,13 +105,10 @@ class DeviceAllocator:
         self.policy = DevicePolicy(vocab)
 
         # Pending, non-best-effort tasks of every candidate job, in task order.
+        sort_key = _task_sort_key(ssn)
         self.tasks: List[TaskInfo] = []
         for job in jobs:
-            pending = list(job.task_status_index.get(TaskStatus.PENDING, {}).values())
-            pending.sort(key=_task_sort_key(ssn))
-            for t in pending:
-                if not t.resreq.is_empty():
-                    self.tasks.append(t)
+            self.tasks.extend(collect_pending(job, sort_key))
 
         node_list = sorted(ssn.nodes.values(), key=lambda n: n.name)
         self.st: SnapshotTensors = build_snapshot_tensors(
@@ -69,23 +120,8 @@ class DeviceAllocator:
         self.n_bucket = bucket(max(n, 1))
         scale = self.policy.column_scale(r)
 
-        def prep(mat: np.ndarray) -> jnp.ndarray:
-            return jnp.asarray(pad_rows(scale_columns(mat, scale), self.n_bucket))
-
         self.node_names = self.st.nodes.names
-        self.state = NodeState(
-            idle=prep(self.st.nodes.idle),
-            releasing=prep(self.st.nodes.releasing),
-            task_count=jnp.asarray(
-                pad_rows(self.st.nodes.task_count.astype(np.int32), self.n_bucket)
-            ),
-            allocatable=prep(self.st.nodes.allocatable),
-            # pad nodes get pods_limit 0 -> never feasible
-            pods_limit=jnp.asarray(
-                pad_rows(self.st.nodes.pods_limit.astype(np.int32), self.n_bucket)
-            ),
-            mins=jnp.asarray(self.policy.scaled_mins(r).astype(np.float32)),
-        )
+        self.state = node_state_from_tensors(self.st, self.policy, self.n_bucket)
 
         # Static [T, N] predicate mask: node-ready gate AND every device
         # predicate a plugin registered (selector/taint enforcement lives in the
@@ -106,12 +142,7 @@ class DeviceAllocator:
             score = score + np.asarray(builder(self.st), dtype=np.float32)
         self.static_score = np.asarray(pad_rows(score.T, self.n_bucket, fill=0.0)).T
 
-        w = ssn.device_score_weights
-        self.weights: Tuple[float, float, float] = (
-            float(w.get("least_requested", 0.0)),
-            float(w.get("balanced", 0.0)),
-            float(w.get("binpack", 0.0)),
-        )
+        self.weights: Tuple[float, float, float] = score_weights(ssn)
 
         scaled_init = scale_columns(self.st.tasks.init_resreq, scale) if self.st.tasks.count else np.zeros((0, r), np.float32)
         scaled_req = scale_columns(self.st.tasks.resreq, scale) if self.st.tasks.count else np.zeros((0, r), np.float32)
@@ -146,6 +177,10 @@ class DeviceAllocator:
         if not fns:
             return 0
         if fns == {"gang"}:
+            if not gang_ready_active(self.ssn):
+                # Registered but disabled by the conf enable flag: the veto-AND
+                # dispatch skips it, JobReady is vacuously true -> deficit 0.
+                return 0
             return job.min_available - job.ready_task_num()
         return None
 

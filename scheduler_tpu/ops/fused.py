@@ -1,0 +1,412 @@
+"""Fused allocate: the ENTIRE action as one device program, one readback.
+
+The per-pop engine (``ops.allocator``) dispatches one scan per job pop and reads
+three arrays back per pop — on a tunneled TPU that round trip costs more than
+the compute (profiled ~85 ms/transfer).  This module moves the *outer* loop of
+``actions/allocate`` (queue pop -> job pop -> task loop, reference
+``allocate.go:95-192``) onto the device too: a single ``lax.while_loop`` whose
+every step
+
+  1. keeps the current job pop going, or — when the pop ended (first infeasible
+     task, gang-ready break, or drained tail) — re-selects the next (queue, job)
+     by the live plugin ordering semantics:
+       queue:  static creation/uid rank (v1: no proportion share ordering)
+       job:    first-nonzero comparator chain in tier order, vectorized as a
+               masked lexicographic argmin over [J] key vectors —
+               priority (higher first, priority.go:61-79),
+               gang (not-ready first, gang.go:96-121),
+               drf (lower dominant share first, drf.go:93-100; shares carried
+               live on device, updated on every placement like the allocate
+               event handler drf.go:135-154),
+               then the session's creation/uid fallback rank.
+  2. places exactly ONE task of that job: epsilon-exact fit against live
+     idle/releasing, dynamic scoring (least-requested / balanced / binpack),
+     deterministic lowest-index argmax — identical to ``ops.placement``.
+
+The host gets back ONE int32[T] array encoding the whole action:
+  >= 0: allocated on that node   |   -1: never reached (left pending)
+  -2: first infeasible task of its job (host records FitErrors)
+  <= -3: pipelined onto node -(v + 3)
+
+Gating: only sessions whose registered callbacks are exactly the builtin
+device-capable set may use this engine (see ``FusedAllocator.supported``);
+anything else falls back to the per-pop or host engines, so custom plugins stay
+correct — just not fused.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_tpu.api.job_info import JobInfo, TaskInfo
+from scheduler_tpu.api.tensors import bucket, build_snapshot_tensors
+from scheduler_tpu.ops.allocator import (
+    collect_pending,
+    gang_ready_active,
+    node_state_from_tensors,
+    score_weights,
+)
+from scheduler_tpu.ops.device import DevicePolicy, pad_rows, scale_columns
+from scheduler_tpu.ops.predicates import fit_mask
+from scheduler_tpu.ops.scoring import dynamic_score
+from scheduler_tpu.utils.scheduler_helper import task_sort_key as _task_sort_key
+
+logger = logging.getLogger("scheduler_tpu.ops.fused")
+
+# Result encoding (see module docstring).
+UNPLACED = -1
+FAILED = -2
+_PIPE_BASE = -3
+
+# Comparators the fused job-selection chain understands, keyed by plugin name.
+_KNOWN_JOB_ORDER = ("priority", "gang", "drf")
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("comparators", "weights", "enforce_pod_count"),
+)
+def fused_allocate(
+    # node tensors (device units, node-bucket padded)
+    idle: jnp.ndarray,          # f32 [N, R]
+    releasing: jnp.ndarray,     # f32 [N, R]
+    task_count: jnp.ndarray,    # i32 [N]
+    allocatable: jnp.ndarray,   # f32 [N, R]
+    pods_limit: jnp.ndarray,    # i32 [N]
+    node_gate: jnp.ndarray,     # bool [N] ready & not padding
+    mins: jnp.ndarray,          # f32 [R]
+    # flat task tensors (task order within job, job-major, task-bucket padded)
+    init_resreq: jnp.ndarray,   # f32 [T, R]
+    resreq: jnp.ndarray,        # f32 [T, R]
+    # job tensors (job-bucket padded)
+    job_task_offset: jnp.ndarray,  # i32 [J]
+    job_task_num: jnp.ndarray,     # i32 [J] (0 for padding)
+    job_deficit: jnp.ndarray,      # i32 [J] ready-break deficit (0 when gang's
+                                   #   job_ready veto isn't active: break fires
+                                   #   after every placement, like the host)
+    job_gang_order: jnp.ndarray,   # i32 [J] true gang deficit for the ORDER
+                                   #   comparator (min_available - ready_num)
+    job_priority: jnp.ndarray,     # i32 [J] PriorityClass value (exact ints)
+    job_tiebreak: jnp.ndarray,     # i32 [J] rank by (creation, uid)
+    job_queue: jnp.ndarray,        # i32 [J]
+    job_alloc_init: jnp.ndarray,   # f32 [J, R] drf allocated at session open
+    # queue tensors
+    queue_rank: jnp.ndarray,       # i32 [Q] creation/uid rank
+    queue_has_jobs: jnp.ndarray,   # bool [Q] real queue
+    # drf
+    drf_total: jnp.ndarray,        # f32 [R] cluster totals (0 where absent)
+    *,
+    comparators: Tuple[str, ...],
+    weights: Tuple[float, float, float],
+    enforce_pod_count: bool,
+):
+    n = idle.shape[0]
+    t_cap = resreq.shape[0]
+    j_cap = job_task_num.shape[0]
+    neg_inf = jnp.float32(-jnp.inf)
+    pos_inf = jnp.float32(jnp.inf)
+    big_i32 = jnp.int32(2**31 - 1)
+
+    total_safe = jnp.where(drf_total > 0, drf_total, 1.0)
+    total_mask = drf_total > 0
+
+    def eligible(cursor, left):
+        return (~left) & (cursor < job_task_num)
+
+    def select_job(cursor, left, n_alloc, alloc):
+        elig = eligible(cursor, left)
+        # Queue pop: lowest-rank queue that still has an eligible job
+        # (static fallback order; matches the host heap's creation/uid order).
+        q_has = (
+            jax.ops.segment_sum(elig.astype(jnp.int32), job_queue,
+                                num_segments=queue_rank.shape[0]) > 0
+        ) & queue_has_jobs
+        q_keys = jnp.where(q_has, queue_rank, big_i32)
+        q_star = jnp.argmin(q_keys)
+        cand = elig & (job_queue == q_star)
+
+        # First-nonzero comparator chain == lexicographic masked argmin.
+        # Integer keys stay integer (PriorityClass values up to 2^31 compare
+        # exactly; float32 would collapse values above 2^24).
+        for name in comparators:
+            if name == "priority":
+                key, sentinel = -job_priority, big_i32
+            elif name == "gang":
+                key = ((job_gang_order - n_alloc) <= 0).astype(jnp.int32)
+                sentinel = big_i32
+            elif name == "drf":
+                frac = jnp.where(
+                    total_mask[None, :], alloc / total_safe[None, :], 0.0
+                )
+                key, sentinel = jnp.max(frac, axis=-1), pos_inf
+            else:  # pragma: no cover - guarded by `supported`
+                raise ValueError(f"unknown comparator {name}")
+            masked = jnp.where(cand, key, sentinel)
+            best = jnp.min(masked)
+            cand = cand & (masked == best)
+
+        tb = jnp.where(cand, job_tiebreak, big_i32)
+        sel = jnp.argmin(tb)
+        return jnp.where(jnp.any(cand), sel, -1).astype(jnp.int32)
+
+    def body(state):
+        (idle, releasing, task_count, cursor, left, n_alloc, alloc,
+         cur, out, steps) = state
+
+        # Selection only runs when the previous pop ended (lax.cond, not
+        # where): most steps continue the current job, and the comparator
+        # chain + segment_sum are a large share of the step's op count.
+        cur = jax.lax.cond(
+            cur < 0,
+            lambda: select_job(cursor, left, n_alloc, alloc),
+            lambda: cur,
+        )
+
+        t_idx = jnp.clip(job_task_offset[cur] + cursor[cur], 0, t_cap - 1)
+        init_req = init_resreq[t_idx]
+        req = resreq[t_idx]
+
+        fit_idle = fit_mask(init_req, idle, mins)
+        fit_rel = fit_mask(init_req, releasing, mins)
+        feasible = (fit_idle | fit_rel) & node_gate
+        if enforce_pod_count:
+            feasible = feasible & (task_count < pods_limit)
+        any_feasible = jnp.any(feasible)
+
+        score = dynamic_score(req, idle, allocatable, *weights)
+        masked_score = jnp.where(feasible, score, neg_inf)
+        best = jnp.argmax(masked_score)
+
+        active = cur >= 0
+        placed = active & any_feasible
+        alloc_here = placed & fit_idle[best]
+        pipe_here = placed & ~fit_idle[best] & fit_rel[best]
+        failed = active & ~any_feasible
+
+        delta = jnp.zeros_like(idle).at[best].set(req)
+        idle = idle - delta * alloc_here
+        releasing = releasing - delta * pipe_here
+        task_count = task_count + ((jnp.arange(n) == best) & (alloc_here | pipe_here))
+
+        cur_safe = jnp.clip(cur, 0, j_cap - 1)
+        consumed = (alloc_here | pipe_here | failed).astype(jnp.int32)
+        cursor = cursor.at[cur_safe].add(jnp.where(active, consumed, 0))
+        n_alloc = n_alloc.at[cur_safe].add(
+            jnp.where(active & alloc_here, 1, 0)
+        )
+        # DRF shares grow on every placement — pipeline fires the allocate
+        # event too (session.go:199-239 -> drf.go:135-144).
+        alloc = alloc.at[cur_safe].add(
+            jnp.where(active & (alloc_here | pipe_here), req, 0.0)
+        )
+        left = left.at[cur_safe].set(
+            jnp.where(active, left[cur_safe] | failed, left[cur_safe])
+        )
+
+        code = jnp.where(
+            alloc_here, best.astype(jnp.int32),
+            jnp.where(pipe_here, _PIPE_BASE - best.astype(jnp.int32),
+                      jnp.where(failed, FAILED, UNPLACED)),
+        )
+        out = out.at[t_idx].set(jnp.where(active, code, out[t_idx]))
+
+        became_ready = (alloc_here | pipe_here) & (
+            n_alloc[cur_safe] >= job_deficit[cur_safe]
+        )
+        drained = cursor[cur_safe] >= job_task_num[cur_safe]
+        end_pop = failed | became_ready | drained
+        cur = jnp.where(active & ~end_pop, cur, -1)
+
+        return (idle, releasing, task_count, cursor, left, n_alloc, alloc,
+                cur, out, steps + 1)
+
+    def cond(state):
+        (_, _, _, cursor, left, _, _, cur, _, steps) = state
+        return ((cur >= 0) | jnp.any(eligible(cursor, left))) & (steps < t_cap + 1)
+
+    init = (
+        idle,
+        releasing,
+        task_count,
+        jnp.zeros(j_cap, dtype=jnp.int32),
+        jnp.zeros(j_cap, dtype=bool),
+        jnp.zeros(j_cap, dtype=jnp.int32),
+        job_alloc_init,
+        jnp.asarray(-1, dtype=jnp.int32),
+        jnp.full(t_cap, UNPLACED, dtype=jnp.int32),
+        jnp.zeros((), dtype=jnp.int32),
+    )
+    final = jax.lax.while_loop(cond, body, init)
+    return final[8]
+
+
+class FusedAllocator:
+    """Host shim: session -> tensors -> one fused_allocate call -> decoded rows."""
+
+    def __init__(self, ssn, jobs: Sequence[JobInfo]) -> None:
+        self.ssn = ssn
+        vocab = next(iter(ssn.nodes.values())).vocab
+        policy = DevicePolicy(vocab)
+        r = vocab.size
+        scale = policy.column_scale(r)
+
+        def rvec(resource) -> np.ndarray:
+            out = np.zeros(r)
+            arr = resource.array
+            out[: arr.shape[0]] = arr
+            return out
+
+        # --- jobs + flat tasks (job-major, task order within job) -----------
+        self.jobs: List[JobInfo] = list(jobs)
+        j = len(self.jobs)
+        jb = bucket(max(j, 1))
+        self.job_rows: List[List[TaskInfo]] = []
+        flat: List[TaskInfo] = []
+        offsets = np.zeros(jb, dtype=np.int32)
+        nums = np.zeros(jb, dtype=np.int32)
+        deficits = np.zeros(jb, dtype=np.int32)
+        gang_order = np.zeros(jb, dtype=np.int32)
+        priorities = np.zeros(jb, dtype=np.int32)
+        queues_idx = np.zeros(jb, dtype=np.int32)
+        alloc_init = np.zeros((jb, r), dtype=np.float64)
+
+        queue_names = sorted(
+            ssn.queues, key=lambda q: (ssn.queues[q].creation_timestamp, q)
+        )
+        qb = bucket(max(len(queue_names), 1))
+        queue_pos = {q: i for i, q in enumerate(queue_names)}
+
+        order = sorted(
+            range(j),
+            key=lambda k: (self.jobs[k].creation_timestamp, self.jobs[k].uid),
+        )
+        tiebreak = np.full(jb, 2**31 - 1, dtype=np.int32)
+        for rank, k in enumerate(order):
+            tiebreak[k] = rank
+
+        # Ready-break deficit: only meaningful when gang's job_ready veto is
+        # live; otherwise JobReady is vacuously true and the break fires after
+        # every placement (deficit 0), matching the host/per-pop engines.
+        gang_break = gang_ready_active(ssn)
+
+        sort_key = _task_sort_key(ssn)
+        for k, job in enumerate(self.jobs):
+            pending = collect_pending(job, sort_key)
+            self.job_rows.append(pending)
+            offsets[k] = len(flat)
+            nums[k] = len(pending)
+            true_deficit = job.min_available - job.ready_task_num()
+            deficits[k] = true_deficit if gang_break else 0
+            gang_order[k] = true_deficit
+            priorities[k] = int(job.priority)
+            queues_idx[k] = queue_pos[job.queue]
+            alloc_init[k] = rvec(job.allocated)
+            flat.extend(pending)
+
+        self.flat = flat
+        node_list = sorted(ssn.nodes.values(), key=lambda nd: nd.name)
+        st = build_snapshot_tensors(node_list, self.jobs, flat, queue_names, vocab)
+        self.node_names = st.nodes.names
+        n = st.nodes.count
+        nb = bucket(max(n, 1))
+        tb = bucket(max(len(flat), 1))
+
+        node_gate = pad_rows(st.nodes.ready, nb, fill=False)
+
+        queue_rank = np.arange(qb, dtype=np.int32)
+        queue_has = np.zeros(qb, dtype=bool)
+        queue_has[: len(queue_names)] = True
+
+        total = st.nodes.allocatable.sum(axis=0)
+
+        self.weights = score_weights(ssn)
+        self.comparators = tuple(
+            name
+            for tier in ssn.tiers
+            for plugin in tier.plugins
+            if plugin.job_order_enabled() and (name := plugin.name) in ssn.job_order_fns
+        )
+        self.enforce_pod_count = "pod_count" in ssn.device_dynamic_gates
+
+        state = node_state_from_tensors(st, policy, nb)
+        self.args = (
+            state.idle,
+            state.releasing,
+            state.task_count,
+            state.allocatable,
+            state.pods_limit,
+            jnp.asarray(node_gate),
+            state.mins,
+            jnp.asarray(pad_rows(scale_columns(st.tasks.init_resreq, scale), tb)),
+            jnp.asarray(pad_rows(scale_columns(st.tasks.resreq, scale), tb)),
+            jnp.asarray(offsets),
+            jnp.asarray(nums),
+            jnp.asarray(deficits),
+            jnp.asarray(gang_order),
+            jnp.asarray(priorities),
+            jnp.asarray(tiebreak),
+            jnp.asarray(queues_idx),
+            jnp.asarray(scale_columns(alloc_init, scale)),
+            jnp.asarray(queue_rank),
+            jnp.asarray(queue_has),
+            jnp.asarray(scale_columns(total[None, :], scale)[0]),
+        )
+
+    # -- capability probe ----------------------------------------------------
+
+    @staticmethod
+    def supported(ssn) -> bool:
+        """True iff every registered callback is in the fused builtin set."""
+        if not ssn.nodes:
+            return False
+        if ssn.predicate_fns or ssn.device_predicates or ssn.device_scorers:
+            return False  # [T, N] static masks/scores not fused yet (v1)
+        if set(ssn.job_order_fns) - set(_KNOWN_JOB_ORDER):
+            return False
+        if ssn.queue_order_fns or ssn.overused_fns:
+            return False  # proportion queue ordering not fused yet (v1)
+        if set(ssn.job_ready_fns) - {"gang"}:
+            return False
+        scoring = set(ssn.node_order_fns) | set(ssn.batch_node_order_fns) | set(ssn.node_map_fns)
+        if scoring - ssn.device_weighted_plugins:
+            return False
+        return True
+
+    # -- run + decode --------------------------------------------------------
+
+    def run(self) -> Dict[str, List[Tuple[TaskInfo, Optional[str], bool, bool]]]:
+        """Execute the fused kernel; returns per-job rows in placement order:
+        [(task, node_name | None, pipelined, failed)] — same row shape as
+        ``DeviceAllocator.place_job``, truncated at each job's pop boundary."""
+        encoded = np.asarray(
+            fused_allocate(
+                *self.args,
+                comparators=self.comparators,
+                weights=self.weights,
+                enforce_pod_count=self.enforce_pod_count,
+            )
+        )
+
+        out: Dict[str, List[Tuple[TaskInfo, Optional[str], bool, bool]]] = {}
+        base = 0
+        for job, rows in zip(self.jobs, self.job_rows):
+            decoded: List[Tuple[TaskInfo, Optional[str], bool, bool]] = []
+            for i, task in enumerate(rows):
+                code = int(encoded[base + i])
+                if code == UNPLACED:
+                    continue
+                if code == FAILED:
+                    decoded.append((task, None, False, True))
+                elif code <= _PIPE_BASE:
+                    decoded.append((task, self.node_names[_PIPE_BASE - code], True, False))
+                else:
+                    decoded.append((task, self.node_names[code], False, False))
+            out[job.uid] = decoded
+            base += len(rows)
+        return out

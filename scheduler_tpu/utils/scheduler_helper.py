@@ -10,8 +10,7 @@ engine where it belongs.
 from __future__ import annotations
 
 import functools
-import random
-from typing import Callable, Dict, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from scheduler_tpu.api.job_info import TaskInfo
 from scheduler_tpu.api.node_info import NodeInfo
@@ -76,16 +75,26 @@ def sort_nodes(node_scores: Dict[NodeInfo, float]) -> List[NodeInfo]:
 
 
 def select_best_node(node_scores: Dict[NodeInfo, float]) -> NodeInfo:
-    """Uniform pick among the top-scoring nodes (scheduler_helper.go:147-158)."""
+    """Lowest-name pick among the top-scoring nodes.
+
+    The reference picks uniformly at random among ties
+    (scheduler_helper.go:147-158); we deliberately pick the first node in name
+    order instead — same top-score class, but deterministic, which makes
+    scheduling decisions reproducible and lets the host engine be
+    property-tested bind-for-bind against the device engines (which take the
+    lowest node index, i.e. the same name-ordered choice)."""
     best_score = None
-    best: List[NodeInfo] = []
+    best: Optional[NodeInfo] = None
     for node, score in node_scores.items():
-        if best_score is None or score > best_score:
+        if (
+            best_score is None
+            or score > best_score
+            or (score == best_score and best is not None and node.name < best.name)
+        ):
             best_score = score
-            best = [node]
-        elif score == best_score:
-            best.append(node)
-    return random.choice(best)
+            best = node
+    assert best is not None
+    return best
 
 
 def task_sort_key(ssn) -> Callable:

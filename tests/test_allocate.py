@@ -152,13 +152,8 @@ class TestGangAllocate:
 
 class TestDeviceHostParity:
     def test_same_bind_count_on_fragmented_cluster(self, monkeypatch):
-        # Determinize the host tie-break: select_best_node picks uniformly among
-        # tied top scorers; pin it to the first (lowest-name) candidate, which
-        # matches the device scan's lowest-index argmax.
-        import scheduler_tpu.utils.scheduler_helper as helper
-
-        monkeypatch.setattr(helper.random, "choice", lambda seq: seq[0])
-
+        # select_best_node is deterministic (lowest name among tied top
+        # scorers), matching the device scan's lowest-index argmax.
         def build():
             cache = SchedulerCache(vocab=make_vocab(), async_io=False)
             cache.run()
@@ -190,6 +185,25 @@ class TestDeviceHostParity:
             return orig(self, job, tasks)
 
         monkeypatch.setattr(DeviceAllocator, "place_job", spy)
+        monkeypatch.setenv("SCHEDULER_TPU_DEVICE", "1")
+        monkeypatch.setenv("SCHEDULER_TPU_FUSED", "0")  # exercise the per-pop engine
+        cache = make_cluster(n_nodes=3)
+        add_gang(cache, "gang1", n_tasks=3, min_member=3)
+        run_allocate(cache)
+        assert used.get("yes")
+        assert len(cache.binder.binds) == 3
+
+    def test_fused_engine_used_by_default(self, monkeypatch):
+        used = {}
+        from scheduler_tpu.ops.fused import FusedAllocator
+
+        orig = FusedAllocator.run
+
+        def spy(self):
+            used["yes"] = True
+            return orig(self)
+
+        monkeypatch.setattr(FusedAllocator, "run", spy)
         monkeypatch.setenv("SCHEDULER_TPU_DEVICE", "1")
         cache = make_cluster(n_nodes=3)
         add_gang(cache, "gang1", n_tasks=3, min_member=3)

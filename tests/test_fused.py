@@ -1,0 +1,183 @@
+"""Fused-allocate parity: the whole-action device program must reproduce the
+per-pop device engine and the host engine bind-for-bind (reference semantics:
+allocate.go:95-192 pop ordering + placement feedback)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import close_session, get_action, open_session
+from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
+
+CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+"""
+
+CONF_NO_DRF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+"""
+
+CONF_NO_GANG = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: drf
+"""
+
+
+def build_cluster(seed=0, n_nodes=12, n_jobs=6, tasks_per_job=5, queues=("default",)):
+    rng = np.random.default_rng(seed)
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    for q in queues:
+        cache.add_queue(build_queue(q))
+    for i in range(n_nodes):
+        cache.add_node(build_node(
+            f"n{i:03d}",
+            {"cpu": float(rng.choice([2000, 4000, 8000])),
+             "memory": float(rng.choice([4, 8, 16])) * 1024**3},
+        ))
+    for j in range(n_jobs):
+        group = f"job{j}"
+        size = int(rng.integers(1, tasks_per_job + 1))
+        min_member = int(rng.integers(1, size + 1))
+        cache.add_pod_group(build_pod_group(
+            group, queue=queues[j % len(queues)], min_member=min_member))
+        for t in range(size):
+            cache.add_pod(build_pod(
+                name=f"{group}-{t}",
+                req={"cpu": float(rng.choice([500, 1000, 2000])),
+                     "memory": float(rng.choice([1, 2, 4])) * 1024**3},
+                groupname=group,
+                priority=int(rng.integers(0, 3)),
+            ))
+    return cache
+
+
+def run_engine(cache, conf_str, env):
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        conf = parse_scheduler_conf(conf_str)
+        ssn = open_session(cache, conf.tiers)
+        get_action("allocate").execute(ssn)
+        close_session(ssn)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    binds = dict(cache.binder.binds)
+    statuses = {
+        t.uid: t.status.name
+        for job in ssn.jobs.values()
+        for t in job.tasks.values()
+    }
+    return binds, statuses
+
+
+ENGINES = {
+    "fused": {"SCHEDULER_TPU_DEVICE": "1", "SCHEDULER_TPU_FUSED": "1"},
+    "per-pop": {"SCHEDULER_TPU_DEVICE": "1", "SCHEDULER_TPU_FUSED": "0"},
+    "host": {"SCHEDULER_TPU_DEVICE": "0", "SCHEDULER_TPU_FUSED": "0"},
+}
+
+
+@pytest.mark.parametrize("conf", [CONF, CONF_NO_DRF, CONF_NO_GANG])
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_three_engines_agree(conf, seed):
+    results = {}
+    for name, env in ENGINES.items():
+        cache = build_cluster(seed=seed)
+        results[name] = run_engine(cache, conf, env)
+    assert results["fused"] == results["per-pop"], "fused vs per-pop"
+    assert results["fused"] == results["host"], "fused vs host"
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_two_queue_parity(seed):
+    results = {}
+    for name, env in ENGINES.items():
+        cache = build_cluster(seed=seed, queues=("qa", "qb"), n_jobs=8)
+        results[name] = run_engine(cache, CONF, env)
+    assert results["fused"] == results["per-pop"]
+    assert results["fused"] == results["host"]
+
+
+def test_fused_gang_holdback():
+    # A gang that cannot fully fit must not bind at all (reference e2e job.go:118).
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    cache.add_node(build_node("n0", {"cpu": 2000, "memory": 4 * 1024**3}))
+    cache.add_pod_group(build_pod_group("big", min_member=3))
+    for t in range(3):
+        cache.add_pod(build_pod(name=f"big-{t}", req={"cpu": 1000, "memory": 1024**3},
+                                groupname="big"))
+    binds, _ = run_engine(cache, CONF, ENGINES["fused"])
+    assert binds == {}
+
+
+def test_fused_respects_priority_order():
+    # Higher-PriorityClass job drains the cluster first (priority.go:61-79:
+    # job order compares PodGroup PriorityClass values, not pod priorities).
+    def build():
+        cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+        cache.run()
+        cache.add_queue(build_queue("default"))
+        cache.add_priority_class("low", 1)
+        cache.add_priority_class("high", 9)
+        cache.add_node(build_node("n0", {"cpu": 2000, "memory": 4 * 1024**3}))
+        for group, pc in (("lo", "low"), ("hi", "high")):
+            pg = build_pod_group(group, min_member=1)
+            pg.priority_class_name = pc
+            cache.add_pod_group(pg)
+            cache.add_pod(build_pod(name=f"{group}-0",
+                                    req={"cpu": 2000, "memory": 1024**3},
+                                    groupname=group))
+        return cache
+
+    for name, env in ENGINES.items():
+        binds, _ = run_engine(build(), CONF, env)
+        assert binds == {"default/hi-0": "n0"}, name
+
+
+def test_fused_priority_values_above_float32_precision():
+    # PriorityClass values adjacent above 2^24 must still order exactly
+    # (float32 would collapse 16777217 onto 16777216).
+    def build():
+        cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+        cache.run()
+        cache.add_queue(build_queue("default"))
+        cache.add_priority_class("lo", 16777216)
+        cache.add_priority_class("hi", 16777217)
+        cache.add_node(build_node("n0", {"cpu": 2000, "memory": 4 * 1024**3}))
+        for group, pc in (("lo", "lo"), ("hi", "hi")):
+            pg = build_pod_group(group, min_member=1)
+            pg.priority_class_name = pc
+            cache.add_pod_group(pg)
+            cache.add_pod(build_pod(name=f"{group}-0",
+                                    req={"cpu": 2000, "memory": 1024**3},
+                                    groupname=group))
+        return cache
+
+    for name, env in ENGINES.items():
+        binds, _ = run_engine(build(), CONF, env)
+        assert binds == {"default/hi-0": "n0"}, name

@@ -147,6 +147,8 @@ class AllocateAction(Action):
 
         engine = FusedAllocator(ssn, candidates)
         results = engine.run()
+        bulk = os.environ.get("SCHEDULER_TPU_BULK", "1") not in ("0", "false")
+        placements = []
         for job in candidates:
             for task, node_name, pipelined, failed in results.get(job.uid, []):
                 if failed:
@@ -154,10 +156,14 @@ class AllocateAction(Action):
                     fe.set_node_error("*", FitError(task.name, "*", NODE_RESOURCE_FIT_FAILED))
                     job.nodes_fit_errors[task.uid] = fe
                     break
-                if pipelined:
+                if bulk:
+                    placements.append((task, node_name, pipelined))
+                elif pipelined:
                     ssn.pipeline(task, node_name)
                 else:
                     ssn.allocate(task, node_name)
+        if bulk:
+            ssn.bulk_apply(placements)
 
     # -- device engine -------------------------------------------------------
 

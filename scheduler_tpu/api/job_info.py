@@ -75,13 +75,23 @@ class TaskInfo:
         return self.pod.creation_timestamp
 
     def clone(self) -> "TaskInfo":
+        t = self.clone_shared()
+        t.resreq = self.resreq.clone()
+        t.init_resreq = self.init_resreq.clone()
+        return t
+
+    def clone_shared(self) -> "TaskInfo":
+        """Status-isolated clone that SHARES the (immutable-after-creation)
+        resreq/init_resreq vectors — the bulk-commit fast path.  Node accounting
+        only needs the clone so later status changes don't leak in; the request
+        vectors are never mutated after task creation."""
         t = TaskInfo.__new__(TaskInfo)
         t.uid = self.uid
         t.job = self.job
         t.name = self.name
         t.namespace = self.namespace
-        t.resreq = self.resreq.clone()
-        t.init_resreq = self.init_resreq.clone()
+        t.resreq = self.resreq
+        t.init_resreq = self.init_resreq
         t.node_name = self.node_name
         t.status = self.status
         t.priority = self.priority
@@ -177,6 +187,39 @@ class JobInfo:
         if allocated_status(status):
             self.allocated.add(task.resreq)
         self._add_to_index(task)
+
+    def bulk_update_status(self, tasks: list, status: TaskStatus) -> None:
+        """Batch ``update_task_status``: same bucket moves, but ONE aggregate
+        update computed as a dense vector sum instead of per-task Resource ops.
+        Equivalent final state to calling update_task_status per task."""
+        if not tasks:
+            return
+        import numpy as np
+
+        now_allocated = allocated_status(status)
+        sub_rows = []
+        add_rows = []
+        has_scalars = False
+        for ti in tasks:
+            task = self.tasks.get(ti.uid)
+            if task is None:
+                raise KeyError(f"task {ti.uid} not in job {self.uid}")
+            self._delete_from_index(task)
+            was_allocated = allocated_status(task.status)
+            # sub-then-add of the same rows cancels when allocation-ness is
+            # unchanged (e.g. Allocated -> Binding at dispatch) — skip it.
+            if was_allocated and not now_allocated:
+                sub_rows.append(task.resreq.array)
+            elif now_allocated and not was_allocated:
+                add_rows.append(task.resreq.array)
+                has_scalars = has_scalars or task.resreq.has_scalars
+            task.status = status
+            ti.status = status
+            self._add_to_index(task)
+        if sub_rows:
+            self.allocated.sub_array(np.sum(sub_rows, axis=0))
+        if add_rows:
+            self.allocated.add_array(np.sum(add_rows, axis=0), has_scalars)
 
     # -- gang arithmetic (job_info.go:367-418) ------------------------------
 

@@ -110,6 +110,47 @@ class NodeInfo:
             self.used.add(ti.resreq)
         self.tasks[ti.uid] = ti
 
+    def bulk_add_tasks(self, tasks) -> None:
+        """Batch ``add_task``: the same status state machine, with the resource
+        arithmetic collapsed into one dense delta per accounting vector.
+
+        Tasks must already carry their final status; clones stored in
+        ``self.tasks`` share request vectors (``TaskInfo.clone_shared``).
+        """
+        if not tasks:
+            return
+        import numpy as np
+
+        idle_sub = []
+        rel_add = []
+        rel_sub = []
+        used_add = []
+        for task in tasks:
+            if task.uid in self.tasks:
+                raise ValueError(
+                    f"task {task.namespace}/{task.name} already on node {self.name}"
+                )
+            ti = task.clone_shared()
+            if self.node is not None:
+                arr = ti.resreq.array
+                if ti.status == TaskStatus.RELEASING:
+                    rel_add.append(arr)
+                    idle_sub.append(arr)
+                elif ti.status == TaskStatus.PIPELINED:
+                    rel_sub.append(arr)
+                else:
+                    idle_sub.append(arr)
+                used_add.append(arr)
+            self.tasks[ti.uid] = ti
+        if idle_sub:
+            self.idle.sub_array(np.sum(idle_sub, axis=0))
+        if rel_add:
+            self.releasing.add_array(np.sum(rel_add, axis=0))
+        if rel_sub:
+            self.releasing.sub_array(np.sum(rel_sub, axis=0))
+        if used_add:
+            self.used.add_array(np.sum(used_add, axis=0))
+
     def remove_task(self, ti: TaskInfo) -> None:
         task = self.tasks.get(ti.uid)
         if task is None:

@@ -240,6 +240,28 @@ class ResourceVec:
         dec = ResourceVec(self.vocab, np.where(d < 0, -d, 0.0))
         return inc, dec
 
+    # -- batch-commit helpers ------------------------------------------------
+
+    def add_array(self, arr: np.ndarray, has_scalars: bool = False) -> "ResourceVec":
+        """Add a dense [R] delta in place (bulk-commit fast path: one numpy op
+        stands in for many ``add`` calls)."""
+        self._sync()
+        self._arr += arr
+        self.has_scalars = self.has_scalars or has_scalars or bool(np.any(arr[2:] != 0.0))
+        return self
+
+    def sub_array(self, arr: np.ndarray) -> "ResourceVec":
+        """Subtract a dense [R] delta in place, asserting epsilon-tolerant
+        sufficiency like ``sub``."""
+        self._sync()
+        mins = self.vocab.min_thresholds()
+        assert_that(
+            bool(np.all((arr < self._arr) | (np.abs(self._arr - arr) < mins))),
+            lambda: f"resource is not sufficient to do operation: <{self}> sub <{arr}>",
+        )
+        self._arr -= arr
+        return self
+
     # -- misc ---------------------------------------------------------------
 
     def to_dict(self) -> Dict[str, float]:

@@ -57,6 +57,13 @@ class Cache(abc.ABC):
     @abc.abstractmethod
     def bind(self, task: "TaskInfo", hostname: str) -> None: ...
 
+    def bind_bulk(self, tasks: list) -> None:
+        """Bind many tasks (each carrying its node_name) in one call.  Default
+        falls back to per-task ``bind``; implementations may batch the state
+        update and the async API dispatch."""
+        for task in tasks:
+            self.bind(task, task.node_name)
+
     @abc.abstractmethod
     def evict(self, task: "TaskInfo", reason: str) -> None: ...
 

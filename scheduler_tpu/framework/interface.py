@@ -48,10 +48,18 @@ class Event:
 
 @dataclass
 class EventHandler:
-    """Callbacks fired on session allocate/deallocate so plugins keep shares live."""
+    """Callbacks fired on session allocate/deallocate so plugins keep shares live.
+
+    ``bulk_allocate_func`` is the TPU-native extension: when a whole device
+    placement commits at once, a handler that provides it receives ONE call with
+    every event instead of a per-task loop, so plugins can update shares with
+    vectorized arithmetic.  Must be state-equivalent to folding allocate_func
+    over the same events.
+    """
 
     allocate_func: Optional[Callable[[Event], None]] = None
     deallocate_func: Optional[Callable[[Event], None]] = None
+    bulk_allocate_func: Optional[Callable[[list], None]] = None
 
 
 @dataclass

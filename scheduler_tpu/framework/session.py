@@ -356,6 +356,15 @@ class Session:
             if eh.deallocate_func is not None:
                 eh.deallocate_func(Event(task))
 
+    def _fire_allocate_bulk(self, tasks: List[TaskInfo]) -> None:
+        events = [Event(t) for t in tasks]
+        for eh in self.event_handlers:
+            if eh.bulk_allocate_func is not None:
+                eh.bulk_allocate_func(events)
+            elif eh.allocate_func is not None:
+                for ev in events:
+                    eh.allocate_func(ev)
+
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
         """Assign onto releasing resources; session-state only (session.go:199-239)."""
         job = self.jobs.get(task.job)
@@ -387,6 +396,68 @@ class Session:
         if self.job_ready(job):
             for t in list(job.task_status_index.get(TaskStatus.ALLOCATED, {}).values()):
                 self._dispatch(t)
+
+    def bulk_apply(self, placements: List) -> None:
+        """Commit a whole device placement at once: the batched equivalent of
+        calling ``allocate``/``pipeline`` per row, with identical final state.
+
+        ``placements`` rows are ``(task, hostname, pipelined)`` in placement
+        order.  Equivalence to the sequential path (which the fused kernel
+        already emulated when *choosing* the placement):
+
+        * node/job accounting is order-independent — the same deltas sum;
+        * the reference dispatches ALL Allocated tasks of a job each time an
+          allocation finds the job ready (session.go:286-294); readiness is
+          monotone during allocate, so "dispatch every Allocated task of every
+          job that is ready after the batch" reaches the same end state;
+        * event handlers fire once with the full batch (or per-event for
+          handlers without a bulk form).
+        """
+        if not placements:
+            return
+
+        from collections import defaultdict
+
+        by_job: Dict[str, List] = defaultdict(list)
+        by_node: Dict[str, List[TaskInfo]] = defaultdict(list)
+        for task, hostname, pipelined in placements:
+            if task.job not in self.jobs:
+                raise KeyError(f"failed to find job {task.job} when allocating")
+            if hostname not in self.nodes:
+                raise KeyError(f"failed to find node {hostname}")
+            if not pipelined:
+                self.cache.allocate_volumes(task, hostname)
+            by_job[task.job].append((task, hostname, pipelined))
+            by_node[hostname].append(task)
+
+        affected: List[JobInfo] = []
+        for job_uid, rows in by_job.items():
+            job = self.jobs[job_uid]
+            job.bulk_update_status(
+                [t for t, _, p in rows if not p], TaskStatus.ALLOCATED
+            )
+            job.bulk_update_status([t for t, _, p in rows if p], TaskStatus.PIPELINED)
+            for task, hostname, _ in rows:
+                task.node_name = hostname
+            affected.append(job)
+
+        for hostname, tasks in by_node.items():
+            self.nodes[hostname].bulk_add_tasks(tasks)
+
+        self._fire_allocate_bulk([t for t, _, _ in placements])
+
+        to_bind: List[TaskInfo] = []
+        for job in affected:
+            if self.job_ready(job):
+                allocated = list(
+                    job.task_status_index.get(TaskStatus.ALLOCATED, {}).values()
+                )
+                for t in allocated:
+                    self.cache.bind_volumes(t)
+                job.bulk_update_status(allocated, TaskStatus.BINDING)
+                to_bind.extend(allocated)
+        if to_bind:
+            self.cache.bind_bulk(to_bind)
 
     def _dispatch(self, task: TaskInfo) -> None:
         """Bind an allocated task through the cache (session.go:299-323)."""

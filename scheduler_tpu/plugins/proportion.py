@@ -177,7 +177,30 @@ class ProportionPlugin(Plugin):
             attr.allocated.sub(event.task.resreq)
             self._update_share(attr)
 
-        ssn.add_event_handler(EventHandler(allocate_func=on_allocate, deallocate_func=on_deallocate))
+        def on_allocate_bulk(events) -> None:
+            # One dense sum per queue, one share recompute (state-equivalent to
+            # folding on_allocate over the events).
+            import numpy as np
+
+            rows_by_queue: Dict[str, list] = {}
+            for ev in events:
+                queue_uid = ssn.jobs[ev.task.job].queue
+                rows_by_queue.setdefault(queue_uid, []).append(ev.task.resreq)
+            for queue_uid, reqs in rows_by_queue.items():
+                attr = self.queue_attrs[queue_uid]
+                attr.allocated.add_array(
+                    np.sum([r.array for r in reqs], axis=0),
+                    any(r.has_scalars for r in reqs),
+                )
+                self._update_share(attr)
+
+        ssn.add_event_handler(
+            EventHandler(
+                allocate_func=on_allocate,
+                deallocate_func=on_deallocate,
+                bulk_allocate_func=on_allocate_bulk,
+            )
+        )
 
     def on_session_close(self, ssn) -> None:
         self.total_resource = None

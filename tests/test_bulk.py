@@ -1,0 +1,112 @@
+"""Bulk-commit equivalence: applying a fused placement via ``Session.bulk_apply``
+must end in the SAME state as the per-task ``ssn.allocate``/``ssn.pipeline`` loop
+(the two code paths in ``actions/allocate._run_fused``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import close_session, get_action, open_session
+from scheduler_tpu.harness import make_synthetic_cluster
+
+CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+"""
+
+
+def _run_cycle(bulk: bool, n_nodes=24, n_pods=120):
+    os.environ["SCHEDULER_TPU_BULK"] = "1" if bulk else "0"
+    try:
+        conf = parse_scheduler_conf(CONF)
+        cluster = make_synthetic_cluster(n_nodes, n_pods, tasks_per_job=6)
+        ssn = open_session(cluster.cache, conf.tiers)
+        get_action("allocate").execute(ssn)
+        state = _capture(ssn)
+        close_session(ssn)
+        cluster.cache.stop()
+        binds = dict(cluster.cache.binder.binds)
+        return state, binds
+    finally:
+        os.environ.pop("SCHEDULER_TPU_BULK", None)
+
+
+def _capture(ssn):
+    nodes = {
+        name: (
+            node.idle.array.copy(),
+            node.used.array.copy(),
+            node.releasing.array.copy(),
+            sorted(t.name for t in node.tasks.values()),
+        )
+        for name, node in ssn.nodes.items()
+    }
+    jobs = {
+        job.name: (
+            job.allocated.array.copy(),
+            {
+                int(status): sorted(t.name for t in tasks.values())
+                for status, tasks in job.task_status_index.items()
+            },
+        )
+        for job in ssn.jobs.values()
+    }
+    return nodes, jobs
+
+
+def test_bulk_apply_matches_sequential_commit():
+    (nodes_a, jobs_a), binds_a = _run_cycle(bulk=True)
+    (nodes_b, jobs_b), binds_b = _run_cycle(bulk=False)
+
+    assert binds_a == binds_b and binds_a  # same placements, non-empty
+    assert nodes_a.keys() == nodes_b.keys()
+    for name in nodes_a:
+        ia, ua, ra, ta = nodes_a[name]
+        ib, ub, rb, tb = nodes_b[name]
+        np.testing.assert_allclose(ia, ib, err_msg=f"idle mismatch on {name}")
+        np.testing.assert_allclose(ua, ub, err_msg=f"used mismatch on {name}")
+        np.testing.assert_allclose(ra, rb, err_msg=f"releasing mismatch on {name}")
+        assert ta == tb
+    assert jobs_a.keys() == jobs_b.keys()
+    for uid in jobs_a:
+        alloc_a, idx_a = jobs_a[uid]
+        alloc_b, idx_b = jobs_b[uid]
+        np.testing.assert_allclose(alloc_a, alloc_b, err_msg=f"allocated mismatch {uid}")
+        assert idx_a == idx_b, f"status index mismatch {uid}"
+
+
+def test_bulk_apply_fires_bulk_event_handlers():
+    """DRF shares after a bulk commit equal the per-event fold."""
+    from scheduler_tpu.framework.registry import get_plugin_builder
+
+    os.environ["SCHEDULER_TPU_BULK"] = "1"
+    try:
+        conf = parse_scheduler_conf(CONF)
+        cluster = make_synthetic_cluster(16, 64, tasks_per_job=4)
+        ssn = open_session(cluster.cache, conf.tiers)
+        get_action("allocate").execute(ssn)
+        drf = ssn.plugins["drf"]
+        for uid, job in ssn.jobs.items():
+            attr = drf.job_attrs[uid]
+            np.testing.assert_allclose(
+                attr.allocated.array,
+                job.allocated.array
+                + sum(
+                    (t.resreq.array for t in job.task_status_index.get(4, {}).values()),
+                    np.zeros_like(job.allocated.array),
+                ),
+                err_msg=f"drf allocated out of sync for {uid}",
+            )
+        close_session(ssn)
+        cluster.cache.stop()
+    finally:
+        os.environ.pop("SCHEDULER_TPU_BULK", None)

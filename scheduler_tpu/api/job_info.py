@@ -191,35 +191,38 @@ class JobInfo:
     def bulk_update_status(self, tasks: list, status: TaskStatus) -> None:
         """Batch ``update_task_status``: same bucket moves, but ONE aggregate
         update computed as a dense vector sum instead of per-task Resource ops.
-        Equivalent final state to calling update_task_status per task."""
+        Equivalent final state to calling update_task_status per task; the
+        aggregate applies BEFORE the index moves so a failed sufficiency
+        assertion leaves the job consistent."""
         if not tasks:
             return
-        import numpy as np
+        from scheduler_tpu.api.resource import sum_rows
 
         now_allocated = allocated_status(status)
+        resolved = []
         sub_rows = []
         add_rows = []
-        has_scalars = False
         for ti in tasks:
             task = self.tasks.get(ti.uid)
             if task is None:
                 raise KeyError(f"task {ti.uid} not in job {self.uid}")
-            self._delete_from_index(task)
             was_allocated = allocated_status(task.status)
             # sub-then-add of the same rows cancels when allocation-ness is
             # unchanged (e.g. Allocated -> Binding at dispatch) — skip it.
             if was_allocated and not now_allocated:
-                sub_rows.append(task.resreq.array)
+                sub_rows.append(task.resreq)
             elif now_allocated and not was_allocated:
-                add_rows.append(task.resreq.array)
-                has_scalars = has_scalars or task.resreq.has_scalars
+                add_rows.append(task.resreq)
+            resolved.append((ti, task))
+        if sub_rows:
+            self.allocated.sub_array(sum_rows(sub_rows)[0])
+        if add_rows:
+            self.allocated.add_array(*sum_rows(add_rows))
+        for ti, task in resolved:
+            self._delete_from_index(task)
             task.status = status
             ti.status = status
             self._add_to_index(task)
-        if sub_rows:
-            self.allocated.sub_array(np.sum(sub_rows, axis=0))
-        if add_rows:
-            self.allocated.add_array(np.sum(add_rows, axis=0), has_scalars)
 
     # -- gang arithmetic (job_info.go:367-418) ------------------------------
 

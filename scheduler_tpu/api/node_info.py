@@ -116,15 +116,18 @@ class NodeInfo:
 
         Tasks must already carry their final status; clones stored in
         ``self.tasks`` share request vectors (``TaskInfo.clone_shared``).
+        Arithmetic applies BEFORE any dict insert so a failed sufficiency
+        assertion leaves the node consistent (no half-registered batch).
         """
         if not tasks:
             return
-        import numpy as np
+        from scheduler_tpu.api.resource import sum_rows
 
         idle_sub = []
         rel_add = []
         rel_sub = []
         used_add = []
+        clones = []
         for task in tasks:
             if task.uid in self.tasks:
                 raise ValueError(
@@ -132,24 +135,25 @@ class NodeInfo:
                 )
             ti = task.clone_shared()
             if self.node is not None:
-                arr = ti.resreq.array
                 if ti.status == TaskStatus.RELEASING:
-                    rel_add.append(arr)
-                    idle_sub.append(arr)
+                    rel_add.append(ti.resreq)
+                    idle_sub.append(ti.resreq)
                 elif ti.status == TaskStatus.PIPELINED:
-                    rel_sub.append(arr)
+                    rel_sub.append(ti.resreq)
                 else:
-                    idle_sub.append(arr)
-                used_add.append(arr)
-            self.tasks[ti.uid] = ti
+                    idle_sub.append(ti.resreq)
+                used_add.append(ti.resreq)
+            clones.append(ti)
         if idle_sub:
-            self.idle.sub_array(np.sum(idle_sub, axis=0))
+            self.idle.sub_array(sum_rows(idle_sub)[0])
         if rel_add:
-            self.releasing.add_array(np.sum(rel_add, axis=0))
+            self.releasing.add_array(*sum_rows(rel_add))
         if rel_sub:
-            self.releasing.sub_array(np.sum(rel_sub, axis=0))
+            self.releasing.sub_array(sum_rows(rel_sub)[0])
         if used_add:
-            self.used.add_array(np.sum(used_add, axis=0))
+            self.used.add_array(*sum_rows(used_add))
+        for ti in clones:
+            self.tasks[ti.uid] = ti
 
     def remove_task(self, ti: TaskInfo) -> None:
         task = self.tasks.get(ti.uid)

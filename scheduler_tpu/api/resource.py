@@ -295,6 +295,15 @@ class ResourceVec:
         return ", ".join(parts)
 
 
+def sum_rows(reqs) -> Tuple[np.ndarray, bool]:
+    """Dense [R] sum + ORed has_scalars over ResourceVecs — THE way to fold a
+    batch of requests into one ``add_array``/``sub_array`` delta (keeps the
+    has_scalars propagation rule in one place)."""
+    rows = [r.array for r in reqs]
+    has_scalars = any(r.has_scalars for r in reqs)
+    return np.sum(rows, axis=0), has_scalars
+
+
 def share(allocated: float, total: float) -> float:
     """Fraction helper with 0-total convention (reference api/helpers Share):
     0/0 -> 0, x/0 -> 1."""

@@ -80,7 +80,9 @@ class SchedulerCache(Cache):
 
     def run(self) -> None:
         if self._async_io and self._io_pool is None:
-            self._io_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="cache-io")
+            self._io_pool = ThreadPoolExecutor(
+                max_workers=self._IO_WORKERS, thread_name_prefix="cache-io"
+            )
         self._running = True
 
     def stop(self) -> None:
@@ -315,9 +317,10 @@ class SchedulerCache(Cache):
             logger.exception("bind of %s to %s failed; resyncing", task.uid, hostname)
             self._resync_failed_bind(task, hostname)
 
-    # Binder RPCs per async chunk: small enough to keep the io pool's workers
-    # all busy on a big batch, large enough to amortize submission overhead.
+    # Upper bound on binder RPCs per async chunk; the actual chunk shrinks so a
+    # batch spreads across every io worker (chunk ~ N/workers, floor 16).
     _BIND_CHUNK = 256
+    _IO_WORKERS = 8
 
     def bind_bulk(self, tasks) -> None:
         """Batch ``bind``: one mutex hold, vectorized node/job accounting,
@@ -348,8 +351,9 @@ class SchedulerCache(Cache):
             for task, hostname in chunk:
                 self._bind_one(task, hostname)
 
-        for start in range(0, len(resolved), self._BIND_CHUNK):
-            self._submit_io(bind_chunk, resolved[start : start + self._BIND_CHUNK])
+        chunk_size = max(16, min(self._BIND_CHUNK, -(-len(resolved) // self._IO_WORKERS)))
+        for start in range(0, len(resolved), chunk_size):
+            self._submit_io(bind_chunk, resolved[start : start + chunk_size])
 
     def _resync_failed_bind(self, ti: TaskInfo, hostname: str) -> None:
         with self.mutex:
@@ -430,4 +434,6 @@ class SchedulerCache(Cache):
         """Drain pending async bind/evict IO (replaces sleeps in tests)."""
         if self._io_pool is not None:
             self._io_pool.shutdown(wait=True)
-            self._io_pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="cache-io")
+            self._io_pool = ThreadPoolExecutor(
+                max_workers=self._IO_WORKERS, thread_name_prefix="cache-io"
+            )

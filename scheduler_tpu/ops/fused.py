@@ -70,7 +70,7 @@ _KNOWN_JOB_ORDER = ("priority", "gang", "drf")
 
 @functools.partial(
     jax.jit,
-    static_argnames=("comparators", "weights", "enforce_pod_count"),
+    static_argnames=("comparators", "weights", "enforce_pod_count", "window"),
 )
 def fused_allocate(
     # node tensors (device units, node-bucket padded)
@@ -105,6 +105,7 @@ def fused_allocate(
     comparators: Tuple[str, ...],
     weights: Tuple[float, float, float],
     enforce_pod_count: bool,
+    window: int = 1,
 ):
     n = idle.shape[0]
     t_cap = resreq.shape[0]
@@ -155,7 +156,11 @@ def fused_allocate(
         sel = jnp.argmin(tb)
         return jnp.where(jnp.any(cand), sel, -1).astype(jnp.int32)
 
-    def body(state):
+    def micro_step(state):
+        """One maybe-select + place-one placement; the while body unrolls
+        ``window`` of these per iteration to amortize loop overhead (the
+        semantics are IDENTICAL to window=1 — this is pure unrolling; a
+        micro-step whose job pool is exhausted is a masked no-op)."""
         (idle, releasing, task_count, cursor, left, n_alloc, alloc,
          cur, out, steps) = state
 
@@ -226,9 +231,14 @@ def fused_allocate(
         return (idle, releasing, task_count, cursor, left, n_alloc, alloc,
                 cur, out, steps + 1)
 
+    def body(state):
+        for _ in range(window):
+            state = micro_step(state)
+        return state
+
     def cond(state):
         (_, _, _, cursor, left, _, _, cur, _, steps) = state
-        return ((cur >= 0) | jnp.any(eligible(cursor, left))) & (steps < t_cap + 1)
+        return ((cur >= 0) | jnp.any(eligible(cursor, left))) & (steps < t_cap + window)
 
     init = (
         idle,
@@ -380,6 +390,17 @@ class FusedAllocator:
 
     # -- run + decode --------------------------------------------------------
 
+    @staticmethod
+    def _window_size() -> int:
+        """Placements unrolled per while-loop step (pure unrolling — any value
+        gives identical results; higher amortizes loop overhead at the cost of
+        compile time).  NOTE: ranked/sorted batching (lexsort / top_k) is off
+        the table on this TPU stack — those ops hang the axon compiler — so the
+        scan stays one-task-at-a-time and speed comes from unrolling."""
+        import os
+
+        return max(1, int(os.environ.get("SCHEDULER_TPU_WINDOW", "8")))
+
     def run(self) -> Dict[str, List[Tuple[TaskInfo, Optional[str], bool, bool]]]:
         """Execute the fused kernel; returns per-job rows in placement order:
         [(task, node_name | None, pipelined, failed)] — same row shape as
@@ -390,6 +411,7 @@ class FusedAllocator:
                 comparators=self.comparators,
                 weights=self.weights,
                 enforce_pod_count=self.enforce_pod_count,
+                window=self._window_size(),
             )
         )
 

@@ -112,17 +112,14 @@ class DrfPlugin(Plugin):
         def on_allocate_bulk(events) -> None:
             # Vectorized form of folding on_allocate over events: one dense sum
             # per job, one share recompute.
-            import numpy as np
+            from scheduler_tpu.api.resource import sum_rows
 
             rows_by_job: Dict[str, list] = {}
             for ev in events:
                 rows_by_job.setdefault(ev.task.job, []).append(ev.task.resreq)
             for job_uid, reqs in rows_by_job.items():
                 attr = self.job_attrs[job_uid]
-                attr.allocated.add_array(
-                    np.sum([r.array for r in reqs], axis=0),
-                    any(r.has_scalars for r in reqs),
-                )
+                attr.allocated.add_array(*sum_rows(reqs))
                 self._update_share(attr)
 
         ssn.add_event_handler(

@@ -180,7 +180,7 @@ class ProportionPlugin(Plugin):
         def on_allocate_bulk(events) -> None:
             # One dense sum per queue, one share recompute (state-equivalent to
             # folding on_allocate over the events).
-            import numpy as np
+            from scheduler_tpu.api.resource import sum_rows
 
             rows_by_queue: Dict[str, list] = {}
             for ev in events:
@@ -188,10 +188,7 @@ class ProportionPlugin(Plugin):
                 rows_by_queue.setdefault(queue_uid, []).append(ev.task.resreq)
             for queue_uid, reqs in rows_by_queue.items():
                 attr = self.queue_attrs[queue_uid]
-                attr.allocated.add_array(
-                    np.sum([r.array for r in reqs], axis=0),
-                    any(r.has_scalars for r in reqs),
-                )
+                attr.allocated.add_array(*sum_rows(reqs))
                 self._update_share(attr)
 
         ssn.add_event_handler(

@@ -1,0 +1,5 @@
+"""``python -m scheduler_tpu`` == the scheduler daemon (cmd/kube-batch/main.go)."""
+
+from scheduler_tpu.cli import main
+
+main()

@@ -202,10 +202,16 @@ class JobInfo:
         resolved = []
         sub_rows = []
         add_rows = []
+        seen = set()
         for ti in tasks:
             task = self.tasks.get(ti.uid)
             if task is None:
                 raise KeyError(f"task {ti.uid} not in job {self.uid}")
+            if ti.uid in seen:
+                # A repeat in one batch is a no-op the second time (sequential
+                # update_task_status would see status already == target).
+                continue
+            seen.add(ti.uid)
             was_allocated = allocated_status(task.status)
             # sub-then-add of the same rows cancels when allocation-ness is
             # unchanged (e.g. Allocated -> Binding at dispatch) — skip it.

@@ -128,11 +128,13 @@ class NodeInfo:
         rel_sub = []
         used_add = []
         clones = []
+        batch_uids = set()
         for task in tasks:
-            if task.uid in self.tasks:
+            if task.uid in self.tasks or task.uid in batch_uids:
                 raise ValueError(
                     f"task {task.namespace}/{task.name} already on node {self.name}"
                 )
+            batch_uids.add(task.uid)
             ti = task.clone_shared()
             if self.node is not None:
                 if ti.status == TaskStatus.RELEASING:

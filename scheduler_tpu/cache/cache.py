@@ -56,6 +56,7 @@ class SchedulerCache(Cache):
         status_updater: Optional[StatusUpdater] = None,
         volume_binder: Optional[VolumeBinder] = None,
         async_io: bool = True,
+        io_workers: Optional[int] = None,
     ) -> None:
         self.scheduler_name = scheduler_name
         self.default_queue = default_queue
@@ -73,6 +74,8 @@ class SchedulerCache(Cache):
         self.volume_binder = volume_binder if volume_binder is not None else FakeVolumeBinder()
 
         self._async_io = async_io
+        if io_workers:
+            self._IO_WORKERS = io_workers  # per-instance override of the default
         self._io_pool: Optional[ThreadPoolExecutor] = None
         self._running = False
 

@@ -1,0 +1,237 @@
+"""Scheduler daemon entrypoint: ``python -m scheduler_tpu.cli``.
+
+Reference: ``cmd/kube-batch/main.go`` + ``cmd/kube-batch/app/server.go`` —
+flag parsing, action/plugin registration by import (main.go:36-41), the
+/metrics HTTP endpoint on --listen-address (server.go:96-99, plus /healthz per
+doc/design/metrics.md's liveness idea and /debug/threads as the pprof
+stand-in), optional leader election (server.go:111-152), then the scheduler
+loop.
+
+Cluster-state ingestion: with no API server to watch, state enters through the
+cache's event-handler methods.  The daemon can preload a cluster from a JSON
+file (--cluster-state) or mass-generate a synthetic one (--synthetic N,P) —
+the kubemark stand-in; a library embedder constructs SchedulerCache and calls
+add_pod/add_node/... directly.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import signal
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from scheduler_tpu.apis.objects import (
+    GROUP_NAME_ANNOTATION,
+    NodeSpec,
+    PodGroup,
+    PodSpec,
+    Queue,
+    Taint,
+    Toleration,
+)
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.options import ServerOption, option_from_namespace, register_options
+from scheduler_tpu.scheduler import Scheduler
+from scheduler_tpu.utils import metrics
+from scheduler_tpu.utils.leaderelection import LeaderElector
+
+logger = logging.getLogger("scheduler_tpu.cli")
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    cache: Optional[SchedulerCache] = None  # set by serve_metrics
+
+    def _respond(self, body: bytes, ctype: str, status: int = 200) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        if self.path.startswith("/metrics"):
+            self._respond(metrics.render_prometheus().encode(), "text/plain; version=0.0.4")
+        elif self.path.startswith("/healthz"):
+            self._respond(b"ok", "text/plain")
+        elif self.path.startswith("/debug/threads"):
+            # pprof stand-in (main.go:24-25): dump every thread's stack.
+            frames = sys._current_frames()
+            parts = []
+            for tid, frame in frames.items():
+                parts.append(f"--- thread {tid} ---\n")
+                parts.extend(traceback.format_stack(frame))
+            self._respond("".join(parts).encode(), "text/plain")
+        elif self.path.startswith("/api/queues") and self.cache is not None:
+            # Queue list for the kubectl-style CLI (pkg/cli/queue/list.go).
+            with self.cache.mutex:
+                rows = [
+                    {
+                        "name": q.name,
+                        "weight": q.weight,
+                        "jobs": sum(
+                            1 for j in self.cache.jobs.values() if j.queue == q.uid
+                        ),
+                    }
+                    for q in self.cache.queues.values()
+                ]
+            self._respond(json.dumps(rows).encode(), "application/json")
+        else:
+            self._respond(b"not found", "text/plain", 404)
+
+    def do_POST(self) -> None:  # noqa: N802
+        if self.path.startswith("/api/queues") and self.cache is not None:
+            # Queue create (pkg/cli/queue/create.go:46-68: name + weight).
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                spec = json.loads(self.rfile.read(length) or b"{}")
+                queue = Queue(
+                    name=spec["name"],
+                    weight=int(spec.get("weight", 1)),
+                    capability=spec.get("capability", {}),
+                )
+            except (ValueError, KeyError) as exc:
+                self._respond(f"bad queue spec: {exc}".encode(), "text/plain", 400)
+                return
+            self.cache.add_queue(queue)
+            self._respond(json.dumps({"name": queue.name}).encode(), "application/json", 201)
+        else:
+            self._respond(b"not found", "text/plain", 404)
+
+    def log_message(self, fmt: str, *args) -> None:  # quiet access log
+        logger.debug("http: " + fmt, *args)
+
+
+def serve_metrics(
+    listen_address: str, cache: Optional[SchedulerCache] = None
+) -> ThreadingHTTPServer:
+    """Start the /metrics (+ admin API) endpoint in a daemon thread
+    (server.go:96-99)."""
+    host, _, port = listen_address.rpartition(":")
+    handler = type("BoundMetricsHandler", (_MetricsHandler,), {"cache": cache})
+    server = ThreadingHTTPServer((host or "0.0.0.0", int(port)), handler)
+    threading.Thread(target=server.serve_forever, name="metrics-http", daemon=True).start()
+    return server
+
+
+def load_cluster_state(cache: SchedulerCache, path: str) -> None:
+    """Preload cluster state from a JSON file: {queues, nodes, podGroups, pods}."""
+    with open(path, "r") as f:
+        state = json.load(f)
+    for q in state.get("queues", []):
+        cache.add_queue(Queue(name=q["name"], weight=int(q.get("weight", 1)),
+                              capability=q.get("capability", {})))
+    for n in state.get("nodes", []):
+        cache.add_node(NodeSpec(
+            name=n["name"],
+            allocatable={k: float(v) for k, v in n.get("allocatable", {}).items()},
+            capacity={k: float(v) for k, v in n.get("capacity", n.get("allocatable", {})).items()},
+            labels=n.get("labels", {}),
+            taints=[Taint(**t) for t in n.get("taints", [])],
+            unschedulable=bool(n.get("unschedulable", False)),
+        ))
+    for g in state.get("podGroups", []):
+        pg = PodGroup(
+            name=g["name"], namespace=g.get("namespace", "default"),
+            queue=g.get("queue", ""), min_member=int(g.get("minMember", 1)),
+            min_resources=g.get("minResources"),
+        )
+        if g.get("phase"):
+            pg.status.phase = g["phase"]
+        cache.add_pod_group(pg)
+    for p in state.get("pods", []):
+        annotations = dict(p.get("annotations", {}))
+        if p.get("group"):
+            annotations[GROUP_NAME_ANNOTATION] = p["group"]
+        cache.add_pod(PodSpec(
+            name=p["name"], namespace=p.get("namespace", "default"),
+            containers=[{k: float(v) for k, v in c.items()} for c in p.get("containers", [])],
+            phase=p.get("phase", "Pending"),
+            node_name=p.get("nodeName", ""),
+            priority=int(p.get("priority", 0)),
+            labels=p.get("labels", {}),
+            annotations=annotations,
+            node_selector=p.get("nodeSelector", {}),
+            tolerations=[Toleration(**t) for t in p.get("tolerations", [])],
+            scheduler_name=p.get("schedulerName", cache.scheduler_name),
+        ))
+
+
+def run(opt: ServerOption, stop: Optional[threading.Event] = None,
+        cluster_state: Optional[str] = None,
+        synthetic: Optional[str] = None) -> None:
+    """app.Run equivalent (server.go:76-153)."""
+    register_options(opt)
+
+    if synthetic:
+        from scheduler_tpu.harness import make_synthetic_cluster
+
+        n_nodes, n_pods = (int(x) for x in synthetic.split(","))
+        cache = make_synthetic_cluster(n_nodes, n_pods).cache
+    else:
+        cache = SchedulerCache(
+            scheduler_name=opt.scheduler_name,
+            default_queue=opt.default_queue,
+            io_workers=opt.io_workers,
+        )
+        if cluster_state:
+            load_cluster_state(cache, cluster_state)
+
+    server = serve_metrics(opt.listen_address, cache)
+    sched = Scheduler(cache, opt.scheduler_conf, opt.schedule_period)
+    stop = stop or threading.Event()
+
+    def lead(stop_event: threading.Event) -> None:
+        sched.run(stop_event)
+
+    try:
+        if opt.enable_leader_election:
+            LeaderElector(opt.lock_file).run(lead, stop)
+        else:
+            lead(stop)
+    finally:
+        server.shutdown()
+        cache.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+
+    from scheduler_tpu.options import add_flags
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    parser = argparse.ArgumentParser(
+        prog="scheduler_tpu", description="TPU-native batch scheduler daemon"
+    )
+    add_flags(parser)
+    parser.add_argument(
+        "--cluster-state", default=None,
+        help="JSON file with initial cluster state (queues/nodes/podGroups/pods)",
+    )
+    parser.add_argument(
+        "--synthetic", default=None, metavar="NODES,PODS",
+        help="generate a synthetic cluster instead of loading state",
+    )
+    ns = parser.parse_args(argv)
+    opt = option_from_namespace(ns)
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame) -> None:
+        logger.info("signal %s: shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    run(opt, stop, cluster_state=ns.cluster_state, synthetic=ns.synthetic)
+
+
+if __name__ == "__main__":
+    main()

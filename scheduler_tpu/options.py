@@ -1,0 +1,101 @@
+"""CLI flags for the scheduler daemon.
+
+Reference: ``cmd/kube-batch/app/options/options.go`` — same knobs, same
+defaults (scheduler-name ``volcano`` :27, schedule-period 1s :28, default-queue
+``default`` :29, listen address ``:8080`` :31, leader election + lock namespace
+:40-50).  The kube API QPS/burst flags become the cache's io-worker knob — the
+binding backend here is the cache's async executor, not a rate-limited REST
+client.
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+DEFAULT_SCHEDULER_NAME = "volcano"
+DEFAULT_SCHEDULER_PERIOD = 1.0
+DEFAULT_QUEUE = "default"
+DEFAULT_LISTEN_ADDRESS = ":8080"
+DEFAULT_LOCK_FILE = "/tmp/scheduler_tpu-leader.lock"
+
+
+@dataclass
+class ServerOption:
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    scheduler_conf: Optional[str] = None
+    schedule_period: float = DEFAULT_SCHEDULER_PERIOD
+    default_queue: str = DEFAULT_QUEUE
+    listen_address: str = DEFAULT_LISTEN_ADDRESS
+    enable_leader_election: bool = False
+    lock_file: str = DEFAULT_LOCK_FILE
+    enable_priority_class: bool = True
+    io_workers: int = 8
+
+
+# The reference keeps a mutable global the cache reads back
+# (options.go:54 ServerOpts); preserved for the same wiring.
+ServerOpts: ServerOption = ServerOption()
+
+
+def register_options(opt: ServerOption) -> None:
+    global ServerOpts
+    ServerOpts = opt
+
+
+def add_flags(parser: argparse.ArgumentParser) -> None:
+    """options.go:63-81 equivalents."""
+    parser.add_argument(
+        "--scheduler-name", default=DEFAULT_SCHEDULER_NAME,
+        help="pods with this schedulerName are scheduled by this scheduler",
+    )
+    parser.add_argument(
+        "--scheduler-conf", default=None,
+        help="path to the YAML scheduler configuration (actions + plugin tiers)",
+    )
+    parser.add_argument(
+        "--schedule-period", default=DEFAULT_SCHEDULER_PERIOD, type=float,
+        help="seconds between scheduling cycles",
+    )
+    parser.add_argument(
+        "--default-queue", default=DEFAULT_QUEUE,
+        help="queue assigned to pod groups whose queue is unset",
+    )
+    parser.add_argument(
+        "--listen-address", default=DEFAULT_LISTEN_ADDRESS,
+        help="host:port for the /metrics + /healthz HTTP endpoint",
+    )
+    parser.add_argument(
+        "--leader-elect", action="store_true", default=False,
+        help="run active/standby with a lease lock; only the leader schedules",
+    )
+    parser.add_argument(
+        "--lock-file", default=DEFAULT_LOCK_FILE,
+        help="lease-lock path used for leader election",
+    )
+    parser.add_argument(
+        "--io-workers", default=8, type=int,
+        help="async bind/evict executor workers (the QPS/burst analogue)",
+    )
+
+
+def option_from_namespace(ns: argparse.Namespace) -> ServerOption:
+    """Map an ``add_flags`` namespace to a ServerOption (single source of truth
+    for the flag wiring — cli.main reuses this)."""
+    return ServerOption(
+        scheduler_name=ns.scheduler_name,
+        scheduler_conf=ns.scheduler_conf,
+        schedule_period=ns.schedule_period,
+        default_queue=ns.default_queue,
+        listen_address=ns.listen_address,
+        enable_leader_election=ns.leader_elect,
+        lock_file=ns.lock_file,
+        io_workers=ns.io_workers,
+    )
+
+
+def parse_options(argv: Optional[List[str]] = None) -> ServerOption:
+    parser = argparse.ArgumentParser(prog="scheduler_tpu")
+    add_flags(parser)
+    return option_from_namespace(parser.parse_args(argv))

@@ -1,0 +1,79 @@
+"""The scheduler loop: conf-ordered actions over periodic sessions.
+
+Reference: ``pkg/scheduler/scheduler.go`` — ``NewScheduler`` holds cache +
+actions + plugin tiers (:45-60), ``Run`` starts the cache and ticks
+``runOnce`` every schedule period (:63-86), and ``runOnce`` opens a session,
+executes each configured action with a latency metric, and closes (:88-102).
+Configuration is read once at ``run`` (no hot reload), like the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional
+
+import scheduler_tpu.actions  # noqa: F401  registry side effects (factory.go:29-35)
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.conf import SchedulerConfiguration, load_scheduler_conf
+from scheduler_tpu.framework import close_session, get_action, open_session
+from scheduler_tpu.framework.interface import Action
+from scheduler_tpu.utils import metrics
+
+logger = logging.getLogger("scheduler_tpu.scheduler")
+
+
+class Scheduler:
+    def __init__(
+        self,
+        cache,
+        scheduler_conf: Optional[str] = None,
+        schedule_period: float = 1.0,
+    ) -> None:
+        self.cache = cache
+        self.scheduler_conf = scheduler_conf
+        self.schedule_period = schedule_period
+        self.actions: List[Action] = []
+        self.conf: Optional[SchedulerConfiguration] = None
+
+    def _load_conf(self) -> None:
+        """scheduler.go:70-83: resolve the action list once, at startup."""
+        self.conf = load_scheduler_conf(self.scheduler_conf)
+        self.actions = [get_action(name) for name in self.conf.actions]
+
+    def run(self, stop: Optional[threading.Event] = None) -> None:
+        """Start the cache and tick run_once every period until ``stop`` is set
+        (the reference's ``wait.Until(runOnce, period)``, scheduler.go:85)."""
+        stop = stop or threading.Event()
+        self.cache.run()
+        self._load_conf()
+        logger.info(
+            "scheduler running: actions=%s period=%.3fs",
+            [a.name() for a in self.actions], self.schedule_period,
+        )
+        while not stop.is_set():
+            started = time.perf_counter()
+            try:
+                self.run_once()
+            except Exception:
+                logger.exception("scheduling cycle failed")
+            elapsed = time.perf_counter() - started
+            stop.wait(max(0.0, self.schedule_period - elapsed))
+
+    def run_once(self) -> None:
+        """One scheduling cycle (scheduler.go:88-102)."""
+        if self.conf is None:
+            self._load_conf()
+        start = time.perf_counter()
+        ssn = open_session(self.cache, self.conf.tiers)
+        try:
+            for action in self.actions:
+                action_start = time.perf_counter()
+                action.execute(ssn)
+                metrics.update_action_duration(
+                    action.name(), time.perf_counter() - action_start
+                )
+        finally:
+            close_session(ssn)
+        metrics.update_e2e_duration(time.perf_counter() - start)

@@ -1,0 +1,152 @@
+"""Lease-file leader election: the active/standby analogue.
+
+Reference: ``cmd/kube-batch/app/server.go:111-152`` — ConfigMap resource lock,
+LeaseDuration 15s / RenewDeadline 10s / RetryPeriod 5s (:49-51), process exits
+when leadership is lost (:147-149).  The authoritative store here is a lease
+file on shared disk instead of the API server: acquire by atomically writing
+(holder, deadline) when the current lease is absent/expired, renew by
+rewriting before the deadline.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+logger = logging.getLogger("scheduler_tpu.leaderelection")
+
+LEASE_DURATION = 15.0
+RENEW_DEADLINE = 10.0
+RETRY_PERIOD = 5.0
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        lock_file: str,
+        identity: Optional[str] = None,
+        lease_duration: float = LEASE_DURATION,
+        renew_deadline: float = RENEW_DEADLINE,
+        retry_period: float = RETRY_PERIOD,
+    ) -> None:
+        self.lock_file = lock_file
+        self.identity = identity or f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+
+    # -- lease file ---------------------------------------------------------
+
+    def _read(self) -> Optional[dict]:
+        try:
+            with open(self.lock_file, "r") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write(self) -> None:
+        """Atomic replace so a crashed writer never leaves a torn lease."""
+        tmp = f"{self.lock_file}.{self.identity}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"holder": self.identity, "renewed": time.time()}, f)
+        os.replace(tmp, self.lock_file)
+
+    def _other_holds_live_lease(self) -> bool:
+        lease = self._read()
+        return (
+            lease is not None
+            and lease.get("holder") != self.identity
+            and time.time() - float(lease.get("renewed", 0.0)) < self.lease_duration
+        )
+
+    def _try_acquire_or_renew(self) -> bool:
+        if self._other_holds_live_lease():
+            return False
+        lease = self._read()
+        if lease is not None and lease.get("holder") == self.identity:
+            self._write()  # uncontended renew of our own lease
+            return True
+        # Contended acquire (absent/expired lease): serialize the
+        # read-check-write through an O_CREAT|O_EXCL claim file so two
+        # standbys can't both observe "expired" and both lead (split brain).
+        claim = f"{self.lock_file}.claim"
+        try:
+            fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            # Another candidate is mid-acquire; break the claim only if its
+            # owner crashed (claim older than a full lease).
+            try:
+                if time.time() - os.path.getmtime(claim) > self.lease_duration:
+                    os.unlink(claim)
+            except OSError:
+                pass
+            return False
+        try:
+            os.close(fd)
+            if self._other_holds_live_lease():
+                return False  # lost the race to a lease written before our claim
+            self._write()
+            return True
+        finally:
+            try:
+                os.unlink(claim)
+            except OSError:
+                pass
+
+    # -- run loop (leaderelection.RunOrDie equivalent) -----------------------
+
+    def run(
+        self,
+        on_started_leading: Callable[[threading.Event], None],
+        stop: Optional[threading.Event] = None,
+    ) -> None:
+        """Block until leadership, run the workload, exit when the lease is
+        lost (server.go:140-151: OnStoppedLeading is fatal)."""
+        stop = stop or threading.Event()
+        while not stop.is_set():
+            if self._try_acquire_or_renew():
+                break
+            logger.info("standby: lease held by another scheduler; retrying")
+            stop.wait(self.retry_period)
+        if stop.is_set():
+            return
+
+        logger.info("leading as %s", self.identity)
+        lost = threading.Event()
+
+        def renew_loop() -> None:
+            while not stop.is_set() and not lost.is_set():
+                deadline = time.time() + self.renew_deadline
+                renewed = False
+                while time.time() < deadline:
+                    if self._try_acquire_or_renew():
+                        renewed = True
+                        break
+                    time.sleep(min(1.0, self.retry_period))
+                if not renewed:
+                    logger.error("leader election lost for %s", self.identity)
+                    lost.set()
+                    stop.set()
+                    return
+                stop.wait(self.retry_period)
+
+        renewer = threading.Thread(target=renew_loop, name="lease-renew", daemon=True)
+        renewer.start()
+        try:
+            on_started_leading(stop)
+        finally:
+            stop.set()
+            renewer.join(timeout=2.0)
+            # Release the lease if still ours so a standby takes over instantly.
+            lease = self._read()
+            if lease is not None and lease.get("holder") == self.identity:
+                try:
+                    os.unlink(self.lock_file)
+                except OSError:
+                    pass

@@ -1,0 +1,31 @@
+"""Queue admin API + CLI (reference cmd/cli/queue.go, pkg/cli/queue)."""
+
+from scheduler_tpu import cli, queue_cli
+from scheduler_tpu.cache import SchedulerCache
+from tests.fixtures import build_pod, build_pod_group, build_queue, make_vocab
+
+
+def test_queue_create_and_list_roundtrip(capsys):
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.add_queue(build_queue("default"))
+    cache.add_pod_group(build_pod_group("g", min_member=1, queue="default"))
+    cache.add_pod(build_pod(name="g-0", req={"cpu": 100}, groupname="g"))
+
+    server = cli.serve_metrics("127.0.0.1:0", cache)
+    try:
+        addr = f"http://127.0.0.1:{server.server_address[1]}"
+
+        out = queue_cli.queue_create(addr, "tenant-a", 4)
+        assert out == {"name": "tenant-a"}
+        assert cache.queues["tenant-a"].weight == 4
+
+        rows = {r["name"]: r for r in queue_cli.queue_list(addr)}
+        assert rows["tenant-a"]["weight"] == 4
+        assert rows["default"]["jobs"] == 1
+
+        assert queue_cli.main(["--server", addr, "create", "--name", "t2", "--weight", "2"]) == 0
+        assert queue_cli.main(["--server", addr, "list"]) == 0
+        captured = capsys.readouterr().out
+        assert "t2" in captured and "tenant-a" in captured
+    finally:
+        server.shutdown()

@@ -1,0 +1,161 @@
+"""Scheduler loop, daemon entrypoint, metrics endpoint, and leader election
+(reference scheduler.go:45-102, server.go:76-153)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.options import ServerOption, parse_options
+from scheduler_tpu.scheduler import Scheduler
+from scheduler_tpu.utils.leaderelection import LeaderElector
+from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
+
+
+def small_cache():
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.add_queue(build_queue("default"))
+    for i in range(3):
+        cache.add_node(build_node(f"n{i}", {"cpu": 4000, "memory": 8 * 1024**3}))
+    cache.add_pod_group(build_pod_group("g1", min_member=3))
+    for t in range(3):
+        cache.add_pod(build_pod(name=f"g1-{t}", req={"cpu": 1000, "memory": 1024**3},
+                                groupname="g1"))
+    return cache
+
+
+def test_run_once_schedules_the_example_gang(tmp_path):
+    conf = tmp_path / "conf.yaml"
+    conf.write_text(
+        """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+"""
+    )
+    cache = small_cache()
+    sched = Scheduler(cache, scheduler_conf=str(conf))
+    cache.run()
+    sched.run_once()
+    assert len(cache.binder.binds) == 3
+
+
+def test_run_loops_until_stopped():
+    cache = small_cache()
+    sched = Scheduler(cache, schedule_period=0.01)
+    stop = threading.Event()
+    t = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+    t.start()
+    deadline = time.time() + 5.0
+    while time.time() < deadline and len(cache.binder.binds) < 3:
+        time.sleep(0.02)
+    stop.set()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert len(cache.binder.binds) == 3  # default conf: enqueue,allocate,backfill
+
+
+def test_default_conf_loads_all_actions():
+    sched = Scheduler(small_cache())
+    sched._load_conf()
+    assert [a.name() for a in sched.actions] == ["enqueue", "allocate", "backfill"]
+
+
+def test_parse_options_defaults_match_reference():
+    opt = parse_options([])
+    assert opt.scheduler_name == "volcano"
+    assert opt.schedule_period == 1.0
+    assert opt.default_queue == "default"
+    assert opt.listen_address == ":8080"
+    assert not opt.enable_leader_election
+
+
+def test_cli_run_with_cluster_state_and_metrics(tmp_path):
+    from scheduler_tpu import cli
+
+    state = {
+        "queues": [{"name": "default", "weight": 1}],
+        "nodes": [
+            {"name": "n0", "allocatable": {"cpu": 4000, "memory": 8 * 1024**3, "pods": 110}},
+            {"name": "n1", "allocatable": {"cpu": 4000, "memory": 8 * 1024**3, "pods": 110},
+             "taints": [{"key": "dedicated", "value": "infra"}]},
+        ],
+        "podGroups": [{"name": "g", "minMember": 2, "queue": "default", "phase": "Inqueue"}],
+        "pods": [
+            {"name": "g-0", "group": "g", "containers": [{"cpu": 500, "memory": 1024**2}]},
+            {"name": "g-1", "group": "g", "containers": [{"cpu": 500, "memory": 1024**2}],
+             "tolerations": [{"key": "dedicated", "value": "infra"}]},
+        ],
+    }
+    path = tmp_path / "state.json"
+    path.write_text(json.dumps(state))
+
+    opt = ServerOption(schedule_period=0.01, listen_address="127.0.0.1:0")
+    # Port 0 won't round-trip through rpartition cleanly for the metric URL, so
+    # bind explicitly via the helper to learn the port.
+    server = cli.serve_metrics("127.0.0.1:0")
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5).read()
+        assert b"volcano_e2e_scheduling_latency_milliseconds" in body
+        health = urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=5).read()
+        assert health == b"ok"
+    finally:
+        server.shutdown()
+
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cli.load_cluster_state(cache, str(path))
+    cache.run()
+    sched = Scheduler(cache, schedule_period=0.01)
+    sched.run_once()
+    assert set(cache.binder.binds) == {"default/g-0", "default/g-1"}
+
+
+def test_leader_election_single_holder(tmp_path):
+    lock = str(tmp_path / "leader.lock")
+    order = []
+
+    def workload(name, hold):
+        def lead(stop_event):
+            order.append(name)
+            hold.wait()
+
+        return lead
+
+    stop_a = threading.Event()
+    hold_a = threading.Event()
+    a = LeaderElector(lock, identity="a", lease_duration=0.5, renew_deadline=0.3,
+                      retry_period=0.05)
+    ta = threading.Thread(target=a.run, args=(workload("a", hold_a), stop_a), daemon=True)
+    ta.start()
+    deadline = time.time() + 2.0
+    while time.time() < deadline and "a" not in order:
+        time.sleep(0.01)
+    assert order == ["a"]
+
+    # A second elector stays standby while the lease renews.
+    stop_b = threading.Event()
+    hold_b = threading.Event()
+    b = LeaderElector(lock, identity="b", lease_duration=0.5, renew_deadline=0.3,
+                      retry_period=0.05)
+    tb = threading.Thread(target=b.run, args=(workload("b", hold_b), stop_b), daemon=True)
+    tb.start()
+    time.sleep(0.7)
+    assert order == ["a"]
+
+    # Leader releases; standby takes over.
+    hold_a.set()
+    stop_a.set()
+    deadline = time.time() + 3.0
+    while time.time() < deadline and "b" not in order:
+        time.sleep(0.02)
+    assert order == ["a", "b"]
+    hold_b.set()
+    stop_b.set()
+    ta.join(timeout=2)
+    tb.join(timeout=2)

@@ -25,7 +25,7 @@ from scheduler_tpu.api.vocab import (
     MEMORY,
     MIN_MEMORY,
     MIN_MILLI_CPU,
-    MIN_MILLI_SCALAR,
+    MIN_SCALAR,
     DEFAULT_VOCAB,
     ResourceVocabulary,
 )
@@ -56,7 +56,7 @@ __all__ = [
     "MEMORY",
     "MIN_MEMORY",
     "MIN_MILLI_CPU",
-    "MIN_MILLI_SCALAR",
+    "MIN_SCALAR",
     "DEFAULT_VOCAB",
     "ResourceVocabulary",
 ]

@@ -7,12 +7,13 @@ index, so a ResourceVocabulary assigns each resource name a dimension:
 
 * dim 0: cpu (millicores)
 * dim 1: memory (bytes)
-* dim 2+: scalar resources (milli-units), append-only registration
+* dim 2+: scalar resources (RAW units, e.g. GPUs as 1.0), append-only registration
 
 The vocabulary also carries the per-dimension epsilon thresholds that reproduce the
-reference's comparison semantics (minMilliCPU=10, minMemory=10MiB,
-minMilliScalar=10 — ``resource_info.go:70-72``) so that gang counts can't drift
-between the host model and the device kernels.
+reference's comparison semantics (``resource_info.go:70-72``: minMilliCPU=10,
+minMemory=10MiB, minMilliScalar=10).  The reference stores scalars in
+milli-units (``MilliValue``), so its epsilon of 10 milli == 0.01 raw units here
+— same semantics, different unit convention.
 """
 
 from __future__ import annotations
@@ -28,7 +29,8 @@ MEMORY = 1
 
 MIN_MILLI_CPU = 10.0
 MIN_MEMORY = 10.0 * 1024 * 1024
-MIN_MILLI_SCALAR = 10.0
+# 10 milli-units in the reference's scalar convention = 0.01 raw units here.
+MIN_SCALAR = 10.0 / 1000.0
 
 
 class ResourceVocabulary:
@@ -66,7 +68,7 @@ class ResourceVocabulary:
             dim = len(self._names)
             self._index[name] = dim
             self._names.append(name)
-            self._mins.append(MIN_MILLI_SCALAR)
+            self._mins.append(MIN_SCALAR)
             self._mins_arr = np.asarray(self._mins, dtype=np.float64)
         return dim
 

@@ -143,9 +143,11 @@ class TestComparisons:
     def test_is_empty(self):
         assert vec(cpu=9, mem=MIN_MEMORY - 1).is_empty()
         assert not vec(cpu=10).is_empty()
+        # Scalars are RAW units with the reference's 10-milli epsilon == 0.01
+        # (the reference stores scalars via MilliValue; see api/vocab.py).
         vocab = ResourceVocabulary([GPU])
-        assert not ResourceVec.from_dict({GPU: 10}, vocab).is_empty()
-        assert ResourceVec.from_dict({GPU: 9}, vocab).is_empty()
+        assert not ResourceVec.from_dict({GPU: 0.01}, vocab).is_empty()
+        assert ResourceVec.from_dict({GPU: 0.009}, vocab).is_empty()
 
     def test_is_zero(self):
         r = vec(cpu=5, mem=MIN_MEMORY * 2)

@@ -1,0 +1,193 @@
+"""BASELINE.md scenario ladder at CI scale, driven through the full Scheduler
+loop (the reference's e2e suite shape: real actions + plugins over a fake-backed
+cache; test/e2e/job.go, queue.go, predicates.go, nodeorder.go scenarios)."""
+
+import numpy as np
+
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.harness import make_synthetic_cluster
+from scheduler_tpu.scheduler import Scheduler
+from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
+
+
+def run_cycles(cache, conf_text, tmp_path, cycles=1):
+    conf = tmp_path / "conf.yaml"
+    conf.write_text(conf_text)
+    sched = Scheduler(cache, scheduler_conf=str(conf))
+    cache.run()
+    for _ in range(cycles):
+        sched.run_once()
+    return sched
+
+
+# -- Scenario 1: example/job.yaml — 3-replica gang, 3 nodes, allocate+gang ----
+
+def test_scenario1_example_gang(tmp_path):
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.add_queue(build_queue("default"))
+    for i in range(3):
+        cache.add_node(build_node(f"n{i}", {"cpu": 2000, "memory": 4 * 1024**3}))
+    cache.add_pod_group(build_pod_group("qj", min_member=3))
+    for t in range(3):
+        cache.add_pod(build_pod(name=f"qj-{t}", req={"cpu": 1000, "memory": 1024**3},
+                                groupname="qj"))
+    run_cycles(cache, """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+""", tmp_path)
+    assert set(cache.binder.binds) == {"default/qj-0", "default/qj-1", "default/qj-2"}
+    # Gang of 3 × 1cpu across 3 × 2cpu nodes: every task binds somewhere legal.
+    hosts = set(cache.binder.binds.values())
+    assert hosts <= {"n0", "n1", "n2"}
+
+
+# -- Scenario 2: kubemark density — hollow nodes, predicates+nodeorder --------
+
+def test_scenario2_kubemark_density(tmp_path):
+    cluster = make_synthetic_cluster(100, 500, tasks_per_job=10)
+    run_cycles(cluster.cache, """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: predicates
+  - name: nodeorder
+""", tmp_path)
+    binds = cluster.cache.binder.binds
+    assert len(binds) == 500
+    # nodeorder's least-requested spreads the load: no node hogs the job.
+    per_node = {}
+    for host in binds.values():
+        per_node[host] = per_node.get(host, 0) + 1
+    assert len(per_node) >= 50, f"only {len(per_node)} nodes used"
+    assert max(per_node.values()) <= 30
+
+
+# -- Scenario 3: binpack + drf at density, mixed cpu/mem requests -------------
+
+def test_scenario3_binpack_drf(tmp_path):
+    cluster = make_synthetic_cluster(200, 2000, tasks_per_job=20)
+    run_cycles(cluster.cache, """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+""", tmp_path)
+    binds = cluster.cache.binder.binds
+    assert len(binds) == 2000
+    # binpack packs: substantially fewer nodes carry the load than spread would.
+    used_nodes = set(binds.values())
+    assert len(used_nodes) < 120, f"binpack used {len(used_nodes)} nodes"
+
+
+# -- Scenario 4: over-subscribed two-queue reclaim under proportion -----------
+
+def test_scenario4_two_queue_reclaim(tmp_path):
+    vocab = make_vocab()
+    cache = SchedulerCache(vocab=vocab, async_io=False)
+    cache.add_queue(build_queue("overfed", weight=1))
+    cache.add_queue(build_queue("starved", weight=1))
+    # Both dims fully contended (4x4cpu/4Gi, fat fills everything): proportion
+    # only yields victims whose queue stays >= deserved on EVERY dim
+    # (proportion.go:190), so an uncontended dim would veto all reclaim.
+    for i in range(4):
+        cache.add_node(build_node(f"n{i}", {"cpu": 4000, "memory": 4 * 1024**3}))
+    pods = {}
+    # overfed occupies the whole cluster with running pods.
+    cache.add_pod_group(build_pod_group("fat", queue="overfed", min_member=1))
+    for t in range(16):
+        pod = build_pod(
+            name=f"fat-{t}", req={"cpu": 1000, "memory": 1024**3}, groupname="fat",
+            nodename=f"n{t % 4}", phase="Running")
+        pods[f"default/fat-{t}"] = pod
+        cache.add_pod(pod)
+    # starved wants half the cluster.
+    cache.add_pod_group(build_pod_group("thin", queue="starved", min_member=1))
+    for t in range(8):
+        cache.add_pod(build_pod(
+            name=f"thin-{t}", req={"cpu": 1000, "memory": 1024**3}, groupname="thin"))
+
+    conf = tmp_path / "conf.yaml"
+    conf.write_text("""
+actions: "reclaim, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+  - name: proportion
+""")
+    sched = Scheduler(cache, scheduler_conf=str(conf))
+    cache.run()
+    # Reclaim evicts at most one task per starved JOB per cycle (reclaim.go:
+    # the popped job is never re-pushed), so convergence to the 50/50 deserved
+    # split takes several cycles, with evicted pods terminating in between
+    # (here: deleted from the cache, as the kubelet's delete event would).
+    terminated = 0
+    for _ in range(12):
+        sched.run_once()
+        for key in cache.evictor.evicts[terminated:]:
+            cache.delete_pod(pods[key])
+            terminated += 1
+
+    # proportion deserves a 50/50 split (reference test/e2e/queue.go:26).
+    assert len(cache.evictor.evicts) == 8, cache.evictor.evicts
+    assert all(e.startswith("default/fat-") for e in cache.evictor.evicts)
+    thin_binds = {k for k in cache.binder.binds if k.startswith("default/thin-")}
+    assert len(thin_binds) == 8, f"starved queue reached {len(thin_binds)}/8"
+
+
+# -- Scenario 5: topology-aware GPU gangs (affinity predicates) ---------------
+
+def test_scenario5_gpu_gangs_with_affinity(tmp_path):
+    vocab = make_vocab(("nvidia.com/gpu",))
+    cache = SchedulerCache(vocab=vocab, async_io=False)
+    cache.add_queue(build_queue("default"))
+    for i in range(8):
+        gpu = i < 4
+        alloc = {"cpu": 16000, "memory": 64 * 1024**3, "pods": 110}
+        if gpu:
+            alloc["nvidia.com/gpu"] = 8.0
+        node = build_node(f"n{i}", alloc)
+        node.labels["accelerator"] = "gpu" if gpu else "none"
+        cache.add_node(node)
+    # 8 gangs x 4 tasks, each task wants 2 GPUs and selects accelerator=gpu.
+    for j in range(8):
+        group = f"gpu-gang-{j}"
+        cache.add_pod_group(build_pod_group(group, min_member=4))
+        for t in range(4):
+            pod = build_pod(
+                name=f"{group}-{t}",
+                req={"cpu": 1000, "memory": 1024**3, "nvidia.com/gpu": 2.0},
+                groupname=group,
+                selector={"accelerator": "gpu"},
+            )
+            cache.add_pod(pod)
+    run_cycles(cache, """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: predicates
+  - name: nodeorder
+""", tmp_path)
+    binds = cache.binder.binds
+    # 4 GPU nodes x 8 GPUs / 2 per task = 16 schedulable tasks = 4 full gangs;
+    # the rest hold back (gang all-or-nothing), and nothing lands off-GPU.
+    assert len(binds) == 16, f"bound {len(binds)}"
+    assert set(binds.values()) <= {"n0", "n1", "n2", "n3"}
+    gangs_bound = {k.split("/")[1].rsplit("-", 1)[0] for k in binds}
+    assert len(gangs_bound) == 4
+    # GPU capacity respected: 4 tasks x 2 GPUs per chosen node.
+    per_node = {}
+    for host in binds.values():
+        per_node[host] = per_node.get(host, 0) + 2
+    assert all(v <= 8 for v in per_node.values())

@@ -59,9 +59,12 @@ def main() -> None:
     n_pods = int(os.environ.get("SCHEDULER_TPU_BENCH_PODS", 500 if smoke else 100_000))
     tasks_per_job = int(os.environ.get("SCHEDULER_TPU_BENCH_GANG", 100))
 
-    # Warmup at the same bucket shapes: same node count (fixes the node bucket)
-    # and one full-size gang (fixes the task bucket), tiny pod count.
-    one_cycle(n_nodes, min(tasks_per_job, n_pods), tasks_per_job)
+    # Warmup at the REAL shapes: the steady-state scheduler loop compiles once
+    # per (node-bucket, task-bucket) pair and re-runs every period, so the
+    # measured cycle must not pay the one-time XLA compile. A reduced-pod warmup
+    # misses the full-scale program's bucket and forces a ~10s recompile into
+    # the measured cycle; warm with the exact same problem instead.
+    one_cycle(n_nodes, n_pods, tasks_per_job)
 
     binds, elapsed = one_cycle(n_nodes, n_pods, tasks_per_job)
     if binds == 0:

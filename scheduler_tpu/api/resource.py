@@ -48,8 +48,11 @@ class ResourceVec:
     ) -> None:
         self.vocab = vocab if vocab is not None else DEFAULT_VOCAB
         if arr is None:
-            arr = np.zeros(self.vocab.size, dtype=np.float64)
-        self._arr = np.asarray(arr, dtype=np.float64)
+            self._arr = np.zeros(self.vocab.size, dtype=np.float64)
+            if has_scalars is None:
+                has_scalars = False
+        else:
+            self._arr = np.asarray(arr, dtype=np.float64)
         self.max_task_num = max_task_num
         # Mirrors "ScalarResources != nil" in the reference; inferred from content
         # when not stated explicitly.

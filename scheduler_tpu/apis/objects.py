@@ -7,8 +7,10 @@ that the reference gets from ``k8s.io/api/core/v1``.
 
 Resource quantities are plain ``{name: float}`` dicts in *canonical units*:
 ``cpu`` in millicores, ``memory`` in bytes, ``pods`` as a count, and every other
-(scalar) resource in milli-units — the same canonicalization the reference applies
-in ``NewResource`` (``pkg/scheduler/api/resource_info.go:75-93``).
+(scalar) resource in RAW units (e.g. GPUs as 1.0) — the reference canonicalizes
+scalars to milli-units in ``NewResource`` (``pkg/scheduler/api/resource_info.go:75-93``);
+here the vocabulary's epsilon carries the unit conversion instead
+(``api/vocab.py``: 10 milli == 0.01 raw).
 """
 
 from __future__ import annotations

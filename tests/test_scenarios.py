@@ -147,7 +147,7 @@ tiers:
 # -- Scenario 5: topology-aware GPU gangs (affinity predicates) ---------------
 
 def test_scenario5_gpu_gangs_with_affinity(tmp_path):
-    vocab = make_vocab(("nvidia.com/gpu",))
+    vocab = make_vocab("nvidia.com/gpu")
     cache = SchedulerCache(vocab=vocab, async_io=False)
     cache.add_queue(build_queue("default"))
     for i in range(8):

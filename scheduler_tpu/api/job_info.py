@@ -55,6 +55,7 @@ class TaskInfo:
         "priority",
         "pod",
         "volume_ready",
+        "req_sig_cache",
     )
 
     def __init__(self, pod: PodSpec, vocab: ResourceVocabulary) -> None:
@@ -69,10 +70,21 @@ class TaskInfo:
         self.priority: int = pod.priority
         self.pod: PodSpec = pod
         self.volume_ready: bool = False
+        self.req_sig_cache: Optional[bytes] = None
 
     @property
     def creation_timestamp(self) -> float:
         return self.pod.creation_timestamp
+
+    @property
+    def req_sig(self) -> bytes:
+        """Byte signature of (resreq, init_resreq) — the task-order tie-break
+        that groups identical requests so the device engine sees long runs."""
+        sig = self.req_sig_cache
+        if sig is None:
+            sig = self.resreq.array.tobytes() + self.init_resreq.array.tobytes()
+            self.req_sig_cache = sig
+        return sig
 
     def clone(self) -> "TaskInfo":
         t = self.clone_shared()
@@ -97,6 +109,7 @@ class TaskInfo:
         t.priority = self.priority
         t.pod = self.pod
         t.volume_ready = self.volume_ready
+        t.req_sig_cache = self.req_sig_cache
         return t
 
     def __repr__(self) -> str:
@@ -267,6 +280,15 @@ class JobInfo:
     # -- clone (job_info.go:295-329) ----------------------------------------
 
     def clone(self) -> "JobInfo":
+        """Status-isolated deep clone (job_info.go:295-329).
+
+        Tasks are cloned with SHARED request vectors (``TaskInfo.clone_shared``):
+        resreq/init_resreq are immutable after task creation (no mutating call
+        site exists), so sharing them is state-equivalent to the reference's
+        deep copy while skipping two vector copies per task.  The aggregates are
+        copied directly instead of re-summed per task — by construction they
+        equal the fold of ``add_task_info`` over the tasks.
+        """
         job = JobInfo(self.uid, self.vocab)
         job.name = self.name
         job.namespace = self.namespace
@@ -275,8 +297,17 @@ class JobInfo:
         job.min_available = self.min_available
         job.pod_group = self.pod_group
         job.creation_timestamp = self.creation_timestamp
+        index = job.task_status_index
+        tasks = job.tasks
         for task in self.tasks.values():
-            job.add_task_info(task.clone())
+            t = task.clone_shared()
+            tasks[t.uid] = t
+            bucket = index.get(t.status)
+            if bucket is None:
+                bucket = index[t.status] = {}
+            bucket[t.uid] = t
+        job.allocated = self.allocated.clone()
+        job.total_request = self.total_request.clone()
         return job
 
     def __repr__(self) -> str:

@@ -192,7 +192,9 @@ class NodeInfo:
         n.idle = self.idle.clone()
         n.used = self.used.clone()
         for task in self.tasks.values():
-            n.tasks[task.uid] = task.clone()
+            # Shared request vectors: immutable after task creation (see
+            # JobInfo.clone); only status isolation is needed.
+            n.tasks[task.uid] = task.clone_shared()
         return n
 
     def __repr__(self) -> str:

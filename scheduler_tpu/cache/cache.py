@@ -351,8 +351,28 @@ class SchedulerCache(Cache):
                 self.nodes[hostname].bulk_add_tasks(node_tasks)
 
         def bind_chunk(chunk) -> None:
-            for task, hostname in chunk:
-                self._bind_one(task, hostname)
+            from scheduler_tpu.cache.interface import BulkBindError
+
+            by_uid = {task.pod.uid: (task, hostname) for task, hostname in chunk}
+            failed_uids = set()
+            try:
+                self.binder.bind_bulk([(task.pod, hostname) for task, hostname in chunk])
+            except BulkBindError as e:
+                # Exactly these pods failed; the rest of the batch applied.
+                failed_uids = {pod.uid for pod, _ in e.failed}
+            except Exception:
+                # Unknown failure mode: assume nothing applied, resync all
+                # (cache.go:432-437 semantics — resync re-fetches truth).
+                logger.exception("bulk bind failed; resyncing chunk")
+                failed_uids = set(by_uid)
+            with self.mutex:
+                for task, hostname in chunk:
+                    if task.pod.uid not in failed_uids:
+                        task.pod.node_name = hostname
+            for uid in failed_uids:
+                task, hostname = by_uid[uid]
+                logger.error("bind of %s to %s failed; resyncing", task.uid, hostname)
+                self._resync_failed_bind(task, hostname)
 
         chunk_size = max(16, min(self._BIND_CHUNK, -(-len(resolved) // self._IO_WORKERS)))
         for start in range(0, len(resolved), chunk_size):

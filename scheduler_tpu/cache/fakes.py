@@ -9,22 +9,60 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import List
+from collections import deque
+from typing import Iterable, List
 
 from scheduler_tpu.cache.interface import Binder, Evictor, StatusUpdater, VolumeBinder
+
+
+class Channel:
+    """Minimal Go-channel stand-in: deque + condition with a batched put.
+
+    ``queue.Queue.put`` costs a lock round trip per item; ``put_many`` records a
+    whole bind batch under one lock, which matters at 100k binds/cycle.
+    """
+
+    def __init__(self) -> None:
+        self._items: deque = deque()
+        self._cond = threading.Condition()
+
+    def put(self, item) -> None:
+        with self._cond:
+            self._items.append(item)
+            self._cond.notify()
+
+    def put_many(self, items: Iterable) -> None:
+        with self._cond:
+            self._items.extend(items)
+            self._cond.notify_all()
+
+    def get(self, timeout: float = 3.0):
+        with self._cond:
+            if not self._cond.wait_for(lambda: bool(self._items), timeout=timeout):
+                raise queue.Empty
+            return self._items.popleft()
 
 
 class FakeBinder(Binder):
     def __init__(self) -> None:
         self.lock = threading.Lock()
         self.binds: dict = {}
-        self.channel: "queue.Queue[str]" = queue.Queue()
+        self.channel = Channel()
 
     def bind(self, pod, hostname: str) -> None:
         with self.lock:
             key = f"{pod.namespace}/{pod.name}"
             self.binds[key] = hostname
             self.channel.put(key)
+
+    def bind_bulk(self, pairs) -> None:
+        with self.lock:
+            keys = []
+            for pod, hostname in pairs:
+                key = f"{pod.namespace}/{pod.name}"
+                self.binds[key] = hostname
+                keys.append(key)
+            self.channel.put_many(keys)
 
     def wait(self, n: int, timeout: float = 3.0) -> List[str]:
         """Block until n binds were recorded (or raise queue.Empty)."""
@@ -35,7 +73,7 @@ class FakeEvictor(Evictor):
     def __init__(self) -> None:
         self.lock = threading.Lock()
         self.evicts: List[str] = []
-        self.channel: "queue.Queue[str]" = queue.Queue()
+        self.channel = Channel()
 
     def evict(self, pod) -> None:
         with self.lock:

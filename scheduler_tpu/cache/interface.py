@@ -17,9 +17,39 @@ if TYPE_CHECKING:
     from scheduler_tpu.apis.objects import PodGroupCondition, PodSpec
 
 
+class BulkBindError(Exception):
+    """Raised by ``Binder.bind_bulk`` when only part of a batch failed.
+
+    ``failed`` holds the ``(pod, hostname)`` pairs that did NOT bind; every
+    other pair in the batch is guaranteed applied.  This lets the cache resync
+    exactly the failed pods instead of reverting pods that are really bound.
+    """
+
+    def __init__(self, failed: list) -> None:
+        super().__init__(f"{len(failed)} binds failed")
+        self.failed = failed
+
+
 class Binder(abc.ABC):
     @abc.abstractmethod
     def bind(self, pod: "PodSpec", hostname: str) -> None: ...
+
+    def bind_bulk(self, pairs: list) -> None:
+        """Bind many ``(pod, hostname)`` pairs in one call.
+
+        Contract: either succeed for the whole batch, or raise
+        ``BulkBindError`` listing exactly the pairs that failed (any other
+        exception means the caller must assume NOTHING in the batch applied).
+        The default falls back to per-pod ``bind`` and collects failures.
+        """
+        failed = []
+        for pod, hostname in pairs:
+            try:
+                self.bind(pod, hostname)
+            except Exception:
+                failed.append((pod, hostname))
+        if failed:
+            raise BulkBindError(failed)
 
 
 class Evictor(abc.ABC):

@@ -52,9 +52,9 @@ class EventHandler:
 
     ``bulk_allocate_func`` is the TPU-native extension: when a whole device
     placement commits at once, a handler that provides it receives ONE call with
-    every event instead of a per-task loop, so plugins can update shares with
-    vectorized arithmetic.  Must be state-equivalent to folding allocate_func
-    over the same events.
+    the full ``List[TaskInfo]`` (no per-task Event wrappers), so plugins can
+    update shares with vectorized arithmetic.  Must be state-equivalent to
+    folding allocate_func over per-task Events for the same tasks.
     """
 
     allocate_func: Optional[Callable[[Event], None]] = None

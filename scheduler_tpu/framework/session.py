@@ -271,6 +271,11 @@ class Session:
         res = self.task_compare_fns(l, r)
         if res != 0:
             return res < 0
+        # Same tie-break chain as utils.scheduler_helper.task_sort_key so heap
+        # pops and sorted lists agree engine-to-engine (req-signature grouping
+        # is the device run-batching enabler; see task_sort_key).
+        if l.req_sig != r.req_sig:
+            return l.req_sig < r.req_sig
         if l.creation_timestamp == r.creation_timestamp:
             return l.uid < r.uid
         return l.creation_timestamp < r.creation_timestamp
@@ -357,11 +362,15 @@ class Session:
                 eh.deallocate_func(Event(task))
 
     def _fire_allocate_bulk(self, tasks: List[TaskInfo]) -> None:
-        events = [Event(t) for t in tasks]
+        events = None
         for eh in self.event_handlers:
             if eh.bulk_allocate_func is not None:
-                eh.bulk_allocate_func(events)
+                # Bulk handlers take the task list directly — no Event wrapper
+                # per task (100k wrappers/cycle otherwise).
+                eh.bulk_allocate_func(tasks)
             elif eh.allocate_func is not None:
+                if events is None:
+                    events = [Event(t) for t in tasks]
                 for ev in events:
                     eh.allocate_func(ev)
 

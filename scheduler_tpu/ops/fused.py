@@ -64,13 +64,17 @@ UNPLACED = -1
 FAILED = -2
 _PIPE_BASE = -3
 
+# Upper bound on placements per micro-step in the run-batched fast path.  Runs
+# longer than this just take multiple steps; keep it a power of two.
+MAX_BATCH = 128
+
 # Comparators the fused job-selection chain understands, keyed by plugin name.
 _KNOWN_JOB_ORDER = ("priority", "gang", "drf")
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("comparators", "weights", "enforce_pod_count", "window"),
+    static_argnames=("comparators", "weights", "enforce_pod_count", "window", "batch_runs"),
 )
 def fused_allocate(
     # node tensors (device units, node-bucket padded)
@@ -101,11 +105,15 @@ def fused_allocate(
     queue_has_jobs: jnp.ndarray,   # bool [Q] real queue
     # drf
     drf_total: jnp.ndarray,        # f32 [R] cluster totals (0 where absent)
+    # run-length batching
+    run_len: jnp.ndarray,          # i32 [T] consecutive identical-request tasks
+                                   #   starting here (within one job)
     *,
     comparators: Tuple[str, ...],
     weights: Tuple[float, float, float],
     enforce_pod_count: bool,
     window: int = 1,
+    batch_runs: bool = False,
 ):
     n = idle.shape[0]
     t_cap = resreq.shape[0]
@@ -194,21 +202,69 @@ def fused_allocate(
         pipe_here = placed & ~fit_idle[best] & fit_rel[best]
         failed = active & ~any_feasible
 
-        delta = jnp.zeros_like(idle).at[best].set(req)
-        idle = idle - delta * alloc_here
-        releasing = releasing - delta * pipe_here
-        task_count = task_count + ((jnp.arange(n) == best) & (alloc_here | pipe_here))
-
         cur_safe = jnp.clip(cur, 0, j_cap - 1)
-        consumed = (alloc_here | pipe_here | failed).astype(jnp.int32)
+
+        if batch_runs:
+            # Place a whole RUN of identical tasks on `best` in one step.
+            # Valid only under binpack-only scoring (see `_batch_runs_ok`):
+            # binpack's score of the chosen node is non-decreasing in
+            # placements while every other node's score is unchanged, so once
+            # `best` wins the (lowest-index-tie) argmax it stays the winner for
+            # the entire run — the sequential task-by-task scan provably picks
+            # the same node until the run ends or the node stops fitting.
+            deficit_v = job_deficit[cur_safe]
+            # Gang-break room: with no gang veto (deficit 0) the pop ends after
+            # every placement, so the batch must stay at 1.
+            room = jnp.where(deficit_v > 0, deficit_v - n_alloc[cur_safe], 1)
+            hi0 = jnp.minimum(run_len[t_idx], jnp.int32(MAX_BATCH))
+            hi0 = jnp.minimum(hi0, room)
+            if enforce_pod_count:
+                hi0 = jnp.minimum(hi0, pods_limit[best] - task_count[best])
+            hi0 = jnp.maximum(hi0, 1)
+
+            # Largest j such that the j-th sequential placement still fits:
+            # fit(init_req, idle[best] - (j-1)*req) with the exact epsilon
+            # rule — binary search, invariant ok(lo) (ok(1) == fit_idle[best]).
+            idle_b = idle[best]
+
+            def ok(j):
+                avail = idle_b - (j - 1).astype(idle.dtype) * req
+                return jnp.all((init_req < avail) | (jnp.abs(avail - init_req) < mins))
+
+            lo = jnp.int32(1)
+            hi = hi0
+            for _ in range(MAX_BATCH.bit_length()):
+                mid = (lo + hi + 1) // 2
+                good = ok(mid) & (mid <= hi)
+                lo = jnp.where(good, mid, lo)
+                hi = jnp.where(good, hi, jnp.minimum(hi, mid - 1))
+            m = jnp.where(alloc_here, lo, 1)
+        else:
+            m = jnp.int32(1)
+
+        delta = jnp.zeros_like(idle).at[best].set(req)
+        idle = idle - delta * (alloc_here * m.astype(idle.dtype))
+        releasing = releasing - delta * pipe_here
+        task_count = task_count + (
+            (jnp.arange(n) == best) & (alloc_here | pipe_here)
+        ) * jnp.where(alloc_here, m, 1)
+
+        consumed = jnp.where(
+            alloc_here, m, (pipe_here | failed).astype(jnp.int32)
+        )
         cursor = cursor.at[cur_safe].add(jnp.where(active, consumed, 0))
         n_alloc = n_alloc.at[cur_safe].add(
-            jnp.where(active & alloc_here, 1, 0)
+            jnp.where(active & alloc_here, m, 0)
         )
         # DRF shares grow on every placement — pipeline fires the allocate
         # event too (session.go:199-239 -> drf.go:135-144).
         alloc = alloc.at[cur_safe].add(
-            jnp.where(active & (alloc_here | pipe_here), req, 0.0)
+            jnp.where(
+                active & (alloc_here | pipe_here),
+                jnp.where(alloc_here, m, 1).astype(alloc.dtype),
+                0.0,
+            )
+            * req
         )
         left = left.at[cur_safe].set(
             jnp.where(active, left[cur_safe] | failed, left[cur_safe])
@@ -219,7 +275,17 @@ def fused_allocate(
             jnp.where(pipe_here, _PIPE_BASE - best.astype(jnp.int32),
                       jnp.where(failed, FAILED, UNPLACED)),
         )
-        out = out.at[t_idx].set(jnp.where(active, code, out[t_idx]))
+        if batch_runs:
+            # Write `consumed` copies of the code starting at t_idx (the whole
+            # run shares one node).  `out` is padded by MAX_BATCH so the slice
+            # never clamps/shifts at the tail.
+            window_slice = jax.lax.dynamic_slice(out, (t_idx,), (MAX_BATCH,))
+            wmask = jnp.arange(MAX_BATCH) < jnp.where(active, consumed, 0)
+            out = jax.lax.dynamic_update_slice(
+                out, jnp.where(wmask, code, window_slice), (t_idx,)
+            )
+        else:
+            out = out.at[t_idx].set(jnp.where(active, code, out[t_idx]))
 
         became_ready = (alloc_here | pipe_here) & (
             n_alloc[cur_safe] >= job_deficit[cur_safe]
@@ -249,11 +315,12 @@ def fused_allocate(
         jnp.zeros(j_cap, dtype=jnp.int32),
         job_alloc_init,
         jnp.asarray(-1, dtype=jnp.int32),
-        jnp.full(t_cap, UNPLACED, dtype=jnp.int32),
+        # Padded by MAX_BATCH so the run write-window never clamps at the tail.
+        jnp.full(t_cap + MAX_BATCH, UNPLACED, dtype=jnp.int32),
         jnp.zeros((), dtype=jnp.int32),
     )
     final = jax.lax.while_loop(cond, body, init)
-    return final[8]
+    return final[8][:t_cap]
 
 
 class FusedAllocator:
@@ -335,7 +402,33 @@ class FusedAllocator:
 
         total = st.nodes.allocatable.sum(axis=0)
 
+        # Run lengths: consecutive tasks (within one job) with identical
+        # request rows, counted from each position — the device batches a whole
+        # run per placement step under binpack-only scoring.
+        t_count = len(flat)
+        run_host = np.ones(tb, dtype=np.int32)
+        if t_count > 1:
+            res = st.tasks.resreq[:t_count]
+            initr = st.tasks.init_resreq[:t_count]
+            same = np.all(res[1:] == res[:-1], axis=1) & np.all(
+                initr[1:] == initr[:-1], axis=1
+            )
+            job_starts = np.zeros(t_count, dtype=bool)
+            real = nums[:j] > 0
+            job_starts[offsets[:j][real]] = True
+            same &= ~job_starts[1:]
+            gid = np.concatenate(([0], np.cumsum(~same)))
+            counts = np.bincount(gid)
+            ends = np.cumsum(counts) - 1
+            run_host[:t_count] = (ends[gid] - np.arange(t_count) + 1).astype(np.int32)
+
         self.weights = score_weights(ssn)
+        # Run batching is exact only when the chosen node's score cannot drop
+        # below a competitor's mid-run: true for binpack alone (non-decreasing
+        # on the chosen node, static elsewhere).
+        self.batch_runs = (
+            self.weights[0] == 0.0 and self.weights[1] == 0.0 and self.weights[2] > 0.0
+        )
         self.comparators = tuple(
             name
             for tier in ssn.tiers
@@ -366,6 +459,7 @@ class FusedAllocator:
             jnp.asarray(queue_rank),
             jnp.asarray(queue_has),
             jnp.asarray(scale_columns(total[None, :], scale)[0]),
+            jnp.asarray(run_host),
         )
 
     # -- capability probe ----------------------------------------------------
@@ -412,23 +506,28 @@ class FusedAllocator:
                 weights=self.weights,
                 enforce_pod_count=self.enforce_pod_count,
                 window=self._window_size(),
+                batch_runs=self.batch_runs,
             )
         )
 
+        # One bulk conversion: per-element int(ndarray[i]) costs ~100x a list
+        # element access at this scale.
+        codes = encoded.tolist()
+        node_names = self.node_names
         out: Dict[str, List[Tuple[TaskInfo, Optional[str], bool, bool]]] = {}
         base = 0
         for job, rows in zip(self.jobs, self.job_rows):
             decoded: List[Tuple[TaskInfo, Optional[str], bool, bool]] = []
             for i, task in enumerate(rows):
-                code = int(encoded[base + i])
+                code = codes[base + i]
                 if code == UNPLACED:
                     continue
                 if code == FAILED:
                     decoded.append((task, None, False, True))
                 elif code <= _PIPE_BASE:
-                    decoded.append((task, self.node_names[_PIPE_BASE - code], True, False))
+                    decoded.append((task, node_names[_PIPE_BASE - code], True, False))
                 else:
-                    decoded.append((task, self.node_names[code], False, False))
+                    decoded.append((task, node_names[code], False, False))
             out[job.uid] = decoded
             base += len(rows)
         return out

@@ -109,14 +109,14 @@ class DrfPlugin(Plugin):
             attr.allocated.sub(event.task.resreq)
             self._update_share(attr)
 
-        def on_allocate_bulk(events) -> None:
-            # Vectorized form of folding on_allocate over events: one dense sum
-            # per job, one share recompute.
+        def on_allocate_bulk(tasks) -> None:
+            # Vectorized form of folding on_allocate over the tasks: one dense
+            # sum per job, one share recompute.
             from scheduler_tpu.api.resource import sum_rows
 
             rows_by_job: Dict[str, list] = {}
-            for ev in events:
-                rows_by_job.setdefault(ev.task.job, []).append(ev.task.resreq)
+            for task in tasks:
+                rows_by_job.setdefault(task.job, []).append(task.resreq)
             for job_uid, reqs in rows_by_job.items():
                 attr = self.job_attrs[job_uid]
                 attr.allocated.add_array(*sum_rows(reqs))

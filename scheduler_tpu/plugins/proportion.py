@@ -177,15 +177,15 @@ class ProportionPlugin(Plugin):
             attr.allocated.sub(event.task.resreq)
             self._update_share(attr)
 
-        def on_allocate_bulk(events) -> None:
+        def on_allocate_bulk(tasks) -> None:
             # One dense sum per queue, one share recompute (state-equivalent to
-            # folding on_allocate over the events).
+            # folding on_allocate over the tasks).
             from scheduler_tpu.api.resource import sum_rows
 
             rows_by_queue: Dict[str, list] = {}
-            for ev in events:
-                queue_uid = ssn.jobs[ev.task.job].queue
-                rows_by_queue.setdefault(queue_uid, []).append(ev.task.resreq)
+            for task in tasks:
+                queue_uid = ssn.jobs[task.job].queue
+                rows_by_queue.setdefault(queue_uid, []).append(task.resreq)
             for queue_uid, reqs in rows_by_queue.items():
                 attr = self.queue_attrs[queue_uid]
                 attr.allocated.add_array(*sum_rows(reqs))

@@ -104,6 +104,12 @@ def task_sort_key(ssn) -> Callable:
         res = ssn.task_compare_fns(l, r)
         if res != 0:
             return res
+        # Deterministic tie-break among plugin-equal tasks.  The reference's
+        # heap breaks such ties arbitrarily (util/priority_queue.go), so any
+        # total order is within spec; grouping identical requests first lets
+        # the device engine batch whole runs per placement step.
+        if l.req_sig != r.req_sig:
+            return -1 if l.req_sig < r.req_sig else 1
         if l.creation_timestamp != r.creation_timestamp:
             return -1 if l.creation_timestamp < r.creation_timestamp else 1
         return -1 if l.uid < r.uid else (1 if l.uid > r.uid else 0)

@@ -50,6 +50,46 @@ def _fused_enabled() -> bool:
     return os.environ.get("SCHEDULER_TPU_FUSED", "1") not in ("0", "false")
 
 
+def collect_candidates(ssn) -> List[JobInfo]:
+    """Jobs eligible for this allocate pass (the allocate.go:49-72 filter):
+    skip PodGroup-Pending jobs, JobValid vetoes, and jobs whose queue is gone."""
+    candidates: List[JobInfo] = []
+    for job in ssn.jobs.values():
+        if job.pod_group is not None and job.pod_group.status.phase == PodGroupPhase.PENDING:
+            continue
+        vr = ssn.job_valid(job)
+        if vr is not None and not vr.passed:
+            logger.debug("job %s skips allocate: %s", job.uid, vr.message)
+            continue
+        if job.queue not in ssn.queues:
+            logger.warning("skip job %s: queue %s not found", job.uid, job.queue)
+            continue
+        candidates.append(job)
+    return candidates
+
+
+def apply_fused_results(ssn, candidates: List[JobInfo], results) -> None:
+    """Commit a fused-engine run to the session: record FitErrors for failed
+    rows, apply placements (bulk by default, per-row when SCHEDULER_TPU_BULK=0)."""
+    bulk = os.environ.get("SCHEDULER_TPU_BULK", "1") not in ("0", "false")
+    placements = []
+    for job in candidates:
+        for task, node_name, pipelined, failed in results.get(job.uid, []):
+            if failed:
+                fe = FitErrors()
+                fe.set_node_error("*", FitError(task.name, "*", NODE_RESOURCE_FIT_FAILED))
+                job.nodes_fit_errors[task.uid] = fe
+                break
+            if bulk:
+                placements.append((task, node_name, pipelined))
+            elif pipelined:
+                ssn.pipeline(task, node_name)
+            else:
+                ssn.allocate(task, node_name)
+    if bulk:
+        ssn.bulk_apply(placements)
+
+
 class AllocateAction(Action):
     def name(self) -> str:
         return "allocate"
@@ -58,23 +98,20 @@ class AllocateAction(Action):
         queues = PriorityQueue(ssn.queue_order_fn)
         jobs_map: Dict[str, PriorityQueue] = {}
 
-        candidates: List[JobInfo] = []
-        for job in ssn.jobs.values():
-            if job.pod_group is not None and job.pod_group.status.phase == PodGroupPhase.PENDING:
-                continue
-            vr = ssn.job_valid(job)
-            if vr is not None and not vr.passed:
-                logger.debug("job %s skips allocate: %s", job.uid, vr.message)
-                continue
-            queue = ssn.queues.get(job.queue)
-            if queue is None:
-                logger.warning("skip job %s: queue %s not found", job.uid, job.queue)
-                continue
-            # The reference pushes the queue once per job — duplicates drive the
-            # round-robin rotation (allocate.go:58-63).
-            queues.push(queue)
-            jobs_map.setdefault(job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
-            candidates.append(job)
+        candidates = collect_candidates(ssn)
+        for job in candidates:
+            # One heap entry per queue. The reference pushes one copy per job
+            # (allocate.go:58-63); with a live comparator (proportion shares
+            # mutate between pops) the stale duplicate copies make pop order
+            # heap-implementation-defined.  A single copy pins the intended
+            # semantic — pop the least-share queue — and keeps the heap
+            # consistent: the only key that mutates belongs to the queue
+            # currently outside the heap (it re-sifts on re-push).  The
+            # rotation is driven by the re-push after every job pop instead.
+            if job.queue not in jobs_map:
+                queues.push(ssn.queues[job.queue])
+                jobs_map[job.queue] = PriorityQueue(ssn.job_order_fn)
+            jobs_map[job.queue].push(job)
 
         logger.debug("allocating over %d queues", len(jobs_map))
 
@@ -146,24 +183,7 @@ class AllocateAction(Action):
         from scheduler_tpu.ops.fused import FusedAllocator
 
         engine = FusedAllocator(ssn, candidates)
-        results = engine.run()
-        bulk = os.environ.get("SCHEDULER_TPU_BULK", "1") not in ("0", "false")
-        placements = []
-        for job in candidates:
-            for task, node_name, pipelined, failed in results.get(job.uid, []):
-                if failed:
-                    fe = FitErrors()
-                    fe.set_node_error("*", FitError(task.name, "*", NODE_RESOURCE_FIT_FAILED))
-                    job.nodes_fit_errors[task.uid] = fe
-                    break
-                if bulk:
-                    placements.append((task, node_name, pipelined))
-                elif pipelined:
-                    ssn.pipeline(task, node_name)
-                else:
-                    ssn.allocate(task, node_name)
-        if bulk:
-            ssn.bulk_apply(placements)
+        apply_fused_results(ssn, candidates, engine.run())
 
     # -- device engine -------------------------------------------------------
 

@@ -85,6 +85,11 @@ class Session:
         self.device_weighted_plugins: set = set()
         # Dynamic (in-scan) gates a plugin turned on, e.g. "pod_count".
         self.device_dynamic_gates: set = set()
+        # Queue fair-share tensors for the fused engine: plugin name ->
+        # builder(queue_uids) -> {"deserved": [Q, R], "allocated": [Q, R]}
+        # raw-unit numpy arrays (proportion registers this so its live queue
+        # ordering + overused gating can run inside the device while-loop).
+        self.device_queue_fair: Dict[str, Callable] = {}
 
     # -- registration (Add*Fn) ----------------------------------------------
 
@@ -141,6 +146,9 @@ class Session:
 
     def add_device_scorer(self, name: str, builder: Callable) -> None:
         self.device_scorers[name] = builder
+
+    def add_device_queue_fair(self, name: str, builder: Callable) -> None:
+        self.device_queue_fair[name] = builder
 
     # -- tiered dispatch ------------------------------------------------------
 

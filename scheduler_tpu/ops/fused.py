@@ -10,7 +10,10 @@ every step
   1. keeps the current job pop going, or — when the pop ended (first infeasible
      task, gang-ready break, or drained tail) — re-selects the next (queue, job)
      by the live plugin ordering semantics:
-       queue:  static creation/uid rank (v1: no proportion share ordering)
+       queue:  proportion share order + overused gate when proportion is
+               active (shares carried live on device, updated every placement
+               like proportion's allocate handler, proportion.go:236-246);
+               creation/uid rank as the fallback/tiebreak
        job:    first-nonzero comparator chain in tier order, vectorized as a
                masked lexicographic argmin over [J] key vectors —
                priority (higher first, priority.go:61-79),
@@ -64,6 +67,11 @@ UNPLACED = -1
 FAILED = -2
 _PIPE_BASE = -3
 
+# `cur` sentinel: all remaining queues are overused -> the action is over.
+# Distinct from every result code and from the -1 "re-select" sentinel so the
+# two encodings can never be conflated.
+HALT = -100
+
 # Upper bound on placements per micro-step in the run-batched fast path.  Runs
 # longer than this just take multiple steps; keep it a power of two.
 MAX_BATCH = 128
@@ -74,7 +82,10 @@ _KNOWN_JOB_ORDER = ("priority", "gang", "drf")
 
 @functools.partial(
     jax.jit,
-    static_argnames=("comparators", "weights", "enforce_pod_count", "window", "batch_runs"),
+    static_argnames=(
+        "comparators", "queue_comparators", "overused_gate", "weights",
+        "enforce_pod_count", "window", "batch_runs",
+    ),
 )
 def fused_allocate(
     # node tensors (device units, node-bucket padded)
@@ -103,6 +114,9 @@ def fused_allocate(
     # queue tensors
     queue_rank: jnp.ndarray,       # i32 [Q] creation/uid rank
     queue_has_jobs: jnp.ndarray,   # bool [Q] real queue
+    # proportion fair-share tensors (zero rows when proportion isn't fused)
+    queue_deserved: jnp.ndarray,   # f32 [Q, R] water-filled deserved share
+    queue_alloc_init: jnp.ndarray, # f32 [Q, R] allocated at session open
     # drf
     drf_total: jnp.ndarray,        # f32 [R] cluster totals (0 where absent)
     # run-length batching
@@ -110,6 +124,8 @@ def fused_allocate(
                                    #   starting here (within one job)
     *,
     comparators: Tuple[str, ...],
+    queue_comparators: Tuple[str, ...] = (),
+    overused_gate: bool = False,
     weights: Tuple[float, float, float],
     enforce_pod_count: bool,
     window: int = 1,
@@ -121,6 +137,7 @@ def fused_allocate(
     neg_inf = jnp.float32(-jnp.inf)
     pos_inf = jnp.float32(jnp.inf)
     big_i32 = jnp.int32(2**31 - 1)
+    track_queue_alloc = bool(queue_comparators) or overused_gate
 
     total_safe = jnp.where(drf_total > 0, drf_total, 1.0)
     total_mask = drf_total > 0
@@ -128,16 +145,41 @@ def fused_allocate(
     def eligible(cursor, left):
         return (~left) & (cursor < job_task_num)
 
-    def select_job(cursor, left, n_alloc, alloc):
+    def select_job(cursor, left, n_alloc, alloc, q_alloc):
         elig = eligible(cursor, left)
-        # Queue pop: lowest-rank queue that still has an eligible job
-        # (static fallback order; matches the host heap's creation/uid order).
+        # Queue pop: queues holding an eligible job, minus overused ones
+        # (checked live at every pop like the host loop, allocate.go:101),
+        # ordered by the queue comparator chain then creation/uid rank.
         q_has = (
             jax.ops.segment_sum(elig.astype(jnp.int32), job_queue,
                                 num_segments=queue_rank.shape[0]) > 0
         ) & queue_has_jobs
-        q_keys = jnp.where(q_has, queue_rank, big_i32)
-        q_star = jnp.argmin(q_keys)
+        if overused_gate:
+            # proportion Overused == deserved.less_equal(allocated): per dim
+            # (d < a) | (|a - d| < eps), all dims (proportion.go:198-209) —
+            # algebraically identical to d - a < eps (single compare).
+            le = (queue_deserved - q_alloc) < mins[None, :]
+            q_has = q_has & ~jnp.all(le, axis=-1)
+        cand_q = q_has
+        for qname in queue_comparators:
+            if qname == "proportion":
+                # share = max over included dims of allocated/deserved, with
+                # the 0-total convention (helpers Share: 0/0 -> 0, x/0 -> 1);
+                # scalar dims with deserved == 0 are excluded from the max
+                # (resource_names semantics), i.e. contribute 0.
+                d = queue_deserved
+                frac = jnp.where(d > 0, q_alloc / jnp.where(d > 0, d, 1.0), 0.0)
+                cpumem = jnp.arange(d.shape[1]) < 2
+                frac = jnp.where(
+                    (d <= 0) & cpumem[None, :] & (q_alloc > 0), 1.0, frac
+                )
+                qkey = jnp.max(frac, axis=-1)
+            else:  # pragma: no cover - guarded by `supported`
+                raise ValueError(f"unknown queue comparator {qname}")
+            masked_q = jnp.where(cand_q, qkey, pos_inf)
+            cand_q = cand_q & (masked_q == jnp.min(masked_q))
+        q_star = jnp.argmin(jnp.where(cand_q, queue_rank, big_i32))
+        any_queue = jnp.any(q_has)
         cand = elig & (job_queue == q_star)
 
         # First-nonzero comparator chain == lexicographic masked argmin.
@@ -162,7 +204,15 @@ def fused_allocate(
 
         tb = jnp.where(cand, job_tiebreak, big_i32)
         sel = jnp.argmin(tb)
-        return jnp.where(jnp.any(cand), sel, -1).astype(jnp.int32)
+        # HALT: no selectable queue — everything drained, or eligible jobs
+        # remain only in overused queues (the host loop would skip those queue
+        # pops forever; overused is monotone during allocate since allocated
+        # only grows, so the action is over).  Guard on any_queue FIRST: with
+        # cand_q all-False the argmin over all-sentinel keys returns 0, and
+        # q0's eligible jobs would otherwise be spuriously selected.
+        return jnp.where(
+            any_queue & jnp.any(cand), sel, HALT
+        ).astype(jnp.int32)
 
     def micro_step(state):
         """One maybe-select + place-one placement; the while body unrolls
@@ -170,14 +220,15 @@ def fused_allocate(
         semantics are IDENTICAL to window=1 — this is pure unrolling; a
         micro-step whose job pool is exhausted is a masked no-op)."""
         (idle, releasing, task_count, cursor, left, n_alloc, alloc,
-         cur, out, steps) = state
+         q_alloc, cur, out, steps) = state
 
         # Selection only runs when the previous pop ended (lax.cond, not
         # where): most steps continue the current job, and the comparator
         # chain + segment_sum are a large share of the step's op count.
+        # A HALT stays a HALT (re-selecting would return HALT again).
         cur = jax.lax.cond(
-            cur < 0,
-            lambda: select_job(cursor, left, n_alloc, alloc),
+            cur == -1,
+            lambda: select_job(cursor, left, n_alloc, alloc, q_alloc),
             lambda: cur,
         )
 
@@ -258,14 +309,16 @@ def fused_allocate(
         )
         # DRF shares grow on every placement — pipeline fires the allocate
         # event too (session.go:199-239 -> drf.go:135-144).
-        alloc = alloc.at[cur_safe].add(
-            jnp.where(
-                active & (alloc_here | pipe_here),
-                jnp.where(alloc_here, m, 1).astype(alloc.dtype),
-                0.0,
-            )
-            * req
+        placed_copies = jnp.where(
+            active & (alloc_here | pipe_here),
+            jnp.where(alloc_here, m, 1).astype(alloc.dtype),
+            0.0,
         )
+        alloc = alloc.at[cur_safe].add(placed_copies * req)
+        if track_queue_alloc:
+            # proportion's allocate event handler: queue allocated grows on
+            # every placement too (proportion.go:236-246).
+            q_alloc = q_alloc.at[job_queue[cur_safe]].add(placed_copies * req)
         left = left.at[cur_safe].set(
             jnp.where(active, left[cur_safe] | failed, left[cur_safe])
         )
@@ -292,10 +345,12 @@ def fused_allocate(
         )
         drained = cursor[cur_safe] >= job_task_num[cur_safe]
         end_pop = failed | became_ready | drained
-        cur = jnp.where(active & ~end_pop, cur, -1)
+        cur = jnp.where(
+            cur == HALT, HALT, jnp.where(active & ~end_pop, cur, -1)
+        )
 
         return (idle, releasing, task_count, cursor, left, n_alloc, alloc,
-                cur, out, steps + 1)
+                q_alloc, cur, out, steps + 1)
 
     def body(state):
         for _ in range(window):
@@ -303,8 +358,9 @@ def fused_allocate(
         return state
 
     def cond(state):
-        (_, _, _, cursor, left, _, _, cur, _, steps) = state
-        return ((cur >= 0) | jnp.any(eligible(cursor, left))) & (steps < t_cap + window)
+        (_, _, _, cursor, left, _, _, _, cur, _, steps) = state
+        alive = (cur >= 0) | ((cur != HALT) & jnp.any(eligible(cursor, left)))
+        return alive & (steps < t_cap + window)
 
     init = (
         idle,
@@ -314,13 +370,14 @@ def fused_allocate(
         jnp.zeros(j_cap, dtype=bool),
         jnp.zeros(j_cap, dtype=jnp.int32),
         job_alloc_init,
+        queue_alloc_init,
         jnp.asarray(-1, dtype=jnp.int32),
         # Padded by MAX_BATCH so the run write-window never clamps at the tail.
         jnp.full(t_cap + MAX_BATCH, UNPLACED, dtype=jnp.int32),
         jnp.zeros((), dtype=jnp.int32),
     )
     final = jax.lax.while_loop(cond, body, init)
-    return final[8][:t_cap]
+    return final[9][:t_cap]
 
 
 class FusedAllocator:
@@ -435,6 +492,27 @@ class FusedAllocator:
             for plugin in tier.plugins
             if plugin.job_order_enabled() and (name := plugin.name) in ssn.job_order_fns
         )
+        # Queue-level chain: proportion's live share ordering + overused gate
+        # (the session's overused dispatch has no enable flag, so neither does
+        # this — any tier plugin with a registered overused fn activates it).
+        self.queue_comparators = tuple(
+            name
+            for tier in ssn.tiers
+            for plugin in tier.plugins
+            if plugin.queue_order_enabled()
+            and (name := plugin.name) in ssn.queue_order_fns
+        )
+        self.overused_gate = any(
+            plugin.name in ssn.overused_fns
+            for tier in ssn.tiers
+            for plugin in tier.plugins
+        )
+        queue_deserved = np.zeros((qb, r), dtype=np.float64)
+        queue_alloc = np.zeros((qb, r), dtype=np.float64)
+        if self.queue_comparators or self.overused_gate:
+            fair = ssn.device_queue_fair["proportion"](queue_names)
+            queue_deserved[: len(queue_names)] = scale_columns(fair["deserved"], scale)
+            queue_alloc[: len(queue_names)] = scale_columns(fair["allocated"], scale)
         self.enforce_pod_count = "pod_count" in ssn.device_dynamic_gates
 
         state = node_state_from_tensors(st, policy, nb)
@@ -458,6 +536,8 @@ class FusedAllocator:
             jnp.asarray(scale_columns(alloc_init, scale)),
             jnp.asarray(queue_rank),
             jnp.asarray(queue_has),
+            jnp.asarray(queue_deserved),
+            jnp.asarray(queue_alloc),
             jnp.asarray(scale_columns(total[None, :], scale)[0]),
             jnp.asarray(run_host),
         )
@@ -473,8 +553,14 @@ class FusedAllocator:
             return False  # [T, N] static masks/scores not fused yet (v1)
         if set(ssn.job_order_fns) - set(_KNOWN_JOB_ORDER):
             return False
-        if ssn.queue_order_fns or ssn.overused_fns:
-            return False  # proportion queue ordering not fused yet (v1)
+        if set(ssn.queue_order_fns) - {"proportion"}:
+            return False
+        if set(ssn.overused_fns) - {"proportion"}:
+            return False
+        if (ssn.queue_order_fns or ssn.overused_fns) and (
+            "proportion" not in ssn.device_queue_fair
+        ):
+            return False  # proportion without its device tensors -> host path
         if set(ssn.job_ready_fns) - {"gang"}:
             return False
         scoring = set(ssn.node_order_fns) | set(ssn.batch_node_order_fns) | set(ssn.node_map_fns)
@@ -503,6 +589,8 @@ class FusedAllocator:
             fused_allocate(
                 *self.args,
                 comparators=self.comparators,
+                queue_comparators=self.queue_comparators,
+                overused_gate=self.overused_gate,
                 weights=self.weights,
                 enforce_pod_count=self.enforce_pod_count,
                 window=self._window_size(),

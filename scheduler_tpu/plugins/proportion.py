@@ -116,6 +116,30 @@ class ProportionPlugin(Plugin):
 
         ssn.add_queue_order_fn(self.name(), queue_order_fn)
 
+        def device_queue_fair(queue_uids):
+            """Raw-unit [Q, R] deserved/allocated matrices for the fused engine.
+
+            Queues with no jobs this session have no attr; their rows stay zero
+            and the kernel's share/overused math degenerates to share 0 /
+            not-overused — but such queues also hold no eligible jobs, so they
+            are never selected.
+            """
+            import numpy as np
+
+            q = len(queue_uids)
+            r = vocab.size
+            deserved = np.zeros((q, r), dtype=np.float64)
+            allocated = np.zeros((q, r), dtype=np.float64)
+            for i, uid in enumerate(queue_uids):
+                attr = self.queue_attrs.get(uid)
+                if attr is None:
+                    continue
+                deserved[i] = attr.deserved.array
+                allocated[i] = attr.allocated.array
+            return {"deserved": deserved, "allocated": allocated}
+
+        ssn.add_device_queue_fair(self.name(), device_queue_fair)
+
         def reclaimable_fn(reclaimer: TaskInfo, reclaimees):
             victims = None
             allocations: Dict[str, ResourceVec] = {}

@@ -77,6 +77,15 @@ def run_engine(cache, conf_str, env):
         conf = parse_scheduler_conf(conf_str)
         ssn = open_session(cache, conf.tiers)
         get_action("allocate").execute(ssn)
+        # Capture BEFORE close_session — it clears ssn.jobs (framework.go
+        # CloseSession nils the maps), which would make this vacuously {}.
+        # Keyed by task name: uids are a process-global counter, so they vary
+        # between the separately-built caches the engines run against.
+        statuses = {
+            t.name: t.status.name
+            for job in ssn.jobs.values()
+            for t in job.tasks.values()
+        }
         close_session(ssn)
     finally:
         for k, v in old.items():
@@ -85,11 +94,6 @@ def run_engine(cache, conf_str, env):
             else:
                 os.environ[k] = v
     binds = dict(cache.binder.binds)
-    statuses = {
-        t.uid: t.status.name
-        for job in ssn.jobs.values()
-        for t in job.tasks.values()
-    }
     return binds, statuses
 
 
@@ -181,3 +185,133 @@ def test_fused_priority_values_above_float32_precision():
     for name, env in ENGINES.items():
         binds, _ = run_engine(build(), CONF, env)
         assert binds == {"default/hi-0": "n0"}, name
+
+
+CONF_PROPORTION = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: proportion
+  - name: binpack
+"""
+
+
+def build_weighted_cluster(seed=0, n_nodes=8, n_jobs=8, tasks_per_job=4,
+                           weights=(1, 3)):
+    """Two queues with unequal weights and enough demand to oversubscribe the
+    cluster, so proportion's live share ordering and overused gating both
+    decide placements."""
+    rng = np.random.default_rng(seed)
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    names = [f"q{i}" for i in range(len(weights))]
+    for q, w in zip(names, weights):
+        cache.add_queue(build_queue(q, weight=w))
+    for i in range(n_nodes):
+        cache.add_node(build_node(
+            f"n{i:03d}", {"cpu": 4000.0, "memory": 8 * 1024**3}))
+    for j in range(n_jobs):
+        group = f"job{j}"
+        size = int(rng.integers(1, tasks_per_job + 1))
+        cache.add_pod_group(build_pod_group(
+            group, queue=names[j % len(names)],
+            min_member=int(rng.integers(1, size + 1))))
+        for t in range(size):
+            cache.add_pod(build_pod(
+                name=f"{group}-{t}",
+                req={"cpu": float(rng.choice([1000, 2000])),
+                     "memory": float(rng.choice([2, 4])) * 1024**3},
+                groupname=group,
+                priority=int(rng.integers(0, 3)),
+            ))
+    return cache
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_proportion_three_engines_agree(seed):
+    results = {}
+    for name, env in ENGINES.items():
+        cache = build_weighted_cluster(seed=seed)
+        results[name] = run_engine(cache, CONF_PROPORTION, env)
+    assert results["fused"] == results["per-pop"], "fused vs per-pop"
+    assert results["fused"] == results["host"], "fused vs host"
+
+
+def test_proportion_fused_engine_selected():
+    # The proportion conf must actually take the fused path (not fall back).
+    from scheduler_tpu.framework import open_session as _open
+    from scheduler_tpu.ops.fused import FusedAllocator
+
+    cache = build_weighted_cluster(seed=0)
+    conf = parse_scheduler_conf(CONF_PROPORTION)
+    ssn = _open(cache, conf.tiers)
+    assert FusedAllocator.supported(ssn)
+    close_session(ssn)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_proportion_overused_queue_starved(seed):
+    # A 1:9 weight split on a small cluster must starve the light queue once
+    # it exceeds its deserved share — engines must agree on exactly which
+    # tasks lost out.
+    results = {}
+    for name, env in ENGINES.items():
+        cache = build_weighted_cluster(seed=seed, n_nodes=3, n_jobs=10,
+                                       weights=(1, 9))
+        results[name] = run_engine(cache, CONF_PROPORTION, env)
+    assert results["fused"] == results["per-pop"]
+    assert results["fused"] == results["host"]
+
+
+def build_releasing_cluster(seed=0):
+    """Two weighted queues; part of each node's capacity is held by RELEASING
+    tasks (evicted-but-not-gone), so placements split between allocate (idle)
+    and pipeline (releasing) — exercising proportion's q_alloc growth on the
+    pipelined branch too."""
+    rng = np.random.default_rng(seed)
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("qa", weight=1))
+    cache.add_queue(build_queue("qb", weight=2))
+    for i in range(4):
+        cache.add_node(build_node(f"n{i:03d}", {"cpu": 4000.0, "memory": 8 * 1024**3}))
+    # One running gang whose tasks get evicted -> releasing rows.
+    cache.add_pod_group(build_pod_group("old", queue="qa", min_member=4, phase="Running"))
+    for i in range(4):
+        # Full-node requests: idle goes to 0, so after eviction the pending
+        # tasks can only land on releasing resources (-> pipeline).
+        cache.add_pod(build_pod(
+            name=f"old-{i}", req={"cpu": 4000.0, "memory": 8 * 1024**3},
+            groupname="old", nodename=f"n{i:03d}", phase="Running"))
+    for task in list(cache.jobs["default/old"].tasks.values()):
+        cache.evict(task, "make room")
+    # Pending gangs in both queues; requests only fit idle+releasing mixes.
+    for j in range(6):
+        group = f"new{j}"
+        queue = ("qa", "qb")[j % 2]
+        size = int(rng.integers(1, 4))
+        cache.add_pod_group(build_pod_group(
+            group, queue=queue, min_member=int(rng.integers(1, size + 1))))
+        for t in range(size):
+            cache.add_pod(build_pod(
+                name=f"{group}-{t}",
+                req={"cpu": float(rng.choice([1000, 2000])),
+                     "memory": float(rng.choice([2, 4])) * 1024**3},
+                groupname=group,
+                priority=int(rng.integers(0, 3)),
+            ))
+    return cache
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_proportion_pipelined_parity(seed):
+    results = {}
+    for name, env in ENGINES.items():
+        cache = build_releasing_cluster(seed=seed)
+        results[name] = run_engine(cache, CONF_PROPORTION, env)
+    # The scenario must actually pipeline something, or it tests nothing.
+    assert any(s == "PIPELINED" for s in results["host"][1].values())
+    assert results["fused"] == results["per-pop"], "fused vs per-pop"
+    assert results["fused"] == results["host"], "fused vs host"

@@ -1,0 +1,31 @@
+# Build/test entry points (reference: Makefile + hack/make-rules).
+PY ?= python
+
+.PHONY: all native test test-fast bench bench-smoke verify clean
+
+all: native
+
+# C++ host-runtime library (snapshot packer / commit kernels), loaded via
+# ctypes with a pure-Python fallback when unbuilt.
+native:
+	$(PY) -m scheduler_tpu.native --build
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -x -m "not slow"
+
+bench:
+	$(PY) bench.py
+
+bench-smoke:
+	$(PY) bench.py --smoke
+
+# Lint-ish gate (reference `make verify`): compile every module.
+verify:
+	$(PY) -m compileall -q scheduler_tpu tests bench.py __graft_entry__.py
+
+clean:
+	find . -name '__pycache__' -type d -exec rm -rf {} + 2>/dev/null || true
+	rm -f scheduler_tpu/native/_libschedtpu*.so

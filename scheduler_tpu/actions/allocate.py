@@ -68,9 +68,11 @@ def collect_candidates(ssn) -> List[JobInfo]:
     return candidates
 
 
-def apply_fused_results(ssn, candidates: List[JobInfo], results) -> None:
+def apply_fused_results(ssn, candidates: List[JobInfo], results, plan_fn=None) -> None:
     """Commit a fused-engine run to the session: record FitErrors for failed
-    rows, apply placements (bulk by default, per-row when SCHEDULER_TPU_BULK=0)."""
+    rows, apply placements (bulk by default, per-row when SCHEDULER_TPU_BULK=0).
+    ``plan_fn`` lazily builds the engine's CommitPlan — only the bulk path
+    consumes it, so the per-row path never pays for its construction."""
     bulk = os.environ.get("SCHEDULER_TPU_BULK", "1") not in ("0", "false")
     placements = []
     for job in candidates:
@@ -87,7 +89,7 @@ def apply_fused_results(ssn, candidates: List[JobInfo], results) -> None:
             else:
                 ssn.allocate(task, node_name)
     if bulk:
-        ssn.bulk_apply(placements)
+        ssn.bulk_apply(placements, plan=plan_fn() if plan_fn is not None else None)
 
 
 class AllocateAction(Action):
@@ -183,7 +185,8 @@ class AllocateAction(Action):
         from scheduler_tpu.ops.fused import FusedAllocator
 
         engine = FusedAllocator(ssn, candidates)
-        apply_fused_results(ssn, candidates, engine.run())
+        results = engine.run()
+        apply_fused_results(ssn, candidates, results, plan_fn=engine.commit_plan)
 
     # -- device engine -------------------------------------------------------
 

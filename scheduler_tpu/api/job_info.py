@@ -56,6 +56,7 @@ class TaskInfo:
         "pod",
         "volume_ready",
         "req_sig_cache",
+        "resreq_empty_cache",
     )
 
     def __init__(self, pod: PodSpec, vocab: ResourceVocabulary) -> None:
@@ -71,10 +72,22 @@ class TaskInfo:
         self.pod: PodSpec = pod
         self.volume_ready: bool = False
         self.req_sig_cache: Optional[bytes] = None
+        self.resreq_empty_cache: Optional[bool] = None
 
     @property
     def creation_timestamp(self) -> float:
         return self.pod.creation_timestamp
+
+    @property
+    def resreq_empty(self) -> bool:
+        """Cached ``resreq.is_empty()`` — the BestEffort test runs once per
+        task per action otherwise (request vectors are immutable after
+        creation, so the answer never changes)."""
+        empty = self.resreq_empty_cache
+        if empty is None:
+            empty = self.resreq.is_empty()
+            self.resreq_empty_cache = empty
+        return empty
 
     @property
     def req_sig(self) -> bytes:
@@ -110,6 +123,7 @@ class TaskInfo:
         t.pod = self.pod
         t.volume_ready = self.volume_ready
         t.req_sig_cache = self.req_sig_cache
+        t.resreq_empty_cache = self.resreq_empty_cache
         return t
 
     def __repr__(self) -> str:
@@ -201,12 +215,17 @@ class JobInfo:
             self.allocated.add(task.resreq)
         self._add_to_index(task)
 
-    def bulk_update_status(self, tasks: list, status: TaskStatus) -> None:
+    def bulk_update_status(self, tasks: list, status: TaskStatus, net_add=None) -> None:
         """Batch ``update_task_status``: same bucket moves, but ONE aggregate
         update computed as a dense vector sum instead of per-task Resource ops.
         Equivalent final state to calling update_task_status per task; the
         aggregate applies BEFORE the index moves so a failed sufficiency
-        assertion leaves the job consistent."""
+        assertion leaves the job consistent.
+
+        ``net_add`` (dense [R] row, optional): the precomputed sum of the
+        batch's resreq rows (CommitPlan) — valid only when every task moves
+        from a non-allocated to an allocated status; skips gathering per-task
+        rows entirely."""
         if not tasks:
             return
         from scheduler_tpu.api.resource import sum_rows
@@ -215,6 +234,7 @@ class JobInfo:
         resolved = []
         sub_rows = []
         add_rows = []
+        add_count = 0
         seen = set()
         for ti in tasks:
             task = self.tasks.get(ti.uid)
@@ -229,13 +249,22 @@ class JobInfo:
             # sub-then-add of the same rows cancels when allocation-ness is
             # unchanged (e.g. Allocated -> Binding at dispatch) — skip it.
             if was_allocated and not now_allocated:
+                if net_add is not None:
+                    raise ValueError(
+                        "net_add given but batch contains an allocated->"
+                        "non-allocated transition"
+                    )
                 sub_rows.append(task.resreq)
             elif now_allocated and not was_allocated:
-                add_rows.append(task.resreq)
+                if net_add is None:
+                    add_rows.append(task.resreq)
+                add_count += 1
             resolved.append((ti, task))
         if sub_rows:
             self.allocated.sub_array(sum_rows(sub_rows)[0])
-        if add_rows:
+        if net_add is not None and add_count:
+            self.allocated.add_array(net_add)
+        elif add_rows:
             self.allocated.add_array(*sum_rows(add_rows))
         for ti, task in resolved:
             self._delete_from_index(task)

@@ -110,7 +110,7 @@ class NodeInfo:
             self.used.add(ti.resreq)
         self.tasks[ti.uid] = ti
 
-    def bulk_add_tasks(self, tasks) -> None:
+    def bulk_add_tasks(self, tasks, agg=None) -> None:
         """Batch ``add_task``: the same status state machine, with the resource
         arithmetic collapsed into one dense delta per accounting vector.
 
@@ -118,7 +118,11 @@ class NodeInfo:
         ``self.tasks`` share request vectors (``TaskInfo.clone_shared``).
         Arithmetic applies BEFORE any dict insert so a failed sufficiency
         assertion leaves the node consistent (no half-registered batch).
-        """
+
+        ``agg`` (CommitPlan node delta, optional):
+        (idle_sub, releasing_sub, used_add, n_alloc, n_pipe) dense rows —
+        skips gathering per-task rows.  Valid only for allocated/pipelined
+        batches (a RELEASING task in the batch raises)."""
         if not tasks:
             return
         from scheduler_tpu.api.resource import sum_rows
@@ -136,7 +140,7 @@ class NodeInfo:
                 )
             batch_uids.add(task.uid)
             ti = task.clone_shared()
-            if self.node is not None:
+            if self.node is not None and agg is None:
                 if ti.status == TaskStatus.RELEASING:
                     rel_add.append(ti.resreq)
                     idle_sub.append(ti.resreq)
@@ -145,15 +149,25 @@ class NodeInfo:
                 else:
                     idle_sub.append(ti.resreq)
                 used_add.append(ti.resreq)
+            elif agg is not None and ti.status == TaskStatus.RELEASING:
+                raise ValueError("agg fast path does not cover RELEASING tasks")
             clones.append(ti)
-        if idle_sub:
-            self.idle.sub_array(sum_rows(idle_sub)[0])
-        if rel_add:
-            self.releasing.add_array(*sum_rows(rel_add))
-        if rel_sub:
-            self.releasing.sub_array(sum_rows(rel_sub)[0])
-        if used_add:
-            self.used.add_array(*sum_rows(used_add))
+        if agg is not None and self.node is not None:
+            a_idle_sub, a_rel_sub, a_used_add, n_alloc, n_pipe = agg
+            if n_alloc:
+                self.idle.sub_array(a_idle_sub)
+            if n_pipe:
+                self.releasing.sub_array(a_rel_sub)
+            self.used.add_array(a_used_add)
+        else:
+            if idle_sub:
+                self.idle.sub_array(sum_rows(idle_sub)[0])
+            if rel_add:
+                self.releasing.add_array(*sum_rows(rel_add))
+            if rel_sub:
+                self.releasing.sub_array(sum_rows(rel_sub)[0])
+            if used_add:
+                self.used.add_array(*sum_rows(used_add))
         for ti in clones:
             self.tasks[ti.uid] = ti
 

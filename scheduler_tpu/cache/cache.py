@@ -325,11 +325,17 @@ class SchedulerCache(Cache):
     _BIND_CHUNK = 256
     _IO_WORKERS = 8
 
-    def bind_bulk(self, tasks) -> None:
+    def bind_bulk(self, tasks, plan=None) -> None:
         """Batch ``bind``: one mutex hold, vectorized node/job accounting,
-        chunked async dispatch (failures resync individually)."""
+        chunked async dispatch (failures resync individually).
+
+        ``plan`` (optional) = CommitPlan.bind_deltas output:
+        (node name -> (delta row, count), job uid -> allocated sum) — the
+        cache-side accounting then applies precomputed dense rows instead of
+        gathering per-task request vectors a second time."""
         from collections import defaultdict
 
+        node_rows, job_rows = plan if plan is not None else ({}, {})
         with self.mutex:
             by_job = defaultdict(list)
             by_node = defaultdict(list)
@@ -345,10 +351,19 @@ class SchedulerCache(Cache):
                 resolved.append((task, ti.node_name))
             for task, hostname in resolved:
                 task.node_name = hostname
-            for rows in by_job.values():
-                rows[0][0].bulk_update_status([t for _, t in rows], TaskStatus.BINDING)
+            for uid, rows in by_job.items():
+                rows[0][0].bulk_update_status(
+                    [t for _, t in rows], TaskStatus.BINDING,
+                    net_add=job_rows.get(uid),
+                )
             for hostname, node_tasks in by_node.items():
-                self.nodes[hostname].bulk_add_tasks(node_tasks)
+                agg = None
+                if hostname in node_rows:
+                    row, count = node_rows[hostname]
+                    # Bind batches are allocated-status only: idle -= row,
+                    # used += row, releasing untouched.
+                    agg = (row, None, row, count, 0)
+                self.nodes[hostname].bulk_add_tasks(node_tasks, agg=agg)
 
         def bind_chunk(chunk) -> None:
             from scheduler_tpu.cache.interface import BulkBindError

@@ -59,7 +59,7 @@ class EventHandler:
 
     allocate_func: Optional[Callable[[Event], None]] = None
     deallocate_func: Optional[Callable[[Event], None]] = None
-    bulk_allocate_func: Optional[Callable[[list], None]] = None
+    bulk_allocate_func: Optional[Callable[..., None]] = None  # (tasks, plan=None)
 
 
 @dataclass

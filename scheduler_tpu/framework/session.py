@@ -369,13 +369,25 @@ class Session:
             if eh.deallocate_func is not None:
                 eh.deallocate_func(Event(task))
 
-    def _fire_allocate_bulk(self, tasks: List[TaskInfo]) -> None:
+    def _fire_allocate_bulk(self, tasks: List[TaskInfo], plan=None) -> None:
+        import inspect
+
         events = None
         for eh in self.event_handlers:
             if eh.bulk_allocate_func is not None:
                 # Bulk handlers take the task list directly — no Event wrapper
-                # per task (100k wrappers/cycle otherwise).
-                eh.bulk_allocate_func(tasks)
+                # per task (100k wrappers/cycle otherwise) — plus the optional
+                # CommitPlan with precomputed per-job/per-queue sums.  Handlers
+                # written against the original single-arg contract still work:
+                # the plan is passed only if the signature accepts it.
+                try:
+                    takes_plan = len(inspect.signature(eh.bulk_allocate_func).parameters) >= 2
+                except (TypeError, ValueError):
+                    takes_plan = False
+                if takes_plan:
+                    eh.bulk_allocate_func(tasks, plan)
+                else:
+                    eh.bulk_allocate_func(tasks)
             elif eh.allocate_func is not None:
                 if events is None:
                     events = [Event(t) for t in tasks]
@@ -414,7 +426,7 @@ class Session:
             for t in list(job.task_status_index.get(TaskStatus.ALLOCATED, {}).values()):
                 self._dispatch(t)
 
-    def bulk_apply(self, placements: List) -> None:
+    def bulk_apply(self, placements: List, plan=None) -> None:
         """Commit a whole device placement at once: the batched equivalent of
         calling ``allocate``/``pipeline`` per row, with identical final state.
 
@@ -429,6 +441,10 @@ class Session:
           job that is ready after the batch" reaches the same end state;
         * event handlers fire once with the full batch (or per-event for
           handlers without a bulk form).
+
+        ``plan`` (CommitPlan, optional) carries every ledger delta as
+        precomputed dense rows — with it, no per-task resource arithmetic runs
+        anywhere in the commit.
         """
         if not placements:
             return
@@ -447,34 +463,49 @@ class Session:
             by_job[task.job].append((task, hostname, pipelined))
             by_node[hostname].append(task)
 
+        job_alloc = plan.job_alloc() if plan is not None else {}
         affected: List[JobInfo] = []
         for job_uid, rows in by_job.items():
             job = self.jobs[job_uid]
             job.bulk_update_status(
-                [t for t, _, p in rows if not p], TaskStatus.ALLOCATED
+                [t for t, _, p in rows if not p], TaskStatus.ALLOCATED,
+                net_add=job_alloc.get(job_uid),
             )
             job.bulk_update_status([t for t, _, p in rows if p], TaskStatus.PIPELINED)
             for task, hostname, _ in rows:
                 task.node_name = hostname
             affected.append(job)
 
+        node_deltas = plan.node_deltas() if plan is not None else {}
+        job_alloc_counts = plan.job_alloc_counts() if plan is not None else {}
         for hostname, tasks in by_node.items():
-            self.nodes[hostname].bulk_add_tasks(tasks)
+            self.nodes[hostname].bulk_add_tasks(tasks, agg=node_deltas.get(hostname))
 
-        self._fire_allocate_bulk([t for t, _, _ in placements])
+        self._fire_allocate_bulk([t for t, _, _ in placements], plan)
 
         to_bind: List[TaskInfo] = []
+        ready_uids: List[str] = []
+        plan_covers_bind = plan is not None
         for job in affected:
             if self.job_ready(job):
                 allocated = list(
                     job.task_status_index.get(TaskStatus.ALLOCATED, {}).values()
                 )
+                # The plan's bind ledger covers exactly THIS batch's allocated
+                # rows.  A ready job can also hold Allocated tasks from an
+                # earlier action in the same session (e.g. backfill ordered
+                # before allocate) — those are in to_bind but not in the plan,
+                # so using the plan would under-account the cache ledgers.
+                if plan_covers_bind and len(allocated) != job_alloc_counts.get(job.uid, 0):
+                    plan_covers_bind = False
                 for t in allocated:
                     self.cache.bind_volumes(t)
                 job.bulk_update_status(allocated, TaskStatus.BINDING)
                 to_bind.extend(allocated)
+                ready_uids.append(job.uid)
         if to_bind:
-            self.cache.bind_bulk(to_bind)
+            bind_plan = plan.bind_deltas(ready_uids) if plan_covers_bind else None
+            self.cache.bind_bulk(to_bind, bind_plan)
 
     def _dispatch(self, task: TaskInfo) -> None:
         """Bind an allocated task through the cache (session.go:299-323)."""

@@ -61,7 +61,7 @@ def collect_pending(job: JobInfo, sort_key) -> List[TaskInfo]:
     pending = [
         t
         for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
-        if not t.resreq.is_empty()
+        if not t.resreq_empty
     ]
     pending.sort(key=sort_key)
     return pending

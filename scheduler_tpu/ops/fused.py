@@ -413,6 +413,7 @@ class FusedAllocator:
         queue_names = sorted(
             ssn.queues, key=lambda q: (ssn.queues[q].creation_timestamp, q)
         )
+        self.queue_uids = queue_names
         qb = bucket(max(len(queue_names), 1))
         queue_pos = {q: i for i, q in enumerate(queue_names)}
 
@@ -446,6 +447,8 @@ class FusedAllocator:
         self.flat = flat
         node_list = sorted(ssn.nodes.values(), key=lambda nd: nd.name)
         st = build_snapshot_tensors(node_list, self.jobs, flat, queue_names, vocab)
+        self.st = st
+        self._queues_of_jobs = queues_idx
         self.node_names = st.nodes.names
         n = st.nodes.count
         nb = bucket(max(n, 1))
@@ -465,19 +468,13 @@ class FusedAllocator:
         t_count = len(flat)
         run_host = np.ones(tb, dtype=np.int32)
         if t_count > 1:
-            res = st.tasks.resreq[:t_count]
-            initr = st.tasks.init_resreq[:t_count]
-            same = np.all(res[1:] == res[:-1], axis=1) & np.all(
-                initr[1:] == initr[:-1], axis=1
+            from scheduler_tpu import native
+
+            run_host[:t_count] = native.run_lengths(
+                st.tasks.resreq[:t_count],
+                st.tasks.init_resreq[:t_count],
+                st.tasks.job_idx[:t_count],
             )
-            job_starts = np.zeros(t_count, dtype=bool)
-            real = nums[:j] > 0
-            job_starts[offsets[:j][real]] = True
-            same &= ~job_starts[1:]
-            gid = np.concatenate(([0], np.cumsum(~same)))
-            counts = np.bincount(gid)
-            ends = np.cumsum(counts) - 1
-            run_host[:t_count] = (ends[gid] - np.arange(t_count) + 1).astype(np.int32)
 
         self.weights = score_weights(ssn)
         # Run batching is exact only when the chosen node's score cannot drop
@@ -598,6 +595,8 @@ class FusedAllocator:
             )
         )
 
+        self._encoded = encoded
+
         # One bulk conversion: per-element int(ndarray[i]) costs ~100x a list
         # element access at this scale.
         codes = encoded.tolist()
@@ -619,3 +618,27 @@ class FusedAllocator:
             out[job.uid] = decoded
             base += len(rows)
         return out
+
+    def commit_plan(self):
+        """Array-level ledger aggregates of the last ``run()`` (CommitPlan) —
+        lets bulk_apply skip per-task ResourceVec arithmetic entirely."""
+        from scheduler_tpu.api.commit_plan import CommitPlan
+        from scheduler_tpu import native
+
+        t = len(self.flat)
+        node_id, pipelined, _failed, _n = native.decode_placement_codes(
+            self._encoded[:t]
+        )
+        job_ids = self.st.tasks.job_idx[:t]
+        queue_ids = self._queues_of_jobs[np.clip(job_ids, 0, None)].astype(np.int32)
+        queue_ids = np.where(job_ids >= 0, queue_ids, -1).astype(np.int32)
+        return CommitPlan(
+            matrix=self.st.tasks.resreq[:t],
+            node_id=node_id,
+            pipelined=pipelined,
+            job_ids=job_ids,
+            queue_ids=queue_ids,
+            node_names=self.node_names,
+            job_uids=[j.uid for j in self.jobs],
+            queue_uids=self.queue_uids,
+        )

@@ -109,9 +109,17 @@ class DrfPlugin(Plugin):
             attr.allocated.sub(event.task.resreq)
             self._update_share(attr)
 
-        def on_allocate_bulk(tasks) -> None:
+        def on_allocate_bulk(tasks, plan=None) -> None:
             # Vectorized form of folding on_allocate over the tasks: one dense
-            # sum per job, one share recompute.
+            # sum per job, one share recompute.  With a CommitPlan the per-job
+            # sums arrive precomputed (plan.job_all — DRF counts pipelined
+            # placements too, drf.go:135-154).
+            if plan is not None:
+                for job_uid, row in plan.job_all().items():
+                    attr = self.job_attrs[job_uid]
+                    attr.allocated.add_array(row)
+                    self._update_share(attr)
+                return
             from scheduler_tpu.api.resource import sum_rows
 
             rows_by_job: Dict[str, list] = {}

@@ -201,9 +201,16 @@ class ProportionPlugin(Plugin):
             attr.allocated.sub(event.task.resreq)
             self._update_share(attr)
 
-        def on_allocate_bulk(tasks) -> None:
+        def on_allocate_bulk(tasks, plan=None) -> None:
             # One dense sum per queue, one share recompute (state-equivalent to
-            # folding on_allocate over the tasks).
+            # folding on_allocate over the tasks).  With a CommitPlan the
+            # per-queue sums arrive precomputed (plan.queue_all).
+            if plan is not None:
+                for queue_uid, row in plan.queue_all().items():
+                    attr = self.queue_attrs[queue_uid]
+                    attr.allocated.add_array(row)
+                    self._update_share(attr)
+                return
             from scheduler_tpu.api.resource import sum_rows
 
             rows_by_queue: Dict[str, list] = {}

@@ -98,7 +98,30 @@ def select_best_node(node_scores: Dict[NodeInfo, float]) -> NodeInfo:
 
 
 def task_sort_key(ssn) -> Callable:
-    """Sort key equivalent of the session's task_order_fn for list.sort()."""
+    """Sort key equivalent of the session's task_order_fn for list.sort().
+
+    Fast path: when the enabled task-order chain is the builtin priority
+    plugin (or empty), the comparator chain collapses to a plain tuple key —
+    list.sort() then runs entirely in C instead of dispatching a Python
+    comparator through every tier per comparison (~500k dispatches for a
+    100k-task cycle, the dominant host-side cost before this path existed).
+    """
+    enabled = {
+        plugin.name
+        for tier in ssn.tiers
+        for plugin in tier.plugins
+        if plugin.task_order_enabled() and plugin.name in ssn.task_order_fns
+    }
+    if enabled <= {"priority"}:
+        if "priority" in enabled:
+            # priority.go:39-59: higher pod priority first; then the same
+            # deterministic tie-break chain as the generic path below.
+            def key(t: TaskInfo):
+                return (-t.priority, t.req_sig, t.creation_timestamp, t.uid)
+        else:
+            def key(t: TaskInfo):
+                return (t.req_sig, t.creation_timestamp, t.uid)
+        return key
 
     def cmp(l: TaskInfo, r: TaskInfo) -> int:
         res = ssn.task_compare_fns(l, r)

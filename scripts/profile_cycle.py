@@ -45,7 +45,7 @@ def run(n_nodes: int, n_pods: int, label: str) -> None:
     results = engine.run()
     t4 = time.perf_counter()
 
-    apply_fused_results(ssn, candidates, results)
+    apply_fused_results(ssn, candidates, results, plan_fn=engine.commit_plan)
     t5 = time.perf_counter()
 
     close_session(ssn)

@@ -1,0 +1,140 @@
+"""C++ host-runtime kernels: build, parity with the numpy fallbacks, and the
+CommitPlan ledger math they feed."""
+
+import numpy as np
+import pytest
+
+from scheduler_tpu import native
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(7)
+
+
+def test_native_builds_and_loads():
+    assert native.build() is not None
+    assert native.available()
+
+
+def test_segment_sum_matches_fallback(rng):
+    rows = rng.uniform(0, 10, (5000, 4))
+    seg = rng.integers(-2, 50, 5000).astype(np.int32)
+    got = native.segment_sum(rows, seg, 50)
+    exp = np.zeros((50, 4))
+    ok = (seg >= 0) & (seg < 50)
+    np.add.at(exp, seg[ok], rows[ok])
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_segment_sum_indexed_matches_gather(rng):
+    matrix = rng.uniform(0, 10, (800, 3))
+    idx = rng.integers(-1, 800, 1200).astype(np.int32)
+    seg = rng.integers(-1, 9, 1200).astype(np.int32)
+    got = native.segment_sum_indexed(matrix, idx, seg, 9)
+    exp = np.zeros((9, 3))
+    ok = (idx >= 0) & (seg >= 0)
+    np.add.at(exp, seg[ok], matrix[idx[ok]])
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_segment_count(rng):
+    seg = rng.integers(-1, 5, 300).astype(np.int32)
+    got = native.segment_count(seg, 5)
+    exp = np.bincount(seg[seg >= 0], minlength=5)
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_decode_placement_codes():
+    codes = np.array([0, 7, -1, -2, -3, -5], dtype=np.int32)
+    node_id, pipelined, failed, placed = native.decode_placement_codes(codes)
+    assert node_id.tolist() == [0, 7, -1, -1, 0, 2]
+    assert pipelined.tolist() == [False, False, False, False, True, True]
+    assert failed.tolist() == [False, False, False, True, False, False]
+    assert placed == 4
+
+
+def test_run_lengths_job_boundaries():
+    resreq = np.array([[1.0, 2.0]] * 5 + [[3.0, 4.0]])
+    init = resreq.copy()
+    job = np.array([0, 0, 0, 1, 1, 1], dtype=np.int32)
+    runs = native.run_lengths(resreq, init, job)
+    # Identical rows, but the job boundary at index 3 breaks the run; the
+    # request change at index 5 breaks again.
+    assert runs.tolist() == [3, 2, 1, 2, 1, 1]
+
+
+def test_run_lengths_init_resreq_breaks_runs():
+    resreq = np.ones((3, 2))
+    init = np.array([[1.0, 1.0], [1.0, 1.0], [9.0, 9.0]])
+    job = np.zeros(3, dtype=np.int32)
+    assert native.run_lengths(resreq, init, job).tolist() == [2, 1, 1]
+
+
+def test_commit_plan_ledgers_match_per_task_sums(rng):
+    from scheduler_tpu.api.commit_plan import CommitPlan
+
+    t, r = 400, 3
+    matrix = rng.uniform(0.5, 4.0, (t, r))
+    codes = rng.choice(
+        np.array([0, 1, 2, -1, -2, -3, -4], dtype=np.int32), t
+    )
+    node_id, pipelined, failed, _ = native.decode_placement_codes(codes)
+    job_ids = rng.integers(0, 6, t).astype(np.int32)
+    queue_of_job = np.array([0, 1, 0, 1, 0, 1], dtype=np.int32)
+    queue_ids = queue_of_job[job_ids]
+    plan = CommitPlan(
+        matrix, node_id, pipelined, job_ids, queue_ids,
+        node_names=[f"n{i}" for i in range(5)],
+        job_uids=[f"j{i}" for i in range(6)],
+        queue_uids=["qa", "qb"],
+    )
+
+    placed = node_id >= 0
+    alloc = placed & ~pipelined
+    # node ledger (used = alloc_sum + pipe_sum: summation order differs from a
+    # single pass over all rows, so allow last-ulp drift — float addition is
+    # non-associative; the resource epsilons >= 10 raw units absorb it)
+    for name, (idle_sub, rel_sub, used, n_alloc, n_pipe) in plan.node_deltas().items():
+        k = int(name[1:])
+        on = placed & (node_id == k)
+        np.testing.assert_array_equal(idle_sub, matrix[on & alloc].sum(axis=0) if (on & alloc).any() else np.zeros(r))
+        np.testing.assert_allclose(used, matrix[on].sum(axis=0), rtol=1e-12)
+        assert n_alloc == int((on & alloc).sum())
+        assert n_pipe == int((on & pipelined).sum())
+    # job ledgers
+    for uid, row in plan.job_alloc().items():
+        k = int(uid[1:])
+        np.testing.assert_array_equal(row, matrix[alloc & (job_ids == k)].sum(axis=0))
+    for uid, row in plan.job_all().items():
+        k = int(uid[1:])
+        np.testing.assert_array_equal(row, matrix[placed & (job_ids == k)].sum(axis=0))
+    # queue ledger
+    for uid, row in plan.queue_all().items():
+        k = {"qa": 0, "qb": 1}[uid]
+        np.testing.assert_array_equal(row, matrix[placed & (queue_ids == k)].sum(axis=0))
+    # bind ledger restricted to ready jobs
+    nodes, jobs = plan.bind_deltas(["j0", "j3"])
+    ready_rows = alloc & np.isin(job_ids, [0, 3])
+    for name, (row, count) in nodes.items():
+        k = int(name[1:])
+        np.testing.assert_array_equal(row, matrix[ready_rows & (node_id == k)].sum(axis=0))
+        assert count == int((ready_rows & (node_id == k)).sum())
+    assert set(jobs) <= {"j0", "j3"}
+
+
+def test_fallback_paths_match_native(rng, monkeypatch):
+    """Force the numpy fallbacks and compare against the native results."""
+    rows = rng.uniform(0, 3, (1000, 2))
+    seg = rng.integers(-1, 20, 1000).astype(np.int32)
+    codes = rng.choice(np.array([3, -1, -2, -7], dtype=np.int32), 1000)
+    native_sum = native.segment_sum(rows, seg, 20)
+    native_dec = native.decode_placement_codes(codes)
+
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)  # _load() -> None
+    fb_sum = native.segment_sum(rows, seg, 20)
+    fb_dec = native.decode_placement_codes(codes)
+    np.testing.assert_array_equal(native_sum, fb_sum)
+    for a, b in zip(native_dec, fb_dec):
+        np.testing.assert_array_equal(a, b)

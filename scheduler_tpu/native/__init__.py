@@ -47,22 +47,25 @@ def build(force: bool = False) -> Optional[str]:
     if not force and os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(_SRC):
         return out
     cxx = os.environ.get("CXX", "g++")
-    # Write to a temp file then rename so a concurrent import never loads a
-    # half-written library.
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(out))
-    os.close(fd)
-    cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    tmp = None
     try:
+        # Write to a temp file then rename so a concurrent import never loads
+        # a half-written library.  mkstemp is inside the try: a read-only
+        # package directory must degrade to the numpy fallbacks, not raise.
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(out))
+        os.close(fd)
+        cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, out)
         return out
     except (subprocess.CalledProcessError, OSError) as exc:
         detail = getattr(exc, "stderr", "") or str(exc)
         logger.warning("native build failed (%s); using numpy fallbacks", detail.strip()[:500])
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         return None
 
 
@@ -192,12 +195,15 @@ def run_lengths(resreq: np.ndarray, init_resreq: np.ndarray, job_idx: np.ndarray
     if lib is not None:
         lib.run_lengths_i32(resreq, init_resreq, job_idx, t, resreq.shape[1], out)
         return out
+    # Vectorized fallback: group consecutive identical rows, then distance to
+    # each group's last element (no Python-per-row loop on a 100k-task cycle).
     same = (
         np.all(resreq[1:] == resreq[:-1], axis=1)
         & np.all(init_resreq[1:] == init_resreq[:-1], axis=1)
         & (job_idx[1:] == job_idx[:-1])
     )
-    for i in range(t - 2, -1, -1):
-        if same[i]:
-            out[i] = out[i + 1] + 1
+    gid = np.concatenate(([0], np.cumsum(~same)))
+    counts = np.bincount(gid)
+    ends = np.cumsum(counts) - 1
+    out[:] = (ends[gid] - np.arange(t) + 1).astype(np.int32)
     return out

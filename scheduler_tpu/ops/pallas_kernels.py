@@ -1,0 +1,128 @@
+"""Pallas TPU kernels for the hot batched ops.
+
+First kernel: the session-static predicate stage — label-selector matching,
+taint/toleration matching, and the per-task/per-node gates fused into ONE
+[T, N] mask kernel.  The math (ops/predicates.py, reference
+``plugins/predicates/predicates.go:169-231``):
+
+    violations[t, n] = selector[t] @ missing_labels[n] + untolerated[t] @ taints[n]
+    mask[t, n]       = violations == 0 AND not unknown_selector[t]
+                                     AND not unschedulable[n]
+
+Both contractions ride the MXU (f32 matmuls over the label/taint vocab axis);
+the gates fuse into the same tile pass, so the [T, N] intermediates never
+round-trip through HBM.  The jnp path (ops/predicates.plugin_predicate_mask +
+taint_mask) materializes three [T, N] arrays and ANDs them on host.
+
+Tile geometry: T and N tile at 128 (f32 min tile is (8, 128); 128x128 feeds
+the MXU), the vocab axes pad to a lane multiple and are consumed whole per
+tile — label vocabularies are small (tens of pairs), so no K-loop is needed.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_T = 128
+TILE_N = 128
+
+
+def pallas_enabled() -> bool:
+    return os.environ.get("SCHEDULER_TPU_PALLAS", "1") not in ("0", "false")
+
+
+def _interpret() -> bool:
+    # Interpreter mode off-TPU so tests (CPU mesh) exercise the same kernel.
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _pad_to(x: np.ndarray, rows: int, cols: int) -> np.ndarray:
+    out = np.zeros((rows, cols), dtype=x.dtype)
+    out[: x.shape[0], : x.shape[1]] = x
+    return out
+
+
+def _mask_kernel(sel_ref, missing_ref, untol_ref, taints_ref, unknown_ref,
+                 unsched_ref, out_ref):
+    viol = jnp.dot(sel_ref[:], missing_ref[:], preferred_element_type=jnp.float32)
+    viol = viol + jnp.dot(untol_ref[:], taints_ref[:], preferred_element_type=jnp.float32)
+    ok = (viol == 0.0) & (unknown_ref[:] == 0.0) & (unsched_ref[:] == 0.0)
+    out_ref[:] = ok
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _mask_call(sel, missing, untol, taints, unknown, unsched, *, interpret: bool):
+    t_pad, l_pad = sel.shape
+    n_pad = missing.shape[1]
+    grid = (t_pad // TILE_T, n_pad // TILE_N)
+    return pl.pallas_call(
+        _mask_kernel,
+        out_shape=jax.ShapeDtypeStruct((t_pad, n_pad), jnp.bool_),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((TILE_T, l_pad), lambda i, j: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((l_pad, TILE_N), lambda i, j: (0, j),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((TILE_T, taints.shape[0]), lambda i, j: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((taints.shape[0], TILE_N), lambda i, j: (0, j),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((TILE_T, 1), lambda i, j: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, TILE_N), lambda i, j: (0, j),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((TILE_T, TILE_N), lambda i, j: (i, j),
+                                   memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(sel, missing, untol, taints, unknown, unsched)
+
+
+def static_predicate_mask(
+    selector: np.ndarray,          # bool [T, L] required label pairs
+    has_unknown: np.ndarray,       # bool [T] selector pair absent from vocab
+    node_labels: np.ndarray,       # bool [N, L]
+    unschedulable: np.ndarray,     # bool [N]
+    node_taints: np.ndarray,       # bool [N, K]
+    tolerated: np.ndarray,         # bool [T, K] task tolerates taint k
+) -> np.ndarray:
+    """Fused selector+taint+gate mask -> bool [T, N] (host arrays in/out)."""
+    t = selector.shape[0]
+    n = node_labels.shape[0]
+    if t == 0 or n == 0:
+        return np.ones((t, n), dtype=bool)
+
+    lane = 128
+    t_pad = -(-t // TILE_T) * TILE_T
+    n_pad = -(-n // TILE_N) * TILE_N
+    l_pad = max(lane, -(-selector.shape[1] // lane) * lane)
+    k_pad = max(lane, -(-node_taints.shape[1] // lane) * lane)
+
+    sel = _pad_to(selector.astype(np.float32), t_pad, l_pad)
+    missing = np.zeros((l_pad, n_pad), dtype=np.float32)
+    missing[: node_labels.shape[1], :n] = (~node_labels).astype(np.float32).T
+    untol = np.zeros((t_pad, k_pad), dtype=np.float32)
+    untol[:t, : tolerated.shape[1]] = (~tolerated).astype(np.float32)
+    taints = np.zeros((k_pad, n_pad), dtype=np.float32)
+    taints[: node_taints.shape[1], :n] = node_taints.astype(np.float32).T
+    unknown = _pad_to(has_unknown.astype(np.float32)[:, None], t_pad, 1)
+    unsched = _pad_to(unschedulable.astype(np.float32)[None, :], 1, n_pad)
+
+    out = _mask_call(
+        jnp.asarray(sel), jnp.asarray(missing), jnp.asarray(untol),
+        jnp.asarray(taints), jnp.asarray(unknown), jnp.asarray(unsched),
+        interpret=_interpret(),
+    )
+    # np.array copies: jax outputs are read-only views, and callers AND more
+    # gates into the mask in place.
+    return np.array(out[:t, :n])

@@ -191,17 +191,36 @@ class PredicatesPlugin(Plugin):
             t = st.tasks.count
             if t == 0:
                 return np.ones((0, st.nodes.count), dtype=bool)
-            mask = np.array(  # np.array copies: jax outputs are read-only views
-                plugin_predicate_mask(
-                    jnp.asarray(st.tasks.selector),
-                    jnp.asarray(st.tasks.has_unknown_selector),
-                    jnp.asarray(st.nodes.labels),
-                    jnp.asarray(st.nodes.unschedulable),
+            mask = None
+            from scheduler_tpu.ops import pallas_kernels
+
+            if pallas_kernels.pallas_enabled():
+                # One fused Pallas kernel: selector + taint matmuls (MXU) and
+                # the unknown/unschedulable gates in a single [T, N] tile pass.
+                try:
+                    mask = pallas_kernels.static_predicate_mask(
+                        st.tasks.selector,
+                        st.tasks.has_unknown_selector,
+                        st.nodes.labels,
+                        st.nodes.unschedulable,
+                        st.nodes.taints,
+                        st.tasks.tolerated,
+                    )
+                except Exception:  # pragma: no cover - backend-specific
+                    logger.exception("pallas predicate kernel failed; jnp fallback")
+                    mask = None
+            if mask is None:
+                mask = np.array(  # np.array copies: jax outputs are read-only views
+                    plugin_predicate_mask(
+                        jnp.asarray(st.tasks.selector),
+                        jnp.asarray(st.tasks.has_unknown_selector),
+                        jnp.asarray(st.nodes.labels),
+                        jnp.asarray(st.nodes.unschedulable),
+                    )
                 )
-            )
-            mask &= np.asarray(
-                taint_mask(jnp.asarray(st.nodes.taints), jnp.asarray(st.tasks.tolerated))
-            )
+                mask &= np.asarray(
+                    taint_mask(jnp.asarray(st.nodes.taints), jnp.asarray(st.tasks.tolerated))
+                )
             # Required node affinity terms (host-evaluated, static per session).
             task_by_uid: Dict[str, TaskInfo] = {}
             for job in ssn.jobs.values():

@@ -13,6 +13,10 @@ def rng():
 
 
 def test_native_builds_and_loads():
+    import os
+
+    if os.environ.get("SCHEDULER_TPU_NATIVE", "1") in ("0", "false"):
+        pytest.skip("native explicitly disabled via SCHEDULER_TPU_NATIVE")
     assert native.build() is not None
     assert native.available()
 

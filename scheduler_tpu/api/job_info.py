@@ -9,6 +9,8 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+import numpy as np
+
 from scheduler_tpu.api.resource import ResourceVec
 from scheduler_tpu.api.types import TaskStatus, allocated_status, get_task_status
 from scheduler_tpu.api.unschedule_info import FitErrors
@@ -72,7 +74,9 @@ class TaskInfo:
         self.pod: PodSpec = pod
         self.volume_ready: bool = False
         self.req_sig_cache: Optional[bytes] = None
-        self.resreq_empty_cache: Optional[bool] = None
+        # Computed eagerly: clones inherit it, so the per-cycle snapshot's
+        # fresh task copies never re-run the epsilon compare (100k/cycle).
+        self.resreq_empty_cache: Optional[bool] = self.resreq.is_empty()
 
     @property
     def creation_timestamp(self) -> float:
@@ -159,6 +163,14 @@ class JobInfo:
         self.nodes_fit_delta: Dict[str, ResourceVec] = {}  # node -> shortfall
         self.job_fit_errors: str = ""
 
+        # Cached dense request matrices (see request_matrices): rebuilt only
+        # when the task SET changes — status moves keep them valid, and clones
+        # share them, so steady-state snapshot tensor builds gather rows
+        # instead of copying 100k vectors per cycle.
+        self._req_matrix = None
+        self._init_req_matrix = None
+        self._req_row_of: Optional[Dict[str, int]] = None
+
     # -- PodGroup binding ---------------------------------------------------
 
     def set_pod_group(self, pg: PodGroup) -> None:
@@ -171,6 +183,33 @@ class JobInfo:
 
     def unset_pod_group(self) -> None:
         self.pod_group = None
+
+    def request_matrices(self):
+        """(resreq [n, R] f64, init_resreq [n, R] f64, uid -> row) over this
+        job's tasks.  Rows are exact copies of each task's request vectors
+        (immutable after creation), so gathers from these matrices are
+        byte-identical to reading ``task.resreq.array`` per task."""
+        if self._req_matrix is None or self._req_row_of is None:
+            n = len(self.tasks)
+            r = self.vocab.size
+            req = np.zeros((n, r), dtype=np.float64)
+            init = np.zeros((n, r), dtype=np.float64)
+            row_of: Dict[str, int] = {}
+            for i, (uid, task) in enumerate(self.tasks.items()):
+                arr = task.resreq.array
+                req[i, : arr.shape[0]] = arr
+                arr = task.init_resreq.array
+                init[i, : arr.shape[0]] = arr
+                row_of[uid] = i
+            self._req_matrix = req
+            self._init_req_matrix = init
+            self._req_row_of = row_of
+        return self._req_matrix, self._init_req_matrix, self._req_row_of
+
+    def _invalidate_request_matrices(self) -> None:
+        self._req_matrix = None
+        self._init_req_matrix = None
+        self._req_row_of = None
 
     # -- task CRUD (status-indexed, job_info.go:238-292) --------------------
 
@@ -190,6 +229,7 @@ class JobInfo:
         if allocated_status(ti.status):
             self.allocated.add(ti.resreq)
         self.total_request.add(ti.resreq)
+        self._invalidate_request_matrices()
 
     def delete_task_info(self, ti: TaskInfo) -> None:
         task = self.tasks.get(ti.uid)
@@ -200,6 +240,7 @@ class JobInfo:
         self.total_request.sub(task.resreq)
         del self.tasks[task.uid]
         self._delete_from_index(task)
+        self._invalidate_request_matrices()
 
     def update_task_status(self, ti: TaskInfo, status: TaskStatus) -> None:
         """Move a task between status buckets, maintaining the allocated aggregate."""
@@ -337,6 +378,11 @@ class JobInfo:
             bucket[t.uid] = t
         job.allocated = self.allocated.clone()
         job.total_request = self.total_request.clone()
+        # Same task set, shared (immutable) request vectors -> the cached
+        # request matrices stay valid for the clone.
+        job._req_matrix = self._req_matrix
+        job._init_req_matrix = self._init_req_matrix
+        job._req_row_of = self._req_row_of
         return job
 
     def __repr__(self) -> str:

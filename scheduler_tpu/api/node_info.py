@@ -127,6 +127,32 @@ class NodeInfo:
             return
         from scheduler_tpu.api.resource import sum_rows
 
+        if agg is not None:
+            # Trusted engine batch (CommitPlan): no per-task ledger gathering.
+            # ALL validation runs before any state mutates (same atomicity
+            # promise as the generic path): one uid-set pass replaces the
+            # per-task membership probes.
+            releasing_status = TaskStatus.RELEASING
+            clones = []
+            for task in tasks:
+                if task.status is releasing_status:
+                    raise ValueError("agg fast path does not cover RELEASING tasks")
+                clones.append(task.clone_shared())
+            uids = {t.uid for t in clones}
+            if len(uids) != len(clones) or not self.tasks.keys().isdisjoint(uids):
+                raise ValueError(f"duplicate task in bulk add on node {self.name}")
+            a_idle_sub, a_rel_sub, a_used_add, n_alloc, n_pipe = agg
+            if self.node is not None:
+                if n_alloc:
+                    self.idle.sub_array(a_idle_sub)
+                if n_pipe:
+                    self.releasing.sub_array(a_rel_sub)
+                self.used.add_array(a_used_add)
+            node_tasks = self.tasks
+            for ti in clones:
+                node_tasks[ti.uid] = ti
+            return
+
         idle_sub = []
         rel_add = []
         rel_sub = []
@@ -140,7 +166,7 @@ class NodeInfo:
                 )
             batch_uids.add(task.uid)
             ti = task.clone_shared()
-            if self.node is not None and agg is None:
+            if self.node is not None:
                 if ti.status == TaskStatus.RELEASING:
                     rel_add.append(ti.resreq)
                     idle_sub.append(ti.resreq)
@@ -149,25 +175,15 @@ class NodeInfo:
                 else:
                     idle_sub.append(ti.resreq)
                 used_add.append(ti.resreq)
-            elif agg is not None and ti.status == TaskStatus.RELEASING:
-                raise ValueError("agg fast path does not cover RELEASING tasks")
             clones.append(ti)
-        if agg is not None and self.node is not None:
-            a_idle_sub, a_rel_sub, a_used_add, n_alloc, n_pipe = agg
-            if n_alloc:
-                self.idle.sub_array(a_idle_sub)
-            if n_pipe:
-                self.releasing.sub_array(a_rel_sub)
-            self.used.add_array(a_used_add)
-        else:
-            if idle_sub:
-                self.idle.sub_array(sum_rows(idle_sub)[0])
-            if rel_add:
-                self.releasing.add_array(*sum_rows(rel_add))
-            if rel_sub:
-                self.releasing.sub_array(sum_rows(rel_sub)[0])
-            if used_add:
-                self.used.add_array(*sum_rows(used_add))
+        if idle_sub:
+            self.idle.sub_array(sum_rows(idle_sub)[0])
+        if rel_add:
+            self.releasing.add_array(*sum_rows(rel_add))
+        if rel_sub:
+            self.releasing.sub_array(sum_rows(rel_sub)[0])
+        if used_add:
+            self.used.add_array(*sum_rows(used_add))
         for ti in clones:
             self.tasks[ti.uid] = ti
 

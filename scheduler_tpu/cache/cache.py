@@ -266,6 +266,13 @@ class SchedulerCache(Cache):
                 if job.pod_group is None:
                     logger.debug("job %s skipped in snapshot: missing PodGroup", job_id)
                     continue
+                # Build request matrices on the PERSISTENT job so the cache
+                # amortizes them across cycles (clones inherit the built refs;
+                # building lazily on a clone would be lost at session close).
+                # Only jobs with pending tasks feed the task tensors — a huge
+                # all-running job must not pay a rebuild on every churn cycle.
+                if TaskStatus.PENDING in job.task_status_index:
+                    job.request_matrices()
                 clone = job.clone()
                 if clone.pod_group is not None:
                     pc = self.priority_classes.get(clone.pod_group.priority_class_name)

@@ -192,12 +192,16 @@ class PredicatesPlugin(Plugin):
             if t == 0:
                 return np.ones((0, st.nodes.count), dtype=bool)
             mask = None
-            from scheduler_tpu.ops import pallas_kernels
+            import os
 
-            if pallas_kernels.pallas_enabled():
+            if os.environ.get("SCHEDULER_TPU_PALLAS", "1") not in ("0", "false"):
                 # One fused Pallas kernel: selector + taint matmuls (MXU) and
                 # the unknown/unschedulable gates in a single [T, N] tile pass.
+                # Import inside the try: a jax build without pallas-TPU support
+                # must fall back to the jnp path, not crash the session.
                 try:
+                    from scheduler_tpu.ops import pallas_kernels
+
                     mask = pallas_kernels.static_predicate_mask(
                         st.tasks.selector,
                         st.tasks.has_unknown_selector,

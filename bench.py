@@ -66,11 +66,16 @@ def main() -> None:
     # the measured cycle; warm with the exact same problem instead.
     one_cycle(n_nodes, n_pods, tasks_per_job)
 
-    binds, elapsed = one_cycle(n_nodes, n_pods, tasks_per_job)
-    if binds == 0:
+    # Median of three measured cycles: the tunneled-TPU round trips have
+    # multi-hundred-ms jitter, and the metric is the STEADY-state cycle rate.
+    runs = [one_cycle(n_nodes, n_pods, tasks_per_job) for _ in range(1 if smoke else 3)]
+    if any(b != runs[0][0] for b, _ in runs) or runs[0][0] == 0:
         print(json.dumps({"metric": "pods_per_sec", "value": 0.0, "unit": "pods/s",
-                          "vs_baseline": 0.0, "error": "no binds"}))
+                          "vs_baseline": 0.0,
+                          "error": f"unstable binds: {[b for b, _ in runs]}"}))
         sys.exit(1)
+    # (binds, elapsed) from the same median-elapsed run.
+    binds, elapsed = sorted(runs, key=lambda r: r[1])[len(runs) // 2]
 
     pods_per_sec = binds / elapsed
     print(json.dumps({
@@ -83,6 +88,7 @@ def main() -> None:
             "pods": n_pods,
             "binds": binds,
             "cycle_seconds": round(elapsed, 3),
+            "cycles_seconds_all": [round(el, 3) for _, el in runs],
             "backend": _backend(),
         },
     }))

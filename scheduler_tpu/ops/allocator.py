@@ -77,6 +77,24 @@ def score_weights(ssn) -> Tuple[float, float, float]:
     )
 
 
+def build_static_tensors(ssn, st: SnapshotTensors, n_bucket: int):
+    """Session-static ([T, N_bucket] bool mask, [T, N_bucket] f32 score): the
+    node-ready gate AND every registered device predicate, plus the summed
+    static scorer contributions (node-axis padded; pad nodes are infeasible)."""
+    t_count = max(st.tasks.count, 1)
+    base = np.asarray(base_static_mask(t_count, jnp.asarray(st.nodes.ready)))
+    for name, builder in ssn.device_predicates.items():
+        contribution = np.asarray(builder(st))
+        base = base & contribution
+    mask = np.asarray(pad_rows(base.T.astype(bool), n_bucket, fill=False)).T
+
+    score = np.zeros((t_count, st.nodes.count), dtype=np.float32)
+    for name, builder in ssn.device_scorers.items():
+        score = score + np.asarray(builder(st), dtype=np.float32)
+    score = np.asarray(pad_rows(score.T, n_bucket, fill=0.0)).T
+    return mask, score
+
+
 def node_state_from_tensors(st: SnapshotTensors, policy: DevicePolicy, n_bucket: int) -> NodeState:
     """Padded, unit-scaled device NodeState from host snapshot tensors."""
     r = policy.vocab.size
@@ -123,24 +141,12 @@ class DeviceAllocator:
         self.node_names = self.st.nodes.names
         self.state = node_state_from_tensors(self.st, self.policy, self.n_bucket)
 
-        # Static [T, N] predicate mask: node-ready gate AND every device
-        # predicate a plugin registered (selector/taint enforcement lives in the
-        # predicates plugin, matching the reference's plugin split).
-        t_count = max(self.st.tasks.count, 1)
-        base = np.asarray(
-            base_static_mask(t_count, jnp.asarray(self.st.nodes.ready))
+        # Static [T, N] predicate mask + score (selector/taint enforcement
+        # lives in the predicates plugin, matching the reference's plugin
+        # split).
+        self.static_mask, self.static_score = build_static_tensors(
+            ssn, self.st, self.n_bucket
         )
-        for name, builder in ssn.device_predicates.items():
-            contribution = np.asarray(builder(self.st))
-            base = base & contribution
-        self.static_mask = np.asarray(
-            pad_rows(base.T.astype(bool), self.n_bucket, fill=False)
-        ).T  # pad the node axis
-
-        score = np.zeros((t_count, n), dtype=np.float32)
-        for name, builder in ssn.device_scorers.items():
-            score = score + np.asarray(builder(self.st), dtype=np.float32)
-        self.static_score = np.asarray(pad_rows(score.T, self.n_bucket, fill=0.0)).T
 
         self.weights: Tuple[float, float, float] = score_weights(ssn)
 

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -49,7 +50,9 @@ import numpy as np
 
 from scheduler_tpu.api.job_info import JobInfo, TaskInfo
 from scheduler_tpu.api.tensors import bucket, build_snapshot_tensors
+from scheduler_tpu.api.types import TaskStatus
 from scheduler_tpu.ops.allocator import (
+    build_static_tensors,
     collect_pending,
     gang_ready_active,
     node_state_from_tensors,
@@ -83,8 +86,8 @@ _KNOWN_JOB_ORDER = ("priority", "gang", "drf")
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "comparators", "queue_comparators", "overused_gate", "weights",
-        "enforce_pod_count", "window", "batch_runs",
+        "comparators", "queue_comparators", "overused_gate", "use_static",
+        "weights", "enforce_pod_count", "window", "batch_runs",
     ),
 )
 def fused_allocate(
@@ -99,6 +102,10 @@ def fused_allocate(
     # flat task tensors (task order within job, job-major, task-bucket padded)
     init_resreq: jnp.ndarray,   # f32 [T, R]
     resreq: jnp.ndarray,        # f32 [T, R]
+    # session-static per-(task, node) tensors; [1, 1] dummies when use_static
+    # is False (the kernel never touches them then)
+    static_mask: jnp.ndarray,   # bool [T, N]
+    static_score: jnp.ndarray,  # f32 [T, N]
     # job tensors (job-bucket padded)
     job_task_offset: jnp.ndarray,  # i32 [J]
     job_task_num: jnp.ndarray,     # i32 [J] (0 for padding)
@@ -126,6 +133,7 @@ def fused_allocate(
     comparators: Tuple[str, ...],
     queue_comparators: Tuple[str, ...] = (),
     overused_gate: bool = False,
+    use_static: bool = False,
     weights: Tuple[float, float, float],
     enforce_pod_count: bool,
     window: int = 1,
@@ -239,11 +247,15 @@ def fused_allocate(
         fit_idle = fit_mask(init_req, idle, mins)
         fit_rel = fit_mask(init_req, releasing, mins)
         feasible = (fit_idle | fit_rel) & node_gate
+        if use_static:
+            feasible = feasible & static_mask[t_idx]
         if enforce_pod_count:
             feasible = feasible & (task_count < pods_limit)
         any_feasible = jnp.any(feasible)
 
         score = dynamic_score(req, idle, allocatable, *weights)
+        if use_static:
+            score = score + static_score[t_idx]
         masked_score = jnp.where(feasible, score, neg_inf)
         best = jnp.argmax(masked_score)
 
@@ -449,6 +461,10 @@ class FusedAllocator:
         st = build_snapshot_tensors(node_list, self.jobs, flat, queue_names, vocab)
         self.st = st
         self._queues_of_jobs = queues_idx
+
+        # Session-static [T, N] mask/score (device predicates + scorers),
+        # fused into the placement loop.  Size-gated by `supported`.
+        self.use_static = bool(ssn.device_predicates or ssn.device_scorers)
         self.node_names = st.nodes.names
         n = st.nodes.count
         nb = bucket(max(n, 1))
@@ -462,9 +478,21 @@ class FusedAllocator:
 
         total = st.nodes.allocatable.sum(axis=0)
 
+        # Session-static [T, N] mask/score, padded on both axes.
+        if self.use_static:
+            s_mask, s_score = build_static_tensors(ssn, st, nb)
+            static_mask_host = pad_rows(s_mask, tb, fill=False)
+            static_score_host = pad_rows(s_score, tb, fill=0.0)
+        else:
+            s_mask = s_score = None
+            static_mask_host = np.ones((1, 1), dtype=bool)
+            static_score_host = np.zeros((1, 1), dtype=np.float32)
+
         # Run lengths: consecutive tasks (within one job) with identical
         # request rows, counted from each position — the device batches a whole
-        # run per placement step under binpack-only scoring.
+        # run per placement step under binpack-only scoring.  With static
+        # tensors, a run must also share its mask/score rows (same requests do
+        # not imply same selectors), so those break runs too.
         t_count = len(flat)
         run_host = np.ones(tb, dtype=np.int32)
         if t_count > 1:
@@ -475,6 +503,25 @@ class FusedAllocator:
                 st.tasks.init_resreq[:t_count],
                 st.tasks.job_idx[:t_count],
             )
+            if self.use_static:
+                same_static = np.all(s_mask[1:t_count] == s_mask[: t_count - 1], axis=1) & np.all(
+                    s_score[1:t_count] == s_score[: t_count - 1], axis=1
+                )
+                breaks = np.zeros(t_count, dtype=bool)
+                breaks[1:] = ~same_static
+                # Recompute run lengths bounded by BOTH request runs and
+                # static-row runs: a position's run is the min of its request
+                # run and the distance to the next static break.
+                next_break = np.full(t_count, t_count, dtype=np.int64)
+                bpos = np.nonzero(breaks)[0]
+                if bpos.size:
+                    idx = np.searchsorted(bpos, np.arange(t_count), side="right")
+                    has_nb = idx < bpos.size
+                    next_break[has_nb] = bpos[idx[has_nb]]
+                run_host[:t_count] = np.minimum(
+                    run_host[:t_count],
+                    (next_break - np.arange(t_count)).astype(np.int32),
+                )
 
         self.weights = score_weights(ssn)
         # Run batching is exact only when the chosen node's score cannot drop
@@ -523,6 +570,8 @@ class FusedAllocator:
             state.mins,
             jnp.asarray(pad_rows(scale_columns(st.tasks.init_resreq, scale), tb)),
             jnp.asarray(pad_rows(scale_columns(st.tasks.resreq, scale), tb)),
+            jnp.asarray(static_mask_host),
+            jnp.asarray(static_score_host),
             jnp.asarray(offsets),
             jnp.asarray(nums),
             jnp.asarray(deficits),
@@ -546,8 +595,25 @@ class FusedAllocator:
         """True iff every registered callback is in the fused builtin set."""
         if not ssn.nodes:
             return False
-        if ssn.predicate_fns or ssn.device_predicates or ssn.device_scorers:
-            return False  # [T, N] static masks/scores not fused yet (v1)
+        # Host predicates need device counterparts; static [T, N] tensors are
+        # fused when they fit the device-memory budget (bool mask + f32 score
+        # = 5 bytes per element; past it, the per-pop engine slices masks per
+        # job instead).  SCHEDULER_TPU_FUSED_STATIC_LIMIT is in BYTES.
+        for name in ssn.predicate_fns:
+            if name not in ssn.device_predicates:
+                return False
+        if ssn.device_predicates or ssn.device_scorers:
+            n_bucket = bucket(max(len(ssn.nodes), 1))
+            pending = sum(
+                1
+                for job in ssn.jobs.values()
+                for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
+                if not t.resreq_empty
+            )
+            t_bucket = bucket(max(pending, 1))
+            limit = int(os.environ.get("SCHEDULER_TPU_FUSED_STATIC_LIMIT", str(160 * 1024 * 1024)))
+            if 5 * t_bucket * n_bucket > limit:
+                return False
         if set(ssn.job_order_fns) - set(_KNOWN_JOB_ORDER):
             return False
         if set(ssn.queue_order_fns) - {"proportion"}:
@@ -588,6 +654,7 @@ class FusedAllocator:
                 comparators=self.comparators,
                 queue_comparators=self.queue_comparators,
                 overused_gate=self.overused_gate,
+                use_static=self.use_static,
                 weights=self.weights,
                 enforce_pod_count=self.enforce_pod_count,
                 window=self._window_size(),

@@ -315,3 +315,117 @@ def test_proportion_pipelined_parity(seed):
     assert any(s == "PIPELINED" for s in results["host"][1].values())
     assert results["fused"] == results["per-pop"], "fused vs per-pop"
     assert results["fused"] == results["host"], "fused vs host"
+
+
+CONF_PREDICATES = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: predicates
+  - name: nodeorder
+"""
+
+CONF_PREDICATES_BINPACK = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: predicates
+  - name: binpack
+"""
+
+
+def build_labeled_cluster(seed=0, n_nodes=10, n_jobs=8, tasks_per_job=4):
+    """Nodes with zone/disk labels and a tainted subset; tasks with selectors
+    and mixed tolerations — drives the static [T, N] mask through the fused
+    engine."""
+    from scheduler_tpu.apis.objects import Taint, Toleration
+
+    rng = np.random.default_rng(seed)
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    for i in range(n_nodes):
+        taints = [Taint(key="dedicated", value="infra", effect="NoSchedule")] if i % 4 == 0 else []
+        node = build_node(
+            f"n{i:03d}", {"cpu": 8000.0, "memory": 16 * 1024**3},
+            labels={"zone": f"z{i % 3}", "disk": "ssd" if i % 2 else "hdd"},
+        )
+        node.taints = taints
+        cache.add_node(node)
+    for j in range(n_jobs):
+        group = f"job{j}"
+        size = int(rng.integers(1, tasks_per_job + 1))
+        cache.add_pod_group(build_pod_group(
+            group, min_member=int(rng.integers(1, size + 1))))
+        for t in range(size):
+            pod = build_pod(
+                name=f"{group}-{t}",
+                req={"cpu": float(rng.choice([1000, 2000])),
+                     "memory": float(rng.choice([2, 4])) * 1024**3},
+                groupname=group,
+                priority=int(rng.integers(0, 3)),
+                selector=(
+                    {"zone": f"z{j % 3}"} if j % 3 == 0
+                    else ({"disk": "ssd"} if j % 3 == 1 else {})
+                ),
+            )
+            if j % 2 == 0:
+                pod.tolerations = [Toleration(key="dedicated", operator="Equal",
+                                              value="infra", effect="NoSchedule")]
+            cache.add_pod(pod)
+    return cache
+
+
+@pytest.mark.parametrize("conf", [CONF_PREDICATES, CONF_PREDICATES_BINPACK])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_static_fused_three_engines_agree(conf, seed):
+    results = {}
+    for name, env in ENGINES.items():
+        cache = build_labeled_cluster(seed=seed)
+        results[name] = run_engine(cache, conf, env)
+    assert results["fused"] == results["per-pop"], "fused vs per-pop"
+    assert results["fused"] == results["host"], "fused vs host"
+
+
+def test_static_fused_engine_selected():
+    from scheduler_tpu.framework import open_session as _open
+    from scheduler_tpu.ops.fused import FusedAllocator
+
+    cache = build_labeled_cluster(seed=0)
+    conf = parse_scheduler_conf(CONF_PREDICATES)
+    ssn = _open(cache, conf.tiers)
+    assert FusedAllocator.supported(ssn)
+    close_session(ssn)
+
+
+def test_static_run_batching_breaks_on_selector_change():
+    # One gang, identical requests, but the tasks alternate selectors — the
+    # run-batched binpack path must break runs at mask boundaries instead of
+    # placing the whole run under the first task's mask.
+    outs = {}
+    for name, env in ENGINES.items():
+        cache2 = SchedulerCache(vocab=make_vocab(), async_io=False)
+        cache2.run()
+        cache2.add_queue(build_queue("default"))
+        for i in range(4):
+            cache2.add_node(build_node(
+                f"n{i}", {"cpu": 4000.0, "memory": 8 * 1024**3},
+                labels={"zone": "za" if i < 2 else "zb"}))
+        cache2.add_pod_group(build_pod_group("mix", min_member=6))
+        for t in range(6):
+            cache2.add_pod(build_pod(
+                name=f"mix-{t}", req={"cpu": 1000.0, "memory": 1024**3},
+                groupname="mix", selector={"zone": "za" if t % 2 == 0 else "zb"}))
+        outs[name] = run_engine(cache2, CONF_PREDICATES_BINPACK, env)
+    assert outs["fused"] == outs["host"]
+    binds, _ = outs["fused"]
+    assert len(binds) == 6
+    for pod, node in binds.items():
+        t = int(pod.rsplit("-", 1)[1])
+        want = ("n0", "n1") if t % 2 == 0 else ("n2", "n3")
+        assert node in want, (pod, node)

@@ -96,3 +96,50 @@ def test_sharded_selector_mask_matches_dense():
     got = np.asarray(sharded_selector_mask(jnp.asarray(sel), jnp.asarray(labels), mesh=mesh))
     ref = (sel.astype(np.float32) @ (~labels).astype(np.float32).T) == 0
     np.testing.assert_array_equal(got, ref)
+
+
+def test_fused_engine_node_sharded_matches_single_device():
+    """The WHOLE fused allocate program runs with the node axis sharded over
+    the 8-device mesh (GSPMD inserts the collectives) and must produce the
+    same placement codes as the replicated run."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import scheduler_tpu.actions  # noqa: F401
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.actions.allocate import collect_candidates
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.framework import close_session, open_session
+    from scheduler_tpu.ops import fused as F
+    from tests.test_fused import CONF_PROPORTION, build_weighted_cluster
+
+    cache = build_weighted_cluster(seed=0, n_nodes=16)
+    ssn = open_session(cache, parse_scheduler_conf(CONF_PROPORTION).tiers)
+    eng = F.FusedAllocator(ssn, collect_candidates(ssn))
+
+    def call(args):
+        return np.asarray(F.fused_allocate(
+            *args, comparators=eng.comparators,
+            queue_comparators=eng.queue_comparators,
+            overused_gate=eng.overused_gate, use_static=eng.use_static,
+            weights=eng.weights, enforce_pod_count=eng.enforce_pod_count,
+            window=4, batch_runs=eng.batch_runs,
+        ))
+
+    base = call(eng.args)
+
+    mesh = make_mesh()
+    node_vec = NamedSharding(mesh, P(NODE_AXIS))
+    node_mat = NamedSharding(mesh, P(NODE_AXIS, None))
+    rep = NamedSharding(mesh, P())
+    # fused_allocate positional order: idle, releasing, task_count,
+    # allocatable, pods_limit, node_gate, mins, init_resreq, resreq,
+    # static_mask, static_score, then job/queue tensors (replicated).
+    specs = [node_mat, node_mat, node_vec, node_mat, node_vec, node_vec,
+             rep, rep, rep, rep, rep] + [rep] * (len(eng.args) - 11)
+    sharded = tuple(
+        jax.device_put(np.asarray(a), s) for a, s in zip(eng.args, specs)
+    )
+    out = call(sharded)
+    close_session(ssn)
+    np.testing.assert_array_equal(base, out)

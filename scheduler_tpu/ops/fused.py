@@ -305,12 +305,13 @@ def fused_allocate(
         else:
             m = jnp.int32(1)
 
-        delta = jnp.zeros_like(idle).at[best].set(req)
-        idle = idle - delta * (alloc_here * m.astype(idle.dtype))
-        releasing = releasing - delta * pipe_here
-        task_count = task_count + (
-            (jnp.arange(n) == best) & (alloc_here | pipe_here)
-        ) * jnp.where(alloc_here, m, 1)
+        # Row-targeted scatter-adds: a full [N, R] dense delta per step would
+        # cost N*R elementwise work per placement; these touch one row.
+        idle = idle.at[best].add(-req * (alloc_here * m.astype(idle.dtype)))
+        releasing = releasing.at[best].add(-req * pipe_here)
+        task_count = task_count.at[best].add(
+            (alloc_here | pipe_here) * jnp.where(alloc_here, m, 1)
+        )
 
         consumed = jnp.where(
             alloc_here, m, (pipe_here | failed).astype(jnp.int32)

@@ -182,7 +182,8 @@ def run(opt: ServerOption, stop: Optional[threading.Event] = None,
             load_cluster_state(cache, cluster_state)
 
     server = serve_metrics(opt.listen_address, cache)
-    sched = Scheduler(cache, opt.scheduler_conf, opt.schedule_period)
+    sched = Scheduler(cache, opt.scheduler_conf, opt.schedule_period,
+                      profile_dir=opt.profile_dir)
     stop = stop or threading.Event()
 
     def lead(stop_event: threading.Event) -> None:

@@ -32,6 +32,9 @@ class ServerOption:
     lock_file: str = DEFAULT_LOCK_FILE
     enable_priority_class: bool = True
     io_workers: int = 8
+    # xprof/TensorBoard trace dir; per-cycle JAX profiler traces when set
+    # (the pprof analogue, main.go:24-25 -> SURVEY.md §5).
+    profile_dir: Optional[str] = None
 
 
 # The reference keeps a mutable global the cache reads back
@@ -78,6 +81,10 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
         "--io-workers", default=8, type=int,
         help="async bind/evict executor workers (the QPS/burst analogue)",
     )
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="write JAX profiler (xprof) traces of the first cycles to this directory",
+    )
 
 
 def option_from_namespace(ns: argparse.Namespace) -> ServerOption:
@@ -92,6 +99,7 @@ def option_from_namespace(ns: argparse.Namespace) -> ServerOption:
         enable_leader_election=ns.leader_elect,
         lock_file=ns.lock_file,
         io_workers=ns.io_workers,
+        profile_dir=ns.profile_dir,
     )
 
 

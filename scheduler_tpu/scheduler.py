@@ -30,12 +30,23 @@ class Scheduler:
         cache,
         scheduler_conf: Optional[str] = None,
         schedule_period: float = 1.0,
+        profile_dir: Optional[str] = None,
     ) -> None:
         self.cache = cache
         self.scheduler_conf = scheduler_conf
         self.schedule_period = schedule_period
+        # xprof trace directory (SURVEY.md §5: JAX profiler traces around the
+        # session kernel).  Only the first PROFILE_CYCLES cycles are traced —
+        # one compiling cycle plus steady-state samples — each into its own
+        # subdirectory (sub-second cycles would otherwise collide in the
+        # profiler's second-resolution run dirs), so a long-running daemon
+        # never grows the directory unboundedly.
+        self.profile_dir = profile_dir
+        self._profiled_cycles = 0
         self.actions: List[Action] = []
         self.conf: Optional[SchedulerConfiguration] = None
+
+    PROFILE_CYCLES = 3
 
     def _load_conf(self) -> None:
         """scheduler.go:70-83: resolve the action list once, at startup."""
@@ -65,6 +76,21 @@ class Scheduler:
         """One scheduling cycle (scheduler.go:88-102)."""
         if self.conf is None:
             self._load_conf()
+        if self.profile_dir and self._profiled_cycles < self.PROFILE_CYCLES:
+            import os
+
+            import jax
+
+            cycle_dir = os.path.join(
+                self.profile_dir, f"cycle{self._profiled_cycles:04d}"
+            )
+            self._profiled_cycles += 1
+            with jax.profiler.trace(cycle_dir):
+                self._run_once_inner()
+        else:
+            self._run_once_inner()
+
+    def _run_once_inner(self) -> None:
         start = time.perf_counter()
         ssn = open_session(self.cache, self.conf.tiers)
         try:

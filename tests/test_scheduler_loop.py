@@ -159,3 +159,27 @@ def test_leader_election_single_holder(tmp_path):
     stop_b.set()
     ta.join(timeout=2)
     tb.join(timeout=2)
+
+
+def test_profile_dir_writes_trace(tmp_path):
+    """--profile-dir wraps each cycle in a JAX profiler trace (SURVEY §5's
+    pprof analogue); the trace directory must be populated after a cycle."""
+    import scheduler_tpu.actions  # noqa: F401
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.cache import SchedulerCache
+    from scheduler_tpu.scheduler import Scheduler
+    from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
+
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    cache.add_node(build_node("n0", {"cpu": 4000, "memory": 8 * 1024**3}))
+    cache.add_pod_group(build_pod_group("j", min_member=1))
+    cache.add_pod(build_pod(name="j-0", req={"cpu": 1000, "memory": 1024**3}, groupname="j"))
+
+    prof = tmp_path / "xprof"
+    sched = Scheduler(cache, schedule_period=0.01, profile_dir=str(prof))
+    sched.run_once()
+    assert cache.binder.binds
+    traced = list(prof.rglob("*"))
+    assert traced, "profiler trace directory is empty"

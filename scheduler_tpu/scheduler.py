@@ -10,9 +10,12 @@ Configuration is read once at ``run`` (no hot reload), like the reference.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import List, Optional
+
+import jax
 
 import scheduler_tpu.actions  # noqa: F401  registry side effects (factory.go:29-35)
 import scheduler_tpu.plugins  # noqa: F401
@@ -77,16 +80,30 @@ class Scheduler:
         if self.conf is None:
             self._load_conf()
         if self.profile_dir and self._profiled_cycles < self.PROFILE_CYCLES:
-            import os
-
-            import jax
-
             cycle_dir = os.path.join(
                 self.profile_dir, f"cycle{self._profiled_cycles:04d}"
             )
             self._profiled_cycles += 1
-            with jax.profiler.trace(cycle_dir):
+            # A diagnostics flag must never cost a scheduling cycle: trace
+            # setup OR export can fail (unwritable path surfaces only at
+            # stop_and_export) -> log, disable profiling, keep scheduling.
+            trace = None
+            try:
+                trace = jax.profiler.trace(cycle_dir)
+                trace.__enter__()
+            except Exception:
+                logger.exception("profiler trace setup failed; disabling")
+                self.profile_dir = None
+                trace = None
+            try:
                 self._run_once_inner()
+            finally:
+                if trace is not None:
+                    try:
+                        trace.__exit__(None, None, None)
+                    except Exception:
+                        logger.exception("profiler trace export failed; disabling")
+                        self.profile_dir = None
         else:
             self._run_once_inner()
 

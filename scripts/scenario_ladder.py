@@ -1,0 +1,273 @@
+"""The BASELINE.json scenario ladder at full (or scaled) size, one JSON line
+per scenario.
+
+Usage: PYTHONPATH=. python scripts/scenario_ladder.py [--scale F]
+
+  1. example gang: 6-task gang onto 3 nodes, allocate only
+  2. kubemark density: 1k nodes x 5k pods, predicates + nodeorder
+  3. binpack+drf: 10k nodes x 100k pods (the bench.py headline)
+  4. 2-queue preempt/reclaim, proportion, over-subscribed
+  5. topology GPU gangs: 1k 8-task PodGroups, 8-GPU nodes, zone selectors
+
+Each scenario runs a warmup cycle (jit compile) then reports the median of
+three measured cycles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.api.vocab import ResourceVocabulary
+from scheduler_tpu.apis.objects import (
+    GROUP_NAME_ANNOTATION,
+    NodeSpec,
+    PodGroup,
+    PodSpec,
+    Queue,
+)
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import close_session, get_action, open_session
+
+GPU = "nvidia.com/gpu"
+
+
+def run_cycle(build, conf_str, actions):
+    conf = parse_scheduler_conf(conf_str)
+    cache = build()
+    start = time.perf_counter()
+    ssn = open_session(cache, conf.tiers)
+    for a in actions:
+        get_action(a).execute(ssn)
+    close_session(ssn)
+    elapsed = time.perf_counter() - start
+    return cache, elapsed
+
+
+def measure(name, build, conf_str, actions, placed_of):
+    run_cycle(build, conf_str, actions)  # warmup/compile
+    results = []
+    for _ in range(3):
+        cache, elapsed = run_cycle(build, conf_str, actions)
+        results.append((placed_of(cache), elapsed))
+    counts = {c for c, _ in results}
+    placed, elapsed = sorted(results, key=lambda r: r[1])[1]
+    print(json.dumps({
+        "scenario": name,
+        "placed": placed,
+        "cycle_seconds": round(elapsed, 3),
+        "placed_per_sec": round(placed / elapsed, 1) if elapsed else 0.0,
+        "stable": len(counts) == 1,
+    }), flush=True)
+
+
+def scenario1():
+    def build():
+        cache = SchedulerCache(vocab=ResourceVocabulary(), async_io=False)
+        cache.run()
+        cache.add_queue(Queue(name="default", weight=1))
+        for i in range(3):
+            cache.add_node(NodeSpec(name=f"node-{i}", allocatable={
+                "cpu": 4000.0, "memory": 16 * 2**30, "pods": 110}))
+        pg = PodGroup(name="qj-1", namespace="d", queue="default", min_member=6)
+        pg.status.phase = "Inqueue"
+        cache.add_pod_group(pg)
+        for t in range(6):
+            cache.add_pod(PodSpec(
+                name=f"qj-1-{t}", namespace="d",
+                containers=[{"cpu": 1000.0, "memory": 2**30}],
+                annotations={GROUP_NAME_ANNOTATION: "qj-1"}))
+        return cache
+
+    conf = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+"""
+    measure("1-example-gang", build, conf, ("allocate",),
+            lambda c: len(c.binder.binds))
+
+
+def scenario2(scale):
+    n_nodes, n_jobs, per_job = int(1000 * scale), int(100 * scale), 50
+
+    def build():
+        rng = np.random.default_rng(0)
+        cache = SchedulerCache(vocab=ResourceVocabulary(), async_io=False)
+        cache.run()
+        cache.add_queue(Queue(name="default", weight=1))
+        for i in range(n_nodes):
+            cache.add_node(NodeSpec(name=f"hollow-{i:05d}", allocatable={
+                "cpu": 16000.0, "memory": 64 * 2**30, "pods": 110},
+                labels={"zone": f"z{i % 4}"}))
+        for j in range(n_jobs):
+            g = f"batch{j}"
+            pg = PodGroup(name=g, namespace="d", queue="default", min_member=1)
+            pg.status.phase = "Inqueue"
+            cache.add_pod_group(pg)
+            for t in range(per_job):
+                cache.add_pod(PodSpec(
+                    name=f"{g}-{t}", namespace="d",
+                    containers=[{"cpu": float(rng.choice([100, 200, 500])),
+                                 "memory": float(rng.choice([1, 2])) * 2**30}],
+                    annotations={GROUP_NAME_ANNOTATION: g},
+                    node_selector={"zone": f"z{j % 4}"} if j % 2 == 0 else {}))
+        return cache
+
+    conf = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: predicates
+  - name: nodeorder
+"""
+    measure("2-kubemark-density", build, conf, ("allocate",),
+            lambda c: len(c.binder.binds))
+
+
+def scenario3(scale):
+    from scheduler_tpu.harness import make_synthetic_cluster
+
+    n_nodes, n_pods = int(10_000 * scale), int(100_000 * scale)
+
+    def build():
+        return make_synthetic_cluster(n_nodes, n_pods, tasks_per_job=100).cache
+
+    conf = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+"""
+    measure("3-binpack-drf", build, conf, ("allocate",),
+            lambda c: len(c.binder.binds))
+
+
+def scenario4(scale):
+    n_nodes = int(1000 * scale)
+    n_run = int(25_000 * scale)
+    n_pend = int(25_000 * scale)
+    gang = 50
+
+    def build():
+        cache = SchedulerCache(vocab=ResourceVocabulary(), async_io=False)
+        cache.run()
+        cache.add_queue(Queue(name="fat", weight=1))
+        cache.add_queue(Queue(name="thin", weight=1))
+        for i in range(n_nodes):
+            cache.add_node(NodeSpec(name=f"n{i:05d}", allocatable={
+                "cpu": float(2000 * (n_run // n_nodes + 1)),
+                "memory": float(4 * 2**30) * (n_run // n_nodes + 1),
+                "pods": 110}))
+        for j in range(n_run // gang):
+            g = f"fat{j}"
+            pg = PodGroup(name=g, namespace="d", queue="fat", min_member=1)
+            pg.status.phase = "Running"
+            cache.add_pod_group(pg)
+            for t in range(gang):
+                i = (j * gang + t) % n_nodes
+                cache.add_pod(PodSpec(
+                    name=f"{g}-{t}", namespace="d",
+                    containers=[{"cpu": 2000.0, "memory": 4 * 2**30}],
+                    annotations={GROUP_NAME_ANNOTATION: g},
+                    node_name=f"n{i:05d}", phase="Running"))
+        for j in range(n_pend // gang):
+            g = f"thin{j}"
+            pg = PodGroup(name=g, namespace="d", queue="thin", min_member=1)
+            pg.status.phase = "Inqueue"
+            cache.add_pod_group(pg)
+            for t in range(gang):
+                cache.add_pod(PodSpec(
+                    name=f"{g}-{t}", namespace="d",
+                    containers=[{"cpu": 2000.0, "memory": 4 * 2**30}],
+                    annotations={GROUP_NAME_ANNOTATION: g}))
+        return cache
+
+    conf = """
+actions: "reclaim"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: proportion
+"""
+    measure("4-two-queue-reclaim", build, conf, ("reclaim",),
+            lambda c: len(c.evictor.evicts))
+
+
+def scenario5(scale):
+    n_nodes, n_gangs, gang = int(1500 * scale), int(1000 * scale), 8
+
+    def build():
+        cache = SchedulerCache(vocab=ResourceVocabulary((GPU,)), async_io=False)
+        cache.run()
+        cache.add_queue(Queue(name="default", weight=1))
+        for i in range(n_nodes):
+            cache.add_node(NodeSpec(
+                name=f"gpu-{i:04d}",
+                allocatable={"cpu": 64000.0, "memory": 256 * 2**30,
+                             GPU: 8.0, "pods": 110},
+                labels={"zone": f"z{i % 8}"}))
+        for j in range(n_gangs):
+            g = f"train{j}"
+            pg = PodGroup(name=g, namespace="d", queue="default", min_member=gang)
+            pg.status.phase = "Inqueue"
+            cache.add_pod_group(pg)
+            for t in range(gang):
+                cache.add_pod(PodSpec(
+                    name=f"{g}-{t}", namespace="d",
+                    containers=[{"cpu": 4000.0, "memory": 16 * 2**30, GPU: 1.0}],
+                    annotations={GROUP_NAME_ANNOTATION: g},
+                    node_selector={"zone": f"z{j % 8}"}))
+        return cache
+
+    conf = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: predicates
+  - name: nodeorder
+"""
+    measure("5-gpu-topology-gangs", build, conf, ("allocate",),
+            lambda c: len(c.binder.binds))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--scale", type=float, default=1.0,
+                        help="size multiplier for scenarios 2-5")
+    parser.add_argument("--only", default=None,
+                        help="comma-separated scenario numbers to run")
+    ns = parser.parse_args()
+    only = {int(x) for x in ns.only.split(",")} if ns.only else {1, 2, 3, 4, 5}
+    if 1 in only:
+        scenario1()
+    if 2 in only:
+        scenario2(ns.scale)
+    if 3 in only:
+        scenario3(ns.scale)
+    if 4 in only:
+        scenario4(ns.scale)
+    if 5 in only:
+        scenario5(ns.scale)
+
+
+if __name__ == "__main__":
+    main()

@@ -183,3 +183,29 @@ def test_profile_dir_writes_trace(tmp_path):
     assert cache.binder.binds
     traced = list(prof.rglob("*"))
     assert traced, "profiler trace directory is empty"
+
+
+def test_profile_dir_failure_does_not_cost_a_cycle(tmp_path):
+    """An unwritable/bogus profile path must degrade to unprofiled scheduling,
+    not abort the cycle."""
+    import scheduler_tpu.actions  # noqa: F401
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.cache import SchedulerCache
+    from scheduler_tpu.scheduler import Scheduler
+    from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
+
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    cache.add_node(build_node("n0", {"cpu": 4000, "memory": 8 * 1024**3}))
+    cache.add_pod_group(build_pod_group("j", min_member=1))
+    cache.add_pod(build_pod(name="j-0", req={"cpu": 1000, "memory": 1024**3}, groupname="j"))
+
+    # A regular FILE where the trace dir should be -> trace setup fails.
+    bogus = tmp_path / "not-a-dir"
+    bogus.write_text("occupied")
+    sched = Scheduler(cache, schedule_period=0.01,
+                      profile_dir=str(bogus / "sub"))
+    sched.run_once()
+    assert cache.binder.binds, "cycle must schedule despite profiler failure"
+    assert sched.profile_dir is None, "profiling should disable itself"

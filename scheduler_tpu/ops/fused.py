@@ -287,21 +287,16 @@ def fused_allocate(
 
             # Largest j such that the j-th sequential placement still fits:
             # fit(init_req, idle[best] - (j-1)*req) with the exact epsilon
-            # rule — binary search, invariant ok(lo) (ok(1) == fit_idle[best]).
+            # rule.  ok(j) is monotone decreasing in j, so evaluate all
+            # MAX_BATCH candidates in one [MAX_BATCH, R] vector pass (a
+            # scalar binary search costs ~8x more tiny sequential ops per
+            # placement step).
             idle_b = idle[best]
-
-            def ok(j):
-                avail = idle_b - (j - 1).astype(idle.dtype) * req
-                return jnp.all((init_req < avail) | (jnp.abs(avail - init_req) < mins))
-
-            lo = jnp.int32(1)
-            hi = hi0
-            for _ in range(MAX_BATCH.bit_length()):
-                mid = (lo + hi + 1) // 2
-                good = ok(mid) & (mid <= hi)
-                lo = jnp.where(good, mid, lo)
-                hi = jnp.where(good, hi, jnp.minimum(hi, mid - 1))
-            m = jnp.where(alloc_here, lo, 1)
+            js = jnp.arange(1, MAX_BATCH + 1, dtype=jnp.int32)
+            avail = idle_b[None, :] - (js - 1).astype(idle.dtype)[:, None] * req[None, :]
+            ok_js = fit_mask(init_req, avail, mins)
+            fit_count = jnp.max(jnp.where(ok_js & (js <= hi0), js, 1))
+            m = jnp.where(alloc_here, fit_count, 1)
         else:
             m = jnp.int32(1)
 

@@ -150,11 +150,18 @@ def fused_allocate(
     total_safe = jnp.where(drf_total > 0, drf_total, 1.0)
     total_mask = drf_total > 0
 
-    def eligible(cursor, left):
-        return (~left) & (cursor < job_task_num)
+    # Packed loop state (fewer scatters per step — each dynamic-update-slice
+    # costs fixed per-op time that dominates the while-loop at scale):
+    #   node_state f32 [N, 2R+1]: idle | releasing | task_count
+    #   job_state  i32 [J, 3]:    cursor | n_alloc | left-count (>0 == left)
+    r_dim = resreq.shape[1]
+    pods_limit_f = pods_limit.astype(jnp.float32)
 
-    def select_job(cursor, left, n_alloc, alloc, q_alloc):
-        elig = eligible(cursor, left)
+    def eligible(job_state):
+        return (job_state[:, 2] == 0) & (job_state[:, 0] < job_task_num)
+
+    def select_job(job_state, alloc, q_alloc):
+        elig = eligible(job_state)
         # Queue pop: queues holding an eligible job, minus overused ones
         # (checked live at every pop like the host loop, allocate.go:101),
         # ordered by the queue comparator chain then creation/uid rank.
@@ -197,7 +204,7 @@ def fused_allocate(
             if name == "priority":
                 key, sentinel = -job_priority, big_i32
             elif name == "gang":
-                key = ((job_gang_order - n_alloc) <= 0).astype(jnp.int32)
+                key = ((job_gang_order - job_state[:, 1]) <= 0).astype(jnp.int32)
                 sentinel = big_i32
             elif name == "drf":
                 frac = jnp.where(
@@ -227,8 +234,9 @@ def fused_allocate(
         ``window`` of these per iteration to amortize loop overhead (the
         semantics are IDENTICAL to window=1 — this is pure unrolling; a
         micro-step whose job pool is exhausted is a masked no-op)."""
-        (idle, releasing, task_count, cursor, left, n_alloc, alloc,
-         q_alloc, cur, out, steps) = state
+        (node_state, job_state, alloc, q_alloc, cur, out, steps) = state
+        idle = node_state[:, :r_dim]
+        releasing = node_state[:, r_dim : 2 * r_dim]
 
         # Selection only runs when the previous pop ended (lax.cond, not
         # where): most steps continue the current job, and the comparator
@@ -236,11 +244,11 @@ def fused_allocate(
         # A HALT stays a HALT (re-selecting would return HALT again).
         cur = jax.lax.cond(
             cur == -1,
-            lambda: select_job(cursor, left, n_alloc, alloc, q_alloc),
+            lambda: select_job(job_state, alloc, q_alloc),
             lambda: cur,
         )
 
-        t_idx = jnp.clip(job_task_offset[cur] + cursor[cur], 0, t_cap - 1)
+        t_idx = jnp.clip(job_task_offset[cur] + job_state[cur, 0], 0, t_cap - 1)
         init_req = init_resreq[t_idx]
         req = resreq[t_idx]
 
@@ -250,7 +258,7 @@ def fused_allocate(
         if use_static:
             feasible = feasible & static_mask[t_idx]
         if enforce_pod_count:
-            feasible = feasible & (task_count < pods_limit)
+            feasible = feasible & (node_state[:, 2 * r_dim] < pods_limit_f)
         any_feasible = jnp.any(feasible)
 
         score = dynamic_score(req, idle, allocatable, *weights)
@@ -278,11 +286,14 @@ def fused_allocate(
             deficit_v = job_deficit[cur_safe]
             # Gang-break room: with no gang veto (deficit 0) the pop ends after
             # every placement, so the batch must stay at 1.
-            room = jnp.where(deficit_v > 0, deficit_v - n_alloc[cur_safe], 1)
+            room = jnp.where(deficit_v > 0, deficit_v - job_state[cur_safe, 1], 1)
             hi0 = jnp.minimum(run_len[t_idx], jnp.int32(MAX_BATCH))
             hi0 = jnp.minimum(hi0, room)
             if enforce_pod_count:
-                hi0 = jnp.minimum(hi0, pods_limit[best] - task_count[best])
+                hi0 = jnp.minimum(
+                    hi0,
+                    pods_limit[best] - node_state[best, 2 * r_dim].astype(jnp.int32),
+                )
             hi0 = jnp.maximum(hi0, 1)
 
             # Largest j such that the j-th sequential placement still fits:
@@ -300,36 +311,41 @@ def fused_allocate(
         else:
             m = jnp.int32(1)
 
-        # Row-targeted scatter-adds: a full [N, R] dense delta per step would
-        # cost N*R elementwise work per placement; these touch one row.
-        idle = idle.at[best].add(-req * (alloc_here * m.astype(idle.dtype)))
-        releasing = releasing.at[best].add(-req * pipe_here)
-        task_count = task_count.at[best].add(
-            (alloc_here | pipe_here) * jnp.where(alloc_here, m, 1)
-        )
+        # ONE packed scatter per ledger: each dynamic-update-slice has a fixed
+        # per-op cost that dominates the loop at scale, so idle/releasing/
+        # task_count update as a single [2R+1] row and cursor/n_alloc/left as
+        # a single [3] row.
+        m_f = m.astype(node_state.dtype)
+        copies = jnp.where(alloc_here, m, 1)
+        node_row = jnp.concatenate([
+            -req * (alloc_here * m_f),
+            -req * pipe_here,
+            (((alloc_here | pipe_here) * copies).astype(node_state.dtype))[None],
+        ])
+        node_state = node_state.at[best].add(node_row)
 
         consumed = jnp.where(
             alloc_here, m, (pipe_here | failed).astype(jnp.int32)
         )
-        cursor = cursor.at[cur_safe].add(jnp.where(active, consumed, 0))
-        n_alloc = n_alloc.at[cur_safe].add(
-            jnp.where(active & alloc_here, m, 0)
-        )
+        job_row = jnp.stack([
+            jnp.where(active, consumed, 0),              # cursor advance
+            jnp.where(active & alloc_here, m, 0),        # n_alloc
+            (active & failed).astype(jnp.int32),         # left-count (first
+                                                         # failure ends the
+                                                         # job's eligibility,
+                                                         # so add == set)
+        ])
+        job_state = job_state.at[cur_safe].add(job_row)
         # DRF shares grow on every placement — pipeline fires the allocate
         # event too (session.go:199-239 -> drf.go:135-144).
         placed_copies = jnp.where(
-            active & (alloc_here | pipe_here),
-            jnp.where(alloc_here, m, 1).astype(alloc.dtype),
-            0.0,
+            active & (alloc_here | pipe_here), copies.astype(alloc.dtype), 0.0
         )
         alloc = alloc.at[cur_safe].add(placed_copies * req)
         if track_queue_alloc:
             # proportion's allocate event handler: queue allocated grows on
             # every placement too (proportion.go:236-246).
             q_alloc = q_alloc.at[job_queue[cur_safe]].add(placed_copies * req)
-        left = left.at[cur_safe].set(
-            jnp.where(active, left[cur_safe] | failed, left[cur_safe])
-        )
 
         code = jnp.where(
             alloc_here, best.astype(jnp.int32),
@@ -348,17 +364,17 @@ def fused_allocate(
         else:
             out = out.at[t_idx].set(jnp.where(active, code, out[t_idx]))
 
+        row_after = job_state[cur_safe]
         became_ready = (alloc_here | pipe_here) & (
-            n_alloc[cur_safe] >= job_deficit[cur_safe]
+            row_after[1] >= job_deficit[cur_safe]
         )
-        drained = cursor[cur_safe] >= job_task_num[cur_safe]
+        drained = row_after[0] >= job_task_num[cur_safe]
         end_pop = failed | became_ready | drained
         cur = jnp.where(
             cur == HALT, HALT, jnp.where(active & ~end_pop, cur, -1)
         )
 
-        return (idle, releasing, task_count, cursor, left, n_alloc, alloc,
-                q_alloc, cur, out, steps + 1)
+        return (node_state, job_state, alloc, q_alloc, cur, out, steps + 1)
 
     def body(state):
         for _ in range(window):
@@ -366,17 +382,15 @@ def fused_allocate(
         return state
 
     def cond(state):
-        (_, _, _, cursor, left, _, _, _, cur, _, steps) = state
-        alive = (cur >= 0) | ((cur != HALT) & jnp.any(eligible(cursor, left)))
+        (_, job_state, _, _, cur, _, steps) = state
+        alive = (cur >= 0) | ((cur != HALT) & jnp.any(eligible(job_state)))
         return alive & (steps < t_cap + window)
 
     init = (
-        idle,
-        releasing,
-        task_count,
-        jnp.zeros(j_cap, dtype=jnp.int32),
-        jnp.zeros(j_cap, dtype=bool),
-        jnp.zeros(j_cap, dtype=jnp.int32),
+        jnp.concatenate(
+            [idle, releasing, task_count.astype(idle.dtype)[:, None]], axis=1
+        ),
+        jnp.zeros((j_cap, 3), dtype=jnp.int32),
         job_alloc_init,
         queue_alloc_init,
         jnp.asarray(-1, dtype=jnp.int32),
@@ -385,7 +399,7 @@ def fused_allocate(
         jnp.zeros((), dtype=jnp.int32),
     )
     final = jax.lax.while_loop(cond, body, init)
-    return final[9][:t_cap]
+    return final[5][:t_cap]
 
 
 class FusedAllocator:

@@ -1,0 +1,130 @@
+"""Randomized cross-engine parity: arbitrary clusters x plugin tier
+combinations, fused vs per-pop vs host must agree bind-for-bind and
+status-for-status.
+
+This is the broad-spectrum guard for the three-engine contract: targeted
+parity tests (test_fused.py) pin known-interesting shapes; this fuzz sweeps
+the configuration space — mixed selectors, taints, weighted queues, gangs,
+releasing capacity, priority classes — with seeded RNG so failures replay."""
+
+import numpy as np
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.apis.objects import Taint, Toleration
+from scheduler_tpu.cache import SchedulerCache
+from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
+from tests.test_fused import ENGINES, run_engine
+
+PLUGIN_SETS = [
+    ("priority", "gang"),
+    ("priority", "gang", "drf", "binpack"),
+    ("priority", "gang", "proportion", "binpack"),
+    ("priority", "gang", "drf", "predicates", "nodeorder"),
+    ("priority", "gang", "proportion", "predicates", "binpack"),
+    ("priority", "gang", "drf", "proportion", "predicates", "nodeorder"),
+]
+
+
+def conf_for(plugins):
+    lines = "\n".join(f"  - name: {p}" for p in plugins)
+    return f'actions: "allocate"\ntiers:\n- plugins:\n{lines}\n'
+
+
+def random_cluster(seed: int):
+    rng = np.random.default_rng(seed)
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+
+    n_queues = int(rng.integers(1, 4))
+    queues = [f"q{i}" for i in range(n_queues)]
+    for i, q in enumerate(queues):
+        cache.add_queue(build_queue(q, weight=int(rng.integers(1, 5))))
+
+    cache.add_priority_class("pc-lo", 1)
+    cache.add_priority_class("pc-hi", int(rng.integers(5, 100)))
+
+    n_nodes = int(rng.integers(3, 20))
+    zones = [f"z{i}" for i in range(int(rng.integers(1, 4)))]
+    remaining = {}
+    for i in range(n_nodes):
+        cpu = float(rng.choice([2000, 4000, 8000]))
+        mem = float(rng.choice([4, 8, 16])) * 1024**3
+        node = build_node(
+            f"n{i:03d}", {"cpu": cpu, "memory": mem},
+            labels={"zone": str(rng.choice(zones)),
+                    "disk": str(rng.choice(["ssd", "hdd"]))},
+        )
+        if rng.random() < 0.2:
+            node.taints = [Taint(key="dedicated", value="x", effect="NoSchedule")]
+        if rng.random() < 0.1:
+            node.unschedulable = True
+        cache.add_node(node)
+        remaining[node.name] = [cpu, mem]
+
+    # Some running pods occupying capacity (bound only where they FIT — an
+    # oversubscribed node trips the Sub sufficiency assertion, as it should);
+    # a fraction get evicted so releasing capacity/pipelining paths run.
+    for j in range(int(rng.integers(0, 4))):
+        g = f"run{j}"
+        cache.add_pod_group(build_pod_group(
+            g, queue=str(rng.choice(queues)), min_member=1, phase="Running"))
+        for t in range(int(rng.integers(1, 4))):
+            cpu = float(rng.choice([1000, 2000]))
+            mem = float(rng.choice([2, 4])) * 1024**3
+            target = f"n{int(rng.integers(0, n_nodes)):03d}"
+            if remaining[target][0] < cpu or remaining[target][1] < mem:
+                continue
+            remaining[target][0] -= cpu
+            remaining[target][1] -= mem
+            cache.add_pod(build_pod(
+                name=f"{g}-{t}", req={"cpu": cpu, "memory": mem},
+                groupname=g, nodename=target, phase="Running"))
+    # Deterministic across the three engine builds: keyed on stable task
+    # NAMES (uids are a process-global counter and differ per build).
+    for job in list(cache.jobs.values()):
+        for i, task in enumerate(sorted(job.tasks.values(), key=lambda t: t.name)):
+            if task.node_name and (i + seed) % 3 == 0:
+                cache.evict(task, "fuzz churn")
+
+    for j in range(int(rng.integers(1, 10))):
+        g = f"job{j}"
+        size = int(rng.integers(1, 6))
+        pg = build_pod_group(
+            g, queue=str(rng.choice(queues)),
+            min_member=int(rng.integers(1, size + 1)))
+        if rng.random() < 0.3:
+            pg.priority_class_name = str(rng.choice(["pc-lo", "pc-hi"]))
+        cache.add_pod_group(pg)
+        for t in range(size):
+            sel = {}
+            if rng.random() < 0.4:
+                sel["zone"] = str(rng.choice(zones))
+            if rng.random() < 0.2:
+                sel["disk"] = "ssd"
+            pod = build_pod(
+                name=f"{g}-{t}",
+                req={"cpu": float(rng.choice([500, 1000, 2000])),
+                     "memory": float(rng.choice([1, 2, 4])) * 1024**3},
+                groupname=g,
+                priority=int(rng.integers(0, 3)),
+                selector=sel,
+            )
+            if rng.random() < 0.3:
+                pod.tolerations = [Toleration(key="dedicated", operator="Equal",
+                                              value="x", effect="NoSchedule")]
+            cache.add_pod(pod)
+    return cache
+
+
+@pytest.mark.parametrize("plugins", PLUGIN_SETS, ids=lambda p: "+".join(p))
+@pytest.mark.parametrize("seed", [101, 202, 303, 404, 505])
+def test_engines_agree_on_random_clusters(plugins, seed):
+    conf = conf_for(plugins)
+    results = {}
+    for name, env in ENGINES.items():
+        cache = random_cluster(seed)
+        results[name] = run_engine(cache, conf, env)
+    assert results["fused"] == results["per-pop"], "fused vs per-pop"
+    assert results["fused"] == results["host"], "fused vs host"

@@ -9,10 +9,16 @@ detector + PANIC_ON_ERROR for the same reason).
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# SCHEDULER_TPU_TEST_TPU=1 runs the suite on the real attached TPU instead of
+# the virtual CPU mesh — slower, but exercises the production backend
+# (hardware-validation sweeps; multi-device sharding tests self-skip if the
+# chip count is insufficient).
+_use_tpu = os.environ.get("SCHEDULER_TPU_TEST_TPU", "").lower() in ("1", "true")
+if not _use_tpu:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("PANIC_ON_ERROR", "true")
 
 # The image's sitecustomize may import jax at interpreter start (registering a
@@ -21,6 +27,7 @@ os.environ.setdefault("PANIC_ON_ERROR", "true")
 # initialize lazily on first use.
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _use_tpu:
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

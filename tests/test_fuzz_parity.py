@@ -39,7 +39,7 @@ def random_cluster(seed: int):
 
     n_queues = int(rng.integers(1, 4))
     queues = [f"q{i}" for i in range(n_queues)]
-    for i, q in enumerate(queues):
+    for q in queues:
         cache.add_queue(build_queue(q, weight=int(rng.integers(1, 5))))
 
     cache.add_priority_class("pc-lo", 1)

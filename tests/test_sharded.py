@@ -17,8 +17,19 @@ from scheduler_tpu.ops.sharded import (
 
 
 def make_mesh(n=8):
+    import os
+
     devices = jax.devices()
-    assert len(devices) >= n, "conftest must force 8 virtual CPU devices"
+    if len(devices) < n:
+        if os.environ.get("SCHEDULER_TPU_TEST_TPU", "").lower() in ("1", "true"):
+            # Real-hardware sweeps may have a single chip — skipping is the
+            # expected outcome there.
+            pytest.skip(f"needs {n} devices, have {len(devices)}")
+        # On the default CPU path a short device count means the 8-virtual-
+        # device forcing regressed — fail loudly, never silently skip.
+        raise AssertionError(
+            f"conftest must force {n} virtual CPU devices (got {len(devices)})"
+        )
     return Mesh(np.array(devices[:n]), (NODE_AXIS,))
 
 

@@ -87,7 +87,7 @@ _KNOWN_JOB_ORDER = ("priority", "gang", "drf")
     jax.jit,
     static_argnames=(
         "comparators", "queue_comparators", "overused_gate", "use_static",
-        "weights", "enforce_pod_count", "window", "batch_runs",
+        "n_queues", "weights", "enforce_pod_count", "window", "batch_runs",
     ),
 )
 def fused_allocate(
@@ -134,6 +134,7 @@ def fused_allocate(
     queue_comparators: Tuple[str, ...] = (),
     overused_gate: bool = False,
     use_static: bool = False,
+    n_queues: int = 0,
     weights: Tuple[float, float, float],
     enforce_pod_count: bool,
     window: int = 1,
@@ -160,8 +161,44 @@ def fused_allocate(
     def eligible(job_state):
         return (job_state[:, 2] == 0) & (job_state[:, 0] < job_task_num)
 
+    # Single-queue sessions (the common case) skip the whole queue-selection
+    # block at trace time: every eligible job is in queue 0.  Decided by the
+    # static n_queues count, NOT queue_rank's shape — the queue axis is
+    # bucket-padded (minimum 8), so the shape never reveals a single queue.
+    single_queue = (
+        n_queues == 1 and not queue_comparators and not overused_gate
+    )
+
+    def job_chain(cand, job_state, alloc):
+        """First-nonzero comparator chain == lexicographic masked argmin.
+        Integer keys stay integer (PriorityClass values up to 2^31 compare
+        exactly; float32 would collapse values above 2^24)."""
+        for name in comparators:
+            if name == "priority":
+                key, sentinel = -job_priority, big_i32
+            elif name == "gang":
+                key = ((job_gang_order - job_state[:, 1]) <= 0).astype(jnp.int32)
+                sentinel = big_i32
+            elif name == "drf":
+                frac = jnp.where(
+                    total_mask[None, :], alloc / total_safe[None, :], 0.0
+                )
+                key, sentinel = jnp.max(frac, axis=-1), pos_inf
+            else:  # pragma: no cover - guarded by `supported`
+                raise ValueError(f"unknown comparator {name}")
+            masked = jnp.where(cand, key, sentinel)
+            cand = cand & (masked == jnp.min(masked))
+        return cand
+
     def select_job(job_state, alloc, q_alloc):
         elig = eligible(job_state)
+        if single_queue:
+            cand = job_chain(elig, job_state, alloc)
+            tb = jnp.where(cand, job_tiebreak, big_i32)
+            return jnp.where(
+                jnp.any(cand), jnp.argmin(tb), HALT
+            ).astype(jnp.int32)
+
         # Queue pop: queues holding an eligible job, minus overused ones
         # (checked live at every pop like the host loop, allocate.go:101),
         # ordered by the queue comparator chain then creation/uid rank.
@@ -195,27 +232,7 @@ def fused_allocate(
             cand_q = cand_q & (masked_q == jnp.min(masked_q))
         q_star = jnp.argmin(jnp.where(cand_q, queue_rank, big_i32))
         any_queue = jnp.any(q_has)
-        cand = elig & (job_queue == q_star)
-
-        # First-nonzero comparator chain == lexicographic masked argmin.
-        # Integer keys stay integer (PriorityClass values up to 2^31 compare
-        # exactly; float32 would collapse values above 2^24).
-        for name in comparators:
-            if name == "priority":
-                key, sentinel = -job_priority, big_i32
-            elif name == "gang":
-                key = ((job_gang_order - job_state[:, 1]) <= 0).astype(jnp.int32)
-                sentinel = big_i32
-            elif name == "drf":
-                frac = jnp.where(
-                    total_mask[None, :], alloc / total_safe[None, :], 0.0
-                )
-                key, sentinel = jnp.max(frac, axis=-1), pos_inf
-            else:  # pragma: no cover - guarded by `supported`
-                raise ValueError(f"unknown comparator {name}")
-            masked = jnp.where(cand, key, sentinel)
-            best = jnp.min(masked)
-            cand = cand & (masked == best)
+        cand = job_chain(elig & (job_queue == q_star), job_state, alloc)
 
         tb = jnp.where(cand, job_tiebreak, big_i32)
         sel = jnp.argmin(tb)
@@ -665,6 +682,7 @@ class FusedAllocator:
                 queue_comparators=self.queue_comparators,
                 overused_gate=self.overused_gate,
                 use_static=self.use_static,
+                n_queues=len(self.queue_uids),
                 weights=self.weights,
                 enforce_pod_count=self.enforce_pod_count,
                 window=self._window_size(),

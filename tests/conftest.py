@@ -13,7 +13,10 @@ import sys
 # the virtual CPU mesh — slower, but exercises the production backend
 # (hardware-validation sweeps; multi-device sharding tests self-skip if the
 # chip count is insufficient).
-_use_tpu = os.environ.get("SCHEDULER_TPU_TEST_TPU", "").lower() in ("1", "true")
+# Single source of truth for the flag — test modules import this rather than
+# re-parsing the env var (drift would change skip-vs-fail behavior).
+USE_TPU = os.environ.get("SCHEDULER_TPU_TEST_TPU", "").lower() in ("1", "true")
+_use_tpu = USE_TPU
 if not _use_tpu:
     os.environ["JAX_PLATFORMS"] = "cpu"
     _flags = os.environ.get("XLA_FLAGS", "")

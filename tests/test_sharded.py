@@ -1,6 +1,8 @@
 """Multi-chip placement parity: the node-sharded scan must match the
 single-device kernel bit-for-bit on an 8-virtual-device mesh (conftest forces
-``--xla_force_host_platform_device_count=8``)."""
+``--xla_force_host_platform_device_count=8`` on the default CPU path; under
+``SCHEDULER_TPU_TEST_TPU=1`` the real backend is used and these tests skip
+when the hardware has fewer than 8 chips)."""
 
 import jax
 import jax.numpy as jnp
@@ -17,11 +19,11 @@ from scheduler_tpu.ops.sharded import (
 
 
 def make_mesh(n=8):
-    import os
+    from tests.conftest import USE_TPU
 
     devices = jax.devices()
     if len(devices) < n:
-        if os.environ.get("SCHEDULER_TPU_TEST_TPU", "").lower() in ("1", "true"):
+        if USE_TPU:
             # Real-hardware sweeps may have a single chip — skipping is the
             # expected outcome there.
             pytest.skip(f"needs {n} devices, have {len(devices)}")
@@ -133,6 +135,7 @@ def test_fused_engine_node_sharded_matches_single_device():
             *args, comparators=eng.comparators,
             queue_comparators=eng.queue_comparators,
             overused_gate=eng.overused_gate, use_static=eng.use_static,
+            n_queues=len(eng.queue_uids),
             weights=eng.weights, enforce_pod_count=eng.enforce_pod_count,
             window=4, batch_runs=eng.batch_runs,
         ))

@@ -89,3 +89,37 @@ def cpu(cores: float) -> float:
 
 def gi(gibi: float) -> float:
     return gibi * 1024.0 * 1024.0 * 1024.0
+
+
+def add_running_workload(cache, rng, queues, n_nodes, n_jobs,
+                         gang_range=(1, 5), group_prefix="run",
+                         priority_class=None, priority=0):
+    """Capacity-respecting running pods for fuzz clusters: binds pods only to
+    nodes with room (an oversubscribed node trips the Sub sufficiency
+    assertion, as it should).  Shared by the fuzz suites so the bookkeeping
+    cannot drift between them.  Returns the per-node remaining capacity."""
+    remaining = {
+        n.name: [n.allocatable.milli_cpu, n.allocatable.memory]
+        for n in cache.nodes.values()
+    }
+    node_names = sorted(remaining)
+    for j in range(n_jobs):
+        g = f"{group_prefix}{j}"
+        pg = build_pod_group(g, queue=str(rng.choice(queues)),
+                             min_member=1, phase="Running")
+        if priority_class is not None:
+            pg.priority_class_name = priority_class
+        cache.add_pod_group(pg)
+        for t in range(int(rng.integers(*gang_range))):
+            cpu = float(rng.choice([1000, 2000]))
+            mem = float(rng.choice([2, 4])) * 1024**3
+            target = node_names[int(rng.integers(0, len(node_names)))]
+            if remaining[target][0] < cpu or remaining[target][1] < mem:
+                continue
+            remaining[target][0] -= cpu
+            remaining[target][1] -= mem
+            cache.add_pod(build_pod(
+                name=f"{g}-{t}", req={"cpu": cpu, "memory": mem},
+                groupname=g, nodename=target, phase="Running",
+                priority=priority))
+    return remaining

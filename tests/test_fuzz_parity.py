@@ -14,7 +14,14 @@ import scheduler_tpu.actions  # noqa: F401
 import scheduler_tpu.plugins  # noqa: F401
 from scheduler_tpu.apis.objects import Taint, Toleration
 from scheduler_tpu.cache import SchedulerCache
-from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
+from tests.fixtures import (
+    add_running_workload,
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    make_vocab,
+)
 from tests.test_fused import ENGINES, run_engine
 
 PLUGIN_SETS = [
@@ -47,12 +54,11 @@ def random_cluster(seed: int):
 
     n_nodes = int(rng.integers(3, 20))
     zones = [f"z{i}" for i in range(int(rng.integers(1, 4)))]
-    remaining = {}
     for i in range(n_nodes):
-        cpu = float(rng.choice([2000, 4000, 8000]))
-        mem = float(rng.choice([4, 8, 16])) * 1024**3
         node = build_node(
-            f"n{i:03d}", {"cpu": cpu, "memory": mem},
+            f"n{i:03d}",
+            {"cpu": float(rng.choice([2000, 4000, 8000])),
+             "memory": float(rng.choice([4, 8, 16])) * 1024**3},
             labels={"zone": str(rng.choice(zones)),
                     "disk": str(rng.choice(["ssd", "hdd"]))},
         )
@@ -61,26 +67,11 @@ def random_cluster(seed: int):
         if rng.random() < 0.1:
             node.unschedulable = True
         cache.add_node(node)
-        remaining[node.name] = [cpu, mem]
 
-    # Some running pods occupying capacity (bound only where they FIT — an
-    # oversubscribed node trips the Sub sufficiency assertion, as it should);
-    # a fraction get evicted so releasing capacity/pipelining paths run.
-    for j in range(int(rng.integers(0, 4))):
-        g = f"run{j}"
-        cache.add_pod_group(build_pod_group(
-            g, queue=str(rng.choice(queues)), min_member=1, phase="Running"))
-        for t in range(int(rng.integers(1, 4))):
-            cpu = float(rng.choice([1000, 2000]))
-            mem = float(rng.choice([2, 4])) * 1024**3
-            target = f"n{int(rng.integers(0, n_nodes)):03d}"
-            if remaining[target][0] < cpu or remaining[target][1] < mem:
-                continue
-            remaining[target][0] -= cpu
-            remaining[target][1] -= mem
-            cache.add_pod(build_pod(
-                name=f"{g}-{t}", req={"cpu": cpu, "memory": mem},
-                groupname=g, nodename=target, phase="Running"))
+    # Running pods occupying capacity; a fraction get evicted below so
+    # releasing capacity/pipelining paths run.
+    add_running_workload(cache, rng, queues, n_nodes,
+                         n_jobs=int(rng.integers(0, 4)), gang_range=(1, 4))
     # Deterministic across the three engine builds: keyed on stable task
     # NAMES (uids are a process-global counter and differ per build).
     for job in list(cache.jobs.values()):

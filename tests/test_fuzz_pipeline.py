@@ -1,0 +1,137 @@
+"""Randomized full-pipeline stress: the production action order over random
+clusters must uphold the structural invariants no matter the draw.
+
+Unlike test_fuzz_parity (engine-vs-engine equality on allocate), this sweeps
+the ACTION INTERPLAY — enqueue admission, reclaim/preempt evictions, allocate
+placement, backfill — and asserts what must always hold:
+
+* node accounting never goes negative (PANIC_ON_ERROR also guards every
+  Sub on the way);
+* gang atomicity for binds: a job binds >= min_available tasks or none;
+* every bound task's target node exists and passed its selector;
+* evictions only ever target Running/Releasing work.
+"""
+
+import numpy as np
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.api.types import TaskStatus
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import close_session, get_action, open_session
+from tests.fixtures import (
+    add_running_workload,
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    make_vocab,
+)
+
+CONF = """
+actions: "enqueue, reclaim, allocate, backfill, preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def random_mixed_cluster(seed: int):
+    rng = np.random.default_rng(seed)
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+
+    queues = [f"q{i}" for i in range(int(rng.integers(1, 4)))]
+    for q in queues:
+        cache.add_queue(build_queue(q, weight=int(rng.integers(1, 4))))
+    cache.add_priority_class("lo", 1)
+    cache.add_priority_class("hi", int(rng.integers(10, 90)))
+
+    n_nodes = int(rng.integers(4, 16))
+    zones = [f"z{i}" for i in range(int(rng.integers(1, 3)))]
+    node_zone = {}
+    for i in range(n_nodes):
+        cpu = float(rng.choice([4000, 8000]))
+        mem = float(rng.choice([8, 16])) * 1024**3
+        zone = str(rng.choice(zones))
+        name = f"n{i:03d}"
+        cache.add_node(build_node(name, {"cpu": cpu, "memory": mem},
+                                  labels={"zone": zone}))
+        node_zone[name] = zone
+
+    # Running workload (capacity-respecting, shared helper).
+    add_running_workload(cache, rng, queues, n_nodes,
+                         n_jobs=int(rng.integers(0, 5)), gang_range=(1, 5),
+                         priority_class="lo", priority=1)
+
+    # Pending gangs, some with selectors, some Pending-phase (enqueue gates
+    # them), some BestEffort for backfill.
+    selectors = {}
+    min_members = {}
+    for j in range(int(rng.integers(1, 8))):
+        g = f"pend{j}"
+        size = int(rng.integers(1, 5))
+        phase = "Pending" if rng.random() < 0.5 else "Inqueue"
+        pg = build_pod_group(g, queue=str(rng.choice(queues)),
+                             min_member=int(rng.integers(1, size + 1)),
+                             phase=phase)
+        if rng.random() < 0.5:
+            pg.priority_class_name = "hi"
+        cache.add_pod_group(pg)
+        min_members[f"default/{g}"] = pg.min_member
+        for t in range(size):
+            sel = {"zone": str(rng.choice(zones))} if rng.random() < 0.3 else {}
+            selectors[f"default/{g}-{t}"] = sel
+            cache.add_pod(build_pod(
+                name=f"{g}-{t}",
+                req={"cpu": float(rng.choice([500, 1000, 2000])),
+                     "memory": float(rng.choice([1, 2, 4])) * 1024**3},
+                groupname=g, priority=int(rng.integers(0, 3)), selector=sel))
+    if rng.random() < 0.5:
+        cache.add_pod_group(build_pod_group("be", queue=queues[0], min_member=1,
+                                            phase="Inqueue"))
+        cache.add_pod(build_pod(name="be-0", req={}, groupname="be"))
+        selectors["default/be-0"] = {}
+    return cache, node_zone, selectors, min_members
+
+
+@pytest.mark.parametrize("seed", [11, 22, 33, 44, 55, 66, 77, 88])
+def test_pipeline_invariants_on_random_clusters(seed):
+    cache, node_zone, selectors, min_members = random_mixed_cluster(seed)
+    conf = parse_scheduler_conf(CONF)
+    ssn = open_session(cache, conf.tiers)
+    for name in conf.actions:
+        get_action(name).execute(ssn)
+
+    # Node ledgers stay sane.
+    for node in ssn.nodes.values():
+        assert (node.idle.array >= -1e-6).all(), (seed, node.name, node.idle.array)
+        assert (node.releasing.array >= -1e-6).all(), (seed, node.name)
+
+    # Bind-level gang atomicity + selector honoring.
+    for uid, job in ssn.jobs.items():
+        bound = [t for t in job.tasks.values()
+                 if t.status in (TaskStatus.BINDING, TaskStatus.BOUND)]
+        if uid in min_members:
+            assert len(bound) == 0 or len(bound) >= min_members[uid], (
+                seed, uid, len(bound), min_members[uid])
+        for t in bound:
+            assert t.node_name in node_zone, (seed, t.name, t.node_name)
+            sel = selectors.get(f"default/{t.name}", {})
+            if sel:
+                assert node_zone[t.node_name] == sel["zone"], (
+                    seed, t.name, t.node_name, sel)
+    close_session(ssn)
+
+    # Evictions only target previously running work.
+    for uid in cache.evictor.evicts:
+        assert uid.startswith("default/run"), (seed, uid)

@@ -12,6 +12,7 @@ from scheduler_tpu.conf import (
 )
 from scheduler_tpu.framework import Arguments, Session, open_session
 from scheduler_tpu.framework.interface import ValidateResult
+from scheduler_tpu.framework.job_updater import is_pod_group_status_updated
 from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
 
 
@@ -339,3 +340,44 @@ class TestSessionMutations:
         assert ssn.nodes["n1"].releasing.milli_cpu == 1000
         stmt.commit()
         assert cache2.evictor.wait(1) == ["default/victim"]
+
+
+class TestJobUpdaterDedup:
+    """is_pod_group_status_updated (job_updater.go:55-100): condition churn
+    with identical content must not trigger pushes until the jittered window."""
+
+    def _status(self, phase="Running", running=1, transition_id="a", ts=None):
+        import time
+
+        from scheduler_tpu.apis.objects import PodGroupCondition, PodGroupStatus
+
+        st = PodGroupStatus(phase=phase, running=running)
+        st.conditions.append(PodGroupCondition(
+            type="Unschedulable", status="True", transition_id=transition_id,
+            reason="NotEnoughResources", message="3/5 tasks unschedulable",
+            last_transition_time=time.time() if ts is None else ts,
+        ))
+        return st
+
+    def test_phase_or_count_change_updates(self):
+        assert is_pod_group_status_updated(self._status(phase="Pending"), self._status())
+        assert is_pod_group_status_updated(self._status(running=2), self._status())
+
+    def test_same_content_new_transition_id_dedupes_within_window(self):
+        old = self._status(transition_id="cycle-1")
+        new = self._status(transition_id="cycle-2")
+        assert not is_pod_group_status_updated(new, old)
+
+    def test_same_content_new_transition_id_refreshes_after_window(self):
+        import time
+
+        # Old transition stamped beyond the max window (60s + 30s jitter).
+        old = self._status(transition_id="cycle-1", ts=time.time() - 120)
+        new = self._status(transition_id="cycle-2")
+        assert is_pod_group_status_updated(new, old)
+
+    def test_message_change_always_updates(self):
+        old = self._status()
+        new = self._status()
+        new.conditions[0].message = "4/5 tasks unschedulable"
+        assert is_pod_group_status_updated(new, old)

@@ -17,7 +17,7 @@ import pytest
 
 import scheduler_tpu.actions  # noqa: F401
 import scheduler_tpu.plugins  # noqa: F401
-from scheduler_tpu.api.types import TaskStatus
+from scheduler_tpu.api.types import TaskStatus, allocated_status
 from scheduler_tpu.cache import SchedulerCache
 from scheduler_tpu.conf import parse_scheduler_conf
 from scheduler_tpu.framework import close_session, get_action, open_session
@@ -135,3 +135,66 @@ def test_pipeline_invariants_on_random_clusters(seed):
     # Evictions only target previously running work.
     for uid in cache.evictor.evicts:
         assert uid.startswith("default/run"), (seed, uid)
+
+
+@pytest.mark.parametrize("seed", [7, 17, 27])
+def test_multi_cycle_churn_keeps_cache_consistent(seed):
+    """Three full cycles over ONE cache with churn between them (binds turn
+    Running, some pods complete and are deleted, new gangs arrive): the
+    cache-side ledgers must stay exact across sessions — the regime the
+    per-cycle tests never see."""
+    cache, _, _, _ = random_mixed_cluster(seed)
+    conf = parse_scheduler_conf(CONF)
+
+    for cycle in range(3):
+        ssn = open_session(cache, conf.tiers)
+        for name in conf.actions:
+            get_action(name).execute(ssn)
+        close_session(ssn)
+
+        # Churn: bound pods start Running (kubelet), a third of running pods
+        # complete and vanish (API delete), and a fresh gang arrives.
+        for job in list(cache.jobs.values()):
+            for task in list(job.tasks.values()):
+                if task.status == TaskStatus.BINDING:
+                    pod = task.pod
+                    pod.phase = "Running"
+                    pod.node_name = task.node_name
+                    cache.update_pod(pod)
+        running = [t for j in cache.jobs.values() for t in j.tasks.values()
+                   if t.status == TaskStatus.RUNNING]
+        for i, task in enumerate(sorted(running, key=lambda t: t.name)):
+            if (i + cycle) % 3 == 0:
+                cache.delete_pod(task.pod)
+        g = f"wave{seed}-{cycle}"
+        pg = build_pod_group(g, queue=sorted(cache.queues)[0], min_member=2,
+                             phase="Inqueue")
+        cache.add_pod_group(pg)
+        for t in range(2):
+            cache.add_pod(build_pod(
+                name=f"{g}-{t}",
+                req={"cpu": 1000.0, "memory": 2 * 1024**3}, groupname=g))
+
+    # Cache ledger exactness: every node's used must equal the sum of its
+    # tasks' requests, and idle + used must equal allocatable.
+    for node in cache.nodes.values():
+        expect_used = np.zeros_like(node.used.array)
+        for t in node.tasks.values():
+            arr = t.resreq.array
+            expect_used[: arr.shape[0]] += arr
+        np.testing.assert_allclose(
+            node.used.array, expect_used, atol=1e-6,
+            err_msg=f"{node.name} used ledger drifted")
+        np.testing.assert_allclose(
+            node.idle.array + node.used.array, node.allocatable.array,
+            atol=1e-6, err_msg=f"{node.name} idle+used != allocatable")
+    # Job aggregates: allocated equals the fold over allocated-status tasks.
+    for job in cache.jobs.values():
+        expect = np.zeros_like(job.allocated.array)
+        for t in job.tasks.values():
+            if allocated_status(t.status):
+                arr = t.resreq.array
+                expect[: arr.shape[0]] += arr
+        np.testing.assert_allclose(
+            job.allocated.array, expect, atol=1e-6,
+            err_msg=f"{job.uid} allocated ledger drifted")

@@ -68,6 +68,17 @@ def collect_candidates(ssn) -> List[JobInfo]:
     return candidates
 
 
+def record_fused_failures(failures) -> None:
+    """Record first-infeasible rows as FitErrors on their jobs — the single
+    owner of the 'failed placement row -> FitErrors' convention for columnar
+    results (``failures`` = [(job, row)] from ``FusedAllocator.run_columnar``)."""
+    for job, row in failures:
+        core = job.store.cores[row]
+        fe = FitErrors()
+        fe.set_node_error("*", FitError(core.name, "*", NODE_RESOURCE_FIT_FAILED))
+        job.nodes_fit_errors[core.uid] = fe
+
+
 def apply_fused_results(ssn, candidates: List[JobInfo], results, plan_fn=None) -> None:
     """Commit a fused-engine run to the session: record FitErrors for failed
     rows, apply placements (bulk by default, per-row when SCHEDULER_TPU_BULK=0).
@@ -185,8 +196,14 @@ class AllocateAction(Action):
         from scheduler_tpu.ops.fused import FusedAllocator
 
         engine = FusedAllocator(ssn, candidates)
-        results = engine.run()
-        apply_fused_results(ssn, candidates, results, plan_fn=engine.commit_plan)
+        if os.environ.get("SCHEDULER_TPU_BULK", "1") in ("0", "false"):
+            # Per-row commit requested: object decode + per-task session ops.
+            results = engine.run()
+            apply_fused_results(ssn, candidates, results, plan_fn=None)
+            return
+        items, node_batches, failures = engine.run_columnar()
+        record_fused_failures(failures)
+        ssn.bulk_apply_columnar(items, node_batches, engine.commit_plan())
 
     # -- device engine -------------------------------------------------------
 

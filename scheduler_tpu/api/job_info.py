@@ -3,11 +3,28 @@
 Reference: ``pkg/scheduler/api/job_info.go`` (TaskInfo :36-93, JobInfo :127-418).
 The status-indexed task maps and gang arithmetic (ReadyTaskNum/ValidTaskNum/
 Ready/Pipelined) are the contract the gang plugin relies on.
+
+TPU-native design: per-task MUTABLE state (status / node_name / volume_ready)
+lives in per-job numpy columns (``_TaskRows``), not in Python objects.  A
+``TaskInfo`` is a *view*: immutable identity fields are plain slots, mutable
+fields are properties over the owning job's columns.  The payoffs:
+
+* ``JobInfo.clone()`` (the per-cycle snapshot, reference ``cache.go:584-654``)
+  copies three arrays per job instead of cloning every task object — the
+  100k-task snapshot drops from O(tasks) Python to O(jobs) numpy.
+* bulk status moves (the device-engine commit) are vectorized column writes
+  plus O(1) count updates, with the object dict/index maintained lazily and
+  only materialized for host paths that actually walk objects.
+* gang arithmetic reads maintained status counts — no index walks.
+
+State equivalence with the object model is the invariant: materializing
+``tasks`` / ``task_status_index`` at any point yields exactly the dicts the
+eager object implementation would hold.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -16,6 +33,13 @@ from scheduler_tpu.api.types import TaskStatus, allocated_status, get_task_statu
 from scheduler_tpu.api.unschedule_info import FitErrors
 from scheduler_tpu.api.vocab import ResourceVocabulary
 from scheduler_tpu.apis.objects import PodGroup, PodSpec
+
+# int value -> TaskStatus object (column values decode through this).
+_STATUS_OBJ: Dict[int, TaskStatus] = {int(s): s for s in TaskStatus}
+# Bitmask of the allocated-ish statuses (types.ALLOCATED_STATUSES).
+_ALLOC_BITS = int(
+    TaskStatus.BOUND | TaskStatus.BINDING | TaskStatus.RUNNING | TaskStatus.ALLOCATED
+)
 
 
 def pod_resource_without_init(pod: PodSpec, vocab: ResourceVocabulary) -> ResourceVec:
@@ -43,7 +67,13 @@ def job_id_for_pod(pod: PodSpec) -> str:
 
 
 class TaskInfo:
-    """One schedulable task (pod) as seen by a Session."""
+    """One schedulable task (pod) as seen by a Session.
+
+    Either *detached* (``_blk is None``: status/node_name/volume_ready live in
+    local slots — freshly constructed tasks, frozen node-side clones) or a
+    *view* bound to a job's column block (``_blk``/``_row``: the mutable fields
+    read and write the columns, so every view of a task aliases one truth).
+    """
 
     __slots__ = (
         "uid",
@@ -52,13 +82,15 @@ class TaskInfo:
         "namespace",
         "resreq",
         "init_resreq",
-        "node_name",
-        "status",
         "priority",
         "pod",
-        "volume_ready",
         "req_sig_cache",
         "resreq_empty_cache",
+        "_blk",
+        "_row",
+        "_status",
+        "_node_name",
+        "_volume_ready",
     )
 
     def __init__(self, pod: PodSpec, vocab: ResourceVocabulary) -> None:
@@ -68,15 +100,75 @@ class TaskInfo:
         self.namespace: str = pod.namespace
         self.resreq: ResourceVec = pod_resource_without_init(pod, vocab)
         self.init_resreq: ResourceVec = pod_resource_request(pod, vocab)
-        self.node_name: str = pod.node_name
-        self.status: TaskStatus = get_task_status(pod)
         self.priority: int = pod.priority
         self.pod: PodSpec = pod
-        self.volume_ready: bool = False
         self.req_sig_cache: Optional[bytes] = None
-        # Computed eagerly: clones inherit it, so the per-cycle snapshot's
-        # fresh task copies never re-run the epsilon compare (100k/cycle).
+        # Computed eagerly: views/clones inherit it, so per-cycle consumers
+        # never re-run the epsilon compare (100k/cycle).
         self.resreq_empty_cache: Optional[bool] = self.resreq.is_empty()
+        self._blk = None
+        self._row = 0
+        self._status: TaskStatus = get_task_status(pod)
+        self._node_name: str = pod.node_name
+        self._volume_ready: bool = False
+
+    # -- mutable state (columns when bound, slots when detached) -------------
+
+    @property
+    def status(self) -> TaskStatus:
+        blk = self._blk
+        if blk is None:
+            return self._status
+        return _STATUS_OBJ[int(blk.status[self._row])]
+
+    @status.setter
+    def status(self, value: TaskStatus) -> None:
+        blk = self._blk
+        if blk is None:
+            self._status = value
+        else:
+            blk.status[self._row] = int(value)
+
+    @property
+    def node_name(self) -> str:
+        blk = self._blk
+        if blk is None:
+            return self._node_name
+        return blk.node_name[self._row]
+
+    @node_name.setter
+    def node_name(self, value: str) -> None:
+        blk = self._blk
+        if blk is None:
+            self._node_name = value
+        else:
+            blk.node_name[self._row] = value
+
+    @property
+    def volume_ready(self) -> bool:
+        blk = self._blk
+        if blk is None:
+            return self._volume_ready
+        return bool(blk.volume_ready[self._row])
+
+    @volume_ready.setter
+    def volume_ready(self, value: bool) -> None:
+        blk = self._blk
+        if blk is None:
+            self._volume_ready = value
+        else:
+            blk.volume_ready[self._row] = value
+
+    def _detach(self) -> None:
+        """Freeze current column values into local slots and unbind."""
+        blk = self._blk
+        if blk is None:
+            return
+        row = self._row
+        self._status = _STATUS_OBJ[int(blk.status[row])]
+        self._node_name = blk.node_name[row]
+        self._volume_ready = bool(blk.volume_ready[row])
+        self._blk = None
 
     @property
     def creation_timestamp(self) -> float:
@@ -84,9 +176,8 @@ class TaskInfo:
 
     @property
     def resreq_empty(self) -> bool:
-        """Cached ``resreq.is_empty()`` — the BestEffort test runs once per
-        task per action otherwise (request vectors are immutable after
-        creation, so the answer never changes)."""
+        """Cached ``resreq.is_empty()`` — request vectors are immutable after
+        creation, so the answer never changes."""
         empty = self.resreq_empty_cache
         if empty is None:
             empty = self.resreq.is_empty()
@@ -96,7 +187,15 @@ class TaskInfo:
     @property
     def req_sig(self) -> bytes:
         """Byte signature of (resreq, init_resreq) — the task-order tie-break
-        that groups identical requests so the device engine sees long runs."""
+        that groups identical requests so the device engine sees long runs.
+
+        Bound views read the job store's matrix-derived signature when built,
+        so the object sort path and ``pending_rows_sorted`` compare the SAME
+        bytes (widths can otherwise differ when the vocabulary grew between
+        task creations)."""
+        blk = self._blk
+        if blk is not None and blk.sigs is not None and blk.matrix_gen == blk.gen:
+            return blk.sigs[self._row]
         sig = self.req_sig_cache
         if sig is None:
             sig = self.resreq.array.tobytes() + self.init_resreq.array.tobytes()
@@ -110,10 +209,9 @@ class TaskInfo:
         return t
 
     def clone_shared(self) -> "TaskInfo":
-        """Status-isolated clone that SHARES the (immutable-after-creation)
-        resreq/init_resreq vectors — the bulk-commit fast path.  Node accounting
-        only needs the clone so later status changes don't leak in; the request
-        vectors are never mutated after task creation."""
+        """Detached, status-frozen copy that SHARES the (immutable-after-
+        creation) resreq/init_resreq vectors — node-side storage uses this so
+        later status changes don't leak into node accounting."""
         t = TaskInfo.__new__(TaskInfo)
         t.uid = self.uid
         t.job = self.job
@@ -121,13 +219,42 @@ class TaskInfo:
         t.namespace = self.namespace
         t.resreq = self.resreq
         t.init_resreq = self.init_resreq
-        t.node_name = self.node_name
-        t.status = self.status
         t.priority = self.priority
         t.pod = self.pod
-        t.volume_ready = self.volume_ready
         t.req_sig_cache = self.req_sig_cache
         t.resreq_empty_cache = self.resreq_empty_cache
+        t._blk = None
+        t._row = 0
+        blk = self._blk
+        if blk is None:
+            t._status = self._status
+            t._node_name = self._node_name
+            t._volume_ready = self._volume_ready
+        else:
+            row = self._row
+            t._status = _STATUS_OBJ[int(blk.status[row])]
+            t._node_name = blk.node_name[row]
+            t._volume_ready = bool(blk.volume_ready[row])
+        return t
+
+    def _view_bound_to(self, blk: "_TaskRows", row: int) -> "TaskInfo":
+        """A copy of this task's immutable identity bound to (blk, row)."""
+        t = TaskInfo.__new__(TaskInfo)
+        t.uid = self.uid
+        t.job = self.job
+        t.name = self.name
+        t.namespace = self.namespace
+        t.resreq = self.resreq
+        t.init_resreq = self.init_resreq
+        t.priority = self.priority
+        t.pod = self.pod
+        t.req_sig_cache = self.req_sig_cache
+        t.resreq_empty_cache = self.resreq_empty_cache
+        t._blk = blk
+        t._row = row
+        t._status = TaskStatus.PENDING  # unused while bound
+        t._node_name = ""
+        t._volume_ready = False
         return t
 
     def __repr__(self) -> str:
@@ -135,6 +262,222 @@ class TaskInfo:
             f"Task({self.namespace}/{self.name} uid={self.uid} job={self.job} "
             f"status={self.status.name} node={self.node_name!r})"
         )
+
+
+class _TaskRows:
+    """Columnar task state of one JobInfo.
+
+    Ownership discipline (what makes zero-copy snapshots safe):
+
+    * ``status`` / ``node_name`` / ``volume_ready`` are PRIVATE to this block —
+      ``clone_state`` copies the first ``n`` rows.
+    * ``cores`` (row -> the owning cache's TaskInfo, the immutable identity
+      source) and ``uids`` are SHARED, append-only lists.  Deletion only
+      removes the uid from ``row_of`` and zeroes the private status cell; the
+      shared entries stay so clones holding older row spaces keep reading
+      valid data.  Compaction REBINDS the owner's slots to fresh lists/arrays
+      (never mutates shared ones in place) and remaps any live views.
+    * the immutable per-row columns (``priority`` / ``creation`` /
+      ``resreq_empty`` / ``has_scalars`` numpy arrays) are shared and appended
+      with reallocation-on-growth, so clones' refs stay valid for their rows.
+    * request matrices + byte signatures build lazily (``gen`` vs
+      ``matrix_gen``) and are shared by clones taken while valid.
+    """
+
+    __slots__ = (
+        "n",
+        "status",
+        "node_name",
+        "volume_ready",
+        "cores",
+        "uids",
+        "row_of",
+        "priority",
+        "creation",
+        "resreq_empty",
+        "has_scalars",
+        "req_matrix",
+        "init_req_matrix",
+        "sigs",
+        "gen",
+        "matrix_gen",
+        "dead",
+        "r_dim",
+    )
+
+    def __init__(self, r_dim: int) -> None:
+        self.n = 0
+        cap = 8
+        self.status = np.zeros(cap, dtype=np.int16)
+        self.node_name = np.empty(cap, dtype=object)
+        self.volume_ready = np.zeros(cap, dtype=bool)
+        self.cores: List[Optional[TaskInfo]] = []
+        self.uids: List[Optional[str]] = []
+        self.row_of: Dict[str, int] = {}
+        self.priority = np.zeros(cap, dtype=np.int64)
+        self.creation = np.zeros(cap, dtype=np.float64)
+        self.resreq_empty = np.zeros(cap, dtype=bool)
+        self.has_scalars = np.zeros(cap, dtype=bool)
+        self.req_matrix: Optional[np.ndarray] = None
+        self.init_req_matrix: Optional[np.ndarray] = None
+        self.sigs: Optional[List[bytes]] = None
+        self.gen = 0
+        self.matrix_gen = -1
+        self.dead = 0
+        self.r_dim = r_dim
+
+    # -- growth ---------------------------------------------------------------
+
+    def _grow(self) -> None:
+        cap = max(16, 2 * self.status.shape[0])
+        for slot in ("status", "node_name", "volume_ready", "priority", "creation",
+                     "resreq_empty", "has_scalars"):
+            old = getattr(self, slot)
+            new = np.zeros(cap, dtype=old.dtype) if old.dtype != object else np.empty(cap, dtype=object)
+            new[: old.shape[0]] = old
+            setattr(self, slot, new)
+
+    def append(self, core: TaskInfo, status: TaskStatus, node_name: str,
+               volume_ready: bool) -> int:
+        if self.n == self.status.shape[0]:
+            self._grow()
+        row = self.n
+        self.n = row + 1
+        self.status[row] = int(status)
+        self.node_name[row] = node_name
+        self.volume_ready[row] = volume_ready
+        self.cores.append(core)
+        self.uids.append(core.uid)
+        self.row_of[core.uid] = row
+        self.priority[row] = core.priority
+        self.creation[row] = core.pod.creation_timestamp
+        self.resreq_empty[row] = bool(core.resreq_empty)
+        self.has_scalars[row] = core.resreq.has_scalars
+        self.gen += 1
+        return row
+
+    def kill(self, uid: str) -> int:
+        """Tombstone a row (shared entries untouched — see class docstring)."""
+        row = self.row_of.pop(uid)
+        self.status[row] = 0
+        self.dead += 1
+        self.gen += 1
+        return row
+
+    # -- cloning (the snapshot path) ------------------------------------------
+
+    def clone_state(self) -> "_TaskRows":
+        blk = _TaskRows.__new__(_TaskRows)
+        n = self.n
+        blk.n = n
+        blk.status = self.status[:n].copy()
+        blk.node_name = self.node_name[:n].copy()
+        blk.volume_ready = self.volume_ready[:n].copy()
+        blk.cores = self.cores
+        blk.uids = self.uids
+        blk.row_of = dict(self.row_of)
+        blk.priority = self.priority
+        blk.creation = self.creation
+        blk.resreq_empty = self.resreq_empty
+        blk.has_scalars = self.has_scalars
+        blk.req_matrix = self.req_matrix
+        blk.init_req_matrix = self.init_req_matrix
+        blk.sigs = self.sigs
+        blk.gen = self.gen
+        blk.matrix_gen = self.matrix_gen
+        blk.dead = self.dead
+        blk.r_dim = self.r_dim
+        return blk
+
+    # -- request matrices ------------------------------------------------------
+
+    def matrices_valid(self) -> bool:
+        return self.matrix_gen == self.gen and self.req_matrix is not None
+
+    def build_matrices(self, views: Optional[Dict[str, TaskInfo]]) -> None:
+        """(Re)build the request matrices + signatures aligned with this row
+        space (dead rows stay zero — compaction happens only at delete time,
+        never here, so callers holding row indices across this call stay
+        valid).
+
+        Rows are exact copies of each task's request vectors (immutable after
+        creation), so gathers from these matrices are byte-identical to reading
+        ``task.resreq.array`` per task.
+        """
+        n = self.n
+        r = self.r_dim
+        req = np.zeros((n, r), dtype=np.float64)
+        init = np.zeros((n, r), dtype=np.float64)
+        for uid, row in self.row_of.items():
+            core = self.cores[row]
+            arr = core.resreq.array
+            req[row, : arr.shape[0]] = arr
+            arr = core.init_resreq.array
+            init[row, : arr.shape[0]] = arr
+        self.req_matrix = req
+        self.init_req_matrix = init
+        # Byte signatures sliced from the matrix buffers: identical bytes to
+        # task.resreq.array.tobytes() + task.init_resreq.array.tobytes().
+        item = r * 8
+        req_buf = req.tobytes()
+        init_buf = init.tobytes()
+        self.sigs = [
+            req_buf[i * item : (i + 1) * item] + init_buf[i * item : (i + 1) * item]
+            for i in range(n)
+        ]
+        self.matrix_gen = self.gen
+
+    def _compact(self, views: Optional[Dict[str, TaskInfo]]) -> None:
+        """Rebuild the row space dropping tombstones.  Owner-only: fresh lists
+        and arrays are REBOUND into the slots (shared old ones stay valid for
+        clones), and any live views of this block are remapped in place."""
+        live = sorted(self.row_of.items(), key=lambda kv: kv[1])
+        n = len(live)
+        cap = max(8, n)
+        status = np.zeros(cap, dtype=np.int16)
+        node_name = np.empty(cap, dtype=object)
+        volume_ready = np.zeros(cap, dtype=bool)
+        priority = np.zeros(cap, dtype=np.int64)
+        creation = np.zeros(cap, dtype=np.float64)
+        resreq_empty = np.zeros(cap, dtype=bool)
+        has_scalars = np.zeros(cap, dtype=bool)
+        cores: List[Optional[TaskInfo]] = []
+        uids: List[Optional[str]] = []
+        row_of: Dict[str, int] = {}
+        for new_row, (uid, old_row) in enumerate(live):
+            status[new_row] = self.status[old_row]
+            node_name[new_row] = self.node_name[old_row]
+            volume_ready[new_row] = self.volume_ready[old_row]
+            priority[new_row] = self.priority[old_row]
+            creation[new_row] = self.creation[old_row]
+            resreq_empty[new_row] = self.resreq_empty[old_row]
+            has_scalars[new_row] = self.has_scalars[old_row]
+            core = self.cores[old_row]
+            cores.append(core)
+            uids.append(uid)
+            row_of[uid] = new_row
+            if core is not None and core._blk is self:
+                core._row = new_row
+        if views:
+            for uid, view in views.items():
+                if view._blk is self:
+                    view._row = row_of[uid]
+        self.n = n
+        self.status = status
+        self.node_name = node_name
+        self.volume_ready = volume_ready
+        self.priority = priority
+        self.creation = creation
+        self.resreq_empty = resreq_empty
+        self.has_scalars = has_scalars
+        self.cores = cores
+        self.uids = uids
+        self.row_of = row_of
+        self.dead = 0
+        self.req_matrix = None
+        self.init_req_matrix = None
+        self.sigs = None
+        self.gen += 1
 
 
 class JobInfo:
@@ -150,8 +493,10 @@ class JobInfo:
         self.min_available: int = 0
         self.pod_group: Optional[PodGroup] = None
 
-        self.tasks: Dict[str, TaskInfo] = {}
-        self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
+        self._store = _TaskRows(vocab.size)
+        self._views: Optional[Dict[str, TaskInfo]] = None
+        self._index: Optional[Dict[TaskStatus, Dict[str, TaskInfo]]] = None
+        self._counts: Dict[int, int] = {}
 
         self.allocated: ResourceVec = ResourceVec.empty(vocab)
         self.total_request: ResourceVec = ResourceVec.empty(vocab)
@@ -162,14 +507,6 @@ class JobInfo:
         self.nodes_fit_errors: Dict[str, FitErrors] = {}  # task uid -> FitErrors
         self.nodes_fit_delta: Dict[str, ResourceVec] = {}  # node -> shortfall
         self.job_fit_errors: str = ""
-
-        # Cached dense request matrices (see request_matrices): rebuilt only
-        # when the task SET changes — status moves keep them valid, and clones
-        # share them, so steady-state snapshot tensor builds gather rows
-        # instead of copying 100k vectors per cycle.
-        self._req_matrix = None
-        self._init_req_matrix = None
-        self._req_row_of: Optional[Dict[str, int]] = None
 
     # -- PodGroup binding ---------------------------------------------------
 
@@ -184,154 +521,341 @@ class JobInfo:
     def unset_pod_group(self) -> None:
         self.pod_group = None
 
+    # -- columnar access ------------------------------------------------------
+
+    @property
+    def store(self) -> _TaskRows:
+        """The columnar block (row-aligned with ``request_matrices``)."""
+        return self._store
+
+    @property
+    def task_count(self) -> int:
+        return len(self._store.row_of)
+
+    def status_count(self, status: TaskStatus) -> int:
+        return self._counts.get(int(status), 0)
+
     def request_matrices(self):
         """(resreq [n, R] f64, init_resreq [n, R] f64, uid -> row) over this
-        job's tasks.  Rows are exact copies of each task's request vectors
-        (immutable after creation), so gathers from these matrices are
-        byte-identical to reading ``task.resreq.array`` per task."""
-        if self._req_matrix is None or self._req_row_of is None:
-            n = len(self.tasks)
-            r = self.vocab.size
-            req = np.zeros((n, r), dtype=np.float64)
-            init = np.zeros((n, r), dtype=np.float64)
-            row_of: Dict[str, int] = {}
-            for i, (uid, task) in enumerate(self.tasks.items()):
-                arr = task.resreq.array
-                req[i, : arr.shape[0]] = arr
-                arr = task.init_resreq.array
-                init[i, : arr.shape[0]] = arr
-                row_of[uid] = i
-            self._req_matrix = req
-            self._init_req_matrix = init
-            self._req_row_of = row_of
-        return self._req_matrix, self._init_req_matrix, self._req_row_of
+        job's row space (dead rows zero)."""
+        st = self._store
+        if not st.matrices_valid():
+            # Track vocabulary growth (scalars register on the fly): matrix
+            # width follows the CURRENT vocab size at build time.
+            st.r_dim = max(st.r_dim, self.vocab.size)
+            st.build_matrices(self._views)
+        return st.req_matrix, st.init_req_matrix, st.row_of
 
     def _invalidate_request_matrices(self) -> None:
-        self._req_matrix = None
-        self._init_req_matrix = None
-        self._req_row_of = None
+        # Matrices invalidate via the store generation; nothing to do, kept
+        # for API compatibility.
+        pass
+
+    def rows_with_status(self, status: TaskStatus) -> np.ndarray:
+        st = self._store
+        return np.nonzero(st.status[: st.n] == int(status))[0]
+
+    def pending_rows(self) -> np.ndarray:
+        """Live PENDING, non-best-effort rows (the allocate-eligible set)."""
+        st = self._store
+        mask = st.status[: st.n] == int(TaskStatus.PENDING)
+        mask &= ~st.resreq_empty[: st.n]
+        return np.nonzero(mask)[0]
+
+    def pending_eligible_count(self) -> int:
+        return int(self.pending_rows().shape[0])
+
+    def pending_rows_sorted(self, use_priority: bool) -> np.ndarray:
+        """``pending_rows`` in builtin task order, straight from the columns:
+        the tuple key ``(-priority, req_sig, creation, uid)`` (or without the
+        priority term) — exactly ``utils.scheduler_helper.task_sort_key``'s
+        fast path, no task objects."""
+        rows = self.pending_rows()
+        if rows.shape[0] <= 1:
+            return rows
+        st = self._store
+        if not st.matrices_valid():
+            self.request_matrices()
+        sigs = st.sigs
+        uids = st.uids
+        rl = rows.tolist()
+        if use_priority:
+            prio = st.priority
+            creation = st.creation
+            rl.sort(key=lambda r: (-prio[r], sigs[r], creation[r], uids[r]))
+        else:
+            creation = st.creation
+            rl.sort(key=lambda r: (sigs[r], creation[r], uids[r]))
+        return np.asarray(rl, dtype=np.int64)
+
+    def status_sum(self, statuses: Sequence[TaskStatus]):
+        """(dense [R] resreq sum, ORed has_scalars) over live tasks in the given
+        statuses — byte-identical to folding ``add`` per task (matrix rows are
+        exact copies of each resreq)."""
+        st = self._store
+        bits = 0
+        for s in statuses:
+            bits |= int(s)
+        mask = (st.status[: st.n].astype(np.int64) & bits) != 0
+        rows = np.nonzero(mask)[0]
+        r = self.vocab.size
+        if rows.shape[0] == 0:
+            return np.zeros(r, dtype=np.float64), False
+        req, _, _ = self.request_matrices()
+        row = req[rows].sum(axis=0)
+        if row.shape[0] < r:  # vocab grew since the matrices were built
+            padded = np.zeros(r, dtype=np.float64)
+            padded[: row.shape[0]] = row
+            row = padded
+        return row, bool(st.has_scalars[rows].any())
+
+    def view_for_row(self, row: int) -> TaskInfo:
+        """The task view for a row (materializes just this one if needed)."""
+        st = self._store
+        uid = st.uids[row]
+        if self._views is not None:
+            view = self._views.get(uid)
+            if view is not None:
+                return view
+        core = st.cores[row]
+        if core._blk is st:
+            view = core
+        else:
+            view = core._view_bound_to(st, row)
+        if self._views is not None:
+            self._views[uid] = view
+        return view
+
+    # -- lazy object materialization ------------------------------------------
+
+    def _materialize(self) -> Dict[str, TaskInfo]:
+        views = self._views
+        if views is None:
+            st = self._store
+            cores = st.cores
+            views = {}
+            for uid, row in st.row_of.items():
+                core = cores[row]
+                if core._blk is st:
+                    views[uid] = core
+                else:
+                    views[uid] = core._view_bound_to(st, row)
+            self._views = views
+        return views
+
+    @property
+    def tasks(self) -> Dict[str, TaskInfo]:
+        return self._materialize()
+
+    @property
+    def task_status_index(self) -> Dict[TaskStatus, Dict[str, TaskInfo]]:
+        index = self._index
+        if index is None:
+            views = self._materialize()
+            st = self._store
+            status_col = st.status
+            index = {}
+            for uid, view in views.items():
+                status = _STATUS_OBJ[int(status_col[view._row])] if view._blk is st else view.status
+                bucket = index.get(status)
+                if bucket is None:
+                    bucket = index[status] = {}
+                bucket[uid] = view
+            self._index = index
+        return index
 
     # -- task CRUD (status-indexed, job_info.go:238-292) --------------------
 
-    def _add_to_index(self, ti: TaskInfo) -> None:
-        self.task_status_index.setdefault(ti.status, {})[ti.uid] = ti
-
-    def _delete_from_index(self, ti: TaskInfo) -> None:
-        bucket = self.task_status_index.get(ti.status)
-        if bucket is not None:
-            bucket.pop(ti.uid, None)
-            if not bucket:
-                del self.task_status_index[ti.status]
+    def _count_add(self, status_val: int, delta: int) -> None:
+        c = self._counts.get(status_val, 0) + delta
+        if c:
+            self._counts[status_val] = c
+        else:
+            self._counts.pop(status_val, None)
 
     def add_task_info(self, ti: TaskInfo) -> None:
-        self.tasks[ti.uid] = ti
-        self._add_to_index(ti)
-        if allocated_status(ti.status):
+        if ti.uid in self._store.row_of:
+            raise KeyError(f"task {ti.uid} already in job {self.uid}")
+        status = ti.status
+        node_name = ti.node_name
+        volume_ready = ti.volume_ready
+        ti._detach()
+        row = self._store.append(ti, status, node_name, volume_ready)
+        ti._blk = self._store
+        ti._row = row
+        self._count_add(int(status), 1)
+        if allocated_status(status):
             self.allocated.add(ti.resreq)
         self.total_request.add(ti.resreq)
-        self._invalidate_request_matrices()
+        if self._views is not None:
+            self._views[ti.uid] = ti
+        if self._index is not None:
+            self._index.setdefault(status, {})[ti.uid] = ti
 
     def delete_task_info(self, ti: TaskInfo) -> None:
-        task = self.tasks.get(ti.uid)
-        if task is None:
+        st = self._store
+        row = st.row_of.get(ti.uid)
+        if row is None:
             raise KeyError(f"task {ti.namespace}/{ti.name} not in job {self.uid}")
-        if allocated_status(task.status):
-            self.allocated.sub(task.resreq)
-        self.total_request.sub(task.resreq)
-        del self.tasks[task.uid]
-        self._delete_from_index(task)
-        self._invalidate_request_matrices()
+        status = _STATUS_OBJ[int(st.status[row])]
+        core = st.cores[row]
+        if allocated_status(status):
+            self.allocated.sub(core.resreq)
+        self.total_request.sub(core.resreq)
+        # Detach live views/cores of this row so held refs keep final values.
+        if core._blk is st:
+            core._detach()
+        if self._views is not None:
+            view = self._views.pop(ti.uid, None)
+            if view is not None and view._blk is st:
+                view._detach()
+        if ti._blk is st:
+            ti._detach()
+        if self._index is not None:
+            bucket = self._index.get(status)
+            if bucket is not None:
+                bucket.pop(ti.uid, None)
+                if not bucket:
+                    del self._index[status]
+        st.kill(ti.uid)
+        self._count_add(int(status), -1)
+        # Compact HERE (not at matrix build): no caller holds raw row indices
+        # across a delete — engines work on session clones (own stores) and
+        # cross-store row reuse is generation-guarded — whereas matrix builds
+        # happen mid-cycle with live row sets in flight.  This also bounds
+        # storage for churning jobs that never rebuild matrices.
+        if st.dead > max(64, len(st.row_of)):
+            st._compact(self._views)
 
     def update_task_status(self, ti: TaskInfo, status: TaskStatus) -> None:
         """Move a task between status buckets, maintaining the allocated aggregate."""
-        task = self.tasks.get(ti.uid)
-        if task is None:
+        st = self._store
+        row = st.row_of.get(ti.uid)
+        if row is None:
             raise KeyError(f"task {ti.uid} not in job {self.uid}")
-        self._delete_from_index(task)
-        if allocated_status(task.status):
-            self.allocated.sub(task.resreq)
-        task.status = status
-        ti.status = status
-        if allocated_status(status):
-            self.allocated.add(task.resreq)
-        self._add_to_index(task)
+        old_val = int(st.status[row])
+        new_val = int(status)
+        core = st.cores[row]
+        resreq = core.resreq if core is not None else ti.resreq
+        if old_val & _ALLOC_BITS:
+            self.allocated.sub(resreq)
+        st.status[row] = new_val
+        if ti._blk is not st:
+            ti.status = status  # caller's detached/foreign object tracks too
+        if new_val & _ALLOC_BITS:
+            self.allocated.add(resreq)
+        self._count_add(old_val, -1)
+        self._count_add(new_val, 1)
+        if self._index is not None:
+            old_status = _STATUS_OBJ[old_val]
+            bucket = self._index.get(old_status)
+            view = None
+            if bucket is not None:
+                view = bucket.pop(ti.uid, None)
+                if not bucket:
+                    del self._index[old_status]
+            if view is None:
+                view = self.view_for_row(row)
+            self._index.setdefault(status, {})[ti.uid] = view
+
+    def bulk_update_status_rows(
+        self, rows: np.ndarray, status: TaskStatus, net_add: Optional[np.ndarray] = None
+    ) -> None:
+        """Vectorized ``update_task_status`` over row indices: one column
+        write, O(statuses) count updates, one dense aggregate delta.
+
+        ``net_add`` ([R] row, optional): precomputed sum of the batch's resreq
+        rows (CommitPlan) — valid only when every row moves from a
+        non-allocated to an allocated status.
+        """
+        if len(rows) == 0:
+            return
+        st = self._store
+        rows = np.asarray(rows)
+        if rows.shape[0] > 1:
+            # A repeat in one batch is a no-op the second time (sequential
+            # update_task_status would see status already == target).
+            rows = np.unique(rows)
+        old = st.status[rows]
+        new_val = int(status)
+        now_alloc = bool(new_val & _ALLOC_BITS)
+        was_alloc = (old.astype(np.int64) & _ALLOC_BITS) != 0
+        sub_rows = rows[was_alloc] if not now_alloc else rows[:0]
+        add_rows = rows[~was_alloc] if now_alloc else rows[:0]
+        if sub_rows.shape[0] and net_add is not None:
+            raise ValueError(
+                "net_add given but batch contains an allocated->non-allocated transition"
+            )
+        if sub_rows.shape[0] or (add_rows.shape[0] and net_add is None):
+            req, _, _ = self.request_matrices()
+        if sub_rows.shape[0]:
+            self.allocated.sub_array(req[sub_rows].sum(axis=0))
+        if net_add is not None and add_rows.shape[0]:
+            self.allocated.add_array(net_add)
+        elif add_rows.shape[0]:
+            self.allocated.add_array(
+                req[add_rows].sum(axis=0), bool(st.has_scalars[add_rows].any())
+            )
+        # Counts: one bincount over the old values.
+        vals, cnts = np.unique(old, return_counts=True)
+        for v, c in zip(vals.tolist(), cnts.tolist()):
+            self._count_add(int(v), -int(c))
+        self._count_add(new_val, int(rows.shape[0]))
+        st.status[rows] = new_val
+        self._index = None  # rebuilt lazily; views stay valid
 
     def bulk_update_status(self, tasks: list, status: TaskStatus, net_add=None) -> None:
-        """Batch ``update_task_status``: same bucket moves, but ONE aggregate
-        update computed as a dense vector sum instead of per-task Resource ops.
-        Equivalent final state to calling update_task_status per task; the
-        aggregate applies BEFORE the index moves so a failed sufficiency
-        assertion leaves the job consistent.
-
-        ``net_add`` (dense [R] row, optional): the precomputed sum of the
-        batch's resreq rows (CommitPlan) — valid only when every task moves
-        from a non-allocated to an allocated status; skips gathering per-task
-        rows entirely."""
+        """Batch ``update_task_status`` over task objects (object-path API).
+        Equivalent final state to calling update_task_status per task; repeats
+        in one batch are no-ops the second time."""
         if not tasks:
             return
-        from scheduler_tpu.api.resource import sum_rows
-
-        now_allocated = allocated_status(status)
-        resolved = []
-        sub_rows = []
-        add_rows = []
-        add_count = 0
-        seen = set()
+        st = self._store
+        row_of = st.row_of
+        rows = []
+        foreign = []
         for ti in tasks:
-            task = self.tasks.get(ti.uid)
-            if task is None:
+            row = row_of.get(ti.uid)
+            if row is None:
                 raise KeyError(f"task {ti.uid} not in job {self.uid}")
-            if ti.uid in seen:
-                # A repeat in one batch is a no-op the second time (sequential
-                # update_task_status would see status already == target).
-                continue
-            seen.add(ti.uid)
-            was_allocated = allocated_status(task.status)
-            # sub-then-add of the same rows cancels when allocation-ness is
-            # unchanged (e.g. Allocated -> Binding at dispatch) — skip it.
-            if was_allocated and not now_allocated:
-                if net_add is not None:
-                    raise ValueError(
-                        "net_add given but batch contains an allocated->"
-                        "non-allocated transition"
-                    )
-                sub_rows.append(task.resreq)
-            elif now_allocated and not was_allocated:
-                if net_add is None:
-                    add_rows.append(task.resreq)
-                add_count += 1
-            resolved.append((ti, task))
-        if sub_rows:
-            self.allocated.sub_array(sum_rows(sub_rows)[0])
-        if net_add is not None and add_count:
-            self.allocated.add_array(net_add)
-        elif add_rows:
-            self.allocated.add_array(*sum_rows(add_rows))
-        for ti, task in resolved:
-            self._delete_from_index(task)
-            task.status = status
+            rows.append(row)
+            if ti._blk is not st:
+                foreign.append(ti)
+        self.bulk_update_status_rows(np.asarray(rows, dtype=np.int64), status, net_add)
+        for ti in foreign:
             ti.status = status
-            self._add_to_index(task)
+
+    def set_node_names_rows(self, rows: np.ndarray, names) -> None:
+        """Vectorized ``task.node_name = ...`` over rows.  ``names`` is a str
+        (broadcast) or a sequence aligned with ``rows``."""
+        if len(rows) == 0:
+            return
+        col = self._store.node_name
+        if isinstance(names, str):
+            col[rows] = names
+        else:
+            col[np.asarray(rows)] = np.asarray(names, dtype=object)
 
     # -- gang arithmetic (job_info.go:367-418) ------------------------------
 
     def ready_task_num(self) -> int:
-        return sum(
-            len(tasks)
-            for status, tasks in self.task_status_index.items()
-            if allocated_status(status) or status == TaskStatus.SUCCEEDED
+        c = self._counts
+        return (
+            c.get(int(TaskStatus.BOUND), 0)
+            + c.get(int(TaskStatus.BINDING), 0)
+            + c.get(int(TaskStatus.RUNNING), 0)
+            + c.get(int(TaskStatus.ALLOCATED), 0)
+            + c.get(int(TaskStatus.SUCCEEDED), 0)
         )
 
     def waiting_task_num(self) -> int:
-        return len(self.task_status_index.get(TaskStatus.PIPELINED, {}))
+        return self._counts.get(int(TaskStatus.PIPELINED), 0)
 
     def valid_task_num(self) -> int:
-        return sum(
-            len(tasks)
-            for status, tasks in self.task_status_index.items()
-            if allocated_status(status)
-            or status
-            in (TaskStatus.SUCCEEDED, TaskStatus.PIPELINED, TaskStatus.PENDING)
+        return (
+            self.ready_task_num()
+            + self._counts.get(int(TaskStatus.PIPELINED), 0)
+            + self._counts.get(int(TaskStatus.PENDING), 0)
         )
 
     def ready(self) -> bool:
@@ -342,7 +866,7 @@ class JobInfo:
 
     def fit_error(self) -> str:
         """Histogram of task statuses for unschedulable messages (job_info.go:344-364)."""
-        reasons = {str(status): len(tasks) for status, tasks in self.task_status_index.items()}
+        reasons = {str(_STATUS_OBJ[v]): c for v, c in self._counts.items() if c}
         reasons["minAvailable"] = self.min_available
         sorted_strs = sorted(f"{v} {k}" for k, v in reasons.items())
         return "job is not ready, {}.".format(", ".join(sorted_strs))
@@ -350,16 +874,13 @@ class JobInfo:
     # -- clone (job_info.go:295-329) ----------------------------------------
 
     def clone(self) -> "JobInfo":
-        """Status-isolated deep clone (job_info.go:295-329).
-
-        Tasks are cloned with SHARED request vectors (``TaskInfo.clone_shared``):
-        resreq/init_resreq are immutable after task creation (no mutating call
-        site exists), so sharing them is state-equivalent to the reference's
-        deep copy while skipping two vector copies per task.  The aggregates are
-        copied directly instead of re-summed per task — by construction they
-        equal the fold of ``add_task_info`` over the tasks.
-        """
-        job = JobInfo(self.uid, self.vocab)
+        """Status-isolated clone (job_info.go:295-329): copies the three mutable
+        columns and shares everything immutable — O(arrays), no per-task work.
+        Materializing the clone's ``tasks`` yields exactly the dict the
+        reference's per-task deep copy would."""
+        job = JobInfo.__new__(JobInfo)
+        job.uid = self.uid
+        job.vocab = self.vocab
         job.name = self.name
         job.namespace = self.namespace
         job.queue = self.queue
@@ -367,26 +888,19 @@ class JobInfo:
         job.min_available = self.min_available
         job.pod_group = self.pod_group
         job.creation_timestamp = self.creation_timestamp
-        index = job.task_status_index
-        tasks = job.tasks
-        for task in self.tasks.values():
-            t = task.clone_shared()
-            tasks[t.uid] = t
-            bucket = index.get(t.status)
-            if bucket is None:
-                bucket = index[t.status] = {}
-            bucket[t.uid] = t
+        job._store = self._store.clone_state()
+        job._views = None
+        job._index = None
+        job._counts = dict(self._counts)
         job.allocated = self.allocated.clone()
         job.total_request = self.total_request.clone()
-        # Same task set, shared (immutable) request vectors -> the cached
-        # request matrices stay valid for the clone.
-        job._req_matrix = self._req_matrix
-        job._init_req_matrix = self._init_req_matrix
-        job._req_row_of = self._req_row_of
+        job.nodes_fit_errors = {}
+        job.nodes_fit_delta = {}
+        job.job_fit_errors = ""
         return job
 
     def __repr__(self) -> str:
         return (
             f"Job({self.namespace}/{self.name} uid={self.uid} queue={self.queue} "
-            f"minAvailable={self.min_available} tasks={len(self.tasks)})"
+            f"minAvailable={self.min_available} tasks={self.task_count})"
         )

@@ -7,6 +7,15 @@ on task status (:165-222) is what makes pipelining onto releasing resources work
 * PIPELINED task: subtracted from Releasing only (it consumes resources that a
   releasing task will free), not from Idle.
 * any other (allocated-ish) status: subtracted from Idle, added to Used.
+
+TPU-native change vs round 1: the per-node task map is built LAZILY.  Adds
+record (frozen status, node name, source) entries and apply accounting
+immediately; the frozen ``TaskInfo`` clones that ``tasks`` exposes are only
+materialized when something actually walks the map (preempt/reclaim victim
+sweeps, set_node rebuilds, tests).  Pure allocate/bind cycles — the hot path —
+never pay the 2x100k ``clone_shared`` cost that dominated the round-1 commit.
+``task_count`` is maintained eagerly so the pod-count predicate and the node
+tensors never force materialization.
 """
 
 from __future__ import annotations
@@ -25,6 +34,40 @@ class NodeState:
     NOT_READY = "NotReady"
 
 
+class _Pending:
+    """A recorded-but-unmaterialized node task: the source task object (its
+    immutable identity fields are what the frozen clone copies) plus the
+    status/node frozen at add time."""
+
+    __slots__ = ("status", "node_name", "src")
+
+    def __init__(self, status: TaskStatus, node_name: str, src: TaskInfo) -> None:
+        self.status = status
+        self.node_name = node_name
+        self.src = src
+
+    def resreq(self) -> ResourceVec:
+        return self.src.resreq
+
+    def materialize(self) -> TaskInfo:
+        t = self.src.clone_shared()
+        t.status = self.status
+        t.node_name = self.node_name
+        return t
+
+
+class _Batch:
+    """A whole deferred columnar add: task cores (row-independent immutable
+    identity objects) sharing one frozen status.  Immutable once recorded, so
+    node clones share it by reference."""
+
+    __slots__ = ("cores", "status")
+
+    def __init__(self, cores, status: TaskStatus) -> None:
+        self.cores = cores
+        self.status = status
+
+
 class NodeInfo:
     def __init__(self, vocab: ResourceVocabulary, node: Optional[NodeSpec] = None) -> None:
         self.vocab = vocab
@@ -37,13 +80,36 @@ class NodeInfo:
         self.allocatable: ResourceVec = ResourceVec.empty(vocab)
         self.capability: ResourceVec = ResourceVec.empty(vocab)
 
-        self.tasks: Dict[str, TaskInfo] = {}
+        self._tasks: Dict[str, TaskInfo] = {}
+        self._pending: Dict[str, _Pending] = {}
+        self._batches: list = []
+        self.task_count: int = 0
 
         self.state_phase: str = NodeState.NOT_READY
         self.state_reason: str = "UnInitialized"
 
         if node is not None:
             self.set_node(node)
+
+    def _explode_batches(self) -> None:
+        if self._batches:
+            pending = self._pending
+            name = self.name
+            for batch in self._batches:
+                status = batch.status
+                for core in batch.cores:
+                    pending[core.uid] = _Pending(status, name, core)
+            self._batches = []
+
+    @property
+    def tasks(self) -> Dict[str, TaskInfo]:
+        """The frozen per-node task map (materializes deferred adds)."""
+        self._explode_batches()
+        if self._pending:
+            for uid, entry in self._pending.items():
+                self._tasks[uid] = entry.materialize()
+            self._pending.clear()
+        return self._tasks
 
     def ready(self) -> bool:
         return self.state_phase == NodeState.READY
@@ -90,34 +156,52 @@ class NodeInfo:
                 self.idle.sub(task.resreq)
             self.used.add(task.resreq)
 
+    def _account_add(self, status: TaskStatus, resreq: ResourceVec) -> None:
+        if self.node is not None:
+            if status == TaskStatus.RELEASING:
+                self.releasing.add(resreq)
+                self.idle.sub(resreq)
+            elif status == TaskStatus.PIPELINED:
+                self.releasing.sub(resreq)
+            else:
+                self.idle.sub(resreq)
+            self.used.add(resreq)
+
+    def _account_remove(self, status: TaskStatus, resreq: ResourceVec) -> None:
+        if self.node is not None:
+            if status == TaskStatus.RELEASING:
+                self.releasing.sub(resreq)
+                self.idle.add(resreq)
+            elif status == TaskStatus.PIPELINED:
+                self.releasing.add(resreq)
+            else:
+                self.idle.add(resreq)
+            self.used.sub(resreq)
+
+    def _contains(self, uid: str) -> bool:
+        self._explode_batches()
+        return uid in self._tasks or uid in self._pending
+
     def add_task(self, task: TaskInfo) -> None:
         """Account a task onto this node (node_info.go:165-196).
 
-        Holds a clone so later status changes don't corrupt node accounting.
+        The map holds a status-frozen clone so later status changes don't
+        corrupt node accounting; the clone is deferred until the map is read.
         """
-        if task.uid in self.tasks:
+        if self._contains(task.uid):
             raise ValueError(f"task {task.namespace}/{task.name} already on node {self.name}")
-
-        ti = task.clone()
-        if self.node is not None:
-            if ti.status == TaskStatus.RELEASING:
-                self.releasing.add(ti.resreq)
-                self.idle.sub(ti.resreq)
-            elif ti.status == TaskStatus.PIPELINED:
-                self.releasing.sub(ti.resreq)
-            else:
-                self.idle.sub(ti.resreq)
-            self.used.add(ti.resreq)
-        self.tasks[ti.uid] = ti
+        status = task.status
+        self._account_add(status, task.resreq)
+        self._pending[task.uid] = _Pending(status, task.node_name, task)
+        self.task_count += 1
 
     def bulk_add_tasks(self, tasks, agg=None) -> None:
         """Batch ``add_task``: the same status state machine, with the resource
         arithmetic collapsed into one dense delta per accounting vector.
 
-        Tasks must already carry their final status; clones stored in
-        ``self.tasks`` share request vectors (``TaskInfo.clone_shared``).
-        Arithmetic applies BEFORE any dict insert so a failed sufficiency
-        assertion leaves the node consistent (no half-registered batch).
+        Tasks must already carry their final status.  Arithmetic applies BEFORE
+        any record insert so a failed sufficiency assertion leaves the node
+        consistent (no half-registered batch).
 
         ``agg`` (CommitPlan node delta, optional):
         (idle_sub, releasing_sub, used_add, n_alloc, n_pipe) dense rows —
@@ -130,16 +214,16 @@ class NodeInfo:
         if agg is not None:
             # Trusted engine batch (CommitPlan): no per-task ledger gathering.
             # ALL validation runs before any state mutates (same atomicity
-            # promise as the generic path): one uid-set pass replaces the
-            # per-task membership probes.
+            # promise as the generic path).
             releasing_status = TaskStatus.RELEASING
-            clones = []
+            entries = []
             for task in tasks:
-                if task.status is releasing_status:
+                status = task.status
+                if status is releasing_status:
                     raise ValueError("agg fast path does not cover RELEASING tasks")
-                clones.append(task.clone_shared())
-            uids = {t.uid for t in clones}
-            if len(uids) != len(clones) or not self.tasks.keys().isdisjoint(uids):
+                entries.append((task.uid, _Pending(status, task.node_name, task)))
+            uids = {uid for uid, _ in entries}
+            if len(uids) != len(entries) or any(self._contains(u) for u in uids):
                 raise ValueError(f"duplicate task in bulk add on node {self.name}")
             a_idle_sub, a_rel_sub, a_used_add, n_alloc, n_pipe = agg
             if self.node is not None:
@@ -148,34 +232,35 @@ class NodeInfo:
                 if n_pipe:
                     self.releasing.sub_array(a_rel_sub)
                 self.used.add_array(a_used_add)
-            node_tasks = self.tasks
-            for ti in clones:
-                node_tasks[ti.uid] = ti
+            pending = self._pending
+            for uid, entry in entries:
+                pending[uid] = entry
+            self.task_count += len(entries)
             return
 
         idle_sub = []
         rel_add = []
         rel_sub = []
         used_add = []
-        clones = []
+        entries = []
         batch_uids = set()
         for task in tasks:
-            if task.uid in self.tasks or task.uid in batch_uids:
+            if self._contains(task.uid) or task.uid in batch_uids:
                 raise ValueError(
                     f"task {task.namespace}/{task.name} already on node {self.name}"
                 )
             batch_uids.add(task.uid)
-            ti = task.clone_shared()
+            status = task.status
             if self.node is not None:
-                if ti.status == TaskStatus.RELEASING:
-                    rel_add.append(ti.resreq)
-                    idle_sub.append(ti.resreq)
-                elif ti.status == TaskStatus.PIPELINED:
-                    rel_sub.append(ti.resreq)
+                if status == TaskStatus.RELEASING:
+                    rel_add.append(task.resreq)
+                    idle_sub.append(task.resreq)
+                elif status == TaskStatus.PIPELINED:
+                    rel_sub.append(task.resreq)
                 else:
-                    idle_sub.append(ti.resreq)
-                used_add.append(ti.resreq)
-            clones.append(ti)
+                    idle_sub.append(task.resreq)
+                used_add.append(task.resreq)
+            entries.append((task.uid, _Pending(status, task.node_name, task)))
         if idle_sub:
             self.idle.sub_array(sum_rows(idle_sub)[0])
         if rel_add:
@@ -184,23 +269,48 @@ class NodeInfo:
             self.releasing.sub_array(sum_rows(rel_sub)[0])
         if used_add:
             self.used.add_array(*sum_rows(used_add))
-        for ti in clones:
-            self.tasks[ti.uid] = ti
+        pending = self._pending
+        for uid, entry in entries:
+            pending[uid] = entry
+        self.task_count += len(entries)
+
+    def add_deferred_batches(self, batches, agg) -> None:
+        """Columnar batch add (trusted engine commit): no clones, no per-uid
+        inserts — whole ``(cores, status)`` batch records are appended and
+        explode only if the map is actually read.  ``agg`` is the CommitPlan
+        node delta carrying ALL the ledger arithmetic; the engine guarantees
+        batch uids are fresh (a device placement only targets PENDING tasks),
+        so the object path's per-uid duplicate probe is skipped."""
+        n = 0
+        append = self._batches.append
+        for cores, status in batches:
+            if cores:
+                append(_Batch(cores, status))
+                n += len(cores)
+        if not n:
+            return
+        a_idle_sub, a_rel_sub, a_used_add, n_alloc, n_pipe = agg
+        if self.node is not None:
+            if n_alloc:
+                self.idle.sub_array(a_idle_sub)
+            if n_pipe:
+                self.releasing.sub_array(a_rel_sub)
+            self.used.add_array(a_used_add)
+        self.task_count += n
 
     def remove_task(self, ti: TaskInfo) -> None:
-        task = self.tasks.get(ti.uid)
+        self._explode_batches()
+        entry = self._pending.pop(ti.uid, None)
+        if entry is not None:
+            self._account_remove(entry.status, entry.resreq())
+            self.task_count -= 1
+            return
+        task = self._tasks.get(ti.uid)
         if task is None:
             raise KeyError(f"task {ti.namespace}/{ti.name} not on node {self.name}")
-        if self.node is not None:
-            if task.status == TaskStatus.RELEASING:
-                self.releasing.sub(task.resreq)
-                self.idle.add(task.resreq)
-            elif task.status == TaskStatus.PIPELINED:
-                self.releasing.add(task.resreq)
-            else:
-                self.idle.add(task.resreq)
-            self.used.sub(task.resreq)
-        del self.tasks[task.uid]
+        self._account_remove(task.status, task.resreq)
+        del self._tasks[task.uid]
+        self.task_count -= 1
 
     def update_task(self, ti: TaskInfo) -> None:
         self.remove_task(ti)
@@ -211,21 +321,32 @@ class NodeInfo:
         return self.allocatable.max_task_num
 
     def clone(self) -> "NodeInfo":
-        n = NodeInfo(self.vocab)
+        n = NodeInfo.__new__(NodeInfo)
+        n.vocab = self.vocab
         n.name = self.name
         n.node = self.node
         n.state_phase = self.state_phase
         n.state_reason = self.state_reason
-        n.allocatable = self.allocatable.clone()
-        n.capability = self.capability.clone()
+        # allocatable/capability are never mutated in place (set_node rebinds
+        # fresh vectors), so clones share them; idle/used/releasing mutate.
+        n.allocatable = self.allocatable
+        n.capability = self.capability
         n.releasing = self.releasing.clone()
         n.idle = self.idle.clone()
         n.used = self.used.clone()
-        for task in self.tasks.values():
-            # Shared request vectors: immutable after task creation (see
-            # JobInfo.clone); only status isolation is needed.
-            n.tasks[task.uid] = task.clone_shared()
+        n._tasks = {}
+        n._pending = {}
+        n._batches = []
+        n.task_count = 0
+        for task in self._tasks.values():
+            # Folded entries are mutated in place by eviction paths (the
+            # handed-out victim objects), so the clone needs its own copies;
+            # deferred entries are immutable records and copy by reference.
+            n._tasks[task.uid] = task.clone_shared()
+        n._pending = dict(self._pending)
+        n._batches = list(self._batches)
+        n.task_count = self.task_count
         return n
 
     def __repr__(self) -> str:
-        return f"Node({self.name} idle=<{self.idle}> used=<{self.used}> tasks={len(self.tasks)})"
+        return f"Node({self.name} idle=<{self.idle}> used=<{self.used}> tasks={self.task_count})"

@@ -304,6 +304,81 @@ def build_task_tensors(
     )
 
 
+def build_task_tensors_columnar(
+    per_job: Sequence,
+    jobs: JobTensors,
+    vocab: ResourceVocabulary,
+    label_vocab: LabelVocab,
+    taint_vocab: TaintVocab,
+) -> TaskTensors:
+    """``build_task_tensors`` from ``(JobInfo, rows)`` pairs — request rows,
+    priority and creation gather straight from the job stores (byte-identical
+    to the object path: the matrices ARE copies of each task's vectors); only
+    selector/toleration extraction touches pod objects, and no TaskInfo views
+    are materialized at all."""
+    t = sum(len(rows) for _, rows in per_job)
+    r = vocab.size
+    mins = vocab.min_thresholds()
+    resreq = np.zeros((t, r))
+    init_resreq = np.zeros((t, r))
+    job_idx = np.full(t, -1, dtype=np.int32)
+    priority = np.zeros(t, dtype=np.int32)
+    creation = np.zeros(t)
+    selector = np.zeros((t, label_vocab.size), dtype=bool)
+    has_unknown = np.zeros(t, dtype=bool)
+    tolerated = np.zeros((t, taint_vocab.size), dtype=bool)
+    uids: List[str] = []
+
+    taints = taint_vocab.taints
+    base = 0
+    for job, rows in per_job:
+        n = len(rows)
+        if n == 0:
+            continue
+        st = job.store
+        req_m, init_m, _ = job.request_matrices()
+        width = min(req_m.shape[1], r)
+        resreq[base : base + n, :width] = req_m[rows, :width]
+        init_resreq[base : base + n, :width] = init_m[rows, :width]
+        job_idx[base : base + n] = jobs.index.get(job.uid, -1)
+        priority[base : base + n] = st.priority[rows]
+        creation[base : base + n] = st.creation[rows]
+        cores = st.cores
+        uid_list = st.uids
+        for k, row in enumerate(rows.tolist()):
+            uids.append(uid_list[row])
+            pod = cores[row].pod
+            sel = pod.node_selector
+            if sel:
+                for key, value in sel.items():
+                    idx = label_vocab.lookup(key, value)
+                    if idx is None:
+                        has_unknown[base + k] = True
+                    else:
+                        selector[base + k, idx] = True
+            if taints:
+                tols = pod.tolerations
+                for col, taint in enumerate(taints):
+                    if any(tol.tolerates(taint) for tol in tols):
+                        tolerated[base + k, col] = True
+        base += n
+
+    best_effort = np.all(init_resreq < mins[None, :], axis=1)
+    return TaskTensors(
+        uids=uids,
+        index={uid: i for i, uid in enumerate(uids)},
+        resreq=resreq,
+        init_resreq=init_resreq,
+        job_idx=job_idx,
+        priority=priority,
+        creation=creation,
+        best_effort=best_effort,
+        selector=selector,
+        has_unknown_selector=has_unknown,
+        tolerated=tolerated,
+    )
+
+
 def build_job_tensors(jobs: Sequence[JobInfo], queue_names: List[str]) -> JobTensors:
     j = len(jobs)
     queue_index = {name: i for i, name in enumerate(queue_names)}
@@ -345,6 +420,36 @@ def build_snapshot_tensors(
     job_tensors = build_job_tensors(job_list, queue_names)
     task_tensors = build_task_tensors(
         tasks, job_tensors, vocab, label_vocab, taint_vocab, job_infos=job_list
+    )
+    return SnapshotTensors(
+        vocab=vocab,
+        label_vocab=label_vocab,
+        taint_vocab=taint_vocab,
+        min_thresholds=vocab.min_thresholds(),
+        nodes=node_tensors,
+        tasks=task_tensors,
+        jobs=job_tensors,
+        queue_names=list(queue_names),
+    )
+
+
+def build_snapshot_tensors_columnar(
+    nodes: Iterable[NodeInfo],
+    jobs: Iterable[JobInfo],
+    per_job: Sequence,
+    queue_names: List[str],
+    vocab: ResourceVocabulary,
+) -> SnapshotTensors:
+    """``build_snapshot_tensors`` with task rows given as ``(job, rows)`` pairs
+    (job-store row indices) instead of TaskInfo objects."""
+    label_vocab = LabelVocab()
+    taint_vocab = TaintVocab()
+    node_list = sorted(nodes, key=lambda n: n.name)
+    job_list = list(jobs)
+    node_tensors = build_node_tensors(node_list, vocab, label_vocab, taint_vocab)
+    job_tensors = build_job_tensors(job_list, queue_names)
+    task_tensors = build_task_tensors_columnar(
+        per_job, job_tensors, vocab, label_vocab, taint_vocab
     )
     return SnapshotTensors(
         vocab=vocab,

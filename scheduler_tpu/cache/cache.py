@@ -177,7 +177,8 @@ class SchedulerCache(Cache):
             job_id = f"{pod.namespace}/{shadow_pod_group_name(pod)}"
         job = self.jobs.get(job_id)
         if job is not None:
-            task = job.tasks.get(pod.uid)
+            row = job.store.row_of.get(pod.uid)
+            task = job.view_for_row(row) if row is not None else None
             if task is not None:
                 job.delete_task_info(task)
                 if task.node_name and task.node_name in self.nodes:
@@ -189,7 +190,7 @@ class SchedulerCache(Cache):
 
     def _gc_job(self, job: JobInfo) -> None:
         """Drop finished/empty jobs (the reference's deletedJobs GC queue)."""
-        if not job.tasks and job.pod_group is None:
+        if job.task_count == 0 and job.pod_group is None:
             self.jobs.pop(job.uid, None)
 
     # -- node events ---------------------------------------------------------
@@ -271,7 +272,7 @@ class SchedulerCache(Cache):
                 # building lazily on a clone would be lost at session close).
                 # Only jobs with pending tasks feed the task tensors — a huge
                 # all-running job must not pay a rebuild on every churn cycle.
-                if TaskStatus.PENDING in job.task_status_index:
+                if job.status_count(TaskStatus.PENDING):
                     job.request_matrices()
                 clone = job.clone()
                 if clone.pod_group is not None:
@@ -412,6 +413,108 @@ class SchedulerCache(Cache):
             task.node_name = ""
             job.update_task_status(task, TaskStatus.PENDING)
 
+    # -- columnar commit hooks (TPU-native extension) --------------------------
+
+    def allocate_volumes_rows(self, job, rows, names) -> None:
+        if getattr(self.volume_binder, "NOOP", False) or len(rows) == 0:
+            return
+        for r, name in zip(rows, names):
+            self.volume_binder.allocate_volumes(job.view_for_row(int(r)), name)
+
+    def bind_volumes_rows(self, job, rows) -> None:
+        if getattr(self.volume_binder, "NOOP", False):
+            return
+        for r in rows:
+            self.volume_binder.bind_volumes(job.view_for_row(int(r)))
+
+    def bind_bulk_columnar(self, items, plan) -> None:
+        """Columnar ``bind_bulk``: (session_job, rows) batches applied to the
+        cache's own jobs by ROW — valid because the session job clone shares
+        the cache job's row space and the store generation proves the task set
+        has not drifted since the snapshot.  On any drift the whole batch
+        falls back to the uid-resolving object path (same atomic semantics).
+
+        ``plan`` = CommitPlan.bind_deltas output (required here — the session
+        only routes through this path when the plan covers the batch).
+        """
+        node_rows, job_rows = plan
+        with self.mutex:
+            resolved = []
+            distinct_nodes = set(node_rows)
+            for sjob, rows in items:
+                cjob = self.jobs.get(sjob.uid)
+                if cjob is None:
+                    raise KeyError(f"failed to find job {sjob.uid}")
+                if cjob.store.gen != sjob.store.gen:
+                    resolved = None
+                    break
+                resolved.append((cjob, rows, sjob.store.node_name[rows]))
+            if resolved is None:
+                # Task set drifted mid-cycle: resolve by uid instead.
+                tasks = [
+                    sjob.view_for_row(int(r)) for sjob, rows in items for r in rows
+                ]
+                self.bind_bulk(tasks, None)
+                return
+            for hostname in distinct_nodes:
+                if hostname not in self.nodes:
+                    raise KeyError(f"failed to find node {hostname}")
+            per_node: Dict[str, list] = {}
+            for cjob, rows, names in resolved:
+                cjob.bulk_update_status_rows(
+                    rows, TaskStatus.BINDING, net_add=job_rows.get(cjob.uid)
+                )
+                cjob.set_node_names_rows(rows, names)
+                cores = cjob.store.cores
+                for r, name in zip(rows.tolist(), names.tolist()):
+                    per_node.setdefault(name, []).append(cores[r])
+            for hostname, cores in per_node.items():
+                row, count = node_rows[hostname]
+                # Bind batches are allocated-status only: idle -= row,
+                # used += row, releasing untouched.
+                self.nodes[hostname].add_deferred_batches(
+                    [(cores, TaskStatus.BINDING)], (row, None, row, count, 0)
+                )
+
+        for cjob, rows, names in resolved:
+            n = len(rows)
+            chunk = max(16, min(self._BIND_CHUNK, -(-n // self._IO_WORKERS)))
+            for start in range(0, n, chunk):
+                self._submit_io(
+                    self._bind_chunk_columnar,
+                    cjob,
+                    rows[start : start + chunk],
+                    names[start : start + chunk],
+                )
+
+    def _bind_chunk_columnar(self, cjob, rows, names) -> None:
+        from scheduler_tpu.cache.interface import BulkBindError
+
+        cores = cjob.store.cores
+        pairs = [(cores[r].pod, name) for r, name in zip(rows.tolist(), names.tolist())]
+        failed_uids = set()
+        try:
+            self.binder.bind_bulk(pairs)
+        except BulkBindError as e:
+            failed_uids = {pod.uid for pod, _ in e.failed}
+        except Exception:
+            logger.exception("bulk bind failed; resyncing chunk")
+            failed_uids = {pod.uid for pod, _ in pairs}
+        with self.mutex:
+            for pod, hostname in pairs:
+                if pod.uid not in failed_uids:
+                    pod.node_name = hostname
+        if failed_uids:
+            for pod, hostname in pairs:
+                if pod.uid not in failed_uids:
+                    continue
+                logger.error("bind of %s to %s failed; resyncing", pod.uid, hostname)
+                with self.mutex:
+                    row = cjob.store.row_of.get(pod.uid)
+                    task = cjob.view_for_row(row) if row is not None else None
+                if task is not None:
+                    self._resync_failed_bind(task, hostname)
+
     def evict(self, ti: TaskInfo, reason: str) -> None:
         """Mark releasing locally, then dispatch the eviction asynchronously."""
         with self.mutex:
@@ -454,6 +557,8 @@ class SchedulerCache(Cache):
 
     def record_job_status_event(self, job: JobInfo) -> None:
         """Emit unschedulable conditions for unscheduled tasks (cache.go:500-525)."""
+        if not job.status_count(TaskStatus.PENDING):
+            return  # nothing unscheduled; skip without materializing views
         base_msg = job.job_fit_errors or ALL_NODE_UNAVAILABLE
         for status, tasks in job.task_status_index.items():
             if status != TaskStatus.PENDING:

@@ -98,6 +98,10 @@ class FakeStatusUpdater(StatusUpdater):
 
 
 class FakeVolumeBinder(VolumeBinder):
+    # No side effects at all: the columnar commit path skips task-view
+    # materialization entirely for NOOP volume binders.
+    NOOP = True
+
     def allocate_volumes(self, task, hostname: str) -> None:
         pass
 

@@ -109,6 +109,25 @@ class Cache(abc.ABC):
     @abc.abstractmethod
     def bind_volumes(self, task: "TaskInfo") -> None: ...
 
+    # -- columnar commit hooks (TPU-native extension) -------------------------
+    # Defaults materialize task views and delegate to the per-task methods, so
+    # any Cache implementation is automatically columnar-capable; the real
+    # SchedulerCache overrides these with vectorized versions.
+
+    def allocate_volumes_rows(self, job: "JobInfo", rows, names) -> None:
+        for r, name in zip(rows, names):
+            self.allocate_volumes(job.view_for_row(int(r)), name)
+
+    def bind_volumes_rows(self, job: "JobInfo", rows) -> None:
+        for r in rows:
+            self.bind_volumes(job.view_for_row(int(r)))
+
+    def bind_bulk_columnar(self, items: list, plan) -> None:
+        """Bind (session_job, rows) batches.  Default: materialize and use the
+        object path."""
+        tasks = [job.view_for_row(int(r)) for job, rows in items for r in rows]
+        self.bind_bulk(tasks)
+
     @abc.abstractmethod
     def client(self):
         """Handle to the backing API client (None for fake-backed caches)."""

@@ -43,6 +43,38 @@ logger = logging.getLogger("scheduler_tpu.session")
 _session_counter = itertools.count(1)
 
 
+class _LazyTaskViews:
+    """Sequence of placed task views that materializes on first access — the
+    ``tasks`` argument handed to bulk allocate handlers by the columnar commit
+    (builtin handlers consume only the CommitPlan and never touch it)."""
+
+    def __init__(self, items) -> None:
+        self._items = items
+        self._views: Optional[list] = None
+
+    def _materialize(self) -> list:
+        views = self._views
+        if views is None:
+            views = self._views = [
+                job.view_for_row(int(r))
+                for job, rows, _names, _pipe in self._items
+                for r in rows
+            ]
+        return views
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def __len__(self) -> int:
+        return sum(len(rows) for _job, rows, _names, _pipe in self._items)
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+
 class Session:
     def __init__(self, cache: "Cache", tiers: Optional[List[Tier]] = None) -> None:
         self.uid: str = f"ssn-{next(_session_counter)}"
@@ -369,9 +401,39 @@ class Session:
             if eh.deallocate_func is not None:
                 eh.deallocate_func(Event(task))
 
-    def _fire_allocate_bulk(self, tasks: List[TaskInfo], plan=None) -> None:
+    @staticmethod
+    def _call_bulk_handler(fn, tasks, plan) -> None:
+        """Invoke a bulk allocate handler with or without the CommitPlan,
+        matched to its signature: a parameter literally named ``plan`` gets it
+        by keyword; otherwise a second positional slot (or ``*args``) gets it
+        positionally; otherwise the handler is plan-unaware.  Raw arity
+        counting misclassifies ``(tasks, **kwargs)``; name-only checking
+        breaks ``(tasks, commit_plan)`` — this covers both."""
         import inspect
 
+        try:
+            params = inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            fn(tasks)
+            return
+        if "plan" in params:
+            fn(tasks, plan=plan)
+            return
+        positional = [
+            p
+            for p in params.values()
+            if p.kind
+            in (inspect.Parameter.POSITIONAL_ONLY, inspect.Parameter.POSITIONAL_OR_KEYWORD)
+        ]
+        var_pos = any(
+            p.kind is inspect.Parameter.VAR_POSITIONAL for p in params.values()
+        )
+        if len(positional) >= 2 or var_pos:
+            fn(tasks, plan)
+        else:
+            fn(tasks)
+
+    def _fire_allocate_bulk(self, tasks: List[TaskInfo], plan=None) -> None:
         events = None
         for eh in self.event_handlers:
             if eh.bulk_allocate_func is not None:
@@ -380,14 +442,7 @@ class Session:
                 # CommitPlan with precomputed per-job/per-queue sums.  Handlers
                 # written against the original single-arg contract still work:
                 # the plan is passed only if the signature accepts it.
-                try:
-                    takes_plan = len(inspect.signature(eh.bulk_allocate_func).parameters) >= 2
-                except (TypeError, ValueError):
-                    takes_plan = False
-                if takes_plan:
-                    eh.bulk_allocate_func(tasks, plan)
-                else:
-                    eh.bulk_allocate_func(tasks)
+                self._call_bulk_handler(eh.bulk_allocate_func, tasks, plan)
             elif eh.allocate_func is not None:
                 if events is None:
                     events = [Event(t) for t in tasks]
@@ -507,6 +562,83 @@ class Session:
             bind_plan = plan.bind_deltas(ready_uids) if plan_covers_bind else None
             self.cache.bind_bulk(to_bind, bind_plan)
 
+    def bulk_apply_columnar(self, items, node_batches, plan) -> None:
+        """Commit a whole device placement with NO per-task Python objects:
+        the columnar equivalent of ``bulk_apply`` (same final state, argued
+        there), driven by job-store row indices and the CommitPlan ledgers.
+
+        ``items``: [(job, rows, names, pipe)] — placed rows per job in
+        placement order, the target node name per row, and the pipelined mask.
+        ``node_batches``: node name -> [(cores, status)] deferred node-side
+        task records grouped by the engine.
+        """
+        if not items:
+            return
+        import numpy as np
+
+        from scheduler_tpu.api.types import TaskStatus as TS
+
+        job_alloc = plan.job_alloc()
+        affected: List[JobInfo] = []
+        for job, rows, names, pipe in items:
+            if len(rows) == 0:
+                continue
+            alloc_rows = rows[~pipe]
+            pipe_rows = rows[pipe]
+            self.cache.allocate_volumes_rows(job, alloc_rows, names[~pipe])
+            job.bulk_update_status_rows(alloc_rows, TS.ALLOCATED, net_add=job_alloc.get(job.uid))
+            job.bulk_update_status_rows(pipe_rows, TS.PIPELINED)
+            job.set_node_names_rows(rows, names)
+            affected.append(job)
+
+        node_deltas = plan.node_deltas()
+        nodes = self.nodes
+        for node_name, batches in node_batches.items():
+            node = nodes.get(node_name)
+            if node is None:
+                raise KeyError(f"failed to find node {node_name}")
+            node.add_deferred_batches(batches, node_deltas[node_name])
+
+        self._fire_allocate_bulk_columnar(items, plan)
+
+        to_bind = []
+        ready_uids: List[str] = []
+        plan_covers_bind = True
+        alloc_counts = plan.job_alloc_counts()
+        for job in affected:
+            if self.job_ready(job):
+                alloc_rows = job.rows_with_status(TS.ALLOCATED)
+                # The plan's bind ledger covers exactly THIS batch's allocated
+                # rows; Allocated tasks left by an earlier action in the same
+                # session would under-account it (see bulk_apply).
+                if alloc_rows.shape[0] != alloc_counts.get(job.uid, 0):
+                    plan_covers_bind = False
+                self.cache.bind_volumes_rows(job, alloc_rows)
+                job.bulk_update_status_rows(alloc_rows, TS.BINDING)
+                to_bind.append((job, alloc_rows))
+                ready_uids.append(job.uid)
+        if to_bind:
+            if plan_covers_bind:
+                self.cache.bind_bulk_columnar(to_bind, plan.bind_deltas(ready_uids))
+            else:
+                tasks = [
+                    job.view_for_row(int(r)) for job, rows in to_bind for r in rows
+                ]
+                self.cache.bind_bulk(tasks, None)
+
+    def _fire_allocate_bulk_columnar(self, items, plan) -> None:
+        """Event fan-out for the columnar commit.  Builtin bulk handlers
+        consume only the plan; the tasks argument is a LAZY sequence that
+        materializes views only if a handler actually touches it, so handlers
+        reading both tasks and plan keep the object-path contract."""
+        lazy = _LazyTaskViews(items)
+        for eh in self.event_handlers:
+            if eh.bulk_allocate_func is not None:
+                self._call_bulk_handler(eh.bulk_allocate_func, lazy, plan)
+            elif eh.allocate_func is not None:
+                for t in lazy:
+                    eh.allocate_func(Event(t))
+
     def _dispatch(self, task: TaskInfo) -> None:
         """Bind an allocated task through the cache (session.go:299-323)."""
         self.cache.bind_volumes(task)
@@ -541,7 +673,8 @@ class Session:
 
 
 def job_status(ssn: Session, job: JobInfo) -> PodGroupStatus:
-    """Recompute a job's PodGroup status at session close (session.go:151-189)."""
+    """Recompute a job's PodGroup status at session close (session.go:151-189).
+    Pure count arithmetic — never materializes task objects."""
     status = job.pod_group.status
 
     unschedulable = any(
@@ -551,20 +684,16 @@ def job_status(ssn: Session, job: JobInfo) -> PodGroupStatus:
         for c in status.conditions
     )
 
-    if job.task_status_index.get(TaskStatus.RUNNING) and unschedulable:
+    if job.status_count(TaskStatus.RUNNING) and unschedulable:
         status.phase = PodGroupPhase.UNKNOWN
     else:
-        allocated = sum(
-            len(tasks)
-            for st, tasks in job.task_status_index.items()
-            if st in ALLOCATED_STATUSES
-        )
+        allocated = sum(job.status_count(st) for st in ALLOCATED_STATUSES)
         if allocated >= job.pod_group.min_member:
             status.phase = PodGroupPhase.RUNNING
         elif job.pod_group.status.phase != PodGroupPhase.INQUEUE:
             status.phase = PodGroupPhase.PENDING
 
-    status.running = len(job.task_status_index.get(TaskStatus.RUNNING, {}))
-    status.failed = len(job.task_status_index.get(TaskStatus.FAILED, {}))
-    status.succeeded = len(job.task_status_index.get(TaskStatus.SUCCEEDED, {}))
+    status.running = job.status_count(TaskStatus.RUNNING)
+    status.failed = job.status_count(TaskStatus.FAILED)
+    status.succeeded = job.status_count(TaskStatus.SUCCEEDED)
     return status

@@ -44,8 +44,19 @@ def _lib_path() -> str:
 def build(force: bool = False) -> Optional[str]:
     """Compile the shared library; returns its path or None on failure."""
     out = _lib_path()
-    if not force and os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(_SRC):
-        return out
+    try:
+        # Up-to-date probe inside the try: a stripped install (compiled .so
+        # shipped without src/) must load what exists or degrade to the numpy
+        # fallbacks, never raise out of _load().
+        if not force and os.path.exists(out) and (
+            not os.path.exists(_SRC)
+            or os.path.getmtime(out) >= os.path.getmtime(_SRC)
+        ):
+            return out
+    except OSError:
+        return out if os.path.exists(out) else None
+    if not os.path.exists(_SRC):
+        return None
     cxx = os.environ.get("CXX", "g++")
     tmp = None
     try:

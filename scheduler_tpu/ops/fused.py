@@ -49,7 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from scheduler_tpu.api.job_info import JobInfo, TaskInfo
-from scheduler_tpu.api.tensors import bucket, build_snapshot_tensors
+from scheduler_tpu.api.tensors import bucket, build_snapshot_tensors_columnar
 from scheduler_tpu.api.types import TaskStatus
 from scheduler_tpu.ops.allocator import (
     build_static_tensors,
@@ -61,7 +61,11 @@ from scheduler_tpu.ops.allocator import (
 from scheduler_tpu.ops.device import DevicePolicy, pad_rows, scale_columns
 from scheduler_tpu.ops.predicates import fit_mask
 from scheduler_tpu.ops.scoring import dynamic_score
-from scheduler_tpu.utils.scheduler_helper import task_sort_key as _task_sort_key
+from scheduler_tpu.utils.scheduler_helper import (
+    enabled_task_order_chain as _enabled_task_order_chain,
+    task_order_builtin,
+    task_sort_key as _task_sort_key,
+)
 
 logger = logging.getLogger("scheduler_tpu.ops.fused")
 
@@ -436,11 +440,13 @@ class FusedAllocator:
             return out
 
         # --- jobs + flat tasks (job-major, task order within job) -----------
+        # Pending tasks are collected as job-store ROW indices, not objects:
+        # the builtin task order sorts straight from the columns; a custom
+        # task-order chain falls back to object collection and converts.
         self.jobs: List[JobInfo] = list(jobs)
         j = len(self.jobs)
         jb = bucket(max(j, 1))
-        self.job_rows: List[List[TaskInfo]] = []
-        flat: List[TaskInfo] = []
+        self.job_rows: List[np.ndarray] = []
         offsets = np.zeros(jb, dtype=np.int32)
         nums = np.zeros(jb, dtype=np.int32)
         deficits = np.zeros(jb, dtype=np.int32)
@@ -469,23 +475,40 @@ class FusedAllocator:
         # every placement (deficit 0), matching the host/per-pop engines.
         gang_break = gang_ready_active(ssn)
 
-        sort_key = _task_sort_key(ssn)
+        if task_order_builtin(ssn):
+            use_priority = "priority" in _enabled_task_order_chain(ssn)
+
+            def pending_rows(job: JobInfo) -> np.ndarray:
+                return job.pending_rows_sorted(use_priority)
+        else:
+            sort_key = _task_sort_key(ssn)
+
+            def pending_rows(job: JobInfo) -> np.ndarray:
+                row_of = job.store.row_of
+                return np.asarray(
+                    [row_of[t.uid] for t in collect_pending(job, sort_key)],
+                    dtype=np.int64,
+                )
+
+        t_total = 0
         for k, job in enumerate(self.jobs):
-            pending = collect_pending(job, sort_key)
-            self.job_rows.append(pending)
-            offsets[k] = len(flat)
-            nums[k] = len(pending)
+            rows = pending_rows(job)
+            self.job_rows.append(rows)
+            offsets[k] = t_total
+            nums[k] = len(rows)
             true_deficit = job.min_available - job.ready_task_num()
             deficits[k] = true_deficit if gang_break else 0
             gang_order[k] = true_deficit
             priorities[k] = int(job.priority)
             queues_idx[k] = queue_pos[job.queue]
             alloc_init[k] = rvec(job.allocated)
-            flat.extend(pending)
+            t_total += len(rows)
 
-        self.flat = flat
+        self.flat_count = t_total
         node_list = sorted(ssn.nodes.values(), key=lambda nd: nd.name)
-        st = build_snapshot_tensors(node_list, self.jobs, flat, queue_names, vocab)
+        st = build_snapshot_tensors_columnar(
+            node_list, self.jobs, list(zip(self.jobs, self.job_rows)), queue_names, vocab
+        )
         self.st = st
         self._queues_of_jobs = queues_idx
 
@@ -495,7 +518,7 @@ class FusedAllocator:
         self.node_names = st.nodes.names
         n = st.nodes.count
         nb = bucket(max(n, 1))
-        tb = bucket(max(len(flat), 1))
+        tb = bucket(max(t_total, 1))
 
         node_gate = pad_rows(st.nodes.ready, nb, fill=False)
 
@@ -520,7 +543,7 @@ class FusedAllocator:
         # run per placement step under binpack-only scoring.  With static
         # tensors, a run must also share its mask/score rows (same requests do
         # not imply same selectors), so those break runs too.
-        t_count = len(flat)
+        t_count = t_total
         run_host = np.ones(tb, dtype=np.int32)
         if t_count > 1:
             from scheduler_tpu import native
@@ -632,13 +655,20 @@ class FusedAllocator:
         if ssn.device_predicates or ssn.device_scorers:
             n_bucket = bucket(max(len(ssn.nodes), 1))
             pending = sum(
-                1
-                for job in ssn.jobs.values()
-                for t in job.task_status_index.get(TaskStatus.PENDING, {}).values()
-                if not t.resreq_empty
+                job.pending_eligible_count() for job in ssn.jobs.values()
             )
             t_bucket = bucket(max(pending, 1))
-            limit = int(os.environ.get("SCHEDULER_TPU_FUSED_STATIC_LIMIT", str(160 * 1024 * 1024)))
+            try:
+                limit = int(
+                    os.environ.get(
+                        "SCHEDULER_TPU_FUSED_STATIC_LIMIT", str(160 * 1024 * 1024)
+                    )
+                )
+            except ValueError:
+                logger.warning(
+                    "malformed SCHEDULER_TPU_FUSED_STATIC_LIMIT; using 160MiB default"
+                )
+                limit = 160 * 1024 * 1024
             if 5 * t_bucket * n_bucket > limit:
                 return False
         if set(ssn.job_order_fns) - set(_KNOWN_JOB_ORDER):
@@ -671,10 +701,7 @@ class FusedAllocator:
 
         return max(1, int(os.environ.get("SCHEDULER_TPU_WINDOW", "8")))
 
-    def run(self) -> Dict[str, List[Tuple[TaskInfo, Optional[str], bool, bool]]]:
-        """Execute the fused kernel; returns per-job rows in placement order:
-        [(task, node_name | None, pipelined, failed)] — same row shape as
-        ``DeviceAllocator.place_job``, truncated at each job's pop boundary."""
+    def _execute(self) -> np.ndarray:
         encoded = np.asarray(
             fused_allocate(
                 *self.args,
@@ -689,8 +716,80 @@ class FusedAllocator:
                 batch_runs=self.batch_runs,
             )
         )
-
         self._encoded = encoded
+        return encoded
+
+    def run_columnar(self):
+        """Execute the fused kernel and decode WITHOUT task objects.
+
+        Returns ``(items, node_batches, failures)``:
+          items        [(job, rows, names, pipe)] — placed job-store rows in
+                       placement (task) order, target node name per row, and
+                       the pipelined mask — the ``Session.bulk_apply_columnar``
+                       contract;
+          node_batches node name -> [(cores, status)] deferred node records;
+          failures     [(job, row)] first-infeasible rows (FitError sites).
+        """
+        from scheduler_tpu import native
+
+        encoded = self._execute()
+        t = self.flat_count
+        names_arr = np.asarray(self.node_names, dtype=object)
+
+        items = []
+        failures = []
+        flat_nid = []
+        flat_pipe = []
+        flat_cores = []
+        base = 0
+        for job, rows in zip(self.jobs, self.job_rows):
+            n = len(rows)
+            if n == 0:
+                items.append((job, rows[:0], np.empty(0, dtype=object), np.zeros(0, bool)))
+                continue
+            codes = encoded[base : base + n]
+            base += n
+            placed_alloc = codes >= 0
+            placed_pipe = codes <= _PIPE_BASE
+            placed = placed_alloc | placed_pipe
+            fail = np.nonzero(codes == FAILED)[0]
+            if fail.shape[0]:
+                failures.append((job, int(rows[fail[0]])))
+            sel_rows = rows[placed]
+            if sel_rows.shape[0] == 0:
+                items.append((job, sel_rows, np.empty(0, dtype=object), np.zeros(0, bool)))
+                continue
+            nid = np.where(codes >= 0, codes, _PIPE_BASE - codes)[placed]
+            pipe = placed_pipe[placed]
+            items.append((job, sel_rows, names_arr[nid], pipe))
+            cores = job.store.cores
+            flat_cores.extend(cores[r] for r in sel_rows.tolist())
+            flat_nid.append(nid)
+            flat_pipe.append(pipe)
+
+        node_batches: Dict[str, list] = {}
+        if flat_cores:
+            nid_all = np.concatenate(flat_nid)
+            pipe_all = np.concatenate(flat_pipe)
+            # Group into per-(node, status) batches with one stable sort.
+            key = nid_all * 2 + pipe_all
+            order = np.argsort(key, kind="stable")
+            uniq, starts = np.unique(key[order], return_index=True)
+            bounds = list(starts.tolist()) + [order.shape[0]]
+            order_l = order.tolist()
+            for g, k in enumerate(uniq.tolist()):
+                node_name = self.node_names[k >> 1]
+                status = TaskStatus.PIPELINED if (k & 1) else TaskStatus.ALLOCATED
+                members = [flat_cores[i] for i in order_l[bounds[g] : bounds[g + 1]]]
+                node_batches.setdefault(node_name, []).append((members, status))
+        return items, node_batches, failures
+
+    def run(self) -> Dict[str, List[Tuple[TaskInfo, Optional[str], bool, bool]]]:
+        """Execute the fused kernel; returns per-job rows in placement order:
+        [(task, node_name | None, pipelined, failed)] — same row shape as
+        ``DeviceAllocator.place_job``, truncated at each job's pop boundary.
+        (Object-path decode; the production commit uses ``run_columnar``.)"""
+        encoded = self._execute()
 
         # One bulk conversion: per-element int(ndarray[i]) costs ~100x a list
         # element access at this scale.
@@ -700,10 +799,11 @@ class FusedAllocator:
         base = 0
         for job, rows in zip(self.jobs, self.job_rows):
             decoded: List[Tuple[TaskInfo, Optional[str], bool, bool]] = []
-            for i, task in enumerate(rows):
+            for i, row in enumerate(rows.tolist()):
                 code = codes[base + i]
                 if code == UNPLACED:
                     continue
+                task = job.view_for_row(row)
                 if code == FAILED:
                     decoded.append((task, None, False, True))
                 elif code <= _PIPE_BASE:
@@ -720,7 +820,7 @@ class FusedAllocator:
         from scheduler_tpu.api.commit_plan import CommitPlan
         from scheduler_tpu import native
 
-        t = len(self.flat)
+        t = self.flat_count
         node_id, pipelined, _failed, _n = native.decode_placement_codes(
             self._encoded[:t]
         )

@@ -60,12 +60,20 @@ class DrfPlugin(Plugin):
         for node in ssn.nodes.values():
             self.total_resource.add(node.allocatable)
 
+        from scheduler_tpu.api.types import ALLOCATED_STATUSES
+
         for job in ssn.jobs.values():
             attr = _DrfAttr(ResourceVec.empty(vocab))
-            for status, tasks in job.task_status_index.items():
-                if allocated_status(status):
-                    for t in tasks.values():
-                        attr.allocated.add(t.resreq)
+            # Columnar fold of allocated-status resreqs — byte-identical to
+            # adding per task (the matrix rows are exact copies); jobs whose
+            # matrices aren't built this cycle use the maintained aggregate.
+            if any(job.status_count(s) for s in ALLOCATED_STATUSES):
+                if job.store.matrices_valid():
+                    attr.allocated.add_array(*job.status_sum(ALLOCATED_STATUSES))
+                else:
+                    attr.allocated.add_array(
+                        job.allocated.array.copy(), job.allocated.has_scalars
+                    )
             self._update_share(attr)
             self.job_attrs[job.uid] = attr
 

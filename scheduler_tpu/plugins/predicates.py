@@ -192,16 +192,18 @@ class PredicatesPlugin(Plugin):
             if t == 0:
                 return np.ones((0, st.nodes.count), dtype=bool)
             mask = None
-            import os
-
-            if os.environ.get("SCHEDULER_TPU_PALLAS", "1") not in ("0", "false"):
-                # One fused Pallas kernel: selector + taint matmuls (MXU) and
-                # the unknown/unschedulable gates in a single [T, N] tile pass.
-                # Import inside the try: a jax build without pallas-TPU support
-                # must fall back to the jnp path, not crash the session.
+            # One fused Pallas kernel: selector + taint matmuls (MXU) and
+            # the unknown/unschedulable gates in a single [T, N] tile pass.
+            # Import inside the try: a jax build without pallas-TPU support
+            # must fall back to the jnp path, not crash the session — and
+            # pallas_kernels.pallas_enabled() is the single source of truth
+            # for the on/off flag.
+            try:
+                from scheduler_tpu.ops import pallas_kernels
+            except Exception:  # pragma: no cover - backend-specific
+                pallas_kernels = None
+            if pallas_kernels is not None and pallas_kernels.pallas_enabled():
                 try:
-                    from scheduler_tpu.ops import pallas_kernels
-
                     mask = pallas_kernels.static_predicate_mask(
                         st.tasks.selector,
                         st.tasks.has_unknown_selector,

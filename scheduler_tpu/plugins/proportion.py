@@ -61,7 +61,10 @@ class ProportionPlugin(Plugin):
         for node in ssn.nodes.values():
             self.total_resource.add(node.allocatable)
 
-        # Build per-queue aggregates from jobs' tasks.
+        # Build per-queue aggregates from jobs' tasks (columnar status folds —
+        # byte-identical to the per-task adds; see drf.on_session_open).
+        from scheduler_tpu.api.types import ALLOCATED_STATUSES
+
         for job in ssn.jobs.values():
             if job.queue not in self.queue_attrs:
                 queue = ssn.queues.get(job.queue)
@@ -69,14 +72,16 @@ class ProportionPlugin(Plugin):
                     continue
                 self.queue_attrs[job.queue] = _QueueAttr(queue, vocab)
             attr = self.queue_attrs[job.queue]
-            for status, tasks in job.task_status_index.items():
-                if allocated_status(status):
-                    for t in tasks.values():
-                        attr.allocated.add(t.resreq)
-                        attr.request.add(t.resreq)
-                elif status == TaskStatus.PENDING:
-                    for t in tasks.values():
-                        attr.request.add(t.resreq)
+            if any(job.status_count(s) for s in ALLOCATED_STATUSES):
+                if job.store.matrices_valid():
+                    alloc_row, alloc_hs = job.status_sum(ALLOCATED_STATUSES)
+                else:
+                    alloc_row = job.allocated.array.copy()
+                    alloc_hs = job.allocated.has_scalars
+                attr.allocated.add_array(alloc_row, alloc_hs)
+                attr.request.add_array(alloc_row, alloc_hs)
+            if job.status_count(TaskStatus.PENDING):
+                attr.request.add_array(*job.status_sum((TaskStatus.PENDING,)))
 
         # Water-filling (proportion.go:101-154).
         remaining = self.total_resource.clone()

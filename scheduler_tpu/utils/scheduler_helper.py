@@ -97,6 +97,26 @@ def select_best_node(node_scores: Dict[NodeInfo, float]) -> NodeInfo:
     return best
 
 
+def enabled_task_order_chain(ssn) -> set:
+    """Plugin names whose task-order callbacks are registered AND enabled, in
+    dispatch terms — THE single source for every consumer that special-cases
+    the builtin chain (task_sort_key's fast path, the columnar engines)."""
+    return {
+        plugin.name
+        for tier in ssn.tiers
+        for plugin in tier.plugins
+        if plugin.task_order_enabled() and plugin.name in ssn.task_order_fns
+    }
+
+
+def task_order_builtin(ssn) -> bool:
+    """True when the enabled task-order chain is the builtin priority plugin
+    (or empty) — i.e. the sort key is the plain ``(-priority, req_sig,
+    creation, uid)`` tuple, which the columnar engines build straight from the
+    job store columns without materializing task objects."""
+    return enabled_task_order_chain(ssn) <= {"priority"}
+
+
 def task_sort_key(ssn) -> Callable:
     """Sort key equivalent of the session's task_order_fn for list.sort().
 
@@ -106,12 +126,7 @@ def task_sort_key(ssn) -> Callable:
     comparator through every tier per comparison (~500k dispatches for a
     100k-task cycle, the dominant host-side cost before this path existed).
     """
-    enabled = {
-        plugin.name
-        for tier in ssn.tiers
-        for plugin in tier.plugins
-        if plugin.task_order_enabled() and plugin.name in ssn.task_order_fns
-    }
+    enabled = enabled_task_order_chain(ssn)
     if enabled <= {"priority"}:
         if "priority" in enabled:
             # priority.go:39-59: higher pod priority first; then the same

@@ -33,7 +33,7 @@ def run(n_nodes: int, n_pods: int, label: str) -> None:
     ssn = open_session(cluster.cache, conf.tiers)
     t1 = time.perf_counter()
 
-    from scheduler_tpu.actions.allocate import apply_fused_results, collect_candidates
+    from scheduler_tpu.actions.allocate import collect_candidates, record_fused_failures
     from scheduler_tpu.ops.fused import FusedAllocator
 
     candidates = collect_candidates(ssn)
@@ -42,10 +42,11 @@ def run(n_nodes: int, n_pods: int, label: str) -> None:
     engine = FusedAllocator(ssn, candidates)
     t3 = time.perf_counter()
 
-    results = engine.run()
+    items, node_batches, failures = engine.run_columnar()
     t4 = time.perf_counter()
 
-    apply_fused_results(ssn, candidates, results, plan_fn=engine.commit_plan)
+    record_fused_failures(failures)
+    ssn.bulk_apply_columnar(items, node_batches, engine.commit_plan())
     t5 = time.perf_counter()
 
     close_session(ssn)

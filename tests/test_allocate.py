@@ -197,13 +197,13 @@ class TestDeviceHostParity:
         used = {}
         from scheduler_tpu.ops.fused import FusedAllocator
 
-        orig = FusedAllocator.run
+        orig = FusedAllocator._execute
 
         def spy(self):
             used["yes"] = True
             return orig(self)
 
-        monkeypatch.setattr(FusedAllocator, "run", spy)
+        monkeypatch.setattr(FusedAllocator, "_execute", spy)
         monkeypatch.setenv("SCHEDULER_TPU_DEVICE", "1")
         cache = make_cluster(n_nodes=3)
         add_gang(cache, "gang1", n_tasks=3, min_member=3)

@@ -194,7 +194,7 @@ class TaskInfo:
         bytes (widths can otherwise differ when the vocabulary grew between
         task creations)."""
         blk = self._blk
-        if blk is not None and blk.sigs is not None and blk.matrix_gen == blk.gen:
+        if blk is not None and blk.sigs is not None and blk.sig_gen == blk.gen:
             return blk.sigs[self._row]
         sig = self.req_sig_cache
         if sig is None:
@@ -278,10 +278,11 @@ class _TaskRows:
       valid data.  Compaction REBINDS the owner's slots to fresh lists/arrays
       (never mutates shared ones in place) and remaps any live views.
     * the immutable per-row columns (``priority`` / ``creation`` /
-      ``resreq_empty`` / ``has_scalars`` numpy arrays) are shared and appended
-      with reallocation-on-growth, so clones' refs stay valid for their rows.
-    * request matrices + byte signatures build lazily (``gen`` vs
-      ``matrix_gen``) and are shared by clones taken while valid.
+      ``resreq_empty`` / ``has_scalars`` arrays and the request MATRICES) are
+      shared and appended with reallocation-on-growth, so clones' refs stay
+      valid for their rows.
+    * byte signatures build lazily (``gen`` vs ``sig_gen``) and are shared by
+      clones taken while valid.
     """
 
     __slots__ = (
@@ -300,7 +301,7 @@ class _TaskRows:
         "init_req_matrix",
         "sigs",
         "gen",
-        "matrix_gen",
+        "sig_gen",
         "dead",
         "r_dim",
     )
@@ -311,18 +312,23 @@ class _TaskRows:
         self.status = np.zeros(cap, dtype=np.int16)
         self.node_name = np.empty(cap, dtype=object)
         self.volume_ready = np.zeros(cap, dtype=bool)
-        self.cores: List[Optional[TaskInfo]] = []
-        self.uids: List[Optional[str]] = []
+        # Object ndarrays (not lists) so engine decode/grouping can gather
+        # thousands of cores/uids with one fancy index instead of list comps.
+        self.cores = np.empty(cap, dtype=object)
+        self.uids = np.empty(cap, dtype=object)
         self.row_of: Dict[str, int] = {}
         self.priority = np.zeros(cap, dtype=np.int64)
         self.creation = np.zeros(cap, dtype=np.float64)
         self.resreq_empty = np.zeros(cap, dtype=bool)
         self.has_scalars = np.zeros(cap, dtype=bool)
-        self.req_matrix: Optional[np.ndarray] = None
-        self.init_req_matrix: Optional[np.ndarray] = None
+        # Request matrices are maintained INCREMENTALLY at append time (the
+        # cost rides event ingestion, not the scheduling cycle); they only
+        # rebuild wholesale at compaction.  Signatures build lazily per cycle.
+        self.req_matrix = np.zeros((cap, r_dim), dtype=np.float64)
+        self.init_req_matrix = np.zeros((cap, r_dim), dtype=np.float64)
         self.sigs: Optional[List[bytes]] = None
         self.gen = 0
-        self.matrix_gen = -1
+        self.sig_gen = -1
         self.dead = 0
         self.r_dim = r_dim
 
@@ -331,11 +337,27 @@ class _TaskRows:
     def _grow(self) -> None:
         cap = max(16, 2 * self.status.shape[0])
         for slot in ("status", "node_name", "volume_ready", "priority", "creation",
-                     "resreq_empty", "has_scalars"):
+                     "resreq_empty", "has_scalars", "cores", "uids"):
             old = getattr(self, slot)
             new = np.zeros(cap, dtype=old.dtype) if old.dtype != object else np.empty(cap, dtype=object)
             new[: old.shape[0]] = old
             setattr(self, slot, new)
+        for slot in ("req_matrix", "init_req_matrix"):
+            old = getattr(self, slot)
+            new = np.zeros((cap, old.shape[1]), dtype=np.float64)
+            new[: old.shape[0]] = old
+            setattr(self, slot, new)
+
+    def _widen(self, r: int) -> None:
+        """Grow the request-matrix width (vocab registered new scalars)."""
+        for slot in ("req_matrix", "init_req_matrix"):
+            old = getattr(self, slot)
+            new = np.zeros((old.shape[0], r), dtype=np.float64)
+            new[:, : old.shape[1]] = old
+            setattr(self, slot, new)
+        self.r_dim = r
+        self.sigs = None
+        self.sig_gen = -1
 
     def append(self, core: TaskInfo, status: TaskStatus, node_name: str,
                volume_ready: bool) -> int:
@@ -346,13 +368,21 @@ class _TaskRows:
         self.status[row] = int(status)
         self.node_name[row] = node_name
         self.volume_ready[row] = volume_ready
-        self.cores.append(core)
-        self.uids.append(core.uid)
+        self.cores[row] = core
+        self.uids[row] = core.uid
         self.row_of[core.uid] = row
         self.priority[row] = core.priority
         self.creation[row] = core.pod.creation_timestamp
         self.resreq_empty[row] = bool(core.resreq_empty)
         self.has_scalars[row] = core.resreq.has_scalars
+        arr = core.resreq.array
+        if arr.shape[0] > self.r_dim:
+            self._widen(arr.shape[0])
+        self.req_matrix[row, : arr.shape[0]] = arr
+        arr = core.init_resreq.array
+        if arr.shape[0] > self.r_dim:
+            self._widen(arr.shape[0])
+        self.init_req_matrix[row, : arr.shape[0]] = arr
         self.gen += 1
         return row
 
@@ -384,48 +414,31 @@ class _TaskRows:
         blk.init_req_matrix = self.init_req_matrix
         blk.sigs = self.sigs
         blk.gen = self.gen
-        blk.matrix_gen = self.matrix_gen
+        blk.sig_gen = self.sig_gen
         blk.dead = self.dead
         blk.r_dim = self.r_dim
         return blk
 
-    # -- request matrices ------------------------------------------------------
+    # -- request signatures ----------------------------------------------------
 
-    def matrices_valid(self) -> bool:
-        return self.matrix_gen == self.gen and self.req_matrix is not None
+    def sigs_valid(self) -> bool:
+        return self.sig_gen == self.gen and self.sigs is not None
 
-    def build_matrices(self, views: Optional[Dict[str, TaskInfo]]) -> None:
-        """(Re)build the request matrices + signatures aligned with this row
-        space (dead rows stay zero — compaction happens only at delete time,
-        never here, so callers holding row indices across this call stay
-        valid).
-
-        Rows are exact copies of each task's request vectors (immutable after
-        creation), so gathers from these matrices are byte-identical to reading
-        ``task.resreq.array`` per task.
-        """
+    def build_sigs(self) -> None:
+        """Byte signatures sliced from the (incrementally maintained) matrix
+        buffers: identical bytes to ``resreq.array.tobytes() +
+        init_resreq.array.tobytes()`` at matrix width — the uniform width
+        makes the sort tie-break consistent across tasks created at
+        different vocabulary sizes."""
         n = self.n
-        r = self.r_dim
-        req = np.zeros((n, r), dtype=np.float64)
-        init = np.zeros((n, r), dtype=np.float64)
-        for uid, row in self.row_of.items():
-            core = self.cores[row]
-            arr = core.resreq.array
-            req[row, : arr.shape[0]] = arr
-            arr = core.init_resreq.array
-            init[row, : arr.shape[0]] = arr
-        self.req_matrix = req
-        self.init_req_matrix = init
-        # Byte signatures sliced from the matrix buffers: identical bytes to
-        # task.resreq.array.tobytes() + task.init_resreq.array.tobytes().
-        item = r * 8
-        req_buf = req.tobytes()
-        init_buf = init.tobytes()
+        item = self.req_matrix.shape[1] * 8
+        req_buf = self.req_matrix[:n].tobytes()
+        init_buf = self.init_req_matrix[:n].tobytes()
         self.sigs = [
             req_buf[i * item : (i + 1) * item] + init_buf[i * item : (i + 1) * item]
             for i in range(n)
         ]
-        self.matrix_gen = self.gen
+        self.sig_gen = self.gen
 
     def _compact(self, views: Optional[Dict[str, TaskInfo]]) -> None:
         """Rebuild the row space dropping tombstones.  Owner-only: fresh lists
@@ -441,8 +454,10 @@ class _TaskRows:
         creation = np.zeros(cap, dtype=np.float64)
         resreq_empty = np.zeros(cap, dtype=bool)
         has_scalars = np.zeros(cap, dtype=bool)
-        cores: List[Optional[TaskInfo]] = []
-        uids: List[Optional[str]] = []
+        req = np.zeros((cap, self.r_dim), dtype=np.float64)
+        init = np.zeros((cap, self.r_dim), dtype=np.float64)
+        cores = np.empty(cap, dtype=object)
+        uids = np.empty(cap, dtype=object)
         row_of: Dict[str, int] = {}
         for new_row, (uid, old_row) in enumerate(live):
             status[new_row] = self.status[old_row]
@@ -452,9 +467,11 @@ class _TaskRows:
             creation[new_row] = self.creation[old_row]
             resreq_empty[new_row] = self.resreq_empty[old_row]
             has_scalars[new_row] = self.has_scalars[old_row]
+            req[new_row] = self.req_matrix[old_row]
+            init[new_row] = self.init_req_matrix[old_row]
             core = self.cores[old_row]
-            cores.append(core)
-            uids.append(uid)
+            cores[new_row] = core
+            uids[new_row] = uid
             row_of[uid] = new_row
             if core is not None and core._blk is self:
                 core._row = new_row
@@ -470,13 +487,14 @@ class _TaskRows:
         self.creation = creation
         self.resreq_empty = resreq_empty
         self.has_scalars = has_scalars
+        self.req_matrix = req
+        self.init_req_matrix = init
         self.cores = cores
         self.uids = uids
         self.row_of = row_of
         self.dead = 0
-        self.req_matrix = None
-        self.init_req_matrix = None
         self.sigs = None
+        self.sig_gen = -1
         self.gen += 1
 
 
@@ -535,15 +553,26 @@ class JobInfo:
     def status_count(self, status: TaskStatus) -> int:
         return self._counts.get(int(status), 0)
 
+    def _pad_row(self, row: np.ndarray) -> np.ndarray:
+        """Pad a matrix-derived [R_matrix] row to the CURRENT vocab width —
+        the matrices' width lags when scalars registered after this job's
+        last task append."""
+        r = self.vocab.size
+        if row.shape[0] >= r:
+            return row
+        padded = np.zeros(r, dtype=np.float64)
+        padded[: row.shape[0]] = row
+        return padded
+
     def request_matrices(self):
-        """(resreq [n, R] f64, init_resreq [n, R] f64, uid -> row) over this
-        job's row space (dead rows zero)."""
+        """(resreq, init_resreq, uid -> row): full-capacity [cap >= n, R_matrix]
+        request matrices aligned with this job's row space, plus the live row
+        map.  Gather by LIVE rows only — tombstoned rows keep stale values
+        until compaction, and rows past ``store.n`` are uninitialized capacity.
+        ``R_matrix`` can lag the current vocab width (see ``_pad_row``).
+        Maintained incrementally at task add time — this is a plain accessor,
+        never a build."""
         st = self._store
-        if not st.matrices_valid():
-            # Track vocabulary growth (scalars register on the fly): matrix
-            # width follows the CURRENT vocab size at build time.
-            st.r_dim = max(st.r_dim, self.vocab.size)
-            st.build_matrices(self._views)
         return st.req_matrix, st.init_req_matrix, st.row_of
 
     def _invalidate_request_matrices(self) -> None:
@@ -574,8 +603,8 @@ class JobInfo:
         if rows.shape[0] <= 1:
             return rows
         st = self._store
-        if not st.matrices_valid():
-            self.request_matrices()
+        if not st.sigs_valid():
+            st.build_sigs()
         sigs = st.sigs
         uids = st.uids
         rl = rows.tolist()
@@ -602,12 +631,10 @@ class JobInfo:
         if rows.shape[0] == 0:
             return np.zeros(r, dtype=np.float64), False
         req, _, _ = self.request_matrices()
-        row = req[rows].sum(axis=0)
-        if row.shape[0] < r:  # vocab grew since the matrices were built
-            padded = np.zeros(r, dtype=np.float64)
-            padded[: row.shape[0]] = row
-            row = padded
-        return row, bool(st.has_scalars[rows].any())
+        return (
+            self._pad_row(req[rows].sum(axis=0)),
+            bool(st.has_scalars[rows].any()),
+        )
 
     def view_for_row(self, row: int) -> TaskInfo:
         """The task view for a row (materializes just this one if needed)."""
@@ -789,12 +816,13 @@ class JobInfo:
         if sub_rows.shape[0] or (add_rows.shape[0] and net_add is None):
             req, _, _ = self.request_matrices()
         if sub_rows.shape[0]:
-            self.allocated.sub_array(req[sub_rows].sum(axis=0))
+            self.allocated.sub_array(self._pad_row(req[sub_rows].sum(axis=0)))
         if net_add is not None and add_rows.shape[0]:
-            self.allocated.add_array(net_add)
+            self.allocated.add_array(self._pad_row(net_add))
         elif add_rows.shape[0]:
             self.allocated.add_array(
-                req[add_rows].sum(axis=0), bool(st.has_scalars[add_rows].any())
+                self._pad_row(req[add_rows].sum(axis=0)),
+                bool(st.has_scalars[add_rows].any()),
             )
         # Counts: one bincount over the old values.
         vals, cnts = np.unique(old, return_counts=True)

@@ -284,7 +284,7 @@ class NodeInfo:
         n = 0
         append = self._batches.append
         for cores, status in batches:
-            if cores:
+            if len(cores):
                 append(_Batch(cores, status))
                 n += len(cores)
         if not n:

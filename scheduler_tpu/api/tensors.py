@@ -343,11 +343,10 @@ def build_task_tensors_columnar(
         job_idx[base : base + n] = jobs.index.get(job.uid, -1)
         priority[base : base + n] = st.priority[rows]
         creation[base : base + n] = st.creation[rows]
-        cores = st.cores
-        uid_list = st.uids
-        for k, row in enumerate(rows.tolist()):
-            uids.append(uid_list[row])
-            pod = cores[row].pod
+        uids.extend(st.uids[rows].tolist())
+        cores_sel = st.cores[rows].tolist()
+        for k, core in enumerate(cores_sel):
+            pod = core.pod
             sel = pod.node_selector
             if sel:
                 for key, value in sel.items():

@@ -267,13 +267,13 @@ class SchedulerCache(Cache):
                 if job.pod_group is None:
                     logger.debug("job %s skipped in snapshot: missing PodGroup", job_id)
                     continue
-                # Build request matrices on the PERSISTENT job so the cache
+                # Build request signatures on the PERSISTENT job so the cache
                 # amortizes them across cycles (clones inherit the built refs;
                 # building lazily on a clone would be lost at session close).
-                # Only jobs with pending tasks feed the task tensors — a huge
-                # all-running job must not pay a rebuild on every churn cycle.
-                if job.status_count(TaskStatus.PENDING):
-                    job.request_matrices()
+                # Only jobs with pending tasks sort by signature — a huge
+                # all-running job must not pay a build on every churn cycle.
+                if job.status_count(TaskStatus.PENDING) and not job.store.sigs_valid():
+                    job.store.build_sigs()
                 clone = job.clone()
                 if clone.pod_group is not None:
                     pc = self.priority_classes.get(clone.pod_group.priority_class_name)
@@ -465,9 +465,9 @@ class SchedulerCache(Cache):
                     rows, TaskStatus.BINDING, net_add=job_rows.get(cjob.uid)
                 )
                 cjob.set_node_names_rows(rows, names)
-                cores = cjob.store.cores
-                for r, name in zip(rows.tolist(), names.tolist()):
-                    per_node.setdefault(name, []).append(cores[r])
+                cores_sel = cjob.store.cores[rows]
+                for core, name in zip(cores_sel.tolist(), names.tolist()):
+                    per_node.setdefault(name, []).append(core)
             for hostname, cores in per_node.items():
                 row, count = node_rows[hostname]
                 # Bind batches are allocated-status only: idle -= row,
@@ -490,8 +490,8 @@ class SchedulerCache(Cache):
     def _bind_chunk_columnar(self, cjob, rows, names) -> None:
         from scheduler_tpu.cache.interface import BulkBindError
 
-        cores = cjob.store.cores
-        pairs = [(cores[r].pod, name) for r, name in zip(rows.tolist(), names.tolist())]
+        cores = cjob.store.cores[rows]
+        pairs = [(core.pod, name) for core, name in zip(cores.tolist(), names.tolist())]
         failed_uids = set()
         try:
             self.binder.bind_bulk(pairs)

@@ -157,13 +157,18 @@ def fused_allocate(
 
     # Packed loop state (fewer scatters per step — each dynamic-update-slice
     # costs fixed per-op time that dominates the while-loop at scale):
-    #   node_state f32 [N, 2R+1]: idle | releasing | task_count
-    #   job_state  i32 [J, 3]:    cursor | n_alloc | left-count (>0 == left)
+    #   node_state f32 [N, 2R+1]:  idle | releasing | task_count
+    #   job_state  f32 [J, 3+R]:   cursor | n_alloc | left-count | drf alloc
+    # (f32 counts are exact below 2^24 — far above any task count here; the
+    # single packed row makes each step ONE job scatter instead of two.)
     r_dim = resreq.shape[1]
     pods_limit_f = pods_limit.astype(jnp.float32)
+    job_task_num_f = job_task_num.astype(jnp.float32)
+    job_gang_order_f = job_gang_order.astype(jnp.float32)
+    job_deficit_f = job_deficit.astype(jnp.float32)
 
     def eligible(job_state):
-        return (job_state[:, 2] == 0) & (job_state[:, 0] < job_task_num)
+        return (job_state[:, 2] == 0) & (job_state[:, 0] < job_task_num_f)
 
     # Single-queue sessions (the common case) skip the whole queue-selection
     # block at trace time: every eligible job is in queue 0.  Decided by the
@@ -173,7 +178,7 @@ def fused_allocate(
         n_queues == 1 and not queue_comparators and not overused_gate
     )
 
-    def job_chain(cand, job_state, alloc):
+    def job_chain(cand, job_state):
         """First-nonzero comparator chain == lexicographic masked argmin.
         Integer keys stay integer (PriorityClass values up to 2^31 compare
         exactly; float32 would collapse values above 2^24)."""
@@ -181,11 +186,11 @@ def fused_allocate(
             if name == "priority":
                 key, sentinel = -job_priority, big_i32
             elif name == "gang":
-                key = ((job_gang_order - job_state[:, 1]) <= 0).astype(jnp.int32)
+                key = ((job_gang_order_f - job_state[:, 1]) <= 0).astype(jnp.int32)
                 sentinel = big_i32
             elif name == "drf":
                 frac = jnp.where(
-                    total_mask[None, :], alloc / total_safe[None, :], 0.0
+                    total_mask[None, :], job_state[:, 3:] / total_safe[None, :], 0.0
                 )
                 key, sentinel = jnp.max(frac, axis=-1), pos_inf
             else:  # pragma: no cover - guarded by `supported`
@@ -194,10 +199,10 @@ def fused_allocate(
             cand = cand & (masked == jnp.min(masked))
         return cand
 
-    def select_job(job_state, alloc, q_alloc):
+    def select_job(job_state, q_alloc):
         elig = eligible(job_state)
         if single_queue:
-            cand = job_chain(elig, job_state, alloc)
+            cand = job_chain(elig, job_state)
             tb = jnp.where(cand, job_tiebreak, big_i32)
             return jnp.where(
                 jnp.any(cand), jnp.argmin(tb), HALT
@@ -236,7 +241,7 @@ def fused_allocate(
             cand_q = cand_q & (masked_q == jnp.min(masked_q))
         q_star = jnp.argmin(jnp.where(cand_q, queue_rank, big_i32))
         any_queue = jnp.any(q_has)
-        cand = job_chain(elig & (job_queue == q_star), job_state, alloc)
+        cand = job_chain(elig & (job_queue == q_star), job_state)
 
         tb = jnp.where(cand, job_tiebreak, big_i32)
         sel = jnp.argmin(tb)
@@ -255,9 +260,8 @@ def fused_allocate(
         ``window`` of these per iteration to amortize loop overhead (the
         semantics are IDENTICAL to window=1 — this is pure unrolling; a
         micro-step whose job pool is exhausted is a masked no-op)."""
-        (node_state, job_state, alloc, q_alloc, cur, out, steps) = state
+        (node_state, job_state, q_alloc, cur, out, steps) = state
         idle = node_state[:, :r_dim]
-        releasing = node_state[:, r_dim : 2 * r_dim]
 
         # Selection only runs when the previous pop ended (lax.cond, not
         # where): most steps continue the current job, and the comparator
@@ -265,16 +269,26 @@ def fused_allocate(
         # A HALT stays a HALT (re-selecting would return HALT again).
         cur = jax.lax.cond(
             cur == -1,
-            lambda: select_job(job_state, alloc, q_alloc),
+            lambda: select_job(job_state, q_alloc),
             lambda: cur,
         )
 
-        t_idx = jnp.clip(job_task_offset[cur] + job_state[cur, 0], 0, t_cap - 1)
+        t_idx = jnp.clip(
+            job_task_offset[cur] + job_state[cur, 0].astype(jnp.int32), 0, t_cap - 1
+        )
         init_req = init_resreq[t_idx]
         req = resreq[t_idx]
 
-        fit_idle = fit_mask(init_req, idle, mins)
-        fit_rel = fit_mask(init_req, releasing, mins)
+        # Joint epsilon-exact fit against idle AND releasing in ONE op chain:
+        # the packed node row [idle | releasing] reshapes to [N, 2, R].
+        avail2 = node_state[:, : 2 * r_dim].reshape(-1, 2, r_dim)
+        ok2 = jnp.all(
+            (init_req[None, None, :] < avail2)
+            | (jnp.abs(avail2 - init_req[None, None, :]) < mins[None, None, :]),
+            axis=-1,
+        )
+        fit_idle = ok2[:, 0]
+        fit_rel = ok2[:, 1]
         feasible = (fit_idle | fit_rel) & node_gate
         if use_static:
             feasible = feasible & static_mask[t_idx]
@@ -307,7 +321,11 @@ def fused_allocate(
             deficit_v = job_deficit[cur_safe]
             # Gang-break room: with no gang veto (deficit 0) the pop ends after
             # every placement, so the batch must stay at 1.
-            room = jnp.where(deficit_v > 0, deficit_v - job_state[cur_safe, 1], 1)
+            room = jnp.where(
+                deficit_v > 0,
+                deficit_v - job_state[cur_safe, 1].astype(jnp.int32),
+                1,
+            )
             hi0 = jnp.minimum(run_len[t_idx], jnp.int32(MAX_BATCH))
             hi0 = jnp.minimum(hi0, room)
             if enforce_pod_count:
@@ -348,21 +366,24 @@ def fused_allocate(
         consumed = jnp.where(
             alloc_here, m, (pipe_here | failed).astype(jnp.int32)
         )
-        job_row = jnp.stack([
-            jnp.where(active, consumed, 0),              # cursor advance
-            jnp.where(active & alloc_here, m, 0),        # n_alloc
-            (active & failed).astype(jnp.int32),         # left-count (first
+        # DRF shares grow on every placement — pipeline fires the allocate
+        # event too (session.go:199-239 -> drf.go:135-144).  The share delta
+        # rides the SAME packed job row as cursor/n_alloc/left: one scatter.
+        placed_copies = jnp.where(
+            active & (alloc_here | pipe_here), copies.astype(job_state.dtype), 0.0
+        )
+        job_row = jnp.concatenate([
+            jnp.stack([
+                jnp.where(active, consumed, 0),          # cursor advance
+                jnp.where(active & alloc_here, m, 0),    # n_alloc
+                (active & failed).astype(jnp.int32),     # left-count (first
                                                          # failure ends the
                                                          # job's eligibility,
                                                          # so add == set)
+            ]).astype(job_state.dtype),
+            placed_copies * req,
         ])
         job_state = job_state.at[cur_safe].add(job_row)
-        # DRF shares grow on every placement — pipeline fires the allocate
-        # event too (session.go:199-239 -> drf.go:135-144).
-        placed_copies = jnp.where(
-            active & (alloc_here | pipe_here), copies.astype(alloc.dtype), 0.0
-        )
-        alloc = alloc.at[cur_safe].add(placed_copies * req)
         if track_queue_alloc:
             # proportion's allocate event handler: queue allocated grows on
             # every placement too (proportion.go:236-246).
@@ -387,15 +408,15 @@ def fused_allocate(
 
         row_after = job_state[cur_safe]
         became_ready = (alloc_here | pipe_here) & (
-            row_after[1] >= job_deficit[cur_safe]
+            row_after[1] >= job_deficit_f[cur_safe]
         )
-        drained = row_after[0] >= job_task_num[cur_safe]
+        drained = row_after[0] >= job_task_num_f[cur_safe]
         end_pop = failed | became_ready | drained
         cur = jnp.where(
             cur == HALT, HALT, jnp.where(active & ~end_pop, cur, -1)
         )
 
-        return (node_state, job_state, alloc, q_alloc, cur, out, steps + 1)
+        return (node_state, job_state, q_alloc, cur, out, steps + 1)
 
     def body(state):
         for _ in range(window):
@@ -403,7 +424,7 @@ def fused_allocate(
         return state
 
     def cond(state):
-        (_, job_state, _, _, cur, _, steps) = state
+        (_, job_state, _, cur, _, steps) = state
         alive = (cur >= 0) | ((cur != HALT) & jnp.any(eligible(job_state)))
         return alive & (steps < t_cap + window)
 
@@ -411,8 +432,13 @@ def fused_allocate(
         jnp.concatenate(
             [idle, releasing, task_count.astype(idle.dtype)[:, None]], axis=1
         ),
-        jnp.zeros((j_cap, 3), dtype=jnp.int32),
-        job_alloc_init,
+        jnp.concatenate(
+            [
+                jnp.zeros((j_cap, 3), dtype=job_alloc_init.dtype),
+                job_alloc_init,
+            ],
+            axis=1,
+        ),
         queue_alloc_init,
         jnp.asarray(-1, dtype=jnp.int32),
         # Padded by MAX_BATCH so the run write-window never clamps at the tail.
@@ -420,7 +446,7 @@ def fused_allocate(
         jnp.zeros((), dtype=jnp.int32),
     )
     final = jax.lax.while_loop(cond, body, init)
-    return final[5][:t_cap]
+    return final[4][:t_cap]
 
 
 class FusedAllocator:
@@ -762,25 +788,26 @@ class FusedAllocator:
             nid = np.where(codes >= 0, codes, _PIPE_BASE - codes)[placed]
             pipe = placed_pipe[placed]
             items.append((job, sel_rows, names_arr[nid], pipe))
-            cores = job.store.cores
-            flat_cores.extend(cores[r] for r in sel_rows.tolist())
+            flat_cores.append(job.store.cores[sel_rows])
             flat_nid.append(nid)
             flat_pipe.append(pipe)
 
         node_batches: Dict[str, list] = {}
         if flat_cores:
+            cores_all = np.concatenate(flat_cores)
             nid_all = np.concatenate(flat_nid)
             pipe_all = np.concatenate(flat_pipe)
-            # Group into per-(node, status) batches with one stable sort.
+            # Group into per-(node, status) batches with one stable sort and
+            # pure array gathers — no per-task Python.
             key = nid_all * 2 + pipe_all
             order = np.argsort(key, kind="stable")
+            cores_sorted = cores_all[order]
             uniq, starts = np.unique(key[order], return_index=True)
-            bounds = list(starts.tolist()) + [order.shape[0]]
-            order_l = order.tolist()
+            bounds = starts.tolist() + [order.shape[0]]
             for g, k in enumerate(uniq.tolist()):
                 node_name = self.node_names[k >> 1]
                 status = TaskStatus.PIPELINED if (k & 1) else TaskStatus.ALLOCATED
-                members = [flat_cores[i] for i in order_l[bounds[g] : bounds[g + 1]]]
+                members = cores_sorted[bounds[g] : bounds[g + 1]]
                 node_batches.setdefault(node_name, []).append((members, status))
         return items, node_batches, failures
 

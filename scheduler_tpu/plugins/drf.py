@@ -60,20 +60,14 @@ class DrfPlugin(Plugin):
         for node in ssn.nodes.values():
             self.total_resource.add(node.allocatable)
 
-        from scheduler_tpu.api.types import ALLOCATED_STATUSES
-
         for job in ssn.jobs.values():
-            attr = _DrfAttr(ResourceVec.empty(vocab))
-            # Columnar fold of allocated-status resreqs — byte-identical to
-            # adding per task (the matrix rows are exact copies); jobs whose
-            # matrices aren't built this cycle use the maintained aggregate.
-            if any(job.status_count(s) for s in ALLOCATED_STATUSES):
-                if job.store.matrices_valid():
-                    attr.allocated.add_array(*job.status_sum(ALLOCATED_STATUSES))
-                else:
-                    attr.allocated.add_array(
-                        job.allocated.array.copy(), job.allocated.has_scalars
-                    )
+            # The maintained job aggregate IS the sum over allocated-status
+            # tasks (fold of add_task_info/update_task_status) — and it is the
+            # SAME value the fused engine seeds its on-device DRF carry with
+            # (ops/fused.py alloc_init), so host and device shares agree by
+            # construction.  O(R) per job: all-running 100k-task jobs pay
+            # nothing per cycle.
+            attr = _DrfAttr(job.allocated.clone())
             self._update_share(attr)
             self.job_attrs[job.uid] = attr
 

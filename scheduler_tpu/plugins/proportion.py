@@ -61,10 +61,10 @@ class ProportionPlugin(Plugin):
         for node in ssn.nodes.values():
             self.total_resource.add(node.allocatable)
 
-        # Build per-queue aggregates from jobs' tasks (columnar status folds —
-        # byte-identical to the per-task adds; see drf.on_session_open).
-        from scheduler_tpu.api.types import ALLOCATED_STATUSES
-
+        # Build per-queue aggregates: allocated comes from the maintained job
+        # aggregate (same source the fused engine seeds its device tensors
+        # with — see drf.on_session_open), pending from one columnar status
+        # fold (only jobs in the allocation working set pay O(tasks)).
         for job in ssn.jobs.values():
             if job.queue not in self.queue_attrs:
                 queue = ssn.queues.get(job.queue)
@@ -72,14 +72,8 @@ class ProportionPlugin(Plugin):
                     continue
                 self.queue_attrs[job.queue] = _QueueAttr(queue, vocab)
             attr = self.queue_attrs[job.queue]
-            if any(job.status_count(s) for s in ALLOCATED_STATUSES):
-                if job.store.matrices_valid():
-                    alloc_row, alloc_hs = job.status_sum(ALLOCATED_STATUSES)
-                else:
-                    alloc_row = job.allocated.array.copy()
-                    alloc_hs = job.allocated.has_scalars
-                attr.allocated.add_array(alloc_row, alloc_hs)
-                attr.request.add_array(alloc_row, alloc_hs)
+            attr.allocated.add(job.allocated)
+            attr.request.add(job.allocated)
             if job.status_count(TaskStatus.PENDING):
                 attr.request.add_array(*job.status_sum((TaskStatus.PENDING,)))
 

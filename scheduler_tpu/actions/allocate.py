@@ -68,6 +68,31 @@ def collect_candidates(ssn) -> List[JobInfo]:
     return candidates
 
 
+def split_dynamic(ssn, candidates: List[JobInfo]) -> tuple:
+    """Partition jobs by scan-dynamic predicate use (host ports / inter-pod
+    affinity, published per-task by the predicates plugin).  A job with ANY
+    dynamic pending task runs entirely through the exact host loop — gang
+    arithmetic stays whole-job — while every other job keeps the device
+    engines.  Returns ``(static_jobs, dynamic_jobs)``."""
+    dyn_uids = ssn.device_dynamic_task_uids
+    if not dyn_uids:
+        return candidates, []
+    static_jobs: List[JobInfo] = []
+    dynamic_jobs: List[JobInfo] = []
+    for job in candidates:
+        # Columnar check — materializing task views here would cost O(tasks)
+        # Python objects per cycle, defeating the very fast path this split
+        # protects.  pending_rows() already excludes BestEffort rows, so a
+        # dynamic-but-empty-request task cannot de-accelerate (backfill owns
+        # those on the host path regardless).
+        rows = job.pending_rows()
+        if rows.shape[0] and dyn_uids.intersection(job.store.uids[rows]):
+            dynamic_jobs.append(job)
+        else:
+            static_jobs.append(job)
+    return static_jobs, dynamic_jobs
+
+
 def record_fused_failures(failures) -> None:
     """Record first-infeasible rows as FitErrors on their jobs — the single
     owner of the 'failed placement row -> FitErrors' convention for columnar
@@ -108,10 +133,45 @@ class AllocateAction(Action):
         return "allocate"
 
     def execute(self, ssn) -> None:
+        candidates = collect_candidates(ssn)
+        # Jobs with scan-dynamic predicates (host ports / pod affinity) can
+        # only run on the exact host loop; everything else may use the device
+        # engines.  The device pass runs FIRST — both device engines thread
+        # node state on device, so host placements interleaved between device
+        # pops would be invisible to them (double-booking) — then the dynamic
+        # jobs place against the node state the device pass committed.  A
+        # deliberate deviation from the reference's single interleaved job
+        # order (allocate.go:95-133), bounded to the dynamic jobs themselves
+        # and taken so that one affinity pod cannot de-accelerate a 100k-task
+        # session.
+        deferred: List[JobInfo] = []  # dynamic jobs -> host loop afterwards
+
+        engine = None
+        if _device_enabled() and candidates:
+            from scheduler_tpu.ops.allocator import DeviceAllocator
+            from scheduler_tpu.ops.fused import FusedAllocator
+
+            static_jobs, dynamic_jobs = split_dynamic(ssn, candidates)
+            if _fused_enabled() and FusedAllocator.supported(ssn, static_jobs):
+                # Whole-action fusion: queue/job selection AND every task
+                # placement in one device program, one readback.
+                if static_jobs:
+                    self._run_fused(ssn, static_jobs)
+                if not dynamic_jobs:
+                    return
+                candidates = dynamic_jobs
+            elif DeviceAllocator.supported(ssn) and static_jobs:
+                engine = DeviceAllocator(ssn, static_jobs)
+                candidates = static_jobs
+                deferred = dynamic_jobs
+
+        self._heap_loop(ssn, candidates, engine)
+        if deferred:
+            self._heap_loop(ssn, deferred, None)
+
+    def _heap_loop(self, ssn, candidates: List[JobInfo], engine) -> None:
         queues = PriorityQueue(ssn.queue_order_fn)
         jobs_map: Dict[str, PriorityQueue] = {}
-
-        candidates = collect_candidates(ssn)
         for job in candidates:
             # One heap entry per queue. The reference pushes one copy per job
             # (allocate.go:58-63); with a live comparator (proportion shares
@@ -127,19 +187,6 @@ class AllocateAction(Action):
             jobs_map[job.queue].push(job)
 
         logger.debug("allocating over %d queues", len(jobs_map))
-
-        engine = None
-        if _device_enabled() and candidates:
-            from scheduler_tpu.ops.allocator import DeviceAllocator
-            from scheduler_tpu.ops.fused import FusedAllocator
-
-            if _fused_enabled() and FusedAllocator.supported(ssn):
-                # Whole-action fusion: queue/job selection AND every task
-                # placement in one device program, one readback.
-                self._run_fused(ssn, candidates)
-                return
-            if DeviceAllocator.supported(ssn):
-                engine = DeviceAllocator(ssn, candidates)
 
         # Host path keeps the reference's per-job PriorityQueue; the device path
         # uses a sorted deque + cursor instead — the scan consumes tasks strictly

@@ -122,6 +122,11 @@ class Session:
         # raw-unit numpy arrays (proportion registers this so its live queue
         # ordering + overused gating can run inside the device while-loop).
         self.device_queue_fair: Dict[str, Callable] = {}
+        # Task uids whose predicates depend on placements made DURING the scan
+        # (host ports, inter-pod (anti-)affinity).  Their static mask rows are
+        # incomplete; actions must route the owning jobs through the exact
+        # host loop while the rest of the session stays device-accelerated.
+        self.device_dynamic_task_uids: set = set()
 
     # -- registration (Add*Fn) ----------------------------------------------
 

@@ -667,8 +667,14 @@ class FusedAllocator:
     # -- capability probe ----------------------------------------------------
 
     @staticmethod
-    def supported(ssn) -> bool:
-        """True iff every registered callback is in the fused builtin set."""
+    def supported(ssn, jobs: Optional[Sequence[JobInfo]] = None) -> bool:
+        """True iff every registered callback is in the fused builtin set.
+
+        ``jobs`` — the candidate set the engine would actually run (e.g. the
+        static partition from ``actions.allocate.split_dynamic``); sizing the
+        static-tensor memory gate over it instead of the whole session keeps a
+        large *dynamic* job from spuriously disqualifying fusion of the rest.
+        """
         if not ssn.nodes:
             return False
         # Host predicates need device counterparts; static [T, N] tensors are
@@ -680,9 +686,8 @@ class FusedAllocator:
                 return False
         if ssn.device_predicates or ssn.device_scorers:
             n_bucket = bucket(max(len(ssn.nodes), 1))
-            pending = sum(
-                job.pending_eligible_count() for job in ssn.jobs.values()
-            )
+            sized = ssn.jobs.values() if jobs is None else jobs
+            pending = sum(job.pending_eligible_count() for job in sized)
             t_bucket = bucket(max(pending, 1))
             try:
                 limit = int(

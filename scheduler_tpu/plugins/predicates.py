@@ -9,9 +9,10 @@ and required inter-pod (anti-)affinity.
 Device path: registers a [T, N] static-mask builder (selector + affinity +
 taints + unschedulable + pressure) and turns on the in-scan pod-count gate.
 Host ports and inter-pod affinity depend on placements made *during* the scan,
-which the static mask can't see — when any pending task uses them this plugin
-withholds its device builder, which forces the allocator's exact host fallback
-(``DeviceAllocator.supported``).
+which the static mask can't see — tasks that use them are published in
+``ssn.device_dynamic_task_uids`` and the allocate action routes their jobs
+through the exact host loop; every other job stays on the device engines (one
+affinity pod must not de-accelerate a 100k-task cycle).
 """
 
 from __future__ import annotations
@@ -165,20 +166,20 @@ class PredicatesPlugin(Plugin):
 
         ssn.add_predicate_fn(self.name(), predicate)
 
-        # Device path: only when nothing scan-dynamic beyond pod counts is used.
-        uses_dynamic = False
+        # Device path: the static constraints always compile to the [T, N]
+        # mask.  Tasks using scan-dynamic predicates (host ports, inter-pod
+        # (anti-)affinity depend on placements made DURING the scan) are
+        # published per-task instead of de-accelerating the whole session:
+        # the allocate action routes their jobs through the exact host loop
+        # while every other job stays on the device engines.
         for job in ssn.jobs.values():
             for t in job.task_status_index.get(TaskStatus.PENDING, {}).values():
                 aff = t.pod.affinity
                 if t.pod.host_ports or (aff and (aff.pod_affinity or aff.pod_anti_affinity)):
-                    uses_dynamic = True
-                    break
-            if uses_dynamic:
-                break
+                    ssn.device_dynamic_task_uids.add(t.uid)
 
-        if not uses_dynamic:
-            ssn.add_device_predicate(self.name(), self._device_mask_builder(ssn))
-            ssn.device_dynamic_gates.add("pod_count")
+        ssn.add_device_predicate(self.name(), self._device_mask_builder(ssn))
+        ssn.device_dynamic_gates.add("pod_count")
 
     def _device_mask_builder(self, ssn):
         pressure_checks = list(self.pressure_checks)

@@ -210,3 +210,118 @@ class TestDeviceHostParity:
         run_allocate(cache)
         assert used.get("yes")
         assert len(cache.binder.binds) == 3
+
+
+PREDICATES_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: predicates
+"""
+
+
+class TestDynamicPredicateSplit:
+    """One scan-dynamic pod (host ports / pod affinity) must not de-accelerate
+    the whole session: its job takes the exact host loop while every other job
+    stays on the fused engine, placing against the state the fused commit left
+    (plugins/predicates.py per-task gating + actions/allocate.py split)."""
+
+    def _spy_fused(self, monkeypatch):
+        from scheduler_tpu.ops.fused import FusedAllocator
+
+        seen = {}
+        orig = FusedAllocator.__init__
+
+        def spy(engine, ssn, jobs):
+            seen["jobs"] = [j.uid for j in jobs]
+            return orig(engine, ssn, jobs)
+
+        monkeypatch.setattr(FusedAllocator, "__init__", spy)
+        return seen
+
+    def test_one_affinity_pod_keeps_fused_engine(self, monkeypatch):
+        from scheduler_tpu.apis.objects import Affinity, PodAffinityTerm
+
+        seen = self._spy_fused(monkeypatch)
+        cache = make_cluster(n_nodes=4, node_cpu=8000)
+        for i in range(3):
+            add_gang(cache, f"plain{i}", n_tasks=1, min_member=1)
+        cache.add_pod_group(build_pod_group("aff", min_member=1))
+        pod = build_pod(
+            name="aff-0", req={"cpu": 1000, "memory": 1024**2}, groupname="aff",
+            labels={"app": "db"},
+        )
+        pod.affinity = Affinity(
+            pod_anti_affinity=[PodAffinityTerm(label_selector={"app": "db"})]
+        )
+        cache.add_pod(pod)
+        run_allocate(cache, PREDICATES_CONF)
+        # The fused engine ran, over exactly the three static jobs.
+        assert len(seen["jobs"]) == 3
+        assert not any("aff" in uid for uid in seen["jobs"])
+        # Everyone still placed (the affinity job via the host loop).
+        assert len(cache.binder.binds) == 4
+
+    def test_anti_affinity_pair_respected_in_mixed_session(self, monkeypatch):
+        from scheduler_tpu.apis.objects import Affinity, PodAffinityTerm
+
+        seen = self._spy_fused(monkeypatch)
+        cache = make_cluster(n_nodes=3, node_cpu=8000)
+        add_gang(cache, "plain", n_tasks=2, min_member=2)
+        cache.add_pod_group(build_pod_group("db", min_member=2))
+        for i in range(2):
+            pod = build_pod(
+                name=f"db-{i}", req={"cpu": 1000, "memory": 1024**2}, groupname="db",
+                labels={"app": "db"},
+            )
+            pod.affinity = Affinity(
+                pod_anti_affinity=[PodAffinityTerm(label_selector={"app": "db"})]
+            )
+            cache.add_pod(pod)
+        run_allocate(cache, PREDICATES_CONF)
+        assert len(seen["jobs"]) == 1  # just the plain gang
+        assert len(cache.binder.binds) == 4
+        # The two anti-affinity pods still land on distinct nodes.
+        assert (
+            cache.binder.binds["default/db-0"] != cache.binder.binds["default/db-1"]
+        )
+
+    def test_host_port_job_takes_host_loop(self, monkeypatch):
+        seen = self._spy_fused(monkeypatch)
+        cache = make_cluster(n_nodes=3, node_cpu=8000)
+        add_gang(cache, "plain", n_tasks=1, min_member=1)
+        cache.add_pod_group(build_pod_group("web", min_member=2))
+        for i in range(2):
+            pod = build_pod(
+                name=f"web-{i}", req={"cpu": 100, "memory": 1024**2}, groupname="web"
+            )
+            pod.host_ports = [8080]
+            cache.add_pod(pod)
+        run_allocate(cache, PREDICATES_CONF)
+        assert len(seen["jobs"]) == 1
+        assert len(cache.binder.binds) == 3
+        assert (
+            cache.binder.binds["default/web-0"] != cache.binder.binds["default/web-1"]
+        )
+
+    def test_no_double_booking_with_perpop_engine(self, monkeypatch):
+        """Device pops thread node state on device; dynamic jobs must place
+        AFTER the device pass, never interleaved (a host placement between
+        device pops would be invisible to the engine -> double-booking)."""
+        monkeypatch.setenv("SCHEDULER_TPU_FUSED", "0")
+        cache = make_cluster(n_nodes=1, node_cpu=1000)
+        add_gang(cache, "static", n_tasks=1, min_member=1, cpu=600)
+        cache.add_pod_group(build_pod_group("web", min_member=1))
+        pod = build_pod(
+            name="web-0", req={"cpu": 600, "memory": 1024**2}, groupname="web",
+            priority=10,
+        )
+        pod.host_ports = [8080]
+        cache.add_pod(pod)
+        run_allocate(cache, PREDICATES_CONF)
+        # 1000 cpu cannot host both 600-cpu pods: exactly one binds.
+        assert len(cache.binder.binds) == 1
+        node = cache.nodes["n0"]
+        assert node.idle.get("cpu") >= 0

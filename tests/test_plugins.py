@@ -131,7 +131,8 @@ tiers:
 
 
 class TestHostOnlyPredicates:
-    """Host ports and inter-pod affinity force the exact host fallback."""
+    """Host ports and inter-pod affinity route their jobs to the exact host
+    loop (per-task gating — the rest of the session stays device-fused)."""
 
     def test_host_port_conflict(self):
         cache = fresh_cache()

@@ -27,8 +27,8 @@ def one_cycle(n_nodes: int, n_pods: int, tasks_per_job: int) -> tuple[int, float
     import scheduler_tpu.actions  # noqa: F401  registry side effects
     import scheduler_tpu.plugins  # noqa: F401
     from scheduler_tpu.conf import parse_scheduler_conf
-    from scheduler_tpu.framework import close_session, get_action, open_session
     from scheduler_tpu.harness import make_synthetic_cluster
+    from scheduler_tpu.harness.measure import steady_cycle
 
     conf = parse_scheduler_conf(
         """
@@ -42,13 +42,7 @@ tiers:
 """
     )
     cluster = make_synthetic_cluster(n_nodes, n_pods, tasks_per_job=tasks_per_job)
-
-    start = time.perf_counter()
-    ssn = open_session(cluster.cache, conf.tiers)
-    get_action("allocate").execute(ssn)
-    close_session(ssn)
-    elapsed = time.perf_counter() - start
-
+    elapsed = steady_cycle(cluster.cache, conf, ("allocate",))
     binds = len(cluster.cache.binder.binds)
     return binds, elapsed
 

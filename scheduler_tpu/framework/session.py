@@ -127,6 +127,8 @@ class Session:
         # incomplete; actions must route the owning jobs through the exact
         # host loop while the rest of the session stays device-accelerated.
         self.device_dynamic_task_uids: set = set()
+        # job uid -> job_tie_key cache (fixed at first use, see job_tie_key).
+        self._job_tie_keys: Dict[str, tuple] = {}
 
     # -- registration (Add*Fn) ----------------------------------------------
 
@@ -283,13 +285,48 @@ class Session:
                     return j < 0
         return None
 
+    def job_tie_key(self, job: JobInfo) -> tuple:
+        """Deterministic job-order fallback key, fixed at first use per
+        session: ``(floor(creation), request-sig, selector, creation, uid)``.
+
+        The reference's fallback is CreationTimestamp then UID
+        (session_plugins.go:297-303) — and its timestamps are metav1.Time,
+        WHOLE-SECOND granularity, so jobs created in the same burst second
+        are an arbitrary-order tie there.  We preserve its FIFO behavior at
+        that same observable granularity, and inside a tied second we order
+        single-pending-task jobs by their task's request signature and node
+        selector, so plugin-equal one-pod jobs (the kubemark shadow-PodGroup
+        shape) sit adjacently in every engine — the fused engine then places
+        whole runs of them in one device step."""
+        key = self._job_tie_keys.get(job.uid)
+        if key is None:
+            sig = b""
+            sel = ""
+            pending_rows = getattr(job, "pending_rows", None)
+            if pending_rows is not None:  # plugin tests may pass bare stubs
+                rows = pending_rows()
+                if rows.shape[0] == 1:
+                    st = job.store
+                    if not st.sigs_valid():
+                        st.build_sigs()
+                    sig = st.sigs[rows[0]]
+                    # Selector in the key too: tasks with different selectors
+                    # have different static mask rows, which break device
+                    # runs — grouping by (request, selector) keeps run-mates
+                    # adjacent.
+                    pod = st.cores[rows[0]].pod
+                    if pod is not None and pod.node_selector:
+                        sel = repr(sorted(pod.node_selector.items()))
+            ts = job.creation_timestamp
+            key = (int(ts), sig, sel, ts, job.uid)
+            self._job_tie_keys[job.uid] = key
+        return key
+
     def job_order_fn(self, l: JobInfo, r: JobInfo) -> bool:
         res = self._ordered(self.job_order_fns, "job_order_enabled", l, r)
         if res is not None:
             return res
-        if l.creation_timestamp == r.creation_timestamp:
-            return l.uid < r.uid
-        return l.creation_timestamp < r.creation_timestamp
+        return self.job_tie_key(l) < self.job_tie_key(r)
 
     def queue_order_fn(self, l: QueueInfo, r: QueueInfo) -> bool:
         res = self._ordered(self.queue_order_fns, "queue_order_enabled", l, r)

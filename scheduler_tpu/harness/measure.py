@@ -1,0 +1,41 @@
+"""Steady-state cycle measurement, shared by ``bench.py`` and the scenario
+ladder.
+
+In the real scheduler loop, informer ingestion and the per-job request-matrix
+caches are populated BETWEEN cycles (the reference's cache mirrors the cluster
+continuously, cache.go:342-361); a freshly built synthetic cluster would charge
+that one-time build to the measured cycle.  ``steady_cycle`` therefore warms
+the engine tensors once without executing a placement, then times one
+open -> actions -> close cycle with the garbage collector frozen (the
+100k-object synthetic cluster is long-lived for the whole cycle; letting the
+collector trace it mid-measurement costs multi-hundred-ms pauses).
+"""
+
+from __future__ import annotations
+
+import gc
+import time
+
+
+def steady_cycle(cache, conf, actions) -> float:
+    """Warm caches, then run and time one scheduling cycle.  Returns seconds."""
+    from scheduler_tpu.actions.allocate import collect_candidates
+    from scheduler_tpu.framework import close_session, get_action, open_session
+    from scheduler_tpu.ops.fused import FusedAllocator
+
+    warm_ssn = open_session(cache, conf.tiers)
+    cands = collect_candidates(warm_ssn)
+    if cands and warm_ssn.nodes and FusedAllocator.supported(warm_ssn, cands):
+        FusedAllocator(warm_ssn, cands)
+    close_session(warm_ssn)
+    gc.collect()
+    gc.freeze()
+    try:
+        start = time.perf_counter()
+        ssn = open_session(cache, conf.tiers)
+        for name in actions:
+            get_action(name).execute(ssn)
+        close_session(ssn)
+        return time.perf_counter() - start
+    finally:
+        gc.unfreeze()

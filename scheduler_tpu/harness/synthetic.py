@@ -83,6 +83,11 @@ def make_synthetic_cluster(
     pod_names: List[str] = []
     n_jobs = max(1, (n_pods + tasks_per_job - 1) // tasks_per_job)
     pod_idx = 0
+    # Deterministic creation timestamps (one shared base second + µs offsets):
+    # engine-parity comparisons across separately built synthetic clusters
+    # must not depend on wall-clock second boundaries (the job tie key
+    # truncates to whole seconds, matching metav1.Time granularity).
+    ts_base = 1_700_000_000.0
     for j in range(n_jobs):
         size = min(tasks_per_job, n_pods - j * tasks_per_job)
         if size <= 0:
@@ -96,6 +101,7 @@ def make_synthetic_cluster(
             min_member=size if gang else 1,
         )
         pg.status.phase = "Inqueue"
+        pg.creation_timestamp = ts_base + j * 1e-6
         cache.add_pod_group(pg)
         for t in range(size):
             name = f"{group}-{t:04d}"
@@ -107,6 +113,7 @@ def make_synthetic_cluster(
                 priority=j % 10,
                 annotations={GROUP_NAME_ANNOTATION: group},
             )
+            pod.creation_timestamp = ts_base + pod_idx * 1e-6
             cache.add_pod(pod)
             pod_names.append(f"default/{name}")
             pod_idx += 1

@@ -95,6 +95,28 @@ def build_static_tensors(ssn, st: SnapshotTensors, n_bucket: int):
     return mask, score
 
 
+def build_static_tensors_device(ssn, st: SnapshotTensors, n_bucket: int, t_bucket: int):
+    """Device-resident variant of ``build_static_tensors`` for the fused
+    engine: plugin contributions combine and pad ON DEVICE, so the [T, N]
+    mask never crosses the host boundary (at 100k x 10k that round trip
+    costs more than the entire placement loop)."""
+    t_count = max(st.tasks.count, 1)
+    n = st.nodes.count
+    mask = base_static_mask(t_count, jnp.asarray(st.nodes.ready))
+    for name, builder in ssn.device_predicates.items():
+        mask = mask & jnp.asarray(builder(st))
+    score = jnp.zeros((t_count, n), dtype=jnp.float32)
+    for name, builder in ssn.device_scorers.items():
+        score = score + jnp.asarray(builder(st), dtype=jnp.float32)
+    mask = jnp.pad(
+        mask,
+        ((0, t_bucket - mask.shape[0]), (0, n_bucket - n)),
+        constant_values=False,
+    )
+    score = jnp.pad(score, ((0, t_bucket - score.shape[0]), (0, n_bucket - n)))
+    return mask, score
+
+
 def node_state_from_tensors(st: SnapshotTensors, policy: DevicePolicy, n_bucket: int) -> NodeState:
     """Padded, unit-scaled device NodeState from host snapshot tensors."""
     r = policy.vocab.size

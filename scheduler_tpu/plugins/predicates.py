@@ -171,20 +171,30 @@ class PredicatesPlugin(Plugin):
         # (anti-)affinity depend on placements made DURING the scan) are
         # published per-task instead of de-accelerating the whole session:
         # the allocate action routes their jobs through the exact host loop
-        # while every other job stays on the device engines.
+        # while every other job stays on the device engines.  The same sweep
+        # collects the (few) node-required-affinity tasks so the mask builder
+        # can correct just those rows.
+        node_affinity_uids: set = set()
         for job in ssn.jobs.values():
             for t in job.task_status_index.get(TaskStatus.PENDING, {}).values():
                 aff = t.pod.affinity
                 if t.pod.host_ports or (aff and (aff.pod_affinity or aff.pod_anti_affinity)):
                     ssn.device_dynamic_task_uids.add(t.uid)
+                if aff and aff.node_required:
+                    node_affinity_uids.add(t.uid)
 
-        ssn.add_device_predicate(self.name(), self._device_mask_builder(ssn))
+        ssn.add_device_predicate(
+            self.name(), self._device_mask_builder(ssn, node_affinity_uids)
+        )
         ssn.device_dynamic_gates.add("pod_count")
 
-    def _device_mask_builder(self, ssn):
+    def _device_mask_builder(self, ssn, node_affinity_uids: set):
         pressure_checks = list(self.pressure_checks)
 
-        def build(st) -> np.ndarray:
+        def build(st):
+            """[T, N] static mask as a DEVICE array — consumers that fuse it
+            into a device program never pay a [T, N] host round trip; host
+            engines ``np.asarray`` it (the per-pop fallback's slicing path)."""
             import jax.numpy as jnp
 
             from scheduler_tpu.ops.predicates import plugin_predicate_mask, taint_mask
@@ -217,31 +227,44 @@ class PredicatesPlugin(Plugin):
                     logger.exception("pallas predicate kernel failed; jnp fallback")
                     mask = None
             if mask is None:
-                mask = np.array(  # np.array copies: jax outputs are read-only views
-                    plugin_predicate_mask(
-                        jnp.asarray(st.tasks.selector),
-                        jnp.asarray(st.tasks.has_unknown_selector),
-                        jnp.asarray(st.nodes.labels),
-                        jnp.asarray(st.nodes.unschedulable),
-                    )
+                mask = plugin_predicate_mask(
+                    jnp.asarray(st.tasks.selector),
+                    jnp.asarray(st.tasks.has_unknown_selector),
+                    jnp.asarray(st.nodes.labels),
+                    jnp.asarray(st.nodes.unschedulable),
+                ) & taint_mask(
+                    jnp.asarray(st.nodes.taints), jnp.asarray(st.tasks.tolerated)
                 )
-                mask &= np.asarray(
-                    taint_mask(jnp.asarray(st.nodes.taints), jnp.asarray(st.tasks.tolerated))
-                )
-            # Required node affinity terms (host-evaluated, static per session).
-            task_by_uid: Dict[str, TaskInfo] = {}
-            for job in ssn.jobs.values():
-                task_by_uid.update(job.tasks)
+            # Required node affinity terms (host-evaluated per affected ROW —
+            # affinity tasks are few; the correction lands on device as one
+            # small gather/scatter instead of pulling the [T, N] mask back).
             node_specs = [ssn.nodes[name].node for name in st.nodes.names]
-            for i, uid in enumerate(st.tasks.uids):
-                task = task_by_uid.get(uid)
-                if task is None or task.pod.affinity is None or not task.pod.affinity.node_required:
-                    continue
-                for j, spec in enumerate(node_specs):
-                    if spec is not None and not node_selector_matches(
-                        _affinity_only_pod(task.pod), spec
-                    ):
-                        mask[i, j] = False
+            aff_rows: List[int] = []
+            aff_masks: List[np.ndarray] = []
+            task_by_uid: Optional[Dict[str, TaskInfo]] = None
+            if node_affinity_uids:
+                for i, uid in enumerate(st.tasks.uids):
+                    if uid not in node_affinity_uids:
+                        continue
+                    if task_by_uid is None:
+                        task_by_uid = {}
+                        for job in ssn.jobs.values():
+                            task_by_uid.update(job.tasks)
+                    task = task_by_uid.get(uid)
+                    if task is None or task.pod.affinity is None:
+                        continue
+                    row = np.ones(st.nodes.count, dtype=bool)
+                    for j, spec in enumerate(node_specs):
+                        if spec is not None and not node_selector_matches(
+                            _affinity_only_pod(task.pod), spec
+                        ):
+                            row[j] = False
+                    aff_rows.append(i)
+                    aff_masks.append(row)
+            if aff_rows:
+                rows = jnp.asarray(np.asarray(aff_rows, dtype=np.int32))
+                corr = jnp.asarray(np.stack(aff_masks))
+                mask = mask.at[rows].set(mask[rows] & corr)
             # Pressure gates.
             if pressure_checks:
                 ok = np.ones(st.nodes.count, dtype=bool)
@@ -250,7 +273,7 @@ class PredicatesPlugin(Plugin):
                         spec.conditions.get(c) == "True" for c in pressure_checks
                     ):
                         ok[j] = False
-                mask &= ok[None, :]
+                mask = mask & jnp.asarray(ok)[None, :]
             return mask
 
         return build

@@ -39,15 +39,11 @@ GPU = "nvidia.com/gpu"
 
 
 def run_cycle(build, conf_str, actions):
+    from scheduler_tpu.harness.measure import steady_cycle
+
     conf = parse_scheduler_conf(conf_str)
     cache = build()
-    start = time.perf_counter()
-    ssn = open_session(cache, conf.tiers)
-    for a in actions:
-        get_action(a).execute(ssn)
-    close_session(ssn)
-    elapsed = time.perf_counter() - start
-    return cache, elapsed
+    return cache, steady_cycle(cache, conf, actions)
 
 
 def measure(name, build, conf_str, actions, placed_of):
@@ -97,7 +93,7 @@ tiers:
 
 
 def scenario2(scale):
-    n_nodes, n_jobs, per_job = int(1000 * scale), int(100 * scale), 50
+    n_nodes, n_pods = int(1000 * scale), int(5000 * scale)
 
     def build():
         rng = np.random.default_rng(0)
@@ -108,18 +104,21 @@ def scenario2(scale):
             cache.add_node(NodeSpec(name=f"hollow-{i:05d}", allocatable={
                 "cpu": 16000.0, "memory": 64 * 2**30, "pods": 110},
                 labels={"zone": f"z{i % 4}"}))
-        for j in range(n_jobs):
-            g = f"batch{j}"
-            pg = PodGroup(name=g, namespace="d", queue="default", min_member=1)
-            pg.status.phase = "Inqueue"
-            cache.add_pod_group(pg)
-            for t in range(per_job):
-                cache.add_pod(PodSpec(
-                    name=f"{g}-{t}", namespace="d",
-                    containers=[{"cpu": float(rng.choice([100, 200, 500])),
-                                 "memory": float(rng.choice([1, 2])) * 2**30}],
-                    annotations={GROUP_NAME_ANNOTATION: g},
-                    node_selector={"zone": f"z{j % 4}"} if j % 2 == 0 else {}))
+        # kubemark density = BARE sleep pods (RC-created, no PodGroup): the
+        # cache synthesizes a single-member shadow PodGroup per pod, the
+        # reference's cache/util.go:30-63 path — so this scenario is
+        # thousands of independent min_member=1 jobs, not multi-task gangs.
+        for t in range(n_pods):
+            pod = PodSpec(
+                name=f"sleep-{t:05d}", namespace="d",
+                scheduler_name="volcano",
+                containers=[{"cpu": float(rng.choice([100, 200, 500])),
+                             "memory": float(rng.choice([1, 2])) * 2**30}],
+                node_selector={"zone": f"z{t % 4}"} if t % 2 == 0 else {})
+            # one burst second (matches real create-storms at metav1.Time
+            # granularity; keeps run grouping deterministic across builds)
+            pod.creation_timestamp = 1_700_000_000.0 + t * 1e-6
+            cache.add_pod(pod)
         return cache
 
     conf = """

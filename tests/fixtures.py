@@ -2,11 +2,26 @@
 
 from __future__ import annotations
 
+import itertools
 from typing import Dict, List, Optional
 
 from scheduler_tpu.api import ResourceVocabulary
 from scheduler_tpu.apis import NodeSpec, PodGroup, PodSpec, Queue
 from scheduler_tpu.apis.objects import GROUP_NAME_ANNOTATION, PodPhase
+
+
+# Deterministic creation timestamps: all fixture objects share one base
+# SECOND (the session's job tie key truncates to whole seconds, matching the
+# reference's metav1.Time granularity) with a monotonically increasing
+# microsecond offset.  Parity tests build the "same" cluster once per engine;
+# wall-clock timestamps would let those builds straddle a second boundary and
+# regroup tie-equal jobs differently between engines.
+_TS_BASE = 1_700_000_000.0
+_ts_counter = itertools.count()
+
+
+def next_ts() -> float:
+    return _TS_BASE + next(_ts_counter) * 1e-6
 
 
 def build_resource_list(cpu_milli: float, memory: float, **scalars: float) -> Dict[str, float]:
@@ -41,6 +56,7 @@ def build_pod(
     )
     if uid:
         pod.uid = uid
+    pod.creation_timestamp = next_ts()
     return pod
 
 
@@ -71,11 +87,14 @@ def build_pod_group(
         min_resources=min_resources,
     )
     pg.status.phase = phase
+    pg.creation_timestamp = next_ts()
     return pg
 
 
 def build_queue(name: str, weight: int = 1, capability: Optional[Dict[str, float]] = None) -> Queue:
-    return Queue(name=name, weight=weight, capability=dict(capability or {}))
+    q = Queue(name=name, weight=weight, capability=dict(capability or {}))
+    q.creation_timestamp = next_ts()
+    return q
 
 
 def make_vocab(*scalars: str) -> ResourceVocabulary:

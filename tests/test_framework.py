@@ -150,8 +150,11 @@ class TestDispatchSemantics:
                 self.uid = uid
                 self.creation_timestamp = ts
 
+        # Distinct uids per assertion: the session fixes each job's tie key at
+        # first use (Session.job_tie_key), so re-using a uid with a different
+        # timestamp would read the cached key.
         assert ssn.job_order_fn(J("x", 1.0), J("y", 2.0)) is True
-        assert ssn.job_order_fn(J("x", 2.0), J("y", 1.0)) is False
+        assert ssn.job_order_fn(J("p", 2.0), J("q", 1.0)) is False
         assert ssn.job_order_fn(J("a", 1.0), J("b", 1.0)) is True  # uid tiebreak
 
     def test_node_order_additive(self):

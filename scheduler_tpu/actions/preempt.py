@@ -21,12 +21,6 @@ from scheduler_tpu.framework.interface import Action
 from scheduler_tpu.framework.statement import Statement
 from scheduler_tpu.utils import metrics
 from scheduler_tpu.utils.priority_queue import PriorityQueue
-from scheduler_tpu.utils.scheduler_helper import (
-    get_node_list,
-    predicate_nodes,
-    prioritize_nodes,
-    sort_nodes,
-)
 
 logger = logging.getLogger("scheduler_tpu.actions.preempt")
 
@@ -36,6 +30,15 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn) -> None:
+        from scheduler_tpu.utils.sweep import RunningLedger, SweepCache
+
+        # O(1)-per-task sweep memoization + candidate-presence pre-gates
+        # (see utils/sweep.py) — the per-node victim semantics below stay
+        # exact and live.  Both gate on the same enable switch so that
+        # SCHEDULER_TPU_SWEEP=0 restores the pure reference path.
+        sweep = SweepCache(ssn)
+        ledger = RunningLedger(ssn) if sweep.enabled else None
+
         preemptors_map: Dict[str, PriorityQueue] = {}
         preemptor_tasks: Dict[str, PriorityQueue] = {}
         under_request: List[JobInfo] = []
@@ -85,7 +88,20 @@ class PreemptAction(Action):
                         # Preempt other jobs within the same queue.
                         return job.queue == preemptor_job.queue and preemptor.job != task.job
 
-                    if self._preempt(ssn, stmt, preemptor, job_filter):
+                    if self._preempt(
+                        ssn,
+                        stmt,
+                        preemptor,
+                        job_filter,
+                        sweep=sweep,
+                        node_gate=(
+                            None
+                            if ledger is None
+                            else lambda node, j=preemptor_job: ledger.has_other_job_running(
+                                node, j.queue, j.uid
+                            )
+                        ),
+                    ):
                         assigned = True
 
                     if ssn.job_pipelined(preemptor_job):
@@ -114,6 +130,14 @@ class PreemptAction(Action):
                         preemptor,
                         lambda task: task.status == TaskStatus.RUNNING
                         and preemptor.job == task.job,
+                        sweep=sweep,
+                        node_gate=(
+                            None
+                            if ledger is None
+                            else lambda node, j=job: ledger.has_own_job_running(
+                                node, j.queue, j.uid
+                            )
+                        ),
                     )
                     stmt.commit()
                     if not assigned:
@@ -125,21 +149,29 @@ class PreemptAction(Action):
         stmt: Statement,
         preemptor: TaskInfo,
         task_filter: Optional[Callable[[TaskInfo], bool]],
+        sweep=None,
+        node_gate: Optional[Callable] = None,
     ) -> bool:
-        """One preemptor's hunt for a node (reference preempt.go:180-260)."""
+        """One preemptor's hunt for a node (reference preempt.go:180-260).
+
+        ``sweep`` (utils.sweep.SweepCache) memoizes the predicate+score node
+        ordering per task signature; ``node_gate`` skips nodes the ledger
+        proved to hold no candidate Running tasks.  Both are exact filters —
+        when either declines (None / dynamic task), the reference's per-task
+        sweep runs unchanged."""
+        from scheduler_tpu.utils.sweep import full_sweep
+
         assigned = False
-        all_nodes = get_node_list(ssn.nodes)
+        ordered = sweep.ordered_nodes(preemptor) if sweep is not None else None
+        pod_count_live = sweep is not None and ordered is not None
+        if ordered is None:
+            ordered = full_sweep(ssn, preemptor, ssn.predicate_fn)
 
-        passing, _ = predicate_nodes(preemptor, all_nodes, ssn.predicate_fn)
-        node_scores = prioritize_nodes(
-            preemptor,
-            passing,
-            ssn.batch_node_order_fn,
-            ssn.node_order_map_fn,
-            ssn.node_order_reduce_fn,
-        )
-
-        for node in sort_nodes(node_scores):
+        for node in ordered:
+            if pod_count_live and not sweep.node_open(node):
+                continue
+            if node_gate is not None and not node_gate(node):
+                continue
             logger.debug("considering task %s on node %s", preemptor.uid, node.name)
 
             preemptees = [

@@ -29,6 +29,15 @@ class ReclaimAction(Action):
         return "reclaim"
 
     def execute(self, ssn) -> None:
+        from scheduler_tpu.utils.sweep import RunningLedger, SweepCache
+
+        # O(1)-per-task sweep memoization + candidate-presence pre-gate (see
+        # utils/sweep.py); the per-node victim semantics stay exact and live.
+        # Both gate on the same enable switch so SCHEDULER_TPU_SWEEP=0
+        # restores the pure reference path.
+        sweep = SweepCache(ssn)
+        ledger = RunningLedger(ssn) if sweep.enabled else None
+
         queues = PriorityQueue(ssn.queue_order_fn)
         queue_seen: set = set()
         preemptors_map: Dict[str, PriorityQueue] = {}
@@ -72,10 +81,25 @@ class ReclaimAction(Action):
             task = tasks.pop()
 
             assigned = False
-            for node in get_node_list(ssn.nodes):
-                try:
-                    ssn.predicate_fn(task, node)
-                except Exception:
+            # Name-ordered like the reference (no scoring in reclaim,
+            # reclaim.go:134-141); the cached set already applied the static
+            # predicate, the live pod-count gate applies per candidate.
+            ordered = sweep.passing_nodes(task)
+            pod_count_live = ordered is not None
+            if ordered is None:
+                ordered = get_node_list(ssn.nodes)
+            for node in ordered:
+                if pod_count_live:
+                    if not sweep.node_open(node):
+                        continue
+                else:
+                    try:
+                        ssn.predicate_fn(task, node)
+                    except Exception:
+                        continue
+                if ledger is not None and not ledger.has_other_queue_running(
+                    node, job.queue
+                ):
                     continue
 
                 resreq = task.init_resreq.clone()

@@ -798,6 +798,31 @@ class JobInfo:
         if len(rows) == 0:
             return
         st = self._store
+        if len(rows) == 1:
+            # Scalar fast path: thousands of single-task (shadow-PodGroup)
+            # jobs each pay this per cycle — the vector machinery below costs
+            # ~40us of numpy overhead per call against ~3us here.
+            row = int(rows[0])
+            old_val = int(st.status[row])
+            new_val = int(status)
+            if old_val == new_val:
+                return
+            core = st.cores[row]
+            was_alloc = bool(old_val & _ALLOC_BITS)
+            now_alloc = bool(new_val & _ALLOC_BITS)
+            if was_alloc and not now_alloc:
+                if net_add is not None:
+                    raise ValueError(
+                        "net_add given but batch contains an allocated->non-allocated transition"
+                    )
+                self.allocated.sub(core.resreq)
+            elif now_alloc and not was_alloc:
+                self.allocated.add(core.resreq)
+            st.status[row] = new_val
+            self._count_add(old_val, -1)
+            self._count_add(new_val, 1)
+            self._index = None  # rebuilt lazily; views stay valid
+            return
         rows = np.asarray(rows)
         if rows.shape[0] > 1:
             # A repeat in one batch is a no-op the second time (sequential

@@ -94,6 +94,7 @@ class Session:
         self.queue_order_fns: Dict[str, Callable] = {}
         self.task_order_fns: Dict[str, Callable] = {}
         self.predicate_fns: Dict[str, Callable] = {}
+        self.static_predicate_fns: Dict[str, Callable] = {}
         self.node_order_fns: Dict[str, Callable] = {}
         self.batch_node_order_fns: Dict[str, Callable] = {}
         self.node_map_fns: Dict[str, Callable] = {}
@@ -143,6 +144,15 @@ class Session:
 
     def add_predicate_fn(self, name: str, fn: Callable) -> None:
         self.predicate_fns[name] = fn
+
+    def add_static_predicate_fn(self, name: str, fn: Callable) -> None:
+        """The plugin's predicate MINUS its scan/state-dependent parts (pod
+        count, host ports, inter-pod affinity).  A plugin that registers this
+        alongside its predicate_fn promises: for tasks without dynamic
+        predicates, ``predicate_fn == static_predicate_fn AND the live gates``
+        — which lets preempt/reclaim memoize whole node sweeps per task
+        signature (utils.sweep.SweepCache)."""
+        self.static_predicate_fns[name] = fn
 
     def add_node_order_fn(self, name: str, fn: Callable) -> None:
         self.node_order_fns[name] = fn
@@ -369,6 +379,17 @@ class Session:
                 if not plugin.predicate_enabled():
                     continue
                 fn = self.predicate_fns.get(plugin.name)
+                if fn is not None:
+                    fn(task, node)  # raises on failure
+
+    def static_predicate_fn(self, task: TaskInfo, node: NodeInfo) -> None:
+        """``predicate_fn`` over the registered STATIC predicate parts only
+        (see add_static_predicate_fn); same dispatch, same error contract."""
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not plugin.predicate_enabled():
+                    continue
+                fn = self.static_predicate_fns.get(plugin.name)
                 if fn is not None:
                     fn(task, node)  # raises on failure
 

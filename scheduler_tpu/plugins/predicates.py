@@ -140,10 +140,10 @@ class PredicatesPlugin(Plugin):
     def on_session_open(self, ssn) -> None:
         plugin = self
 
-        def predicate(task: TaskInfo, node: NodeInfo) -> None:
-            # NodePodNumber (predicates.go:162-166)
-            if len(node.tasks) >= node.pods_limit:
-                raise FitError(task.name, node.name, NODE_POD_NUMBER_EXCEEDED)
+        def static_predicate(task: TaskInfo, node: NodeInfo) -> None:
+            """Node/pod-spec checks that cannot change during an action:
+            everything in ``predicate`` except pod count (live node state),
+            host ports, and inter-pod affinity (placement-dependent)."""
             if node.node is None:
                 raise FitError(task.name, node.name, "node(s) not ready")
             if node.node.unschedulable:
@@ -157,6 +157,12 @@ class PredicatesPlugin(Plugin):
                 raise FitError(
                     task.name, node.name, "node(s) had taints that the pod didn't tolerate"
                 )
+
+        def predicate(task: TaskInfo, node: NodeInfo) -> None:
+            # NodePodNumber (predicates.go:162-166)
+            if len(node.tasks) >= node.pods_limit:
+                raise FitError(task.name, node.name, NODE_POD_NUMBER_EXCEEDED)
+            static_predicate(task, node)
             if not host_ports_free(task.pod, node):
                 raise FitError(task.name, node.name, "node(s) didn't have free ports")
             if not plugin._pod_affinity_ok(ssn, task, node):
@@ -165,6 +171,7 @@ class PredicatesPlugin(Plugin):
                 )
 
         ssn.add_predicate_fn(self.name(), predicate)
+        ssn.add_static_predicate_fn(self.name(), static_predicate)
 
         # Device path: the static constraints always compile to the [T, N]
         # mask.  Tasks using scan-dynamic predicates (host ports, inter-pod

@@ -1,0 +1,201 @@
+"""Memoized node sweeps for preempt/reclaim (VERDICT r1 #4).
+
+The reference runs a full PredicateNodes + PrioritizeNodes + SortNodes sweep
+per preemptor task (preempt.go:191-195, 16-way parallel); at BASELINE scenario
+4 scale (50k pending tasks x 10k nodes) that is O(T x N) Python here.  Two
+observations make the sweep O(1) per task instead:
+
+* **Predicate results are per-signature.**  For tasks without scan-dynamic
+  predicates (host ports, inter-pod affinity), the predicate outcome depends
+  only on (request row, node selector, required node affinity, tolerations)
+  x node — and the node-side inputs (labels, taints, readiness, pressure)
+  never change during an action.  The only live predicate component, the
+  pod-count limit, is re-checked per candidate at iteration time
+  (``node_open``).
+* **Scores are frozen during preempt/reclaim.**  The builtin scorers
+  (least-requested / balanced / binpack / static node-affinity preferences)
+  read node ``idle`` and ``allocatable`` only.  Preemption never touches
+  idle: evictions move resources used -> releasing, and pipelining consumes
+  releasing — so one sweep per signature is EXACT for the whole action.
+
+``SweepCache.enabled`` gates on exactly those builtins (every predicate
+plugin registered a static variant; scoring only from "nodeorder"); anything
+else falls back to the reference's per-task sweep.
+
+``RunningLedger`` records which (queue, job) pairs have Running tasks on each
+node, so the victim hunt can skip nodes with no candidate tasks at all
+without enumerating (and cloning) their task maps.  This is EXACT: a node
+absent from the ledger had no Running candidates when the action started, and
+the action itself only removes Running tasks — a stale presence just means
+one wasted exact enumeration.  (A resource-total pre-gate would NOT be exact:
+the actions' validate gate is ``not total.less(resreq)``, and ``less`` is
+strict with the reference's nil-scalar-map quirk, resource_info.go:226-250.)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from scheduler_tpu.api.job_info import TaskInfo
+from scheduler_tpu.api.node_info import NodeInfo
+from scheduler_tpu.api.types import TaskStatus
+from scheduler_tpu.utils.scheduler_helper import (
+    get_node_list,
+    predicate_nodes,
+    prioritize_nodes,
+    sort_nodes,
+)
+
+
+class SweepCache:
+    """sig -> best-first node list, memoized for one action execution."""
+
+    def __init__(self, ssn) -> None:
+        self.ssn = ssn
+        self._cache: Dict[tuple, List[NodeInfo]] = {}
+        self._nodes = get_node_list(ssn.nodes)
+        import os
+
+        scoring = set(ssn.node_order_fns) | set(ssn.node_map_fns)
+        self.enabled = (
+            set(ssn.predicate_fns) <= set(ssn.static_predicate_fns)
+            # Builtin scorers read only node idle/allocatable/labels — all
+            # frozen during preempt/reclaim.  Batch scorers (inter-pod
+            # affinity preferences) depend on live placements: no caching.
+            and scoring <= {"nodeorder", "binpack"}
+            and not ssn.batch_node_order_fns
+            and os.environ.get("SCHEDULER_TPU_SWEEP", "1") not in ("0", "false")
+        )
+        # The pod-count live gate applies exactly when the predicates plugin's
+        # predicate would run in the dispatch (registered AND tier-enabled).
+        self._check_pod_count = "predicates" in ssn.predicate_fns and any(
+            plugin.name == "predicates" and plugin.predicate_enabled()
+            for tier in ssn.tiers
+            for plugin in tier.plugins
+        )
+
+    def task_sig(self, task: TaskInfo) -> Optional[tuple]:
+        """Everything the cached sweep depends on; None -> task needs the
+        exact per-task path (scan-dynamic predicates)."""
+        pod = task.pod
+        aff = pod.affinity
+        if pod.host_ports or (aff and (aff.pod_affinity or aff.pod_anti_affinity)):
+            return None
+        return (
+            task.req_sig,
+            repr(sorted(pod.node_selector.items())),
+            repr(pod.tolerations),
+            repr(aff.node_required) if aff else "",
+            repr(getattr(aff, "node_preferred", None)) if aff else "",
+        )
+
+    def ordered_nodes(self, task: TaskInfo) -> Optional[List[NodeInfo]]:
+        """Best-first candidate nodes for this task, memoized by signature.
+        Returns None when the task (or session) needs the legacy sweep.
+        Callers must still apply the live pod-count gate (``node_open``)."""
+        if not self.enabled:
+            return None
+        sig = self.task_sig(task)
+        if sig is None:
+            return None
+        hit = self._cache.get(sig)
+        if hit is None:
+            hit = full_sweep(self.ssn, task, self.ssn.static_predicate_fn)
+            self._cache[sig] = hit
+        return hit
+
+    def passing_nodes(self, task: TaskInfo) -> Optional[List[NodeInfo]]:
+        """Name-ordered nodes passing the static predicate, memoized by
+        signature — reclaim's shape (no scoring: the reference walks the node
+        map and takes the first workable node, reclaim.go:134-141)."""
+        if not self.enabled:
+            return None
+        sig = self.task_sig(task)
+        if sig is None:
+            return None
+        key = ("passing",) + sig
+        hit = self._cache.get(key)
+        if hit is None:
+            hit, _ = predicate_nodes(task, self._nodes, self.ssn.static_predicate_fn)
+            self._cache[key] = hit
+        return hit
+
+    def node_open(self, node: NodeInfo) -> bool:
+        """The live predicate component: pod-count headroom (the cached sweep
+        used the static predicate, which excludes it by contract)."""
+        if not self._check_pod_count:
+            return True
+        return len(node.tasks) < node.pods_limit
+
+
+def full_sweep(ssn, task: TaskInfo, predicate) -> List[NodeInfo]:
+    """The reference's per-task pipeline (preempt.go:191-195): predicate all
+    nodes, score the passing set, best-first.  One definition shared by the
+    memoized path (static predicate) and the legacy fallback (full
+    predicate) so the two cannot drift."""
+    passing, _ = predicate_nodes(task, get_node_list(ssn.nodes), predicate)
+    scores = prioritize_nodes(
+        task,
+        passing,
+        ssn.batch_node_order_fn,
+        ssn.node_order_map_fn,
+        ssn.node_order_reduce_fn,
+    )
+    return sort_nodes(scores)
+
+
+class RunningLedger:
+    """Which (queue, job) pairs had Running tasks on each node at action
+    start.  Presence-only — see module docstring for why totals would not be
+    an exact gate.  Built LAZILY on first gate call (an action with no
+    preemptors never pays the scan), reading the job stores' node_name
+    column vectorized."""
+
+    def __init__(self, ssn) -> None:
+        self._ssn = ssn
+        self._built = False
+        # node name -> queue uid -> set of job uids with Running tasks there.
+        self.node_queue_jobs: Dict[str, Dict[str, Set[str]]] = {}
+
+    def _build(self) -> None:
+        self._built = True
+        for job in self._ssn.jobs.values():
+            rows = job.rows_with_status(TaskStatus.RUNNING)
+            if rows.shape[0] == 0:
+                continue
+            queue = job.queue
+            uid = job.uid
+            for node_name in set(job.store.node_name[rows].tolist()):
+                if not node_name:
+                    continue
+                self.node_queue_jobs.setdefault(node_name, {}).setdefault(
+                    queue, set()
+                ).add(uid)
+
+    def has_other_queue_running(self, node: NodeInfo, queue: str) -> bool:
+        """Reclaim candidates exist: some OTHER queue ran tasks here."""
+        if not self._built:
+            self._build()
+        per_q = self.node_queue_jobs.get(node.name)
+        if not per_q:
+            return False
+        return any(q != queue for q in per_q)
+
+    def has_other_job_running(self, node: NodeInfo, queue: str, job_uid: str) -> bool:
+        """Preempt phase-1 candidates exist: the SAME queue's other jobs ran
+        tasks here."""
+        if not self._built:
+            self._build()
+        per_q = self.node_queue_jobs.get(node.name)
+        jobs = per_q.get(queue) if per_q else None
+        if not jobs:
+            return False
+        return bool(jobs - {job_uid})
+
+    def has_own_job_running(self, node: NodeInfo, queue: str, job_uid: str) -> bool:
+        """Preempt phase-2 candidates exist: the job's own tasks ran here."""
+        if not self._built:
+            self._build()
+        per_q = self.node_queue_jobs.get(node.name)
+        jobs = per_q.get(queue) if per_q else None
+        return bool(jobs and job_uid in jobs)

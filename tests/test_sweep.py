@@ -1,0 +1,133 @@
+"""SweepCache / RunningLedger: the memoized preempt/reclaim node sweep must be
+bind-for-bind and evict-for-evict identical to the reference per-task sweep
+(SCHEDULER_TPU_SWEEP=0), and must tolerate scan-dynamic tasks (legacy path).
+"""
+
+import numpy as np
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import close_session, get_action, open_session
+from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
+
+PREEMPT_CONF = """
+actions: "allocate, preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+  - name: predicates
+  - name: nodeorder
+"""
+
+RECLAIM_CONF = """
+actions: "reclaim"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: proportion
+  - name: predicates
+  - name: nodeorder
+"""
+
+
+def _preempt_cluster(n_nodes=8):
+    rng = np.random.default_rng(3)
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    cache.add_priority_class("high", 100)
+    for i in range(n_nodes):
+        cache.add_node(build_node(
+            f"n{i:02d}", {"cpu": 4000, "memory": 8 * 1024**3},
+            labels={"zone": f"z{i % 2}"}))
+    # low-priority running gangs filling the nodes
+    for j in range(n_nodes):
+        g = f"low{j}"
+        cache.add_pod_group(build_pod_group(g, min_member=1, phase="Running"))
+        for t in range(2):
+            cache.add_pod(build_pod(
+                name=f"{g}-{t}", req={"cpu": 1500, "memory": 2 * 1024**3},
+                groupname=g, nodename=f"n{j:02d}", phase="Running"))
+    # high-priority pending gang needing preemption
+    pg = build_pod_group("hi", min_member=2)
+    pg.priority_class_name = "high"
+    cache.add_pod_group(pg)
+    for t in range(2):
+        cache.add_pod(build_pod(
+            name=f"hi-{t}", req={"cpu": 2500, "memory": 3 * 1024**3},
+            groupname="hi", priority=100,
+            selector={"zone": "z0"} if t == 0 else None))
+    return cache
+
+
+def _reclaim_cluster(n_nodes=6):
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("qa", weight=1))
+    cache.add_queue(build_queue("qb", weight=1))
+    for i in range(n_nodes):
+        cache.add_node(build_node(f"n{i:02d}", {"cpu": 4000, "memory": 8 * 1024**3}))
+    # qa hogs everything
+    for j in range(n_nodes):
+        g = f"hog{j}"
+        cache.add_pod_group(build_pod_group(g, queue="qa", min_member=1, phase="Running"))
+        for t in range(2):
+            cache.add_pod(build_pod(
+                name=f"{g}-{t}", req={"cpu": 2000, "memory": 4 * 1024**3},
+                groupname=g, nodename=f"n{j:02d}", phase="Running"))
+    # qb starves
+    cache.add_pod_group(build_pod_group("starved", queue="qb", min_member=1))
+    cache.add_pod(build_pod(
+        name="starved-0", req={"cpu": 2000, "memory": 4 * 1024**3}, groupname="starved"))
+    return cache
+
+
+def _run(build, conf_str, monkeypatch, sweep_on):
+    monkeypatch.setenv("SCHEDULER_TPU_SWEEP", "1" if sweep_on else "0")
+    cache = build()
+    conf = parse_scheduler_conf(conf_str)
+    ssn = open_session(cache, conf.tiers)
+    for name in conf.actions:
+        get_action(name).execute(ssn)
+    close_session(ssn)
+    return dict(cache.binder.binds), list(cache.evictor.evicts)
+
+
+def test_preempt_sweep_cache_is_exact(monkeypatch):
+    on = _run(_preempt_cluster, PREEMPT_CONF, monkeypatch, True)
+    off = _run(_preempt_cluster, PREEMPT_CONF, monkeypatch, False)
+    assert on == off
+    binds, evicts = on
+    assert evicts, "expected preemption victims"
+
+
+def test_reclaim_sweep_cache_is_exact(monkeypatch):
+    on = _run(_reclaim_cluster, RECLAIM_CONF, monkeypatch, True)
+    off = _run(_reclaim_cluster, RECLAIM_CONF, monkeypatch, False)
+    assert on == off
+    _binds, evicts = on
+    assert evicts, "expected reclaim victims"
+
+
+def test_dynamic_task_uses_legacy_sweep(monkeypatch):
+    """Host-port preemptors bypass the cache but still preempt correctly."""
+
+    def build():
+        cache = _preempt_cluster()
+        # make one pending pod scan-dynamic
+        pod = build_pod(
+            name="dyn-0", req={"cpu": 2500, "memory": 3 * 1024**3},
+            groupname="hi", priority=100)
+        pod.host_ports = [9999]
+        cache.add_pod(pod)
+        return cache
+
+    on = _run(build, PREEMPT_CONF, monkeypatch, True)
+    off = _run(build, PREEMPT_CONF, monkeypatch, False)
+    assert on == off

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import signal
 import sys
 import threading
@@ -166,6 +167,11 @@ def run(opt: ServerOption, stop: Optional[threading.Event] = None,
         synthetic: Optional[str] = None) -> None:
     """app.Run equivalent (server.go:76-153)."""
     register_options(opt)
+    if opt.mesh:
+        # The fused engine reads the mesh through SCHEDULER_TPU_MESH
+        # (ops/mesh.py); set unconditionally so --mesh 1 also OVERRIDES an
+        # inherited environment value instead of leaking it into the run.
+        os.environ["SCHEDULER_TPU_MESH"] = opt.mesh
 
     if synthetic:
         from scheduler_tpu.harness import make_synthetic_cluster

@@ -911,6 +911,14 @@ class FusedAllocator:
             run_dev,
         )
 
+        # Multi-chip: shard the node axis over the configured mesh (--mesh /
+        # SCHEDULER_TPU_MESH; None = single-chip, today's exact behavior).
+        from scheduler_tpu.ops.mesh import get_mesh, shard_fused_args
+
+        mesh = get_mesh()
+        if mesh is not None:
+            self.args = shard_fused_args(mesh, self.args)
+
     # -- capability probe ----------------------------------------------------
 
     @staticmethod

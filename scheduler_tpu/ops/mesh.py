@@ -1,0 +1,119 @@
+"""Production multi-chip wiring: shard the fused engine's node axis over the
+available chips.
+
+The node axis is this framework's "big" axis (SURVEY §5: the honest analogue
+of sequence parallelism) — node tensors ([N, R] ledgers, [T, N] static
+mask/score) shard over a 1-D device mesh; job/queue/task tensors replicate.
+XLA/GSPMD inserts the collectives (the per-step argmax over the sharded node
+axis becomes a sharded reduce + all-gather over ICI), exactly the
+scaling-book recipe: annotate shardings, let the compiler place collectives.
+
+Enable with ``--mesh auto|N`` (daemon flag) or ``SCHEDULER_TPU_MESH``; the
+default ("1") keeps today's single-chip behavior byte-for-byte.  Mesh sizes
+are clamped to the largest power of two <= available devices so the
+power-of-two node buckets always divide evenly.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("scheduler_tpu.ops.mesh")
+
+_cached_mesh = None
+_cached_key: Optional[str] = None
+
+
+def mesh_spec() -> str:
+    return os.environ.get("SCHEDULER_TPU_MESH", "1")
+
+
+def get_mesh():
+    """The configured 1-D node mesh, or None for single-chip (default).
+    Malformed specs degrade to single-chip with a warning (an engine-choice
+    knob must never crash a scheduling cycle)."""
+    global _cached_mesh, _cached_key
+    spec = mesh_spec().strip().lower()
+    if spec == _cached_key:
+        return _cached_mesh
+    import jax
+    from jax.sharding import Mesh
+
+    from scheduler_tpu.ops.sharded import NODE_AXIS
+
+    mesh = None
+    if spec not in ("", "1", "none", "off", "0"):
+        devices = jax.devices()
+        if spec == "auto":
+            want = len(devices)
+        else:
+            try:
+                want = int(spec)
+            except ValueError:
+                logger.warning("malformed mesh spec %r; staying single-chip", spec)
+                want = 1
+        n = 1
+        while n * 2 <= min(want, len(devices)):
+            n *= 2
+        if n > 1:
+            mesh = Mesh(np.asarray(devices[:n]), (NODE_AXIS,))
+        elif want > 1:
+            logger.warning(
+                "mesh %r requested but only %d device(s); staying single-chip",
+                spec, len(devices),
+            )
+    _cached_mesh, _cached_key = mesh, spec
+    return mesh
+
+
+def shard_fused_args(mesh, args: Tuple) -> Tuple:
+    """Place ``FusedAllocator.args`` onto the mesh: node-axis tensors shard
+    over NODE_AXIS, [T, N] static tensors shard on their node axis, and
+    everything else replicates.  Positions follow ``fused_allocate``'s
+    signature.  Both mesh size and node buckets are powers of two, so the
+    axis divides whenever the bucket is at least mesh-sized; tiny clusters
+    (bucket < mesh) stay single-chip rather than crash device_put."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from scheduler_tpu.ops.sharded import (
+        NODE_AXIS,
+        node_sharding,
+        task_node_sharding,
+    )
+
+    n_bucket = args[0].shape[0]
+    if n_bucket % mesh.size != 0:
+        logger.warning(
+            "node bucket %d smaller than the %d-chip mesh; staying single-chip",
+            n_bucket, mesh.size,
+        )
+        return args
+
+    node0 = node_sharding(mesh)
+    rep = NamedSharding(mesh, P())
+
+    def static_spec(a):
+        # [1, 1] dummies (use_static off) cannot shard their unit axis.
+        if a.ndim == 2 and a.shape[1] > 1:
+            return task_node_sharding(mesh)
+        return rep
+
+    specs = [
+        node0,            # idle [N, R]
+        node0,            # releasing [N, R]
+        node0,            # task_count [N]
+        node0,            # allocatable [N, R]
+        node0,            # pods_limit [N]
+        node0,            # node_gate [N]
+        rep,              # mins [R]
+        rep,              # init_resreq [T, R]
+        rep,              # resreq [T, R]
+        static_spec(args[9]),   # static_mask [T, N]
+        static_spec(args[10]),  # static_score [T, N]
+    ] + [rep] * (len(args) - 11)
+    return tuple(jax.device_put(a, s) for a, s in zip(args, specs))

@@ -35,6 +35,10 @@ class ServerOption:
     # xprof/TensorBoard trace dir; per-cycle JAX profiler traces when set
     # (the pprof analogue, main.go:24-25 -> SURVEY.md §5).
     profile_dir: Optional[str] = None
+    # Device mesh for the fused engine's node axis: "1" single-chip (default),
+    # "auto" = all visible chips, or an explicit chip count (TPU-native knob;
+    # the reference's 16-worker sweep parallelism takes this slot).
+    mesh: str = "1"
 
 
 # The reference keeps a mutable global the cache reads back
@@ -85,6 +89,11 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
         "--profile-dir", default=None,
         help="write JAX profiler (xprof) traces of the first cycles to this directory",
     )
+    parser.add_argument(
+        "--mesh", default="1",
+        help="node-axis device mesh for the fused engine: 1 (single chip), "
+             "auto (all chips), or a chip count",
+    )
 
 
 def option_from_namespace(ns: argparse.Namespace) -> ServerOption:
@@ -100,6 +109,7 @@ def option_from_namespace(ns: argparse.Namespace) -> ServerOption:
         lock_file=ns.lock_file,
         io_workers=ns.io_workers,
         profile_dir=ns.profile_dir,
+        mesh=ns.mesh,
     )
 
 

@@ -157,3 +157,34 @@ def test_fused_engine_node_sharded_matches_single_device():
     out = call(sharded)
     close_session(ssn)
     np.testing.assert_array_equal(base, out)
+
+
+def test_production_mesh_flag_matches_single_chip(monkeypatch):
+    """--mesh / SCHEDULER_TPU_MESH routes the PRODUCTION allocate action
+    through FusedAllocator with the node axis sharded over the mesh; binds
+    must match the single-chip run exactly (VERDICT r1 #6)."""
+    import scheduler_tpu.actions  # noqa: F401
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.framework import close_session, get_action, open_session
+    from scheduler_tpu.ops import mesh as mesh_mod
+    from tests.test_fused import CONF, build_cluster
+
+    make_mesh()  # skip when <8 devices on real hardware
+
+    def run():
+        cache = build_cluster(seed=1, n_nodes=16, n_jobs=8)
+        ssn = open_session(cache, parse_scheduler_conf(CONF).tiers)
+        get_action("allocate").execute(ssn)
+        close_session(ssn)
+        return dict(cache.binder.binds)
+
+    monkeypatch.delenv("SCHEDULER_TPU_MESH", raising=False)
+    single = run()
+
+    monkeypatch.setenv("SCHEDULER_TPU_MESH", "8")
+    assert mesh_mod.get_mesh() is not None, "mesh should activate"
+    sharded = run()
+
+    assert single == sharded
+    assert len(single) > 0

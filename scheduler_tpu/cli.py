@@ -26,15 +26,7 @@ import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
-from scheduler_tpu.apis.objects import (
-    GROUP_NAME_ANNOTATION,
-    NodeSpec,
-    PodGroup,
-    PodSpec,
-    Queue,
-    Taint,
-    Toleration,
-)
+from scheduler_tpu.apis.objects import Queue
 from scheduler_tpu.cache import SchedulerCache
 from scheduler_tpu.options import ServerOption, option_from_namespace, register_options
 from scheduler_tpu.scheduler import Scheduler
@@ -120,51 +112,31 @@ def serve_metrics(
 
 
 def load_cluster_state(cache: SchedulerCache, path: str) -> None:
-    """Preload cluster state from a JSON file: {queues, nodes, podGroups, pods}."""
+    """Preload cluster state from a JSON file: {queues, nodes, podGroups, pods}
+    — the same object schema the API-server connector speaks (connector/wire)."""
+    from scheduler_tpu.connector.wire import (
+        parse_node,
+        parse_pod,
+        parse_pod_group,
+        parse_queue,
+    )
+
     with open(path, "r") as f:
         state = json.load(f)
     for q in state.get("queues", []):
-        cache.add_queue(Queue(name=q["name"], weight=int(q.get("weight", 1)),
-                              capability=q.get("capability", {})))
+        cache.add_queue(parse_queue(q))
     for n in state.get("nodes", []):
-        cache.add_node(NodeSpec(
-            name=n["name"],
-            allocatable={k: float(v) for k, v in n.get("allocatable", {}).items()},
-            capacity={k: float(v) for k, v in n.get("capacity", n.get("allocatable", {})).items()},
-            labels=n.get("labels", {}),
-            taints=[Taint(**t) for t in n.get("taints", [])],
-            unschedulable=bool(n.get("unschedulable", False)),
-        ))
+        cache.add_node(parse_node(n))
     for g in state.get("podGroups", []):
-        pg = PodGroup(
-            name=g["name"], namespace=g.get("namespace", "default"),
-            queue=g.get("queue", ""), min_member=int(g.get("minMember", 1)),
-            min_resources=g.get("minResources"),
-        )
-        if g.get("phase"):
-            pg.status.phase = g["phase"]
-        cache.add_pod_group(pg)
+        cache.add_pod_group(parse_pod_group(g))
     for p in state.get("pods", []):
-        annotations = dict(p.get("annotations", {}))
-        if p.get("group"):
-            annotations[GROUP_NAME_ANNOTATION] = p["group"]
-        cache.add_pod(PodSpec(
-            name=p["name"], namespace=p.get("namespace", "default"),
-            containers=[{k: float(v) for k, v in c.items()} for c in p.get("containers", [])],
-            phase=p.get("phase", "Pending"),
-            node_name=p.get("nodeName", ""),
-            priority=int(p.get("priority", 0)),
-            labels=p.get("labels", {}),
-            annotations=annotations,
-            node_selector=p.get("nodeSelector", {}),
-            tolerations=[Toleration(**t) for t in p.get("tolerations", [])],
-            scheduler_name=p.get("schedulerName", cache.scheduler_name),
-        ))
+        cache.add_pod(parse_pod(p, cache.scheduler_name))
 
 
 def run(opt: ServerOption, stop: Optional[threading.Event] = None,
         cluster_state: Optional[str] = None,
-        synthetic: Optional[str] = None) -> None:
+        synthetic: Optional[str] = None,
+        api_server: Optional[str] = None) -> None:
     """app.Run equivalent (server.go:76-153)."""
     register_options(opt)
     if opt.mesh:
@@ -173,7 +145,19 @@ def run(opt: ServerOption, stop: Optional[threading.Event] = None,
         # inherited environment value instead of leaking it into the run.
         os.environ["SCHEDULER_TPU_MESH"] = opt.mesh
 
-    if synthetic:
+    connector = None
+    if api_server:
+        # External system of record: list+watch ingestion + RPC side effects
+        # over the wire (the reference's API-server seam, cache.go:256-336).
+        from scheduler_tpu.connector import connect_cache
+
+        cache, connector = connect_cache(
+            api_server,
+            scheduler_name=opt.scheduler_name,
+            default_queue=opt.default_queue,
+            io_workers=opt.io_workers,
+        )
+    elif synthetic:
         from scheduler_tpu.harness import make_synthetic_cluster
 
         n_nodes, n_pods = (int(x) for x in synthetic.split(","))
@@ -193,6 +177,10 @@ def run(opt: ServerOption, stop: Optional[threading.Event] = None,
     stop = stop or threading.Event()
 
     def lead(stop_event: threading.Event) -> None:
+        if connector is not None:
+            connector.start()  # LIST (retried) seeds the cache, then watch
+            if not connector.wait_for_cache_sync(timeout=60):
+                logger.warning("cache sync timed out; scheduling on partial state")
         sched.run(stop_event)
 
     try:
@@ -201,6 +189,8 @@ def run(opt: ServerOption, stop: Optional[threading.Event] = None,
         else:
             lead(stop)
     finally:
+        if connector is not None:
+            connector.stop()
         server.shutdown()
         cache.stop()
 
@@ -226,6 +216,10 @@ def main(argv: Optional[List[str]] = None) -> None:
         "--synthetic", default=None, metavar="NODES,PODS",
         help="generate a synthetic cluster instead of loading state",
     )
+    parser.add_argument(
+        "--api-server", default=None, metavar="URL",
+        help="external system of record (list+watch in, binds/evictions out)",
+    )
     ns = parser.parse_args(argv)
     opt = option_from_namespace(ns)
 
@@ -237,7 +231,8 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     signal.signal(signal.SIGINT, on_signal)
     signal.signal(signal.SIGTERM, on_signal)
-    run(opt, stop, cluster_state=ns.cluster_state, synthetic=ns.synthetic)
+    run(opt, stop, cluster_state=ns.cluster_state, synthetic=ns.synthetic,
+        api_server=ns.api_server)
 
 
 if __name__ == "__main__":

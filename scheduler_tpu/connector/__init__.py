@@ -1,0 +1,23 @@
+"""External wire connector: SchedulerCache over a remote API server.
+
+The seam the reference fills with client-go informers + REST clients
+(cache.go:256-336, :447-487): list+watch ingestion in, Binder/Evictor/
+StatusUpdater RPCs out, failure -> resync.  ``mock_server`` is the
+system-of-record stand-in for e2e tests and local development.
+"""
+
+from scheduler_tpu.connector.client import (
+    ApiConnector,
+    HttpBinder,
+    HttpEvictor,
+    HttpStatusUpdater,
+    connect_cache,
+)
+
+__all__ = [
+    "ApiConnector",
+    "HttpBinder",
+    "HttpEvictor",
+    "HttpStatusUpdater",
+    "connect_cache",
+]

@@ -1,0 +1,281 @@
+"""The external wire: a SchedulerCache fed by a remote API server.
+
+The reference's cache is an informer mirror of the Kubernetes API server with
+RPC side effects (``cache/cache.go:256-336`` watch streams in, ``:447-487``
+binds/evictions out).  This module is that seam over HTTP/JSON:
+
+* **list+watch in**: one LIST (``GET /state``) seeds the cache, then a watch
+  thread long-polls ``GET /watch?since=seq`` and applies add/update/delete
+  events for pods / nodes / podgroups / queues / priority classes through the
+  cache's event-handler methods — the informer fan-in (event_handlers.go).
+* **RPCs out**: Binder / Evictor / StatusUpdater implementations POST to the
+  server.  A failed bind raises; the cache's existing resync path reverts the
+  local Binding state so the next cycle retries (errTasks semantics,
+  cache.go:559-581) — and the server's eventual watch echo reconciles any
+  remaining drift, exactly the reference's crash-tolerant reconcile model.
+
+Transport is stdlib ``urllib`` — the wire format, not the client library, is
+the contract.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from scheduler_tpu.api.vocab import ResourceVocabulary
+from scheduler_tpu.cache.cache import SchedulerCache
+from scheduler_tpu.cache.interface import Binder, BulkBindError, Evictor, StatusUpdater
+from scheduler_tpu.connector.wire import (
+    parse_node,
+    parse_pod,
+    parse_pod_group,
+    parse_queue,
+)
+
+logger = logging.getLogger("scheduler_tpu.connector")
+
+
+def _post(base: str, path: str, payload: dict, timeout: float = 10.0) -> dict:
+    req = urllib.request.Request(
+        base + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _get(base: str, path: str, timeout: float = 30.0) -> dict:
+    with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+class HttpBinder(Binder):
+    def __init__(self, base: str) -> None:
+        self.base = base
+
+    def bind(self, pod, hostname: str) -> None:
+        _post(self.base, "/bind", {
+            "namespace": pod.namespace, "name": pod.name, "node": hostname,
+        })
+
+    def bind_bulk(self, pairs: list) -> None:
+        payload = {"pairs": [
+            {"namespace": pod.namespace, "name": pod.name, "node": hostname}
+            for pod, hostname in pairs
+        ]}
+        try:
+            _post(self.base, "/bind-bulk", payload)
+        except urllib.error.HTTPError as err:
+            if err.code != 409:
+                raise  # transport/unknown failure: caller assumes nothing applied
+            failed_keys = {
+                (f.get("namespace", "default"), f["name"])
+                for f in json.loads(err.read() or b"{}").get("failed", [])
+            }
+            raise BulkBindError([
+                (pod, hostname)
+                for pod, hostname in pairs
+                if (pod.namespace, pod.name) in failed_keys
+            ]) from err
+
+
+class HttpEvictor(Evictor):
+    def __init__(self, base: str) -> None:
+        self.base = base
+
+    def evict(self, pod) -> None:
+        _post(self.base, "/evict", {"namespace": pod.namespace, "name": pod.name})
+
+
+class HttpStatusUpdater(StatusUpdater):
+    def __init__(self, base: str) -> None:
+        self.base = base
+
+    def update_pod_condition(self, pod, condition) -> None:
+        # The cache passes conditions as plain dicts (cache.record_job_status_
+        # event); accept attribute-style objects too.
+        def field(name: str) -> str:
+            if isinstance(condition, dict):
+                return str(condition.get(name, ""))
+            return str(getattr(condition, name, ""))
+
+        _post(self.base, "/pod-condition", {
+            "namespace": pod.namespace, "name": pod.name,
+            "type": field("type"),
+            "status": field("status"),
+            "reason": field("reason"),
+            "message": field("message"),
+        })
+
+    def update_pod_group(self, job) -> None:
+        pg = job.pod_group
+        if pg is None:
+            return
+        _post(self.base, "/podgroup-status", {
+            "namespace": pg.namespace, "name": pg.name,
+            "phase": str(pg.status.phase),
+            "conditions": [
+                {"type": c.type, "status": c.status, "reason": c.reason}
+                for c in pg.status.conditions
+            ],
+        })
+
+
+class ApiConnector:
+    """list+watch ingestion loop binding a SchedulerCache to a server."""
+
+    def __init__(self, cache: SchedulerCache, base: str) -> None:
+        self.cache = cache
+        self.base = base
+        self.seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.synced = threading.Event()
+
+    # -- event application ---------------------------------------------------
+
+    def _apply(self, kind: str, op: str, obj: dict) -> None:
+        cache = self.cache
+        try:
+            if kind == "pod":
+                pod = parse_pod(obj, cache.scheduler_name)
+                if op == "add":
+                    cache.add_pod(pod)
+                elif op == "update":
+                    cache.update_pod(pod)
+                else:
+                    cache.delete_pod(pod)
+            elif kind == "node":
+                node = parse_node(obj)
+                if op == "add":
+                    cache.add_node(node)
+                elif op == "update":
+                    cache.update_node(node)
+                else:
+                    cache.delete_node(node)
+            elif kind == "podgroup":
+                pg = parse_pod_group(obj)
+                if op == "delete":
+                    cache.delete_pod_group(pg)
+                elif op == "update":
+                    cache.update_pod_group(pg)
+                else:
+                    cache.add_pod_group(pg)
+            elif kind == "queue":
+                q = parse_queue(obj)
+                if op == "delete":
+                    cache.delete_queue(q)
+                else:
+                    cache.add_queue(q)
+            elif kind == "priorityclass":
+                if op == "delete":
+                    cache.delete_priority_class(obj["name"])
+                else:
+                    cache.add_priority_class(obj["name"], int(obj.get("value", 0)))
+        except Exception:
+            logger.exception("failed to apply %s %s event", op, kind)
+
+    def list_and_seed(self) -> None:
+        """The initial LIST: seed the cache, remember the watch cursor.  On a
+        RE-list (watch horizon lost), pods apply as updates — stable uids make
+        that an idempotent replace.  (Objects deleted during the horizon gap
+        are reconciled by their next event; a full store-replace diff is the
+        remaining gap vs the reference's informer relist.)"""
+        relist = self.synced.is_set()
+        state = _get(self.base, "/state")
+        self.seq = int(state.get("seq", 0))
+        for q in state.get("queues", []):
+            self._apply("queue", "add", q)
+        for pc in state.get("priorityClasses", []):
+            self._apply("priorityclass", "add", pc)
+        for n in state.get("nodes", []):
+            self._apply("node", "update" if relist else "add", n)
+        for g in state.get("podGroups", []):
+            self._apply("podgroup", "update" if relist else "add", g)
+        for p in state.get("pods", []):
+            self._apply("pod", "update" if relist else "add", p)
+        self.synced.set()
+
+    def _watch_loop(self) -> None:
+        # LIST first, with retries: the daemon and its system of record start
+        # concurrently in any orchestrated deploy — a refused connection at
+        # boot must resync, not crash (cache.Run/WaitForCacheSync semantics).
+        while not self._stop.is_set() and not self.synced.is_set():
+            try:
+                self.list_and_seed()
+            except Exception:
+                logger.warning("initial LIST failed; retrying", exc_info=True)
+                self._stop.wait(1.0)
+        while not self._stop.is_set():
+            try:
+                payload = _get(
+                    self.base, f"/watch?since={self.seq}&timeout=5", timeout=30
+                )
+            except Exception:
+                if self._stop.is_set():
+                    return
+                logger.warning("watch poll failed; retrying", exc_info=True)
+                self._stop.wait(1.0)
+                continue
+            if payload.get("relist"):
+                # Watch horizon passed our cursor ("resourceVersion too
+                # old"): re-LIST.  Adds/updates re-apply idempotently (stable
+                # uids make update a replace).
+                try:
+                    self.list_and_seed()
+                except Exception:
+                    logger.warning("relist failed; retrying", exc_info=True)
+                    self._stop.wait(1.0)
+                continue
+            for event in payload.get("events", []):
+                self.seq = max(self.seq, int(event["seq"]))
+                self._apply(event["kind"], event["op"], event["object"])
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._watch_loop, name="connector-watch", daemon=True
+        )
+        self._thread.start()
+
+    def wait_for_cache_sync(self, timeout: float = 60.0) -> bool:
+        """Block until the initial LIST has seeded the cache
+        (cache.WaitForCacheSync, cache.go:364-384)."""
+        return self.synced.wait(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+def connect_cache(
+    base: str,
+    scheduler_name: str = "volcano",
+    default_queue: str = "default",
+    io_workers: Optional[int] = None,
+    vocab: Optional[ResourceVocabulary] = None,
+    async_io: bool = True,
+) -> tuple:
+    """A SchedulerCache whose side effects cross the wire to ``base``.
+    Returns ``(cache, connector)`` — call ``connector.start()`` after
+    ``cache.run()`` and ``connector.stop()`` at shutdown."""
+    cache = SchedulerCache(
+        scheduler_name=scheduler_name,
+        default_queue=default_queue,
+        vocab=vocab,
+        binder=HttpBinder(base),
+        evictor=HttpEvictor(base),
+        status_updater=HttpStatusUpdater(base),
+        async_io=async_io,
+        io_workers=io_workers,
+    )
+    connector = ApiConnector(cache, base)
+    cache.client = lambda: connector  # the reference Cache.Client() slot
+    return cache, connector

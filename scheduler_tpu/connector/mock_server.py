@@ -1,0 +1,234 @@
+"""Mock API server: the system-of-record process for connector e2e tests.
+
+Stands in for the reference's Kubernetes API server (the scheduler's only
+communication backend, SURVEY §2.1): holds the authoritative object store,
+serves LIST (``GET /state``) + WATCH (``GET /watch?since=N`` long-poll), and
+accepts the scheduler's side effects (``POST /bind | /bind-bulk | /evict |
+/pod-condition | /podgroup-status``).  Binds mutate the store and are echoed
+back on the watch stream as pod updates — the informer echo that makes the
+scheduler's cache converge on the server's truth.
+
+Failure injection (``POST /inject {"op": "bind", "times": K}``) makes the
+next K bind calls fail with HTTP 500, which must drive the scheduler's
+resync-and-retry path (reference errTasks queue, cache.go:559-581).
+
+Run standalone:  python -m scheduler_tpu.connector.mock_server --port 18200
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List
+from urllib.parse import parse_qs, urlparse
+
+
+class MockState:
+    def __init__(self) -> None:
+        self.lock = threading.Condition()
+        self.objects: Dict[str, Dict[str, Dict]] = {
+            "queue": {}, "node": {}, "podgroup": {}, "pod": {},
+            "priorityclass": {},
+        }
+        self.events: List[Dict] = []  # {seq, kind, op, object}
+        self.seq = 0
+        self.fail: Dict[str, int] = {}  # op -> remaining injected failures
+        self.bind_calls = 0
+        self.evict_calls = 0
+        self.status_updates: List[Dict] = []
+
+    @staticmethod
+    def key(kind: str, obj: Dict) -> str:
+        if kind in ("pod", "podgroup"):
+            from scheduler_tpu.connector.wire import pod_key
+
+            return pod_key(obj)
+        return obj["name"]
+
+    def apply(self, kind: str, op: str, obj: Dict) -> None:
+        with self.lock:
+            key = self.key(kind, obj)
+            if kind == "pod" and not obj.get("uid"):
+                # The system of record assigns identity (k8s UID analogue):
+                # every later event for this pod carries the same uid.
+                obj = dict(obj)
+                obj["uid"] = f"wire-{key}"
+            if op == "delete":
+                obj = self.objects[kind].pop(key, obj)
+            else:
+                self.objects[kind][key] = obj
+            self.seq += 1
+            self.events.append({"seq": self.seq, "kind": kind, "op": op, "object": obj})
+            # Bounded history: watchers older than the horizon must re-list
+            # (the "resourceVersion too old" analogue).
+            if len(self.events) > 10_000:
+                del self.events[:5_000]
+            self.lock.notify_all()
+
+    def take_failure(self, op: str) -> bool:
+        with self.lock:
+            left = self.fail.get(op, 0)
+            if left > 0:
+                self.fail[op] = left - 1
+                return True
+            return False
+
+
+def make_handler(state: MockState):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _json(self, payload, code=200) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self) -> Dict:
+            length = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(length) or b"{}")
+
+        def do_GET(self) -> None:
+            url = urlparse(self.path)
+            if url.path == "/state":
+                with state.lock:
+                    self._json({
+                        "seq": state.seq,
+                        "queues": list(state.objects["queue"].values()),
+                        "nodes": list(state.objects["node"].values()),
+                        "podGroups": list(state.objects["podgroup"].values()),
+                        "pods": list(state.objects["pod"].values()),
+                        "priorityClasses": list(state.objects["priorityclass"].values()),
+                    })
+                return
+            if url.path == "/watch":
+                import bisect
+
+                q = parse_qs(url.query)
+                since = int(q.get("since", ["0"])[0])
+                timeout = float(q.get("timeout", ["10"])[0])
+                deadline = time.monotonic() + timeout
+                with state.lock:
+                    while state.seq <= since:
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            break
+                        state.lock.wait(left)
+                    if state.events and since < state.events[0]["seq"] - 1:
+                        # History pruned past the watcher's cursor: relist.
+                        self._json({"relist": True})
+                        return
+                    # events are seq-sorted: bisect instead of a full rescan.
+                    idx = bisect.bisect_right(
+                        [e["seq"] for e in state.events], since
+                    )
+                    events = state.events[idx:]
+                self._json({"events": events})
+                return
+            if url.path.startswith("/pods/"):
+                _, _, ns, name = url.path.split("/", 3)
+                with state.lock:
+                    obj = state.objects["pod"].get(f"{ns}/{name}")
+                if obj is None:
+                    self._json({"error": "not found"}, 404)
+                else:
+                    self._json(obj)
+                return
+            if url.path == "/stats":
+                with state.lock:
+                    self._json({
+                        "bind_calls": state.bind_calls,
+                        "evict_calls": state.evict_calls,
+                        "status_updates": len(state.status_updates),
+                        "seq": state.seq,
+                    })
+                return
+            self._json({"error": "not found"}, 404)
+
+        def do_POST(self) -> None:
+            url = urlparse(self.path)
+            body = self._body()
+            if url.path == "/objects":
+                state.apply(body["kind"], body.get("op", "add"), body["object"])
+                self._json({"ok": True}, 201)
+                return
+            if url.path == "/inject":
+                with state.lock:
+                    state.fail[body["op"]] = int(body.get("times", 1))
+                self._json({"ok": True})
+                return
+            if url.path in ("/bind", "/bind-bulk"):
+                pairs = body["pairs"] if url.path == "/bind-bulk" else [body]
+                failed = []
+                for pair in pairs:
+                    with state.lock:
+                        state.bind_calls += 1
+                    if state.take_failure("bind"):
+                        failed.append(pair)
+                        continue
+                    key = f"{pair.get('namespace', 'default')}/{pair['name']}"
+                    with state.lock:
+                        pod = state.objects["pod"].get(key)
+                    if pod is None:
+                        failed.append(pair)
+                        continue
+                    pod = dict(pod)
+                    pod["nodeName"] = pair["node"]
+                    pod["phase"] = "Running"
+                    # Echo on the watch stream: the scheduler's cache sees its
+                    # own bind come back as a pod update, like an informer.
+                    state.apply("pod", "update", pod)
+                if url.path == "/bind":
+                    if failed:
+                        self._json({"error": "bind failed"}, 500)
+                    else:
+                        self._json({"ok": True})
+                else:
+                    self._json({"failed": failed}, 200 if not failed else 409)
+                return
+            if url.path == "/evict":
+                with state.lock:
+                    state.evict_calls += 1
+                if state.take_failure("evict"):
+                    self._json({"error": "evict failed"}, 500)
+                    return
+                key = f"{body.get('namespace', 'default')}/{body['name']}"
+                with state.lock:
+                    pod = state.objects["pod"].get(key)
+                if pod is not None:
+                    state.apply("pod", "delete", pod)
+                self._json({"ok": True})
+                return
+            if url.path in ("/pod-condition", "/podgroup-status"):
+                with state.lock:
+                    state.status_updates.append(body)
+                self._json({"ok": True})
+                return
+            self._json({"error": "not found"}, 404)
+
+    return Handler
+
+
+def serve(port: int):
+    state = MockState()
+    server = ThreadingHTTPServer(("127.0.0.1", port), make_handler(state))
+    return server, state
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog="mock-apiserver")
+    parser.add_argument("--port", type=int, default=18200)
+    ns = parser.parse_args()
+    server, _state = serve(ns.port)
+    print(f"mock apiserver on :{ns.port}", flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Wire codecs: the JSON object schema shared by the cluster-state file, the
+API-server connector, and the mock server.
+
+One schema, three consumers (``--cluster-state`` preload, the connector's
+list+watch ingestion, and test drivers talking to the mock server) — the
+reference's equivalent is the CRD types every component round-trips through
+the API server (``pkg/apis/scheduling/v1alpha1/types.go``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from scheduler_tpu.apis.objects import (
+    GROUP_NAME_ANNOTATION,
+    NodeSpec,
+    PodGroup,
+    PodSpec,
+    Queue,
+    Taint,
+    Toleration,
+)
+
+
+def parse_queue(q: Dict) -> Queue:
+    return Queue(
+        name=q["name"],
+        weight=int(q.get("weight", 1)),
+        capability=q.get("capability", {}),
+    )
+
+
+def parse_node(n: Dict) -> NodeSpec:
+    return NodeSpec(
+        name=n["name"],
+        allocatable={k: float(v) for k, v in n.get("allocatable", {}).items()},
+        capacity={
+            k: float(v)
+            for k, v in n.get("capacity", n.get("allocatable", {})).items()
+        },
+        labels=n.get("labels", {}),
+        taints=[Taint(**t) for t in n.get("taints", [])],
+        unschedulable=bool(n.get("unschedulable", False)),
+    )
+
+
+def parse_pod_group(g: Dict) -> PodGroup:
+    pg = PodGroup(
+        name=g["name"],
+        namespace=g.get("namespace", "default"),
+        queue=g.get("queue", ""),
+        min_member=int(g.get("minMember", 1)),
+        min_resources=g.get("minResources"),
+    )
+    if g.get("phase"):
+        pg.status.phase = g["phase"]
+    if g.get("priorityClassName"):
+        pg.priority_class_name = g["priorityClassName"]
+    return pg
+
+
+def parse_pod(p: Dict, default_scheduler: str = "volcano") -> PodSpec:
+    annotations = dict(p.get("annotations", {}))
+    if p.get("group"):
+        annotations[GROUP_NAME_ANNOTATION] = p["group"]
+    pod = PodSpec(
+        name=p["name"],
+        namespace=p.get("namespace", "default"),
+        containers=[{k: float(v) for k, v in c.items()} for c in p.get("containers", [])],
+        phase=p.get("phase", "Pending"),
+        node_name=p.get("nodeName", ""),
+        priority=int(p.get("priority", 0)),
+        labels=p.get("labels", {}),
+        annotations=annotations,
+        node_selector=p.get("nodeSelector", {}),
+        tolerations=[Toleration(**t) for t in p.get("tolerations", [])],
+        scheduler_name=p.get("schedulerName", default_scheduler),
+    )
+    # Wire identity must be STABLE across events: the cache resolves tasks by
+    # uid, so a fresh uid per watch echo would duplicate the task on every
+    # update and make deletes no-ops.  The server's uid wins; absent one,
+    # namespace/name IS the identity (unique in any consistent store).
+    pod.uid = p["uid"] if p.get("uid") else pod_key(p)
+    if p.get("creationTimestamp") is not None:
+        pod.creation_timestamp = float(p["creationTimestamp"])
+    if p.get("hostPorts"):
+        pod.host_ports = [int(x) for x in p["hostPorts"]]
+    return pod
+
+
+def pod_key(obj: Dict) -> str:
+    return f"{obj.get('namespace', 'default')}/{obj['name']}"

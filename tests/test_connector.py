@@ -1,0 +1,208 @@
+"""External-wire e2e: the daemon against a mock API-server PROCESS.
+
+The VERDICT r1 #7 contract: an event stream feeds the cache over the wire
+(list+watch), binds/evictions cross back as RPCs, and an injected bind
+failure self-heals through the resync path.  The mock server is a real
+subprocess — the scheduler and its system of record share no memory.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+
+PORT = 18261
+BASE = f"http://127.0.0.1:{PORT}"
+
+CONF = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: predicates
+  - name: nodeorder
+"""
+
+
+def _post(path, payload):
+    req = urllib.request.Request(
+        BASE + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _get(path):
+    with urllib.request.urlopen(BASE + path, timeout=10) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _add(kind, obj):
+    _post("/objects", {"kind": kind, "object": obj})
+
+
+@pytest.fixture(scope="module")
+def wire():
+    """Mock server subprocess + daemon thread, shared by the module's tests."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "scheduler_tpu.connector.mock_server",
+         "--port", str(PORT)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    assert "mock apiserver" in proc.stdout.readline()
+
+    _add("queue", {"name": "default", "weight": 1})
+    for i in range(3):
+        _add("node", {"name": f"wn-{i}", "allocatable": {
+            "cpu": 4000, "memory": 16 * 2**30, "pods": 110}})
+
+    import tempfile
+
+    from scheduler_tpu import cli
+    from scheduler_tpu.options import ServerOption
+
+    conf_path = tempfile.mktemp(suffix=".yaml")
+    with open(conf_path, "w") as f:
+        f.write(CONF)
+    opt = ServerOption(
+        scheduler_conf=conf_path, schedule_period=0.2,
+        listen_address=":18262", io_workers=2,
+    )
+    stop = threading.Event()
+    t = threading.Thread(
+        target=cli.run, kwargs=dict(opt=opt, stop=stop, api_server=BASE),
+        daemon=True)
+    t.start()
+    try:
+        yield proc
+    finally:
+        stop.set()
+        t.join(timeout=60)
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def _wait_bound(names, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        pods = {p["name"]: p for p in _get("/state")["pods"]}
+        if all(pods.get(n, {}).get("nodeName") for n in names):
+            return pods
+        time.sleep(0.3)
+    raise AssertionError(
+        f"pods never bound: { {n: pods.get(n, {}).get('nodeName') for n in names} }")
+
+
+def test_binds_cross_the_wire(wire):
+    """A gang created on the server gets scheduled and bound THERE."""
+    _add("podgroup", {"name": "wj-1", "queue": "default", "minMember": 3,
+                      "phase": "Inqueue"})
+    for i in range(3):
+        _add("pod", {"name": f"wj-1-{i}", "group": "wj-1",
+                     "containers": [{"cpu": 1000, "memory": 2**30}]})
+    pods = _wait_bound([f"wj-1-{i}" for i in range(3)])
+    assert {p["nodeName"] for p in pods.values() if p["name"].startswith("wj-1")} \
+        <= {"wn-0", "wn-1", "wn-2"}
+    assert _get("/stats")["bind_calls"] >= 3
+
+
+def test_injected_bind_failure_self_heals(wire):
+    """One bind 500 -> local resync reverts to Pending -> a later cycle
+    rebinds; the pod ends up bound on the server (errTasks semantics)."""
+    _post("/inject", {"op": "bind", "times": 1})
+    _add("podgroup", {"name": "wj-2", "queue": "default", "minMember": 1,
+                      "phase": "Inqueue"})
+    _add("pod", {"name": "wj-2-0", "group": "wj-2",
+                 "containers": [{"cpu": 500, "memory": 2**30}]})
+    _wait_bound(["wj-2-0"])
+    # The failure really happened: more bind calls than bound pods needed.
+    stats = _get("/stats")
+    assert stats["bind_calls"] >= 5  # 3 (wj-1) + failed + retry
+
+
+def test_eviction_crosses_the_wire(wire):
+    """ssn.evict reaches the server as a pod delete."""
+    # Reclaim setup is heavyweight; drive the evictor directly through the
+    # connector cache instead (the daemon shares it): create a Running pod
+    # and evict its task via the session-level API.
+    _add("podgroup", {"name": "wj-3", "queue": "default", "minMember": 1,
+                      "phase": "Running"})
+    _add("pod", {"name": "wj-3-0", "group": "wj-3", "nodeName": "wn-0",
+                 "phase": "Running",
+                 "containers": [{"cpu": 100, "memory": 2**29}]})
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if any(p["name"] == "wj-3-0" for p in _get("/state")["pods"]):
+            break
+        time.sleep(0.2)
+    from scheduler_tpu.connector.client import HttpEvictor
+    from scheduler_tpu.connector.wire import parse_pod
+
+    pod = next(p for p in _get("/state")["pods"] if p["name"] == "wj-3-0")
+    HttpEvictor(BASE).evict(parse_pod(pod))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if not any(p["name"] == "wj-3-0" for p in _get("/state")["pods"]):
+            return
+        time.sleep(0.2)
+    raise AssertionError("evicted pod still on the server")
+
+
+def test_watch_echo_keeps_single_task():
+    """Stable wire uids: a pod's bind echo (update event) must REPLACE the
+    cached task, not duplicate it (uid-resolved delete half of update_pod)."""
+    from scheduler_tpu.api.types import TaskStatus
+    from scheduler_tpu.connector import connect_cache
+    from scheduler_tpu.connector.mock_server import serve
+
+    server, _state = serve(18263)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = "http://127.0.0.1:18263"
+    conn = None
+    try:
+        def post(path, payload):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            urllib.request.urlopen(req, timeout=5).read()
+
+        post("/objects", {"kind": "queue", "object": {"name": "default", "weight": 1}})
+        post("/objects", {"kind": "node", "object": {
+            "name": "n0", "allocatable": {"cpu": 4000, "memory": 2**30, "pods": 110}}})
+        post("/objects", {"kind": "podgroup", "object": {
+            "name": "g", "queue": "default", "minMember": 1, "phase": "Inqueue"}})
+        post("/objects", {"kind": "pod", "object": {
+            "name": "p0", "group": "g", "containers": [{"cpu": 100, "memory": 2**20}]}})
+
+        cache, conn = connect_cache(base, async_io=False)
+        cache.run()
+        conn.start()
+        assert conn.wait_for_cache_sync(10)
+
+        job = next(iter(cache.jobs.values()))
+        task = next(iter(job.tasks.values()))
+        cache.bind(task, "n0")  # POSTs /bind; the server echoes a pod update
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with cache.mutex:
+                tasks = list(job.tasks.values())
+                if len(tasks) == 1 and tasks[0].status == TaskStatus.RUNNING:
+                    break
+            time.sleep(0.1)
+        with cache.mutex:
+            tasks = list(job.tasks.values())
+        assert len(tasks) == 1, [t.uid for t in tasks]
+        assert tasks[0].status == TaskStatus.RUNNING
+        assert tasks[0].node_name == "n0"
+    finally:
+        if conn is not None:
+            conn.stop()
+        server.shutdown()

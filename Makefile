@@ -22,9 +22,11 @@ bench:
 bench-smoke:
 	$(PY) bench.py --smoke
 
-# Lint-ish gate (reference `make verify`): compile every module.
+# Lint gate (reference `make verify`: gofmt/golint/compile slots): byte-compile
+# everything, then the AST lint (unused imports, whitespace hygiene).
 verify:
-	$(PY) -m compileall -q scheduler_tpu tests bench.py __graft_entry__.py
+	$(PY) -m compileall -q scheduler_tpu tests scripts bench.py __graft_entry__.py
+	$(PY) scripts/lint.py
 
 clean:
 	find . -name '__pycache__' -type d -exec rm -rf {} + 2>/dev/null || true

@@ -37,4 +37,4 @@ Layer map mirrors SURVEY.md §1 (reference layers → here):
 * ``utils``       — priority queue, metrics, logging, assertions
 """
 
-__version__ = "0.1.0"
+from scheduler_tpu.version import VERSION as __version__  # single source

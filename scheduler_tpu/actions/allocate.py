@@ -23,7 +23,7 @@ from __future__ import annotations
 import logging
 import os
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from scheduler_tpu.api.job_info import JobInfo, TaskInfo
 from scheduler_tpu.api.types import TaskStatus

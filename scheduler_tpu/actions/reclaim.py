@@ -11,9 +11,8 @@ onto the freed resources.
 from __future__ import annotations
 
 import logging
-from typing import Dict, List
+from typing import Dict
 
-from scheduler_tpu.api.job_info import JobInfo
 from scheduler_tpu.api.resource import ResourceVec
 from scheduler_tpu.api.types import TaskStatus
 from scheduler_tpu.apis.objects import PodGroupPhase

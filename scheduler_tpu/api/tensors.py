@@ -191,7 +191,7 @@ def build_node_tensors(
         used[i] = _fit(ni.used.array, r)
         allocatable[i] = _fit(ni.allocatable.array, r)
         pods_limit[i] = ni.pods_limit
-        task_count[i] = len(ni.tasks)
+        task_count[i] = ni.task_count  # eager counter: no view materialization
         ready[i] = ni.ready()
         if ni.node is not None:
             unschedulable[i] = ni.node.unschedulable

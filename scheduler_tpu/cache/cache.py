@@ -17,7 +17,7 @@ from __future__ import annotations
 import logging
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from scheduler_tpu.api.cluster_info import ClusterInfo
 from scheduler_tpu.api.job_info import JobInfo, TaskInfo, job_id_for_pod

@@ -14,7 +14,7 @@ from typing import TYPE_CHECKING, Optional
 if TYPE_CHECKING:
     from scheduler_tpu.api.cluster_info import ClusterInfo
     from scheduler_tpu.api.job_info import JobInfo, TaskInfo
-    from scheduler_tpu.apis.objects import PodGroupCondition, PodSpec
+    from scheduler_tpu.apis.objects import PodSpec
 
 
 class BulkBindError(Exception):

@@ -221,6 +221,11 @@ def main(argv: Optional[List[str]] = None) -> None:
         help="external system of record (list+watch in, binds/evictions out)",
     )
     ns = parser.parse_args(argv)
+    if getattr(ns, "version", False):
+        from scheduler_tpu.version import version_string
+
+        print(version_string())
+        return
     opt = option_from_namespace(ns)
 
     stop = threading.Event()

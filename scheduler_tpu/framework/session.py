@@ -637,7 +637,6 @@ class Session:
         """
         if not items:
             return
-        import numpy as np
 
         from scheduler_tpu.api.types import TaskStatus as TS
 

@@ -13,7 +13,6 @@ from typing import List, TYPE_CHECKING, Tuple
 
 from scheduler_tpu.api.job_info import TaskInfo
 from scheduler_tpu.api.types import TaskStatus
-from scheduler_tpu.framework.interface import Event
 
 if TYPE_CHECKING:
     from scheduler_tpu.framework.session import Session

@@ -17,17 +17,11 @@ import gc
 import time
 
 
-def steady_cycle(cache, conf, actions) -> float:
-    """Warm caches, then run and time one scheduling cycle.  Returns seconds."""
-    from scheduler_tpu.actions.allocate import collect_candidates
+def timed_cycle(cache, conf, actions) -> float:
+    """Run and time one scheduling cycle with the GC frozen (no cache
+    warming — churned work is legitimately cold in steady state)."""
     from scheduler_tpu.framework import close_session, get_action, open_session
-    from scheduler_tpu.ops.fused import FusedAllocator
 
-    warm_ssn = open_session(cache, conf.tiers)
-    cands = collect_candidates(warm_ssn)
-    if cands and warm_ssn.nodes and FusedAllocator.supported(warm_ssn, cands):
-        FusedAllocator(warm_ssn, cands)
-    close_session(warm_ssn)
     gc.collect()
     gc.freeze()
     try:
@@ -39,3 +33,17 @@ def steady_cycle(cache, conf, actions) -> float:
         return time.perf_counter() - start
     finally:
         gc.unfreeze()
+
+
+def steady_cycle(cache, conf, actions) -> float:
+    """Warm caches, then run and time one scheduling cycle.  Returns seconds."""
+    from scheduler_tpu.actions.allocate import collect_candidates
+    from scheduler_tpu.framework import close_session, open_session
+    from scheduler_tpu.ops.fused import FusedAllocator
+
+    warm_ssn = open_session(cache, conf.tiers)
+    cands = collect_candidates(warm_ssn)
+    if cands and warm_ssn.nodes and FusedAllocator.supported(warm_ssn, cands):
+        FusedAllocator(warm_ssn, cands)
+    close_session(warm_ssn)
+    return timed_cycle(cache, conf, actions)

@@ -682,7 +682,6 @@ class FusedAllocator:
         # order, which lets the kernel select by cursor (and batch runs of
         # identical single-task jobs) instead of re-running the chain.
         in_jobs: List[JobInfo] = list(jobs)
-        j = len(in_jobs)
 
         # Ready-break deficit: only meaningful when gang's job_ready veto is
         # live; otherwise JobReady is vacuously true and the break fires after
@@ -704,7 +703,18 @@ class FusedAllocator:
                     dtype=np.int64,
                 )
 
-        rows_l = [pending_rows(job) for job in in_jobs]
+        # Jobs with nothing pending are dead weight for the whole pipeline
+        # (never selectable; they'd only pad the sort, the arrays, and the
+        # decode) — in a churn steady state they are the vast majority of
+        # candidates, so drop them HERE rather than carry them to the kernel.
+        pairs = [
+            (job, rows)
+            for job in in_jobs
+            if (rows := pending_rows(job)).shape[0] > 0
+        ]
+        in_jobs = [job for job, _ in pairs]
+        rows_l = [rows for _, rows in pairs]
+        j = len(in_jobs)
         nums_j = np.asarray([len(rw) for rw in rows_l], dtype=np.int32)
         prio_j = np.asarray([int(job.priority) for job in in_jobs], dtype=np.int32)
         gang_j = np.asarray(
@@ -751,9 +761,7 @@ class FusedAllocator:
                         totals_s[None, :] > 0, alloc_s / safe[None, :], np.float32(0.0)
                     )
                     chain_keys.append(frac.max(axis=1))
-            order = np.lexsort(
-                tuple([tiebreak_j] + list(reversed(chain_keys)) + [nums_j == 0])
-            )
+            order = np.lexsort(tuple([tiebreak_j] + list(reversed(chain_keys))))
         else:
             order = np.arange(0, dtype=np.int64)
 

@@ -11,7 +11,7 @@ client.
 from __future__ import annotations
 
 import argparse
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional
 
 DEFAULT_SCHEDULER_NAME = "volcano"
@@ -93,6 +93,10 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
         "--mesh", default="1",
         help="node-axis device mesh for the fused engine: 1 (single chip), "
              "auto (all chips), or a chip count",
+    )
+    parser.add_argument(
+        "--version", action="store_true", default=False,
+        help="print version/build info and exit (pkg/version/version.go:26-33)",
     )
 
 

@@ -15,7 +15,6 @@ from typing import Dict
 
 from scheduler_tpu.api.job_info import JobInfo, TaskInfo
 from scheduler_tpu.api.resource import ResourceVec, share as share_fn
-from scheduler_tpu.api.types import allocated_status
 from scheduler_tpu.framework.arguments import Arguments
 from scheduler_tpu.framework.interface import EventHandler, Plugin
 

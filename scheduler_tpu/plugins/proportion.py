@@ -16,7 +16,7 @@ from typing import Dict, Optional
 from scheduler_tpu.api.job_info import TaskInfo
 from scheduler_tpu.api.queue_info import QueueInfo
 from scheduler_tpu.api.resource import ResourceVec, res_min, share as share_fn
-from scheduler_tpu.api.types import TaskStatus, allocated_status
+from scheduler_tpu.api.types import TaskStatus
 from scheduler_tpu.framework.arguments import Arguments
 from scheduler_tpu.framework.interface import EventHandler, Plugin
 

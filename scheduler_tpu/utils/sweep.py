@@ -34,7 +34,7 @@ strict with the reference's nil-scalar-map quirk, resource_info.go:226-250.)
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set
 
 from scheduler_tpu.api.job_info import TaskInfo
 from scheduler_tpu.api.node_info import NodeInfo

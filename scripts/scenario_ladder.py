@@ -1,16 +1,22 @@
-"""The BASELINE.json scenario ladder at full (or scaled) size, one JSON line
-per scenario.
+"""The BASELINE.json scenario ladder with steady-state churn, p50/p99.
 
-Usage: PYTHONPATH=. python scripts/scenario_ladder.py [--scale F]
+Usage: PYTHONPATH=. python scripts/scenario_ladder.py
+           [--scale F] [--only 1,3] [--cycles N] [--out LADDER.json]
 
   1. example gang: 6-task gang onto 3 nodes, allocate only
-  2. kubemark density: 1k nodes x 5k pods, predicates + nodeorder
+  2. kubemark density: 1k nodes x 5k bare sleep pods (shadow PodGroups),
+     predicates + nodeorder
   3. binpack+drf: 10k nodes x 100k pods (the bench.py headline)
   4. 2-queue preempt/reclaim, proportion, over-subscribed
   5. topology GPU gangs: 1k 8-task PodGroups, 8-GPU nodes, zone selectors
 
-Each scenario runs a warmup cycle (jit compile) then reports the median of
-three measured cycles.
+Per scenario: one measured FULL cycle (everything pending, warm caches —
+bench.py's shape), then ``--cycles`` measured cycles under CHURN: ~10% of the
+workload retires (pods deleted through the cache's event handlers, the
+informer-delete path) and equivalent new work arrives before each cycle.
+Latency percentiles are reported over the churn cycles — the north-star p99
+session-cycle latency (BASELINE.md; reference machinery:
+test/e2e/benchmark.go:262-282, metric_util.go:70-83).
 """
 
 from __future__ import annotations
@@ -33,53 +39,107 @@ from scheduler_tpu.apis.objects import (
 )
 from scheduler_tpu.cache import SchedulerCache
 from scheduler_tpu.conf import parse_scheduler_conf
-from scheduler_tpu.framework import close_session, get_action, open_session
+from scheduler_tpu.harness.measure import steady_cycle, timed_cycle
 
 GPU = "nvidia.com/gpu"
+TS0 = 1_700_000_000.0
 
 
-def run_cycle(build, conf_str, actions):
-    from scheduler_tpu.harness.measure import steady_cycle
-
+def measure(name, factory, conf_str, actions, placed_of, cycles=20,
+            results=None):
+    """``factory()`` returns a fresh ``(build, churn)`` pair (fresh churn
+    state per build).  One throwaway build absorbs the jit compile; the
+    recorded runs hit the compile cache like the steady scheduler loop."""
     conf = parse_scheduler_conf(conf_str)
+    build0, _ = factory()
+    steady_cycle(build0(), conf, actions)  # compile pass, unrecorded
+    build, churn = factory()
     cache = build()
-    return cache, steady_cycle(cache, conf, actions)
-
-
-def measure(name, build, conf_str, actions, placed_of):
-    run_cycle(build, conf_str, actions)  # warmup/compile
-    results = []
-    for _ in range(3):
-        cache, elapsed = run_cycle(build, conf_str, actions)
-        results.append((placed_of(cache), elapsed))
-    counts = {c for c, _ in results}
-    placed, elapsed = sorted(results, key=lambda r: r[1])[1]
-    print(json.dumps({
+    full_s = steady_cycle(cache, conf, actions)
+    placed_full = placed_of(cache)
+    rec = {
         "scenario": name,
-        "placed": placed,
-        "cycle_seconds": round(elapsed, 3),
-        "placed_per_sec": round(placed / elapsed, 1) if elapsed else 0.0,
-        "stable": len(counts) == 1,
-    }), flush=True)
+        "placed_full": placed_full,
+        "full_cycle_seconds": round(full_s, 3),
+        "full_placed_per_sec": round(placed_full / full_s, 1) if full_s else 0.0,
+    }
+    if churn is not None and cycles > 0:
+        rng = np.random.default_rng(42)
+        # One unrecorded churn cycle: the churned shapes (smaller task
+        # buckets) compile here, like the steady loop's first tick.
+        churn(cache, rng, 0)
+        steady_cycle(cache, conf, actions)
+        lat, placed = [], []
+        for i in range(1, cycles + 1):
+            churn(cache, rng, i)
+            before = placed_of(cache)
+            el = timed_cycle(cache, conf, actions)
+            lat.append(el)
+            placed.append(placed_of(cache) - before)
+        rates = [p / e for p, e in zip(placed, lat) if e > 0]
+        rec.update({
+            "churn_cycles": cycles,
+            "churn_placed_per_cycle": round(float(np.mean(placed)), 1),
+            "cycle_seconds_p50": round(float(np.percentile(lat, 50)), 3),
+            "cycle_seconds_p99": round(float(np.percentile(lat, 99)), 3),
+            "cycle_seconds_max": round(max(lat), 3),
+            "pods_per_sec_p50": round(float(np.median(rates)), 1) if rates else 0.0,
+        })
+    print(json.dumps(rec), flush=True)
+    if results is not None:
+        results.append(rec)
+    return rec
 
 
-def scenario1():
-    def build():
-        cache = SchedulerCache(vocab=ResourceVocabulary(), async_io=False)
-        cache.run()
-        cache.add_queue(Queue(name="default", weight=1))
-        for i in range(3):
-            cache.add_node(NodeSpec(name=f"node-{i}", allocatable={
-                "cpu": 4000.0, "memory": 16 * 2**30, "pods": 110}))
-        pg = PodGroup(name="qj-1", namespace="d", queue="default", min_member=6)
-        pg.status.phase = "Inqueue"
-        cache.add_pod_group(pg)
-        for t in range(6):
-            cache.add_pod(PodSpec(
-                name=f"qj-1-{t}", namespace="d",
-                containers=[{"cpu": 1000.0, "memory": 2**30}],
-                annotations={GROUP_NAME_ANNOTATION: "qj-1"}))
-        return cache
+def _retire(cache, entries) -> None:
+    """Delete jobs' pods + group through the event-handler path (the
+    informer-delete analogue: bound pods free their node resources)."""
+    for pg, pods in entries:
+        for pod in pods:
+            cache.delete_pod(pod)
+        if pg is not None:
+            cache.delete_pod_group(pg)
+
+
+# --- scenario 1: example gang ------------------------------------------------
+
+def scenario1(cycles, results):
+    def factory():
+        alive = {"gen": 0, "jobs": []}
+
+        def add_gang(cache, gen):
+            g = f"qj-{gen}"
+            pg = PodGroup(name=g, namespace="d", queue="default", min_member=6)
+            pg.status.phase = "Inqueue"
+            pg.creation_timestamp = TS0 + gen
+            cache.add_pod_group(pg)
+            pods = []
+            for t in range(6):
+                pod = PodSpec(name=f"{g}-{t}", namespace="d",
+                              containers=[{"cpu": 1000.0, "memory": 2**30}],
+                              annotations={GROUP_NAME_ANNOTATION: g})
+                pod.creation_timestamp = TS0 + gen + t * 1e-6
+                cache.add_pod(pod)
+                pods.append(pod)
+            alive["jobs"].append((pg, pods))
+
+        def build():
+            cache = SchedulerCache(vocab=ResourceVocabulary(), async_io=False)
+            cache.run()
+            cache.add_queue(Queue(name="default", weight=1))
+            for i in range(3):
+                cache.add_node(NodeSpec(name=f"node-{i}", allocatable={
+                    "cpu": 4000.0, "memory": 16 * 2**30, "pods": 110}))
+            add_gang(cache, 0)
+            return cache
+
+        def churn(cache, rng, i):
+            _retire(cache, alive["jobs"])
+            alive["jobs"] = []
+            alive["gen"] += 1
+            add_gang(cache, alive["gen"])
+
+        return build, churn
 
     conf = """
 actions: "allocate"
@@ -88,38 +148,18 @@ tiers:
   - name: priority
   - name: gang
 """
-    measure("1-example-gang", build, conf, ("allocate",),
-            lambda c: len(c.binder.binds))
+    measure("1-example-gang", factory, conf, ("allocate",),
+            lambda c: len(c.binder.binds), cycles, results)
 
 
-def scenario2(scale):
+# --- scenario 2: kubemark density (bare sleep pods) --------------------------
+
+def scenario2(scale, cycles, results):
     n_nodes, n_pods = int(1000 * scale), int(5000 * scale)
 
-    def build():
-        rng = np.random.default_rng(0)
-        cache = SchedulerCache(vocab=ResourceVocabulary(), async_io=False)
-        cache.run()
-        cache.add_queue(Queue(name="default", weight=1))
-        for i in range(n_nodes):
-            cache.add_node(NodeSpec(name=f"hollow-{i:05d}", allocatable={
-                "cpu": 16000.0, "memory": 64 * 2**30, "pods": 110},
-                labels={"zone": f"z{i % 4}"}))
-        # kubemark density = BARE sleep pods (RC-created, no PodGroup): the
-        # cache synthesizes a single-member shadow PodGroup per pod, the
-        # reference's cache/util.go:30-63 path — so this scenario is
-        # thousands of independent min_member=1 jobs, not multi-task gangs.
-        for t in range(n_pods):
-            pod = PodSpec(
-                name=f"sleep-{t:05d}", namespace="d",
-                scheduler_name="volcano",
-                containers=[{"cpu": float(rng.choice([100, 200, 500])),
-                             "memory": float(rng.choice([1, 2])) * 2**30}],
-                node_selector={"zone": f"z{t % 4}"} if t % 2 == 0 else {})
-            # one burst second (matches real create-storms at metav1.Time
-            # granularity; keeps run grouping deterministic across builds)
-            pod.creation_timestamp = 1_700_000_000.0 + t * 1e-6
-            cache.add_pod(pod)
-        return cache
+    def factory():
+        alive = {"pods": [], "gen": 0}
+        return _s2_build_churn(n_nodes, n_pods, alive)
 
     conf = """
 actions: "allocate"
@@ -131,17 +171,64 @@ tiers:
   - name: predicates
   - name: nodeorder
 """
-    measure("2-kubemark-density", build, conf, ("allocate",),
-            lambda c: len(c.binder.binds))
+    measure("2-kubemark-density", factory, conf, ("allocate",),
+            lambda c: len(c.binder.binds), cycles, results)
 
 
-def scenario3(scale):
-    from scheduler_tpu.harness import make_synthetic_cluster
-
-    n_nodes, n_pods = int(10_000 * scale), int(100_000 * scale)
+def _s2_build_churn(n_nodes, n_pods, alive):
+    def make_pod(rng, name, idx):
+        pod = PodSpec(
+            name=name, namespace="d", scheduler_name="volcano",
+            containers=[{"cpu": float(rng.choice([100, 200, 500])),
+                         "memory": float(rng.choice([1, 2])) * 2**30}],
+            node_selector={"zone": f"z{idx % 4}"} if idx % 2 == 0 else {})
+        pod.creation_timestamp = TS0 + idx * 1e-6
+        return pod
 
     def build():
-        return make_synthetic_cluster(n_nodes, n_pods, tasks_per_job=100).cache
+        rng = np.random.default_rng(0)
+        cache = SchedulerCache(vocab=ResourceVocabulary(), async_io=False)
+        cache.run()
+        cache.add_queue(Queue(name="default", weight=1))
+        for i in range(n_nodes):
+            cache.add_node(NodeSpec(name=f"hollow-{i:05d}", allocatable={
+                "cpu": 16000.0, "memory": 64 * 2**30, "pods": 110},
+                labels={"zone": f"z{i % 4}"}))
+        # kubemark density = BARE sleep pods (RC-created, no PodGroup): the
+        # cache synthesizes a shadow single-member PodGroup per pod
+        # (reference cache/util.go:30-63).
+        for t in range(n_pods):
+            pod = make_pod(rng, f"sleep-{t:05d}", t)
+            cache.add_pod(pod)
+            alive["pods"].append(pod)
+        return cache
+
+    def churn(cache, rng, i):
+        k = max(1, n_pods // 10)
+        idx = rng.choice(len(alive["pods"]), size=k, replace=False)
+        chosen = set(idx.tolist())
+        for j in sorted(chosen, reverse=True):
+            cache.delete_pod(alive["pods"][j])
+            alive["pods"][j] = alive["pods"][-1]
+            alive["pods"].pop()
+        base = alive["gen"] * n_pods + n_pods
+        alive["gen"] += 1
+        for t in range(k):
+            pod = make_pod(rng, f"sleep-g{alive['gen']}-{t:05d}", base + t)
+            cache.add_pod(pod)
+            alive["pods"].append(pod)
+
+    return build, churn
+
+
+# --- scenario 3: binpack + drf at headline scale -----------------------------
+
+def scenario3(scale, cycles, results):
+    n_nodes, n_pods, per_job = int(10_000 * scale), int(100_000 * scale), 100
+
+    def factory():
+        alive = {"jobs": [], "gen": 0}
+        return _s3_build_churn(n_nodes, n_pods, per_job, alive)
 
     conf = """
 actions: "allocate"
@@ -152,15 +239,93 @@ tiers:
   - name: drf
   - name: binpack
 """
-    measure("3-binpack-drf", build, conf, ("allocate",),
-            lambda c: len(c.binder.binds))
+    measure("3-binpack-drf", factory, conf, ("allocate",),
+            lambda c: len(c.binder.binds), cycles, results)
 
 
-def scenario4(scale):
+def _s3_build_churn(n_nodes, n_pods, per_job, alive):
+    def add_gang(cache, g, base_idx, gen):
+        pg = PodGroup(name=g, namespace="default", queue="default",
+                      min_member=per_job)
+        pg.status.phase = "Inqueue"
+        pg.creation_timestamp = TS0 + base_idx * 1e-6
+        cache.add_pod_group(pg)
+        pods = []
+        for t in range(per_job):
+            i = base_idx + t
+            cpu_m = [250.0, 500.0, 1000.0, 2000.0][i % 4]
+            mem = [256.0, 512.0, 1024.0, 2048.0][(i // 4) % 4] * 2**20
+            pod = PodSpec(name=f"{g}-{t:04d}", namespace="default",
+                          containers=[{"cpu": cpu_m, "memory": mem}],
+                          priority=(base_idx // per_job) % 10,
+                          annotations={GROUP_NAME_ANNOTATION: g})
+            pod.creation_timestamp = TS0 + i * 1e-6
+            cache.add_pod(pod)
+            pods.append(pod)
+        alive["jobs"].append((pg, pods))
+
+    def build():
+        from scheduler_tpu.harness import make_synthetic_cluster
+
+        cluster = make_synthetic_cluster(n_nodes, n_pods, tasks_per_job=per_job)
+        cache = cluster.cache
+        # Track the synthetic jobs for churn (cache jobs carry their pods).
+        for job in cache.jobs.values():
+            pods = [t.pod for t in job.tasks.values()]
+            alive["jobs"].append((job.pod_group, pods))
+        return cache
+
+    def churn(cache, rng, i):
+        k = max(1, len(alive["jobs"]) // 10)
+        idx = rng.choice(len(alive["jobs"]), size=k, replace=False)
+        chosen = sorted(set(idx.tolist()), reverse=True)
+        retiring = [alive["jobs"][j] for j in chosen]
+        for j in chosen:
+            alive["jobs"][j] = alive["jobs"][-1]
+            alive["jobs"].pop()
+        _retire(cache, retiring)
+        alive["gen"] += 1
+        for t in range(k):
+            add_gang(cache, f"churn-{alive['gen']:03d}-{t:04d}",
+                     n_pods + (alive["gen"] * k + t) * per_job, alive["gen"])
+
+    return build, churn
+
+
+# --- scenario 4: two-queue reclaim -------------------------------------------
+
+def scenario4(scale, cycles, results):
     n_nodes = int(1000 * scale)
     n_run = int(25_000 * scale)
     n_pend = int(25_000 * scale)
     gang = 50
+
+    def factory():
+        alive = {"fat": [], "gen": 0, "evicted_seen": 0}
+        return _s4_build_churn(n_nodes, n_run, n_pend, gang, alive)
+
+    conf = """
+actions: "reclaim"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: proportion
+"""
+    measure("4-two-queue-reclaim", factory, conf, ("reclaim",),
+            lambda c: len(c.evictor.evicts), cycles, results)
+
+
+def _s4_build_churn(n_nodes, n_run, n_pend, gang, alive):
+    def add_thin(cache, g):
+        pg = PodGroup(name=g, namespace="d", queue="thin", min_member=1)
+        pg.status.phase = "Inqueue"
+        cache.add_pod_group(pg)
+        for t in range(gang):
+            cache.add_pod(PodSpec(
+                name=f"{g}-{t}", namespace="d",
+                containers=[{"cpu": 2000.0, "memory": 4 * 2**30}],
+                annotations={GROUP_NAME_ANNOTATION: g}))
 
     def build():
         cache = SchedulerCache(vocab=ResourceVocabulary(), async_io=False)
@@ -177,39 +342,78 @@ def scenario4(scale):
             pg = PodGroup(name=g, namespace="d", queue="fat", min_member=1)
             pg.status.phase = "Running"
             cache.add_pod_group(pg)
+            pods = []
             for t in range(gang):
                 i = (j * gang + t) % n_nodes
-                cache.add_pod(PodSpec(
+                pod = PodSpec(
                     name=f"{g}-{t}", namespace="d",
                     containers=[{"cpu": 2000.0, "memory": 4 * 2**30}],
                     annotations={GROUP_NAME_ANNOTATION: g},
-                    node_name=f"n{i:05d}", phase="Running"))
+                    node_name=f"n{i:05d}", phase="Running")
+                cache.add_pod(pod)
+                pods.append(pod)
+            alive["fat"].append((pg, pods))
         for j in range(n_pend // gang):
-            g = f"thin{j}"
-            pg = PodGroup(name=g, namespace="d", queue="thin", min_member=1)
-            pg.status.phase = "Inqueue"
-            cache.add_pod_group(pg)
-            for t in range(gang):
-                cache.add_pod(PodSpec(
-                    name=f"{g}-{t}", namespace="d",
-                    containers=[{"cpu": 2000.0, "memory": 4 * 2**30}],
-                    annotations={GROUP_NAME_ANNOTATION: g}))
+            add_thin(cache, f"thin{j}")
         return cache
 
+    def churn(cache, rng, i):
+        # Evicted fat pods terminate (the server deletes them) and fresh
+        # thin work arrives — reclaim faces new starvation every cycle.
+        evicted = set(cache.evictor.evicts[alive["evicted_seen"]:])
+        alive["evicted_seen"] = len(cache.evictor.evicts)
+        for pg, pods in alive["fat"]:
+            for pod in list(pods):
+                if f"{pod.namespace}/{pod.name}" in evicted:
+                    cache.delete_pod(pod)
+                    pods.remove(pod)
+        alive["gen"] += 1
+        for t in range(max(1, (n_pend // gang) // 10)):
+            add_thin(cache, f"thin-g{alive['gen']}-{t}")
+
+    return build, churn
+
+
+# --- scenario 5: GPU topology gangs ------------------------------------------
+
+def scenario5(scale, cycles, results):
+    n_nodes, n_gangs, gang = int(1500 * scale), int(1000 * scale), 8
+
+    def factory():
+        alive = {"jobs": [], "gen": 0}
+        return _s5_build_churn(n_nodes, n_gangs, gang, alive)
+
     conf = """
-actions: "reclaim"
+actions: "allocate"
 tiers:
 - plugins:
   - name: priority
   - name: gang
-  - name: proportion
+  - name: drf
+  - name: predicates
+  - name: nodeorder
 """
-    measure("4-two-queue-reclaim", build, conf, ("reclaim",),
-            lambda c: len(c.evictor.evicts))
+    measure("5-gpu-topology-gangs", factory, conf, ("allocate",),
+            lambda c: len(c.binder.binds), cycles, results)
 
 
-def scenario5(scale):
-    n_nodes, n_gangs, gang = int(1500 * scale), int(1000 * scale), 8
+def _s5_build_churn(n_nodes, n_gangs, gang, alive):
+    def add_gang(cache, vocab_idx, g, zone):
+        pg = PodGroup(name=g, namespace="d", queue="default", min_member=gang)
+        pg.status.phase = "Inqueue"
+        pg.creation_timestamp = TS0 + vocab_idx
+        cache.add_pod_group(pg)
+        pods = []
+        for t in range(gang):
+            pod = PodSpec(
+                name=f"{g}-{t}", namespace="d",
+                containers=[{"cpu": 4000.0, "memory": 16 * 2**30, GPU: 1.0}],
+                annotations={GROUP_NAME_ANNOTATION: g},
+                node_selector={"zone": zone})
+            pod.creation_timestamp = TS0 + vocab_idx + t * 1e-6
+            cache.add_pod(pod)
+            pods.append(pod)
+        alive["jobs"].append((pg, pods))
 
     def build():
         cache = SchedulerCache(vocab=ResourceVocabulary((GPU,)), async_io=False)
@@ -222,30 +426,24 @@ def scenario5(scale):
                              GPU: 8.0, "pods": 110},
                 labels={"zone": f"z{i % 8}"}))
         for j in range(n_gangs):
-            g = f"train{j}"
-            pg = PodGroup(name=g, namespace="d", queue="default", min_member=gang)
-            pg.status.phase = "Inqueue"
-            cache.add_pod_group(pg)
-            for t in range(gang):
-                cache.add_pod(PodSpec(
-                    name=f"{g}-{t}", namespace="d",
-                    containers=[{"cpu": 4000.0, "memory": 16 * 2**30, GPU: 1.0}],
-                    annotations={GROUP_NAME_ANNOTATION: g},
-                    node_selector={"zone": f"z{j % 8}"}))
+            add_gang(cache, j, f"train{j}", f"z{j % 8}")
         return cache
 
-    conf = """
-actions: "allocate"
-tiers:
-- plugins:
-  - name: priority
-  - name: gang
-  - name: drf
-  - name: predicates
-  - name: nodeorder
-"""
-    measure("5-gpu-topology-gangs", build, conf, ("allocate",),
-            lambda c: len(c.binder.binds))
+    def churn(cache, rng, i):
+        k = max(1, len(alive["jobs"]) // 10)
+        idx = rng.choice(len(alive["jobs"]), size=k, replace=False)
+        chosen = sorted(set(idx.tolist()), reverse=True)
+        retiring = [alive["jobs"][j] for j in chosen]
+        for j in chosen:
+            alive["jobs"][j] = alive["jobs"][-1]
+            alive["jobs"].pop()
+        _retire(cache, retiring)
+        alive["gen"] += 1
+        for t in range(k):
+            gi = n_gangs + alive["gen"] * k + t
+            add_gang(cache, gi, f"train-g{alive['gen']}-{t}", f"z{gi % 8}")
+
+    return build, churn
 
 
 def main():
@@ -254,18 +452,37 @@ def main():
                         help="size multiplier for scenarios 2-5")
     parser.add_argument("--only", default=None,
                         help="comma-separated scenario numbers to run")
+    parser.add_argument("--cycles", type=int, default=20,
+                        help="measured churn cycles per scenario (0 = full cycle only)")
+    parser.add_argument("--out", default=None,
+                        help="write the full results JSON to this path")
     ns = parser.parse_args()
     only = {int(x) for x in ns.only.split(",")} if ns.only else {1, 2, 3, 4, 5}
+    results = []
+    started = time.strftime("%Y-%m-%dT%H:%M:%S")
     if 1 in only:
-        scenario1()
+        scenario1(ns.cycles, results)
     if 2 in only:
-        scenario2(ns.scale)
+        scenario2(ns.scale, ns.cycles, results)
     if 3 in only:
-        scenario3(ns.scale)
+        scenario3(ns.scale, ns.cycles, results)
     if 4 in only:
-        scenario4(ns.scale)
+        scenario4(ns.scale, ns.cycles, results)
     if 5 in only:
-        scenario5(ns.scale)
+        scenario5(ns.scale, ns.cycles, results)
+    if ns.out:
+        import jax
+
+        payload = {
+            "started": started,
+            "scale": ns.scale,
+            "churn_cycles": ns.cycles,
+            "backend": str(jax.devices()[0]),
+            "scenarios": results,
+        }
+        with open(ns.out, "w") as f:
+            json.dump(payload, f, indent=1)
+        print(f"wrote {ns.out}", flush=True)
 
 
 if __name__ == "__main__":

@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 import itertools
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from scheduler_tpu.api import ResourceVocabulary
 from scheduler_tpu.apis import NodeSpec, PodGroup, PodSpec, Queue

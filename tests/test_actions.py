@@ -1,7 +1,6 @@
 """Enqueue / backfill / preempt / reclaim action tests
 (model: reference preempt_test.go, reclaim_test.go, e2e job.go/queue.go)."""
 
-import pytest
 
 import scheduler_tpu.actions  # noqa: F401
 import scheduler_tpu.plugins  # noqa: F401

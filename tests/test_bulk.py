@@ -5,7 +5,6 @@ must end in the SAME state as the per-task ``ssn.allocate``/``ssn.pipeline`` loo
 import os
 
 import numpy as np
-import pytest
 
 import scheduler_tpu.actions  # noqa: F401
 import scheduler_tpu.plugins  # noqa: F401
@@ -86,7 +85,6 @@ def test_bulk_apply_matches_sequential_commit():
 
 def test_bulk_apply_fires_bulk_event_handlers():
     """DRF shares after a bulk commit equal the per-event fold."""
-    from scheduler_tpu.framework.registry import get_plugin_builder
 
     os.environ["SCHEDULER_TPU_BULK"] = "1"
     try:

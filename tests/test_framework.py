@@ -1,6 +1,5 @@
 """Framework tests: conf parsing, tiered dispatch semantics, statement rollback."""
 
-import pytest
 
 from scheduler_tpu.api import TaskStatus
 from scheduler_tpu.cache import SchedulerCache

@@ -4,7 +4,6 @@ import pytest
 
 import scheduler_tpu.actions  # noqa: F401
 import scheduler_tpu.plugins  # noqa: F401
-from scheduler_tpu.api import TaskStatus
 from scheduler_tpu.apis.objects import Affinity, NodeSelectorRequirement, PodAffinityTerm, Taint, Toleration
 from scheduler_tpu.cache import SchedulerCache
 from scheduler_tpu.conf import parse_scheduler_conf

@@ -2,7 +2,6 @@
 loop (the reference's e2e suite shape: real actions + plugins over a fake-backed
 cache; test/e2e/job.go, queue.go, predicates.go, nodeorder.go scenarios)."""
 
-import numpy as np
 
 from scheduler_tpu.cache import SchedulerCache
 from scheduler_tpu.harness import make_synthetic_cluster

@@ -6,7 +6,6 @@ import threading
 import time
 import urllib.request
 
-import pytest
 
 from scheduler_tpu.cache import SchedulerCache
 from scheduler_tpu.options import ServerOption, parse_options

@@ -4,7 +4,6 @@ bind-for-bind and evict-for-evict identical to the reference per-task sweep
 """
 
 import numpy as np
-import pytest
 
 import scheduler_tpu.actions  # noqa: F401
 import scheduler_tpu.plugins  # noqa: F401

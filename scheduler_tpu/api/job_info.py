@@ -786,14 +786,20 @@ class JobInfo:
             self._index.setdefault(status, {})[ti.uid] = view
 
     def bulk_update_status_rows(
-        self, rows: np.ndarray, status: TaskStatus, net_add: Optional[np.ndarray] = None
+        self,
+        rows: np.ndarray,
+        status: TaskStatus,
+        net_add: Optional[np.ndarray] = None,
+        assume_unique: bool = False,
     ) -> None:
         """Vectorized ``update_task_status`` over row indices: one column
         write, O(statuses) count updates, one dense aggregate delta.
 
         ``net_add`` ([R] row, optional): precomputed sum of the batch's resreq
         rows (CommitPlan) — valid only when every row moves from a
-        non-allocated to an allocated status.
+        non-allocated to an allocated status.  ``assume_unique`` skips the
+        duplicate sort for callers whose rows are unique by construction (the
+        device engines place each row at most once per action).
         """
         if len(rows) == 0:
             return
@@ -824,7 +830,7 @@ class JobInfo:
             self._index = None  # rebuilt lazily; views stay valid
             return
         rows = np.asarray(rows)
-        if rows.shape[0] > 1:
+        if rows.shape[0] > 1 and not assume_unique:
             # A repeat in one batch is a no-op the second time (sequential
             # update_task_status would see status already == target).
             rows = np.unique(rows)

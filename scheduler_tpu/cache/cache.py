@@ -462,7 +462,8 @@ class SchedulerCache(Cache):
             per_node: Dict[str, list] = {}
             for cjob, rows, names in resolved:
                 cjob.bulk_update_status_rows(
-                    rows, TaskStatus.BINDING, net_add=job_rows.get(cjob.uid)
+                    rows, TaskStatus.BINDING, net_add=job_rows.get(cjob.uid),
+                    assume_unique=True,  # engine rows: one placement per row
                 )
                 cjob.set_node_names_rows(rows, names)
                 cores_sel = cjob.store.cores[rows]
@@ -476,9 +477,13 @@ class SchedulerCache(Cache):
                     [(cores, TaskStatus.BINDING)], (row, None, row, count, 0)
                 )
 
+        # Chunk against the WHOLE batch: with many jobs there is already
+        # ample parallelism, and per-job sizing degenerates to floor-size
+        # chunks (1000 jobs x 100 rows -> 7000 submissions of 16).
+        total = sum(len(rows) for _cjob, rows, _names in resolved)
+        chunk = max(16, min(self._BIND_CHUNK, -(-total // self._IO_WORKERS)))
         for cjob, rows, names in resolved:
             n = len(rows)
-            chunk = max(16, min(self._BIND_CHUNK, -(-n // self._IO_WORKERS)))
             for start in range(0, n, chunk):
                 self._submit_io(
                     self._bind_chunk_columnar,

@@ -648,8 +648,11 @@ class Session:
             alloc_rows = rows[~pipe]
             pipe_rows = rows[pipe]
             self.cache.allocate_volumes_rows(job, alloc_rows, names[~pipe])
-            job.bulk_update_status_rows(alloc_rows, TS.ALLOCATED, net_add=job_alloc.get(job.uid))
-            job.bulk_update_status_rows(pipe_rows, TS.PIPELINED)
+            job.bulk_update_status_rows(
+                alloc_rows, TS.ALLOCATED, net_add=job_alloc.get(job.uid),
+                assume_unique=True,  # engine rows: one placement per row
+            )
+            job.bulk_update_status_rows(pipe_rows, TS.PIPELINED, assume_unique=True)
             job.set_node_names_rows(rows, names)
             affected.append(job)
 
@@ -676,7 +679,7 @@ class Session:
                 if alloc_rows.shape[0] != alloc_counts.get(job.uid, 0):
                     plan_covers_bind = False
                 self.cache.bind_volumes_rows(job, alloc_rows)
-                job.bulk_update_status_rows(alloc_rows, TS.BINDING)
+                job.bulk_update_status_rows(alloc_rows, TS.BINDING, assume_unique=True)
                 to_bind.append((job, alloc_rows))
                 ready_uids.append(job.uid)
         if to_bind:

@@ -12,13 +12,17 @@ from scheduler_tpu.api.vocab import ResourceVocabulary
 
 
 class ClusterInfo:
-    __slots__ = ("jobs", "nodes", "queues", "vocab")
+    __slots__ = ("jobs", "nodes", "queues", "vocab", "node_generation")
 
     def __init__(self, vocab: ResourceVocabulary) -> None:
         self.vocab = vocab
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
         self.queues: Dict[str, QueueInfo] = {}
+        # The owning cache's node-spec generation AT SNAPSHOT TIME (under the
+        # cache mutex) — consumers keying caches on it must never read the
+        # live counter, which can advance between snapshot and use.
+        self.node_generation: int = -1
 
     def __repr__(self) -> str:
         return (

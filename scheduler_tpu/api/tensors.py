@@ -154,23 +154,43 @@ class SnapshotTensors:
     queue_names: List[str] = field(default_factory=list)
 
 
-def build_node_tensors(
+class NodeStaticCache:
+    """Static node-side tensor columns memoized across cycles.
+
+    Names/labels/taints/allocatable/pods_limit/unschedulable (and the label
+    and taint vocabularies built from them) are pure functions of the node
+    SPECS, which change only through node add/update/delete events; the
+    owner (SchedulerCache) bumps a generation counter on those, and the key
+    carries it.  One entry — cycles share one cluster."""
+
+    __slots__ = ("key", "value")
+
+    def __init__(self) -> None:
+        self.key = None
+        self.value = None
+
+    def get(self, key):
+        return self.value if key == self.key else None
+
+    def put(self, key, value) -> None:
+        self.key, self.value = key, value
+
+
+class _NodeStatic:
+    __slots__ = (
+        "names", "index", "allocatable", "pods_limit", "unschedulable",
+        "labels", "taints", "label_vocab", "taint_vocab",
+    )
+
+
+def _build_node_static(
     nodes: Sequence[NodeInfo],
     vocab: ResourceVocabulary,
     label_vocab: LabelVocab,
     taint_vocab: TaintVocab,
-) -> NodeTensors:
+) -> _NodeStatic:
     n = len(nodes)
     r = vocab.size
-    idle = np.zeros((n, r))
-    releasing = np.zeros((n, r))
-    used = np.zeros((n, r))
-    allocatable = np.zeros((n, r))
-    pods_limit = np.zeros(n, dtype=np.int32)
-    task_count = np.zeros(n, dtype=np.int32)
-    ready = np.zeros(n, dtype=bool)
-    unschedulable = np.zeros(n, dtype=bool)
-
     # First pass registers every node label pair / taint so mask widths are final.
     for ni in nodes:
         if ni.node is not None:
@@ -181,41 +201,72 @@ def build_node_tensors(
             for taint in ni.node.taints:
                 taint_vocab.index(taint)
 
-    labels = np.zeros((n, label_vocab.size), dtype=bool)
-    taints = np.zeros((n, taint_vocab.size), dtype=bool)
-    names: List[str] = []
+    st = _NodeStatic()
+    st.label_vocab = label_vocab
+    st.taint_vocab = taint_vocab
+    st.allocatable = np.zeros((n, r))
+    st.pods_limit = np.zeros(n, dtype=np.int32)
+    st.unschedulable = np.zeros(n, dtype=bool)
+    st.labels = np.zeros((n, label_vocab.size), dtype=bool)
+    st.taints = np.zeros((n, taint_vocab.size), dtype=bool)
+    st.names = []
     for i, ni in enumerate(nodes):
-        names.append(ni.name)
-        idle[i] = _fit(ni.idle.array, r)
-        releasing[i] = _fit(ni.releasing.array, r)
-        used[i] = _fit(ni.used.array, r)
-        allocatable[i] = _fit(ni.allocatable.array, r)
-        pods_limit[i] = ni.pods_limit
-        task_count[i] = ni.task_count  # eager counter: no view materialization
-        ready[i] = ni.ready()
+        st.names.append(ni.name)
+        st.allocatable[i] = _fit(ni.allocatable.array, r)
+        st.pods_limit[i] = ni.pods_limit
         if ni.node is not None:
-            unschedulable[i] = ni.node.unschedulable
+            st.unschedulable[i] = ni.node.unschedulable
             for k, v in ni.node.labels.items():
-                labels[i, label_vocab.index(k, v)] = True
-            labels[i, label_vocab.index("kubernetes.io/hostname", ni.name)] = True
+                st.labels[i, label_vocab.index(k, v)] = True
+            st.labels[i, label_vocab.index("kubernetes.io/hostname", ni.name)] = True
             for taint in ni.node.taints:
                 col = taint_vocab.index(taint)
                 if col is not None:
-                    taints[i, col] = True
+                    st.taints[i, col] = True
+    st.index = {name: i for i, name in enumerate(st.names)}
+    return st
+
+
+def build_node_tensors(
+    nodes: Sequence[NodeInfo],
+    vocab: ResourceVocabulary,
+    label_vocab: LabelVocab,
+    taint_vocab: TaintVocab,
+    static: Optional[_NodeStatic] = None,
+) -> NodeTensors:
+    """``static`` — a memoized ``_NodeStatic`` for this exact node set (same
+    names in the same order); when given, its vocabs REPLACE the passed-in
+    empty ones and only the dynamic columns rebuild."""
+    n = len(nodes)
+    r = vocab.size
+    if static is None:
+        static = _build_node_static(nodes, vocab, label_vocab, taint_vocab)
+
+    idle = np.zeros((n, r))
+    releasing = np.zeros((n, r))
+    used = np.zeros((n, r))
+    task_count = np.zeros(n, dtype=np.int32)
+    ready = np.zeros(n, dtype=bool)
+    for i, ni in enumerate(nodes):
+        idle[i] = _fit(ni.idle.array, r)
+        releasing[i] = _fit(ni.releasing.array, r)
+        used[i] = _fit(ni.used.array, r)
+        task_count[i] = ni.task_count  # eager counter: no view materialization
+        ready[i] = ni.ready()
 
     return NodeTensors(
-        names=names,
-        index={name: i for i, name in enumerate(names)},
+        names=static.names,
+        index=static.index,
         idle=idle,
         releasing=releasing,
         used=used,
-        allocatable=allocatable,
-        pods_limit=pods_limit,
+        allocatable=static.allocatable,
+        pods_limit=static.pods_limit,
         task_count=task_count,
         ready=ready,
-        unschedulable=unschedulable,
-        labels=labels,
-        taints=taints,
+        unschedulable=static.unschedulable,
+        labels=static.labels,
+        taints=static.taints,
     )
 
 
@@ -438,14 +489,32 @@ def build_snapshot_tensors_columnar(
     per_job: Sequence,
     queue_names: List[str],
     vocab: ResourceVocabulary,
+    node_cache: Optional[NodeStaticCache] = None,
+    node_key=None,
 ) -> SnapshotTensors:
     """``build_snapshot_tensors`` with task rows given as ``(job, rows)`` pairs
-    (job-store row indices) instead of TaskInfo objects."""
-    label_vocab = LabelVocab()
-    taint_vocab = TaintVocab()
+    (job-store row indices) instead of TaskInfo objects.  ``node_cache`` +
+    ``node_key`` (e.g. the owning cache's node generation) memoize the static
+    node columns and vocabularies across cycles."""
     node_list = sorted(nodes, key=lambda n: n.name)
     job_list = list(jobs)
-    node_tensors = build_node_tensors(node_list, vocab, label_vocab, taint_vocab)
+    static = (
+        node_cache.get(node_key)
+        if node_cache is not None and node_key is not None
+        else None
+    )
+    if static is None:
+        label_vocab = LabelVocab()
+        taint_vocab = TaintVocab()
+        static = _build_node_static(node_list, vocab, label_vocab, taint_vocab)
+        if node_cache is not None and node_key is not None:
+            node_cache.put(node_key, static)
+    else:
+        label_vocab = static.label_vocab
+        taint_vocab = static.taint_vocab
+    node_tensors = build_node_tensors(
+        node_list, vocab, label_vocab, taint_vocab, static=static
+    )
     job_tensors = build_job_tensors(job_list, queue_names)
     task_tensors = build_task_tensors_columnar(
         per_job, job_tensors, vocab, label_vocab, taint_vocab

@@ -65,6 +65,13 @@ class SchedulerCache(Cache):
         self.mutex = threading.RLock()
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
+        # Node-spec generation + static-tensor memo: the engines' static node
+        # columns (labels/taints/allocatable/...) are pure functions of the
+        # node specs, so they cache across cycles until a node event lands.
+        self.node_generation: int = 0
+        from scheduler_tpu.api.tensors import NodeStaticCache
+
+        self.node_tensor_cache = NodeStaticCache()
         self.queues: Dict[str, QueueInfo] = {}
         self.priority_classes: Dict[str, int] = {}
 
@@ -197,16 +204,19 @@ class SchedulerCache(Cache):
 
     def add_node(self, node: NodeSpec) -> None:
         with self.mutex:
+            self.node_generation += 1
             ni = self._get_or_create_node(node.name)
             ni.set_node(node)
 
     def update_node(self, node: NodeSpec) -> None:
         with self.mutex:
+            self.node_generation += 1
             ni = self._get_or_create_node(node.name)
             ni.set_node(node)
 
     def delete_node(self, node: NodeSpec) -> None:
         with self.mutex:
+            self.node_generation += 1
             self.nodes.pop(node.name, None)
 
     # -- podgroup events ------------------------------------------------------
@@ -259,6 +269,7 @@ class SchedulerCache(Cache):
     def snapshot(self) -> ClusterInfo:
         with self.mutex:
             info = ClusterInfo(self.vocab)
+            info.node_generation = self.node_generation
             for name, node in self.nodes.items():
                 info.nodes[name] = node.clone()
             for name, queue in self.queues.items():

@@ -34,6 +34,7 @@ def open_session(cache, tiers: List[Tier]) -> Session:
         if job.pod_group is not None and job.pod_group.status.conditions:
             ssn.pod_group_status[job.uid] = job.pod_group.status.clone()
     ssn.nodes = snapshot.nodes
+    ssn.node_generation = getattr(snapshot, "node_generation", -1)
     ssn.queues = snapshot.queues
 
     for tier in tiers:

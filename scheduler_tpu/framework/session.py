@@ -130,6 +130,9 @@ class Session:
         self.device_dynamic_task_uids: set = set()
         # job uid -> job_tie_key cache (fixed at first use, see job_tie_key).
         self._job_tie_keys: Dict[str, tuple] = {}
+        # The cache's node-spec generation captured AT SNAPSHOT TIME
+        # (open_session); -1 = unknown (bare Session in tests).
+        self.node_generation: int = -1
 
     # -- registration (Add*Fn) ----------------------------------------------
 

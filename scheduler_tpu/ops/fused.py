@@ -791,8 +791,23 @@ class FusedAllocator:
 
         self.flat_count = t_total
         node_list = sorted(ssn.nodes.values(), key=lambda nd: nd.name)
+        # Static node columns memoize across cycles on the owning cache,
+        # keyed by its node generation (bumped on node events); the session's
+        # clones only feed the dynamic columns.
+        cache_obj = getattr(ssn, "cache", None)
+        node_cache = getattr(cache_obj, "node_tensor_cache", None)
+        snap_gen = getattr(ssn, "node_generation", -1)
+        # The generation captured AT SNAPSHOT TIME, never the live counter: a
+        # node event landing between snapshot and engine build must not file
+        # this session's (stale) specs under the new generation.
+        node_key = (
+            (snap_gen, vocab.size, len(node_list))
+            if node_cache is not None and snap_gen >= 0
+            else None
+        )
         st = build_snapshot_tensors_columnar(
-            node_list, self.jobs, list(zip(self.jobs, self.job_rows)), queue_names, vocab
+            node_list, self.jobs, list(zip(self.jobs, self.job_rows)), queue_names, vocab,
+            node_cache=node_cache, node_key=node_key,
         )
         self.st = st
         self._queues_of_jobs = queues_idx

@@ -198,3 +198,23 @@ def test_multi_cycle_churn_keeps_cache_consistent(seed):
         np.testing.assert_allclose(
             job.allocated.array, expect, atol=1e-6,
             err_msg=f"{job.uid} allocated ledger drifted")
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303, 404, 505, 606])
+def test_sweep_cache_exact_on_random_pipelines(seed, monkeypatch):
+    """The preempt/reclaim sweep memoization (utils/sweep.py) must be
+    bind-for-bind AND evict-for-evict identical to the reference per-task
+    sweep on random full pipelines, not just the fixed scenarios in
+    test_sweep.py."""
+    results = {}
+    for mode in ("1", "0"):
+        monkeypatch.setenv("SCHEDULER_TPU_SWEEP", mode)
+        cache, _, _, _ = random_mixed_cluster(seed)
+        conf = parse_scheduler_conf(CONF)
+        ssn = open_session(cache, conf.tiers)
+        for name in conf.actions:
+            get_action(name).execute(ssn)
+        close_session(ssn)
+        results[mode] = (dict(cache.binder.binds), list(cache.evictor.evicts))
+    assert results["1"][0] == results["0"][0], "binds diverge with sweep cache"
+    assert results["1"][1] == results["0"][1], "evicts diverge with sweep cache"

@@ -88,3 +88,78 @@ def test_hostname_implicit_label():
     assert idx is not None
     assert st.nodes.labels[st.nodes.index["n1"], idx]
     assert not st.nodes.labels[st.nodes.index["n2"], idx]
+
+
+class TestNodeStaticCacheInvalidation:
+    """The cross-cycle node-static tensor memo (NodeStaticCache) must
+    invalidate on node events: label changes, cordons, and node add/delete
+    between cycles must be visible to the next cycle's fused engine."""
+
+    def _conf(self):
+        from scheduler_tpu.conf import parse_scheduler_conf
+
+        return parse_scheduler_conf("""
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: predicates
+""")
+
+    def _cycle(self, cache, conf):
+        import scheduler_tpu.actions  # noqa: F401
+        import scheduler_tpu.plugins  # noqa: F401
+        from scheduler_tpu.framework import close_session, get_action, open_session
+
+        ssn = open_session(cache, conf.tiers)
+        get_action("allocate").execute(ssn)
+        close_session(ssn)
+
+    def test_cordon_and_relabel_between_cycles(self):
+        from scheduler_tpu.cache import SchedulerCache
+        from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
+
+        cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+        cache.run()
+        cache.add_queue(build_queue("default"))
+        cache.add_node(build_node("n0", {"cpu": 8000, "memory": 8 * 1024**3},
+                                  labels={"zone": "a"}))
+        cache.add_node(build_node("n1", {"cpu": 8000, "memory": 8 * 1024**3},
+                                  labels={"zone": "a"}))
+        conf = self._conf()
+
+        cache.add_pod_group(build_pod_group("g1", min_member=1))
+        cache.add_pod(build_pod(name="p1", req={"cpu": 100, "memory": 1024**2},
+                                groupname="g1", selector={"zone": "a"}))
+        self._cycle(cache, conf)  # populates the static memo
+        assert "default/p1" in cache.binder.binds
+
+        # Cordon n0 and move n1 to zone b; a zone-a pod must now be
+        # unschedulable (stale cached labels would still place it).
+        n0 = build_node("n0", {"cpu": 8000, "memory": 8 * 1024**3}, labels={"zone": "a"})
+        n0.unschedulable = True
+        cache.update_node(n0)
+        cache.update_node(build_node("n1", {"cpu": 8000, "memory": 8 * 1024**3},
+                                     labels={"zone": "b"}))
+        cache.add_pod_group(build_pod_group("g2", min_member=1))
+        cache.add_pod(build_pod(name="p2", req={"cpu": 100, "memory": 1024**2},
+                                groupname="g2", selector={"zone": "a"}))
+        self._cycle(cache, conf)
+        assert "default/p2" not in cache.binder.binds
+
+        # A zone-b pod goes to the relabeled n1.
+        cache.add_pod_group(build_pod_group("g3", min_member=1))
+        cache.add_pod(build_pod(name="p3", req={"cpu": 100, "memory": 1024**2},
+                                groupname="g3", selector={"zone": "b"}))
+        self._cycle(cache, conf)
+        assert cache.binder.binds.get("default/p3") == "n1"
+
+        # A new node joins; pods land on it once the old ones are cordoned.
+        n2 = build_node("n2", {"cpu": 8000, "memory": 8 * 1024**3}, labels={"zone": "c"})
+        cache.add_node(n2)
+        cache.add_pod_group(build_pod_group("g4", min_member=1))
+        cache.add_pod(build_pod(name="p4", req={"cpu": 100, "memory": 1024**2},
+                                groupname="g4", selector={"zone": "c"}))
+        self._cycle(cache, conf)
+        assert cache.binder.binds.get("default/p4") == "n2"

@@ -73,9 +73,13 @@ def split_dynamic(ssn, candidates: List[JobInfo]) -> tuple:
     affinity, published per-task by the predicates plugin).  A job with ANY
     dynamic pending task runs entirely through the exact host loop — gang
     arithmetic stays whole-job — while every other job keeps the device
-    engines.  Returns ``(static_jobs, dynamic_jobs)``."""
+    engines.  Jobs with volume claims take the host loop too when a real
+    VolumeBinder is configured: an AllocateVolumes failure must fail only
+    that task's placement (reference session.go:242-247), which the batched
+    commit paths cannot express.  Returns ``(static_jobs, dynamic_jobs)``."""
     dyn_uids = ssn.device_dynamic_task_uids
-    if not dyn_uids:
+    volumes_live = not getattr(ssn.cache.volume_binder, "NOOP", False)
+    if not dyn_uids and not volumes_live:
         return candidates, []
     static_jobs: List[JobInfo] = []
     dynamic_jobs: List[JobInfo] = []
@@ -85,11 +89,19 @@ def split_dynamic(ssn, candidates: List[JobInfo]) -> tuple:
         # protects.  pending_rows() already excludes BestEffort rows, so a
         # dynamic-but-empty-request task cannot de-accelerate (backfill owns
         # those on the host path regardless).
-        rows = job.pending_rows()
-        if rows.shape[0] and dyn_uids.intersection(job.store.uids[rows]):
+        if volumes_live and job.volume_claim_tasks:
             dynamic_jobs.append(job)
-        else:
-            static_jobs.append(job)
+            continue
+        # The rows/uids fancy-indexing only pays off when there ARE dynamic
+        # uids to intersect — with a real VolumeBinder installed (every
+        # connector deployment) this loop runs even when dyn_uids is empty,
+        # and the O(1) volume_claim_tasks check above is all those jobs need.
+        if dyn_uids:
+            rows = job.pending_rows()
+            if rows.shape[0] and dyn_uids.intersection(job.store.uids[rows]):
+                dynamic_jobs.append(job)
+                continue
+        static_jobs.append(job)
     return static_jobs, dynamic_jobs
 
 
@@ -319,14 +331,30 @@ class AllocateAction(Action):
             )
             node = select_best_node(node_scores)
 
-            if task.init_resreq.less_equal(node.idle):
-                ssn.allocate(task, node.name)
-            else:
-                delta = node.idle.clone()
-                delta.fit_delta(task.init_resreq)
-                job.nodes_fit_delta[node.name] = delta
-                if task.init_resreq.less_equal(node.releasing):
-                    ssn.pipeline(task, node.name)
+            # A failed ssn.allocate fails THIS task only — log and move on,
+            # the reference's per-task error handling (allocate.go:169-175).
+            # Two distinct failure points, both healed the same way:
+            # AllocateVolumes raises BEFORE any session mutation (the task
+            # simply stays Pending); a gang-dispatch error raises mid-job
+            # exactly like the reference's dispatch loop returning err
+            # (session.go:286-294) — already-bound siblings stand, the rest
+            # stay Allocated in this session clone only, and the next cycle's
+            # snapshot (built from cache truth) retries them.
+            try:
+                if task.init_resreq.less_equal(node.idle):
+                    ssn.allocate(task, node.name)
+                else:
+                    delta = node.idle.clone()
+                    delta.fit_delta(task.init_resreq)
+                    job.nodes_fit_delta[node.name] = delta
+                    if task.init_resreq.less_equal(node.releasing):
+                        ssn.pipeline(task, node.name)
+            except Exception:
+                logger.exception(
+                    "placement of task %s on %s failed; retried next cycle",
+                    task.uid, node.name,
+                )
+                continue
 
             if ssn.job_ready(job):
                 jobs.push(job)

@@ -115,33 +115,37 @@ class PreemptAction(Action):
                 if assigned:
                     preemptors.push(preemptor_job)
 
-            # Phase 2: preemption between tasks within one job.
-            for job in under_request:
-                while True:
-                    tasks = preemptor_tasks.get(job.uid)
-                    if tasks is None or tasks.empty():
-                        break
-                    preemptor = tasks.pop()
+        # Phase 2: preemption between tasks within one job — ONCE, after every
+        # queue's phase 1 (preempt.go:144-174).  Running it inside the queue
+        # loop would drain a preemptor job's task queue while iterating an
+        # UNRELATED queue, silently disabling cross-job preemption for any
+        # queue that is not first in iteration order.
+        for job in under_request:
+            while True:
+                tasks = preemptor_tasks.get(job.uid)
+                if tasks is None or tasks.empty():
+                    break
+                preemptor = tasks.pop()
 
-                    stmt = ssn.statement()
-                    assigned = self._preempt(
-                        ssn,
-                        stmt,
-                        preemptor,
-                        lambda task: task.status == TaskStatus.RUNNING
-                        and preemptor.job == task.job,
-                        sweep=sweep,
-                        node_gate=(
-                            None
-                            if ledger is None
-                            else lambda node, j=job: ledger.has_own_job_running(
-                                node, j.queue, j.uid
-                            )
-                        ),
-                    )
-                    stmt.commit()
-                    if not assigned:
-                        break
+                stmt = ssn.statement()
+                assigned = self._preempt(
+                    ssn,
+                    stmt,
+                    preemptor,
+                    lambda task: task.status == TaskStatus.RUNNING
+                    and preemptor.job == task.job,
+                    sweep=sweep,
+                    node_gate=(
+                        None
+                        if ledger is None
+                        else lambda node, j=job: ledger.has_own_job_running(
+                            node, j.queue, j.uid
+                        )
+                    ),
+                )
+                stmt.commit()
+                if not assigned:
+                    break
 
     def _preempt(
         self,

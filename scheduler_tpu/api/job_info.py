@@ -519,6 +519,12 @@ class JobInfo:
         self.allocated: ResourceVec = ResourceVec.empty(vocab)
         self.total_request: ResourceVec = ResourceVec.empty(vocab)
 
+        # Tasks mounting PersistentVolumeClaims.  Zero for nearly every job;
+        # the cache's columnar volume hooks skip their per-row Python loop
+        # entirely when it is 0, so claim-free jobs never pay for a real
+        # VolumeBinder being configured.
+        self.volume_claim_tasks: int = 0
+
         self.creation_timestamp: float = 0.0
 
         # Why scheduling failed, for status conditions (job_info.go:150-157).
@@ -714,6 +720,8 @@ class JobInfo:
         if allocated_status(status):
             self.allocated.add(ti.resreq)
         self.total_request.add(ti.resreq)
+        if ti.pod is not None and ti.pod.volume_claims:
+            self.volume_claim_tasks += 1
         if self._views is not None:
             self._views[ti.uid] = ti
         if self._index is not None:
@@ -729,6 +737,8 @@ class JobInfo:
         if allocated_status(status):
             self.allocated.sub(core.resreq)
         self.total_request.sub(core.resreq)
+        if core.pod is not None and core.pod.volume_claims:
+            self.volume_claim_tasks -= 1
         # Detach live views/cores of this row so held refs keep final values.
         if core._blk is st:
             core._detach()
@@ -951,6 +961,7 @@ class JobInfo:
         job._views = None
         job._index = None
         job._counts = dict(self._counts)
+        job.volume_claim_tasks = self.volume_claim_tasks
         job.allocated = self.allocated.clone()
         job.total_request = self.total_request.clone()
         job.nodes_fit_errors = {}

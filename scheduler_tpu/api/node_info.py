@@ -118,6 +118,16 @@ class NodeInfo:
         if node is None or allocatable is None:
             self.state_phase, self.state_reason = NodeState.NOT_READY, "UnInitialized"
             return
+        if node.conditions.get("Ready", "True") != "True":
+            # The kubelet reported NotReady — or stopped heartbeating
+            # (Ready=Unknown); the reference CheckNodeCondition requires
+            # Ready == True (predicates.go:169-177).  The node keeps its
+            # accounting but takes no placements — host predicates raise
+            # "not ready" and the device engines drop it from the node gate,
+            # both via this one phase.  A node with no conditions at all is
+            # schedulable (synthetic/preloaded clusters don't report them).
+            self.state_phase, self.state_reason = NodeState.NOT_READY, "NotReady"
+            return
         if not self.used.less_equal(allocatable):
             # Drift between cache and cluster (OutOfSync, node_info.go:110-134).
             self.state_phase, self.state_reason = NodeState.NOT_READY, "OutOfSync"

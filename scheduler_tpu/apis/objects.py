@@ -113,6 +113,10 @@ class PodGroup:
     min_resources: Optional[Dict[str, float]] = None
     status: PodGroupStatus = field(default_factory=PodGroupStatus)
     creation_timestamp: float = field(default_factory=now)
+    # True for cache-synthesized groups covering bare pods (reference
+    # cache/util.go:30-63).  Shadow groups exist ONLY in this process — a
+    # relist diff against the system of record must never prune them.
+    shadow: bool = False
 
 
 @dataclass
@@ -230,6 +234,9 @@ class PodSpec:
     affinity: Optional[Affinity] = None
     tolerations: List[Toleration] = field(default_factory=list)
     host_ports: List[int] = field(default_factory=list)
+    # PersistentVolumeClaim names this pod mounts; drives the VolumeBinder
+    # allocate/bind RPCs (reference cache.go:189-209 via k8s volumebinder).
+    volume_claims: List[str] = field(default_factory=list)
     scheduler_name: str = ""
     deletion_timestamp: Optional[float] = None
     creation_timestamp: float = field(default_factory=now)

@@ -125,6 +125,7 @@ class SchedulerCache(Cache):
                 namespace=pod.namespace,
                 min_member=1,
                 queue=self.default_queue,
+                shadow=True,
             )
             pg.status.phase = PodGroupPhase.INQUEUE
             job_id = f"{pg.namespace}/{pg.name}"
@@ -170,14 +171,18 @@ class SchedulerCache(Cache):
 
     def update_pod(self, pod: PodSpec) -> None:
         with self.mutex:
-            self._delete_pod_locked(pod)
+            # gc=False: an update is delete+add in one breath — GC'ing a
+            # shadow job in between would re-synthesize its PodGroup with a
+            # fresh creation timestamp on every watch echo, destabilizing
+            # job order (and paying a rebuild) for every bare pod.
+            self._delete_pod_locked(pod, gc=False)
             self._add_pod_locked(pod)
 
     def delete_pod(self, pod: PodSpec) -> None:
         with self.mutex:
             self._delete_pod_locked(pod)
 
-    def _delete_pod_locked(self, pod: PodSpec) -> None:
+    def _delete_pod_locked(self, pod: PodSpec, gc: bool = True) -> None:
         job_id = job_id_for_pod(pod)
         if not job_id:
             # May have been adopted via a shadow PodGroup.
@@ -193,11 +198,17 @@ class SchedulerCache(Cache):
                         self.nodes[task.node_name].remove_task(task)
                     except KeyError:
                         pass
-            self._gc_job(job)
+            if gc:
+                self._gc_job(job)
 
     def _gc_job(self, job: JobInfo) -> None:
-        """Drop finished/empty jobs (the reference's deletedJobs GC queue)."""
-        if job.task_count == 0 and job.pod_group is None:
+        """Drop finished/empty jobs (the reference's deletedJobs GC queue).
+        A shadow PodGroup exists only to cover its one bare pod — once the
+        pod is gone the synthesized group must die with it, or every churned
+        bare pod leaks a permanent empty job into every snapshot."""
+        if job.task_count == 0 and (
+            job.pod_group is None or job.pod_group.shadow
+        ):
             self.jobs.pop(job.uid, None)
 
     # -- node events ---------------------------------------------------------
@@ -263,6 +274,54 @@ class SchedulerCache(Cache):
     def delete_priority_class(self, name: str) -> None:
         with self.mutex:
             self.priority_classes.pop(name, None)
+
+    # -- relist reconciliation --------------------------------------------------
+
+    def prune_absent(
+        self,
+        pod_uids: set,
+        node_names: set,
+        podgroup_keys: set,
+        queue_names: set,
+        priority_class_names: set,
+    ) -> int:
+        """Delete every cached object ABSENT from a full LIST of the system of
+        record.  The reference informer's relist is a store replace
+        (client-go Replace); without this, an object deleted while the watch
+        horizon was lost stays a ghost forever — e.g. a dead pod permanently
+        holding node resources.  Shadow PodGroups are local-only synthesized
+        objects and are never pruned (their pods are, which GCs the group).
+        Returns the number of objects removed."""
+        removed = 0
+        with self.mutex:
+            for job in list(self.jobs.values()):
+                ghost_pods = [
+                    task.pod
+                    for task in list(job.tasks.values())
+                    if task.pod.uid not in pod_uids
+                ]
+                for pod in ghost_pods:
+                    self._delete_pod_locked(pod)
+                    removed += 1
+                pg = job.pod_group
+                if pg is not None and not pg.shadow and \
+                        f"{pg.namespace}/{pg.name}" not in podgroup_keys:
+                    self.delete_pod_group(pg)
+                    removed += 1
+            for name in list(self.nodes):
+                if name not in node_names:
+                    self.node_generation += 1
+                    del self.nodes[name]
+                    removed += 1
+            for name in list(self.queues):
+                if name not in queue_names:
+                    del self.queues[name]
+                    removed += 1
+            for name in list(self.priority_classes):
+                if name not in priority_class_names:
+                    del self.priority_classes[name]
+                    removed += 1
+        return removed
 
     # -- snapshot (cache.go:584-654) -------------------------------------------
 
@@ -429,11 +488,15 @@ class SchedulerCache(Cache):
     def allocate_volumes_rows(self, job, rows, names) -> None:
         if getattr(self.volume_binder, "NOOP", False) or len(rows) == 0:
             return
+        if not job.volume_claim_tasks:
+            return  # claim-free job: no per-row materialization, no RPCs
         for r, name in zip(rows, names):
             self.volume_binder.allocate_volumes(job.view_for_row(int(r)), name)
 
     def bind_volumes_rows(self, job, rows) -> None:
         if getattr(self.volume_binder, "NOOP", False):
+            return
+        if not job.volume_claim_tasks:
             return
         for r in rows:
             self.volume_binder.bind_volumes(job.view_for_row(int(r)))

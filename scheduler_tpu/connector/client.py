@@ -29,12 +29,20 @@ from typing import Optional
 
 from scheduler_tpu.api.vocab import ResourceVocabulary
 from scheduler_tpu.cache.cache import SchedulerCache
-from scheduler_tpu.cache.interface import Binder, BulkBindError, Evictor, StatusUpdater
+from scheduler_tpu.cache.interface import (
+    Binder,
+    BulkBindError,
+    Evictor,
+    StatusUpdater,
+    VolumeBinder,
+)
 from scheduler_tpu.connector.wire import (
     parse_node,
     parse_pod,
     parse_pod_group,
     parse_queue,
+    pod_key,
+    pod_uid,
 )
 
 logger = logging.getLogger("scheduler_tpu.connector")
@@ -94,6 +102,38 @@ class HttpEvictor(Evictor):
         _post(self.base, "/evict", {"namespace": pod.namespace, "name": pod.name})
 
 
+class HttpVolumeBinder(VolumeBinder):
+    """Volume claim RPCs (reference cache.go:189-209: defaultVolumeBinder wraps
+    the k8s volumebinder's AssumePodVolumes/BindPodVolumes API calls).
+
+    Only pods that actually mount claims pay an RPC; a claim-less pod is a
+    local no-op, which keeps claim-free workloads on the zero-RPC fast path.
+    A failed allocate raises (the task's placement aborts and resyncs); a
+    failed bind raises into the bind path's existing resync machinery.
+    """
+
+    def __init__(self, base: str) -> None:
+        self.base = base
+
+    def allocate_volumes(self, task, hostname: str) -> None:
+        claims = task.pod.volume_claims
+        if not claims:
+            return
+        _post(self.base, "/allocate-volumes", {
+            "namespace": task.pod.namespace, "name": task.pod.name,
+            "node": hostname, "claims": list(claims),
+        })
+
+    def bind_volumes(self, task) -> None:
+        claims = task.pod.volume_claims
+        if not claims:
+            return
+        _post(self.base, "/bind-volumes", {
+            "namespace": task.pod.namespace, "name": task.pod.name,
+            "claims": list(claims),
+        })
+
+
 class HttpStatusUpdater(StatusUpdater):
     def __init__(self, base: str) -> None:
         self.base = base
@@ -138,6 +178,11 @@ class ApiConnector:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.synced = threading.Event()
+        # Set when an event failed to apply: the cache may be divergent for
+        # that object, so the loop re-LISTs (full store replace) instead of
+        # silently drifting until an unrelated relist (the reference's
+        # syncTask re-fetch, event_handlers.go:96-114).
+        self._dirty = False
 
     # -- event application ---------------------------------------------------
 
@@ -180,14 +225,17 @@ class ApiConnector:
                 else:
                     cache.add_priority_class(obj["name"], int(obj.get("value", 0)))
         except Exception:
-            logger.exception("failed to apply %s %s event", op, kind)
+            self._dirty = True
+            logger.exception("failed to apply %s %s event; scheduling relist", op, kind)
 
     def list_and_seed(self) -> None:
-        """The initial LIST: seed the cache, remember the watch cursor.  On a
-        RE-list (watch horizon lost), pods apply as updates — stable uids make
-        that an idempotent replace.  (Objects deleted during the horizon gap
-        are reconciled by their next event; a full store-replace diff is the
-        remaining gap vs the reference's informer relist.)"""
+        """The initial LIST: seed the cache, remember the watch cursor.  A
+        RE-list (watch horizon lost) is a full store REPLACE, like the
+        reference informer's relist: pods apply as updates (stable uids make
+        that idempotent), and anything cached that the LIST no longer carries
+        is deleted — an object deleted during the horizon gap (its delete
+        event pruned from the server's bounded history) must not survive as a
+        ghost holding node resources."""
         relist = self.synced.is_set()
         state = _get(self.base, "/state")
         self.seq = int(state.get("seq", 0))
@@ -201,6 +249,18 @@ class ApiConnector:
             self._apply("podgroup", "update" if relist else "add", g)
         for p in state.get("pods", []):
             self._apply("pod", "update" if relist else "add", p)
+        if relist:
+            removed = self.cache.prune_absent(
+                pod_uids={pod_uid(p) for p in state.get("pods", [])},
+                node_names={n["name"] for n in state.get("nodes", [])},
+                podgroup_keys={pod_key(g) for g in state.get("podGroups", [])},
+                queue_names={q["name"] for q in state.get("queues", [])},
+                priority_class_names={
+                    pc["name"] for pc in state.get("priorityClasses", [])
+                },
+            )
+            if removed:
+                logger.warning("relist pruned %d ghost objects", removed)
         self.synced.set()
 
     def _watch_loop(self) -> None:
@@ -224,13 +284,16 @@ class ApiConnector:
                 logger.warning("watch poll failed; retrying", exc_info=True)
                 self._stop.wait(1.0)
                 continue
-            if payload.get("relist"):
+            if payload.get("relist") or self._dirty:
                 # Watch horizon passed our cursor ("resourceVersion too
-                # old"): re-LIST.  Adds/updates re-apply idempotently (stable
-                # uids make update a replace).
+                # old"), or an event failed to apply: re-LIST.  The relist is
+                # a full store replace (upserts + ghost pruning), so either
+                # divergence heals the same way.
+                self._dirty = False
                 try:
                     self.list_and_seed()
                 except Exception:
+                    self._dirty = True
                     logger.warning("relist failed; retrying", exc_info=True)
                     self._stop.wait(1.0)
                 continue
@@ -273,6 +336,7 @@ def connect_cache(
         binder=HttpBinder(base),
         evictor=HttpEvictor(base),
         status_updater=HttpStatusUpdater(base),
+        volume_binder=HttpVolumeBinder(base),
         async_io=async_io,
         io_workers=io_workers,
     )

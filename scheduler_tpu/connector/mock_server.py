@@ -39,6 +39,12 @@ class MockState:
         self.bind_calls = 0
         self.evict_calls = 0
         self.status_updates: List[Dict] = []
+        # PVC ledger: claim -> {"node": ..., "bound": bool}; allocate assigns
+        # the claim to a node (AssumePodVolumes analogue), bind finalizes it
+        # (BindPodVolumes).  A claim already assigned to a DIFFERENT node
+        # conflicts (volume topology), which the scheduler must surface as a
+        # failed allocation.
+        self.volumes: Dict[str, Dict] = {}
 
     @staticmethod
     def key(kind: str, obj: Dict) -> str:
@@ -149,6 +155,10 @@ def make_handler(state: MockState):
                         "seq": state.seq,
                     })
                 return
+            if url.path == "/volumes":
+                with state.lock:
+                    self._json(state.volumes)
+                return
             self._json({"error": "not found"}, 404)
 
         def do_POST(self) -> None:
@@ -205,7 +215,57 @@ def make_handler(state: MockState):
                     state.apply("pod", "delete", pod)
                 self._json({"ok": True})
                 return
-            if url.path in ("/pod-condition", "/podgroup-status"):
+            if url.path == "/allocate-volumes":
+                if state.take_failure("allocate-volumes"):
+                    self._json({"error": "allocate-volumes failed"}, 500)
+                    return
+                node = body["node"]
+                with state.lock:
+                    # Assumed-but-unbound claims may move (the k8s assume
+                    # cache reconciles stale assumptions); only a BOUND claim
+                    # on a different node is a hard topology conflict.
+                    for claim in body.get("claims", []):
+                        entry = state.volumes.get(claim)
+                        if entry is not None and entry["bound"] and entry["node"] != node:
+                            self._json(
+                                {"error": f"claim {claim} bound on {entry['node']}"},
+                                409,
+                            )
+                            return
+                    for claim in body.get("claims", []):
+                        entry = state.volumes.get(claim)
+                        if entry is None or not entry["bound"]:
+                            state.volumes[claim] = {"node": node, "bound": False}
+                self._json({"ok": True})
+                return
+            if url.path == "/bind-volumes":
+                if state.take_failure("bind-volumes"):
+                    self._json({"error": "bind-volumes failed"}, 500)
+                    return
+                with state.lock:
+                    for claim in body.get("claims", []):
+                        entry = state.volumes.get(claim)
+                        if entry is None:
+                            self._json({"error": f"claim {claim} never allocated"}, 409)
+                            return
+                        entry["bound"] = True
+                self._json({"ok": True})
+                return
+            if url.path == "/podgroup-status":
+                with state.lock:
+                    state.status_updates.append(body)
+                    key = f"{body.get('namespace', 'default')}/{body['name']}"
+                    pg = state.objects["podgroup"].get(key)
+                # Status updates land on the stored object and echo on the
+                # watch stream — the scheduler's own phase write (e.g.
+                # Pending -> Inqueue at enqueue) must survive a relist.
+                if pg is not None and body.get("phase"):
+                    pg = dict(pg)
+                    pg["phase"] = body["phase"]
+                    state.apply("podgroup", "update", pg)
+                self._json({"ok": True})
+                return
+            if url.path == "/pod-condition":
                 with state.lock:
                     state.status_updates.append(body)
                 self._json({"ok": True})

@@ -9,11 +9,14 @@ the API server (``pkg/apis/scheduling/v1alpha1/types.go``).
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List, Optional
 
 from scheduler_tpu.apis.objects import (
     GROUP_NAME_ANNOTATION,
+    Affinity,
+    NodeSelectorRequirement,
     NodeSpec,
+    PodAffinityTerm,
     PodGroup,
     PodSpec,
     Queue,
@@ -31,6 +34,15 @@ def parse_queue(q: Dict) -> Queue:
 
 
 def parse_node(n: Dict) -> NodeSpec:
+    # Conditions arrive either as {type: status} or k8s-style
+    # [{"type": ..., "status": ...}] — both normalize to the dict form the
+    # predicates plugin checks (ready / memory / disk / PID pressure;
+    # reference predicates.go:169-276).
+    raw_conds = n.get("conditions", {})
+    if isinstance(raw_conds, list):
+        conditions = {c["type"]: str(c.get("status", "True")) for c in raw_conds}
+    else:
+        conditions = {k: str(v) for k, v in raw_conds.items()}
     return NodeSpec(
         name=n["name"],
         allocatable={k: float(v) for k, v in n.get("allocatable", {}).items()},
@@ -41,7 +53,84 @@ def parse_node(n: Dict) -> NodeSpec:
         labels=n.get("labels", {}),
         taints=[Taint(**t) for t in n.get("taints", [])],
         unschedulable=bool(n.get("unschedulable", False)),
+        conditions=conditions,
     )
+
+
+def _parse_requirement(r: Dict) -> NodeSelectorRequirement:
+    return NodeSelectorRequirement(
+        key=r["key"],
+        operator=r.get("operator", "In"),
+        values=[str(v) for v in r.get("values", [])],
+    )
+
+
+def _parse_pod_affinity_terms(terms: List[Dict]) -> List[PodAffinityTerm]:
+    return [
+        PodAffinityTerm(
+            label_selector={k: str(v) for k, v in t.get("labelSelector", {}).items()},
+            topology_key=t.get("topologyKey", "kubernetes.io/hostname"),
+            namespaces=list(t.get("namespaces", [])),
+        )
+        for t in terms
+    ]
+
+
+def parse_affinity(a: Optional[Dict]) -> Optional[Affinity]:
+    """Affinity wire schema → Affinity.
+
+    ``nodeAffinity.required`` is a list of term groups (OR across groups, AND
+    within — nodeSelectorTerms semantics); ``preferred`` is
+    ``[{"weight": W, "terms": [...]}]``; ``podAffinity`` / ``podAntiAffinity``
+    are lists of ``{"labelSelector", "topologyKey", "namespaces"}`` terms
+    (reference predicates.go:278-296 consumes the same shapes from the pod spec).
+    """
+    if not a:
+        return None
+    node = a.get("nodeAffinity", {})
+    return Affinity(
+        node_required=[
+            [_parse_requirement(r) for r in group]
+            for group in node.get("required", [])
+        ],
+        node_preferred=[
+            (int(p.get("weight", 1)), [_parse_requirement(r) for r in p.get("terms", [])])
+            for p in node.get("preferred", [])
+        ],
+        pod_affinity=_parse_pod_affinity_terms(a.get("podAffinity", [])),
+        pod_anti_affinity=_parse_pod_affinity_terms(a.get("podAntiAffinity", [])),
+    )
+
+
+def encode_affinity(a: Optional[Affinity]) -> Optional[Dict]:
+    """Inverse of ``parse_affinity`` (used by workload drivers and tests)."""
+    if a is None:
+        return None
+    return {
+        "nodeAffinity": {
+            "required": [
+                [{"key": r.key, "operator": r.operator, "values": list(r.values)}
+                 for r in group]
+                for group in a.node_required
+            ],
+            "preferred": [
+                {"weight": w,
+                 "terms": [{"key": r.key, "operator": r.operator, "values": list(r.values)}
+                           for r in reqs]}
+                for w, reqs in a.node_preferred
+            ],
+        },
+        "podAffinity": [
+            {"labelSelector": dict(t.label_selector), "topologyKey": t.topology_key,
+             "namespaces": list(t.namespaces)}
+            for t in a.pod_affinity
+        ],
+        "podAntiAffinity": [
+            {"labelSelector": dict(t.label_selector), "topologyKey": t.topology_key,
+             "namespaces": list(t.namespaces)}
+            for t in a.pod_anti_affinity
+        ],
+    }
 
 
 def parse_pod_group(g: Dict) -> PodGroup:
@@ -80,13 +169,23 @@ def parse_pod(p: Dict, default_scheduler: str = "volcano") -> PodSpec:
     # uid, so a fresh uid per watch echo would duplicate the task on every
     # update and make deletes no-ops.  The server's uid wins; absent one,
     # namespace/name IS the identity (unique in any consistent store).
-    pod.uid = p["uid"] if p.get("uid") else pod_key(p)
+    pod.uid = pod_uid(p)
     if p.get("creationTimestamp") is not None:
         pod.creation_timestamp = float(p["creationTimestamp"])
     if p.get("hostPorts"):
         pod.host_ports = [int(x) for x in p["hostPorts"]]
+    if p.get("affinity"):
+        pod.affinity = parse_affinity(p["affinity"])
+    if p.get("volumeClaims"):
+        pod.volume_claims = [str(c) for c in p["volumeClaims"]]
     return pod
 
 
 def pod_key(obj: Dict) -> str:
     return f"{obj.get('namespace', 'default')}/{obj['name']}"
+
+
+def pod_uid(obj: Dict) -> str:
+    """The wire identity rule, shared by ``parse_pod`` and the relist diff —
+    the two MUST agree or a relist would prune live pods as ghosts."""
+    return obj["uid"] if obj.get("uid") else pod_key(obj)

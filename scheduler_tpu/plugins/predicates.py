@@ -271,6 +271,9 @@ class PredicatesPlugin(Plugin):
             if aff_rows:
                 rows = jnp.asarray(np.asarray(aff_rows, dtype=np.int32))
                 corr = jnp.asarray(np.stack(aff_masks))
+                # The pallas kernel path may hand back a host numpy mask;
+                # the functional .at update needs a jnp array either way.
+                mask = jnp.asarray(mask)
                 mask = mask.at[rows].set(mask[rows] & corr)
             # Pressure gates.
             if pressure_checks:

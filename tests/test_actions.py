@@ -101,6 +101,35 @@ class TestPreempt:
         assert cache.evictor.evicts == []
         close_session(ssn)
 
+    def test_preempt_fires_when_queue_is_not_first(self):
+        """Regression: phase 2 (intra-job) must run AFTER phase 1 finished for
+        every queue (preempt.go:144-174).  When it ran inside the queue loop,
+        iterating an unrelated first queue drained the preemptor's task queue
+        through the (victimless) intra-job path, silently disabling cross-job
+        preemption for any queue not first in iteration order."""
+        cache = fresh_cache()
+        cache.add_queue(build_queue("q1"))
+        cache.add_queue(build_queue("q2"))
+        # q1 job seen FIRST so q1 enters the queue iteration before q2.
+        cache.add_node(build_node("n0", {"cpu": 1000, "memory": 1024**3}))
+        cache.add_pod_group(build_pod_group("other", min_member=1, queue="q1"))
+        cache.add_pod(build_pod(name="other-0", req={"cpu": 1000, "memory": 1024**2},
+                                groupname="other", nodename="n0", phase="Running"))
+        cache.add_node(build_node("n1", {"cpu": 2000, "memory": 2 * 1024**3}))
+        cache.add_pod_group(build_pod_group("lo", min_member=1, queue="q2"))
+        for i in range(2):
+            cache.add_pod(build_pod(name=f"lo-{i}", req={"cpu": 1000, "memory": 1024**2},
+                                    groupname="lo", nodename="n1", phase="Running", priority=1))
+        cache.add_pod_group(build_pod_group("hi", min_member=1, queue="q2"))
+        cache.add_pod(build_pod(name="hi-0", req={"cpu": 1000, "memory": 1024**2},
+                                groupname="hi", priority=10))
+        ssn = run_action(cache, "preempt", PREEMPT_CONF)
+        assert len(cache.evictor.evicts) == 1
+        assert cache.evictor.evicts[0].startswith("default/lo-")
+        preemptor = next(iter(ssn.jobs["default/hi"].tasks.values()))
+        assert preemptor.status == TaskStatus.PIPELINED
+        close_session(ssn)
+
     def test_statement_rollback_on_insufficient_gang(self):
         # Preemptor gang needs 2 slots but only 1 victim is takeable (the other
         # slot belongs to a 2-member gang the gang plugin vetoes breaking) ->

@@ -50,6 +50,15 @@ def _fused_enabled() -> bool:
     return os.environ.get("SCHEDULER_TPU_FUSED", "1") not in ("0", "false")
 
 
+def _strict_order() -> bool:
+    """Opt out of the static-first device pass: with mixed static/dynamic
+    jobs the device engines place all static jobs before any dynamic one,
+    which can hand resources to a lower-priority static job (documented
+    deviation from allocate.go:95-133's single interleaved order).  Strict
+    mode routes the whole session through the exact host loop instead."""
+    return os.environ.get("SCHEDULER_TPU_STRICT_ORDER", "0") in ("1", "true")
+
+
 def collect_candidates(ssn) -> List[JobInfo]:
     """Jobs eligible for this allocate pass (the allocate.go:49-72 filter):
     skip PodGroup-Pending jobs, JobValid vetoes, and jobs whose queue is gone."""
@@ -164,6 +173,11 @@ class AllocateAction(Action):
             from scheduler_tpu.ops.fused import FusedAllocator
 
             static_jobs, dynamic_jobs = split_dynamic(ssn, candidates)
+            if dynamic_jobs and _strict_order():
+                # The user asked for the reference's exact interleaved job
+                # order across static and dynamic jobs: one host loop for all.
+                self._heap_loop(ssn, candidates, None)
+                return
             if _fused_enabled() and FusedAllocator.supported(ssn, static_jobs):
                 # Whole-action fusion: queue/job selection AND every task
                 # placement in one device program, one readback.

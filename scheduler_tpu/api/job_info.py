@@ -297,9 +297,12 @@ class _TaskRows:
         "creation",
         "resreq_empty",
         "has_scalars",
+        "constrained",
         "req_matrix",
         "init_req_matrix",
         "sigs",
+        "sig_codes",
+        "uid_rank",
         "gen",
         "sig_gen",
         "dead",
@@ -321,12 +324,21 @@ class _TaskRows:
         self.creation = np.zeros(cap, dtype=np.float64)
         self.resreq_empty = np.zeros(cap, dtype=bool)
         self.has_scalars = np.zeros(cap, dtype=bool)
+        # Pod carries a node selector or tolerations: the tensor builders'
+        # per-pod label/toleration extraction only walks constrained rows —
+        # the typical 100k-task cycle has none and skips the loop entirely.
+        self.constrained = np.zeros(cap, dtype=bool)
         # Request matrices are maintained INCREMENTALLY at append time (the
         # cost rides event ingestion, not the scheduling cycle); they only
         # rebuild wholesale at compaction.  Signatures build lazily per cycle.
         self.req_matrix = np.zeros((cap, r_dim), dtype=np.float64)
         self.init_req_matrix = np.zeros((cap, r_dim), dtype=np.float64)
         self.sigs: Optional[List[bytes]] = None
+        # Numeric sort keys derived with the signatures (same validity): the
+        # per-cycle task-order sort is a 4-key np.lexsort instead of a Python
+        # tuple sort over 100k lambda calls.
+        self.sig_codes: Optional[np.ndarray] = None  # i64, order-isomorphic to sigs
+        self.uid_rank: Optional[np.ndarray] = None   # i64, order-isomorphic to uids
         self.gen = 0
         self.sig_gen = -1
         self.dead = 0
@@ -337,7 +349,7 @@ class _TaskRows:
     def _grow(self) -> None:
         cap = max(16, 2 * self.status.shape[0])
         for slot in ("status", "node_name", "volume_ready", "priority", "creation",
-                     "resreq_empty", "has_scalars", "cores", "uids"):
+                     "resreq_empty", "has_scalars", "constrained", "cores", "uids"):
             old = getattr(self, slot)
             new = np.zeros(cap, dtype=old.dtype) if old.dtype != object else np.empty(cap, dtype=object)
             new[: old.shape[0]] = old
@@ -375,6 +387,10 @@ class _TaskRows:
         self.creation[row] = core.pod.creation_timestamp
         self.resreq_empty[row] = bool(core.resreq_empty)
         self.has_scalars[row] = core.resreq.has_scalars
+        pod = core.pod
+        self.constrained[row] = bool(
+            pod is not None and (pod.node_selector or pod.tolerations)
+        )
         arr = core.resreq.array
         if arr.shape[0] > self.r_dim:
             self._widen(arr.shape[0])
@@ -410,9 +426,12 @@ class _TaskRows:
         blk.creation = self.creation
         blk.resreq_empty = self.resreq_empty
         blk.has_scalars = self.has_scalars
+        blk.constrained = self.constrained
         blk.req_matrix = self.req_matrix
         blk.init_req_matrix = self.init_req_matrix
         blk.sigs = self.sigs
+        blk.sig_codes = self.sig_codes
+        blk.uid_rank = self.uid_rank
         blk.gen = self.gen
         blk.sig_gen = self.sig_gen
         blk.dead = self.dead
@@ -438,6 +457,22 @@ class _TaskRows:
             req_buf[i * item : (i + 1) * item] + init_buf[i * item : (i + 1) * item]
             for i in range(n)
         ]
+        # Numeric companions (same validity window): sig_codes ranks rows by
+        # the SAME bytes the sigs compare as (memcmp over the concatenated
+        # row == bytes.__lt__), uid_rank ranks uid strings — so a lexsort
+        # over (codes, ranks) orders exactly like the tuple sort over
+        # (sigs, uids), but in C per cycle instead of Python per task.
+        if n:
+            self.sig_codes, _ = unique_row_codes(
+                np.concatenate([self.req_matrix[:n], self.init_req_matrix[:n]], axis=1)
+            )
+            order = np.argsort(self.uids[:n], kind="stable")
+            rank = np.empty(n, dtype=np.int64)
+            rank[order] = np.arange(n, dtype=np.int64)
+            self.uid_rank = rank
+        else:
+            self.sig_codes = np.zeros(0, dtype=np.int64)
+            self.uid_rank = np.zeros(0, dtype=np.int64)
         self.sig_gen = self.gen
 
     def _compact(self, views: Optional[Dict[str, TaskInfo]]) -> None:
@@ -454,6 +489,7 @@ class _TaskRows:
         creation = np.zeros(cap, dtype=np.float64)
         resreq_empty = np.zeros(cap, dtype=bool)
         has_scalars = np.zeros(cap, dtype=bool)
+        constrained = np.zeros(cap, dtype=bool)
         req = np.zeros((cap, self.r_dim), dtype=np.float64)
         init = np.zeros((cap, self.r_dim), dtype=np.float64)
         cores = np.empty(cap, dtype=object)
@@ -467,6 +503,7 @@ class _TaskRows:
             creation[new_row] = self.creation[old_row]
             resreq_empty[new_row] = self.resreq_empty[old_row]
             has_scalars[new_row] = self.has_scalars[old_row]
+            constrained[new_row] = self.constrained[old_row]
             req[new_row] = self.req_matrix[old_row]
             init[new_row] = self.init_req_matrix[old_row]
             core = self.cores[old_row]
@@ -487,6 +524,7 @@ class _TaskRows:
         self.creation = creation
         self.resreq_empty = resreq_empty
         self.has_scalars = has_scalars
+        self.constrained = constrained
         self.req_matrix = req
         self.init_req_matrix = init
         self.cores = cores
@@ -494,8 +532,25 @@ class _TaskRows:
         self.row_of = row_of
         self.dead = 0
         self.sigs = None
+        self.sig_codes = None
+        self.uid_rank = None
         self.sig_gen = -1
         self.gen += 1
+
+
+def unique_row_codes(matrix: np.ndarray):
+    """``(codes, unique_rows)`` for a 2-D array: rows ranked by memcmp over
+    their raw bytes (the void-view trick — identical ordering to comparing
+    the rows' ``tobytes()``).  One definition shared by the task-store sort
+    keys and the mega-kernel's request-signature table, so a subtlety fix
+    (e.g. -0.0 bytes) lands in both."""
+    both = np.ascontiguousarray(matrix)
+    voids = both.view(np.dtype((np.void, both.shape[1] * both.itemsize))).ravel()
+    uniq, inverse = np.unique(voids, return_inverse=True)
+    uniq_rows = np.ascontiguousarray(uniq).view(both.dtype).reshape(
+        uniq.shape[0], both.shape[1]
+    )
+    return inverse.astype(np.int64), uniq_rows
 
 
 class JobInfo:
@@ -609,19 +664,15 @@ class JobInfo:
         if rows.shape[0] <= 1:
             return rows
         st = self._store
-        if not st.sigs_valid():
+        if not st.sigs_valid() or st.sig_codes is None:
             st.build_sigs()
-        sigs = st.sigs
-        uids = st.uids
-        rl = rows.tolist()
+        # Numeric 4-key lexsort (primary key LAST): total order — the unique
+        # uid rank breaks every tie — so the result is bit-identical to the
+        # old per-task Python tuple sort, amortized to a C sort per cycle.
+        keys = [st.uid_rank[rows], st.creation[rows], st.sig_codes[rows]]
         if use_priority:
-            prio = st.priority
-            creation = st.creation
-            rl.sort(key=lambda r: (-prio[r], sigs[r], creation[r], uids[r]))
-        else:
-            creation = st.creation
-            rl.sort(key=lambda r: (sigs[r], creation[r], uids[r]))
-        return np.asarray(rl, dtype=np.int64)
+            keys.append(-st.priority[rows])
+        return rows[np.lexsort(tuple(keys))]
 
     def status_sum(self, statuses: Sequence[TaskStatus]):
         """(dense [R] resreq sum, ORed has_scalars) over live tasks in the given

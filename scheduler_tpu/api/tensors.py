@@ -395,22 +395,28 @@ def build_task_tensors_columnar(
         priority[base : base + n] = st.priority[rows]
         creation[base : base + n] = st.creation[rows]
         uids.extend(st.uids[rows].tolist())
-        cores_sel = st.cores[rows].tolist()
-        for k, core in enumerate(cores_sel):
-            pod = core.pod
-            sel = pod.node_selector
-            if sel:
-                for key, value in sel.items():
-                    idx = label_vocab.lookup(key, value)
-                    if idx is None:
-                        has_unknown[base + k] = True
-                    else:
-                        selector[base + k, idx] = True
-            if taints:
-                tols = pod.tolerations
-                for col, taint in enumerate(taints):
-                    if any(tol.tolerates(taint) for tol in tols):
-                        tolerated[base + k, col] = True
+        # Only rows whose pod carries a selector or tolerations need the
+        # per-pod extraction walk; an unconstrained pod contributes exactly
+        # the zero rows these arrays are initialized to.
+        cons = st.constrained[rows]
+        if cons.any():
+            sub = np.nonzero(cons)[0]
+            cores_sel = st.cores[rows[sub]].tolist()
+            for k, core in zip(sub.tolist(), cores_sel):
+                pod = core.pod
+                sel = pod.node_selector
+                if sel:
+                    for key, value in sel.items():
+                        idx = label_vocab.lookup(key, value)
+                        if idx is None:
+                            has_unknown[base + k] = True
+                        else:
+                            selector[base + k, idx] = True
+                if taints:
+                    tols = pod.tolerations
+                    for col, taint in enumerate(taints):
+                        if any(tol.tolerates(taint) for tol in tols):
+                            tolerated[base + k, col] = True
         base += n
 
     best_effort = np.all(init_resreq < mins[None, :], axis=1)

@@ -56,23 +56,28 @@ class MockState:
 
     def apply(self, kind: str, op: str, obj: Dict) -> None:
         with self.lock:
-            key = self.key(kind, obj)
-            if kind == "pod" and not obj.get("uid"):
-                # The system of record assigns identity (k8s UID analogue):
-                # every later event for this pod carries the same uid.
-                obj = dict(obj)
-                obj["uid"] = f"wire-{key}"
-            if op == "delete":
-                obj = self.objects[kind].pop(key, obj)
-            else:
-                self.objects[kind][key] = obj
-            self.seq += 1
-            self.events.append({"seq": self.seq, "kind": kind, "op": op, "object": obj})
-            # Bounded history: watchers older than the horizon must re-list
-            # (the "resourceVersion too old" analogue).
-            if len(self.events) > 10_000:
-                del self.events[:5_000]
-            self.lock.notify_all()
+            self.apply_locked(kind, op, obj)
+
+    def apply_locked(self, kind: str, op: str, obj: Dict) -> None:
+        """``apply`` body for callers already holding the lock (read-modify-
+        write sequences must be atomic under ThreadingHTTPServer)."""
+        key = self.key(kind, obj)
+        if kind == "pod" and not obj.get("uid"):
+            # The system of record assigns identity (k8s UID analogue):
+            # every later event for this pod carries the same uid.
+            obj = dict(obj)
+            obj["uid"] = f"wire-{key}"
+        if op == "delete":
+            obj = self.objects[kind].pop(key, obj)
+        else:
+            self.objects[kind][key] = obj
+        self.seq += 1
+        self.events.append({"seq": self.seq, "kind": kind, "op": op, "object": obj})
+        # Bounded history: watchers older than the horizon must re-list
+        # (the "resourceVersion too old" analogue).
+        if len(self.events) > 10_000:
+            del self.events[:5_000]
+        self.lock.notify_all()
 
     def take_failure(self, op: str) -> bool:
         with self.lock:
@@ -252,17 +257,19 @@ def make_handler(state: MockState):
                 self._json({"ok": True})
                 return
             if url.path == "/podgroup-status":
+                # Status updates land on the stored object and echo on the
+                # watch stream — the scheduler's own phase write (e.g.
+                # Pending -> Inqueue at enqueue) must survive a relist.  The
+                # read-copy-apply runs under ONE lock hold: a concurrent
+                # object update must not be overwritten by a stale snapshot.
                 with state.lock:
                     state.status_updates.append(body)
                     key = f"{body.get('namespace', 'default')}/{body['name']}"
                     pg = state.objects["podgroup"].get(key)
-                # Status updates land on the stored object and echo on the
-                # watch stream — the scheduler's own phase write (e.g.
-                # Pending -> Inqueue at enqueue) must survive a relist.
-                if pg is not None and body.get("phase"):
-                    pg = dict(pg)
-                    pg["phase"] = body["phase"]
-                    state.apply("podgroup", "update", pg)
+                    if pg is not None and body.get("phase"):
+                        pg = dict(pg)
+                        pg["phase"] = body["phase"]
+                        state.apply_locked("podgroup", "update", pg)
                 self._json({"ok": True})
                 return
             if url.path == "/pod-condition":

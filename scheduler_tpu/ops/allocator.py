@@ -91,6 +91,11 @@ def build_static_tensors(ssn, st: SnapshotTensors, n_bucket: int):
     score = np.zeros((t_count, st.nodes.count), dtype=np.float32)
     for name, builder in ssn.device_scorers.items():
         score = score + np.asarray(builder(st), dtype=np.float32)
+    # Clamp to finite values ONCE here: the engines' any-feasible check reads
+    # the winner's masked score against -inf, so a feasible node whose custom
+    # scorer emitted -inf/NaN must not be mistaken for masked-out.  Doing it
+    # at build time keeps the per-step loop body free of the extra ops.
+    score = np.nan_to_num(score, nan=0.0, posinf=1e30, neginf=-1e30)
     score = np.asarray(pad_rows(score.T, n_bucket, fill=0.0)).T
     return mask, score
 
@@ -108,6 +113,8 @@ def build_static_tensors_device(ssn, st: SnapshotTensors, n_bucket: int, t_bucke
     score = jnp.zeros((t_count, n), dtype=jnp.float32)
     for name, builder in ssn.device_scorers.items():
         score = score + jnp.asarray(builder(st), dtype=jnp.float32)
+    # One-time finite clamp (see build_static_tensors) — never in the loop.
+    score = jnp.nan_to_num(score, nan=0.0, posinf=1e30, neginf=-1e30)
     mask = jnp.pad(
         mask,
         ((0, t_bucket - mask.shape[0]), (0, n_bucket - n)),

@@ -92,7 +92,7 @@ _KNOWN_JOB_ORDER = ("priority", "gang", "drf")
     static_argnames=(
         "comparators", "queue_comparators", "overused_gate", "use_static",
         "n_queues", "weights", "enforce_pod_count", "window", "batch_runs",
-        "sorted_jobs", "has_releasing",
+        "sorted_jobs", "has_releasing", "step_kernel",
     ),
 )
 def fused_allocate(
@@ -146,6 +146,7 @@ def fused_allocate(
     batch_runs: bool = False,
     sorted_jobs: bool = False,
     has_releasing: bool = True,
+    step_kernel: bool = False,
 ):
     n = idle.shape[0]
     t_cap = resreq.shape[0]
@@ -175,6 +176,13 @@ def fused_allocate(
     # runner-up (ties broken by lowest index, same as the sequential argmax).
     binpack_only = weights[0] == 0.0 and weights[1] == 0.0 and weights[2] > 0.0
     score_bound = batch_runs and not binpack_only
+    # Fused selection kernel (pallas): fit+score+mask+argmax as ONE launch per
+    # micro-step (ops/pallas_kernels.make_placement_step).  Valid only without
+    # releasing resources (no pipeline arm to disambiguate) and without the
+    # top-2 score bound (which needs the full masked-score vector on the XLA
+    # side).  The caller gates on backend/VMEM support; this re-gate keeps an
+    # inconsistent flag from tracing a broken program.
+    step_kernel = step_kernel and not has_releasing and not score_bound
 
     if cross_batch:
         # Pad the job axis so the [MAX_BATCH]-row slice update never clamps
@@ -207,6 +215,34 @@ def fused_allocate(
     # single packed row makes each step ONE job scatter instead of two.)
     r_dim = resreq.shape[1]
     pods_limit_f = pods_limit.astype(jnp.float32)
+    if step_kernel:
+        # Kernel-mode layout: everything node-sided transposes ONCE here
+        # ([R, N]: resources on sublanes, nodes on lanes) so the per-step
+        # kernel reads its blocks without per-step transposes.  Request pad
+        # rows carry -1 (always "fits": idle >= 0 > -1) so the all-dims fit
+        # reduction ignores them; req pads 0 (no score contribution).
+        from scheduler_tpu.api.vocab import CPU as _CPU_IDX, MEMORY as _MEM_IDX
+        from scheduler_tpu.ops import pallas_kernels as _pk
+
+        r8 = -(-r_dim // 8) * 8
+        initq_T = jnp.concatenate(
+            [init_resreq.T,
+             jnp.full((r8 - r_dim, t_cap), -1.0, init_resreq.dtype)], axis=0)
+        req_T = jnp.concatenate(
+            [resreq.T, jnp.zeros((r8 - r_dim, t_cap), resreq.dtype)], axis=0)
+        mins_c = jnp.concatenate(
+            [mins, jnp.zeros(r8 - r_dim, mins.dtype)])[:, None]
+        alloc_T = jnp.concatenate(
+            [allocatable.T, jnp.zeros((r8 - r_dim, n), allocatable.dtype)],
+            axis=0)
+        gate2d = node_gate[None, :]
+        plim2d = pods_limit_f[None, :]
+        smask_dummy = jnp.ones((1, n), dtype=bool)
+        sscore_dummy = jnp.zeros((1, n), dtype=jnp.float32)
+        step_call = _pk.make_placement_step(
+            r_dim, r8, n, weights, use_static, enforce_pod_count,
+            _CPU_IDX, _MEM_IDX, interpret=_pk._interpret(),
+        )
     job_task_num_f = job_task_num.astype(jnp.float32)
     job_gang_order_f = job_gang_order.astype(jnp.float32)
     job_deficit_f = job_deficit.astype(jnp.float32)
@@ -314,7 +350,7 @@ def fused_allocate(
         semantics are IDENTICAL to window=1 — this is pure unrolling; a
         micro-step whose job pool is exhausted is a masked no-op)."""
         (node_state, job_state, q_alloc, cur, out, steps, cursor, n_dirty) = state
-        idle = node_state[:, :r_dim]
+        idle = None if step_kernel else node_state[:, :r_dim]
 
         # Selection only runs when the previous pop ended (lax.cond, not
         # where): most steps continue the current job, and the comparator
@@ -361,7 +397,26 @@ def fused_allocate(
         init_req = init_resreq[t_idx]
         req = resreq[t_idx]
 
-        if has_releasing:
+        if step_kernel:
+            # The whole selection stage — epsilon fit, gates, static mask,
+            # dynamic+static score, masked lowest-index argmax — is ONE
+            # kernel launch; the loop body keeps only gathers, the batch-fit
+            # block, the ledger scatters, and scalar bookkeeping.
+            initq_c = jax.lax.dynamic_slice(initq_T, (0, t_idx), (r8, 1))
+            req_c = jax.lax.dynamic_slice(req_T, (0, t_idx), (r8, 1))
+            smask_row = static_mask[t_idx][None, :] if use_static else smask_dummy
+            sscore_row = static_score[t_idx][None, :] if use_static else sscore_dummy
+            best, best_score = step_call(
+                node_state, alloc_T, smask_row, sscore_row,
+                gate2d, plim2d, initq_c, req_c, mins_c,
+            )
+            any_feasible = best_score > neg_inf
+            # Nothing feasible -> the kernel's argmin sentinel is n (out of
+            # range); clamp so downstream gathers/scatters stay in bounds
+            # (they are all masked by any_feasible anyway).
+            best = jnp.minimum(best, n - 1)
+            fit_idle = fit_rel = masked_score = None
+        elif has_releasing:
             # Joint epsilon-exact fit against idle AND releasing in ONE op
             # chain: the packed node row [idle | releasing] -> [N, 2, R].
             avail2 = node_state[:, : 2 * r_dim].reshape(-1, 2, r_dim)
@@ -383,19 +438,24 @@ def fused_allocate(
                 axis=-1,
             )
             feasible = fit_idle & node_gate
-        if use_static:
-            feasible = feasible & static_mask[t_idx]
-        if enforce_pod_count:
-            feasible = feasible & (node_state[:, 2 * r_dim] < pods_limit_f)
+        if not step_kernel:
+            if use_static:
+                feasible = feasible & static_mask[t_idx]
+            if enforce_pod_count:
+                feasible = feasible & (node_state[:, 2 * r_dim] < pods_limit_f)
 
-        score = dynamic_score(req, idle, allocatable, *weights)
-        if use_static:
-            score = score + static_score[t_idx]
-        masked_score = jnp.where(feasible, score, neg_inf)
-        best = jnp.argmax(masked_score)
-        # Feasibility of the winner == any feasibility: reuses the argmax
-        # gather instead of a second [N] reduction.
-        any_feasible = masked_score[best] > neg_inf
+            score = dynamic_score(req, idle, allocatable, *weights)
+            if use_static:
+                # static_score is sanitized to finite values at build time
+                # (build_static_tensors*), and dynamic_score is finite by
+                # construction, so `any_feasible` below can safely derive
+                # feasibility from the winner's masked score.
+                score = score + static_score[t_idx]
+            masked_score = jnp.where(feasible, score, neg_inf)
+            best = jnp.argmax(masked_score)
+            # Feasibility of the winner == any feasibility: reuses the argmax
+            # gather instead of a second [N] reduction.
+            any_feasible = masked_score[best] > neg_inf
 
         active = cur >= 0
         placed = active & any_feasible
@@ -438,9 +498,12 @@ def fused_allocate(
             hi0 = jnp.minimum(run_len[t_idx], jnp.int32(MAX_BATCH))
             hi0 = jnp.minimum(hi0, room)
             if enforce_pod_count:
+                tc_best = (
+                    node_state[r8, best] if step_kernel
+                    else node_state[best, 2 * r_dim]
+                )
                 hi0 = jnp.minimum(
-                    hi0,
-                    pods_limit[best] - node_state[best, 2 * r_dim].astype(jnp.int32),
+                    hi0, pods_limit[best] - tc_best.astype(jnp.int32)
                 )
             hi0 = jnp.maximum(hi0, 1)
 
@@ -450,9 +513,14 @@ def fused_allocate(
             # MAX_BATCH candidates in one [MAX_BATCH, R] vector pass (a
             # scalar binary search costs ~8x more tiny sequential ops per
             # placement step).
-            idle_b = idle[best]
+            if step_kernel:
+                idle_b = jax.lax.dynamic_slice(
+                    node_state, (0, best), (r_dim, 1)
+                )[:, 0]
+            else:
+                idle_b = idle[best]
             js = jnp.arange(1, MAX_BATCH + 1, dtype=jnp.int32)
-            avail = idle_b[None, :] - (js - 1).astype(idle.dtype)[:, None] * req[None, :]
+            avail = idle_b[None, :] - (js - 1).astype(idle_b.dtype)[:, None] * req[None, :]
             ok_js = fit_mask(init_req, avail, mins)
             if score_bound:
                 # Top-2 bound: placement j still picks `best` iff its score
@@ -487,12 +555,23 @@ def fused_allocate(
         # a single [3] row.
         m_f = m.astype(node_state.dtype)
         copies = jnp.where(alloc_here, m, 1)
-        node_row = jnp.concatenate([
-            -req * (alloc_here * m_f),
-            -req * pipe_here,
-            (((alloc_here | pipe_here) * copies).astype(node_state.dtype))[None],
-        ])
-        node_state = node_state.at[best].add(node_row)
+        if step_kernel:
+            # Transposed layout: the ledger update is one COLUMN add (idle
+            # rows -= m*req, task_count row += copies); req_c's pad rows are
+            # zero so the concat needs no re-slicing.
+            col = jnp.concatenate([
+                -req_c[:, 0] * (alloc_here * m_f),
+                (((alloc_here | pipe_here) * copies).astype(node_state.dtype))[None],
+                jnp.zeros(7, node_state.dtype),
+            ])
+            node_state = node_state.at[:, best].add(col)
+        else:
+            node_row = jnp.concatenate([
+                -req * (alloc_here * m_f),
+                -req * pipe_here,
+                (((alloc_here | pipe_here) * copies).astype(node_state.dtype))[None],
+            ])
+            node_state = node_state.at[best].add(node_row)
 
         consumed = jnp.where(
             alloc_here, m, (pipe_here | failed).astype(jnp.int32)
@@ -594,10 +673,19 @@ def fused_allocate(
             alive = (cur >= 0) | ((cur != HALT) & jnp.any(eligible(job_state)))
         return alive & (steps < t_cap + window)
 
-    init = (
-        jnp.concatenate(
+    if step_kernel:
+        node_state0 = jnp.concatenate([
+            idle.T,
+            jnp.zeros((r8 - r_dim, n), idle.dtype),
+            task_count.astype(idle.dtype)[None, :],
+            jnp.zeros((7, n), idle.dtype),
+        ], axis=0)
+    else:
+        node_state0 = jnp.concatenate(
             [idle, releasing, task_count.astype(idle.dtype)[:, None]], axis=1
-        ),
+        )
+    init = (
+        node_state0,
         jnp.concatenate(
             [
                 jnp.zeros((j_cap, 3), dtype=job_alloc_init.dtype),
@@ -942,6 +1030,158 @@ class FusedAllocator:
         if mesh is not None:
             self.args = shard_fused_args(mesh, self.args)
 
+        # Fused selection step kernel (pallas): one launch per micro-step for
+        # fit+score+mask+argmax.  Excluded when: the score-bound batch path
+        # needs the full masked-score vector; something is releasing (the
+        # pipeline arm needs per-arm fit flags); the node axis is sharded
+        # (the kernel assumes the whole [_, N] block); or the arrays would
+        # not fit the kernel's single-block VMEM budget.
+        binpack_only = (
+            self.weights[0] == 0.0
+            and self.weights[1] == 0.0
+            and self.weights[2] > 0.0
+        )
+        score_bound = self.batch_runs and not binpack_only
+        try:
+            from scheduler_tpu.ops import pallas_kernels as _pk
+
+            step_ok = _pk.step_kernel_enabled()
+        except Exception:  # pragma: no cover - backend-specific
+            step_ok = False
+        r8 = -(-r // 8) * 8
+        self.step_kernel = bool(
+            step_ok
+            and mesh is None
+            and not self.has_releasing
+            and not score_bound
+            and (2 * r8 + 12) * nb * 4 <= 8 * 1024 * 1024
+        )
+
+        # Mega-kernel: the ENTIRE loop inside one pallas kernel (state in
+        # VMEM scratch, zero per-step op dispatch — ops/megakernel.py).
+        # Strictly stronger gating than the step kernel; when eligible it
+        # supersedes both XLA paths.
+        self.use_mega = False
+        self._mega = None
+        if step_ok and mesh is None:
+            from scheduler_tpu.ops import megakernel as _mk
+
+            if _mk.mega_supported(
+                has_releasing=self.has_releasing,
+                use_static=self.use_static,
+                score_bound=score_bound,
+                cursor_mode=single_queue,
+                r_dim=r,
+                n=nb,
+                n_sigs=1,  # sig count checked below after the table builds
+                comparators=self.comparators,
+            ):
+                self._prepare_mega(policy, scale, state, node_gate, nb, tb, r,
+                                   offsets, nums, deficits, gang_order,
+                                   priorities, tiebreak, alloc_init, total,
+                                   run_dev)
+
+    def _prepare_mega(self, policy, scale, state, node_gate, nb, tb, r,
+                      offsets, nums, deficits, gang_order, priorities,
+                      tiebreak, alloc_init, total, run_dev) -> None:
+        """Build the mega-kernel's inputs (ops/megakernel.py) — per-signature
+        request table, lane-packed job columns, transposed node rows.  Sets
+        ``use_mega`` only if the signature table fits the kernel's cap."""
+        from scheduler_tpu.api.vocab import CPU as _CPU_IDX, MEMORY as _MEM_IDX
+        from scheduler_tpu.ops import megakernel as _mk
+        from scheduler_tpu.ops import pallas_kernels as _pk
+
+        t = self.flat_count
+        if t == 0:
+            return
+        req_s = np.asarray(
+            scale_columns(self.st.tasks.resreq[:t], scale), dtype=np.float32
+        )
+        init_s = np.asarray(
+            scale_columns(self.st.tasks.init_resreq[:t], scale), dtype=np.float32
+        )
+        from scheduler_tpu.api.job_info import unique_row_codes
+
+        inverse, uniq_rows = unique_row_codes(
+            np.concatenate([req_s, init_s], axis=1)
+        )
+        s_count = uniq_rows.shape[0]
+        if s_count > 4096:
+            return  # request mix too wide for the per-signature table
+        s_pad = max(128, -(-s_count // 128) * 128)
+        sig_req = np.zeros((16, s_pad), dtype=np.float32)
+        sig_req[:r, :s_count] = uniq_rows[:, :r].T
+        sig_req[8 : 8 + r, :s_count] = uniq_rows[:, r:].T
+
+        task_sig = np.zeros((1, tb), dtype=np.int32)
+        task_sig[0, :t] = inverse.astype(np.int32)
+
+        jb = nums.shape[0]
+        j_pad = -(-(jb + _mk.MAX_BATCH) // 128) * 128
+        job_off = _mk.pack_lane_i32(offsets.astype(np.int32), j_pad)
+        job_num = _mk.pack_lane_i32(nums.astype(np.int32), j_pad)
+        job_def = _mk.pack_lane_i32(deficits.astype(np.int32), j_pad)
+        job_gang = _mk.pack_lane_i32(gang_order.astype(np.int32), j_pad)
+        job_prio = _mk.pack_lane_i32(priorities.astype(np.int32), j_pad)
+        job_tb = np.full((1, j_pad), 2**31 - 1, dtype=np.int32)
+        job_tb[0, :jb] = tiebreak.astype(np.int32)
+
+        js_drf0 = np.zeros((8, j_pad), dtype=np.float32)
+        js_drf0[:r, :jb] = np.asarray(
+            scale_columns(alloc_init, scale), dtype=np.float32
+        ).T
+        tot_s = np.asarray(
+            scale_columns(total[None, :], scale), dtype=np.float32
+        )[0]
+        drf_safe = np.ones((8, 1), dtype=np.float32)
+        drf_safe[:r, 0] = np.where(tot_s > 0, tot_s, 1.0)
+        drf_mask = np.zeros((8, 1), dtype=np.float32)
+        drf_mask[:r, 0] = (tot_s > 0).astype(np.float32)
+
+        misc = np.zeros((1, 8), dtype=np.int32)
+        misc[0, 0] = len(self.jobs)  # n_real: every kept job has pending rows
+
+        ns0 = (
+            jnp.zeros((16, nb), jnp.float32)
+            .at[:r].set(state.idle.T)
+            .at[8].set(state.task_count.astype(jnp.float32))
+        )
+        alloc_t = jnp.zeros((8, nb), jnp.float32).at[:r].set(state.allocatable.T)
+
+        self._mega_args = (
+            ns0,
+            alloc_t,
+            jnp.asarray(node_gate)[None, :],
+            state.pods_limit.astype(jnp.float32)[None, :],
+            jnp.asarray(sig_req),
+            jnp.asarray(task_sig),
+            run_dev.astype(jnp.int32).reshape(1, tb),
+            jnp.asarray(job_off),
+            jnp.asarray(job_num),
+            jnp.asarray(job_def),
+            jnp.asarray(job_gang),
+            jnp.asarray(job_prio),
+            jnp.asarray(job_tb),
+            jnp.asarray(js_drf0),
+            jnp.asarray(drf_safe),
+            jnp.asarray(drf_mask),
+            jnp.asarray(misc),
+        )
+        mins_f32 = np.asarray(policy.scaled_mins(r), dtype=np.float32)
+        self._mega_kw = dict(
+            r_dim=r,
+            weights=self.weights,
+            enforce_pod_count=self.enforce_pod_count,
+            comparators=self.comparators,
+            cross_batch=self.batch_runs,  # cursor mode is a mega precondition
+            batch_runs=self.batch_runs,
+            mins=tuple(float(x) for x in mins_f32),
+            cpu_idx=_CPU_IDX,
+            mem_idx=_MEM_IDX,
+            interpret=_pk._interpret(),
+        )
+        self.use_mega = True
+
     # -- capability probe ----------------------------------------------------
 
     @staticmethod
@@ -1011,6 +1251,19 @@ class FusedAllocator:
         return max(1, int(os.environ.get("SCHEDULER_TPU_WINDOW", "8")))
 
     def _execute(self) -> np.ndarray:
+        if self.use_mega:
+            from scheduler_tpu.ops import megakernel as _mk
+
+            try:
+                encoded = np.asarray(
+                    _mk.mega_allocate(*self._mega_args, **self._mega_kw)
+                )
+            except Exception:  # pragma: no cover - backend-specific
+                logger.exception("mega kernel failed; falling back to XLA path")
+                self.use_mega = False
+            else:
+                self._encoded = encoded
+                return encoded
         encoded = np.asarray(
             fused_allocate(
                 *self.args,
@@ -1025,6 +1278,7 @@ class FusedAllocator:
                 batch_runs=self.batch_runs,
                 sorted_jobs=True,
                 has_releasing=self.has_releasing,
+                step_kernel=self.step_kernel,
             )
         )
         self._encoded = encoded

@@ -1,0 +1,398 @@
+"""The WHOLE fused-allocate loop as ONE pallas TPU kernel.
+
+``ops/fused.py`` already collapses the allocate action into a single XLA
+``while_loop`` program — but its micro-step body is dispatch-bound: every HLO
+op in the body pays a fixed per-op cost that dwarfs the arithmetic at
+[N, R] sizes (docs/PERF_r02.md), ~20us/step across ~16k steps.  This module
+moves the *loop itself* inside a pallas kernel: node ledgers, job ledgers,
+and the result vector live in VMEM scratch for the whole action, every
+micro-step is straight-line VPU code with zero per-op dispatch, and the only
+HBM traffic is the initial tensor load plus the final [T] result store.
+
+Semantics are identical to ``fused_allocate`` in CURSOR MODE (single queue,
+init-key-sorted jobs) without releasing resources or static [T, N] tensors —
+the shape of the 100k-pod benchmark and of churn steady states.  The host
+shim (``FusedAllocator``) gates on exactly those conditions and falls back
+to the XLA program otherwise; ``tests/test_megakernel.py`` asserts the gate
+engages and pins the two programs bit-for-bit (the three-engine and fuzz
+parity suites exercise the kernel against the host loop as well).
+
+Layout notes (mosaic on this TPU stack):
+
+* Nodes ride the LANE axis ([row, N]) so per-resource rows broadcast against
+  scalar requests; the R axis unrolls statically (r_dim <= 8).
+* Dynamic LANE indexing is not available (lowering bug / SIGABRT on roll),
+  so every "read column j" is a masked reduce and every "update column j"
+  is a masked add — each one full-width VPU op, which is exactly the
+  per-step cost model the kernel optimizes for.
+* Requests are stored per-SIGNATURE ([16, S]: req rows 0..7, init rows
+  8..15) with an i32 signature id per task — identical-request runs share
+  rows, which caps VMEM at a few MB for 100k tasks.
+* Scalar loop state (current job, cursor, dirty count) is the
+  ``lax.while_loop`` carry; misc dynamic counts arrive via one SMEM vector.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Result encoding — MUST match ops/fused.py.
+UNPLACED = -1
+FAILED = -2
+HALT = -100
+MAX_BATCH = 128
+
+_BIG_I32 = 2**31 - 1
+
+
+def _lane_iota(shape):
+    return jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+
+
+def mega_supported(
+    *,
+    has_releasing: bool,
+    use_static: bool,
+    score_bound: bool,
+    cursor_mode: bool,
+    r_dim: int,
+    n: int,
+    n_sigs: int,
+    comparators: Tuple[str, ...],
+) -> bool:
+    return (
+        cursor_mode
+        and not has_releasing
+        and not use_static
+        and not score_bound
+        and r_dim <= 8
+        and n <= 32768
+        and 0 < n_sigs <= 4096
+        and set(comparators) <= {"priority", "gang", "drf"}
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "r_dim", "weights", "enforce_pod_count", "comparators",
+        "cross_batch", "batch_runs", "mins", "cpu_idx", "mem_idx",
+        "interpret",
+    ),
+)
+def mega_allocate(
+    ns0: jnp.ndarray,        # f32 [16, N]  rows 0..7 idle, row 8 task_count
+    alloc_t: jnp.ndarray,    # f32 [8, N]   allocatable
+    gate: jnp.ndarray,       # bool [1, N]
+    plim: jnp.ndarray,       # f32 [1, N]
+    sig_req: jnp.ndarray,    # f32 [16, S]  rows 0..7 resreq, 8..15 init_resreq
+    task_sig: jnp.ndarray,   # i32 [1, T]
+    run_len: jnp.ndarray,    # i32 [1, T]
+    job_off: jnp.ndarray,    # i32 [1, J]
+    job_num: jnp.ndarray,    # i32 [1, J]
+    job_deficit: jnp.ndarray,   # i32 [1, J] ready-break deficit
+    job_gang: jnp.ndarray,   # i32 [1, J] gang ORDER deficit
+    job_prio: jnp.ndarray,   # i32 [1, J]
+    job_tb: jnp.ndarray,     # i32 [1, J] creation/uid rank (big = padding)
+    js_drf0: jnp.ndarray,    # f32 [8, J] drf allocated at session open
+    drf_safe: jnp.ndarray,   # f32 [8, 1] totals (1 where absent)
+    drf_mask: jnp.ndarray,   # f32 [8, 1] 1 where total > 0
+    misc: jnp.ndarray,       # i32 [1, 8] SMEM: [n_real, ...]
+    *,
+    r_dim: int,
+    weights: Tuple[float, float, float],
+    enforce_pod_count: bool,
+    comparators: Tuple[str, ...],
+    cross_batch: bool,
+    batch_runs: bool,
+    mins: Tuple[float, ...],     # static epsilon thresholds, len r_dim
+    cpu_idx: int,
+    mem_idx: int,
+    interpret: bool,
+) -> jnp.ndarray:
+    n = ns0.shape[1]
+    t_pad = task_sig.shape[1]
+    j_pad = job_off.shape[1]
+    # The 2-row write window must fit even when rowlo is the last real row.
+    t_sub = (t_pad - 1) // 128 + 2
+    lr_w, bal_w, bp_w = (float(w) for w in weights)
+    max_steps = t_pad + 8
+
+    def kernel(ns0_ref, alloc_ref, gate_ref, plim_ref, sigr_ref, tsig_ref,
+               rlen_ref, joff_ref, jnum_ref, jdef_ref, jgang_ref, jprio_ref,
+               jtb_ref, jdrf0_ref, dsafe_ref, dmask_ref, misc_ref,
+               out_ref, ns, js):
+        neg_inf = float("-inf")
+        pos_inf = float("inf")
+        lane_n = _lane_iota((1, n))
+        lane_j = _lane_iota((1, j_pad))
+        lane_s = _lane_iota((1, sigr_ref.shape[1]))
+
+        # State into VMEM scratch; result initialized to UNPLACED.
+        ns[:, :] = ns0_ref[:, :]
+        js[0:8, :] = jnp.zeros((8, j_pad), jnp.float32)
+        js[8:16, :] = jdrf0_ref[:, :]
+        out_ref[:, :] = jnp.full((t_sub, 128), UNPLACED, jnp.int32)
+
+        n_real = misc_ref[0, 0]
+
+        jnum = jnum_ref[:]
+        jnum_f = jnum.astype(jnp.float32)
+        joff = joff_ref[:]
+        jdef = jdef_ref[:]
+        jgang_f = jgang_ref[:].astype(jnp.float32)
+        jprio = jprio_ref[:]
+        jtb = jtb_ref[:]
+        gate_v = gate_ref[:]
+        plim_v = plim_ref[:]
+
+        def read_i32(vec, lanes, idx):
+            return jnp.max(jnp.where(lanes == idx, vec, jnp.int32(-_BIG_I32 - 1)))
+
+        def read_f32(vec, lanes, idx):
+            return jnp.sum(jnp.where(lanes == idx, vec, 0.0))
+
+        def body(state):
+            cur, cursor, n_dirty, steps = state
+
+            # ---- selection (branchless; matches fused.py cursor mode) ----
+            cons_row = js[0:1, :]
+            alloc_row = js[1:2, :]
+            left_row = js[2:3, :]
+            elig = (left_row == 0.0) & (cons_row < jnum_f) & (jnum > 0)
+            cand = elig & (lane_j <= cursor)
+            for name in comparators:
+                if name == "priority":
+                    key = -jprio
+                    masked = jnp.where(cand, key, jnp.int32(_BIG_I32))
+                    cand = cand & (masked == jnp.min(masked))
+                elif name == "gang":
+                    key = ((jgang_f - alloc_row) <= 0.0).astype(jnp.int32)
+                    masked = jnp.where(cand, key, jnp.int32(_BIG_I32))
+                    cand = cand & (masked == jnp.min(masked))
+                elif name == "drf":
+                    frac = jnp.where(
+                        dmask_ref[:] > 0.0, js[8:16, :] / dsafe_ref[:], 0.0
+                    )
+                    key = jnp.max(frac, axis=0, keepdims=True)
+                    masked = jnp.where(cand, key, pos_inf)
+                    cand = cand & (masked == jnp.min(masked))
+            tbv = jnp.where(cand, jtb, jnp.int32(_BIG_I32))
+            any_cand = jnp.min(tbv) < _BIG_I32
+            chain_sel = jnp.where(
+                any_cand,
+                jnp.min(jnp.where(tbv == jnp.min(tbv), lane_j, jnp.int32(j_pad))),
+                jnp.int32(HALT),
+            )
+            cheap_sel = jnp.where(cursor < n_real, cursor, jnp.int32(HALT))
+            sel0 = jnp.where(n_dirty > 0, chain_sel, cheap_sel)
+            sel = jnp.where(cur == -1, sel0, cur)
+            newly = (cur == -1) & (sel >= 0)
+            advanced = newly & (sel == cursor)
+            cursor2 = cursor + advanced.astype(jnp.int32)
+            n_dirty2 = n_dirty - (newly & (sel != cursor)).astype(jnp.int32)
+            cur2 = sel
+
+            cur_safe = jnp.clip(cur2, 0, j_pad - 1)
+            cons = read_f32(cons_row, lane_j, cur_safe)
+            nalloc = read_f32(alloc_row, lane_j, cur_safe)
+            off = read_i32(joff, lane_j, cur_safe)
+            num_v = read_i32(jnum, lane_j, cur_safe)
+            deficit_v = read_i32(jdef, lane_j, cur_safe)
+
+            t_idx = jnp.clip(off + cons.astype(jnp.int32), 0, t_pad - 1)
+            lane_t = _lane_iota((1, t_pad))
+            sig = read_i32(tsig_ref[:], lane_t, t_idx)
+            rl = read_i32(rlen_ref[:], lane_t, t_idx)
+
+            reqs = []
+            initqs = []
+            for r in range(r_dim):
+                reqs.append(read_f32(sigr_ref[r : r + 1, :], lane_s, sig))
+                initqs.append(read_f32(sigr_ref[8 + r : 8 + r + 1, :], lane_s, sig))
+
+            # ---- fit + score + masked argmax (rows unrolled) ----
+            feas = gate_v
+            for r in range(r_dim):
+                idle_r = ns[r : r + 1, :]
+                feas = feas & (
+                    (initqs[r] < idle_r) | (jnp.abs(idle_r - initqs[r]) < mins[r])
+                )
+            if enforce_pod_count:
+                feas = feas & (ns[8:9, :] < plim_v)
+
+            score = jnp.zeros((1, n), jnp.float32)
+            if lr_w or bal_w or bp_w:
+                a_c = alloc_ref[cpu_idx : cpu_idx + 1, :]
+                a_m = alloc_ref[mem_idx : mem_idx + 1, :]
+                safe_c = jnp.where(a_c > 0, a_c, 1.0)
+                safe_m = jnp.where(a_m > 0, a_m, 1.0)
+                req_c = a_c - ns[cpu_idx : cpu_idx + 1, :] + reqs[cpu_idx]
+                req_m = a_m - ns[mem_idx : mem_idx + 1, :] + reqs[mem_idx]
+                if bp_w:
+                    fc = jnp.clip(req_c / safe_c, 0.0, 1.0)
+                    fm = jnp.clip(req_m / safe_m, 0.0, 1.0)
+                    score = score + bp_w * (((fc + fm) / 2.0) * 10.0)
+                if lr_w:
+                    lc = jnp.clip((a_c - req_c) / safe_c, 0.0, 1.0)
+                    lm = jnp.clip((a_m - req_m) / safe_m, 0.0, 1.0)
+                    score = score + lr_w * (((lc + lm) / 2.0) * 10.0)
+                if bal_w:
+                    fc = jnp.clip(req_c / safe_c, 0.0, 1.0)
+                    fm = jnp.clip(req_m / safe_m, 0.0, 1.0)
+                    score = score + bal_w * ((1.0 - jnp.abs(fc - fm)) * 10.0)
+
+            masked = jnp.where(feas, score, neg_inf)
+            maxv = jnp.max(masked)
+            any_feasible = maxv > neg_inf
+            best = jnp.minimum(
+                jnp.min(jnp.where(masked == maxv, lane_n, jnp.int32(n))),
+                jnp.int32(n - 1),
+            )
+
+            active = cur2 >= 0
+            placed = active & any_feasible
+            failed = active & ~any_feasible
+            single_pop = num_v == 1
+
+            # ---- run batching (binpack-exact; no score bound here) ----
+            if batch_runs:
+                room = jnp.where(
+                    deficit_v > 0, deficit_v - nalloc.astype(jnp.int32), 1
+                )
+                if cross_batch:
+                    room = jnp.where(
+                        single_pop & (n_dirty2 == 0), jnp.int32(MAX_BATCH), room
+                    )
+                hi0 = jnp.minimum(rl, jnp.int32(MAX_BATCH))
+                hi0 = jnp.minimum(hi0, room)
+                if enforce_pod_count:
+                    pl_best = read_f32(plim_v, lane_n, best)
+                    tc_best = read_f32(ns[8:9, :], lane_n, best)
+                    hi0 = jnp.minimum(
+                        hi0, (pl_best - tc_best).astype(jnp.int32)
+                    )
+                hi0 = jnp.maximum(hi0, 1)
+                js_vec = _lane_iota((1, MAX_BATCH)) + 1
+                ok = jnp.ones((1, MAX_BATCH), dtype=bool)
+                for r in range(r_dim):
+                    idle_br = read_f32(ns[r : r + 1, :], lane_n, best)
+                    avail_r = idle_br - (js_vec - 1).astype(jnp.float32) * reqs[r]
+                    ok = ok & (
+                        (initqs[r] < avail_r)
+                        | (jnp.abs(avail_r - initqs[r]) < mins[r])
+                    )
+                fit_count = jnp.max(jnp.where(ok & (js_vec <= hi0), js_vec, 1))
+                m = jnp.where(placed, fit_count, 1)
+            else:
+                m = jnp.int32(1)
+            cross_active = (
+                (single_pop & placed) if cross_batch else jnp.asarray(False)
+            )
+
+            consumed = jnp.where(placed, m, failed.astype(jnp.int32))
+            m_alloc = jnp.where(placed, m, 0).astype(jnp.float32)
+
+            # ---- node ledger update (masked column add) ----
+            eq_n = (lane_n == best).astype(jnp.float32)
+            for r in range(r_dim):
+                ns[r : r + 1, :] = ns[r : r + 1, :] - (reqs[r] * m_alloc) * eq_n
+            ns[8:9, :] = ns[8:9, :] + m_alloc * eq_n
+
+            # ---- job ledger update (masked window add) ----
+            k = jnp.where(cross_active, m, 1)
+            win = ((lane_j >= cur_safe) & (lane_j < cur_safe + k)).astype(
+                jnp.float32
+            )
+            cons_add = jnp.where(cross_active, 1.0, consumed.astype(jnp.float32))
+            alloc_add = jnp.where(cross_active, 1.0, m_alloc)
+            left_add = jnp.where(
+                cross_active, 0.0, failed.astype(jnp.float32)
+            )
+            js[0:1, :] = js[0:1, :] + cons_add * win
+            js[1:2, :] = js[1:2, :] + alloc_add * win
+            js[2:3, :] = js[2:3, :] + left_add * win
+            drf_scale = jnp.where(cross_active, 1.0, m_alloc)
+            for r in range(r_dim):
+                js[8 + r : 8 + r + 1, :] = (
+                    js[8 + r : 8 + r + 1, :] + (reqs[r] * drf_scale) * win
+                )
+
+            # ---- result write (2-row window around t_idx) ----
+            code = jnp.where(
+                placed, best, jnp.where(failed, jnp.int32(FAILED), jnp.int32(UNPLACED))
+            )
+            wcount = jnp.where(active, consumed, 0)
+            rowlo = t_idx // 128
+            blk = out_ref[pl.ds(rowlo, 2), :]
+            gidx = (
+                rowlo * 128
+                + jax.lax.broadcasted_iota(jnp.int32, (2, 128), 0) * 128
+                + jax.lax.broadcasted_iota(jnp.int32, (2, 128), 1)
+            )
+            wmask = (gidx >= t_idx) & (gidx < t_idx + wcount)
+            out_ref[pl.ds(rowlo, 2), :] = jnp.where(wmask, code, blk)
+
+            # ---- pop end ----
+            row_after_alloc = nalloc + jnp.where(cross_active, 1.0, m_alloc)
+            became_ready = placed & (row_after_alloc >= deficit_v.astype(jnp.float32))
+            cons_after = cons + jnp.where(
+                cross_active, 1.0, consumed.astype(jnp.float32)
+            )
+            drained = cons_after >= num_v.astype(jnp.float32)
+            end_pop = failed | became_ready | drained
+            cur3 = jnp.where(
+                cur2 == HALT, jnp.int32(HALT),
+                jnp.where(active & ~end_pop, cur2, jnp.int32(-1)),
+            )
+            n_dirty3 = n_dirty2 + (active & became_ready & ~drained).astype(
+                jnp.int32
+            )
+            cursor3 = cursor2 + (
+                jnp.where(cross_active, m - 1, 0) if cross_batch else 0
+            )
+            return cur3, cursor3, n_dirty3, steps + 1
+
+        def cond(state):
+            cur, cursor, n_dirty, steps = state
+            alive = (cur >= 0) | (
+                (cur != HALT) & ((cursor < n_real) | (n_dirty > 0))
+            )
+            return alive & (steps < max_steps)
+
+        jax.lax.while_loop(
+            cond, body,
+            (jnp.int32(-1), jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+        )
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((t_sub, 128), jnp.int32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(16)
+        ] + [pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((16, n), jnp.float32),      # ns: idle rows + task_count
+            pltpu.VMEM((16, j_pad), jnp.float32),  # js: cons/alloc/left + drf
+        ],
+        interpret=interpret,
+    )(
+        ns0, alloc_t, gate, plim, sig_req, task_sig, run_len,
+        job_off, job_num, job_deficit, job_gang, job_prio, job_tb,
+        js_drf0, drf_safe, drf_mask, misc,
+    )
+    return out.reshape(-1)[:t_pad]
+
+
+def pack_lane_i32(arr: np.ndarray, lanes: int) -> np.ndarray:
+    out = np.zeros((1, lanes), dtype=np.int32)
+    out[0, : arr.shape[0]] = arr
+    return out

@@ -1,5 +1,17 @@
 """Pallas TPU kernels for the hot batched ops.
 
+Two kernels live here:
+
+* ``static_predicate_mask`` — the session-static predicate stage (below).
+* ``placement_step`` — the fused engine's per-micro-step selection
+  (fit + score + mask + argmax) as ONE kernel launch.  The while-loop body
+  is dispatch-bound: per-step cost tracks HLO op count, not tensor sizes
+  (docs/PERF_r02.md), so collapsing the ~15 [N, R]/[N] ops of the selection
+  stage into one launch is the main lever on the device loop.  Layout is
+  TRANSPOSED ([R, N]: resources on sublanes, nodes on lanes) so the
+  all-dims fit reduction runs along sublanes and N rides the 128-wide lane
+  axis without padding waste.
+
 First kernel: the session-static predicate stage — label-selector matching,
 taint/toleration matching, and the per-task/per-node gates fused into ONE
 [T, N] mask kernel.  The math (ops/predicates.py, reference
@@ -47,6 +59,111 @@ def _pad_to(x: np.ndarray, rows: int, cols: int) -> np.ndarray:
     out = np.zeros((rows, cols), dtype=x.dtype)
     out[: x.shape[0], : x.shape[1]] = x
     return out
+
+
+def step_kernel_enabled() -> bool:
+    """The placement-step kernel has its own off switch on top of the global
+    pallas gate (SCHEDULER_TPU_STEP_KERNEL=0 restores the XLA step path)."""
+    return pallas_enabled() and os.environ.get(
+        "SCHEDULER_TPU_STEP_KERNEL", "1"
+    ) not in ("0", "false")
+
+
+def make_placement_step(
+    r_dim: int,
+    r8: int,
+    n: int,
+    weights,
+    use_static: bool,
+    enforce_pod_count: bool,
+    cpu_idx: int,
+    mem_idx: int,
+    interpret: bool,
+):
+    """One micro-step's selection stage as a single kernel.
+
+    Inputs (all transposed, nodes on lanes):
+      ns        f32 [r8 + 8, n]  packed node state: rows [0, r8) idle
+                (pad rows 0), row r8 task_count, rest padding
+      alloc     f32 [r8, n]      allocatable (pad rows 0)
+      smask     bool [1, n]      static mask row for the current task
+      sscore    f32 [1, n]       static score row
+      gate      bool [1, n]      node gate (ready & not padding)
+      plim      f32 [1, n]       pods limit
+      initq     f32 [r8, 1]      init request (pad rows -1: always fit)
+      req       f32 [r8, 1]      request (pad rows 0: no score effect)
+      mins      f32 [r8, 1]      epsilon thresholds
+
+    Outputs: best (i32 [1,1] lowest-index argmax of the masked score) and
+    its masked score (f32 [1,1]; -inf == nothing feasible).  Scoring
+    reproduces ops/scoring.dynamic_score exactly (same formulas, f32).
+    """
+    lr_w, bal_w, bp_w = (float(w) for w in weights)
+    neg_inf = float("-inf")  # python literal: pallas kernels cannot close over
+    # traced jnp constants (they must be passed as inputs)
+
+    def kernel(ns_ref, alloc_ref, smask_ref, sscore_ref, gate_ref, plim_ref,
+               initq_ref, req_ref, mins_ref, best_ref, score_ref):
+        idle = ns_ref[0:r8, :]
+        initq = initq_ref[:]
+        minsv = mins_ref[:]
+        fit = (initq < idle) | (jnp.abs(idle - initq) < minsv)
+        feasible = jnp.all(fit, axis=0, keepdims=True)
+        feasible = feasible & gate_ref[:]
+        if use_static:
+            feasible = feasible & smask_ref[:]
+        if enforce_pod_count:
+            feasible = feasible & (ns_ref[r8 : r8 + 1, :] < plim_ref[:])
+
+        score = jnp.zeros((1, n), dtype=jnp.float32)
+        if lr_w or bal_w or bp_w:
+            alloc = alloc_ref[:]
+            requested = alloc - idle + req_ref[:]
+            safe = jnp.where(alloc > 0, alloc, 1.0)
+            if bp_w:
+                frac = jnp.clip(requested / safe, 0.0, 1.0)
+                fc = frac[cpu_idx : cpu_idx + 1, :]
+                fm = frac[mem_idx : mem_idx + 1, :]
+                score = score + bp_w * (((fc + fm) / 2.0) * 10.0)
+            if lr_w:
+                lfrac = jnp.clip((alloc - requested) / safe, 0.0, 1.0)
+                lc = lfrac[cpu_idx : cpu_idx + 1, :]
+                lm = lfrac[mem_idx : mem_idx + 1, :]
+                score = score + lr_w * (((lc + lm) / 2.0) * 10.0)
+            if bal_w:
+                bfrac = jnp.clip(requested / safe, 0.0, 1.0)
+                diff = jnp.abs(
+                    bfrac[cpu_idx : cpu_idx + 1, :] - bfrac[mem_idx : mem_idx + 1, :]
+                )
+                score = score + bal_w * ((1.0 - diff) * 10.0)
+        if use_static:
+            score = score + sscore_ref[:]
+
+        masked = jnp.where(feasible, score, neg_inf)
+        maxv = jnp.max(masked)
+        lanes = jax.lax.broadcasted_iota(jnp.int32, masked.shape, 1)
+        best = jnp.min(jnp.where(masked == maxv, lanes, jnp.int32(n)))
+        best_ref[0, 0] = best
+        score_ref[0, 0] = maxv
+
+    def call(ns, alloc, smask, sscore, gate, plim, initq, req, mins):
+        best, score = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((1, 1), jnp.int32),
+                jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            ),
+            # Scalar results live in SMEM — mosaic rejects scalar stores to
+            # VMEM refs.
+            out_specs=(
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ),
+            interpret=interpret,
+        )(ns, alloc, smask, sscore, gate, plim, initq, req, mins)
+        return best[0, 0], score[0, 0]
+
+    return call
 
 
 def _mask_kernel(sel_ref, missing_ref, untol_ref, taints_ref, unknown_ref,

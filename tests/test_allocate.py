@@ -222,6 +222,37 @@ tiers:
 """
 
 
+class TestStrictOrder:
+    """SCHEDULER_TPU_STRICT_ORDER=1 restores the reference's single
+    interleaved job order: a high-priority dynamic (host-port) job must not
+    lose its slot to a lower-priority static job placed by the device-first
+    pass (the documented default deviation, README operational flags)."""
+
+    def _mixed_one_slot(self):
+        cache = make_cluster(n_nodes=1, node_cpu=1000)
+        cache.add_priority_class("hi", 10)
+        add_gang(cache, "static-lo", n_tasks=1, min_member=1, priority=1)
+        pg = build_pod_group("dyn-hi", min_member=1)
+        pg.priority_class_name = "hi"  # job order runs on PriorityClass value
+        cache.add_pod_group(pg)
+        pod = build_pod(name="dyn-hi-0", req={"cpu": 1000, "memory": 1024**2},
+                        groupname="dyn-hi", priority=10)
+        pod.host_ports = [8080]
+        cache.add_pod(pod)
+        return cache
+
+    def test_default_places_static_first(self):
+        cache = self._mixed_one_slot()
+        run_allocate(cache, PREDICATES_CONF)
+        assert cache.binder.binds == {"default/static-lo-0": "n0"}
+
+    def test_strict_order_honors_priority(self, monkeypatch):
+        monkeypatch.setenv("SCHEDULER_TPU_STRICT_ORDER", "1")
+        cache = self._mixed_one_slot()
+        run_allocate(cache, PREDICATES_CONF)
+        assert cache.binder.binds == {"default/dyn-hi-0": "n0"}
+
+
 class TestDynamicPredicateSplit:
     """One scan-dynamic pod (host ports / pod affinity) must not de-accelerate
     the whole session: its job takes the exact host loop while every other job

@@ -1,0 +1,158 @@
+"""Explicit engagement + parity pins for the pallas placement kernels.
+
+The three-engine and fuzz parity suites already run the kernels implicitly
+(interpret mode on the CPU mesh), but they would keep passing if the kernels
+silently stopped engaging.  These tests assert the gates actually fire and
+pin the kernel outputs bit-for-bit against the XLA while-loop on the same
+engine instance.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.actions.allocate import collect_candidates
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import open_session
+from scheduler_tpu.ops.fused import FusedAllocator
+from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
+
+BENCH_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+"""
+
+PREDICATES_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: predicates
+  - name: nodeorder
+"""
+
+
+def _mixed_cluster(conf_str, selectors=False):
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    for i in range(8):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": 4000, "memory": 8 * 2**30, "pods": 9},
+            labels={"zone": "za" if i % 2 else "zb"},
+        ))
+    rnd = random.Random(11)
+    for g in range(6):
+        cache.add_pod_group(build_pod_group(f"g{g}", min_member=3))
+        for i in range(6):
+            pod = build_pod(
+                name=f"g{g}-{i}",
+                req={"cpu": rnd.choice([250, 500, 750]), "memory": 2**30},
+                groupname=f"g{g}", priority=g % 3,
+            )
+            if selectors and g == 2:
+                pod.node_selector = {"zone": "za"}
+            cache.add_pod(pod)
+    # a couple of single-task jobs: the cross-job batching path
+    for s in range(4):
+        cache.add_pod_group(build_pod_group(f"solo{s}", min_member=1))
+        cache.add_pod(build_pod(name=f"solo{s}-0",
+                                req={"cpu": 100, "memory": 2**28},
+                                groupname=f"solo{s}"))
+    conf = parse_scheduler_conf(conf_str)
+    ssn = open_session(cache, conf.tiers)
+    return ssn
+
+
+def test_mega_kernel_engages_and_matches_xla():
+    """The bench-shaped config (no static tensors, single queue, builtin
+    comparators) MUST take the mega-kernel, and its codes must equal the XLA
+    while-loop program's bit-for-bit."""
+    ssn = _mixed_cluster(BENCH_CONF)
+    engine = FusedAllocator(ssn, collect_candidates(ssn))
+    assert engine.use_mega, "mega-kernel gate did not engage on the bench shape"
+    mega = engine._execute().copy()
+    engine.use_mega = False
+    xla = engine._execute().copy()
+    assert np.array_equal(mega, xla)
+    assert int((mega >= 0).sum()) > 0
+
+
+def test_step_kernel_engages_with_static_tensors():
+    """With the predicates plugin registered (static [T, N] tensors) the
+    mega-kernel must NOT engage, the step kernel must, and the step-kernel
+    program must match the plain XLA step path bit-for-bit.  Requests are
+    all-distinct: nodeorder scoring + identical-request runs would take the
+    top-2 score-bound path, which correctly excludes the step kernel."""
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    for i in range(6):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": 8000, "memory": 16 * 2**30, "pods": 20},
+            labels={"zone": "za" if i % 2 else "zb"},
+        ))
+    for g in range(4):
+        cache.add_pod_group(build_pod_group(f"g{g}", min_member=2))
+        for i in range(4):
+            pod = build_pod(
+                name=f"g{g}-{i}",
+                req={"cpu": 200 + 40 * g + 10 * i, "memory": 2**30},
+                groupname=f"g{g}", priority=g % 2,
+            )
+            if g == 1:
+                pod.node_selector = {"zone": "za"}
+            cache.add_pod(pod)
+    ssn = open_session(cache, parse_scheduler_conf(PREDICATES_CONF).tiers)
+    engine = FusedAllocator(ssn, collect_candidates(ssn))
+    assert not engine.use_mega
+    assert engine.step_kernel, "step kernel gate did not engage"
+    with_kernel = engine._execute().copy()
+    engine.step_kernel = False
+    without = engine._execute().copy()
+    assert np.array_equal(with_kernel, without)
+    assert int((with_kernel >= 0).sum()) > 0
+
+
+def test_kernels_respect_the_off_switch(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_STEP_KERNEL", "0")
+    ssn = _mixed_cluster(BENCH_CONF)
+    engine = FusedAllocator(ssn, collect_candidates(ssn))
+    assert not engine.use_mega
+    assert not engine.step_kernel
+
+
+@pytest.mark.parametrize("conf", [BENCH_CONF])
+def test_mega_cross_batch_single_task_jobs(conf):
+    """Thousands of identical single-task jobs (the kubemark-density shape)
+    exercise the cross-job batching arm; parity must hold there too."""
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    for i in range(4):
+        cache.add_node(build_node(f"n{i}", {"cpu": 64000, "memory": 64 * 2**30,
+                                            "pods": 200}))
+    for s in range(120):
+        cache.add_pod_group(build_pod_group(f"d{s:03d}", min_member=1))
+        cache.add_pod(build_pod(name=f"d{s:03d}-0",
+                                req={"cpu": 100, "memory": 2**28},
+                                groupname=f"d{s:03d}"))
+    ssn = open_session(cache, parse_scheduler_conf(conf).tiers)
+    engine = FusedAllocator(ssn, collect_candidates(ssn))
+    assert engine.use_mega
+    assert engine.batch_runs
+    mega = engine._execute().copy()
+    engine.use_mega = False
+    xla = engine._execute().copy()
+    assert np.array_equal(mega, xla)
+    assert int((mega >= 0).sum()) == 120

@@ -298,6 +298,9 @@ class _TaskRows:
         "resreq_empty",
         "has_scalars",
         "constrained",
+        "dyn_pred",
+        "req_aff",
+        "pref_aff",
         "req_matrix",
         "init_req_matrix",
         "sigs",
@@ -328,6 +331,14 @@ class _TaskRows:
         # per-pod label/toleration extraction only walks constrained rows —
         # the typical 100k-task cycle has none and skips the loop entirely.
         self.constrained = np.zeros(cap, dtype=bool)
+        # Pod-spec flags consumed columnar by the plugins each session, so
+        # publication/scoring sweeps never materialize task views:
+        #   dyn_pred — scan-dynamic predicates (host ports / pod affinity)
+        #   req_aff  — required node affinity (device-mask row correction)
+        #   pref_aff — preferred node affinity (static scorer contribution)
+        self.dyn_pred = np.zeros(cap, dtype=bool)
+        self.req_aff = np.zeros(cap, dtype=bool)
+        self.pref_aff = np.zeros(cap, dtype=bool)
         # Request matrices are maintained INCREMENTALLY at append time (the
         # cost rides event ingestion, not the scheduling cycle); they only
         # rebuild wholesale at compaction.  Signatures build lazily per cycle.
@@ -349,7 +360,8 @@ class _TaskRows:
     def _grow(self) -> None:
         cap = max(16, 2 * self.status.shape[0])
         for slot in ("status", "node_name", "volume_ready", "priority", "creation",
-                     "resreq_empty", "has_scalars", "constrained", "cores", "uids"):
+                     "resreq_empty", "has_scalars", "constrained", "dyn_pred",
+                     "req_aff", "pref_aff", "cores", "uids"):
             old = getattr(self, slot)
             new = np.zeros(cap, dtype=old.dtype) if old.dtype != object else np.empty(cap, dtype=object)
             new[: old.shape[0]] = old
@@ -391,6 +403,13 @@ class _TaskRows:
         self.constrained[row] = bool(
             pod is not None and (pod.node_selector or pod.tolerations)
         )
+        aff = pod.affinity if pod is not None else None
+        self.dyn_pred[row] = bool(
+            pod is not None
+            and (pod.host_ports or (aff and (aff.pod_affinity or aff.pod_anti_affinity)))
+        )
+        self.req_aff[row] = bool(aff and aff.node_required)
+        self.pref_aff[row] = bool(aff and aff.node_preferred)
         arr = core.resreq.array
         if arr.shape[0] > self.r_dim:
             self._widen(arr.shape[0])
@@ -427,6 +446,9 @@ class _TaskRows:
         blk.resreq_empty = self.resreq_empty
         blk.has_scalars = self.has_scalars
         blk.constrained = self.constrained
+        blk.dyn_pred = self.dyn_pred
+        blk.req_aff = self.req_aff
+        blk.pref_aff = self.pref_aff
         blk.req_matrix = self.req_matrix
         blk.init_req_matrix = self.init_req_matrix
         blk.sigs = self.sigs
@@ -490,6 +512,9 @@ class _TaskRows:
         resreq_empty = np.zeros(cap, dtype=bool)
         has_scalars = np.zeros(cap, dtype=bool)
         constrained = np.zeros(cap, dtype=bool)
+        dyn_pred = np.zeros(cap, dtype=bool)
+        req_aff = np.zeros(cap, dtype=bool)
+        pref_aff = np.zeros(cap, dtype=bool)
         req = np.zeros((cap, self.r_dim), dtype=np.float64)
         init = np.zeros((cap, self.r_dim), dtype=np.float64)
         cores = np.empty(cap, dtype=object)
@@ -504,6 +529,9 @@ class _TaskRows:
             resreq_empty[new_row] = self.resreq_empty[old_row]
             has_scalars[new_row] = self.has_scalars[old_row]
             constrained[new_row] = self.constrained[old_row]
+            dyn_pred[new_row] = self.dyn_pred[old_row]
+            req_aff[new_row] = self.req_aff[old_row]
+            pref_aff[new_row] = self.pref_aff[old_row]
             req[new_row] = self.req_matrix[old_row]
             init[new_row] = self.init_req_matrix[old_row]
             core = self.cores[old_row]
@@ -525,6 +553,9 @@ class _TaskRows:
         self.resreq_empty = resreq_empty
         self.has_scalars = has_scalars
         self.constrained = constrained
+        self.dyn_pred = dyn_pred
+        self.req_aff = req_aff
+        self.pref_aff = pref_aff
         self.req_matrix = req
         self.init_req_matrix = init
         self.cores = cores

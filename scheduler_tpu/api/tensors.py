@@ -126,6 +126,11 @@ class TaskTensors:
     selector: np.ndarray      # bool [T, L] required label pairs
     has_unknown_selector: np.ndarray  # bool [T]: selector references a pair no node has
     tolerated: np.ndarray     # bool [T, K] taint columns this task tolerates
+    # Affinity flags + task cores: plugins walk ONLY the flagged rows (the
+    # typical cycle has none) instead of building uid->task dicts per session.
+    req_aff: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=bool))
+    pref_aff: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=bool))
+    cores: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=object))
 
     @property
     def count(self) -> int:
@@ -306,6 +311,9 @@ def build_task_tensors(
     if job_infos is not None:
         matrices = {j.uid: j for j in job_infos}
 
+    cores_arr = np.empty(t, dtype=object)
+    req_aff = np.zeros(t, dtype=bool)
+    pref_aff = np.zeros(t, dtype=bool)
     run_start = 0
     uids: List[str] = []
     for i, ti in enumerate(tasks):
@@ -313,6 +321,11 @@ def build_task_tensors(
         job_idx[i] = jobs.index.get(ti.job, -1)
         priority[i] = ti.priority
         creation[i] = ti.creation_timestamp
+        cores_arr[i] = ti
+        aff = ti.pod.affinity
+        if aff is not None:
+            req_aff[i] = bool(aff.node_required)
+            pref_aff[i] = bool(aff.node_preferred)
         if ti.job not in matrices:
             resreq[i] = _fit(ti.resreq.array, r)
             init_resreq[i] = _fit(ti.init_resreq.array, r)
@@ -352,6 +365,9 @@ def build_task_tensors(
         selector=selector,
         has_unknown_selector=has_unknown,
         tolerated=tolerated,
+        req_aff=req_aff,
+        pref_aff=pref_aff,
+        cores=cores_arr,
     )
 
 
@@ -378,6 +394,9 @@ def build_task_tensors_columnar(
     selector = np.zeros((t, label_vocab.size), dtype=bool)
     has_unknown = np.zeros(t, dtype=bool)
     tolerated = np.zeros((t, taint_vocab.size), dtype=bool)
+    req_aff = np.zeros(t, dtype=bool)
+    pref_aff = np.zeros(t, dtype=bool)
+    cores_arr = np.empty(t, dtype=object)
     uids: List[str] = []
 
     taints = taint_vocab.taints
@@ -394,6 +413,9 @@ def build_task_tensors_columnar(
         job_idx[base : base + n] = jobs.index.get(job.uid, -1)
         priority[base : base + n] = st.priority[rows]
         creation[base : base + n] = st.creation[rows]
+        req_aff[base : base + n] = st.req_aff[rows]
+        pref_aff[base : base + n] = st.pref_aff[rows]
+        cores_arr[base : base + n] = st.cores[rows]
         uids.extend(st.uids[rows].tolist())
         # Only rows whose pod carries a selector or tolerations need the
         # per-pod extraction walk; an unconstrained pod contributes exactly
@@ -432,6 +454,9 @@ def build_task_tensors_columnar(
         selector=selector,
         has_unknown_selector=has_unknown,
         tolerated=tolerated,
+        req_aff=req_aff,
+        pref_aff=pref_aff,
+        cores=cores_arr,
     )
 
 

@@ -72,6 +72,11 @@ class SchedulerCache(Cache):
         from scheduler_tpu.api.tensors import NodeStaticCache
 
         self.node_tensor_cache = NodeStaticCache()
+        # Per-signature static-mask/score rows memoized across cycles by the
+        # device-predicate builders (plugins/predicates.py): {plugin: entry},
+        # each entry keyed by (node generation, vocab widths) and dropped
+        # wholesale when its key goes stale.
+        self.static_mask_cache: Dict[str, dict] = {}
         self.queues: Dict[str, QueueInfo] = {}
         self.priority_classes: Dict[str, int] = {}
 

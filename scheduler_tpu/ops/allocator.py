@@ -84,13 +84,18 @@ def build_static_tensors(ssn, st: SnapshotTensors, n_bucket: int):
     t_count = max(st.tasks.count, 1)
     base = np.asarray(base_static_mask(t_count, jnp.asarray(st.nodes.ready)))
     for name, builder in ssn.device_predicates.items():
-        contribution = np.asarray(builder(st))
-        base = base & contribution
+        contribution = builder(st)
+        if contribution is None:
+            continue  # builder declared "no constraint this session"
+        base = base & np.asarray(contribution)
     mask = np.asarray(pad_rows(base.T.astype(bool), n_bucket, fill=False)).T
 
     score = np.zeros((t_count, st.nodes.count), dtype=np.float32)
     for name, builder in ssn.device_scorers.items():
-        score = score + np.asarray(builder(st), dtype=np.float32)
+        contribution = builder(st)
+        if contribution is None:
+            continue
+        score = score + np.asarray(contribution, dtype=np.float32)
     # Clamp to finite values ONCE here: the engines' any-feasible check reads
     # the winner's masked score against -inf, so a feasible node whose custom
     # scorer emitted -inf/NaN must not be mistaken for masked-out.  Doing it
@@ -109,10 +114,16 @@ def build_static_tensors_device(ssn, st: SnapshotTensors, n_bucket: int, t_bucke
     n = st.nodes.count
     mask = base_static_mask(t_count, jnp.asarray(st.nodes.ready))
     for name, builder in ssn.device_predicates.items():
-        mask = mask & jnp.asarray(builder(st))
+        contribution = builder(st)
+        if contribution is None:
+            continue  # builder declared "no constraint this session"
+        mask = mask & jnp.asarray(contribution)
     score = jnp.zeros((t_count, n), dtype=jnp.float32)
     for name, builder in ssn.device_scorers.items():
-        score = score + jnp.asarray(builder(st), dtype=jnp.float32)
+        contribution = builder(st)
+        if contribution is None:
+            continue
+        score = score + jnp.asarray(contribution, dtype=jnp.float32)
     # One-time finite clamp (see build_static_tensors) — never in the loop.
     score = jnp.nan_to_num(score, nan=0.0, posinf=1e30, neginf=-1e30)
     mask = jnp.pad(

@@ -78,16 +78,25 @@ class NodeOrderPlugin(Plugin):
         ssn.device_weighted_plugins.add(self.name())
 
         if w_aff:
-            task_index: Dict[str, TaskInfo] = {}
-            for job in ssn.jobs.values():
-                task_index.update(job.tasks)
 
-            def affinity_scorer(st) -> np.ndarray:
-                score = np.zeros((st.tasks.count, st.nodes.count), dtype=np.float32)
+            def affinity_scorer(st):
+                """Preferred-affinity [T, N] contribution, or None when no
+                task carries preferred terms — the overwhelmingly common
+                cycle allocates nothing here (the flags come from the job
+                stores' columnar ``pref_aff``, no uid->task dict is built)."""
+                t = st.tasks.count
+                rows = (
+                    np.nonzero(st.tasks.pref_aff[:t])[0]
+                    if st.tasks.pref_aff.shape[0] >= t
+                    else np.zeros(0, dtype=np.int64)
+                )
+                if rows.shape[0] == 0:
+                    return None
+                score = np.zeros((t, st.nodes.count), dtype=np.float32)
                 node_specs = [ssn.nodes[name].node for name in st.nodes.names]
-                for i, uid in enumerate(st.tasks.uids):
-                    task = task_index.get(uid)
-                    if task is None or task.pod.affinity is None or not task.pod.affinity.node_preferred:
+                for i in rows.tolist():
+                    task = st.tasks.cores[i]
+                    if task is None or task.pod.affinity is None:
                         continue
                     for j, spec in enumerate(node_specs):
                         if spec is not None:

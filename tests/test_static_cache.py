@@ -1,0 +1,158 @@
+"""Cross-cycle static-mask signature cache + store flag columns.
+
+Pins the round-3 memoization surface: rows cached on the owning cache are
+REUSED across cycles for recurring signatures, invalidated when the node
+world changes (node_generation key), and the columnar pod-spec flags
+(dyn_pred / req_aff / pref_aff) drive the plugin sweeps that used to
+materialize task views.
+"""
+
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.apis.objects import Affinity, NodeSelectorRequirement, PodAffinityTerm
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import close_session, get_action, open_session
+from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
+
+CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: predicates
+  - name: nodeorder
+"""
+
+
+def _zone_cluster():
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    for i in range(4):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": 8000, "memory": 16 * 2**30, "pods": 50},
+            labels={"zone": "za" if i % 2 else "zb"},
+        ))
+    return cache
+
+
+def _add_zone_gang(cache, name, zone, n_tasks=2):
+    pg = build_pod_group(name, min_member=n_tasks)
+    pg.status.phase = "Inqueue"
+    cache.add_pod_group(pg)
+    pods = []
+    for t in range(n_tasks):
+        pod = build_pod(name=f"{name}-{t}", req={"cpu": 500, "memory": 2**29},
+                        groupname=name)
+        pod.node_selector = {"zone": zone}
+        cache.add_pod(pod)
+        pods.append(pod)
+    return pg, pods
+
+
+def _run_cycle(cache):
+    conf = parse_scheduler_conf(CONF)
+    ssn = open_session(cache, conf.tiers)
+    get_action("allocate").execute(ssn)
+    close_session(ssn)
+
+
+def test_signature_rows_are_reused_across_cycles():
+    cache = _zone_cluster()
+    _add_zone_gang(cache, "a", "za")
+    _add_zone_gang(cache, "b", "zb")
+    _run_cycle(cache)
+    entry = cache.static_mask_cache.get("predicates")
+    assert entry is not None and entry["buffer"] is not None
+    rows_after_first = entry["buffer"].shape[0]
+    buffer_id = id(entry["buffer"])
+    assert rows_after_first >= 2  # one row per zone signature
+
+    # Churn with the SAME signatures: no new rows, same buffer object.
+    _add_zone_gang(cache, "c", "za")
+    _add_zone_gang(cache, "d", "zb")
+    _run_cycle(cache)
+    entry = cache.static_mask_cache["predicates"]
+    assert entry["buffer"].shape[0] == rows_after_first
+    assert id(entry["buffer"]) == buffer_id
+
+    # A NEW signature appends a row without recomputing the old ones.
+    _add_zone_gang(cache, "e", "zc")  # unknown zone: distinct signature
+    _run_cycle(cache)
+    entry = cache.static_mask_cache["predicates"]
+    assert entry["buffer"].shape[0] == rows_after_first + 1
+
+
+def test_node_change_invalidates_signature_cache_and_masks():
+    cache = _zone_cluster()
+    _add_zone_gang(cache, "a", "za")
+    _run_cycle(cache)
+    key_before = cache.static_mask_cache["predicates"]["key"]
+    binds_before = dict(cache.binder.binds)
+    assert all(v in ("n1", "n3") for k, v in binds_before.items())  # za nodes
+
+    # Relabel the za nodes to zb: node_generation bumps, the cache key
+    # changes, and a fresh za gang must now be unschedulable.
+    for i in (1, 3):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": 8000, "memory": 16 * 2**30, "pods": 50},
+            labels={"zone": "zb"},
+        ))
+    _add_zone_gang(cache, "f", "za")
+    _run_cycle(cache)
+    entry = cache.static_mask_cache["predicates"]
+    assert entry["key"] != key_before, "node event did not rotate the cache key"
+    assert not any(k.startswith("default/f-") for k in cache.binder.binds), (
+        "stale signature mask placed a za pod after the zone vanished"
+    )
+
+
+def test_store_flags_route_plugin_sweeps():
+    cache = _zone_cluster()
+    pg = build_pod_group("flags", min_member=1)
+    pg.status.phase = "Inqueue"
+    cache.add_pod_group(pg)
+    dyn = build_pod(name="flags-dyn", req={"cpu": 100, "memory": 2**28},
+                    groupname="flags")
+    dyn.host_ports = [8080]
+    cache.add_pod(dyn)
+    req = build_pod(name="flags-req", req={"cpu": 100, "memory": 2**28},
+                    groupname="flags")
+    req.affinity = Affinity(node_required=[[NodeSelectorRequirement(
+        key="zone", operator="In", values=["za"])]])
+    cache.add_pod(req)
+    pref = build_pod(name="flags-pref", req={"cpu": 100, "memory": 2**28},
+                     groupname="flags")
+    pref.affinity = Affinity(node_preferred=[(5, [NodeSelectorRequirement(
+        key="zone", operator="In", values=["zb"])])])
+    cache.add_pod(pref)
+    anti = build_pod(name="flags-anti", req={"cpu": 100, "memory": 2**28},
+                     groupname="flags", labels={"app": "x"})
+    anti.affinity = Affinity(pod_anti_affinity=[PodAffinityTerm(
+        label_selector={"app": "x"})])
+    cache.add_pod(anti)
+
+    job = cache.jobs["default/flags"]
+    st = job.store
+    rows = {t.pod.name: st.row_of[t.uid] for t in job.tasks.values()}
+    assert st.dyn_pred[rows["flags-dyn"]] and st.dyn_pred[rows["flags-anti"]]
+    assert not st.dyn_pred[rows["flags-req"]] and not st.dyn_pred[rows["flags-pref"]]
+    assert st.req_aff[rows["flags-req"]] and not st.req_aff[rows["flags-dyn"]]
+    assert st.pref_aff[rows["flags-pref"]] and not st.pref_aff[rows["flags-req"]]
+
+    # The sweeps act on the flags: dynamic tasks publish to the session,
+    # required-affinity placement is enforced, preferred affinity scores.
+    conf = parse_scheduler_conf(CONF)
+    ssn = open_session(cache, conf.tiers)
+    get_action("allocate").execute(ssn)
+    dyn_uids = ssn.device_dynamic_task_uids
+    assert {u for u in dyn_uids} == {
+        t.uid for t in job.tasks.values() if t.pod.name in ("flags-dyn", "flags-anti")
+    }
+    close_session(ssn)
+    binds = cache.binder.binds
+    assert binds["default/flags-req"] in ("n1", "n3")   # za only
+    assert binds["default/flags-pref"] in ("n0", "n2")  # zb preferred

@@ -22,6 +22,13 @@ observations make the sweep O(1) per task instead:
 plugin registered a static variant; scoring only from "nodeorder"); anything
 else falls back to the reference's per-task sweep.
 
+Granularity note: predicate-side gating IS per task (``task_sig`` returns
+None for scan-dynamic tasks, which take the exact path individually), but
+the scorer-side gate is per SESSION by necessity — a custom scorer changes
+every task's node ordering, so there is no per-task subset it could soundly
+exclude.  A session with one custom scorer therefore runs the reference
+O(T x N) sweeps; the builtin set covers every BASELINE scenario.
+
 ``RunningLedger`` records which (queue, job) pairs have Running tasks on each
 node, so the victim hunt can skip nodes with no candidate tasks at all
 without enumerating (and cloning) their task maps.  This is EXACT: a node

@@ -60,9 +60,11 @@ def main() -> None:
     # the measured cycle; warm with the exact same problem instead.
     one_cycle(n_nodes, n_pods, tasks_per_job)
 
-    # Median of three measured cycles: the tunneled-TPU round trips have
-    # multi-hundred-ms jitter, and the metric is the STEADY-state cycle rate.
-    runs = [one_cycle(n_nodes, n_pods, tasks_per_job) for _ in range(1 if smoke else 3)]
+    # Median of five measured cycles: the tunneled-TPU round trips have
+    # multi-hundred-ms jitter with occasional multi-second outliers, and the
+    # metric is the STEADY-state cycle rate — a 5-sample median stays honest
+    # while shrugging off up to two bad network draws.
+    runs = [one_cycle(n_nodes, n_pods, tasks_per_job) for _ in range(1 if smoke else 5)]
     if any(b != runs[0][0] for b, _ in runs) or runs[0][0] == 0:
         print(json.dumps({"metric": "pods_per_sec", "value": 0.0, "unit": "pods/s",
                           "vs_baseline": 0.0,

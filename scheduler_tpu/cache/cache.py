@@ -394,6 +394,34 @@ class SchedulerCache(Cache):
 
         self._submit_io(self._bind_one, task, hostname)
 
+    # -- lifecycle events (reference Recorder.Eventf, cache.go:482,440,516) ----
+
+    def _pod_event_batch(self, pods_hosts, etype: str, reason: str, fmt) -> None:
+        """ONE batched, best-effort emission per call — payload construction
+        AND delivery are both guarded, so an event problem can never be
+        mistaken for a bind/evict failure (the callers keep emission outside
+        their RPC try blocks for the same reason)."""
+        if not getattr(self.status_updater, "RECORDS_EVENTS", False):
+            return
+        try:
+            events = [
+                {"namespace": pod.namespace, "name": pod.name, "type": etype,
+                 "reason": reason, "message": fmt(pod, host)}
+                for pod, host in pods_hosts
+            ]
+            if events:
+                self.status_updater.record_events(events)
+        except Exception:
+            logger.exception("event emission failed (ignored)")
+
+    @staticmethod
+    def _scheduled_msg(pod, host) -> str:
+        return f"Successfully assigned {pod.namespace}/{pod.name} to {host}"
+
+    @staticmethod
+    def _bind_failed_msg(pod, host) -> str:
+        return f"Binding rejected: {pod.namespace}/{pod.name} on {host}"
+
     def _bind_one(self, task: TaskInfo, hostname: str) -> None:
         try:
             self.binder.bind(task.pod, hostname)
@@ -401,7 +429,15 @@ class SchedulerCache(Cache):
                 task.pod.node_name = hostname
         except Exception:
             logger.exception("bind of %s to %s failed; resyncing", task.uid, hostname)
+            self._pod_event_batch(
+                [(task.pod, hostname)], "Warning", "FailedScheduling",
+                self._bind_failed_msg,
+            )
             self._resync_failed_bind(task, hostname)
+            return
+        self._pod_event_batch(
+            [(task.pod, hostname)], "Normal", "Scheduled", self._scheduled_msg
+        )
 
     # Upper bound on binder RPCs per async chunk; the actual chunk shrinks so a
     # batch spreads across every io worker (chunk ~ N/workers, floor 16).
@@ -484,6 +520,15 @@ class SchedulerCache(Cache):
                 for task, hostname in chunk:
                     if task.pod.uid not in failed_uids:
                         task.pod.node_name = hostname
+            self._pod_event_batch(
+                [(task.pod, hostname) for task, hostname in chunk
+                 if task.pod.uid not in failed_uids],
+                "Normal", "Scheduled", self._scheduled_msg,
+            )
+            self._pod_event_batch(
+                [(by_uid[uid][0].pod, by_uid[uid][1]) for uid in failed_uids],
+                "Warning", "FailedScheduling", self._bind_failed_msg,
+            )
             for uid in failed_uids:
                 task, hostname = by_uid[uid]
                 logger.error("bind of %s to %s failed; resyncing", task.uid, hostname)
@@ -605,7 +650,17 @@ class SchedulerCache(Cache):
             for pod, hostname in pairs:
                 if pod.uid not in failed_uids:
                     pod.node_name = hostname
+        self._pod_event_batch(
+            [(pod, hostname) for pod, hostname in pairs
+             if pod.uid not in failed_uids],
+            "Normal", "Scheduled", self._scheduled_msg,
+        )
         if failed_uids:
+            self._pod_event_batch(
+                [(pod, hostname) for pod, hostname in pairs
+                 if pod.uid in failed_uids],
+                "Warning", "FailedScheduling", self._bind_failed_msg,
+            )
             for pod, hostname in pairs:
                 if pod.uid not in failed_uids:
                     continue
@@ -641,6 +696,13 @@ class SchedulerCache(Cache):
                         node2 = self.nodes[task2.node_name]
                         if task2.uid in node2.tasks:
                             node2.update_task(task2)
+                return
+            # Event emission stays OUTSIDE the try: a recorder problem must
+            # never roll back an eviction that actually happened.
+            self._pod_event_batch(
+                [(task.pod, task.node_name)], "Normal", "Evict",
+                lambda p, h: f"Evicted pod {p.namespace}/{p.name} ({reason})",
+            )
 
         self._submit_io(do_evict)
 
@@ -661,6 +723,8 @@ class SchedulerCache(Cache):
         if not job.status_count(TaskStatus.PENDING):
             return  # nothing unscheduled; skip without materializing views
         base_msg = job.job_fit_errors or ALL_NODE_UNAVAILABLE
+        records_events = getattr(self.status_updater, "RECORDS_EVENTS", False)
+        events = []
         for status, tasks in job.task_status_index.items():
             if status != TaskStatus.PENDING:
                 continue
@@ -672,6 +736,17 @@ class SchedulerCache(Cache):
                     {"type": "PodScheduled", "status": "False",
                      "reason": "Unschedulable", "message": msg},
                 )
+                if records_events:
+                    events.append({
+                        "namespace": task.namespace, "name": task.name,
+                        "type": "Warning", "reason": "FailedScheduling",
+                        "message": msg,
+                    })
+        if events:
+            try:
+                self.status_updater.record_events(events)
+            except Exception:
+                logger.exception("event emission failed (ignored)")
 
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
         self.volume_binder.allocate_volumes(task, hostname)

@@ -86,15 +86,22 @@ class FakeEvictor(Evictor):
 
 
 class FakeStatusUpdater(StatusUpdater):
-    def __init__(self) -> None:
+    def __init__(self, record_events: bool = False) -> None:
         self.pod_conditions: List = []
         self.pod_group_updates: List = []
+        self.events: List = []
+        # Opt-in: the synthetic benchmarks run with the default fake, and
+        # event-payload construction must stay off their commit path.
+        self.RECORDS_EVENTS = record_events
 
     def update_pod_condition(self, pod, condition) -> None:
         self.pod_conditions.append((pod, condition))
 
     def update_pod_group(self, job) -> None:
         self.pod_group_updates.append(job)
+
+    def record_events(self, events: list) -> None:
+        self.events.extend(events)
 
 
 class FakeVolumeBinder(VolumeBinder):

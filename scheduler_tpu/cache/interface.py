@@ -66,6 +66,18 @@ class StatusUpdater(abc.ABC):
     @abc.abstractmethod
     def update_pod_group(self, job: "JobInfo") -> None: ...
 
+    # The cache builds event payloads ONLY when this is True — a no-op
+    # recorder must not cost 100k dict constructions per cycle.
+    RECORDS_EVENTS = False
+
+    def record_events(self, events: list) -> None:
+        """Emit lifecycle events — the reference's Recorder.Eventf calls on
+        Scheduled / Evict / FailedScheduling (cache.go:482,440,516).  Each
+        event is a dict: {"namespace", "name", "type", "reason", "message"}.
+        Batched (one call per bind/evict chunk) and best-effort: the default
+        drops them, implementations must never let an event failure affect
+        scheduling."""
+
 
 class VolumeBinder(abc.ABC):
     @abc.abstractmethod

@@ -135,8 +135,18 @@ class HttpVolumeBinder(VolumeBinder):
 
 
 class HttpStatusUpdater(StatusUpdater):
+    # Lifecycle events (Scheduled/Evict/FailedScheduling) cross the wire —
+    # the reference's Recorder.Eventf against the API server.
+    RECORDS_EVENTS = True
+
     def __init__(self, base: str) -> None:
         self.base = base
+
+    def record_events(self, events: list) -> None:
+        try:
+            _post(self.base, "/events", {"events": events})
+        except Exception:
+            logger.warning("event batch dropped (%d events)", len(events))
 
     def update_pod_condition(self, pod, condition) -> None:
         # The cache passes conditions as plain dicts (cache.record_job_status_

@@ -39,6 +39,7 @@ class MockState:
         self.bind_calls = 0
         self.evict_calls = 0
         self.status_updates: List[Dict] = []
+        self.event_log: List[Dict] = []  # lifecycle events (Eventf analogue)
         # PVC ledger: claim -> {"node": ..., "bound": bool}; allocate assigns
         # the claim to a node (AssumePodVolumes analogue), bind finalizes it
         # (BindPodVolumes).  A claim already assigned to a DIFFERENT node
@@ -164,6 +165,10 @@ def make_handler(state: MockState):
                 with state.lock:
                     self._json(state.volumes)
                 return
+            if url.path == "/events-log":
+                with state.lock:
+                    self._json({"events": list(state.event_log)})
+                return
             self._json({"error": "not found"}, 404)
 
         def do_POST(self) -> None:
@@ -275,6 +280,14 @@ def make_handler(state: MockState):
             if url.path == "/pod-condition":
                 with state.lock:
                     state.status_updates.append(body)
+                self._json({"ok": True})
+                return
+            if url.path == "/events":
+                # Lifecycle event sink (Recorder.Eventf analogue); bounded.
+                with state.lock:
+                    state.event_log.extend(body.get("events", []))
+                    if len(state.event_log) > 50_000:
+                        del state.event_log[:25_000]
                 self._json({"ok": True})
                 return
             self._json({"error": "not found"}, 404)

@@ -204,6 +204,39 @@ def test_affinity_gang_places_over_the_wire(wire):
     _wait(placed, what="affinity gang on distinct za nodes")
 
 
+def test_lifecycle_events_cross_the_wire(wire):
+    """Scheduled and Evict events reach the server's event log — the
+    reference's Recorder.Eventf against the API server (cache.go:482,440).
+    Self-sufficient: drives its own bind (a fresh pod) and its own eviction
+    (an over-subscribed same-queue preemption on a dedicated node)."""
+    _add("node", {"name": "ev-0", "labels": {"pool": "ev"},
+                  "allocatable": {"cpu": 1000, "memory": 2 * 2**30, "pods": 110}})
+    _add("podgroup", {"name": "ev-low", "queue": "q2", "minMember": 1,
+                      "phase": "Running"})
+    for i in range(2):
+        _add("pod", {"name": f"ev-low-{i}", "group": "ev-low", "nodeName": "ev-0",
+                     "phase": "Running", "priority": 1,
+                     "nodeSelector": {"pool": "ev"},
+                     "containers": [{"cpu": 500, "memory": 2**30}]})
+    _add("podgroup", {"name": "ev-high", "queue": "q2", "minMember": 1,
+                      "phase": "Inqueue"})
+    _add("pod", {"name": "ev-high-0", "group": "ev-high", "priority": 9,
+                 "nodeSelector": {"pool": "ev"},
+                 "containers": [{"cpu": 500, "memory": 2**30}]})
+
+    def events_complete():
+        events = _get("/events-log")["events"]
+        mine = [e for e in events if e["name"].startswith("ev-")]
+        reasons = {e["reason"] for e in mine}
+        return "Scheduled" in reasons and "Evict" in reasons
+
+    _wait(events_complete, what="Scheduled + Evict events for the ev- workload")
+    events = [e for e in _get("/events-log")["events"] if e["name"].startswith("ev-")]
+    scheduled = [e for e in events if e["reason"] == "Scheduled"]
+    assert all(e["type"] == "Normal" for e in scheduled)
+    assert any("Successfully assigned" in e["message"] for e in scheduled)
+
+
 def test_volume_claims_cross_the_wire(wire):
     """A claim-bearing pod drives the /allocate-volumes + /bind-volumes RPCs
     (reference cache.go:189-209): the server's PVC ledger ends with the claim

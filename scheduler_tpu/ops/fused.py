@@ -1250,6 +1250,17 @@ class FusedAllocator:
 
         return max(1, int(os.environ.get("SCHEDULER_TPU_WINDOW", "8")))
 
+    def _codes(self) -> np.ndarray:
+        """Placement codes, executing the device program at most once: it is
+        pure, so a caller that already ran ``_execute`` (profilers, probes)
+        must not pay a second device run booked under decode.  ``_execute``
+        itself always re-runs (the kernel parity tests flip engine flags
+        between direct calls)."""
+        encoded = getattr(self, "_encoded", None)
+        if encoded is None:
+            encoded = self._execute()
+        return encoded
+
     def _execute(self) -> np.ndarray:
         if self.use_mega:
             from scheduler_tpu.ops import megakernel as _mk
@@ -1297,7 +1308,7 @@ class FusedAllocator:
         """
         from scheduler_tpu import native
 
-        encoded = self._execute()
+        encoded = self._codes()
         t = self.flat_count
         names_arr = np.asarray(self.node_names, dtype=object)
 
@@ -1355,7 +1366,7 @@ class FusedAllocator:
         [(task, node_name | None, pipelined, failed)] — same row shape as
         ``DeviceAllocator.place_job``, truncated at each job's pop boundary.
         (Object-path decode; the production commit uses ``run_columnar``.)"""
-        encoded = self._execute()
+        encoded = self._codes()
 
         # One bulk conversion: per-element int(ndarray[i]) costs ~100x a list
         # element access at this scale.

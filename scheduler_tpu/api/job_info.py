@@ -33,6 +33,7 @@ from scheduler_tpu.api.types import TaskStatus, allocated_status, get_task_statu
 from scheduler_tpu.api.unschedule_info import FitErrors
 from scheduler_tpu.api.vocab import ResourceVocabulary
 from scheduler_tpu.apis.objects import PodGroup, PodSpec
+from scheduler_tpu.utils.assertions import _panic_on_error
 
 # int value -> TaskStatus object (column values decode through this).
 _STATUS_OBJ: Dict[int, TaskStatus] = {int(s): s for s in TaskStatus}
@@ -883,6 +884,7 @@ class JobInfo:
         status: TaskStatus,
         net_add: Optional[np.ndarray] = None,
         assume_unique: bool = False,
+        assume_from: Optional[TaskStatus] = None,
     ) -> None:
         """Vectorized ``update_task_status`` over row indices: one column
         write, O(statuses) count updates, one dense aggregate delta.
@@ -892,10 +894,52 @@ class JobInfo:
         non-allocated to an allocated status.  ``assume_unique`` skips the
         duplicate sort for callers whose rows are unique by construction (the
         device engines place each row at most once per action).
+        ``assume_from``: every row currently holds this status (engine rows
+        are PENDING by construction; a ready job's deferred dispatch moves
+        ALLOCATED rows) — skips the old-status gather and its histogram.
+        Verified under PANIC_ON_ERROR (the test regime).
         """
         if len(rows) == 0:
             return
         st = self._store
+        if assume_from is not None and len(rows) > 1:
+            rows = np.asarray(rows)
+            if not assume_unique:
+                rows = np.unique(rows)
+            from_val = int(assume_from)
+            new_val = int(status)
+            if _panic_on_error() and not bool(
+                np.all(st.status[rows] == np.int16(from_val))
+            ):
+                raise AssertionError(
+                    f"assume_from={assume_from} violated in bulk status update"
+                )
+            if from_val == new_val:
+                return
+            n = rows.shape[0]
+            was_alloc = bool(from_val & _ALLOC_BITS)
+            now_alloc = bool(new_val & _ALLOC_BITS)
+            if was_alloc and not now_alloc:
+                if net_add is not None:
+                    raise ValueError(
+                        "net_add given but batch contains an allocated->non-allocated transition"
+                    )
+                req, _, _ = self.request_matrices()
+                self.allocated.sub_array(self._pad_row(req[rows].sum(axis=0)))
+            elif now_alloc and not was_alloc:
+                if net_add is not None:
+                    self.allocated.add_array(self._pad_row(net_add))
+                else:
+                    req, _, _ = self.request_matrices()
+                    self.allocated.add_array(
+                        self._pad_row(req[rows].sum(axis=0)),
+                        bool(st.has_scalars[rows].any()),
+                    )
+            st.status[rows] = new_val
+            self._count_add(from_val, -n)
+            self._count_add(new_val, n)
+            self._index = None  # rebuilt lazily; views stay valid
+            return
         if len(rows) == 1:
             # Scalar fast path: thousands of single-task (shadow-PodGroup)
             # jobs each pay this per cycle — the vector machinery below costs

@@ -19,6 +19,8 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
+import numpy as np
+
 from scheduler_tpu.api.cluster_info import ClusterInfo
 from scheduler_tpu.api.job_info import JobInfo, TaskInfo, job_id_for_pod
 from scheduler_tpu.api.node_info import NodeInfo
@@ -569,11 +571,13 @@ class SchedulerCache(Cache):
             self.volume_binder.bind_volumes(job.view_for_row(int(r)))
 
     def bind_bulk_columnar(self, items, plan) -> None:
-        """Columnar ``bind_bulk``: (session_job, rows) batches applied to the
-        cache's own jobs by ROW — valid because the session job clone shares
-        the cache job's row space and the store generation proves the task set
-        has not drifted since the snapshot.  On any drift the whole batch
-        falls back to the uid-resolving object path (same atomic semantics).
+        """Columnar ``bind_bulk``: (session_job, rows, ids) batches applied to
+        the cache's own jobs by ROW — valid because the session job clone
+        shares the cache job's row space and the store generation proves the
+        task set has not drifted since the snapshot.  On any drift the whole
+        batch falls back to the uid-resolving object path (same atomic
+        semantics).  ``ids`` are the engine node indices per row, so the
+        per-node grouping is an integer sort, not a name-string sort.
 
         ``plan`` = CommitPlan.bind_deltas output (required here — the session
         only routes through this path when the plan covers the batch).
@@ -582,48 +586,63 @@ class SchedulerCache(Cache):
         with self.mutex:
             resolved = []
             distinct_nodes = set(node_rows)
-            for sjob, rows in items:
+            for sjob, rows, ids in items:
                 cjob = self.jobs.get(sjob.uid)
                 if cjob is None or cjob.store.gen != sjob.store.gen:
                     # Job deleted or task set drifted mid-cycle: resolve the
                     # whole batch by uid (drift-tolerant skip semantics).
                     resolved = None
                     break
-                resolved.append((cjob, rows, sjob.store.node_name[rows]))
+                resolved.append((cjob, rows, sjob.store.node_name[rows], ids))
             if resolved is not None and any(
                 hostname not in self.nodes for hostname in distinct_nodes
             ):
                 resolved = None  # a target node vanished: same fallback
             if resolved is None:
                 tasks = [
-                    sjob.view_for_row(int(r)) for sjob, rows in items for r in rows
+                    sjob.view_for_row(int(r)) for sjob, rows, _ids in items for r in rows
                 ]
                 self.bind_bulk(tasks, None)
                 return
-            per_node: Dict[str, list] = {}
-            for cjob, rows, names in resolved:
+            for cjob, rows, names, _ids in resolved:
                 cjob.bulk_update_status_rows(
                     rows, TaskStatus.BINDING, net_add=job_rows.get(cjob.uid),
                     assume_unique=True,  # engine rows: one placement per row
+                    assume_from=TaskStatus.PENDING,  # gen match proves no drift
                 )
                 cjob.set_node_names_rows(rows, names)
-                cores_sel = cjob.store.cores[rows]
-                for core, name in zip(cores_sel.tolist(), names.tolist()):
-                    per_node.setdefault(name, []).append(core)
-            for hostname, cores in per_node.items():
-                row, count = node_rows[hostname]
-                # Bind batches are allocated-status only: idle -= row,
-                # used += row, releasing untouched.
-                self.nodes[hostname].add_deferred_batches(
-                    [(cores, TaskStatus.BINDING)], (row, None, row, count, 0)
+            # Per-node batches via ONE stable integer argsort across the whole
+            # batch; each group's name resolves from its first member.
+            ids_all = (
+                np.concatenate([ids for *_, ids in resolved])
+                if resolved
+                else np.zeros(0, dtype=np.int32)
+            )
+            if ids_all.shape[0]:
+                names_all = np.concatenate([names for _, _, names, _ in resolved])
+                cores_all = np.concatenate(
+                    [cjob.store.cores[rows] for cjob, rows, _, _ in resolved]
                 )
+                order = np.argsort(ids_all, kind="stable")
+                cores_sorted = cores_all[order]
+                uniq, starts = np.unique(ids_all[order], return_index=True)
+                bounds = starts.tolist() + [order.shape[0]]
+                for g in range(uniq.shape[0]):
+                    hostname = names_all[order[starts[g]]]
+                    row, count = node_rows[hostname]
+                    # Bind batches are allocated-status only: idle -= row,
+                    # used += row, releasing untouched.
+                    self.nodes[hostname].add_deferred_batches(
+                        [(cores_sorted[bounds[g] : bounds[g + 1]], TaskStatus.BINDING)],
+                        (row, None, row, count, 0),
+                    )
 
         # Chunk against the WHOLE batch: with many jobs there is already
         # ample parallelism, and per-job sizing degenerates to floor-size
         # chunks (1000 jobs x 100 rows -> 7000 submissions of 16).
-        total = sum(len(rows) for _cjob, rows, _names in resolved)
+        total = sum(len(rows) for _cjob, rows, _names, _ids in resolved)
         chunk = max(16, min(self._BIND_CHUNK, -(-total // self._IO_WORKERS)))
-        for cjob, rows, names in resolved:
+        for cjob, rows, names, _ids in resolved:
             n = len(rows)
             for start in range(0, n, chunk):
                 self._submit_io(
@@ -636,37 +655,43 @@ class SchedulerCache(Cache):
     def _bind_chunk_columnar(self, cjob, rows, names) -> None:
         from scheduler_tpu.cache.interface import BulkBindError
 
-        cores = cjob.store.cores[rows]
-        pairs = [(core.pod, name) for core, name in zip(cores.tolist(), names.tolist())]
+        cores = cjob.store.cores[rows].tolist()
+        names_l = names.tolist()
         failed_uids = set()
         try:
-            self.binder.bind_bulk(pairs)
+            # Columnar seam: cores expose .namespace/.name like PodSpecs do,
+            # so no (pod, hostname) pair tuples materialize on the commit path.
+            self.binder.bind_rows(cores, names_l)
         except BulkBindError as e:
             failed_uids = {pod.uid for pod, _ in e.failed}
         except Exception:
             logger.exception("bulk bind failed; resyncing chunk")
-            failed_uids = {pod.uid for pod, _ in pairs}
+            failed_uids = {core.uid for core in cores}
         with self.mutex:
-            for pod, hostname in pairs:
-                if pod.uid not in failed_uids:
-                    pod.node_name = hostname
+            if failed_uids:
+                for core, hostname in zip(cores, names_l):
+                    if core.uid not in failed_uids:
+                        core.pod.node_name = hostname
+            else:
+                for core, hostname in zip(cores, names_l):
+                    core.pod.node_name = hostname
         self._pod_event_batch(
-            [(pod, hostname) for pod, hostname in pairs
-             if pod.uid not in failed_uids],
+            ((core.pod, hostname) for core, hostname in zip(cores, names_l)
+             if core.uid not in failed_uids),
             "Normal", "Scheduled", self._scheduled_msg,
         )
         if failed_uids:
             self._pod_event_batch(
-                [(pod, hostname) for pod, hostname in pairs
-                 if pod.uid in failed_uids],
+                ((core.pod, hostname) for core, hostname in zip(cores, names_l)
+                 if core.uid in failed_uids),
                 "Warning", "FailedScheduling", self._bind_failed_msg,
             )
-            for pod, hostname in pairs:
-                if pod.uid not in failed_uids:
+            for core, hostname in zip(cores, names_l):
+                if core.uid not in failed_uids:
                     continue
-                logger.error("bind of %s to %s failed; resyncing", pod.uid, hostname)
+                logger.error("bind of %s to %s failed; resyncing", core.uid, hostname)
                 with self.mutex:
-                    row = cjob.store.row_of.get(pod.uid)
+                    row = cjob.store.row_of.get(core.uid)
                     task = cjob.view_for_row(row) if row is not None else None
                 if task is not None:
                     self._resync_failed_bind(task, hostname)

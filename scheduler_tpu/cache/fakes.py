@@ -44,29 +44,66 @@ class Channel:
 
 
 class FakeBinder(Binder):
+    """Records bind intents.  Columnar-aware: ``bind_rows`` batches are stored
+    by REFERENCE and the ``ns/name`` key strings only materialize when the
+    ``binds`` dict is actually read — key construction for a 100k-bind batch
+    is test/inspection cost, not commit-path cost."""
+
     def __init__(self) -> None:
         self.lock = threading.Lock()
-        self.binds: dict = {}
-        self.channel = Channel()
+        self._cond = threading.Condition(self.lock)
+        self._folded: dict = {}
+        self._keys: List[str] = []  # bind-order key log (drives wait())
+        self._batches: list = []  # deferred (pods, hostnames) batches
+        self._count = 0
+        self._served = 0
+
+    def _fold_locked(self) -> None:
+        for pods, hostnames in self._batches:
+            folded = self._folded
+            append = self._keys.append
+            for pod, hostname in zip(pods, hostnames):
+                key = f"{pod.namespace}/{pod.name}"
+                folded[key] = hostname
+                append(key)
+        self._batches.clear()
+
+    @property
+    def binds(self) -> dict:
+        with self.lock:
+            self._fold_locked()
+            return self._folded
 
     def bind(self, pod, hostname: str) -> None:
-        with self.lock:
+        with self._cond:
+            self._fold_locked()
             key = f"{pod.namespace}/{pod.name}"
-            self.binds[key] = hostname
-            self.channel.put(key)
+            self._folded[key] = hostname
+            self._keys.append(key)
+            self._count += 1
+            self._cond.notify_all()
 
     def bind_bulk(self, pairs) -> None:
-        with self.lock:
-            keys = []
-            for pod, hostname in pairs:
-                key = f"{pod.namespace}/{pod.name}"
-                self.binds[key] = hostname
-                keys.append(key)
-            self.channel.put_many(keys)
+        self.bind_rows([p for p, _ in pairs], [h for _, h in pairs])
+
+    def bind_rows(self, pods, hostnames) -> None:
+        with self._cond:
+            self._batches.append((pods, hostnames))
+            self._count += len(hostnames)
+            self._cond.notify_all()
 
     def wait(self, n: int, timeout: float = 3.0) -> List[str]:
-        """Block until n binds were recorded (or raise queue.Empty)."""
-        return [self.channel.get(timeout=timeout) for _ in range(n)]
+        """Block until n more binds were recorded (or raise queue.Empty).
+        Concurrent waiters RESERVE disjoint key ranges up front (the channel
+        pop they replace was atomic per key)."""
+        with self._cond:
+            start = self._served
+            self._served = target = start + n
+            if not self._cond.wait_for(lambda: self._count >= target, timeout=timeout):
+                self._served = start  # un-reserve so a later wait can succeed
+                raise queue.Empty
+            self._fold_locked()
+            return self._keys[start:target]
 
 
 class FakeEvictor(Evictor):

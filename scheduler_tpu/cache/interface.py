@@ -51,6 +51,14 @@ class Binder(abc.ABC):
         if failed:
             raise BulkBindError(failed)
 
+    def bind_rows(self, pods, hostnames) -> None:
+        """Columnar ``bind_bulk``: parallel pod/hostname sequences, no pair
+        tuples.  ``pods`` elements only promise ``.namespace``/``.name`` (task
+        cores satisfy this as well as PodSpecs).  Same failure contract as
+        ``bind_bulk``; the default zips into it for binders that predate the
+        columnar path."""
+        self.bind_bulk(list(zip(pods, hostnames)))
+
 
 class Evictor(abc.ABC):
     @abc.abstractmethod
@@ -135,9 +143,9 @@ class Cache(abc.ABC):
             self.bind_volumes(job.view_for_row(int(r)))
 
     def bind_bulk_columnar(self, items: list, plan) -> None:
-        """Bind (session_job, rows) batches.  Default: materialize and use the
-        object path."""
-        tasks = [job.view_for_row(int(r)) for job, rows in items for r in rows]
+        """Bind (session_job, rows, node_ids) batches.  Default: materialize
+        and use the object path."""
+        tasks = [job.view_for_row(int(r)) for job, rows, _ids in items for r in rows]
         self.bind_bulk(tasks)
 
     @abc.abstractmethod

@@ -21,6 +21,8 @@ import itertools
 import logging
 from typing import Callable, Dict, List, Optional, TYPE_CHECKING
 
+import numpy as np
+
 from scheduler_tpu.api.job_info import JobInfo, TaskInfo
 from scheduler_tpu.api.node_info import NodeInfo
 from scheduler_tpu.api.queue_info import QueueInfo
@@ -57,7 +59,7 @@ class _LazyTaskViews:
         if views is None:
             views = self._views = [
                 job.view_for_row(int(r))
-                for job, rows, _names, _pipe in self._items
+                for job, rows, *_ in self._items
                 for r in rows
             ]
         return views
@@ -66,7 +68,7 @@ class _LazyTaskViews:
         return iter(self._materialize())
 
     def __len__(self) -> int:
-        return sum(len(rows) for _job, rows, _names, _pipe in self._items)
+        return sum(len(rows) for _job, rows, *_ in self._items)
 
     def __getitem__(self, i):
         return self._materialize()[i]
@@ -628,13 +630,37 @@ class Session:
             bind_plan = plan.bind_deltas(ready_uids) if plan_covers_bind else None
             self.cache.bind_bulk(to_bind, bind_plan)
 
+    def _job_ready_fusable(self) -> bool:
+        """True iff a job's post-batch readiness is PREDICTABLE from counts:
+        the job_ready dispatch is vacuous or the builtin gang count compare
+        (``JobInfo.ready``), and every allocate handler is bulk-capable (the
+        columnar fire prefers ``bulk_allocate_func``, whose contract is the
+        CommitPlan — only a per-task ``allocate_func`` walks views and could
+        observe the intermediate ALLOCATED status).  BINDING is ready-counting
+        (``ready_task_num``, job_info.go ReadyTaskNum), so writing a
+        predicted-ready batch straight to BINDING gives every later dispatch
+        the same answer as the two-step ALLOCATED -> BINDING walk."""
+        if set(self.job_ready_fns) - {"gang"}:
+            return False
+        return all(
+            eh.bulk_allocate_func is not None or eh.allocate_func is None
+            for eh in self.event_handlers
+        )
+
+    def _gang_ready_live(self) -> bool:
+        # Lazy import: ops.allocator pulls device modules at import time.
+        from scheduler_tpu.ops.allocator import gang_ready_active
+
+        return gang_ready_active(self)
+
     def bulk_apply_columnar(self, items, node_batches, plan) -> None:
         """Commit a whole device placement with NO per-task Python objects:
         the columnar equivalent of ``bulk_apply`` (same final state, argued
         there), driven by job-store row indices and the CommitPlan ledgers.
 
-        ``items``: [(job, rows, names, pipe)] — placed rows per job in
-        placement order, the target node name per row, and the pipelined mask.
+        ``items``: [(job, rows, names, ids, pipe)] — placed rows per job in
+        placement order, the target node name + engine node index per row,
+        and the pipelined mask.
         ``node_batches``: node name -> [(cores, status)] deferred node-side
         task records grouped by the engine.
         """
@@ -644,20 +670,53 @@ class Session:
         from scheduler_tpu.api.types import TaskStatus as TS
 
         job_alloc = plan.job_alloc()
-        affected: List[JobInfo] = []
-        for job, rows, names, pipe in items:
+        alloc_counts = plan.job_alloc_counts()
+        fuse_ok = self._job_ready_fusable()
+        gang_live = self._gang_ready_live() if fuse_ok else False
+
+        to_bind = []  # (job, rows, ids) — BINDING rows for the cache dispatch
+        ready_uids: List[str] = []
+        plan_covers_bind = True
+        deferred: List = []  # jobs whose readiness needs the full dispatch
+        for job, rows, names, ids, pipe in items:
             if len(rows) == 0:
                 continue
             alloc_rows = rows[~pipe]
             pipe_rows = rows[pipe]
             self.cache.allocate_volumes_rows(job, alloc_rows, names[~pipe])
-            job.bulk_update_status_rows(
-                alloc_rows, TS.ALLOCATED, net_add=job_alloc.get(job.uid),
-                assume_unique=True,  # engine rows: one placement per row
+            net = job_alloc.get(job.uid)
+            # Ready fusion: a fresh batch on a predictably-ready job goes
+            # straight to BINDING — one status pass instead of two.  Only
+            # when no ALLOCATED rows predate the batch (so the bind ledger
+            # provably covers exactly these rows).
+            fused = (
+                fuse_ok
+                and alloc_rows.shape[0] > 0
+                and job.status_count(TS.ALLOCATED) == 0
+                and (
+                    not gang_live
+                    or job.ready_task_num() + alloc_rows.shape[0] >= job.min_available
+                )
             )
-            job.bulk_update_status_rows(pipe_rows, TS.PIPELINED, assume_unique=True)
+            if fused:
+                self.cache.bind_volumes_rows(job, alloc_rows)
+                job.bulk_update_status_rows(
+                    alloc_rows, TS.BINDING, net_add=net, assume_unique=True,
+                    assume_from=TS.PENDING,
+                )
+                to_bind.append((job, alloc_rows, ids[~pipe]))
+                ready_uids.append(job.uid)
+            else:
+                job.bulk_update_status_rows(
+                    alloc_rows, TS.ALLOCATED, net_add=net,
+                    assume_unique=True,  # engine rows: one placement per row
+                    assume_from=TS.PENDING,
+                )
+                deferred.append((job, rows, ids, pipe))
+            job.bulk_update_status_rows(
+                pipe_rows, TS.PIPELINED, assume_unique=True, assume_from=TS.PENDING,
+            )
             job.set_node_names_rows(rows, names)
-            affected.append(job)
 
         node_deltas = plan.node_deltas()
         nodes = self.nodes
@@ -669,11 +728,7 @@ class Session:
 
         self._fire_allocate_bulk_columnar(items, plan)
 
-        to_bind = []
-        ready_uids: List[str] = []
-        plan_covers_bind = True
-        alloc_counts = plan.job_alloc_counts()
-        for job in affected:
+        for job, rows, ids, pipe in deferred:
             if self.job_ready(job):
                 alloc_rows = job.rows_with_status(TS.ALLOCATED)
                 # The plan's bind ledger covers exactly THIS batch's allocated
@@ -682,15 +737,26 @@ class Session:
                 if alloc_rows.shape[0] != alloc_counts.get(job.uid, 0):
                     plan_covers_bind = False
                 self.cache.bind_volumes_rows(job, alloc_rows)
-                job.bulk_update_status_rows(alloc_rows, TS.BINDING, assume_unique=True)
-                to_bind.append((job, alloc_rows))
+                job.bulk_update_status_rows(
+                    alloc_rows, TS.BINDING, assume_unique=True,
+                    assume_from=TS.ALLOCATED,
+                )
+                if plan_covers_bind:
+                    # alloc_rows == this batch's allocated rows (count match +
+                    # engine uniqueness): recover their engine node ids via a
+                    # row->id scatter over the batch.
+                    id_of = np.full(int(rows.max()) + 1, -1, dtype=np.int32)
+                    id_of[rows] = ids
+                    to_bind.append((job, alloc_rows, id_of[alloc_rows]))
+                else:
+                    to_bind.append((job, alloc_rows, None))
                 ready_uids.append(job.uid)
         if to_bind:
             if plan_covers_bind:
                 self.cache.bind_bulk_columnar(to_bind, plan.bind_deltas(ready_uids))
             else:
                 tasks = [
-                    job.view_for_row(int(r)) for job, rows in to_bind for r in rows
+                    job.view_for_row(int(r)) for job, rows, _ids in to_bind for r in rows
                 ]
                 self.cache.bind_bulk(tasks, None)
 

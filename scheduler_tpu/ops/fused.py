@@ -1299,10 +1299,12 @@ class FusedAllocator:
         """Execute the fused kernel and decode WITHOUT task objects.
 
         Returns ``(items, node_batches, failures)``:
-          items        [(job, rows, names, pipe)] — placed job-store rows in
-                       placement (task) order, target node name per row, and
-                       the pipelined mask — the ``Session.bulk_apply_columnar``
-                       contract;
+          items        [(job, rows, names, ids, pipe)] — placed job-store rows
+                       in placement (task) order, target node name + engine
+                       node index per row, and the pipelined mask — the
+                       ``Session.bulk_apply_columnar`` contract (the integer
+                       ids let the cache-side bind group per node without
+                       sorting name strings);
           node_batches node name -> [(cores, status)] deferred node records;
           failures     [(job, row)] first-infeasible rows (FitError sites).
         """
@@ -1321,7 +1323,8 @@ class FusedAllocator:
         for job, rows in zip(self.jobs, self.job_rows):
             n = len(rows)
             if n == 0:
-                items.append((job, rows[:0], np.empty(0, dtype=object), np.zeros(0, bool)))
+                items.append((job, rows[:0], np.empty(0, dtype=object),
+                              np.zeros(0, np.int32), np.zeros(0, bool)))
                 continue
             codes = encoded[base : base + n]
             base += n
@@ -1333,11 +1336,12 @@ class FusedAllocator:
                 failures.append((job, int(rows[fail[0]])))
             sel_rows = rows[placed]
             if sel_rows.shape[0] == 0:
-                items.append((job, sel_rows, np.empty(0, dtype=object), np.zeros(0, bool)))
+                items.append((job, sel_rows, np.empty(0, dtype=object),
+                              np.zeros(0, np.int32), np.zeros(0, bool)))
                 continue
             nid = np.where(codes >= 0, codes, _PIPE_BASE - codes)[placed]
             pipe = placed_pipe[placed]
-            items.append((job, sel_rows, names_arr[nid], pipe))
+            items.append((job, sel_rows, names_arr[nid], nid.astype(np.int32), pipe))
             flat_cores.append(job.store.cores[sel_rows])
             flat_nid.append(nid)
             flat_pipe.append(pipe)

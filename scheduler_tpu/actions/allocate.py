@@ -220,7 +220,10 @@ class AllocateAction(Action):
         # drain/re-push the whole heap each time (O(T^2 log T) on a big tail).
         pending_tasks: Dict[str, PriorityQueue] = {}
         ordered_pending: Dict[str, deque] = {}
-        all_nodes = get_node_list(ssn.nodes)
+        # Host-pop path only; deferred so device-engine cycles never
+        # materialize node views for it.
+        all_nodes: List = []
+        all_nodes_ready = False
 
         def host_predicate(task: TaskInfo, node) -> None:
             # Resource pre-predicate: fits idle OR releasing (allocate.go:80-93).
@@ -259,6 +262,9 @@ class AllocateAction(Action):
                             continue
                         tasks.push(task)
                     pending_tasks[job.uid] = tasks
+                if not all_nodes_ready:
+                    all_nodes = get_node_list(ssn.nodes)
+                    all_nodes_ready = True
                 self._run_host_pop(ssn, job, pending_tasks[job.uid], jobs, all_nodes, host_predicate)
 
             queues.push(queue)

@@ -19,7 +19,7 @@ class BackfillAction(Action):
         return "backfill"
 
     def execute(self, ssn) -> None:
-        nodes = get_node_list(ssn.nodes)
+        nodes = None  # materialized on the first BestEffort task, not per cycle
         for job in list(ssn.jobs.values()):
             if job.pod_group is not None and job.pod_group.status.phase == PodGroupPhase.PENDING:
                 continue
@@ -30,6 +30,8 @@ class BackfillAction(Action):
             for task in list(job.task_status_index.get(TaskStatus.PENDING, {}).values()):
                 if not task.init_resreq.is_empty():
                     continue  # only BestEffort tasks backfill
+                if nodes is None:
+                    nodes = get_node_list(ssn.nodes)
                 allocated = False
                 fe = FitErrors()
                 for node in nodes:

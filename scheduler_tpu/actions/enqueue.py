@@ -48,8 +48,17 @@ class EnqueueAction(Action):
 
         empty = ResourceVec.empty(vocab)
         nodes_idle = ResourceVec.empty(vocab)
-        for node in ssn.nodes.values():
-            nodes_idle.add(node.allocatable.clone().multi(OVERCOMMIT_FACTOR).sub(node.used))
+        ledger = getattr(ssn.nodes, "ledger", None)
+        if ledger is not None:
+            # Ledger-backed map: the overcommitted-idle estimate is two
+            # column sums, zero node materializations.
+            if ledger.r < vocab.size:
+                ledger.widen(vocab.size)
+            est = ledger.total_allocatable() * OVERCOMMIT_FACTOR - ledger.total_used()
+            nodes_idle.add_array(est[: vocab.size])
+        else:
+            for node in ssn.nodes.values():
+                nodes_idle.add(node.allocatable.clone().multi(OVERCOMMIT_FACTOR).sub(node.used))
 
         while not queues.empty():
             if nodes_idle.less(empty):

@@ -83,13 +83,100 @@ class NodeInfo:
         self._tasks: Dict[str, TaskInfo] = {}
         self._pending: Dict[str, _Pending] = {}
         self._batches: list = []
-        self.task_count: int = 0
+        self._ledger = None
+        self._row = -1
+        self._tc = 0  # standalone task counter (ledger column when attached)
 
         self.state_phase: str = NodeState.NOT_READY
         self.state_reason: str = "UnInitialized"
 
         if node is not None:
             self.set_node(node)
+
+    # -- ledger attachment (cache-owned nodes) -------------------------------
+
+    @property
+    def task_count(self) -> int:
+        led = self._ledger
+        if led is not None:
+            return int(led.task_count[self._row])
+        return self._tc
+
+    @task_count.setter
+    def task_count(self, value: int) -> None:
+        led = self._ledger
+        if led is not None:
+            led.task_count[self._row] = value
+        else:
+            self._tc = value
+
+    def attach(self, ledger) -> None:
+        """Move this node's dynamic vectors into ledger rows (cache nodes).
+        Current values (usually zeros — attach happens at creation) carry
+        over; from here on ``idle``/``used``/``releasing`` write through."""
+        from scheduler_tpu.api.node_ledger import _LedgerVec
+
+        if self.vocab.size > ledger.r:
+            ledger.widen(self.vocab.size)
+        row = ledger.attach(self.name)
+        r = ledger.r
+        for mat, vec in (("idle", self.idle), ("releasing", self.releasing), ("used", self.used)):
+            arr = vec.array
+            getattr(ledger, mat)[row, : arr.shape[0]] = arr
+            ledger.scalar_flags[mat][row] = vec.has_scalars
+        alloc = self.allocatable.array
+        ledger.allocatable[row, : alloc.shape[0]] = alloc
+        ledger.max_tasks[row] = self.allocatable.max_task_num
+        ledger.task_count[row] = self._tc
+        ledger.ready[row] = self.state_phase == NodeState.READY
+        self._ledger = ledger
+        self._row = row
+        self.idle = _LedgerVec(self.vocab, ledger, "idle", row)
+        self.releasing = _LedgerVec(self.vocab, ledger, "releasing", row)
+        self.used = _LedgerVec(self.vocab, ledger, "used", row)
+
+    @classmethod
+    def view_for_snapshot(cls, src: "NodeInfo", ledger, snap) -> "NodeInfo":
+        """Materialize a session-side node over a CLONED ledger: identity and
+        statics shared with the source cache node, dynamic vectors as views
+        into the session's own matrices, task bookkeeping from the capture
+        taken under the cache mutex (``snap`` = (tasks, pending, batches))."""
+        from scheduler_tpu.api.node_ledger import _LedgerVec
+
+        n = cls.__new__(cls)
+        n.vocab = src.vocab
+        n.name = src.name
+        n.state_phase, n.state_reason = snap[3], snap[4]
+        n.node, n.allocatable, n.capability = snap[5], snap[6], snap[7]
+        n._ledger = ledger
+        n._row = row = ledger.row_of[src.name]
+        n._tc = 0
+        n.idle = _LedgerVec(src.vocab, ledger, "idle", row)
+        n.releasing = _LedgerVec(src.vocab, ledger, "releasing", row)
+        n.used = _LedgerVec(src.vocab, ledger, "used", row)
+        n._tasks = snap[0] if snap[0] is not None else {}
+        n._pending = snap[1] if snap[1] is not None else {}
+        n._batches = snap[2] if snap[2] is not None else []
+        return n
+
+    def snapshot_bookkeeping(self):
+        """Capture bookkeeping + rebindable statics for a session
+        materialization — MUST run under the owning cache's mutex (a
+        mid-session ``set_node`` rebinds spec/allocatable on the source).
+        Folded ``_tasks`` entries are mutated in place by eviction paths, so
+        they copy eagerly; pending/batch records are immutable and copy by
+        reference.  Empty bookkeeping (the common case at scale) captures as
+        Nones — no dict churn."""
+        statics = (self.node, self.allocatable, self.capability)
+        if self._tasks or self._pending or self._batches:
+            return (
+                {uid: t.clone_shared() for uid, t in self._tasks.items()},
+                dict(self._pending),
+                list(self._batches),
+                self.state_phase,
+                self.state_reason,
+            ) + statics
+        return (None, None, None, self.state_phase, self.state_reason) + statics
 
     def _explode_batches(self) -> None:
         if self._batches:
@@ -114,7 +201,17 @@ class NodeInfo:
     def ready(self) -> bool:
         return self.state_phase == NodeState.READY
 
+    def _mirror_ready(self) -> None:
+        if self._ledger is not None:
+            self._ledger.ready[self._row] = self.state_phase == NodeState.READY
+
     def _set_node_state(self, node: Optional[NodeSpec], allocatable: Optional[ResourceVec]) -> None:
+        try:
+            self._set_node_state_inner(node, allocatable)
+        finally:
+            self._mirror_ready()
+
+    def _set_node_state_inner(self, node: Optional[NodeSpec], allocatable: Optional[ResourceVec]) -> None:
         if node is None or allocatable is None:
             self.state_phase, self.state_reason = NodeState.NOT_READY, "UnInitialized"
             return
@@ -152,9 +249,28 @@ class NodeInfo:
         self.node = node
         self.allocatable = allocatable
         self.capability = ResourceVec.from_dict(node.capacity, self.vocab)
-        self.releasing = ResourceVec.empty(self.vocab)
-        self.idle = allocatable.clone()
-        self.used = ResourceVec.empty(self.vocab)
+        led = self._ledger
+        if led is not None:
+            # Attached: reset the ledger rows in place — the view vectors
+            # (and any clones' separate rows) stay bound.
+            if self.vocab.size > led.r:
+                led.widen(self.vocab.size)
+            row = self._row
+            alloc_arr = allocatable.array
+            led.releasing[row] = 0.0
+            led.used[row] = 0.0
+            led.idle[row] = 0.0
+            led.idle[row, : alloc_arr.shape[0]] = alloc_arr
+            led.allocatable[row] = 0.0
+            led.allocatable[row, : alloc_arr.shape[0]] = alloc_arr
+            led.max_tasks[row] = allocatable.max_task_num
+            led.scalar_flags["idle"][row] = allocatable.has_scalars
+            led.scalar_flags["releasing"][row] = False
+            led.scalar_flags["used"][row] = False
+        else:
+            self.releasing = ResourceVec.empty(self.vocab)
+            self.idle = allocatable.clone()
+            self.used = ResourceVec.empty(self.vocab)
 
         for task in self.tasks.values():
             if task.status == TaskStatus.RELEASING:
@@ -331,6 +447,9 @@ class NodeInfo:
         return self.allocatable.max_task_num
 
     def clone(self) -> "NodeInfo":
+        """Standalone deep clone (tests / single-node callers).  Session
+        snapshots do NOT use this — they clone the ledger once and
+        materialize ``view_for_snapshot`` nodes lazily."""
         n = NodeInfo.__new__(NodeInfo)
         n.vocab = self.vocab
         n.name = self.name
@@ -344,6 +463,8 @@ class NodeInfo:
         n.releasing = self.releasing.clone()
         n.idle = self.idle.clone()
         n.used = self.used.clone()
+        n._ledger = None
+        n._row = -1
         n._tasks = {}
         n._pending = {}
         n._batches = []

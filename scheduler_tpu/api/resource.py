@@ -111,13 +111,13 @@ class ResourceVec:
 
     def get(self, name: str) -> float:
         """Quantity for a resource name; 0 for unregistered scalars."""
+        self._sync()  # view-backed subclasses re-slice here; base is a no-op
         if name == RESOURCE_CPU:
             return float(self._arr[CPU])
         if name == RESOURCE_MEMORY:
             return float(self._arr[MEMORY])
         if name not in self.vocab:
             return 0.0
-        self._sync()
         return float(self._arr[self.vocab.dim(name)])
 
     def set_scalar(self, name: str, quantity: float) -> None:

@@ -283,6 +283,43 @@ def _fit(arr: np.ndarray, r: int) -> np.ndarray:
     return out
 
 
+def build_node_tensors_from_ledger(
+    node_map,
+    vocab: ResourceVocabulary,
+    label_vocab: LabelVocab,
+    taint_vocab: TaintVocab,
+    static: Optional[_NodeStatic] = None,
+) -> NodeTensors:
+    """``build_node_tensors`` straight off a session's ``LedgerNodeMap``: the
+    dynamic columns are row GATHERS from the cloned ledger matrices (sorted-
+    name order), touching zero node objects.  Only a static-cache miss (node
+    generation changed) materializes views to rebuild label/taint columns."""
+    led = node_map.ledger
+    if led.r < vocab.size:
+        led.widen(vocab.size)
+    r = vocab.size
+    order = led.sorted_rows()
+    if static is None:
+        names = led.sorted_names()
+        static = _build_node_static(
+            [node_map[name] for name in names], vocab, label_vocab, taint_vocab
+        )
+    return NodeTensors(
+        names=static.names,
+        index=static.index,
+        idle=led.idle[order][:, :r],
+        releasing=led.releasing[order][:, :r],
+        used=led.used[order][:, :r],
+        allocatable=static.allocatable,
+        pods_limit=static.pods_limit,
+        task_count=led.task_count[order].astype(np.int32),
+        ready=led.ready[order],
+        unschedulable=static.unschedulable,
+        labels=static.labels,
+        taints=static.taints,
+    )
+
+
 def build_task_tensors(
     tasks: Sequence[TaskInfo],
     jobs: JobTensors,
@@ -527,25 +564,39 @@ def build_snapshot_tensors_columnar(
     (job-store row indices) instead of TaskInfo objects.  ``node_cache`` +
     ``node_key`` (e.g. the owning cache's node generation) memoize the static
     node columns and vocabularies across cycles."""
-    node_list = sorted(nodes, key=lambda n: n.name)
+    ledger_map = nodes if hasattr(nodes, "ledger") else None
     job_list = list(jobs)
     static = (
         node_cache.get(node_key)
         if node_cache is not None and node_key is not None
         else None
     )
+    node_list = None
     if static is None:
         label_vocab = LabelVocab()
         taint_vocab = TaintVocab()
+        if ledger_map is not None:
+            # Static-cache miss (node generation moved): the ONE path that
+            # materializes every node view this cycle.
+            node_list = [ledger_map[n] for n in ledger_map.ledger.sorted_names()]
+        else:
+            node_list = sorted(nodes, key=lambda n: n.name)
         static = _build_node_static(node_list, vocab, label_vocab, taint_vocab)
         if node_cache is not None and node_key is not None:
             node_cache.put(node_key, static)
     else:
         label_vocab = static.label_vocab
         taint_vocab = static.taint_vocab
-    node_tensors = build_node_tensors(
-        node_list, vocab, label_vocab, taint_vocab, static=static
-    )
+    if ledger_map is not None:
+        node_tensors = build_node_tensors_from_ledger(
+            ledger_map, vocab, label_vocab, taint_vocab, static=static
+        )
+    else:
+        if node_list is None:
+            node_list = sorted(nodes, key=lambda n: n.name)
+        node_tensors = build_node_tensors(
+            node_list, vocab, label_vocab, taint_vocab, static=static
+        )
     job_tensors = build_job_tensors(job_list, queue_names)
     task_tensors = build_task_tensors_columnar(
         per_job, job_tensors, vocab, label_vocab, taint_vocab

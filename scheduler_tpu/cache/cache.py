@@ -67,6 +67,11 @@ class SchedulerCache(Cache):
         self.mutex = threading.RLock()
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
+        # Columnar dynamic node state ([N, R] matrices; nodes hold row views).
+        # Sessions snapshot it with one matrix copy instead of N vector clones.
+        from scheduler_tpu.api.node_ledger import NodeLedger
+
+        self.node_ledger = NodeLedger(self.vocab.size)
         # Node-spec generation + static-tensor memo: the engines' static node
         # columns (labels/taints/allocatable/...) are pure functions of the
         # node specs, so they cache across cycles until a node event lands.
@@ -157,6 +162,7 @@ class SchedulerCache(Cache):
         if node is None:
             node = NodeInfo(self.vocab)  # un-initialized placeholder (node=None)
             node.name = name
+            node.attach(self.node_ledger)
             self.nodes[name] = node
         return node
 
@@ -236,6 +242,7 @@ class SchedulerCache(Cache):
         with self.mutex:
             self.node_generation += 1
             self.nodes.pop(node.name, None)
+            self.node_ledger.detach(node.name)
 
     # -- podgroup events ------------------------------------------------------
 
@@ -319,6 +326,7 @@ class SchedulerCache(Cache):
                 if name not in node_names:
                     self.node_generation += 1
                     del self.nodes[name]
+                    self.node_ledger.detach(name)
                     removed += 1
             for name in list(self.queues):
                 if name not in queue_names:
@@ -333,11 +341,18 @@ class SchedulerCache(Cache):
     # -- snapshot (cache.go:584-654) -------------------------------------------
 
     def snapshot(self) -> ClusterInfo:
+        from scheduler_tpu.api.node_ledger import LedgerNodeMap
+
         with self.mutex:
             info = ClusterInfo(self.vocab)
             info.node_generation = self.node_generation
-            for name, node in self.nodes.items():
-                info.nodes[name] = node.clone()
+            # Node state isolation = ONE ledger matrix copy; per-node views
+            # materialize lazily (api/node_ledger.py LedgerNodeMap).
+            info.nodes = LedgerNodeMap(
+                self.node_ledger.clone(),
+                dict(self.nodes),
+                {name: node.snapshot_bookkeeping() for name, node in self.nodes.items()},
+            )
             for name, queue in self.queues.items():
                 info.queues[name] = queue.clone()
             for job_id, job in self.jobs.items():

@@ -100,7 +100,10 @@ class FakeBinder(Binder):
             start = self._served
             self._served = target = start + n
             if not self._cond.wait_for(lambda: self._count >= target, timeout=timeout):
-                self._served = start  # un-reserve so a later wait can succeed
+                if self._served == target:
+                    # Un-reserve only when no later waiter reserved past us —
+                    # rolling back under one would hand out overlapping keys.
+                    self._served = start
                 raise queue.Empty
             self._fold_locked()
             return self._keys[start:target]

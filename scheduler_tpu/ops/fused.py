@@ -834,12 +834,21 @@ class FusedAllocator:
                     # over the same column-summed totals — so the pre-sort
                     # ranks bit-for-bit like the kernel's own keys (a ulp-
                     # level mismatch would let the chain pick a fresh
-                    # non-head job and break the cursor invariant).
-                    node_sorted = sorted(ssn.nodes.values(), key=lambda nd: nd.name)
-                    alloc_mat = np.zeros((len(node_sorted), r))
-                    for ni, nd in enumerate(node_sorted):
-                        arr = nd.allocatable.array
-                        alloc_mat[ni, : arr.shape[0]] = arr
+                    # non-head job and break the cursor invariant).  The sum
+                    # runs in SORTED-NAME row order either way: the kernel's
+                    # totals fold st.nodes.allocatable in that order, and f64
+                    # addition is order-sensitive.
+                    ledger = getattr(ssn.nodes, "ledger", None)
+                    if ledger is not None:
+                        if ledger.r < r:
+                            ledger.widen(r)
+                        alloc_mat = ledger.allocatable[ledger.sorted_rows()][:, :r]
+                    else:
+                        node_sorted = sorted(ssn.nodes.values(), key=lambda nd: nd.name)
+                        alloc_mat = np.zeros((len(node_sorted), r))
+                        for ni, nd in enumerate(node_sorted):
+                            arr = nd.allocatable.array
+                            alloc_mat[ni, : arr.shape[0]] = arr
                     totals_s = scale_columns(alloc_mat.sum(axis=0)[None, :], scale)[0]
                     alloc_s = scale_columns(alloc_j, scale)
                     safe = np.where(totals_s > 0, totals_s, np.float32(1.0)).astype(
@@ -878,7 +887,13 @@ class FusedAllocator:
         t_total = int(nums[:j].sum()) if j else 0
 
         self.flat_count = t_total
-        node_list = sorted(ssn.nodes.values(), key=lambda nd: nd.name)
+        # Ledger-backed session node maps feed the tensor build columnar
+        # (zero node-object materialization); plain dicts sort as before.
+        node_src = (
+            ssn.nodes
+            if getattr(ssn.nodes, "ledger", None) is not None
+            else sorted(ssn.nodes.values(), key=lambda nd: nd.name)
+        )
         # Static node columns memoize across cycles on the owning cache,
         # keyed by its node generation (bumped on node events); the session's
         # clones only feed the dynamic columns.
@@ -889,12 +904,12 @@ class FusedAllocator:
         # node event landing between snapshot and engine build must not file
         # this session's (stale) specs under the new generation.
         node_key = (
-            (snap_gen, vocab.size, len(node_list))
+            (snap_gen, vocab.size, len(ssn.nodes))
             if node_cache is not None and snap_gen >= 0
             else None
         )
         st = build_snapshot_tensors_columnar(
-            node_list, self.jobs, list(zip(self.jobs, self.job_rows)), queue_names, vocab,
+            node_src, self.jobs, list(zip(self.jobs, self.job_rows)), queue_names, vocab,
             node_cache=node_cache, node_key=node_key,
         )
         self.st = st

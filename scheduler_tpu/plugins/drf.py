@@ -56,8 +56,15 @@ class DrfPlugin(Plugin):
         if vocab is None:
             return
         self.total_resource = ResourceVec.empty(vocab)
-        for node in ssn.nodes.values():
-            self.total_resource.add(node.allocatable)
+        ledger = getattr(ssn.nodes, "ledger", None)
+        if ledger is not None:
+            # Ledger-backed map: one column sum, zero node materializations.
+            if ledger.r < vocab.size:
+                ledger.widen(vocab.size)
+            self.total_resource.add_array(ledger.total_allocatable()[: vocab.size])
+        else:
+            for node in ssn.nodes.values():
+                self.total_resource.add(node.allocatable)
 
         for job in ssn.jobs.values():
             # The maintained job aggregate IS the sum over allocated-status

@@ -58,8 +58,15 @@ class ProportionPlugin(Plugin):
             return
         vocab = next(iter(ssn.jobs.values())).vocab
         self.total_resource = ResourceVec.empty(vocab)
-        for node in ssn.nodes.values():
-            self.total_resource.add(node.allocatable)
+        ledger = getattr(ssn.nodes, "ledger", None)
+        if ledger is not None:
+            # Ledger-backed map: one column sum, zero node materializations.
+            if ledger.r < vocab.size:
+                ledger.widen(vocab.size)
+            self.total_resource.add_array(ledger.total_allocatable()[: vocab.size])
+        else:
+            for node in ssn.nodes.values():
+                self.total_resource.add(node.allocatable)
 
         # Build per-queue aggregates: allocated comes from the maintained job
         # aggregate (same source the fused engine seeds its device tensors

@@ -164,3 +164,42 @@ class TestNodeInfo:
         ni.set_node(build_node("n1", {"cpu": 4000, "memory": 1000}))
         assert not ni.ready()
         assert ni.state_reason == "OutOfSync"
+
+
+class TestNodeLedger:
+    def test_prune_absent_detaches_ledger_rows(self):
+        """A relist prune must free the node's ledger row — a ghost row would
+        inflate every ledger total and crash the next static rebuild
+        (round-4 regression: delete_node detached, prune_absent didn't)."""
+        from scheduler_tpu.cache.cache import SchedulerCache
+
+        vocab = make_vocab()
+        cache = SchedulerCache(vocab=vocab, async_io=False)
+        cache.run()
+        for i in range(3):
+            cache.add_node(build_node(f"n{i}", {"cpu": 4000, "memory": 1000}))
+        total = cache.node_ledger.total_allocatable()
+        assert total[0] == 12000
+        cache.prune_absent(set(), {"n0", "n1"}, set(), set(), set())
+        assert "n2" not in cache.node_ledger.row_of
+        assert cache.node_ledger.total_allocatable()[0] == 8000
+        # The freed row must be reusable without double-counting.
+        cache.add_node(build_node("n3", {"cpu": 2000, "memory": 1000}))
+        assert cache.node_ledger.total_allocatable()[0] == 10000
+
+    def test_ledger_vec_get_fresh_after_grow(self):
+        """ResourceVec.get must re-slice view-backed vectors: matrix growth
+        reallocates storage (round-4 regression)."""
+        from scheduler_tpu.cache.cache import SchedulerCache
+
+        vocab = make_vocab()
+        cache = SchedulerCache(vocab=vocab, async_io=False)
+        cache.run()
+        cache.add_node(build_node("n0", {"cpu": 4000, "memory": 1000}))
+        n0 = cache.nodes["n0"]
+        idle = n0.idle  # view created before growth
+        for i in range(1, 12):  # force a capacity grow (matrix realloc)
+            cache.add_node(build_node(f"n{i}", {"cpu": 1000, "memory": 1000}))
+        cache.update_node(build_node("n0", {"cpu": 9000, "memory": 1000}))
+        assert idle.get("cpu") == 9000
+        assert idle.milli_cpu == 9000

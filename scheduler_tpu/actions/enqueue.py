@@ -55,7 +55,10 @@ class EnqueueAction(Action):
             if ledger.r < vocab.size:
                 ledger.widen(vocab.size)
             est = ledger.total_allocatable() * OVERCOMMIT_FACTOR - ledger.total_used()
-            nodes_idle.add_array(est[: vocab.size])
+            nodes_idle.add_array(
+                est[: vocab.size],
+                ledger.any_alloc_scalars() or ledger.any_used_scalars(),
+            )
         else:
             for node in ssn.nodes.values():
                 nodes_idle.add(node.allocatable.clone().multi(OVERCOMMIT_FACTOR).sub(node.used))

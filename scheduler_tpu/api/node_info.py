@@ -119,7 +119,6 @@ class NodeInfo:
         if self.vocab.size > ledger.r:
             ledger.widen(self.vocab.size)
         row = ledger.attach(self.name)
-        r = ledger.r
         for mat, vec in (("idle", self.idle), ("releasing", self.releasing), ("used", self.used)):
             arr = vec.array
             getattr(ledger, mat)[row, : arr.shape[0]] = arr
@@ -127,6 +126,7 @@ class NodeInfo:
         alloc = self.allocatable.array
         ledger.allocatable[row, : alloc.shape[0]] = alloc
         ledger.max_tasks[row] = self.allocatable.max_task_num
+        ledger.alloc_scalars[row] = self.allocatable.has_scalars
         ledger.task_count[row] = self._tc
         ledger.ready[row] = self.state_phase == NodeState.READY
         self._ledger = ledger
@@ -264,6 +264,7 @@ class NodeInfo:
             led.allocatable[row] = 0.0
             led.allocatable[row, : alloc_arr.shape[0]] = alloc_arr
             led.max_tasks[row] = allocatable.max_task_num
+            led.alloc_scalars[row] = allocatable.has_scalars
             led.scalar_flags["idle"][row] = allocatable.has_scalars
             led.scalar_flags["releasing"][row] = False
             led.scalar_flags["used"][row] = False

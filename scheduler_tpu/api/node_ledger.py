@@ -99,6 +99,10 @@ class NodeLedger:
         self.scalar_flags: Dict[str, np.ndarray] = {
             m: np.zeros(cap, dtype=bool) for m in _DYNAMIC
         }
+        # Map-presence flag of each node's ALLOCATABLE ("ScalarResources !=
+        # nil" survives explicit zeros) — the column-sum fast paths must OR
+        # these exactly like the object path ORs allocatable.has_scalars.
+        self.alloc_scalars = np.zeros(cap, dtype=bool)
         self.names: List[Optional[str]] = []
         self.row_of: Dict[str, int] = {}
         self._free: List[int] = []
@@ -123,6 +127,9 @@ class NodeLedger:
             new = np.zeros(cap, dtype=bool)
             new[: old.shape[0]] = old
             self.scalar_flags[m] = new
+        old = self.alloc_scalars
+        self.alloc_scalars = np.zeros(cap, dtype=bool)
+        self.alloc_scalars[: old.shape[0]] = old
 
     def widen(self, r: int) -> None:
         """Vocabulary registered new scalars: grow the R axis."""
@@ -172,6 +179,7 @@ class NodeLedger:
         self.task_count[row] = 0
         self.max_tasks[row] = 0
         self.ready[row] = False
+        self.alloc_scalars[row] = False
         for flags in self.scalar_flags.values():
             flags[row] = False
 
@@ -197,6 +205,14 @@ class NodeLedger:
     def total_used(self) -> np.ndarray:
         return self.used[: self.n].sum(axis=0)
 
+    def any_alloc_scalars(self) -> bool:
+        """OR of allocatable map-presence flags — what the object path's
+        per-node ``add(node.allocatable)`` would leave in has_scalars."""
+        return bool(self.alloc_scalars[: self.n].any())
+
+    def any_used_scalars(self) -> bool:
+        return bool(self.scalar_flags["used"][: self.n].any())
+
     # -- snapshot -------------------------------------------------------------
 
     def clone(self) -> "NodeLedger":
@@ -212,6 +228,7 @@ class NodeLedger:
         led.max_tasks = self.max_tasks.copy()
         led.ready = self.ready.copy()
         led.scalar_flags = {m: f.copy() for m, f in self.scalar_flags.items()}
+        led.alloc_scalars = self.alloc_scalars.copy()
         led.names = list(self.names)
         led.row_of = dict(self.row_of)
         led._free = list(self._free)

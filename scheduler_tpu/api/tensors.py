@@ -113,28 +113,109 @@ class NodeTensors:
         return len(self.names)
 
 
-@dataclass
 class TaskTensors:
-    uids: List[str]
-    index: Dict[str, int]
-    resreq: np.ndarray        # f64 [T, R]
-    init_resreq: np.ndarray   # f64 [T, R]
-    job_idx: np.ndarray       # i32 [T]  (into JobTensors)
-    priority: np.ndarray      # i32 [T]
-    creation: np.ndarray      # f64 [T]
-    best_effort: np.ndarray   # bool [T] (init_resreq below every epsilon)
-    selector: np.ndarray      # bool [T, L] required label pairs
-    has_unknown_selector: np.ndarray  # bool [T]: selector references a pair no node has
-    tolerated: np.ndarray     # bool [T, K] taint columns this task tolerates
-    # Affinity flags + task cores: plugins walk ONLY the flagged rows (the
-    # typical cycle has none) instead of building uid->task dicts per session.
-    req_aff: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=bool))
-    pref_aff: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=bool))
-    cores: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=object))
+    """Flat task columns (see builders below).
+
+    ``uids``/``index`` are LAZY on the columnar path: only the per-pop host
+    engine and tests resolve them, so the hot path never builds 100k Python
+    strings/dict entries.  Pass them eagerly (object path) or as
+    ``uid_fragments`` = [(uids_column, rows)] gathered on first access.
+    """
+
+    def __init__(
+        self,
+        resreq: np.ndarray,        # f64 [T, R]
+        init_resreq: np.ndarray,   # f64 [T, R]
+        job_idx: np.ndarray,       # i32 [T]  (into JobTensors)
+        best_effort: np.ndarray,   # bool [T] (init_resreq below every epsilon)
+        selector: np.ndarray,      # bool [T, L] required label pairs
+        has_unknown_selector: np.ndarray,  # bool [T]: selector pair no node has
+        tolerated: np.ndarray,     # bool [T, K] tolerated taint columns
+        priority: Optional[np.ndarray] = None,   # i32 [T]
+        creation: Optional[np.ndarray] = None,   # f64 [T]
+        req_aff: Optional[np.ndarray] = None,
+        pref_aff: Optional[np.ndarray] = None,
+        cores: Optional[np.ndarray] = None,
+        uids: Optional[List[str]] = None,
+        index: Optional[Dict[str, int]] = None,
+        uid_fragments: Optional[list] = None,
+    ) -> None:
+        self.resreq = resreq
+        self.init_resreq = init_resreq
+        self.job_idx = job_idx
+        self._priority = priority
+        self._creation = creation
+        self.best_effort = best_effort
+        self.selector = selector
+        self.has_unknown_selector = has_unknown_selector
+        self.tolerated = tolerated
+        # Affinity flags + task cores: plugins walk ONLY the flagged rows (the
+        # typical cycle has none) instead of building uid->task dicts.
+        self.req_aff = req_aff if req_aff is not None else np.zeros(0, dtype=bool)
+        self.pref_aff = pref_aff if pref_aff is not None else np.zeros(0, dtype=bool)
+        self._cores = cores
+        self._uids = uids
+        self._index = index
+        self._uid_fragments = uid_fragments
+
+    @property
+    def cores(self) -> np.ndarray:
+        if self._cores is None:
+            out = np.empty(self.count, dtype=object)
+            base = 0
+            for store, rows in self._store_fragments:
+                n = len(rows)
+                out[base : base + n] = store.cores[rows]
+                base += n
+            self._cores = out
+        return self._cores
+
+    @property
+    def priority(self) -> np.ndarray:
+        if self._priority is None:
+            out = np.zeros(self.count, dtype=np.int32)
+            base = 0
+            for store, rows in self._store_fragments:
+                n = len(rows)
+                out[base : base + n] = store.priority[rows]
+                base += n
+            self._priority = out
+        return self._priority
+
+    @property
+    def creation(self) -> np.ndarray:
+        if self._creation is None:
+            out = np.zeros(self.count)
+            base = 0
+            for store, rows in self._store_fragments:
+                n = len(rows)
+                out[base : base + n] = store.creation[rows]
+                base += n
+            self._creation = out
+        return self._creation
+
+    @property
+    def _store_fragments(self):
+        return self._uid_fragments or ()
+
+    @property
+    def uids(self) -> List[str]:
+        if self._uids is None:
+            out: List[str] = []
+            for store, rows in self._store_fragments:
+                out.extend(store.uids[rows].tolist())
+            self._uids = out
+        return self._uids
+
+    @property
+    def index(self) -> Dict[str, int]:
+        if self._index is None:
+            self._index = {uid: i for i, uid in enumerate(self.uids)}
+        return self._index
 
     @property
     def count(self) -> int:
-        return len(self.uids)
+        return self.resreq.shape[0]
 
 
 @dataclass
@@ -426,15 +507,12 @@ def build_task_tensors_columnar(
     resreq = np.zeros((t, r))
     init_resreq = np.zeros((t, r))
     job_idx = np.full(t, -1, dtype=np.int32)
-    priority = np.zeros(t, dtype=np.int32)
-    creation = np.zeros(t)
     selector = np.zeros((t, label_vocab.size), dtype=bool)
     has_unknown = np.zeros(t, dtype=bool)
     tolerated = np.zeros((t, taint_vocab.size), dtype=bool)
     req_aff = np.zeros(t, dtype=bool)
     pref_aff = np.zeros(t, dtype=bool)
-    cores_arr = np.empty(t, dtype=object)
-    uids: List[str] = []
+    fragments: List = []  # (store, rows) — uids/cores/priority/creation gather lazily
 
     taints = taint_vocab.taints
     base = 0
@@ -448,12 +526,9 @@ def build_task_tensors_columnar(
         resreq[base : base + n, :width] = req_m[rows, :width]
         init_resreq[base : base + n, :width] = init_m[rows, :width]
         job_idx[base : base + n] = jobs.index.get(job.uid, -1)
-        priority[base : base + n] = st.priority[rows]
-        creation[base : base + n] = st.creation[rows]
         req_aff[base : base + n] = st.req_aff[rows]
         pref_aff[base : base + n] = st.pref_aff[rows]
-        cores_arr[base : base + n] = st.cores[rows]
-        uids.extend(st.uids[rows].tolist())
+        fragments.append((st, rows))
         # Only rows whose pod carries a selector or tolerations need the
         # per-pod extraction walk; an unconstrained pod contributes exactly
         # the zero rows these arrays are initialized to.
@@ -480,20 +555,16 @@ def build_task_tensors_columnar(
 
     best_effort = np.all(init_resreq < mins[None, :], axis=1)
     return TaskTensors(
-        uids=uids,
-        index={uid: i for i, uid in enumerate(uids)},
+        uid_fragments=fragments,
         resreq=resreq,
         init_resreq=init_resreq,
         job_idx=job_idx,
-        priority=priority,
-        creation=creation,
         best_effort=best_effort,
         selector=selector,
         has_unknown_selector=has_unknown,
         tolerated=tolerated,
         req_aff=req_aff,
         pref_aff=pref_aff,
-        cores=cores_arr,
     )
 
 

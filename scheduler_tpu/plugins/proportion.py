@@ -63,7 +63,10 @@ class ProportionPlugin(Plugin):
             # Ledger-backed map: one column sum, zero node materializations.
             if ledger.r < vocab.size:
                 ledger.widen(vocab.size)
-            self.total_resource.add_array(ledger.total_allocatable()[: vocab.size])
+            self.total_resource.add_array(
+                ledger.total_allocatable()[: vocab.size],
+                ledger.any_alloc_scalars(),  # map presence survives zeros
+            )
         else:
             for node in ssn.nodes.values():
                 self.total_resource.add(node.allocatable)

@@ -203,3 +203,20 @@ class TestNodeLedger:
         cache.update_node(build_node("n0", {"cpu": 9000, "memory": 1000}))
         assert idle.get("cpu") == 9000
         assert idle.milli_cpu == 9000
+
+    def test_ledger_total_allocatable_keeps_scalar_presence(self):
+        """A zero-valued scalar in a node's allocatable ('gpu: 0' on a drained
+        node) must leave has_scalars True in the ledger fast-path totals, like
+        the object path's per-node add (round-4 review finding)."""
+        from scheduler_tpu.api.vocab import ResourceVocabulary
+        from scheduler_tpu.cache.cache import SchedulerCache
+
+        vocab = ResourceVocabulary(("nvidia.com/gpu",))
+        cache = SchedulerCache(vocab=vocab, async_io=False)
+        cache.run()
+        cache.add_node(build_node(
+            "n0", {"cpu": 4000, "memory": 1000, "nvidia.com/gpu": 0}))
+        assert cache.nodes["n0"].allocatable.has_scalars
+        assert cache.node_ledger.any_alloc_scalars()
+        snap = cache.snapshot()
+        assert snap.nodes.ledger.any_alloc_scalars()

@@ -30,17 +30,27 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn) -> None:
-        from scheduler_tpu.utils.sweep import RunningLedger, SweepCache
+        from scheduler_tpu.ops.victims import VictimGate
+        from scheduler_tpu.utils.scheduler_helper import (
+            build_preemptor_task_queue,
+            enabled_task_order_chain,
+            task_order_builtin,
+        )
+        from scheduler_tpu.utils.sweep import SweepCache
 
-        # O(1)-per-task sweep memoization + candidate-presence pre-gates
-        # (see utils/sweep.py) — the per-node victim semantics below stay
-        # exact and live.  Both gate on the same enable switch so that
-        # SCHEDULER_TPU_SWEEP=0 restores the pure reference path.
+        # O(1)-per-task sweep memoization (utils/sweep.py) + the device
+        # victim pre-gate (ops/victims.py): one masked reduction over the
+        # running-task tensors admits exactly the nodes that can still yield
+        # a victim; the per-node dispatch below stays exact and live.
         sweep = SweepCache(ssn)
-        ledger = RunningLedger(ssn) if sweep.enabled else None
+        gate = VictimGate(ssn, "preempt")
+        if not gate.enabled:
+            gate = None
+        builtin_order = task_order_builtin(ssn)
+        use_priority = "priority" in enabled_task_order_chain(ssn)
 
         preemptors_map: Dict[str, PriorityQueue] = {}
-        preemptor_tasks: Dict[str, PriorityQueue] = {}
+        preemptor_tasks: Dict[str, object] = {}
         under_request: List[JobInfo] = []
         queues = {}
 
@@ -55,13 +65,21 @@ class PreemptAction(Action):
                 continue
             queues.setdefault(queue.uid, queue)
 
-            if job.task_status_index.get(TaskStatus.PENDING):
+            if job.status_count(TaskStatus.PENDING):
                 preemptors_map.setdefault(job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
                 under_request.append(job)
-                tasks = PriorityQueue(ssn.task_order_fn)
-                for task in job.task_status_index[TaskStatus.PENDING].values():
-                    tasks.push(task)
-                preemptor_tasks[job.uid] = tasks
+                preemptor_tasks[job.uid] = build_preemptor_task_queue(
+                    ssn, job, builtin_order, use_priority
+                )
+
+        if gate is not None:
+            if preemptor_tasks:
+                # Snapshot BEFORE the first Statement: a build inside an open
+                # statement would see temporarily-low gang occupancy that a
+                # rollback later restores (ops/victims.py docstring).
+                gate.prime()
+            else:
+                gate = None
 
         # Phase 1: preemption between jobs within a queue.
         for queue in queues.values():
@@ -96,15 +114,18 @@ class PreemptAction(Action):
                         sweep=sweep,
                         node_gate=(
                             None
-                            if ledger is None
-                            else lambda node, j=preemptor_job: ledger.has_other_job_running(
-                                node, j.queue, j.uid
+                            if gate is None
+                            else lambda node, j=preemptor_job: gate.admits_other_job(
+                                node.name, j
                             )
                         ),
                     ):
                         assigned = True
 
                     if ssn.job_pipelined(preemptor_job):
+                        if gate is not None:
+                            # BEFORE commit: commit clears stmt.operations.
+                            gate.note_committed_statement(stmt)
                         stmt.commit()
                         break
 
@@ -137,12 +158,12 @@ class PreemptAction(Action):
                     sweep=sweep,
                     node_gate=(
                         None
-                        if ledger is None
-                        else lambda node, j=job: ledger.has_own_job_running(
-                            node, j.queue, j.uid
-                        )
+                        if gate is None
+                        else lambda node, j=job: gate.admits_own_job(node.name, j)
                     ),
                 )
+                if gate is not None:
+                    gate.note_committed_statement(stmt)  # before ops clear
                 stmt.commit()
                 if not assigned:
                     break

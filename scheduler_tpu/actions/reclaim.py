@@ -28,19 +28,29 @@ class ReclaimAction(Action):
         return "reclaim"
 
     def execute(self, ssn) -> None:
-        from scheduler_tpu.utils.sweep import RunningLedger, SweepCache
+        from scheduler_tpu.ops.victims import VictimGate
+        from scheduler_tpu.utils.scheduler_helper import (
+            build_preemptor_task_queue,
+            enabled_task_order_chain,
+            task_order_builtin,
+        )
+        from scheduler_tpu.utils.sweep import SweepCache
 
-        # O(1)-per-task sweep memoization + candidate-presence pre-gate (see
-        # utils/sweep.py); the per-node victim semantics stay exact and live.
-        # Both gate on the same enable switch so SCHEDULER_TPU_SWEEP=0
-        # restores the pure reference path.
+        # O(1)-per-task sweep memoization (utils/sweep.py) + the device
+        # victim pre-gate (ops/victims.py): one masked reduction over the
+        # running-task tensors admits exactly the nodes that can still yield
+        # a victim; the per-node dispatch below stays exact and live.
         sweep = SweepCache(ssn)
-        ledger = RunningLedger(ssn) if sweep.enabled else None
+        gate = VictimGate(ssn, "reclaim")
+        if not gate.enabled:
+            gate = None
+        builtin_order = task_order_builtin(ssn)
+        use_priority = "priority" in enabled_task_order_chain(ssn)
 
         queues = PriorityQueue(ssn.queue_order_fn)
         queue_seen: set = set()
         preemptors_map: Dict[str, PriorityQueue] = {}
-        preemptor_tasks: Dict[str, PriorityQueue] = {}
+        preemptor_tasks: Dict[str, object] = {}
 
         for job in ssn.jobs.values():
             if job.pod_group is not None and job.pod_group.status.phase == PodGroupPhase.PENDING:
@@ -56,12 +66,17 @@ class ReclaimAction(Action):
                 queue_seen.add(queue.uid)
                 queues.push(queue)
 
-            if job.task_status_index.get(TaskStatus.PENDING):
+            if job.status_count(TaskStatus.PENDING):
                 preemptors_map.setdefault(job.queue, PriorityQueue(ssn.job_order_fn)).push(job)
-                tasks = PriorityQueue(ssn.task_order_fn)
-                for task in job.task_status_index[TaskStatus.PENDING].values():
-                    tasks.push(task)
-                preemptor_tasks[job.uid] = tasks
+                preemptor_tasks[job.uid] = build_preemptor_task_queue(
+                    ssn, job, builtin_order, use_priority
+                )
+
+        if gate is not None:
+            if preemptor_tasks:
+                gate.prime()  # snapshot BEFORE any eviction mutates state
+            else:
+                gate = None
 
         while not queues.empty():
             queue = queues.pop()
@@ -87,7 +102,18 @@ class ReclaimAction(Action):
             pod_count_live = ordered is not None
             if ordered is None:
                 ordered = get_node_list(ssn.nodes)
-            for node in ordered:
+            # ONE masked reduction per hunt (live proportion margins) —
+            # the per-node dispatch below only runs on admitted nodes, and
+            # the admitted set itself comes from one vectorized gather.
+            mask = gate.other_queue_mask(job.queue) if gate is not None else None
+            if mask is not None:
+                candidates = (
+                    ordered[i]
+                    for i in gate.admitted_positions(ordered, mask).tolist()
+                )
+            else:
+                candidates = iter(ordered)
+            for node in candidates:
                 if pod_count_live:
                     if not sweep.node_open(node):
                         continue
@@ -96,10 +122,6 @@ class ReclaimAction(Action):
                         ssn.predicate_fn(task, node)
                     except Exception:
                         continue
-                if ledger is not None and not ledger.has_other_queue_running(
-                    node, job.queue
-                ):
-                    continue
 
                 resreq = task.init_resreq.clone()
                 reclaimed = ResourceVec.empty(resreq.vocab)
@@ -133,6 +155,10 @@ class ReclaimAction(Action):
                     except Exception:
                         logger.exception("failed to reclaim %s", reclaimee.uid)
                         continue
+                    if gate is not None:
+                        owner = ssn.jobs.get(reclaimee.job)
+                        if owner is not None:
+                            gate.note_eviction(node.name, owner)
                     reclaimed.add(reclaimee.resreq)
                     if resreq.less_equal(reclaimed):
                         break

@@ -129,6 +129,7 @@ class TaskInfo:
             self._status = value
         else:
             blk.status[self._row] = int(value)
+            blk.status_gen += 1
 
     @property
     def node_name(self) -> str:
@@ -309,6 +310,7 @@ class _TaskRows:
         "uid_rank",
         "gen",
         "sig_gen",
+        "status_gen",
         "dead",
         "r_dim",
     )
@@ -353,6 +355,10 @@ class _TaskRows:
         self.uid_rank: Optional[np.ndarray] = None   # i64, order-isomorphic to uids
         self.gen = 0
         self.sig_gen = -1
+        # Bumped on EVERY status write (vector or scalar): status-membership
+        # caches (e.g. the unschedulable-condition short-circuit) key on it —
+        # ``gen`` only tracks the task SET (append/kill).
+        self.status_gen = 0
         self.dead = 0
         self.r_dim = r_dim
 
@@ -457,6 +463,7 @@ class _TaskRows:
         blk.uid_rank = self.uid_rank
         blk.gen = self.gen
         blk.sig_gen = self.sig_gen
+        blk.status_gen = self.status_gen
         blk.dead = self.dead
         blk.r_dim = self.r_dim
         return blk
@@ -687,24 +694,36 @@ class JobInfo:
     def pending_eligible_count(self) -> int:
         return int(self.pending_rows().shape[0])
 
-    def pending_rows_sorted(self, use_priority: bool) -> np.ndarray:
-        """``pending_rows`` in builtin task order, straight from the columns:
-        the tuple key ``(-priority, req_sig, creation, uid)`` (or without the
-        priority term) — exactly ``utils.scheduler_helper.task_sort_key``'s
-        fast path, no task objects."""
-        rows = self.pending_rows()
+    def _rows_builtin_sorted(self, rows: np.ndarray, use_priority: bool) -> np.ndarray:
+        """Rows in builtin task order, straight from the columns: the tuple
+        key ``(-priority, req_sig, creation, uid)`` (or without the priority
+        term) — exactly ``utils.scheduler_helper.task_sort_key``'s fast path.
+        ONE definition: allocate and preempt/reclaim must sort identically.
+
+        Numeric 4-key lexsort (primary key LAST): total order — the unique
+        uid rank breaks every tie — so the result is bit-identical to the
+        old per-task Python tuple sort, amortized to a C sort per cycle."""
         if rows.shape[0] <= 1:
             return rows
         st = self._store
         if not st.sigs_valid() or st.sig_codes is None:
             st.build_sigs()
-        # Numeric 4-key lexsort (primary key LAST): total order — the unique
-        # uid rank breaks every tie — so the result is bit-identical to the
-        # old per-task Python tuple sort, amortized to a C sort per cycle.
         keys = [st.uid_rank[rows], st.creation[rows], st.sig_codes[rows]]
         if use_priority:
             keys.append(-st.priority[rows])
         return rows[np.lexsort(tuple(keys))]
+
+    def pending_rows_sorted(self, use_priority: bool) -> np.ndarray:
+        """Allocate-eligible pending rows (best-effort excluded) in builtin
+        task order, no task objects."""
+        return self._rows_builtin_sorted(self.pending_rows(), use_priority)
+
+    def pending_rows_all_sorted(self, use_priority: bool) -> np.ndarray:
+        """Every live PENDING row (best-effort included — preempt/reclaim
+        hunt for all pending tasks, preempt.go:105-116) in builtin order."""
+        st = self._store
+        rows = np.nonzero(st.status[: st.n] == int(TaskStatus.PENDING))[0]
+        return self._rows_builtin_sorted(rows, use_priority)
 
     def status_sum(self, statuses: Sequence[TaskStatus]):
         """(dense [R] resreq sum, ORed has_scalars) over live tasks in the given
@@ -860,6 +879,7 @@ class JobInfo:
         if old_val & _ALLOC_BITS:
             self.allocated.sub(resreq)
         st.status[row] = new_val
+        st.status_gen += 1
         if ti._blk is not st:
             ti.status = status  # caller's detached/foreign object tracks too
         if new_val & _ALLOC_BITS:
@@ -936,6 +956,7 @@ class JobInfo:
                         bool(st.has_scalars[rows].any()),
                     )
             st.status[rows] = new_val
+            st.status_gen += 1
             self._count_add(from_val, -n)
             self._count_add(new_val, n)
             self._index = None  # rebuilt lazily; views stay valid
@@ -961,6 +982,7 @@ class JobInfo:
             elif now_alloc and not was_alloc:
                 self.allocated.add(core.resreq)
             st.status[row] = new_val
+            st.status_gen += 1
             self._count_add(old_val, -1)
             self._count_add(new_val, 1)
             self._index = None  # rebuilt lazily; views stay valid
@@ -997,6 +1019,7 @@ class JobInfo:
             self._count_add(int(v), -int(c))
         self._count_add(new_val, int(rows.shape[0]))
         st.status[rows] = new_val
+        st.status_gen += 1
         self._index = None  # rebuilt lazily; views stay valid
 
     def bulk_update_status(self, tasks: list, status: TaskStatus, net_add=None) -> None:

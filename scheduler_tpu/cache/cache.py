@@ -84,6 +84,11 @@ class SchedulerCache(Cache):
         # each entry keyed by (node generation, vocab widths) and dropped
         # wholesale when its key goes stale.
         self.static_mask_cache: Dict[str, dict] = {}
+        # Condition-dedupe ledgers (reference podConditionHaveUpdate): the
+        # last unschedulable message pushed per pod + a per-job short-circuit
+        # signature; pruned on pod delete.
+        self._pod_cond_last: Dict[str, str] = {}
+        self._job_cond_sig: Dict[str, tuple] = {}
         self.queues: Dict[str, QueueInfo] = {}
         self.priority_classes: Dict[str, int] = {}
 
@@ -201,6 +206,7 @@ class SchedulerCache(Cache):
             # May have been adopted via a shadow PodGroup.
             job_id = f"{pod.namespace}/{shadow_pod_group_name(pod)}"
         job = self.jobs.get(job_id)
+        self._pod_cond_last.pop(pod.uid, None)
         if job is not None:
             row = job.store.row_of.get(pod.uid)
             task = job.view_for_row(row) if row is not None else None
@@ -223,6 +229,7 @@ class SchedulerCache(Cache):
             job.pod_group is None or job.pod_group.shadow
         ):
             self.jobs.pop(job.uid, None)
+            self._job_cond_sig.pop(job.uid, None)
 
     # -- node events ---------------------------------------------------------
 
@@ -759,29 +766,52 @@ class SchedulerCache(Cache):
         return job
 
     def record_job_status_event(self, job: JobInfo) -> None:
-        """Emit unschedulable conditions for unscheduled tasks (cache.go:500-525)."""
+        """Emit unschedulable conditions for unscheduled tasks (cache.go:500-525).
+
+        Conditions DEDUPE like the reference's ``podConditionHaveUpdate``
+        (an API PATCH only goes out when the condition actually changed):
+        per-pod last-pushed messages are remembered, and a whole job
+        short-circuits when its message and task set are unchanged — a
+        steady unschedulable backlog costs O(jobs), not O(pods), per cycle."""
         if not job.status_count(TaskStatus.PENDING):
             return  # nothing unscheduled; skip without materializing views
         base_msg = job.job_fit_errors or ALL_NODE_UNAVAILABLE
         records_events = getattr(self.status_updater, "RECORDS_EVENTS", False)
+        st = job.store
+        # status_gen covers in-place status writes (resync back to PENDING
+        # etc.) that the task-set generation does not see.
+        sig = (base_msg, st.gen, st.status_gen)
+        if (
+            not job.nodes_fit_errors
+            and not records_events
+            and self._job_cond_sig.get(job.uid) == sig
+        ):
+            return
+        if not job.nodes_fit_errors:
+            self._job_cond_sig[job.uid] = sig
+        else:
+            self._job_cond_sig.pop(job.uid, None)
         events = []
-        for status, tasks in job.task_status_index.items():
-            if status != TaskStatus.PENDING:
-                continue
-            for task in tasks.values():
-                fe = job.nodes_fit_errors.get(task.uid)
-                msg = fe.error() if fe is not None else base_msg
+        last = self._pod_cond_last
+        rows = np.nonzero(st.status[: st.n] == int(TaskStatus.PENDING))[0]
+        for row in rows.tolist():
+            uid = st.uids[row]
+            fe = job.nodes_fit_errors.get(uid)
+            msg = fe.error() if fe is not None else base_msg
+            if last.get(uid) != msg:
+                last[uid] = msg
                 self.status_updater.update_pod_condition(
-                    task.pod,
+                    st.cores[row].pod,
                     {"type": "PodScheduled", "status": "False",
                      "reason": "Unschedulable", "message": msg},
                 )
-                if records_events:
-                    events.append({
-                        "namespace": task.namespace, "name": task.name,
-                        "type": "Warning", "reason": "FailedScheduling",
-                        "message": msg,
-                    })
+            if records_events:
+                core = st.cores[row]
+                events.append({
+                    "namespace": core.namespace, "name": core.name,
+                    "type": "Warning", "reason": "FailedScheduling",
+                    "message": msg,
+                })
         if events:
             try:
                 self.status_updater.record_events(events)

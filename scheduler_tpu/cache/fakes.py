@@ -110,19 +110,44 @@ class FakeBinder(Binder):
 
 
 class FakeEvictor(Evictor):
+    """Records evict intents; like FakeBinder, the ``ns/name`` key strings
+    fold lazily — the per-evict commit path only appends a pod ref."""
+
     def __init__(self) -> None:
         self.lock = threading.Lock()
-        self.evicts: List[str] = []
-        self.channel = Channel()
+        self._cond = threading.Condition(self.lock)
+        self._pods: List = []
+        self._keys: List[str] = []
+        self._served = 0
+
+    def _fold_locked(self) -> None:
+        if len(self._keys) < len(self._pods):
+            for pod in self._pods[len(self._keys):]:
+                self._keys.append(f"{pod.namespace}/{pod.name}")
+
+    @property
+    def evicts(self) -> List[str]:
+        with self.lock:
+            self._fold_locked()
+            return self._keys
 
     def evict(self, pod) -> None:
-        with self.lock:
-            key = f"{pod.namespace}/{pod.name}"
-            self.evicts.append(key)
-            self.channel.put(key)
+        with self._cond:
+            self._pods.append(pod)
+            self._cond.notify_all()
 
     def wait(self, n: int, timeout: float = 3.0) -> List[str]:
-        return [self.channel.get(timeout=timeout) for _ in range(n)]
+        with self._cond:
+            start = self._served
+            self._served = target = start + n
+            if not self._cond.wait_for(
+                lambda: len(self._pods) >= target, timeout=timeout
+            ):
+                if self._served == target:
+                    self._served = start
+                raise queue.Empty
+            self._fold_locked()
+            return self._keys[start:target]
 
 
 class FakeStatusUpdater(StatusUpdater):

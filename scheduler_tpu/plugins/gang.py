@@ -86,8 +86,10 @@ class GangPlugin(Plugin):
             if job.ready():
                 continue
             unready = job.min_available - job.ready_task_num()
+            # len(store.row_of) == live task count WITHOUT materializing the
+            # task-view dict (close runs for every unready job every cycle).
             msg = (
-                f"{unready}/{len(job.tasks)} tasks in gang unschedulable: {job.fit_error()}"
+                f"{unready}/{len(job.store.row_of)} tasks in gang unschedulable: {job.fit_error()}"
             )
             job.job_fit_errors = msg
             unschedulable_jobs += 1

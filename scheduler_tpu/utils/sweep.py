@@ -29,14 +29,10 @@ every task's node ordering, so there is no per-task subset it could soundly
 exclude.  A session with one custom scorer therefore runs the reference
 O(T x N) sweeps; the builtin set covers every BASELINE scenario.
 
-``RunningLedger`` records which (queue, job) pairs have Running tasks on each
-node, so the victim hunt can skip nodes with no candidate tasks at all
-without enumerating (and cloning) their task maps.  This is EXACT: a node
-absent from the ledger had no Running candidates when the action started, and
-the action itself only removes Running tasks — a stale presence just means
-one wasted exact enumeration.  (A resource-total pre-gate would NOT be exact:
-the actions' validate gate is ``not total.less(resreq)``, and ``less`` is
-strict with the reference's nil-scalar-map quirk, resource_info.go:226-250.)
+Candidate-presence gating (which nodes still hold viable victims) lives in
+``ops/victims.py`` (VictimGate) — the round-4 successor of the RunningLedger
+that used to sit here, extended with gang/proportion superset modeling and
+live eviction decrements.
 """
 
 from __future__ import annotations
@@ -60,7 +56,7 @@ class SweepCache:
     def __init__(self, ssn) -> None:
         self.ssn = ssn
         self._cache: Dict[tuple, List[NodeInfo]] = {}
-        self._nodes = get_node_list(ssn.nodes)
+        self._node_list: Optional[List[NodeInfo]] = None  # lazy: hunts only
         import os
 
         scoring = set(ssn.node_order_fns) | set(ssn.node_map_fns)
@@ -123,7 +119,11 @@ class SweepCache:
         key = ("passing",) + sig
         hit = self._cache.get(key)
         if hit is None:
-            hit, _ = predicate_nodes(task, self._nodes, self.ssn.static_predicate_fn)
+            if self._node_list is None:
+                self._node_list = get_node_list(self.ssn.nodes)
+            hit, _ = predicate_nodes(
+                task, self._node_list, self.ssn.static_predicate_fn
+            )
             self._cache[key] = hit
         return hit
 
@@ -149,60 +149,3 @@ def full_sweep(ssn, task: TaskInfo, predicate) -> List[NodeInfo]:
         ssn.node_order_reduce_fn,
     )
     return sort_nodes(scores)
-
-
-class RunningLedger:
-    """Which (queue, job) pairs had Running tasks on each node at action
-    start.  Presence-only — see module docstring for why totals would not be
-    an exact gate.  Built LAZILY on first gate call (an action with no
-    preemptors never pays the scan), reading the job stores' node_name
-    column vectorized."""
-
-    def __init__(self, ssn) -> None:
-        self._ssn = ssn
-        self._built = False
-        # node name -> queue uid -> set of job uids with Running tasks there.
-        self.node_queue_jobs: Dict[str, Dict[str, Set[str]]] = {}
-
-    def _build(self) -> None:
-        self._built = True
-        for job in self._ssn.jobs.values():
-            rows = job.rows_with_status(TaskStatus.RUNNING)
-            if rows.shape[0] == 0:
-                continue
-            queue = job.queue
-            uid = job.uid
-            for node_name in set(job.store.node_name[rows].tolist()):
-                if not node_name:
-                    continue
-                self.node_queue_jobs.setdefault(node_name, {}).setdefault(
-                    queue, set()
-                ).add(uid)
-
-    def has_other_queue_running(self, node: NodeInfo, queue: str) -> bool:
-        """Reclaim candidates exist: some OTHER queue ran tasks here."""
-        if not self._built:
-            self._build()
-        per_q = self.node_queue_jobs.get(node.name)
-        if not per_q:
-            return False
-        return any(q != queue for q in per_q)
-
-    def has_other_job_running(self, node: NodeInfo, queue: str, job_uid: str) -> bool:
-        """Preempt phase-1 candidates exist: the SAME queue's other jobs ran
-        tasks here."""
-        if not self._built:
-            self._build()
-        per_q = self.node_queue_jobs.get(node.name)
-        jobs = per_q.get(queue) if per_q else None
-        if not jobs:
-            return False
-        return bool(jobs - {job_uid})
-
-    def has_own_job_running(self, node: NodeInfo, queue: str, job_uid: str) -> bool:
-        """Preempt phase-2 candidates exist: the job's own tasks ran here."""
-        if not self._built:
-            self._build()
-        per_q = self.node_queue_jobs.get(node.name)
-        jobs = per_q.get(queue) if per_q else None
-        return bool(jobs and job_uid in jobs)

@@ -1,4 +1,4 @@
-"""SweepCache / RunningLedger: the memoized preempt/reclaim node sweep must be
+"""SweepCache / VictimGate: the memoized preempt/reclaim node sweep must be
 bind-for-bind and evict-for-evict identical to the reference per-task sweep
 (SCHEDULER_TPU_SWEEP=0), and must tolerate scan-dynamic tasks (legacy path).
 """
@@ -130,3 +130,111 @@ def test_dynamic_task_uses_legacy_sweep(monkeypatch):
     on = _run(build, PREEMPT_CONF, monkeypatch, True)
     off = _run(build, PREEMPT_CONF, monkeypatch, False)
     assert on == off
+
+
+def _run_gate(build, conf_str, monkeypatch, gate_on):
+    monkeypatch.setenv("SCHEDULER_TPU_VICTIM_GATE", "1" if gate_on else "0")
+    cache = build()
+    conf = parse_scheduler_conf(conf_str)
+    ssn = open_session(cache, conf.tiers)
+    for name in conf.actions:
+        get_action(name).execute(ssn)
+    close_session(ssn)
+    return dict(cache.binder.binds), list(cache.evictor.evicts)
+
+
+def test_preempt_victim_gate_is_exact(monkeypatch):
+    """The device victim pre-gate (ops/victims.py) must be a pure superset
+    filter: gated and ungated preempt produce identical evicts + binds."""
+    on = _run_gate(_preempt_cluster, PREEMPT_CONF, monkeypatch, True)
+    off = _run_gate(_preempt_cluster, PREEMPT_CONF, monkeypatch, False)
+    assert on == off
+    _binds, evicts = on
+    assert evicts, "expected preemption victims"
+
+
+def test_reclaim_victim_gate_is_exact(monkeypatch):
+    on = _run_gate(_reclaim_cluster, RECLAIM_CONF, monkeypatch, True)
+    off = _run_gate(_reclaim_cluster, RECLAIM_CONF, monkeypatch, False)
+    assert on == off
+    _binds, evicts = on
+    assert evicts, "expected reclaim victims"
+
+
+def test_victim_gate_fuzz_parity(monkeypatch):
+    """Randomized two-queue clusters: gated == ungated evicts/binds for both
+    actions across seeds (the VERDICT r3 #2 'fuzz pins device victims ==
+    host victims' requirement)."""
+    import numpy as np
+
+    for seed in range(6):
+        rng = np.random.default_rng(seed)
+
+        def build(rng=rng):
+            cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+            cache.run()
+            cache.add_queue(build_queue("qa", weight=int(rng.integers(1, 3))))
+            cache.add_queue(build_queue("qb", weight=int(rng.integers(1, 3))))
+            n_nodes = int(rng.integers(3, 8))
+            for i in range(n_nodes):
+                # Generous capacity: random placement must never overfill.
+                cache.add_node(build_node(
+                    f"n{i:02d}", {"cpu": 64000, "memory": 128 * 1024**3}))
+            for j in range(int(rng.integers(2, n_nodes + 2))):
+                g = f"run{j}"
+                q = "qa" if rng.random() < 0.7 else "qb"
+                mm = int(rng.integers(1, 3))
+                cache.add_pod_group(build_pod_group(
+                    g, queue=q, min_member=mm, phase="Running"))
+                for t in range(int(rng.integers(1, 4))):
+                    cache.add_pod(build_pod(
+                        name=f"{g}-{t}",
+                        req={"cpu": float(rng.integers(1, 3) * 1000),
+                             "memory": float(rng.integers(1, 5)) * 1024**3},
+                        groupname=g, nodename=f"n{int(rng.integers(0, n_nodes)):02d}",
+                        phase="Running"))
+            for j in range(int(rng.integers(1, 4))):
+                g = f"want{j}"
+                cache.add_pod_group(build_pod_group(
+                    g, queue="qb", min_member=1,
+                    phase=str(rng.choice(["Inqueue", "Running"]))))
+                for t in range(int(rng.integers(1, 3))):
+                    cache.add_pod(build_pod(
+                        name=f"{g}-{t}",
+                        req={"cpu": float(rng.integers(1, 3) * 1000),
+                             "memory": float(rng.integers(1, 5)) * 1024**3},
+                        groupname=g,
+                        priority=int(rng.integers(0, 120))))
+            return cache
+
+        import copy
+        state = rng.bit_generator.state
+        for conf_str in (PREEMPT_CONF, RECLAIM_CONF):
+            rng.bit_generator.state = copy.deepcopy(state)
+            on = _run_gate(build, conf_str, monkeypatch, True)
+            rng.bit_generator.state = copy.deepcopy(state)
+            off = _run_gate(build, conf_str, monkeypatch, False)
+            assert on == off, f"gate parity broke: seed={seed} conf={conf_str!r}"
+
+
+TIERED_RECLAIM_CONF = """
+actions: "reclaim"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: proportion
+"""
+
+
+def test_victim_gate_respects_tier_short_circuit(monkeypatch):
+    """Session._victims stops at the first tier whose victim set decides —
+    with gang in tier 1 and proportion in tier 2, proportion may never be
+    consulted, so the gate must NOT apply its margin filter (round-4 review
+    finding: modeling a later-tier plugin over-tightens the gate)."""
+    on = _run_gate(_reclaim_cluster, TIERED_RECLAIM_CONF, monkeypatch, True)
+    off = _run_gate(_reclaim_cluster, TIERED_RECLAIM_CONF, monkeypatch, False)
+    assert on == off
+    _binds, evicts = on
+    assert evicts, "tier-1 gang decides: evictions must happen"

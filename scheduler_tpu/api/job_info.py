@@ -60,6 +60,23 @@ def pod_resource_request(pod: PodSpec, vocab: ResourceVocabulary) -> ResourceVec
     return total
 
 
+def _has_pod_affinity(pod: PodSpec) -> bool:
+    """Any pod-affinity term that can CONTRIBUTE to the InterPodAffinity
+    priority: preferred terms score directly, and hard AFFINITY terms act
+    symmetrically with DefaultHardPodAffinitySymmetricWeight.  Hard
+    ANTI-affinity is predicate-only in the k8s priority (no symmetric score),
+    so counting it would forfeit the fused engine for nothing."""
+    aff = pod.affinity
+    return bool(
+        aff is not None
+        and (
+            aff.pod_affinity
+            or getattr(aff, "pod_preferred", None)
+            or getattr(aff, "pod_anti_preferred", None)
+        )
+    )
+
+
 def job_id_for_pod(pod: PodSpec) -> str:
     """JobID of the PodGroup a pod belongs to (reference getJobID: namespace/group)."""
     if pod.group_name:
@@ -618,6 +635,10 @@ class JobInfo:
         # entirely when it is 0, so claim-free jobs never pay for a real
         # VolumeBinder being configured.
         self.volume_claim_tasks: int = 0
+        # Tasks whose pod carries ANY pod-affinity term (hard or preferred):
+        # lets nodeorder skip registering the InterPodAffinity batch priority
+        # (and thus keep the fused engine) when no pod could contribute.
+        self.pod_affinity_tasks: int = 0
 
         self.creation_timestamp: float = 0.0
 
@@ -824,6 +845,8 @@ class JobInfo:
         self.total_request.add(ti.resreq)
         if ti.pod is not None and ti.pod.volume_claims:
             self.volume_claim_tasks += 1
+        if ti.pod is not None and _has_pod_affinity(ti.pod):
+            self.pod_affinity_tasks += 1
         if self._views is not None:
             self._views[ti.uid] = ti
         if self._index is not None:
@@ -841,6 +864,8 @@ class JobInfo:
         self.total_request.sub(core.resreq)
         if core.pod is not None and core.pod.volume_claims:
             self.volume_claim_tasks -= 1
+        if core.pod is not None and _has_pod_affinity(core.pod):
+            self.pod_affinity_tasks -= 1
         # Detach live views/cores of this row so held refs keep final values.
         if core._blk is st:
             core._detach()
@@ -1111,6 +1136,7 @@ class JobInfo:
         job._index = None
         job._counts = dict(self._counts)
         job.volume_claim_tasks = self.volume_claim_tasks
+        job.pod_affinity_tasks = self.pod_affinity_tasks
         job.allocated = self.allocated.clone()
         job.total_request = self.total_request.clone()
         job.nodes_fit_errors = {}

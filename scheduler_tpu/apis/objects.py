@@ -199,8 +199,11 @@ class PodAffinityTerm:
 
 @dataclass
 class Affinity:
-    """Node + pod affinity constraints (required terms only, like the reference's
-    hard-predicate path; preferred terms feed node scoring)."""
+    """Node + pod affinity constraints.  ``*_required``/``pod_affinity``/
+    ``pod_anti_affinity`` are hard terms (predicate path); the ``*_preferred``
+    forms are (weight, term) pairs feeding node scoring — preferred node
+    affinity in the nodeorder score, preferred pod (anti-)affinity in the
+    InterPodAffinity batch priority (nodeorder.go:229-247)."""
 
     # OR over groups, AND within a group (nodeSelectorTerms semantics).
     node_required: List[List[NodeSelectorRequirement]] = field(default_factory=list)
@@ -208,6 +211,9 @@ class Affinity:
     node_preferred: List[Tuple[int, List[NodeSelectorRequirement]]] = field(default_factory=list)
     pod_affinity: List[PodAffinityTerm] = field(default_factory=list)
     pod_anti_affinity: List[PodAffinityTerm] = field(default_factory=list)
+    # Preferred pod (anti-)affinity: (weight, term) pairs.
+    pod_preferred: List[Tuple[int, PodAffinityTerm]] = field(default_factory=list)
+    pod_anti_preferred: List[Tuple[int, PodAffinityTerm]] = field(default_factory=list)
 
 
 @dataclass

@@ -41,6 +41,7 @@ from scheduler_tpu.connector.wire import (
     parse_pod,
     parse_pod_group,
     parse_queue,
+    obj_name,
     pod_key,
     pod_uid,
 )
@@ -231,9 +232,9 @@ class ApiConnector:
                     cache.add_queue(q)
             elif kind == "priorityclass":
                 if op == "delete":
-                    cache.delete_priority_class(obj["name"])
+                    cache.delete_priority_class(obj_name(obj))
                 else:
-                    cache.add_priority_class(obj["name"], int(obj.get("value", 0)))
+                    cache.add_priority_class(obj_name(obj), int(obj.get("value", 0)))
         except Exception:
             self._dirty = True
             logger.exception("failed to apply %s %s event; scheduling relist", op, kind)
@@ -262,11 +263,11 @@ class ApiConnector:
         if relist:
             removed = self.cache.prune_absent(
                 pod_uids={pod_uid(p) for p in state.get("pods", [])},
-                node_names={n["name"] for n in state.get("nodes", [])},
+                node_names={obj_name(n) for n in state.get("nodes", [])},
                 podgroup_keys={pod_key(g) for g in state.get("podGroups", [])},
-                queue_names={q["name"] for q in state.get("queues", [])},
+                queue_names={obj_name(q) for q in state.get("queues", [])},
                 priority_class_names={
-                    pc["name"] for pc in state.get("priorityClasses", [])
+                    obj_name(pc) for pc in state.get("priorityClasses", [])
                 },
             )
             if removed:

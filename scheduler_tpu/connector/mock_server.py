@@ -49,11 +49,11 @@ class MockState:
 
     @staticmethod
     def key(kind: str, obj: Dict) -> str:
-        if kind in ("pod", "podgroup"):
-            from scheduler_tpu.connector.wire import pod_key
+        from scheduler_tpu.connector.wire import obj_name, pod_key
 
+        if kind in ("pod", "podgroup"):
             return pod_key(obj)
-        return obj["name"]
+        return obj_name(obj)  # both dialects (k8s metadata envelope or flat)
 
     def apply(self, kind: str, op: str, obj: Dict) -> None:
         with self.lock:
@@ -199,8 +199,15 @@ def make_handler(state: MockState):
                         failed.append(pair)
                         continue
                     pod = dict(pod)
-                    pod["nodeName"] = pair["node"]
-                    pod["phase"] = "Running"
+                    if isinstance(pod.get("metadata"), dict):
+                        # Real k8s Pod shape: bind lands in spec/status.
+                        pod["spec"] = dict(pod.get("spec", {}))
+                        pod["spec"]["nodeName"] = pair["node"]
+                        pod["status"] = dict(pod.get("status", {}))
+                        pod["status"]["phase"] = "Running"
+                    else:
+                        pod["nodeName"] = pair["node"]
+                        pod["phase"] = "Running"
                     # Echo on the watch stream: the scheduler's cache sees its
                     # own bind come back as a pod update, like an informer.
                     state.apply("pod", "update", pod)

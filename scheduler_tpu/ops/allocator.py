@@ -203,7 +203,11 @@ class DeviceAllocator:
         for name in ssn.predicate_fns:
             if name not in ssn.device_predicates:
                 return False
-        scoring_fns = set(ssn.node_order_fns) | set(ssn.batch_node_order_fns) | set(ssn.node_map_fns)
+        if ssn.batch_node_order_fns:
+            # Batch priorities (InterPodAffinity) score against live
+            # placements across the whole node set — host path only.
+            return False
+        scoring_fns = set(ssn.node_order_fns) | set(ssn.node_map_fns)
         for name in scoring_fns:
             if name not in ssn.device_scorers and name not in ssn.device_weighted_plugins:
                 return False

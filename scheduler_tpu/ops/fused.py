@@ -1247,7 +1247,13 @@ class FusedAllocator:
             return False  # proportion without its device tensors -> host path
         if set(ssn.job_ready_fns) - {"gang"}:
             return False
-        scoring = set(ssn.node_order_fns) | set(ssn.batch_node_order_fns) | set(ssn.node_map_fns)
+        if ssn.batch_node_order_fns:
+            # Batch priorities (InterPodAffinity) score against LIVE
+            # placements across the whole node set — no device counterpart;
+            # they only register when pod-affinity pods exist, so the common
+            # cycle never loses the engine to this.
+            return False
+        scoring = set(ssn.node_order_fns) | set(ssn.node_map_fns)
         if scoring - ssn.device_weighted_plugins:
             return False
         return True

@@ -42,10 +42,104 @@ def node_affinity_preferred_score(task: TaskInfo, node_labels: Dict[str, str]) -
     return score
 
 
+HARD_POD_AFFINITY_SYMMETRIC_WEIGHT = 1.0  # v1.DefaultHardPodAffinitySymmetricWeight
+
+
+def _topology_value(node: NodeInfo, key: str):
+    if node.node is None:
+        return None
+    value = node.node.labels.get(key)
+    if key == "kubernetes.io/hostname" and value is None:
+        value = node.name
+    return value
+
+
+def _pod_matches_term(pod, term, owner_namespace: str) -> bool:
+    """k8s podMatchesTermsNamespaceAndSelector: empty term namespaces mean
+    the TERM OWNER's namespace; the selector matches the pod's labels."""
+    namespaces = term.namespaces or [owner_namespace]
+    if pod.namespace not in namespaces:
+        return False
+    labels = pod.labels
+    return all(labels.get(k) == v for k, v in term.label_selector.items())
+
+
+def inter_pod_affinity_scores(task: TaskInfo, nodes, weight: float) -> Dict[str, float]:
+    """The InterPodAffinity batch priority
+    (reference ``nodeorder.go:229-247`` -> k8s 1.13
+    ``CalculateInterPodAffinityPriority``): for every existing pod, the
+    incoming pod's PREFERRED (anti-)affinity terms and — symmetrically — the
+    existing pod's terms matching the incoming pod spread +-term.weight over
+    every node in the matched pod's topology domain (hard affinity terms of
+    existing pods count with DefaultHardPodAffinitySymmetricWeight).  Counts
+    max-min normalize to 0..10, then scale by ``podaffinity.weight``."""
+    counts: Dict[str, float] = {n.name: 0.0 for n in nodes}
+    domains: Dict[str, Dict[str, list]] = {}  # key -> value -> node names
+
+    def domain(key: str, value) -> list:
+        if value is None:
+            return ()
+        per_key = domains.get(key)
+        if per_key is None:
+            per_key = {}
+            for n in nodes:
+                v = _topology_value(n, key)
+                if v is not None:
+                    per_key.setdefault(v, []).append(n.name)
+            domains[key] = per_key
+        return per_key.get(value, ())
+
+    def spread(node: NodeInfo, key: str, w: float) -> None:
+        for name in domain(key, _topology_value(node, key)):
+            counts[name] += w
+
+    in_aff = task.pod.affinity
+    in_pref = list(getattr(in_aff, "pod_preferred", ()) or ()) if in_aff else []
+    in_anti = list(getattr(in_aff, "pod_anti_preferred", ()) or ()) if in_aff else []
+    hard_w = HARD_POD_AFFINITY_SYMMETRIC_WEIGHT
+
+    for node in nodes:
+        for ep in node.tasks.values():
+            if ep.uid == task.uid:
+                continue
+            ep_pod = ep.pod
+            if ep_pod is None:
+                continue
+            for w, term in in_pref:
+                if _pod_matches_term(ep_pod, term, task.namespace):
+                    spread(node, term.topology_key, float(w))
+            for w, term in in_anti:
+                if _pod_matches_term(ep_pod, term, task.namespace):
+                    spread(node, term.topology_key, -float(w))
+            ep_aff = ep_pod.affinity
+            if ep_aff is None:
+                continue
+            if hard_w:
+                for term in ep_aff.pod_affinity:
+                    if _pod_matches_term(task.pod, term, ep.namespace):
+                        spread(node, term.topology_key, hard_w)
+            for w, term in getattr(ep_aff, "pod_preferred", ()) or ():
+                if _pod_matches_term(task.pod, term, ep.namespace):
+                    spread(node, term.topology_key, float(w))
+            for w, term in getattr(ep_aff, "pod_anti_preferred", ()) or ():
+                if _pod_matches_term(task.pod, term, ep.namespace):
+                    spread(node, term.topology_key, -float(w))
+
+    max_c = max(counts.values(), default=0.0)
+    min_c = min(counts.values(), default=0.0)
+    if max_c == min_c:
+        return {name: 0.0 for name in counts}
+    span = max_c - min_c
+    return {
+        name: weight * 10.0 * (c - min_c) / span for name, c in counts.items()
+    }
+
+
 class NodeOrderPlugin(Plugin):
     def __init__(self, arguments: Arguments) -> None:
         self.arguments = arguments
         self.w_node_affinity = arguments.get_float(NODE_AFFINITY_WEIGHT, 1.0)
+        self.w_pod_affinity = arguments.get_float(POD_AFFINITY_WEIGHT, 1.0)
         self.w_least_requested = arguments.get_float(LEAST_REQUESTED_WEIGHT, 1.0)
         self.w_balanced = arguments.get_float(BALANCED_RESOURCE_WEIGHT, 1.0)
 
@@ -66,6 +160,20 @@ class NodeOrderPlugin(Plugin):
             return score
 
         ssn.add_node_order_fn(self.name(), node_order_fn)
+
+        # InterPodAffinity priority (nodeorder.go:229-247), registered as a
+        # batch fn ONLY when some pod in the session carries a pod-affinity
+        # term: with none, every count is zero and normalization yields an
+        # all-zero map (no ranking effect), so skipping registration is
+        # behavior-identical — and it keeps the fused engine + sweep caches,
+        # which soundly disable themselves whenever a batch fn exists.
+        w_pod = self.w_pod_affinity
+        if w_pod and any(job.pod_affinity_tasks for job in ssn.jobs.values()):
+
+            def batch_node_order_fn(task: TaskInfo, nodes) -> Dict[str, float]:
+                return inter_pod_affinity_scores(task, nodes, w_pod)
+
+            ssn.add_batch_node_order_fn(self.name(), batch_node_order_fn)
 
         # Device: dynamic weights for idle-dependent scorers; static matrix for
         # preferred node affinity.

@@ -206,3 +206,67 @@ def test_watch_echo_keeps_single_task():
         if conn is not None:
             conn.stop()
         server.shutdown()
+
+
+def test_k8s_shaped_objects_cross_the_wire(wire):
+    """Real kubectl-shaped Pod/PodGroup JSON drives scheduling end-to-end —
+    and the init-container max rule (pod_info.go:53-76) decides fit from the
+    wire: two 300m-container pods with 3.9-core init containers pinned to one
+    4-core k8s-shaped node cannot share it (3.9 > 4 - 0.3), while without
+    ``initContainers`` crossing the wire both would fit trivially."""
+    _add("node", {
+        "kind": "Node", "apiVersion": "v1",
+        "metadata": {"name": "wn-k8s", "labels": {"pool": "k8sinit"}},
+        "status": {
+            "allocatable": {"cpu": "4", "memory": "16Gi", "pods": "110"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    })
+    _add("podgroup", {
+        "apiVersion": "scheduling.volcano.sh/v1beta1", "kind": "PodGroup",
+        "metadata": {"name": "k8s-init", "namespace": "default"},
+        "spec": {"minMember": 1, "queue": "default"},
+        "status": {"phase": "Inqueue"},
+    })
+    for name in ("k8s-init-a", "k8s-init-b"):
+        _add("pod", {
+            "kind": "Pod", "apiVersion": "v1",
+            "metadata": {
+                "name": name, "namespace": "default",
+                "annotations": {"scheduling.k8s.io/group-name": "k8s-init"},
+            },
+            "spec": {
+                "schedulerName": "volcano",
+                "nodeSelector": {"pool": "k8sinit"},
+                "containers": [
+                    {"name": "main",
+                     "resources": {"requests": {"cpu": "300m", "memory": "1Gi"}}},
+                ],
+                "initContainers": [
+                    {"name": "warm",
+                     "resources": {"requests": {"cpu": "3900m", "memory": "1Gi"}}},
+                ],
+            },
+            "status": {"phase": "Pending"},
+        })
+
+    deadline = time.monotonic() + 60
+    bound = {}
+    while time.monotonic() < deadline:
+        pods = {p["metadata"]["name"]: p for p in _get("/state")["pods"]
+                if isinstance(p.get("metadata"), dict)}
+        bound = {
+            n: pods.get(n, {}).get("spec", {}).get("nodeName")
+            for n in ("k8s-init-a", "k8s-init-b")
+        }
+        if sum(1 for v in bound.values() if v) == 1:
+            break
+        time.sleep(0.3)
+    assert sum(1 for v in bound.values() if v) == 1, bound
+    assert "wn-k8s" in bound.values()
+    # A few more cycles: the second pod must STAY pending (init rule holds).
+    time.sleep(1.5)
+    pods = {p["metadata"]["name"]: p for p in _get("/state")["pods"]
+            if isinstance(p.get("metadata"), dict)}
+    final = [pods[n].get("spec", {}).get("nodeName") for n in ("k8s-init-a", "k8s-init-b")]
+    assert sum(1 for v in final if v) == 1, final

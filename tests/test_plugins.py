@@ -317,3 +317,95 @@ tiers:
         victim = next(iter(ssn.jobs[job_id].tasks.values()))
         assert ssn.preemptable(None, [victim]) == []
         close_session(ssn)
+
+
+class TestInterPodAffinityScoring:
+    """InterPodAffinity as a batch node-order priority (nodeorder.go:229-247):
+    the podaffinity.weight argument is live and preferred pod (anti-)affinity
+    draws/spreads placements."""
+
+    CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: nodeorder
+"""
+
+    @staticmethod
+    def _cluster(anti: bool):
+        from scheduler_tpu.apis.objects import Affinity, PodAffinityTerm
+
+        cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+        cache.run()
+        cache.add_queue(build_queue("default", weight=1))
+        for i in range(3):
+            cache.add_node(build_node(f"n{i}", {"cpu": 8000, "memory": 16 * 1024**3}))
+        # An anchor pod labeled app=db runs on n1.
+        cache.add_pod_group(build_pod_group("anchor", min_member=1, phase="Running"))
+        cache.add_pod(build_pod(
+            name="db-0", req={"cpu": 1000, "memory": 1024**3},
+            groupname="anchor", nodename="n1", phase="Running",
+            labels={"app": "db"}))
+        # The incoming pod prefers (anti-)affinity to app=db pods by hostname.
+        pod = build_pod(
+            name="web-0", req={"cpu": 1000, "memory": 1024**3}, groupname="web")
+        term = PodAffinityTerm(label_selector={"app": "db"})
+        aff = Affinity()
+        if anti:
+            aff.pod_anti_preferred = [(100, term)]
+        else:
+            aff.pod_preferred = [(100, term)]
+        pod.affinity = aff
+        cache.add_pod_group(build_pod_group("web", min_member=1, phase="Inqueue"))
+        cache.add_pod(pod)
+        return cache
+
+    def _run(self, anti: bool) -> str:
+        cache = self._cluster(anti)
+        conf = parse_scheduler_conf(self.CONF)
+        ssn = open_session(cache, conf.tiers)
+        get_action("allocate").execute(ssn)
+        close_session(ssn)
+        return cache.binder.binds.get("default/web-0")
+
+    def test_preferred_affinity_colocates(self):
+        assert self._run(anti=False) == "n1"
+
+    def test_preferred_anti_affinity_spreads(self):
+        assert self._run(anti=True) in ("n0", "n2")
+
+    def test_zero_weight_disables_batch_fn(self):
+        """podaffinity.weight: 0 must not register the batch priority (the
+        session keeps the fused engine)."""
+        conf = parse_scheduler_conf("""
+actions: "allocate"
+tiers:
+- plugins:
+  - name: nodeorder
+    arguments:
+      podaffinity.weight: 0
+""")
+        cache = self._cluster(anti=False)
+        ssn = open_session(cache, conf.tiers)
+        try:
+            assert not ssn.batch_node_order_fns
+        finally:
+            close_session(ssn)
+
+    def test_no_affinity_pods_keeps_engine(self):
+        """Without any pod-affinity term in the session, the batch fn stays
+        unregistered (the fused engine gate depends on this)."""
+        cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+        cache.run()
+        cache.add_queue(build_queue("default", weight=1))
+        cache.add_node(build_node("n0", {"cpu": 8000, "memory": 16 * 1024**3}))
+        cache.add_pod_group(build_pod_group("g", min_member=1, phase="Inqueue"))
+        cache.add_pod(build_pod(name="p0", req={"cpu": 1000, "memory": 1024**3}, groupname="g"))
+        conf = parse_scheduler_conf(self.CONF)
+        ssn = open_session(cache, conf.tiers)
+        try:
+            assert not ssn.batch_node_order_fns
+        finally:
+            close_session(ssn)

@@ -190,11 +190,20 @@ class NodeSelectorRequirement:
 
 @dataclass
 class PodAffinityTerm:
-    """Pod (anti-)affinity term: match pods by labels, co/counter-locate by topology."""
+    """Pod (anti-)affinity term: match pods by labels, co/counter-locate by
+    topology.  ``label_selector`` carries matchLabels (exact pairs);
+    ``expressions`` carries matchExpressions (operator requirements) — a pod
+    matches when BOTH hold (k8s labels.Selector semantics)."""
 
     label_selector: Dict[str, str] = field(default_factory=dict)
     topology_key: str = "kubernetes.io/hostname"
     namespaces: List[str] = field(default_factory=list)
+    expressions: List[NodeSelectorRequirement] = field(default_factory=list)
+
+    def matches_labels(self, labels: Dict[str, str]) -> bool:
+        return all(labels.get(k) == v for k, v in self.label_selector.items()) and all(
+            r.matches(labels) for r in self.expressions
+        )
 
 
 @dataclass

@@ -562,7 +562,19 @@ class SchedulerCache(Cache):
         for start in range(0, len(resolved), chunk_size):
             self._submit_io(bind_chunk, resolved[start : start + chunk_size])
 
+    def _sync_pod_via_client(self, namespace: str, name: str) -> bool:
+        """The reference syncTask seam (event_handlers.go:96-114): re-fetch
+        ONE pod from the system of record and rebuild its task.  False when
+        no client is wired (fake-backed caches) or the GET failed — callers
+        then run their local revert."""
+        client = self.client()
+        if client is not None and hasattr(client, "sync_pod"):
+            return bool(client.sync_pod(namespace, name))
+        return False
+
     def _resync_failed_bind(self, ti: TaskInfo, hostname: str) -> None:
+        if self._sync_pod_via_client(ti.namespace, ti.name):
+            return
         with self.mutex:
             try:
                 job, task = self._find_job_and_task(ti)
@@ -733,6 +745,8 @@ class SchedulerCache(Cache):
                 self.evictor.evict(task.pod)
             except Exception:
                 logger.exception("evict of %s failed; resyncing", task.uid)
+                if self._sync_pod_via_client(task.namespace, task.name):
+                    return
                 with self.mutex:
                     try:
                         job2, task2 = self._find_job_and_task(ti)

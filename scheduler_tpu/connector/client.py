@@ -198,46 +198,120 @@ class ApiConnector:
     # -- event application ---------------------------------------------------
 
     def _apply(self, kind: str, op: str, obj: dict) -> None:
-        cache = self.cache
         try:
-            if kind == "pod":
-                pod = parse_pod(obj, cache.scheduler_name)
-                if op == "add":
-                    cache.add_pod(pod)
-                elif op == "update":
-                    cache.update_pod(pod)
-                else:
-                    cache.delete_pod(pod)
-            elif kind == "node":
-                node = parse_node(obj)
-                if op == "add":
-                    cache.add_node(node)
-                elif op == "update":
-                    cache.update_node(node)
-                else:
-                    cache.delete_node(node)
-            elif kind == "podgroup":
-                pg = parse_pod_group(obj)
-                if op == "delete":
-                    cache.delete_pod_group(pg)
-                elif op == "update":
-                    cache.update_pod_group(pg)
-                else:
-                    cache.add_pod_group(pg)
-            elif kind == "queue":
-                q = parse_queue(obj)
-                if op == "delete":
-                    cache.delete_queue(q)
-                else:
-                    cache.add_queue(q)
-            elif kind == "priorityclass":
-                if op == "delete":
-                    cache.delete_priority_class(obj_name(obj))
-                else:
-                    cache.add_priority_class(obj_name(obj), int(obj.get("value", 0)))
+            self._dispatch(kind, op, obj)
         except Exception:
-            self._dirty = True
-            logger.exception("failed to apply %s %s event; scheduling relist", op, kind)
+            logger.exception(
+                "failed to apply %s %s event; single-object resync", op, kind
+            )
+            # The reference syncTask re-fetches ONE object to rebuild truth
+            # (event_handlers.go:96-114); a full relist is reserved for
+            # watch-horizon loss.  Only when the re-fetch itself fails does
+            # the store fall back to a replace.
+            if not self._resync_object(kind, obj):
+                self._dirty = True
+
+    def _object_key(self, kind: str, obj: dict) -> str:
+        if kind in ("pod", "podgroup"):
+            return pod_key(obj)
+        return obj_name(obj)
+
+    def get_object(self, kind: str, key: str) -> Optional[dict]:
+        """GET one object from the system of record; None == 404 (deleted).
+        Transport errors raise."""
+        try:
+            return _get(self.base, f"/objects/{kind}/{key}", timeout=10.0)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def _resync_object(self, kind: str, obj: dict) -> bool:
+        """Re-fetch one object and re-apply it as the current truth (delete
+        when the server no longer has it).  True == handled."""
+        try:
+            key = self._object_key(kind, obj)
+            fresh = self.get_object(kind, key)
+            if fresh is None:
+                self._dispatch(kind, "delete", obj)
+            else:
+                self._dispatch(kind, "update", fresh)
+            return True
+        except Exception:
+            logger.exception("single-object resync failed for %s", kind)
+            return False
+
+    def _dispatch(self, kind: str, op: str, obj: dict) -> None:
+        """The ONE parse-and-apply switch (events, seeding, and single-object
+        resync all route here; failure recovery lives in the callers)."""
+        cache = self.cache
+        if kind == "pod":
+            pod = parse_pod(obj, cache.scheduler_name)
+            if op == "add":
+                cache.add_pod(pod)
+            elif op == "update":
+                cache.update_pod(pod)
+            else:
+                cache.delete_pod(pod)
+        elif kind == "node":
+            node = parse_node(obj)
+            if op == "add":
+                cache.add_node(node)
+            elif op == "update":
+                cache.update_node(node)
+            else:
+                cache.delete_node(node)
+        elif kind == "podgroup":
+            pg = parse_pod_group(obj)
+            if op == "delete":
+                cache.delete_pod_group(pg)
+            elif op == "update":
+                cache.update_pod_group(pg)
+            else:
+                cache.add_pod_group(pg)
+        elif kind == "queue":
+            q = parse_queue(obj)
+            if op == "delete":
+                cache.delete_queue(q)
+            else:
+                cache.add_queue(q)
+        elif kind == "priorityclass":
+            if op == "delete":
+                cache.delete_priority_class(obj_name(obj))
+            else:
+                cache.add_priority_class(obj_name(obj), int(obj.get("value", 0)))
+
+    def sync_pod(self, namespace: str, name: str) -> bool:
+        """The syncTask seam for the cache's failure paths: re-fetch one pod
+        and rebuild its task from the server's truth (or delete it when the
+        server no longer has it).  True == cache now reflects the server."""
+        try:
+            fresh = self.get_object("pod", f"{namespace}/{name}")
+        except Exception:
+            logger.exception("sync_pod GET failed for %s/%s", namespace, name)
+            return False
+        try:
+            if fresh is None:
+                # Server no longer has it: the local pod is a ghost.
+                existing = self._find_pod(namespace, name)
+                if existing is not None:
+                    self.cache.delete_pod(existing)
+            else:
+                self.cache.update_pod(parse_pod(fresh, self.cache.scheduler_name))
+            return True
+        except Exception:
+            logger.exception("sync_pod apply failed for %s/%s", namespace, name)
+            return False
+
+    def _find_pod(self, namespace: str, name: str):
+        with self.cache.mutex:
+            for job in self.cache.jobs.values():
+                st = job.store
+                for uid, row in st.row_of.items():
+                    core = st.cores[row]
+                    if core.namespace == namespace and core.name == name:
+                        return core.pod
+        return None
 
     def list_and_seed(self) -> None:
         """The initial LIST: seed the cache, remember the watch cursor.  A

@@ -38,6 +38,8 @@ class MockState:
         self.fail: Dict[str, int] = {}  # op -> remaining injected failures
         self.bind_calls = 0
         self.evict_calls = 0
+        self.get_calls = 0   # single-object re-fetches (syncTask analogue)
+        self.list_calls = 0  # full LISTs (relists show up here)
         self.status_updates: List[Dict] = []
         self.event_log: List[Dict] = []  # lifecycle events (Eventf analogue)
         # PVC ledger: claim -> {"node": ..., "bound": bool}; allocate assigns
@@ -110,6 +112,7 @@ def make_handler(state: MockState):
             url = urlparse(self.path)
             if url.path == "/state":
                 with state.lock:
+                    state.list_calls += 1
                     self._json({
                         "seq": state.seq,
                         "queues": list(state.objects["queue"].values()),
@@ -146,7 +149,20 @@ def make_handler(state: MockState):
             if url.path.startswith("/pods/"):
                 _, _, ns, name = url.path.split("/", 3)
                 with state.lock:
+                    state.get_calls += 1
                     obj = state.objects["pod"].get(f"{ns}/{name}")
+                if obj is None:
+                    self._json({"error": "not found"}, 404)
+                else:
+                    self._json(obj)
+                return
+            if url.path.startswith("/objects/"):
+                # Single-object GET (the reference syncTask's re-fetch shape):
+                # /objects/<kind>/<key...> where key is ns/name or a bare name.
+                _, _, kind, key = url.path.split("/", 3)
+                with state.lock:
+                    state.get_calls += 1
+                    obj = state.objects.get(kind, {}).get(key)
                 if obj is None:
                     self._json({"error": "not found"}, 404)
                 else:
@@ -157,6 +173,8 @@ def make_handler(state: MockState):
                     self._json({
                         "bind_calls": state.bind_calls,
                         "evict_calls": state.evict_calls,
+                        "get_calls": state.get_calls,
+                        "list_calls": state.list_calls,
                         "status_updates": len(state.status_updates),
                         "seq": state.seq,
                     })

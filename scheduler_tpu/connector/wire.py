@@ -292,13 +292,11 @@ def parse_pod_group(g: Dict) -> PodGroup:
 
 def _parse_k8s_pod_affinity_term(t: Dict) -> PodAffinityTerm:
     sel = t.get("labelSelector", {}) or {}
-    # matchLabels only (matchExpressions on pod selectors would need operator
-    # matching against pod labels — the predicate matcher consumes the
-    # exact-match dict form).
     return PodAffinityTerm(
         label_selector={k: str(v) for k, v in sel.get("matchLabels", {}).items()},
         topology_key=t.get("topologyKey", "kubernetes.io/hostname"),
         namespaces=list(t.get("namespaces", []) or []),
+        expressions=[_parse_requirement(r) for r in sel.get("matchExpressions", []) or []],
     )
 
 

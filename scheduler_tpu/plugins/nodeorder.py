@@ -60,11 +60,10 @@ def _pod_matches_term(pod, term, owner_namespace: str) -> bool:
     namespaces = term.namespaces or [owner_namespace]
     if pod.namespace not in namespaces:
         return False
-    labels = pod.labels
-    return all(labels.get(k) == v for k, v in term.label_selector.items())
+    return term.matches_labels(pod.labels)
 
 
-def inter_pod_affinity_scores(task: TaskInfo, nodes, weight: float) -> Dict[str, float]:
+def inter_pod_affinity_scores(ssn, task: TaskInfo, nodes, weight: float) -> Dict[str, float]:
     """The InterPodAffinity batch priority
     (reference ``nodeorder.go:229-247`` -> k8s 1.13
     ``CalculateInterPodAffinityPriority``): for every existing pod, the
@@ -72,9 +71,14 @@ def inter_pod_affinity_scores(task: TaskInfo, nodes, weight: float) -> Dict[str,
     existing pod's terms matching the incoming pod spread +-term.weight over
     every node in the matched pod's topology domain (hard affinity terms of
     existing pods count with DefaultHardPodAffinitySymmetricWeight).  Counts
-    max-min normalize to 0..10, then scale by ``podaffinity.weight``."""
+    max-min normalize to 0..10, then scale by ``podaffinity.weight``.
+
+    ``nodes`` are the CANDIDATE nodes being scored; existing pods are scanned
+    over EVERY session node like the k8s mapper — a matched pod whose own
+    node fails the incoming pod's predicate still boosts candidates in its
+    topology domain."""
     counts: Dict[str, float] = {n.name: 0.0 for n in nodes}
-    domains: Dict[str, Dict[str, list]] = {}  # key -> value -> node names
+    domains: Dict[str, Dict[str, list]] = {}  # key -> value -> candidate names
 
     def domain(key: str, value) -> list:
         if value is None:
@@ -98,7 +102,7 @@ def inter_pod_affinity_scores(task: TaskInfo, nodes, weight: float) -> Dict[str,
     in_anti = list(getattr(in_aff, "pod_anti_preferred", ()) or ()) if in_aff else []
     hard_w = HARD_POD_AFFINITY_SYMMETRIC_WEIGHT
 
-    for node in nodes:
+    for node in ssn.nodes.values():
         for ep in node.tasks.values():
             if ep.uid == task.uid:
                 continue
@@ -171,7 +175,7 @@ class NodeOrderPlugin(Plugin):
         if w_pod and any(job.pod_affinity_tasks for job in ssn.jobs.values()):
 
             def batch_node_order_fn(task: TaskInfo, nodes) -> Dict[str, float]:
-                return inter_pod_affinity_scores(task, nodes, w_pod)
+                return inter_pod_affinity_scores(ssn, task, nodes, w_pod)
 
             ssn.add_batch_node_order_fn(self.name(), batch_node_order_fn)
 

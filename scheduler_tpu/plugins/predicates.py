@@ -117,8 +117,7 @@ class PredicatesPlugin(Plugin):
                 continue
             if other.namespace not in namespaces:
                 continue
-            labels = other.pod.labels
-            if all(labels.get(k) == v for k, v in term.label_selector.items()):
+            if term.matches_labels(other.pod.labels):
                 return True
         return False
 

@@ -11,6 +11,7 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -270,3 +271,36 @@ def test_k8s_shaped_objects_cross_the_wire(wire):
             if isinstance(p.get("metadata"), dict)}
     final = [pods[n].get("spec", {}).get("nodeName") for n in ("k8s-init-a", "k8s-init-b")]
     assert sum(1 for v in final if v) == 1, final
+
+
+def test_failed_bind_resyncs_one_object_not_a_relist(wire):
+    """syncTask semantics (event_handlers.go:96-114): ONE failed bind causes
+    ONE single-object GET — never a full LIST of the store.  The test polls
+    the single-object endpoint (counting its own GETs) so the daemon's LIST
+    count stays attributable."""
+    # Let the daemon finish its initial LIST before snapshotting counters.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and _get("/stats")["list_calls"] == 0:
+        time.sleep(0.2)
+    before = _get("/stats")
+    _post("/inject", {"op": "bind", "times": 1})
+    _add("podgroup", {"name": "wj-sync", "queue": "default", "minMember": 1,
+                      "phase": "Inqueue"})
+    _add("pod", {"name": "wj-sync-0", "group": "wj-sync",
+                 "containers": [{"cpu": 200, "memory": 2**29}]})
+    polls = 0
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        polls += 1
+        try:
+            pod = _get("/objects/pod/default/wj-sync-0")
+        except urllib.error.HTTPError:
+            pod = {}
+        if pod.get("nodeName"):
+            break
+        time.sleep(0.3)
+    assert pod.get("nodeName"), "pod never bound after injected failure"
+    after = _get("/stats")
+    daemon_gets = after["get_calls"] - before["get_calls"] - polls
+    assert daemon_gets >= 1, (before, after, polls)
+    assert after["list_calls"] == before["list_calls"], (before, after)

@@ -428,3 +428,25 @@ class TestK8sWireShapes:
         assert aff.pod_affinity[0].label_selector == {"app": "db"}
         w, term = aff.pod_anti_preferred[0]
         assert w == 50 and term.topology_key == "zone"
+
+    def test_k8s_pod_affinity_match_expressions(self):
+        """matchExpressions must constrain pod selectors — an empty parsed
+        selector would match EVERY pod (round-4 review finding)."""
+        from scheduler_tpu.connector.wire import parse_pod
+
+        pod = parse_pod({
+            "metadata": {"name": "expr", "namespace": "d"},
+            "spec": {
+                "containers": [{"resources": {"requests": {"cpu": "1"}}}],
+                "affinity": {"podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {"labelSelector": {"matchExpressions": [
+                            {"key": "app", "operator": "In", "values": ["db"]}]},
+                         "topologyKey": "kubernetes.io/hostname"},
+                    ]}},
+            },
+        })
+        term = pod.affinity.pod_anti_affinity[0]
+        assert term.matches_labels({"app": "db"})
+        assert not term.matches_labels({"app": "web"})
+        assert not term.matches_labels({})

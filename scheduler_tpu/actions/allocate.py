@@ -50,13 +50,47 @@ def _fused_enabled() -> bool:
     return os.environ.get("SCHEDULER_TPU_FUSED", "1") not in ("0", "false")
 
 
-def _strict_order() -> bool:
-    """Opt out of the static-first device pass: with mixed static/dynamic
-    jobs the device engines place all static jobs before any dynamic one,
-    which can hand resources to a lower-priority static job (documented
-    deviation from allocate.go:95-133's single interleaved order).  Strict
-    mode routes the whole session through the exact host loop instead."""
-    return os.environ.get("SCHEDULER_TPU_STRICT_ORDER", "0") in ("1", "true")
+def _strict_order_mode() -> str:
+    """How to handle mixed static/dynamic sessions, where the device engines
+    place all static jobs before any dynamic one (a deviation from
+    allocate.go:95-133's single interleaved order):
+
+    * ``auto`` (default): run static-first UNLESS the deviation could invert
+      priorities — a dynamic job the job order ranks ahead of one of its
+      queue's static jobs (``_ordering_inversion``) routes the whole session
+      through the exact host loop.  Matches reference ordering wherever it
+      can differ, keeps the engine wherever it cannot.
+    * ``1``/``true``/``always``: always the exact interleaved host loop.
+    * ``0``/``false``/``never``: always static-first (the round-3 default).
+    """
+    raw = os.environ.get("SCHEDULER_TPU_STRICT_ORDER", "auto").lower()
+    if raw in ("1", "true", "always"):
+        return "always"
+    if raw in ("0", "false", "never"):
+        return "never"
+    return "auto"
+
+
+def _ordering_inversion(ssn, static_jobs: List[JobInfo], dynamic_jobs: List[JobInfo]) -> bool:
+    """True when static-first could hand resources to a lower-ranked job:
+    some queue holds a dynamic job that the session job order ranks AHEAD of
+    one of that queue's static jobs.  Within-queue order is the reference's
+    primary dispensing key; cross-queue rotation is share-driven and
+    self-correcting, so this is the pair the deviation can actually flip.
+    O(jobs) comparator calls, and only on cycles that have dynamic jobs."""
+    best_dynamic: dict = {}
+    order = ssn.job_order_fn
+    for d in dynamic_jobs:
+        cur = best_dynamic.get(d.queue)
+        if cur is None or order(d, cur):
+            best_dynamic[d.queue] = d
+    if not best_dynamic:
+        return False
+    for s in static_jobs:
+        d = best_dynamic.get(s.queue)
+        if d is not None and order(d, s):
+            return True
+    return False
 
 
 def collect_candidates(ssn) -> List[JobInfo]:
@@ -173,9 +207,18 @@ class AllocateAction(Action):
             from scheduler_tpu.ops.fused import FusedAllocator
 
             static_jobs, dynamic_jobs = split_dynamic(ssn, candidates)
-            if dynamic_jobs and _strict_order():
-                # The user asked for the reference's exact interleaved job
-                # order across static and dynamic jobs: one host loop for all.
+            mode = _strict_order_mode()
+            strict = dynamic_jobs and (
+                mode == "always"
+                or (
+                    mode == "auto"
+                    and static_jobs
+                    and _ordering_inversion(ssn, static_jobs, dynamic_jobs)
+                )
+            )
+            if strict:
+                # Reference-exact interleaved job order across static and
+                # dynamic jobs: one host loop for all.
                 self._heap_loop(ssn, candidates, None)
                 return
             if _fused_enabled() and FusedAllocator.supported(ssn, static_jobs):

@@ -54,18 +54,21 @@ class FakeBinder(Binder):
         self._cond = threading.Condition(self.lock)
         self._folded: dict = {}
         self._keys: List[str] = []  # bind-order key log (drives wait())
-        self._batches: list = []  # deferred (pods, hostnames) batches
+        self._times: List[float] = []  # monotonic record time per key
+        self._batches: list = []  # deferred (pods, hostnames, t) batches
         self._count = 0
         self._served = 0
 
     def _fold_locked(self) -> None:
-        for pods, hostnames in self._batches:
+        for pods, hostnames, t in self._batches:
             folded = self._folded
             append = self._keys.append
+            tappend = self._times.append
             for pod, hostname in zip(pods, hostnames):
                 key = f"{pod.namespace}/{pod.name}"
                 folded[key] = hostname
                 append(key)
+                tappend(t)
         self._batches.clear()
 
     @property
@@ -75,11 +78,14 @@ class FakeBinder(Binder):
             return self._folded
 
     def bind(self, pod, hostname: str) -> None:
+        import time as _time
+
         with self._cond:
             self._fold_locked()
             key = f"{pod.namespace}/{pod.name}"
             self._folded[key] = hostname
             self._keys.append(key)
+            self._times.append(_time.monotonic())
             self._count += 1
             self._cond.notify_all()
 
@@ -87,10 +93,24 @@ class FakeBinder(Binder):
         self.bind_rows([p for p, _ in pairs], [h for _, h in pairs])
 
     def bind_rows(self, pods, hostnames) -> None:
+        import time as _time
+
         with self._cond:
-            self._batches.append((pods, hostnames))
+            self._batches.append((pods, hostnames, _time.monotonic()))
             self._count += len(hostnames)
             self._cond.notify_all()
+
+    def bind_records(self):
+        """[(key, hostname, monotonic_time)] in bind order — the per-pod
+        latency join the benchmark harness consumes (the reference's
+        benchmark joins scheduler events with pod timestamps the same way,
+        test/e2e/benchmark.go:262-282)."""
+        with self.lock:
+            self._fold_locked()
+            return [
+                (k, self._folded[k], t)
+                for k, t in zip(self._keys, self._times)
+            ]
 
     def wait(self, n: int, timeout: float = 3.0) -> List[str]:
         """Block until n more binds were recorded (or raise queue.Empty).

@@ -69,13 +69,50 @@ def measure(name, factory, conf_str, actions, placed_of, cycles=20,
         # buckets) compile here, like the steady loop's first tick.
         churn(cache, rng, 0)
         steady_cycle(cache, conf, actions)
-        lat, placed = [], []
-        for i in range(1, cycles + 1):
-            churn(cache, rng, i)
-            before = placed_of(cache)
-            el = timed_cycle(cache, conf, actions)
-            lat.append(el)
-            placed.append(placed_of(cache) - before)
+        # Per-pod latency join (the reference benchmark's create->schedule
+        # percentiles, test/e2e/benchmark.go:262-282 + metric_util.go:70-83):
+        # arrivals stamp at add_pod, placements at bind (FakeBinder records)
+        # or pipeline (reclaim's placement op — fake-backed runs never bind
+        # pipelined tasks, so the session op IS the schedule event).
+        import time as _time
+
+        from scheduler_tpu.framework.session import Session
+
+        arrivals: dict = {}
+        placements: dict = {}
+        orig_add = cache.add_pod
+        bind_seen = len(cache.binder.bind_records())
+
+        def tracked_add(pod):
+            arrivals[f"{pod.namespace}/{pod.name}"] = _time.monotonic()
+            orig_add(pod)
+
+        cache.add_pod = tracked_add
+        orig_pipeline = Session.pipeline
+
+        def tracked_pipeline(self, task, hostname):
+            placements.setdefault(
+                f"{task.namespace}/{task.name}", _time.monotonic()
+            )
+            return orig_pipeline(self, task, hostname)
+
+        Session.pipeline = tracked_pipeline
+        try:
+            lat, placed = [], []
+            for i in range(1, cycles + 1):
+                churn(cache, rng, i)
+                before = placed_of(cache)
+                el = timed_cycle(cache, conf, actions)
+                lat.append(el)
+                placed.append(placed_of(cache) - before)
+        finally:
+            cache.add_pod = orig_add
+            Session.pipeline = orig_pipeline
+        for key, _host, t in cache.binder.bind_records()[bind_seen:]:
+            placements.setdefault(key, t)
+        pod_lat = [
+            placements[k] - t0 for k, t0 in arrivals.items() if k in placements
+        ]
         rates = [p / e for p, e in zip(placed, lat) if e > 0]
         rec.update({
             "churn_cycles": cycles,
@@ -85,6 +122,13 @@ def measure(name, factory, conf_str, actions, placed_of, cycles=20,
             "cycle_seconds_max": round(max(lat), 3),
             "pods_per_sec_p50": round(float(np.median(rates)), 1) if rates else 0.0,
         })
+        if pod_lat:
+            rec.update({
+                "pod_sched_latency_p50": round(float(np.percentile(pod_lat, 50)), 3),
+                "pod_sched_latency_p90": round(float(np.percentile(pod_lat, 90)), 3),
+                "pod_sched_latency_p99": round(float(np.percentile(pod_lat, 99)), 3),
+                "pod_sched_latency_pods": len(pod_lat),
+            })
     print(json.dumps(rec), flush=True)
     if results is not None:
         results.append(rec)

@@ -1078,27 +1078,98 @@ class FusedAllocator:
         # supersedes both XLA paths.
         self.use_mega = False
         self._mega = None
-        if step_ok and mesh is None:
+        mega_enabled = os.environ.get("SCHEDULER_TPU_MEGA", "1") not in ("0", "false")
+        if step_ok and mega_enabled and mesh is None:
             from scheduler_tpu.ops import megakernel as _mk
 
-            if _mk.mega_supported(
+            # Cheap structural gate FIRST; the per-task signature dedupe
+            # only runs when everything else already admits the kernel.
+            mega_ok = _mk.mega_supported(
                 has_releasing=self.has_releasing,
-                use_static=self.use_static,
+                use_static=False,
                 score_bound=score_bound,
                 cursor_mode=single_queue,
                 r_dim=r,
                 n=nb,
                 n_sigs=1,  # sig count checked below after the table builds
                 comparators=self.comparators,
-            ):
+            )
+            static_sids = None
+            if mega_ok and self.use_static:
+                static_sids = self._static_signature_ids(ssn)
+                mega_ok = static_sids is not None and _mk.mega_supported(
+                    has_releasing=self.has_releasing,
+                    use_static=True,
+                    score_bound=score_bound,
+                    cursor_mode=single_queue,
+                    r_dim=r,
+                    n=nb,
+                    n_sigs=1,
+                    comparators=self.comparators,
+                    n_static_sigs=(
+                        int(static_sids.max()) + 1 if static_sids.size else 0
+                    ),
+                )
+            if mega_ok:
                 self._prepare_mega(policy, scale, state, node_gate, nb, tb, r,
                                    offsets, nums, deficits, gang_order,
                                    priorities, tiebreak, alloc_init, total,
-                                   run_dev)
+                                   run_dev, score_bound, static_sids,
+                                   static_mask_dev, static_score_dev)
+
+    def _static_signature_ids(self, ssn) -> Optional[np.ndarray]:
+        """Dense per-task STATIC-signature ids: tasks sharing (selector row,
+        toleration row, unknown flag, affinity spec) share one [N] static
+        mask/score row, so the mega kernel keeps a tiny per-signature VMEM
+        table instead of the [T, N] matrices.  Sound only for the builtin
+        device builders (predicates/nodeorder), whose contributions are pure
+        functions of exactly those columns — any other builder returns None
+        and the session keeps the XLA paths."""
+        if (set(ssn.device_predicates) | set(ssn.device_scorers)) - {
+            "predicates", "nodeorder"
+        }:
+            return None
+        st = self.st
+        t = self.flat_count
+        sel = st.tasks.selector[:t]
+        tol = st.tasks.tolerated[:t]
+        hu = st.tasks.has_unknown_selector[:t]
+        req_aff = st.tasks.req_aff[:t]
+        pref_aff = st.tasks.pref_aff[:t]
+        cols = [hu[:, None]]
+        if sel.shape[1]:
+            cols.insert(0, sel)
+        if tol.shape[1]:
+            cols.append(tol)
+        from scheduler_tpu.api.job_info import unique_row_codes
+
+        codes, _ = unique_row_codes(np.hstack(cols).astype(np.uint8))
+        _, base_ids = np.unique(codes, return_inverse=True)
+        aff_rows = req_aff | pref_aff
+        if not aff_rows.any():
+            return base_ids.astype(np.int32)
+        # Only affinity-carrying rows need the Python walk (their static rows
+        # depend on the affinity SPEC, keyed by value-based dataclass repr);
+        # everything else is the vectorized dense id above.
+        combined = base_ids.astype(np.int64)
+        offset = int(base_ids.max()) + 1
+        key_of: dict = {}
+        cores = st.tasks.cores
+        for i in np.nonzero(aff_rows)[0].tolist():
+            pod = cores[i].pod
+            key = (int(base_ids[i]), repr(pod.affinity) if pod is not None else "")
+            sid = key_of.get(key)
+            if sid is None:
+                sid = key_of[key] = offset + len(key_of)
+            combined[i] = sid
+        _, sids = np.unique(combined, return_inverse=True)  # densify
+        return sids.astype(np.int32)
 
     def _prepare_mega(self, policy, scale, state, node_gate, nb, tb, r,
                       offsets, nums, deficits, gang_order, priorities,
-                      tiebreak, alloc_init, total, run_dev) -> None:
+                      tiebreak, alloc_init, total, run_dev,
+                      score_bound=False, static_sids=None,
+                      static_mask_dev=None, static_score_dev=None) -> None:
         """Build the mega-kernel's inputs (ops/megakernel.py) — per-signature
         request table, lane-packed job columns, transposed node rows.  Sets
         ``use_mega`` only if the signature table fits the kernel's cap."""
@@ -1156,16 +1227,47 @@ class FusedAllocator:
         misc = np.zeros((1, 8), dtype=np.int32)
         misc[0, 0] = len(self.jobs)  # n_real: every kept job has pending rows
 
+        # Per-signature static rows: representative [N] mask/score rows
+        # gathered ON DEVICE from the [T, N] tensors (which never cross the
+        # host boundary), plus the per-task signature-id column.
+        if self.use_static and static_sids is not None:
+            s_count = int(static_sids.max()) + 1 if static_sids.size else 1
+            s_pad = max(8, -(-s_count // 8) * 8)
+            _, first_rows = np.unique(static_sids, return_index=True)
+            rep = jnp.asarray(first_rows.astype(np.int64))
+            smask = (
+                jnp.zeros((s_pad, nb), jnp.float32)
+                .at[:s_count]
+                .set(static_mask_dev[rep].astype(jnp.float32))
+            )
+            sscore = (
+                jnp.zeros((s_pad, nb), jnp.float32)
+                .at[:s_count]
+                .set(static_score_dev[rep])
+            )
+            msig = np.zeros((1, tb), dtype=np.int32)
+            msig[0, :t] = static_sids
+        else:
+            smask = jnp.zeros((8, nb), jnp.float32)
+            sscore = jnp.zeros((8, nb), jnp.float32)
+            msig = np.zeros((1, tb), dtype=np.int32)
+
         ns0 = (
             jnp.zeros((16, nb), jnp.float32)
             .at[:r].set(state.idle.T)
             .at[8].set(state.task_count.astype(jnp.float32))
         )
         alloc_t = jnp.zeros((8, nb), jnp.float32).at[:r].set(state.allocatable.T)
+        rel_t = (
+            jnp.zeros((8, nb), jnp.float32).at[:r].set(state.releasing.T)
+            if self.has_releasing
+            else jnp.zeros((8, nb), jnp.float32)
+        )
 
         self._mega_args = (
             ns0,
             alloc_t,
+            rel_t,
             jnp.asarray(node_gate)[None, :],
             state.pods_limit.astype(jnp.float32)[None, :],
             jnp.asarray(sig_req),
@@ -1180,6 +1282,9 @@ class FusedAllocator:
             jnp.asarray(js_drf0),
             jnp.asarray(drf_safe),
             jnp.asarray(drf_mask),
+            jnp.asarray(msig),
+            smask,
+            sscore,
             jnp.asarray(misc),
         )
         mins_f32 = np.asarray(policy.scaled_mins(r), dtype=np.float32)
@@ -1190,6 +1295,9 @@ class FusedAllocator:
             comparators=self.comparators,
             cross_batch=self.batch_runs,  # cursor mode is a mega precondition
             batch_runs=self.batch_runs,
+            has_releasing=self.has_releasing,
+            use_static=self.use_static and static_sids is not None,
+            score_bound=score_bound,
             mins=tuple(float(x) for x in mins_f32),
             cpu_idx=_CPU_IDX,
             mem_idx=_MEM_IDX,

@@ -10,12 +10,16 @@ micro-step is straight-line VPU code with zero per-op dispatch, and the only
 HBM traffic is the initial tensor load plus the final [T] result store.
 
 Semantics are identical to ``fused_allocate`` in CURSOR MODE (single queue,
-init-key-sorted jobs) without releasing resources or static [T, N] tensors —
-the shape of the 100k-pod benchmark and of churn steady states.  The host
-shim (``FusedAllocator``) gates on exactly those conditions and falls back
-to the XLA program otherwise; ``tests/test_megakernel.py`` asserts the gate
-engages and pins the two programs bit-for-bit (the three-engine and fuzz
-parity suites exercise the kernel against the host loop as well).
+init-key-sorted jobs).  Round 4 widened the coverage: RELEASING resources
+ride a second VMEM ledger (pipelined placements, ``-3 - node`` codes),
+static [T, N] mask/score tensors dedupe into per-signature VMEM rows, and
+batched identical-request runs carry the top-2 score bound in-kernel — so
+the kernel now also covers churn states mid-eviction and predicates/
+nodeorder sessions.  The host shim (``FusedAllocator``) gates on
+``mega_supported`` and falls back to the XLA program otherwise;
+``tests/test_megakernel.py`` asserts the gate engages and pins the two
+programs bit-for-bit (the three-engine and fuzz parity suites exercise the
+kernel against the host loop as well).
 
 Layout notes (mosaic on this TPU stack):
 
@@ -46,6 +50,7 @@ from jax.experimental.pallas import tpu as pltpu
 # Result encoding — MUST match ops/fused.py.
 UNPLACED = -1
 FAILED = -2
+PIPE_BASE = -3  # pipelined code = PIPE_BASE - node (fused.py _PIPE_BASE)
 HALT = -100
 MAX_BATCH = 128
 
@@ -66,12 +71,20 @@ def mega_supported(
     n: int,
     n_sigs: int,
     comparators: Tuple[str, ...],
+    n_static_sigs: int = 0,
 ) -> bool:
+    # Round 4 widened the gate: releasing resources ride a second VMEM
+    # ledger, static [T, N] tensors dedupe into per-signature VMEM rows
+    # (n_static_sigs, capped so mask+score fit the scratch budget), and
+    # batched runs carry the top-2 score bound in-kernel.  The parameters
+    # stay for the caller's clarity.
+    del has_releasing, score_bound
+    if use_static:
+        s_pad = max(8, -(-n_static_sigs // 8) * 8)  # the ACTUAL VMEM rows
+        if not (0 < n_static_sigs and s_pad * n * 8 <= 4 * 1024 * 1024):
+            return False
     return (
         cursor_mode
-        and not has_releasing
-        and not use_static
-        and not score_bound
         and r_dim <= 8
         and n <= 32768
         and 0 < n_sigs <= 4096
@@ -83,13 +96,15 @@ def mega_supported(
     jax.jit,
     static_argnames=(
         "r_dim", "weights", "enforce_pod_count", "comparators",
-        "cross_batch", "batch_runs", "mins", "cpu_idx", "mem_idx",
+        "cross_batch", "batch_runs", "has_releasing", "use_static",
+        "score_bound", "mins", "cpu_idx", "mem_idx",
         "interpret",
     ),
 )
 def mega_allocate(
     ns0: jnp.ndarray,        # f32 [16, N]  rows 0..7 idle, row 8 task_count
     alloc_t: jnp.ndarray,    # f32 [8, N]   allocatable
+    rel0: jnp.ndarray,       # f32 [8, N]   releasing (zeros when unused)
     gate: jnp.ndarray,       # bool [1, N]
     plim: jnp.ndarray,       # f32 [1, N]
     sig_req: jnp.ndarray,    # f32 [16, S]  rows 0..7 resreq, 8..15 init_resreq
@@ -104,6 +119,9 @@ def mega_allocate(
     js_drf0: jnp.ndarray,    # f32 [8, J] drf allocated at session open
     drf_safe: jnp.ndarray,   # f32 [8, 1] totals (1 where absent)
     drf_mask: jnp.ndarray,   # f32 [8, 1] 1 where total > 0
+    msig: jnp.ndarray,       # i32 [1, T] static-signature id per task
+    smask: jnp.ndarray,      # f32 [S_pad, N] static mask rows (1.0/0.0)
+    sscore: jnp.ndarray,     # f32 [S_pad, N] static score rows
     misc: jnp.ndarray,       # i32 [1, 8] SMEM: [n_real, ...]
     *,
     r_dim: int,
@@ -112,6 +130,9 @@ def mega_allocate(
     comparators: Tuple[str, ...],
     cross_batch: bool,
     batch_runs: bool,
+    has_releasing: bool,
+    use_static: bool,
+    score_bound: bool,
     mins: Tuple[float, ...],     # static epsilon thresholds, len r_dim
     cpu_idx: int,
     mem_idx: int,
@@ -120,14 +141,16 @@ def mega_allocate(
     n = ns0.shape[1]
     t_pad = task_sig.shape[1]
     j_pad = job_off.shape[1]
+    s_pad = smask.shape[0]
     # The 2-row write window must fit even when rowlo is the last real row.
     t_sub = (t_pad - 1) // 128 + 2
     lr_w, bal_w, bp_w = (float(w) for w in weights)
     max_steps = t_pad + 8
 
-    def kernel(ns0_ref, alloc_ref, gate_ref, plim_ref, sigr_ref, tsig_ref,
-               rlen_ref, joff_ref, jnum_ref, jdef_ref, jgang_ref, jprio_ref,
-               jtb_ref, jdrf0_ref, dsafe_ref, dmask_ref, misc_ref,
+    def kernel(ns0_ref, alloc_ref, rel0_ref, gate_ref, plim_ref, sigr_ref,
+               tsig_ref, rlen_ref, joff_ref, jnum_ref, jdef_ref, jgang_ref,
+               jprio_ref, jtb_ref, jdrf0_ref, dsafe_ref, dmask_ref,
+               msig_ref, smask_ref, sscore_ref, misc_ref,
                out_ref, ns, js):
         neg_inf = float("-inf")
         pos_inf = float("inf")
@@ -136,7 +159,12 @@ def mega_allocate(
         lane_s = _lane_iota((1, sigr_ref.shape[1]))
 
         # State into VMEM scratch; result initialized to UNPLACED.
-        ns[:, :] = ns0_ref[:, :]
+        # Layout: rows [0..8) idle, row 8 task_count, rows [16..24) the
+        # RELEASING ledger (present only when the session has releasing
+        # resources — the scratch is 16 rows otherwise).
+        ns[0:16, :] = ns0_ref[:, :]
+        if has_releasing:
+            ns[16:24, :] = rel0_ref[:, :]
         js[0:8, :] = jnp.zeros((8, j_pad), jnp.float32)
         js[8:16, :] = jdrf0_ref[:, :]
         out_ref[:, :] = jnp.full((t_sub, 128), UNPLACED, jnp.int32)
@@ -211,6 +239,15 @@ def mega_allocate(
             lane_t = _lane_iota((1, t_pad))
             sig = read_i32(tsig_ref[:], lane_t, t_idx)
             rl = read_i32(rlen_ref[:], lane_t, t_idx)
+            if use_static:
+                # Per-signature static mask/score rows (deduped host-side);
+                # dynamic SUBLANE slicing is supported (same pattern as the
+                # out_ref window write below).
+                ms = jnp.clip(
+                    read_i32(msig_ref[:], lane_t, t_idx), 0, s_pad - 1
+                )
+                mrow = smask_ref[pl.ds(ms, 1), :]
+                srow = sscore_ref[pl.ds(ms, 1), :]
 
             reqs = []
             initqs = []
@@ -219,12 +256,28 @@ def mega_allocate(
                 initqs.append(read_f32(sigr_ref[8 + r : 8 + r + 1, :], lane_s, sig))
 
             # ---- fit + score + masked argmax (rows unrolled) ----
-            feas = gate_v
+            feas_idle = gate_v
             for r in range(r_dim):
                 idle_r = ns[r : r + 1, :]
-                feas = feas & (
+                feas_idle = feas_idle & (
                     (initqs[r] < idle_r) | (jnp.abs(idle_r - initqs[r]) < mins[r])
                 )
+            if has_releasing:
+                # The idle-OR-releasing pre-predicate (allocate.go:80-93):
+                # a task that fits what a releasing victim will free may
+                # PIPELINE onto it.
+                feas_rel = gate_v
+                for r in range(r_dim):
+                    rel_r = ns[16 + r : 16 + r + 1, :]
+                    feas_rel = feas_rel & (
+                        (initqs[r] < rel_r)
+                        | (jnp.abs(rel_r - initqs[r]) < mins[r])
+                    )
+                feas = feas_idle | feas_rel
+            else:
+                feas = feas_idle
+            if use_static:
+                feas = feas & (mrow > 0.0)
             if enforce_pod_count:
                 feas = feas & (ns[8:9, :] < plim_v)
 
@@ -248,6 +301,8 @@ def mega_allocate(
                     fc = jnp.clip(req_c / safe_c, 0.0, 1.0)
                     fm = jnp.clip(req_m / safe_m, 0.0, 1.0)
                     score = score + bal_w * ((1.0 - jnp.abs(fc - fm)) * 10.0)
+            if use_static:
+                score = score + srow
 
             masked = jnp.where(feas, score, neg_inf)
             maxv = jnp.max(masked)
@@ -261,6 +316,18 @@ def mega_allocate(
             placed = active & any_feasible
             failed = active & ~any_feasible
             single_pop = num_v == 1
+            if has_releasing:
+                alloc_best = (
+                    jnp.max(
+                        jnp.where(lane_n == best, feas_idle.astype(jnp.int32), 0)
+                    )
+                    > 0
+                )
+                alloc_here = placed & alloc_best
+                pipe_here = placed & ~alloc_best
+            else:
+                alloc_here = placed
+                pipe_here = jnp.asarray(False)
 
             # ---- run batching (binpack-exact; no score bound here) ----
             if batch_runs:
@@ -289,22 +356,84 @@ def mega_allocate(
                         (initqs[r] < avail_r)
                         | (jnp.abs(avail_r - initqs[r]) < mins[r])
                     )
+                if score_bound:
+                    # Top-2 bound (fused.py score_bound block): placement j
+                    # still picks `best` iff its score after j-1 placements
+                    # beats the runner-up; ties break to the lower index.
+                    # Prefix semantics via first-failure position (no cumprod
+                    # on this backend).
+                    others = jnp.where(lane_n == best, neg_inf, masked)
+                    second = jnp.max(others)
+                    second_idx = jnp.min(
+                        jnp.where(others == second, lane_n, jnp.int32(n))
+                    )
+                    a_c_b = read_f32(
+                        alloc_ref[cpu_idx : cpu_idx + 1, :], lane_n, best
+                    )
+                    a_m_b = read_f32(
+                        alloc_ref[mem_idx : mem_idx + 1, :], lane_n, best
+                    )
+                    idle_c_b = read_f32(
+                        ns[cpu_idx : cpu_idx + 1, :], lane_n, best
+                    )
+                    idle_m_b = read_f32(
+                        ns[mem_idx : mem_idx + 1, :], lane_n, best
+                    )
+                    jm1 = (js_vec - 1).astype(jnp.float32)
+                    avail_c = idle_c_b - jm1 * reqs[cpu_idx]
+                    avail_m = idle_m_b - jm1 * reqs[mem_idx]
+                    safe_cb = jnp.where(a_c_b > 0, a_c_b, 1.0)
+                    safe_mb = jnp.where(a_m_b > 0, a_m_b, 1.0)
+                    reqd_c = a_c_b - avail_c + reqs[cpu_idx]
+                    reqd_m = a_m_b - avail_m + reqs[mem_idx]
+                    s_js = jnp.zeros((1, MAX_BATCH), jnp.float32)
+                    if bp_w:
+                        fc = jnp.clip(reqd_c / safe_cb, 0.0, 1.0)
+                        fm = jnp.clip(reqd_m / safe_mb, 0.0, 1.0)
+                        s_js = s_js + bp_w * (((fc + fm) / 2.0) * 10.0)
+                    if lr_w:
+                        lc = jnp.clip((a_c_b - reqd_c) / safe_cb, 0.0, 1.0)
+                        lm = jnp.clip((a_m_b - reqd_m) / safe_mb, 0.0, 1.0)
+                        s_js = s_js + lr_w * (((lc + lm) / 2.0) * 10.0)
+                    if bal_w:
+                        fc = jnp.clip(reqd_c / safe_cb, 0.0, 1.0)
+                        fm = jnp.clip(reqd_m / safe_mb, 0.0, 1.0)
+                        s_js = s_js + bal_w * ((1.0 - jnp.abs(fc - fm)) * 10.0)
+                    if use_static:
+                        s_js = s_js + read_f32(srow, lane_n, best)
+                    ok_s = (s_js > second) | (
+                        (s_js == second) & (best < second_idx)
+                    )
+                    first_false = jnp.min(
+                        jnp.where(~ok_s, js_vec, jnp.int32(MAX_BATCH + 1))
+                    )
+                    ok = ok & (js_vec < first_false)
                 fit_count = jnp.max(jnp.where(ok & (js_vec <= hi0), js_vec, 1))
-                m = jnp.where(placed, fit_count, 1)
+                m = jnp.where(alloc_here, fit_count, 1)
             else:
                 m = jnp.int32(1)
             cross_active = (
-                (single_pop & placed) if cross_batch else jnp.asarray(False)
+                (single_pop & alloc_here) if cross_batch else jnp.asarray(False)
             )
 
-            consumed = jnp.where(placed, m, failed.astype(jnp.int32))
-            m_alloc = jnp.where(placed, m, 0).astype(jnp.float32)
+            consumed = jnp.where(
+                alloc_here, m, (pipe_here | failed).astype(jnp.int32)
+            )
+            m_alloc = jnp.where(alloc_here, m, 0).astype(jnp.float32)
+            pipe_f = pipe_here.astype(jnp.float32) if has_releasing else 0.0
 
             # ---- node ledger update (masked column add) ----
             eq_n = (lane_n == best).astype(jnp.float32)
             for r in range(r_dim):
                 ns[r : r + 1, :] = ns[r : r + 1, :] - (reqs[r] * m_alloc) * eq_n
-            ns[8:9, :] = ns[8:9, :] + m_alloc * eq_n
+            if has_releasing:
+                for r in range(r_dim):
+                    ns[16 + r : 16 + r + 1, :] = (
+                        ns[16 + r : 16 + r + 1, :] - (reqs[r] * pipe_f) * eq_n
+                    )
+                ns[8:9, :] = ns[8:9, :] + (m_alloc + pipe_f) * eq_n
+            else:
+                ns[8:9, :] = ns[8:9, :] + m_alloc * eq_n
 
             # ---- job ledger update (masked window add) ----
             k = jnp.where(cross_active, m, 1)
@@ -319,7 +448,7 @@ def mega_allocate(
             js[0:1, :] = js[0:1, :] + cons_add * win
             js[1:2, :] = js[1:2, :] + alloc_add * win
             js[2:3, :] = js[2:3, :] + left_add * win
-            drf_scale = jnp.where(cross_active, 1.0, m_alloc)
+            drf_scale = jnp.where(cross_active, 1.0, m_alloc + pipe_f)
             for r in range(r_dim):
                 js[8 + r : 8 + r + 1, :] = (
                     js[8 + r : 8 + r + 1, :] + (reqs[r] * drf_scale) * win
@@ -327,7 +456,13 @@ def mega_allocate(
 
             # ---- result write (2-row window around t_idx) ----
             code = jnp.where(
-                placed, best, jnp.where(failed, jnp.int32(FAILED), jnp.int32(UNPLACED))
+                alloc_here,
+                best,
+                jnp.where(
+                    pipe_here,
+                    jnp.int32(PIPE_BASE) - best,
+                    jnp.where(failed, jnp.int32(FAILED), jnp.int32(UNPLACED)),
+                ),
             )
             wcount = jnp.where(active, consumed, 0)
             rowlo = t_idx // 128
@@ -376,18 +511,19 @@ def mega_allocate(
         kernel,
         out_shape=jax.ShapeDtypeStruct((t_sub, 128), jnp.int32),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(16)
+            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(20)
         ] + [pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((16, n), jnp.float32),      # ns: idle rows + task_count
+            # idle+count rows, plus the releasing ledger rows when live.
+            pltpu.VMEM((24 if has_releasing else 16, n), jnp.float32),
             pltpu.VMEM((16, j_pad), jnp.float32),  # js: cons/alloc/left + drf
         ],
         interpret=interpret,
     )(
-        ns0, alloc_t, gate, plim, sig_req, task_sig, run_len,
+        ns0, alloc_t, rel0, gate, plim, sig_req, task_sig, run_len,
         job_off, job_num, job_deficit, job_gang, job_prio, job_tb,
-        js_drf0, drf_safe, drf_mask, misc,
+        js_drf0, drf_safe, drf_mask, msig, smask, sscore, misc,
     )
     return out.reshape(-1)[:t_pad]
 

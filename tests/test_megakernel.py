@@ -88,12 +88,7 @@ def test_mega_kernel_engages_and_matches_xla():
     assert int((mega >= 0).sum()) > 0
 
 
-def test_step_kernel_engages_with_static_tensors():
-    """With the predicates plugin registered (static [T, N] tensors) the
-    mega-kernel must NOT engage, the step kernel must, and the step-kernel
-    program must match the plain XLA step path bit-for-bit.  Requests are
-    all-distinct: nodeorder scoring + identical-request runs would take the
-    top-2 score-bound path, which correctly excludes the step kernel."""
+def _static_cluster():
     cache = SchedulerCache(vocab=make_vocab(), async_io=False)
     cache.run()
     cache.add_queue(build_queue("default"))
@@ -113,9 +108,30 @@ def test_step_kernel_engages_with_static_tensors():
             if g == 1:
                 pod.node_selector = {"zone": "za"}
             cache.add_pod(pod)
-    ssn = open_session(cache, parse_scheduler_conf(PREDICATES_CONF).tiers)
+    return open_session(cache, parse_scheduler_conf(PREDICATES_CONF).tiers)
+
+
+def test_mega_engages_with_static_tensors_and_matches_xla():
+    """Round-4 gate widening: static [T, N] tensors dedupe into per-signature
+    VMEM rows, so the predicates+nodeorder session takes the MEGA kernel —
+    and its codes equal the XLA step path's bit-for-bit."""
+    ssn = _static_cluster()
     engine = FusedAllocator(ssn, collect_candidates(ssn))
-    assert not engine.use_mega
+    assert engine.use_static
+    assert engine.use_mega, "mega gate must accept static sessions now"
+    mega = engine._execute().copy()
+    engine.use_mega = False
+    xla = engine._execute().copy()
+    assert np.array_equal(mega, xla)
+    assert int((mega >= 0).sum()) > 0
+
+
+def test_step_kernel_matches_xla_with_static_tensors():
+    """The fused step kernel (the mega's fallback) still matches the plain
+    XLA step path bit-for-bit on a static-tensor session."""
+    ssn = _static_cluster()
+    engine = FusedAllocator(ssn, collect_candidates(ssn))
+    engine.use_mega = False
     assert engine.step_kernel, "step kernel gate did not engage"
     with_kernel = engine._execute().copy()
     engine.step_kernel = False
@@ -156,3 +172,77 @@ def test_mega_cross_batch_single_task_jobs(conf):
     xla = engine._execute().copy()
     assert np.array_equal(mega, xla)
     assert int((mega >= 0).sum()) == 120
+
+
+def test_mega_kernel_engages_with_releasing_and_matches_xla():
+    """Round-4 gate widening: a session with RELEASING resources (mid-evict
+    churn state) takes the mega-kernel — the pipelined arm rides a second
+    VMEM ledger — and its codes (including the -3-node pipe encoding) equal
+    the XLA while-loop program's bit-for-bit."""
+    from scheduler_tpu.api.types import TaskStatus
+
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    for i in range(6):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": 4000, "memory": 8 * 2**30, "pods": 110}))
+    for j in range(6):
+        cache.add_pod_group(build_pod_group(f"run{j}", min_member=1, phase="Running"))
+        cache.add_pod(build_pod(
+            name=f"run{j}-0", req={"cpu": 3000, "memory": 6 * 2**30},
+            groupname=f"run{j}", nodename=f"n{j}", phase="Running"))
+    for j in range(4):
+        cache.add_pod_group(build_pod_group(f"want{j}", min_member=1, phase="Inqueue"))
+        cache.add_pod(build_pod(
+            name=f"want{j}-0", req={"cpu": 2500, "memory": 5 * 2**30},
+            groupname=f"want{j}"))
+    conf = parse_scheduler_conf(BENCH_CONF)
+    ssn = open_session(cache, conf.tiers)
+    for job in ssn.jobs.values():
+        if job.uid.endswith(("run0", "run1", "run2")):
+            for t in list(job.tasks.values()):
+                ssn.evict(t, "test")
+
+    engine = FusedAllocator(ssn, collect_candidates(ssn))
+    assert engine.has_releasing
+    assert engine.use_mega, "mega gate must accept releasing sessions now"
+    mega = engine._execute().copy()
+    engine.use_mega = False
+    xla = engine._execute().copy()
+    assert np.array_equal(mega, xla)
+    assert int((mega <= -3).sum()) > 0, "expected pipelined placements"
+
+
+def test_mega_score_bound_cuts_batches_like_xla():
+    """Identical-request gangs + nodeorder scoring + selectors: run batching
+    engages WITH the top-2 score bound, the cut point must match the XLA
+    path's bit-for-bit (round-4 review finding: the bound was previously
+    only exercised where run_len == 1)."""
+    import random as _random
+
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    for i in range(8):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": 64000, "memory": 128 * 2**30, "pods": 110},
+            labels={"zone": f"z{i % 4}"}))
+    for g in range(8):
+        cache.add_pod_group(build_pod_group(f"g{g}", min_member=4))
+        for i in range(8):
+            cache.add_pod(build_pod(
+                name=f"g{g}-{i}", req={"cpu": 2000, "memory": 4 * 2**30},
+                groupname=f"g{g}", selector={"zone": f"z{g % 4}"}))
+    ssn = open_session(cache, parse_scheduler_conf(PREDICATES_CONF).tiers)
+    engine = FusedAllocator(ssn, collect_candidates(ssn))
+    assert engine.use_static and engine.batch_runs
+    assert engine.use_mega, "score-bound + static session must take the mega"
+    mega = engine._execute().copy()
+    engine.use_mega = False
+    xla = engine._execute().copy()
+    assert np.array_equal(mega, xla)
+    assert int((mega >= 0).sum()) == engine.flat_count
+    # The least-requested weight actually spreads batches across nodes —
+    # the bound cut batches (one node could fit everything resource-wise).
+    assert len(set(mega[mega >= 0].tolist())) > 1

@@ -1009,32 +1009,19 @@ class FusedAllocator:
         self.enforce_pod_count = "pod_count" in ssn.device_dynamic_gates
 
         state = node_state_from_tensors(st, policy, nb)
-        self.args = (
-            state.idle,
-            state.releasing,
-            state.task_count,
-            state.allocatable,
-            state.pods_limit,
-            jnp.asarray(node_gate),
-            state.mins,
-            jnp.asarray(pad_rows(scale_columns(st.tasks.init_resreq, scale), tb)),
-            jnp.asarray(pad_rows(scale_columns(st.tasks.resreq, scale), tb)),
-            static_mask_dev,
-            static_score_dev,
-            jnp.asarray(offsets),
-            jnp.asarray(nums),
-            jnp.asarray(deficits),
-            jnp.asarray(gang_order),
-            jnp.asarray(priorities),
-            jnp.asarray(tiebreak),
-            jnp.asarray(queues_idx),
-            jnp.asarray(scale_columns(alloc_init, scale)),
-            jnp.asarray(queue_rank),
-            jnp.asarray(queue_has),
-            jnp.asarray(queue_deserved),
-            jnp.asarray(queue_alloc),
-            jnp.asarray(scale_columns(total[None, :], scale)[0]),
-            run_dev,
+        # The XLA program's argument tuple is built LAZILY: when the mega
+        # kernel runs (the common case) the [T, R] request matrices and the
+        # per-job vectors never cross the host->device link — at 100k tasks
+        # that is ~8MB of upload per cycle riding the same tunnel the
+        # readback does, pure waste for a kernel that consumes the deduped
+        # per-signature table instead.  The fallback (and the sharded path)
+        # builds the tuple on first touch.
+        self._args = None
+        self._args_parts = (
+            state, node_gate, scale, tb, offsets, nums, deficits, gang_order,
+            priorities, tiebreak, queues_idx, alloc_init, queue_rank,
+            queue_has, queue_deserved, queue_alloc, total, run_dev,
+            static_mask_dev, static_score_dev,
         )
 
         # Multi-chip: shard the node axis over the configured mesh (--mesh /
@@ -1042,8 +1029,9 @@ class FusedAllocator:
         from scheduler_tpu.ops.mesh import get_mesh, shard_fused_args
 
         mesh = get_mesh()
+        self._mesh = mesh
         if mesh is not None:
-            self.args = shard_fused_args(mesh, self.args)
+            _ = self.args  # sharded sessions always run the XLA program: build now
 
         # Fused selection step kernel (pallas): one launch per micro-step for
         # fit+score+mask+argmax.  Excluded when: the score-bound batch path
@@ -1378,6 +1366,51 @@ class FusedAllocator:
         import os
 
         return max(1, int(os.environ.get("SCHEDULER_TPU_WINDOW", "8")))
+
+    @property
+    def args(self):
+        """The XLA while-loop program's device argument tuple (lazy — see
+        __init__; mega-kernel cycles never build it)."""
+        if self._args is None:
+            (state, node_gate, scale, tb, offsets, nums, deficits, gang_order,
+             priorities, tiebreak, queues_idx, alloc_init, queue_rank,
+             queue_has, queue_deserved, queue_alloc, total, run_dev,
+             static_mask_dev, static_score_dev) = self._args_parts
+            st = self.st
+            args = (
+                state.idle,
+                state.releasing,
+                state.task_count,
+                state.allocatable,
+                state.pods_limit,
+                jnp.asarray(node_gate),
+                state.mins,
+                jnp.asarray(pad_rows(scale_columns(st.tasks.init_resreq, scale), tb)),
+                jnp.asarray(pad_rows(scale_columns(st.tasks.resreq, scale), tb)),
+                static_mask_dev,
+                static_score_dev,
+                jnp.asarray(offsets),
+                jnp.asarray(nums),
+                jnp.asarray(deficits),
+                jnp.asarray(gang_order),
+                jnp.asarray(priorities),
+                jnp.asarray(tiebreak),
+                jnp.asarray(queues_idx),
+                jnp.asarray(scale_columns(alloc_init, scale)),
+                jnp.asarray(queue_rank),
+                jnp.asarray(queue_has),
+                jnp.asarray(queue_deserved),
+                jnp.asarray(queue_alloc),
+                jnp.asarray(scale_columns(total[None, :], scale)[0]),
+                run_dev,
+            )
+            if self._mesh is not None:
+                from scheduler_tpu.ops.mesh import shard_fused_args
+
+                args = shard_fused_args(self._mesh, args)
+            self._args = args
+            self._args_parts = None  # one-shot: free the host-side copies
+        return self._args
 
     def _codes(self) -> np.ndarray:
         """Placement codes, executing the device program at most once: it is

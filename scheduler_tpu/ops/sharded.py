@@ -38,6 +38,23 @@ from scheduler_tpu.ops.scoring import dynamic_score
 NODE_AXIS = "nodes"
 
 
+def two_level_winner(lscore, global_idx, extra=(), axis=NODE_AXIS):
+    """The two-level argmax reduction shared by every sharded selection:
+    pack one (score, global index, *extra) candidate per chip, all_gather
+    the tiny tuples over ICI, reduce replicated.  The global index rides as
+    float32 (exact below 2^24 nodes); ``jnp.argmax`` takes the FIRST max, so
+    ties break to the lowest shard — combined with each shard's lowest-local-
+    row argmax that is the lowest global index, bit-matching the single-chip
+    kernel's deterministic argmax.  Returns the winner's packed row."""
+    cand = jnp.stack([
+        lscore,
+        global_idx.astype(jnp.float32),
+        *extra,
+    ])
+    all_cand = jax.lax.all_gather(cand, axis)
+    return all_cand[jnp.argmax(all_cand[:, 0])]
+
+
 def node_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for [N, ...] node-major tensors: rows split over the mesh."""
     return NamedSharding(mesh, P(NODE_AXIS))
@@ -104,19 +121,15 @@ def sharded_place_scan(
             # shard, and the local argmax ties to the lowest local row —
             # together, lowest global index, matching the single-chip kernel's
             # deterministic SelectBestNode.
-            cand = jnp.stack([
-                lscore,
-                (lbest + offset).astype(jnp.float32),
-                fit_idle[lbest].astype(jnp.float32),
-                fit_rel[lbest].astype(jnp.float32),
-            ])
-            all_cand = jax.lax.all_gather(cand, NODE_AXIS)  # [D, 4]
-
-            winner = jnp.argmax(all_cand[:, 0])
-            any_feasible = all_cand[winner, 0] > neg_inf
-            g_best = all_cand[winner, 1].astype(jnp.int32)
-            fit_i_best = all_cand[winner, 2] > 0
-            fit_r_best = all_cand[winner, 3] > 0
+            win = two_level_winner(
+                lscore, lbest + offset,
+                extra=(fit_idle[lbest].astype(jnp.float32),
+                       fit_rel[lbest].astype(jnp.float32)),
+            )
+            any_feasible = win[0] > neg_inf
+            g_best = win[1].astype(jnp.int32)
+            fit_i_best = win[2] > 0
+            fit_r_best = win[3] > 0
 
             active = (~stopped) & is_valid
             placed = active & any_feasible

@@ -188,3 +188,42 @@ def test_production_mesh_flag_matches_single_chip(monkeypatch):
 
     assert single == sharded
     assert len(single) > 0
+
+
+def test_sharded_step_kernel_engages_and_matches(monkeypatch):
+    """The FAST engine shards (VERDICT r3 #6): under a mesh the fused
+    selection runs the pallas step kernel PER SHARD inside shard_map with an
+    explicit candidate all-gather — the gate must engage, and codes must
+    equal the single-chip run's."""
+    import scheduler_tpu.actions  # noqa: F401
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.actions.allocate import collect_candidates
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.framework import open_session
+    from scheduler_tpu.ops import mesh as mesh_mod
+    from scheduler_tpu.ops.fused import FusedAllocator
+    from tests.test_fused import CONF, build_cluster
+
+    make_mesh()  # skip when <8 devices
+
+    def engine_for(mesh_on):
+        if mesh_on:
+            monkeypatch.setenv("SCHEDULER_TPU_MESH", "8")
+        else:
+            monkeypatch.delenv("SCHEDULER_TPU_MESH", raising=False)
+        mesh_mod._cached_key = object()  # bust the mesh memo
+        cache = build_cluster(seed=3, n_nodes=16, n_jobs=8)
+        ssn = open_session(cache, parse_scheduler_conf(CONF).tiers)
+        return FusedAllocator(ssn, collect_candidates(ssn))
+
+    sharded = engine_for(True)
+    assert sharded._mesh is not None
+    assert sharded.step_kernel, "sharded step kernel must engage under the mesh"
+    assert not sharded.use_mega  # whole-loop kernel stays single-chip
+    got = np.asarray(sharded._execute())
+
+    single = engine_for(False)
+    single.use_mega = False  # compare the same program shape
+    want = np.asarray(single._execute())
+    assert np.array_equal(got, want)
+    assert int((got >= 0).sum()) > 0

@@ -37,11 +37,10 @@ live eviction decrements.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional
 
 from scheduler_tpu.api.job_info import TaskInfo
 from scheduler_tpu.api.node_info import NodeInfo
-from scheduler_tpu.api.types import TaskStatus
 from scheduler_tpu.utils.scheduler_helper import (
     get_node_list,
     predicate_nodes,

@@ -179,8 +179,6 @@ def test_mega_kernel_engages_with_releasing_and_matches_xla():
     churn state) takes the mega-kernel — the pipelined arm rides a second
     VMEM ledger — and its codes (including the -3-node pipe encoding) equal
     the XLA while-loop program's bit-for-bit."""
-    from scheduler_tpu.api.types import TaskStatus
-
     cache = SchedulerCache(vocab=make_vocab(), async_io=False)
     cache.run()
     cache.add_queue(build_queue("default"))
@@ -219,8 +217,6 @@ def test_mega_score_bound_cuts_batches_like_xla():
     engages WITH the top-2 score bound, the cut point must match the XLA
     path's bit-for-bit (round-4 review finding: the bound was previously
     only exercised where run_len == 1)."""
-    import random as _random
-
     cache = SchedulerCache(vocab=make_vocab(), async_io=False)
     cache.run()
     cache.add_queue(build_queue("default"))

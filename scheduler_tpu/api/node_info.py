@@ -401,6 +401,15 @@ class NodeInfo:
             pending[uid] = entry
         self.task_count += len(entries)
 
+    def append_batch_records(self, batches) -> None:
+        """Record-only half of ``add_deferred_batches``: the caller already
+        applied the ledger arithmetic wholesale (NodeLedger.apply_node_deltas
+        covers idle/releasing/used AND task_count)."""
+        append = self._batches.append
+        for cores, status in batches:
+            if len(cores):
+                append(_Batch(cores, status))
+
     def add_deferred_batches(self, batches, agg) -> None:
         """Columnar batch add (trusted engine commit): no clones, no per-uid
         inserts — whole ``(cores, status)`` batch records are appended and

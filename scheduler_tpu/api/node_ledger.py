@@ -205,6 +205,45 @@ class NodeLedger:
     def total_used(self) -> np.ndarray:
         return self.used[: self.n].sum(axis=0)
 
+    def apply_node_deltas(
+        self,
+        rows: np.ndarray,        # i64 [K] ledger rows (unique)
+        idle_sub: np.ndarray,    # f64 [K, R]
+        rel_sub: np.ndarray,     # f64 [K, R]
+        used_add: np.ndarray,    # f64 [K, R]
+        count_add: np.ndarray,   # i64 [K] task-count increments
+        mins: np.ndarray,        # [R] epsilon thresholds
+    ) -> None:
+        """The bulk commit's node arithmetic as THREE fancy-index ops —
+        exactly ``NodeInfo.add_deferred_batches``'s agg accounting
+        (idle -= alloc rows, releasing -= pipelined rows, used += both,
+        task_count += placements) folded over every touched node at once.
+        The epsilon-tolerant sufficiency check ALWAYS evaluates, like the
+        per-node ``sub_array`` it replaces — ``assert_that`` decides
+        log-vs-raise (PANIC_ON_ERROR)."""
+        from scheduler_tpu.utils.assertions import assert_that
+
+        r = idle_sub.shape[1]
+        m = mins[:r][None, :]
+        cur_i = self.idle[rows][:, :r]
+        cur_r = self.releasing[rows][:, :r]
+        assert_that(
+            bool(
+                np.all((idle_sub < cur_i) | (np.abs(cur_i - idle_sub) < m))
+                and np.all((rel_sub < cur_r) | (np.abs(cur_r - rel_sub) < m))
+            ),
+            "resource is not sufficient for bulk node delta",
+        )
+        self.idle[rows, :r] -= idle_sub
+        self.releasing[rows, :r] -= rel_sub
+        self.used[rows, :r] += used_add
+        self.task_count[rows] += count_add
+        if used_add.shape[1] > 2:
+            touched = np.any(used_add[:, 2:] != 0.0, axis=1)
+            if touched.any():
+                flags = self.scalar_flags["used"]
+                flags[rows[touched]] = True
+
     def any_alloc_scalars(self) -> bool:
         """OR of allocatable map-presence flags — what the object path's
         per-node ``add(node.allocatable)`` would leave in has_scalars."""
@@ -257,6 +296,11 @@ class LedgerNodeMap(Mapping):
         self._sources = sources
         self._captures = captures
         self._views: Dict[str, object] = {}
+        # Deferred columnar batch RECORDS for nodes nobody materialized: the
+        # vectorized bulk commit applies the ledger arithmetic wholesale and
+        # stashes each node's (cores, status) records here; a later
+        # materialization folds them into the view's lazy task map.
+        self._stashed_batches: Dict[str, list] = {}
 
     def __getitem__(self, name: str):
         view = self._views.get(name)
@@ -265,8 +309,28 @@ class LedgerNodeMap(Mapping):
 
             src = self._sources[name]
             view = NodeInfo.view_for_snapshot(src, self.ledger, self._captures[name])
+            stashed = self._stashed_batches.pop(name, None)
+            if stashed:
+                view.append_batch_records(stashed)
             self._views[name] = view
         return view
+
+    def node_spec(self, name: str):
+        """The captured node spec WITHOUT materializing a view (the object
+        path's ``node is not None`` accounting guard needs it)."""
+        view = self._views.get(name)
+        if view is not None:
+            return view.node
+        return self._captures[name][5]
+
+    def stash_batch_records(self, name: str, batches) -> None:
+        """Record (cores, status) batches WITHOUT materializing the node —
+        ledger arithmetic must already be applied (apply_node_deltas)."""
+        view = self._views.get(name)
+        if view is not None:
+            view.append_batch_records(batches)
+        else:
+            self._stashed_batches.setdefault(name, []).extend(batches)
 
     def __contains__(self, name) -> bool:
         return name in self._sources

@@ -661,15 +661,43 @@ class SchedulerCache(Cache):
                 cores_sorted = cores_all[order]
                 uniq, starts = np.unique(ids_all[order], return_index=True)
                 bounds = starts.tolist() + [order.shape[0]]
+                groups = []
                 for g in range(uniq.shape[0]):
                     hostname = names_all[order[starts[g]]]
-                    row, count = node_rows[hostname]
-                    # Bind batches are allocated-status only: idle -= row,
-                    # used += row, releasing untouched.
-                    self.nodes[hostname].add_deferred_batches(
-                        [(cores_sorted[bounds[g] : bounds[g + 1]], TaskStatus.BINDING)],
-                        (row, None, row, count, 0),
+                    groups.append(
+                        (hostname, cores_sorted[bounds[g] : bounds[g + 1]])
                     )
+                # Bind batches are allocated-status only: idle -= row,
+                # used += row, releasing untouched — applied as ONE ledger
+                # scatter over every touched node (records append per node;
+                # placeholder nodes, whose accounting the object path skips,
+                # take the per-node path).
+                led = self.node_ledger
+                if all(
+                    self.nodes[nm].node is not None and nm in led.row_of
+                    for nm, _ in groups
+                ):
+                    delta = np.stack([node_rows[nm][0] for nm, _ in groups])
+                    zeros = np.zeros_like(delta)
+                    counts = np.asarray(
+                        [node_rows[nm][1] for nm, _ in groups], dtype=np.int64
+                    )
+                    led.apply_node_deltas(
+                        np.asarray([led.row_of[nm] for nm, _ in groups], dtype=np.int64),
+                        delta, zeros, delta, counts,
+                        mins=self.vocab.min_thresholds(),
+                    )
+                    for nm, members in groups:
+                        self.nodes[nm].append_batch_records(
+                            [(members, TaskStatus.BINDING)]
+                        )
+                else:
+                    for nm, members in groups:
+                        row, count = node_rows[nm]
+                        self.nodes[nm].add_deferred_batches(
+                            [(members, TaskStatus.BINDING)],
+                            (row, None, row, count, 0),
+                        )
 
         # Chunk against the WHOLE batch: with many jobs there is already
         # ample parallelism, and per-job sizing degenerates to floor-size

@@ -720,11 +720,40 @@ class Session:
 
         node_deltas = plan.node_deltas()
         nodes = self.nodes
-        for node_name, batches in node_batches.items():
-            node = nodes.get(node_name)
-            if node is None:
-                raise KeyError(f"failed to find node {node_name}")
-            node.add_deferred_batches(batches, node_deltas[node_name])
+        ledger = getattr(nodes, "ledger", None)
+        vectorized = False
+        if ledger is not None and node_batches:
+            # Vectorized node commit: ONE ledger scatter for every touched
+            # node's arithmetic, batch RECORDS stashed without materializing
+            # views.  Mirrors add_deferred_batches exactly; placeholder
+            # nodes (no spec: accounting skipped on the object path) fall
+            # back wholesale.
+            names = list(node_batches)
+            rows = [ledger.row_of.get(nm) for nm in names]
+            if all(r is not None for r in rows) and all(
+                nodes.node_spec(nm) is not None for nm in names
+            ):
+                idle_sub = np.stack([node_deltas[nm][0] for nm in names])
+                rel_sub = np.stack([node_deltas[nm][1] for nm in names])
+                used_add = np.stack([node_deltas[nm][2] for nm in names])
+                counts = np.asarray(
+                    [node_deltas[nm][3] + node_deltas[nm][4] for nm in names],
+                    dtype=np.int64,
+                )
+                ledger.apply_node_deltas(
+                    np.asarray(rows, dtype=np.int64),
+                    idle_sub, rel_sub, used_add, counts,
+                    mins=self.cache.vocab.min_thresholds(),
+                )
+                for node_name, batches in node_batches.items():
+                    nodes.stash_batch_records(node_name, batches)
+                vectorized = True
+        if not vectorized:
+            for node_name, batches in node_batches.items():
+                node = nodes.get(node_name)
+                if node is None:
+                    raise KeyError(f"failed to find node {node_name}")
+                node.add_deferred_batches(batches, node_deltas[node_name])
 
         self._fire_allocate_bulk_columnar(items, plan)
 

@@ -13,12 +13,15 @@ from __future__ import annotations
 import logging
 from typing import Dict, Optional
 
+import numpy as np
+
 from scheduler_tpu.api.job_info import TaskInfo
 from scheduler_tpu.api.queue_info import QueueInfo
-from scheduler_tpu.api.resource import ResourceVec, res_min, share as share_fn
+from scheduler_tpu.api.resource import ResourceVec, le_mask, res_min, share as share_fn
 from scheduler_tpu.api.types import TaskStatus
 from scheduler_tpu.framework.arguments import Arguments
 from scheduler_tpu.framework.interface import EventHandler, Plugin
+from scheduler_tpu.utils.assertions import assert_that
 
 logger = logging.getLogger("scheduler_tpu.plugins.proportion")
 
@@ -133,8 +136,6 @@ class ProportionPlugin(Plugin):
             not-overused — but such queues also hold no eligible jobs, so they
             are never selected.
             """
-            import numpy as np
-
             q = len(queue_uids)
             r = vocab.size
             deserved = np.zeros((q, r), dtype=np.float64)
@@ -149,10 +150,12 @@ class ProportionPlugin(Plugin):
 
         ssn.add_device_queue_fair(self.name(), device_queue_fair)
 
-        def reclaimable_fn(reclaimer: TaskInfo, reclaimees):
-            victims = None
+        def _reclaimable_seq(reclaimees, accept):
+            """The reference walk (proportion.go reclaimableFn): per victim,
+            skip when queue allocated is ``less`` than its request, subtract,
+            accept while deserved <= remaining.  Fills ``accept`` by index."""
             allocations: Dict[str, ResourceVec] = {}
-            for reclaimee in reclaimees:
+            for i, reclaimee in enumerate(reclaimees):
                 job = ssn.jobs[reclaimee.job]
                 attr = self.queue_attrs[job.queue]
                 if job.queue not in allocations:
@@ -165,10 +168,53 @@ class ProportionPlugin(Plugin):
                     )
                     continue
                 allocated.sub(reclaimee.resreq)
-                if attr.deserved.less_equal(allocated):
-                    victims = victims or []
-                    victims.append(reclaimee)
-            return victims
+                accept[i] = attr.deserved.less_equal(allocated)
+
+        def reclaimable_fn(reclaimer: TaskInfo, reclaimees):
+            if not reclaimees:
+                return None
+            accept = [False] * len(reclaimees)
+            # Columnar fast path: group by queue; with no scalar maps in
+            # play the ``allocated.less(resreq)`` skip branch is unreachable
+            # (both-maps-nil => less is False, resource.py docstring), so
+            # the cumulative remaining is a sequential difference chain —
+            # ONE ``np.add.accumulate`` reproduces the loop's exact
+            # (((a0 - r1) - r2) ...) float arithmetic, and the epsilon
+            # compare vectorizes.  Scalar-bearing groups take the walk.
+            by_queue: Dict[str, list] = {}
+            for i, t in enumerate(reclaimees):
+                by_queue.setdefault(ssn.jobs[t.job].queue, []).append(i)
+            mins = vocab.min_thresholds()[None, :]
+            for queue_uid, idxs in by_queue.items():
+                attr = self.queue_attrs[queue_uid]
+                group = [reclaimees[i] for i in idxs]
+                if attr.allocated.has_scalars or any(
+                    t.resreq.has_scalars for t in group
+                ):
+                    sub_accept = [False] * len(group)
+                    _reclaimable_seq(group, sub_accept)
+                    for i, ok in zip(idxs, sub_accept):
+                        accept[i] = ok
+                    continue
+                alloc0 = attr.allocated.array
+                reqs = np.stack([t.resreq.array for t in group])
+                chain = np.add.accumulate(
+                    np.concatenate([alloc0[None, :], -reqs]), axis=0
+                )[1:]
+                # The walk's per-step ``sub`` sufficiency assert, vectorized
+                # (pre-subtraction state = chain + own request).
+                pre = chain + reqs
+                assert_that(
+                    bool(np.all(le_mask(reqs, pre, mins))),
+                    "resource is not sufficient for reclaim walk",
+                )
+                d = attr.deserved.array[None, :]
+                ok = le_mask(np.broadcast_to(d, chain.shape), chain, mins)
+                for i, o in zip(idxs, ok.tolist()):
+                    accept[i] = bool(o)
+            if not any(accept):
+                return None
+            return [t for t, ok in zip(reclaimees, accept) if ok]
 
         ssn.add_reclaimable_fn(self.name(), reclaimable_fn)
 

@@ -123,10 +123,11 @@ class PreemptAction(Action):
                         assigned = True
 
                     if ssn.job_pipelined(preemptor_job):
-                        if gate is not None:
-                            # BEFORE commit: commit clears stmt.operations.
-                            gate.note_committed_statement(stmt)
-                        stmt.commit()
+                        # Gate counts drop per ACCEPTED evict (a failed evict
+                        # RPC restores the victim, which stays offerable).
+                        stmt.commit(
+                            on_evicted=None if gate is None else gate.note_evicted_task
+                        )
                         break
 
                 if not ssn.job_pipelined(preemptor_job):
@@ -162,9 +163,9 @@ class PreemptAction(Action):
                         else lambda node, j=job: gate.admits_own_job(node.name, j)
                     ),
                 )
-                if gate is not None:
-                    gate.note_committed_statement(stmt)  # before ops clear
-                stmt.commit()
+                stmt.commit(
+                    on_evicted=None if gate is None else gate.note_evicted_task
+                )
                 if not assigned:
                     break
 

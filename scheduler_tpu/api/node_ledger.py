@@ -223,6 +223,13 @@ class NodeLedger:
         log-vs-raise (PANIC_ON_ERROR)."""
         from scheduler_tpu.utils.assertions import assert_that
 
+        # The delta width is the CALLER's vocab size, which can outrun this
+        # ledger's R: the vocabulary is append-only and grows when a pod
+        # introduces a new scalar resource — no node event widens the cache
+        # ledger.  Widen here so a session-vocab-wide commit never hits a
+        # broadcast error mid-apply.
+        if idle_sub.shape[1] > self.r:
+            self.widen(idle_sub.shape[1])
         r = idle_sub.shape[1]
         m = mins[:r][None, :]
         cur_i = self.idle[rows][:, :r]

@@ -20,6 +20,7 @@ fixture tests pin real ``kubectl``-shaped documents end to end.
 from __future__ import annotations
 
 import calendar
+import datetime
 import re
 import time
 from typing import Dict, List, Optional
@@ -74,11 +75,32 @@ def _requests_to_canonical(requests: Dict) -> Dict[str, float]:
 
 
 def _parse_k8s_time(ts) -> Optional[float]:
+    """Tolerant RFC3339: k8s JSON carries metav1.Time (whole seconds, 'Z')
+    but metav1.MicroTime and third-party producers emit fractional seconds
+    and numeric UTC offsets.  An unparseable timestamp is treated as absent
+    rather than raised — one bad doc must not wedge ingestion (the resync
+    path would refetch the same doc and fail forever)."""
     if ts is None:
         return None
     if isinstance(ts, (int, float)):
         return float(ts)
-    return float(calendar.timegm(time.strptime(str(ts), "%Y-%m-%dT%H:%M:%SZ")))
+    s = str(ts)
+    try:
+        return float(calendar.timegm(time.strptime(s, "%Y-%m-%dT%H:%M:%SZ")))
+    except ValueError:
+        pass
+    try:
+        if s.endswith(("Z", "z")):
+            s = s[:-1] + "+00:00"
+        dt = datetime.datetime.fromisoformat(s)
+        if dt.tzinfo is None:
+            # k8s timestamps are UTC; a naive .timestamp() would apply the
+            # HOST zone (silently skewed epochs) and can raise OSError via
+            # mktime for out-of-range dates.
+            dt = dt.replace(tzinfo=datetime.timezone.utc)
+        return dt.timestamp()
+    except (ValueError, OverflowError, OSError):
+        return None
 
 
 def _is_k8s(obj: Dict) -> bool:

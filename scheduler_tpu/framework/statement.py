@@ -76,8 +76,20 @@ class Statement:
 
     # -- outcome ---------------------------------------------------------------
 
-    def commit(self) -> None:
-        """Replay recorded evictions against the cache (pipelines stay session-only)."""
+    def commit(self, on_evicted=None) -> None:
+        """Replay recorded evictions against the cache (pipelines stay session-only).
+
+        ``on_evicted(task)`` fires only for evictions whose SESSION state
+        sticks.  Under sync dispatch (``async_io=False``) a failed evict RPC
+        raises here and ``_unevict`` restores the session victim — it remains
+        offerable, so success-keyed bookkeeping (the VictimGate's live
+        counts) must not see it.  Under async dispatch ``cache.evict``
+        returning means "accepted for dispatch": a later RPC failure is
+        repaired on the CACHE's objects by its resync path (fire-and-forget,
+        like the reference's eviction goroutines) and never touches the
+        session's snapshot-isolated clone — the session victim stays
+        RELEASING and is un-offerable either way, so firing at commit is
+        correct for everything scoped to this session."""
         for name, args in self.operations:
             if name == "evict":
                 reclaimee, reason = args
@@ -86,6 +98,9 @@ class Statement:
                 except Exception:
                     logger.exception("cache evict failed for %s; restoring", reclaimee.uid)
                     self._unevict(reclaimee)
+                else:
+                    if on_evicted is not None:
+                        on_evicted(reclaimee)
         self.operations = []
 
     def discard(self) -> None:

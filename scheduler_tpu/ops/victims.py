@@ -271,7 +271,14 @@ class VictimGate:
         ok = counts > 0  # [N, Q]
         margins = self._current_margins()
         if margins is not None and self._min_req is not None:
-            ok = ok & np.all(self._min_req <= margins[None, :, :], axis=2)
+            # _min_req's R axis is frozen at gate build; margins re-probe the
+            # LIVE vocab each hunt, so a scalar registered mid-action makes
+            # the widths diverge.  Compare on the common prefix (vocab is
+            # append-only, so column k means the same resource in both).
+            r = min(self._min_req.shape[2], margins.shape[1])
+            ok = ok & np.all(
+                self._min_req[:, :, :r] <= margins[None, :, :r], axis=2
+            )
         qi = self._queue_idx.get(queue_uid, -1)
         if qi >= 0:
             ok = ok.copy()
@@ -299,15 +306,14 @@ class VictimGate:
         if own is not None and row < own.shape[0] and own[row] > 0:
             own[row] -= 1
 
-    def note_committed_statement(self, stmt) -> None:
-        """Fold a COMMITTED statement's evictions into the live counts
-        (preempt runs under rollback, so decrements must wait for commit)."""
-        for op, args in getattr(stmt, "operations", ()):
-            if op == "evict":
-                reclaimee = args[0]
-                job = self.ssn.jobs.get(reclaimee.job)
-                if job is not None and reclaimee.node_name:
-                    self.note_eviction(reclaimee.node_name, job)
+    def note_evicted_task(self, task) -> None:
+        """Statement.commit's ``on_evicted`` hook: fold ONE cache-accepted
+        eviction into the live counts.  Wired per-success (not per recorded
+        op) because a failed evict RPC restores the victim — it can still be
+        offered, so its count must survive."""
+        job = self.ssn.jobs.get(task.job)
+        if job is not None and task.node_name:
+            self.note_eviction(task.node_name, job)
 
     def mask_admits(self, mask: np.ndarray, node_name: str) -> bool:
         row = self._row_of.get(node_name)

@@ -343,6 +343,41 @@ class TestSessionMutations:
         stmt.commit()
         assert cache2.evictor.wait(1) == ["default/victim"]
 
+    def test_commit_on_evicted_fires_only_for_accepted_evicts(self):
+        """A failed cache evict restores the victim (it stays offerable), so
+        success-keyed bookkeeping — the VictimGate's live counts — must not
+        see it (round-4 advisor finding, preempt.py:128)."""
+        cache, _ = _make_cache()
+        cache.run()
+        cache.add_queue(build_queue("default"))
+        cache.add_node(build_node("n1", {"cpu": 4000, "memory": 1000}))
+        cache.add_pod_group(build_pod_group("pg", min_member=1))
+        for name in ("v1", "v2"):
+            cache.add_pod(build_pod(
+                name=name, req={"cpu": 1000, "memory": 100}, groupname="pg",
+                nodename="n1", phase="Running"))
+        ssn = open_session(cache, _tiers([]))
+        tasks = sorted(ssn.jobs["default/pg"].tasks.values(), key=lambda t: t.name)
+        v1, v2 = tasks
+
+        real_evict = cache.evict
+
+        def flaky_evict(task, reason):
+            if task.name == "v1":
+                raise RuntimeError("evict RPC failed")
+            return real_evict(task, reason)
+
+        cache.evict = flaky_evict
+        stmt = ssn.statement()
+        stmt.evict(v1, "preempt")
+        stmt.evict(v2, "preempt")
+        accepted = []
+        stmt.commit(on_evicted=lambda t: accepted.append(t.name))
+        assert accepted == ["v2"]
+        # the failed evict rolled back: v1 is Running again, still offerable
+        assert v1.status == TaskStatus.RUNNING
+        assert v2.status == TaskStatus.RELEASING
+
 
 class TestJobUpdaterDedup:
     """is_pod_group_status_updated (job_updater.go:55-100): condition churn

@@ -204,6 +204,35 @@ class TestNodeLedger:
         assert idle.get("cpu") == 9000
         assert idle.milli_cpu == 9000
 
+    def test_apply_node_deltas_widens_for_wider_delta(self):
+        """A pod can register a NEW scalar resource mid-stream (vocab is
+        append-only, no node event) — the next bulk bind commit then carries
+        session-vocab-wide deltas against a narrower cache ledger.  The
+        apply must widen, not raise a broadcast error mid-commit
+        (round-4 advisor finding, cache.py:685)."""
+        import numpy as np
+
+        from scheduler_tpu.cache.cache import SchedulerCache
+
+        vocab = make_vocab()
+        cache = SchedulerCache(vocab=vocab, async_io=False)
+        cache.run()
+        cache.add_node(build_node("n0", {"cpu": 4000, "memory": 1000}))
+        led = cache.node_ledger
+        r_wide = led.r + 2  # two scalars registered after the node arrived
+        rows = np.asarray([led.row_of["n0"]], dtype=np.int64)
+        delta = np.zeros((1, r_wide))
+        delta[0, 0] = 1000.0
+        zeros = np.zeros_like(delta)
+        mins = np.full(r_wide, 0.1)
+        led.apply_node_deltas(
+            rows, delta, zeros, delta, np.asarray([1], dtype=np.int64), mins=mins
+        )
+        assert led.r == r_wide
+        assert led.idle[rows[0], 0] == 3000.0
+        assert led.used[rows[0], 0] == 1000.0
+        assert led.task_count[rows[0]] == 1
+
     def test_ledger_total_allocatable_keeps_scalar_presence(self):
         """A zero-valued scalar in a node's allocatable ('gpu: 0' on a drained
         node) must leave has_scalars True in the ledger fast-path totals, like

@@ -279,6 +279,22 @@ class TestK8sWireShapes:
         with pytest.raises(ValueError):
             parse_quantity("1Zi")
 
+    def test_parse_k8s_time_tolerant(self):
+        """metav1.MicroTime fractional seconds and numeric UTC offsets are
+        valid k8s JSON; a strict-only parser wedges ingestion on the first
+        such doc (round-4 advisor finding, wire.py:76)."""
+        from scheduler_tpu.connector.wire import _parse_k8s_time
+
+        base = _parse_k8s_time("2024-05-01T12:00:00Z")
+        assert base is not None
+        assert _parse_k8s_time("2024-05-01T12:00:00.123456Z") == pytest.approx(
+            base + 0.123456
+        )
+        assert _parse_k8s_time("2024-05-01T14:00:00+02:00") == base
+        assert _parse_k8s_time("not-a-time") is None
+        assert _parse_k8s_time(None) is None
+        assert _parse_k8s_time(1714564800) == 1714564800.0
+
     def test_parse_k8s_pod_with_init_containers(self):
         from scheduler_tpu.connector.wire import parse_pod
 

@@ -10,6 +10,24 @@ Prints ONE JSON line: pods scheduled per second of session-cycle wall time,
 with vs_baseline = value / 100_000 (the north-star target of one 100k-pod
 cycle per second, BASELINE.md).
 
+The artifact is SELF-DIAGNOSING (round-4 lesson: a degraded tunnel window
+once recorded 26k pods/s for a 138k scheduler, and the JSON carried nothing
+that could tell "bad link" from "regression"):
+
+* every measured cycle carries its host/device phase split
+  (open/engine_init/device/decode/apply/close) and its device-transfer
+  accounting (steady cycles upload ~nothing — ops/transfer_cache.py);
+* a link probe (tiny-transfer RTT + fixed 400KB readback) runs before and
+  after every cycle, so each cycle's surrounding link regime is on record;
+* outlier policy (emitted in the artifact under "policy"): a cycle is
+  link-degraded when an adjacent probe shows RTT or 400KB readback above
+  max(2.5x the session's best probe, an absolute floor of 0.35s/0.45s).
+  If >=3 cycles are healthy, the reported value is the median over healthy
+  cycles (regime "healthy"); when degradation ate the majority, up to 3
+  extra cycles are sampled, and if still <3 healthy the value is the median
+  over ALL cycles with regime "degraded" — the per-cycle device phases and
+  probes then prove where the time went.
+
 A warmup cycle at the same node-bucket / task-bucket shapes runs first so jit
 compilation (cached across calls) is excluded from the measured cycle, matching
 how the steady-state scheduler loop runs (compile once, re-run every period).
@@ -22,13 +40,24 @@ import os
 import sys
 import time
 
+RTT_FLOOR_S = 0.35
+READBACK_FLOOR_S = 0.45
+DEGRADED_FACTOR = 2.5
 
-def one_cycle(n_nodes: int, n_pods: int, tasks_per_job: int) -> tuple[int, float]:
+POLICY = (
+    "cycle link-degraded iff an adjacent probe has rtt_s > max(2.5*best_rtt, "
+    "0.35) or readback_400k_s > max(2.5*best_readback, 0.45); value = median "
+    "over healthy cycles when >=3 are healthy, else median over all cycles "
+    "with regime=degraded; up to 3 extra cycles sampled when <3 healthy"
+)
+
+
+def one_cycle(n_nodes: int, n_pods: int, tasks_per_job: int) -> tuple[int, float, dict]:
     import scheduler_tpu.actions  # noqa: F401  registry side effects
     import scheduler_tpu.plugins  # noqa: F401
     from scheduler_tpu.conf import parse_scheduler_conf
     from scheduler_tpu.harness import make_synthetic_cluster
-    from scheduler_tpu.harness.measure import steady_cycle
+    from scheduler_tpu.harness.measure import steady_cycle_phases
 
     conf = parse_scheduler_conf(
         """
@@ -42,9 +71,29 @@ tiers:
 """
     )
     cluster = make_synthetic_cluster(n_nodes, n_pods, tasks_per_job=tasks_per_job)
-    elapsed = steady_cycle(cluster.cache, conf, ("allocate",))
+    elapsed, phases = steady_cycle_phases(cluster.cache, conf, ("allocate",))
     binds = len(cluster.cache.binder.binds)
-    return binds, elapsed
+    return binds, elapsed, phases
+
+
+def _probe() -> dict:
+    from scheduler_tpu.harness.measure import link_probe
+
+    return link_probe()
+
+
+def _classify(runs: list, probes: list[dict]) -> list[bool]:
+    """Per-cycle link-degraded flags — the ONE implementation of the policy
+    string above; both the extension loop and the final selection use it."""
+    best_rtt = min(p["rtt_s"] for p in probes)
+    best_rb = min(p["readback_400k_s"] for p in probes)
+    rtt_cut = max(DEGRADED_FACTOR * best_rtt, RTT_FLOOR_S)
+    rb_cut = max(DEGRADED_FACTOR * best_rb, READBACK_FLOOR_S)
+
+    def bad(p: dict) -> bool:
+        return p["rtt_s"] > rtt_cut or p["readback_400k_s"] > rb_cut
+
+    return [bad(probes[i]) or bad(probes[i + 1]) for i in range(len(runs))]
 
 
 def main() -> None:
@@ -60,18 +109,33 @@ def main() -> None:
     # the measured cycle; warm with the exact same problem instead.
     one_cycle(n_nodes, n_pods, tasks_per_job)
 
-    # Median of five measured cycles: the tunneled-TPU round trips have
-    # multi-hundred-ms jitter with occasional multi-second outliers, and the
-    # metric is the STEADY-state cycle rate — a 5-sample median stays honest
-    # while shrugging off up to two bad network draws.
-    runs = [one_cycle(n_nodes, n_pods, tasks_per_job) for _ in range(1 if smoke else 5)]
-    if any(b != runs[0][0] for b, _ in runs) or runs[0][0] == 0:
+    # Probe -> cycle -> probe -> cycle ... -> probe: every cycle is bracketed
+    # by link probes.  5 base cycles; up to 3 more if the link ate >=3.
+    base = 1 if smoke else 5
+    max_cycles = base if smoke else base + 3
+    probes = [_probe()]
+    runs: list[tuple[int, float, dict]] = []
+    while len(runs) < base or (
+        not smoke
+        and len(runs) < max_cycles
+        and sum(not bad for bad in _classify(runs, probes)) < 3
+    ):
+        runs.append(one_cycle(n_nodes, n_pods, tasks_per_job))
+        probes.append(_probe())
+
+    if any(b != runs[0][0] for b, _, _ in runs) or runs[0][0] == 0:
         print(json.dumps({"metric": "pods_per_sec", "value": 0.0, "unit": "pods/s",
                           "vs_baseline": 0.0,
-                          "error": f"unstable binds: {[b for b, _ in runs]}"}))
+                          "error": f"unstable binds: {[b for b, _, _ in runs]}"}))
         sys.exit(1)
-    # (binds, elapsed) from the same median-elapsed run.
-    binds, elapsed = sorted(runs, key=lambda r: r[1])[len(runs) // 2]
+
+    flags = _classify(runs, probes)
+    healthy = [r for r, bad in zip(runs, flags) if not bad]
+    if len(healthy) >= 3 or (smoke and healthy):
+        pool, regime = healthy, "healthy"
+    else:
+        pool, regime = runs, "degraded"
+    binds, elapsed, _ = sorted(pool, key=lambda r: r[1])[len(pool) // 2]
 
     pods_per_sec = binds / elapsed
     print(json.dumps({
@@ -84,7 +148,20 @@ def main() -> None:
             "pods": n_pods,
             "binds": binds,
             "cycle_seconds": round(elapsed, 3),
-            "cycles_seconds_all": [round(el, 3) for _, el in runs],
+            "regime": regime,
+            "policy": POLICY,
+            "cycles": [
+                {
+                    "s": round(el, 3),
+                    "link_degraded": bad,
+                    "phases": {k: round(v, 3) for k, v in ph.items()
+                               if k not in ("uploads", "upload_bytes", "upload_hits")},
+                    "uploads": ph.get("uploads", -1),
+                    "upload_bytes": ph.get("upload_bytes", -1),
+                }
+                for (_, el, ph), bad in zip(runs, flags)
+            ],
+            "probes": probes,
             "backend": _backend(),
         },
     }))

@@ -316,16 +316,22 @@ class AllocateAction(Action):
 
     def _run_fused(self, ssn, candidates: List[JobInfo]) -> None:
         from scheduler_tpu.ops.fused import FusedAllocator
+        from scheduler_tpu.utils import phases
 
-        engine = FusedAllocator(ssn, candidates)
+        with phases.phase("engine_init"):
+            engine = FusedAllocator(ssn, candidates)
         if os.environ.get("SCHEDULER_TPU_BULK", "1") in ("0", "false"):
             # Per-row commit requested: object decode + per-task session ops.
             results = engine.run()
             apply_fused_results(ssn, candidates, results, plan_fn=None)
             return
-        items, node_batches, failures = engine.run_columnar()
-        record_fused_failures(failures)
-        ssn.bulk_apply_columnar(items, node_batches, engine.commit_plan())
+        with phases.phase("device"):
+            engine._execute()  # dispatch + kernel + blocking readback
+        with phases.phase("decode"):
+            items, node_batches, failures = engine.run_columnar()  # reuses codes
+        with phases.phase("apply"):
+            record_fused_failures(failures)
+            ssn.bulk_apply_columnar(items, node_batches, engine.commit_plan())
 
     # -- device engine -------------------------------------------------------
 

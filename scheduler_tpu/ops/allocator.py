@@ -137,20 +137,25 @@ def build_static_tensors_device(ssn, st: SnapshotTensors, n_bucket: int, t_bucke
 
 def node_state_from_tensors(st: SnapshotTensors, policy: DevicePolicy, n_bucket: int) -> NodeState:
     """Padded, unit-scaled device NodeState from host snapshot tensors."""
+    from scheduler_tpu.ops.transfer_cache import to_device
+
     r = policy.vocab.size
     scale = policy.column_scale(r)
 
+    # Content-addressed uploads: in the steady cycle most node state did not
+    # churn since the last period, and re-uploading it over the tunneled
+    # transport pays a round trip PER ARRAY (transfer_cache.py).
     def prep(mat: np.ndarray) -> jnp.ndarray:
-        return jnp.asarray(pad_rows(scale_columns(mat, scale), n_bucket))
+        return to_device(pad_rows(scale_columns(mat, scale), n_bucket), np.float32)
 
     return NodeState(
         idle=prep(st.nodes.idle),
         releasing=prep(st.nodes.releasing),
-        task_count=jnp.asarray(pad_rows(st.nodes.task_count.astype(np.int32), n_bucket)),
+        task_count=to_device(pad_rows(st.nodes.task_count.astype(np.int32), n_bucket)),
         allocatable=prep(st.nodes.allocatable),
         # pad nodes get pods_limit 0 -> never feasible under the pod-count gate
-        pods_limit=jnp.asarray(pad_rows(st.nodes.pods_limit.astype(np.int32), n_bucket)),
-        mins=jnp.asarray(policy.scaled_mins(r).astype(np.float32)),
+        pods_limit=to_device(pad_rows(st.nodes.pods_limit.astype(np.int32), n_bucket)),
+        mins=to_device(policy.scaled_mins(r), np.float32),
     )
 
 

@@ -79,6 +79,12 @@ _PIPE_BASE = -3
 # two encodings can never be conflated.
 HALT = -100
 
+
+@jax.jit
+def _narrow16(v):
+    """int32 codes -> int16 for the wire (see FusedAllocator._readback)."""
+    return v.astype(jnp.int16)
+
 # Upper bound on placements per micro-step in the run-batched fast path.  Runs
 # longer than this just take multiple steps; keep it a power of two.
 MAX_BATCH = 128
@@ -975,6 +981,7 @@ class FusedAllocator:
         n = st.nodes.count
         nb = bucket(max(n, 1))
         tb = bucket(max(t_total, 1))
+        self.n_bucket = nb
 
         node_gate = pad_rows(st.nodes.ready, nb, fill=False)
 
@@ -1306,28 +1313,30 @@ class FusedAllocator:
             else jnp.zeros((8, nb), jnp.float32)
         )
 
+        from scheduler_tpu.ops.transfer_cache import to_device
+
         self._mega_args = (
             ns0,
             alloc_t,
             rel_t,
-            jnp.asarray(node_gate)[None, :],
+            to_device(node_gate)[None, :],
             state.pods_limit.astype(jnp.float32)[None, :],
-            jnp.asarray(sig_req),
-            jnp.asarray(task_sig),
+            to_device(sig_req),
+            to_device(task_sig),
             run_dev.astype(jnp.int32).reshape(1, tb),
-            jnp.asarray(job_off),
-            jnp.asarray(job_num),
-            jnp.asarray(job_def),
-            jnp.asarray(job_gang),
-            jnp.asarray(job_prio),
-            jnp.asarray(job_tb),
-            jnp.asarray(js_drf0),
-            jnp.asarray(drf_safe),
-            jnp.asarray(drf_mask),
-            jnp.asarray(msig),
+            to_device(job_off),
+            to_device(job_num),
+            to_device(job_def),
+            to_device(job_gang),
+            to_device(job_prio),
+            to_device(job_tb),
+            to_device(js_drf0),
+            to_device(drf_safe),
+            to_device(drf_mask),
+            to_device(msig),
             smask,
             sscore,
-            jnp.asarray(misc),
+            to_device(misc),
         )
         mins_f32 = np.asarray(policy.scaled_mins(r), dtype=np.float32)
         self._mega_kw = dict(
@@ -1430,6 +1439,8 @@ class FusedAllocator:
              priorities, tiebreak, queues_idx, alloc_init, queue_rank,
              queue_has, queue_deserved, queue_alloc, total, run_dev,
              static_mask_dev, static_score_dev) = self._args_parts
+            from scheduler_tpu.ops.transfer_cache import to_device
+
             st = self.st
             args = (
                 state.idle,
@@ -1437,25 +1448,25 @@ class FusedAllocator:
                 state.task_count,
                 state.allocatable,
                 state.pods_limit,
-                jnp.asarray(node_gate),
+                to_device(node_gate),
                 state.mins,
-                jnp.asarray(pad_rows(scale_columns(st.tasks.init_resreq, scale), tb)),
-                jnp.asarray(pad_rows(scale_columns(st.tasks.resreq, scale), tb)),
+                to_device(pad_rows(scale_columns(st.tasks.init_resreq, scale), tb), np.float32),
+                to_device(pad_rows(scale_columns(st.tasks.resreq, scale), tb), np.float32),
                 static_mask_dev,
                 static_score_dev,
-                jnp.asarray(offsets),
-                jnp.asarray(nums),
-                jnp.asarray(deficits),
-                jnp.asarray(gang_order),
-                jnp.asarray(priorities),
-                jnp.asarray(tiebreak),
-                jnp.asarray(queues_idx),
-                jnp.asarray(scale_columns(alloc_init, scale)),
-                jnp.asarray(queue_rank),
-                jnp.asarray(queue_has),
-                jnp.asarray(queue_deserved),
-                jnp.asarray(queue_alloc),
-                jnp.asarray(scale_columns(total[None, :], scale)[0]),
+                to_device(offsets),
+                to_device(nums),
+                to_device(deficits),
+                to_device(gang_order),
+                to_device(priorities),
+                to_device(tiebreak),
+                to_device(queues_idx),
+                to_device(scale_columns(alloc_init, scale), np.float32),
+                to_device(queue_rank),
+                to_device(queue_has),
+                to_device(queue_deserved, np.float32),
+                to_device(queue_alloc, np.float32),
+                to_device(scale_columns(total[None, :], scale)[0], np.float32),
                 run_dev,
             )
             if self._mesh is not None:
@@ -1477,12 +1488,23 @@ class FusedAllocator:
             encoded = self._execute()
         return encoded
 
+    def _readback(self, dev) -> np.ndarray:
+        """Blocking device->host fetch of the placement codes, halving the
+        bytes on the wire when they fit int16 (codes span
+        [-3-(nb-1), nb-1] ∪ {-1, -2}).  The narrowing runs as an XLA op
+        AFTER the kernel — in-kernel int16 stores are catastrophically slow
+        on this backend — and costs ~nothing while the tunneled transfer is
+        the device phase's floor."""
+        if self.n_bucket <= 30000 and self._mesh is None:
+            return np.asarray(_narrow16(dev)).astype(np.int32)
+        return np.asarray(dev)
+
     def _execute(self) -> np.ndarray:
         if self.use_mega:
             from scheduler_tpu.ops import megakernel as _mk
 
             try:
-                encoded = np.asarray(
+                encoded = self._readback(
                     _mk.mega_allocate(*self._mega_args, **self._mega_kw)
                 )
             except Exception:  # pragma: no cover - backend-specific
@@ -1491,7 +1513,7 @@ class FusedAllocator:
             else:
                 self._encoded = encoded
                 return encoded
-        encoded = np.asarray(
+        encoded = self._readback(
             fused_allocate(
                 *self.args,
                 comparators=self.comparators,

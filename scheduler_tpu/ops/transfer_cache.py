@@ -1,0 +1,146 @@
+"""Content-addressed host->device transfer cache.
+
+The steady scheduling cycle re-derives the same device tensors every period:
+node matrices that didn't churn, per-task signature columns for an unchanged
+pending set, job layout vectors.  Re-uploading them costs little on a local
+PCIe link but multiplies under the tunneled-TPU transport, where EVERY
+transfer pays a round trip — a degraded window turns ~20 small uploads into
+seconds of latency (the round-4 bench artifact recorded exactly that).
+
+``to_device`` therefore keys each upload by ``(dtype, shape, digest(bytes))``
+and returns the already-resident device buffer on a hit.  Correctness is
+content-based, not lifecycle-based: a mutated host array simply produces a
+different digest and misses.  Device buffers are never donated by any engine
+program (no ``donate_argnums`` anywhere in ``ops/``), so residents stay valid.
+
+This is the device-side analogue of the reference's continuously-mirrored
+scheduler cache (``pkg/scheduler/cache/cache.go:342-361``): state persists
+BETWEEN cycles and only deltas move.  Here the persistence is the device
+buffer pool owned by the process, and the "delta" is whichever arrays
+actually changed content.
+
+The pool is bounded (``SCHEDULER_TPU_XFER_CACHE_MB``, default 256) with LRU
+eviction, and instrumented: ``stats()`` reports hits/misses/bytes so the
+bench artifact can prove whether a cycle's device phase included uploads.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+from typing import Tuple
+
+import numpy as np
+
+
+def _cap_bytes() -> int:
+    try:
+        mb = int(os.environ.get("SCHEDULER_TPU_XFER_CACHE_MB", "256"))
+    except ValueError:
+        mb = 256
+    return max(0, mb) * 1024 * 1024
+
+
+class TransferCache:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple, object]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.hit_bytes = 0
+        self.miss_bytes = 0
+
+    def to_device(self, arr: np.ndarray, dtype=None):
+        """Device array with ``arr``'s content (cast to ``dtype`` if given),
+        reusing a resident buffer when one with identical bytes exists."""
+        import jax
+
+        host = np.asarray(arr, dtype=dtype)
+        if not host.flags.c_contiguous:
+            host = np.ascontiguousarray(host)
+        if _cap_bytes() == 0:
+            return jax.device_put(host)
+        nbytes = host.nbytes
+        digest = hashlib.blake2b(memoryview(host).cast("B"), digest_size=16).digest()
+        key = (host.dtype.str, host.shape, digest)
+        with self._lock:
+            dev = self._entries.get(key)
+            if dev is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                self.hit_bytes += nbytes
+                return dev
+        dev = jax.device_put(host)
+        with self._lock:
+            self.misses += 1
+            self.miss_bytes += nbytes
+            # Re-check: a concurrent miss on the same content may have landed
+            # between the locks — keep its entry, don't double-charge _bytes.
+            if key not in self._entries:
+                self._entries[key] = dev
+                self._bytes += nbytes
+            dev = self._entries[key]
+            cap = _cap_bytes()
+            while self._bytes > cap and len(self._entries) > 1:
+                old_key, _old = self._entries.popitem(last=False)
+                self._bytes -= _nbytes_of_key(old_key)
+        return dev
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_bytes": self.hit_bytes,
+                "miss_bytes": self.miss_bytes,
+                "resident_bytes": self._bytes,
+                "entries": len(self._entries),
+            }
+
+    def reset_counters(self) -> dict:
+        """Snapshot and zero the hit/miss counters (per-cycle accounting)."""
+        with self._lock:
+            snap = {
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_bytes": self.hit_bytes,
+                "miss_bytes": self.miss_bytes,
+            }
+            self.hits = self.misses = 0
+            self.hit_bytes = self.miss_bytes = 0
+            return snap
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+
+def _nbytes_of_key(key: Tuple) -> int:
+    dtype_str, shape, _digest = key
+    n = int(np.dtype(dtype_str).itemsize)
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+_GLOBAL = TransferCache()
+
+
+def to_device(arr: np.ndarray, dtype=None):
+    return _GLOBAL.to_device(arr, dtype=dtype)
+
+
+def stats() -> dict:
+    return _GLOBAL.stats()
+
+
+def reset_counters() -> dict:
+    return _GLOBAL.reset_counters()
+
+
+def clear() -> None:
+    return _GLOBAL.clear()

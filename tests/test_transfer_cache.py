@@ -1,0 +1,132 @@
+"""Transfer cache: content-addressed device uploads (ops/transfer_cache.py).
+
+The steady cycle's device phase must not re-upload unchanged tensors — under
+the tunneled transport every transfer pays a round trip, and the round-4
+bench artifact recorded a 5x understatement in a window where ~20 uploads
+each stretched (VERDICT r4 weak #1).
+"""
+
+import numpy as np
+
+from scheduler_tpu.ops.transfer_cache import TransferCache
+
+
+class TestTransferCache:
+    def test_hit_on_identical_content(self):
+        tc = TransferCache()
+        a = np.arange(1024, dtype=np.float32)
+        d1 = tc.to_device(a)
+        d2 = tc.to_device(a.copy())  # different object, same bytes
+        assert d1 is d2
+        assert tc.stats()["hits"] == 1
+        assert tc.stats()["misses"] == 1
+
+    def test_miss_on_mutation(self):
+        tc = TransferCache()
+        a = np.arange(1024, dtype=np.float32)
+        d1 = tc.to_device(a)
+        a[0] = 99.0
+        d2 = tc.to_device(a)
+        assert d1 is not d2
+        assert np.asarray(d2)[0] == 99.0
+        assert tc.stats()["misses"] == 2
+
+    def test_dtype_canonicalization_matches_jnp(self):
+        """device_put canonicalizes f64->f32 / i64->i32 exactly like the
+        jnp.asarray calls it replaced (x64 is never enabled in this repo)."""
+        import jax.numpy as jnp
+
+        tc = TransferCache()
+        f = np.arange(8, dtype=np.float64)
+        i = np.arange(8, dtype=np.int64)
+        assert tc.to_device(f).dtype == jnp.asarray(f).dtype
+        assert tc.to_device(i).dtype == jnp.asarray(i).dtype
+        # explicit cast path
+        assert tc.to_device(f, np.float32).dtype == np.float32
+
+    def test_shape_and_dtype_disambiguate(self):
+        tc = TransferCache()
+        a = np.zeros(16, dtype=np.float32)
+        b = np.zeros((4, 4), dtype=np.float32)  # same bytes, different shape
+        c = np.zeros(16, dtype=np.int32)  # same byte length, different dtype
+        da, db, dc = tc.to_device(a), tc.to_device(b), tc.to_device(c)
+        assert da.shape == (16,) and db.shape == (4, 4)
+        assert dc.dtype == np.int32
+        assert tc.stats()["misses"] == 3
+
+    def test_lru_eviction_bounds_memory(self, monkeypatch):
+        monkeypatch.setenv("SCHEDULER_TPU_XFER_CACHE_MB", "1")
+        tc = TransferCache()
+        chunk = 512 * 1024  # 0.5 MB each
+        for k in range(4):
+            tc.to_device(np.full(chunk // 4, k, dtype=np.int32))
+        st = tc.stats()
+        assert st["resident_bytes"] <= 1024 * 1024
+        assert st["entries"] < 4
+
+    def test_cap_zero_disables_caching(self, monkeypatch):
+        monkeypatch.setenv("SCHEDULER_TPU_XFER_CACHE_MB", "0")
+        tc = TransferCache()
+        a = np.arange(64, dtype=np.float32)
+        d1 = tc.to_device(a)
+        d2 = tc.to_device(a)
+        assert d1 is not d2
+        assert tc.stats()["entries"] == 0
+
+    def test_reset_counters_snapshot(self):
+        tc = TransferCache()
+        tc.to_device(np.arange(4, dtype=np.int32))
+        snap = tc.reset_counters()
+        assert snap["misses"] == 1
+        assert tc.stats()["misses"] == 0
+
+
+class TestPhases:
+    def test_inactive_is_noop(self):
+        from scheduler_tpu.utils import phases
+
+        with phases.phase("x"):
+            pass
+        assert phases.end() == {}
+
+    def test_records_and_accumulates(self):
+        from scheduler_tpu.utils import phases
+
+        phases.begin()
+        with phases.phase("a"):
+            pass
+        with phases.phase("a"):
+            pass
+        with phases.phase("b"):
+            pass
+        rec = phases.end()
+        assert set(rec) == {"a", "b"}
+        assert rec["a"] >= 0.0
+        assert not phases.active()
+
+    def test_steady_cycle_phases_shape(self):
+        """The measurement seam returns the split the bench artifact emits."""
+        import scheduler_tpu.actions  # noqa: F401
+        import scheduler_tpu.plugins  # noqa: F401
+        from scheduler_tpu.conf import parse_scheduler_conf
+        from scheduler_tpu.harness import make_synthetic_cluster
+        from scheduler_tpu.harness.measure import steady_cycle_phases
+
+        conf = parse_scheduler_conf(
+            """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+"""
+        )
+        cluster = make_synthetic_cluster(20, 60, tasks_per_job=10)
+        elapsed, rec = steady_cycle_phases(cluster.cache, conf, ("allocate",))
+        assert elapsed > 0
+        for key in ("open", "close", "uploads", "upload_bytes"):
+            assert key in rec
+        # the engine path ran: device phase recorded
+        assert "device" in rec or "engine_init" in rec

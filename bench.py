@@ -52,13 +52,20 @@ POLICY = (
 )
 
 
-def one_cycle(n_nodes: int, n_pods: int, tasks_per_job: int) -> tuple[int, float, dict]:
+def one_cycle(
+    n_nodes: int, n_pods: int, tasks_per_job: int, n_queues: int = 1
+) -> tuple[int, float, dict]:
     import scheduler_tpu.actions  # noqa: F401  registry side effects
     import scheduler_tpu.plugins  # noqa: F401
     from scheduler_tpu.conf import parse_scheduler_conf
     from scheduler_tpu.harness import make_synthetic_cluster
     from scheduler_tpu.harness.measure import steady_cycle_phases
 
+    # SCHEDULER_TPU_BENCH_QUEUES > 1 runs the multi-queue flagship variant:
+    # proportion's live share ordering joins the conf (the reference treats
+    # multi-queue as the normal case, allocate.go:46-72), and the mega kernel
+    # covers it in-kernel since round 5.
+    proportion = "  - name: proportion\n" if n_queues > 1 else ""
     conf = parse_scheduler_conf(
         """
 actions: "allocate"
@@ -67,10 +74,16 @@ tiers:
   - name: priority
   - name: gang
   - name: drf
-  - name: binpack
 """
+        + proportion
+        + "  - name: binpack\n"
     )
-    cluster = make_synthetic_cluster(n_nodes, n_pods, tasks_per_job=tasks_per_job)
+    queues = tuple(f"q{i}" for i in range(n_queues)) if n_queues > 1 else ("default",)
+    weights = {q: i + 1 for i, q in enumerate(queues)}
+    cluster = make_synthetic_cluster(
+        n_nodes, n_pods, tasks_per_job=tasks_per_job,
+        queues=queues, queue_weights=weights,
+    )
     elapsed, phases = steady_cycle_phases(cluster.cache, conf, ("allocate",))
     binds = len(cluster.cache.binder.binds)
     return binds, elapsed, phases
@@ -101,13 +114,14 @@ def main() -> None:
     n_nodes = int(os.environ.get("SCHEDULER_TPU_BENCH_NODES", 100 if smoke else 10_000))
     n_pods = int(os.environ.get("SCHEDULER_TPU_BENCH_PODS", 500 if smoke else 100_000))
     tasks_per_job = int(os.environ.get("SCHEDULER_TPU_BENCH_GANG", 100))
+    n_queues = int(os.environ.get("SCHEDULER_TPU_BENCH_QUEUES", 1))
 
     # Warmup at the REAL shapes: the steady-state scheduler loop compiles once
     # per (node-bucket, task-bucket) pair and re-runs every period, so the
     # measured cycle must not pay the one-time XLA compile. A reduced-pod warmup
     # misses the full-scale program's bucket and forces a ~10s recompile into
     # the measured cycle; warm with the exact same problem instead.
-    one_cycle(n_nodes, n_pods, tasks_per_job)
+    one_cycle(n_nodes, n_pods, tasks_per_job, n_queues)
 
     # Probe -> cycle -> probe -> cycle ... -> probe: every cycle is bracketed
     # by link probes.  5 base cycles; up to 3 more if the link ate >=3.
@@ -120,7 +134,7 @@ def main() -> None:
         and len(runs) < max_cycles
         and sum(not bad for bad in _classify(runs, probes)) < 3
     ):
-        runs.append(one_cycle(n_nodes, n_pods, tasks_per_job))
+        runs.append(one_cycle(n_nodes, n_pods, tasks_per_job, n_queues))
         probes.append(_probe())
 
     if any(b != runs[0][0] for b, _, _ in runs) or runs[0][0] == 0:
@@ -145,6 +159,7 @@ def main() -> None:
         "vs_baseline": round(pods_per_sec / 100_000.0, 4),
         "detail": {
             "nodes": n_nodes,
+            "queues": n_queues,
             "pods": n_pods,
             "binds": binds,
             "cycle_seconds": round(elapsed, 3),

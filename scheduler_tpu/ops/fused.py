@@ -1090,8 +1090,6 @@ class FusedAllocator:
 
         mesh = get_mesh()
         self._mesh = mesh
-        if mesh is not None:
-            _ = self.args  # sharded sessions always run the XLA program: build now
 
         # Fused selection step kernel (pallas): one launch per micro-step for
         # fit+score+mask+argmax.  Excluded when: the score-bound batch path
@@ -1128,7 +1126,7 @@ class FusedAllocator:
         self.use_mega = False
         self._mega = None
         mega_enabled = os.environ.get("SCHEDULER_TPU_MEGA", "1") not in ("0", "false")
-        if step_ok and mega_enabled and mesh is None:
+        if step_ok and mega_enabled:
             from scheduler_tpu.ops import megakernel as _mk
 
             # Multi-queue sessions run the kernel's queue-chain mode (round 5;
@@ -1177,7 +1175,10 @@ class FusedAllocator:
                                    single_queue=single_queue,
                                    queues_idx=queues_idx,
                                    queue_deserved=queue_deserved,
-                                   queue_alloc=queue_alloc)
+                                   queue_alloc=queue_alloc,
+                                   mesh=mesh)
+        if mesh is not None and not self.use_mega:
+            _ = self.args  # sharded XLA sessions run eagerly-built args
 
     def _static_signature_ids(self, ssn) -> Optional[np.ndarray]:
         """Dense per-task STATIC-signature ids: tasks sharing (selector row,
@@ -1233,7 +1234,8 @@ class FusedAllocator:
                       score_bound=False, static_sids=None,
                       static_mask_dev=None, static_score_dev=None,
                       single_queue=True, queues_idx=None,
-                      queue_deserved=None, queue_alloc=None) -> None:
+                      queue_deserved=None, queue_alloc=None,
+                      mesh=None) -> None:
         """Build the mega-kernel's inputs (ops/megakernel.py) — per-signature
         request table, lane-packed job columns, transposed node rows.  Sets
         ``use_mega`` only if the signature table fits the kernel's cap."""
@@ -1349,17 +1351,36 @@ class FusedAllocator:
             else jnp.zeros((8, nb), jnp.float32)
         )
 
-        from scheduler_tpu.ops.transfer_cache import to_device
+        from scheduler_tpu.ops.transfer_cache import to_device as _to_device
+
+        # Mesh mode runs the kernel replicated under shard_map: every input
+        # must be REPLICATED on the mesh (host uploads placed replicated;
+        # device-derived arrays re-placed — a small one-time broadcast).
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+
+            rep_sharding = NamedSharding(mesh, _P())
+
+            def to_device(a, dtype=None):
+                return _to_device(a, dtype, sharding=rep_sharding)
+
+            def replicate(x):
+                return jax.device_put(x, rep_sharding)
+        else:
+            to_device = _to_device
+
+            def replicate(x):
+                return x
 
         self._mega_args = (
-            ns0,
-            alloc_t,
-            rel_t,
+            replicate(ns0),
+            replicate(alloc_t),
+            replicate(rel_t),
             to_device(node_gate)[None, :],
-            state.pods_limit.astype(jnp.float32)[None, :],
+            replicate(state.pods_limit.astype(jnp.float32)[None, :]),
             to_device(sig_req),
             to_device(task_sig),
-            run_dev.astype(jnp.int32).reshape(1, tb),
+            replicate(run_dev.astype(jnp.int32).reshape(1, tb)),
             to_device(job_off),
             to_device(job_num),
             to_device(job_def),
@@ -1370,8 +1391,8 @@ class FusedAllocator:
             to_device(drf_safe),
             to_device(drf_mask),
             to_device(msig),
-            smask,
-            sscore,
+            replicate(smask),
+            replicate(sscore),
             to_device(jqueue),
             to_device(jq_des),
             to_device(jq_alloc0),
@@ -1395,6 +1416,7 @@ class FusedAllocator:
             multi_queue=multi_queue,
             queue_proportion="proportion" in self.queue_comparators,
             overused_gate=self.overused_gate,
+            mesh=mesh,
             interpret=_pk._interpret(),
         )
         self.use_mega = True
@@ -1538,7 +1560,9 @@ class FusedAllocator:
         AFTER the kernel — in-kernel int16 stores are catastrophically slow
         on this backend — and costs ~nothing while the tunneled transfer is
         the device phase's floor."""
-        if self.n_bucket <= 30000 and self._mesh is None:
+        if self.n_bucket <= 30000 and (self._mesh is None or self.use_mega):
+            # Mega output is replicated even on a mesh; only the node-sharded
+            # XLA program's output skips the narrowing jit.
             return np.asarray(_narrow16(dev)).astype(np.int32)
         return np.asarray(dev)
 

@@ -106,7 +106,7 @@ def mega_supported(
         "cross_batch", "batch_runs", "has_releasing", "use_static",
         "score_bound", "mins", "cpu_idx", "mem_idx",
         "multi_queue", "queue_proportion", "overused_gate",
-        "interpret",
+        "mesh", "interpret",
     ),
 )
 def mega_allocate(
@@ -153,6 +153,7 @@ def mega_allocate(
     queue_proportion: bool,
     overused_gate: bool,
     interpret: bool,
+    mesh=None,
 ) -> jnp.ndarray:
     n = ns0.shape[1]
     t_pad = task_sig.shape[1]
@@ -588,7 +589,7 @@ def mega_allocate(
             (jnp.int32(-1), jnp.int32(0), jnp.int32(0), jnp.int32(0)),
         )
 
-    out = pl.pallas_call(
+    call = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((t_sub, 128), jnp.int32),
         in_specs=[
@@ -603,12 +604,36 @@ def mega_allocate(
             pltpu.VMEM((24 if multi_queue else 16, j_pad), jnp.float32),
         ],
         interpret=interpret,
-    )(
+    )
+    operands = (
         ns0, alloc_t, rel0, gate, plim, sig_req, task_sig, run_len,
         job_off, job_num, job_deficit, job_gang, job_prio, job_tb,
         js_drf0, drf_safe, drf_mask, msig, smask, sscore,
         jqueue, jq_des, jq_alloc0, misc,
     )
+    if mesh is not None:
+        # Mesh mode: the whole-loop kernel runs REPLICATED — every chip
+        # executes the identical sequential scan on the full node ledger.
+        # This is a deliberate distribution choice, not a cop-out: the
+        # per-pop scan is a sequential dependence chain, and at mega-eligible
+        # sizes (n <= 32768) a node-sharded variant would pay an ICI
+        # collective per placement step for less local-work savings than the
+        # collective's latency (docs/DEVICE_ENGINE.md "Sharding the whole
+        # loop").  The cycle's parallel stages (static-mask matmuls, commit
+        # scatters, enqueue/fairness totals) stay node-sharded; clusters past
+        # the VMEM cap take the node-sharded XLA while-loop instead.
+        from jax import shard_map as _shard_map
+        from jax.sharding import PartitionSpec as _P
+
+        out = _shard_map(
+            call,
+            mesh=mesh,
+            in_specs=tuple(_P() for _ in operands),
+            out_specs=_P(),
+            check_vma=False,
+        )(*operands)
+    else:
+        out = call(*operands)
     return out.reshape(-1)[:t_pad]
 
 

@@ -7,8 +7,10 @@ PCIe link but multiplies under the tunneled-TPU transport, where EVERY
 transfer pays a round trip — a degraded window turns ~20 small uploads into
 seconds of latency (the round-4 bench artifact recorded exactly that).
 
-``to_device`` therefore keys each upload by ``(dtype, shape, digest(bytes))``
-and returns the already-resident device buffer on a hit.  Correctness is
+``to_device`` therefore keys each upload by ``(dtype, shape, digest(bytes),
+sharding)`` — the sharding component keeps replicated-mesh and single-device
+placements from aliasing — and returns the already-resident device buffer on
+a hit.  Correctness is
 content-based, not lifecycle-based: a mutated host array simply produces a
 different digest and misses.  Device buffers are never donated by any engine
 program (no ``donate_argnums`` anywhere in ``ops/``), so residents stay valid.
@@ -53,19 +55,23 @@ class TransferCache:
         self.hit_bytes = 0
         self.miss_bytes = 0
 
-    def to_device(self, arr: np.ndarray, dtype=None):
+    def to_device(self, arr: np.ndarray, dtype=None, sharding=None):
         """Device array with ``arr``'s content (cast to ``dtype`` if given),
-        reusing a resident buffer when one with identical bytes exists."""
+        reusing a resident buffer when one with identical bytes exists.
+        ``sharding`` (a jax Sharding) participates in the key, so replicated
+        mesh placements and single-device placements never alias."""
         import jax
 
         host = np.asarray(arr, dtype=dtype)
         if not host.flags.c_contiguous:
             host = np.ascontiguousarray(host)
         if _cap_bytes() == 0:
-            return jax.device_put(host)
+            return jax.device_put(host, sharding)
         nbytes = host.nbytes
         digest = hashlib.blake2b(memoryview(host).cast("B"), digest_size=16).digest()
-        key = (host.dtype.str, host.shape, digest)
+        # Sharding objects are hashable and eq-compare by mesh devices + spec,
+        # so distinct device sets can never alias (str() would drop the ids).
+        key = (host.dtype.str, host.shape, digest, sharding)
         with self._lock:
             dev = self._entries.get(key)
             if dev is not None:
@@ -73,7 +79,7 @@ class TransferCache:
                 self.hits += 1
                 self.hit_bytes += nbytes
                 return dev
-        dev = jax.device_put(host)
+        dev = jax.device_put(host, sharding)
         with self._lock:
             self.misses += 1
             self.miss_bytes += nbytes
@@ -120,7 +126,7 @@ class TransferCache:
 
 
 def _nbytes_of_key(key: Tuple) -> int:
-    dtype_str, shape, _digest = key
+    dtype_str, shape = key[0], key[1]
     n = int(np.dtype(dtype_str).itemsize)
     for d in shape:
         n *= int(d)
@@ -130,8 +136,8 @@ def _nbytes_of_key(key: Tuple) -> int:
 _GLOBAL = TransferCache()
 
 
-def to_device(arr: np.ndarray, dtype=None):
-    return _GLOBAL.to_device(arr, dtype=dtype)
+def to_device(arr: np.ndarray, dtype=None, sharding=None):
+    return _GLOBAL.to_device(arr, dtype=dtype, sharding=sharding)
 
 
 def stats() -> dict:

@@ -219,11 +219,19 @@ def test_sharded_step_kernel_engages_and_matches(monkeypatch):
     sharded = engine_for(True)
     assert sharded._mesh is not None
     assert sharded.step_kernel, "sharded step kernel must engage under the mesh"
-    assert not sharded.use_mega  # whole-loop kernel stays single-chip
-    got = np.asarray(sharded._execute())
+    # Round 5: the whole-loop kernel runs under the mesh too (replicated via
+    # shard_map — the flagship engine no longer dies at >1 chip).
+    assert sharded.use_mega, "mega must engage under the mesh now"
+    got_mega = np.asarray(sharded._execute())
+
+    # The sharded XLA while-loop (per-shard step kernel + candidate
+    # all-gather) remains the big-cluster fallback: pin it too.
+    sharded.use_mega = False
+    got_xla = np.asarray(sharded._execute())
 
     single = engine_for(False)
     single.use_mega = False  # compare the same program shape
     want = np.asarray(single._execute())
-    assert np.array_equal(got, want)
-    assert int((got >= 0).sum()) > 0
+    assert np.array_equal(got_mega, want)
+    assert np.array_equal(got_xla, want)
+    assert int((got_mega >= 0).sum()) > 0

@@ -156,6 +156,7 @@ def run(opt: ServerOption, stop: Optional[threading.Event] = None,
             scheduler_name=opt.scheduler_name,
             default_queue=opt.default_queue,
             io_workers=opt.io_workers,
+            dialect=getattr(opt, "api_dialect", "k8s") or "k8s",
         )
     elif synthetic:
         from scheduler_tpu.harness import make_synthetic_cluster
@@ -219,6 +220,11 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument(
         "--api-server", default=None, metavar="URL",
         help="external system of record (list+watch in, binds/evictions out)",
+    )
+    parser.add_argument(
+        "--api-dialect", default="k8s", choices=("k8s", "legacy"),
+        help="outbound wire shapes: real Kubernetes API calls (default) or "
+             "the compact legacy JSON RPCs",
     )
     ns = parser.parse_args(argv)
     if getattr(ns, "version", False):

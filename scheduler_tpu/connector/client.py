@@ -49,15 +49,44 @@ from scheduler_tpu.connector.wire import (
 logger = logging.getLogger("scheduler_tpu.connector")
 
 
-def _post(base: str, path: str, payload: dict, timeout: float = 10.0) -> dict:
+def _request(
+    base: str, path: str, payload: Optional[dict], method: str,
+    timeout: float = 10.0,
+) -> dict:
     req = urllib.request.Request(
         base + path,
-        data=json.dumps(payload).encode(),
+        data=None if payload is None else json.dumps(payload).encode(),
         headers={"Content-Type": "application/json"},
-        method="POST",
+        method=method,
     )
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return json.loads(resp.read() or b"{}")
+
+
+def _post(base: str, path: str, payload: dict, timeout: float = 10.0) -> dict:
+    return _request(base, path, payload, "POST", timeout)
+
+
+def _patch(base: str, path: str, payload: dict, timeout: float = 10.0) -> dict:
+    return _request(base, path, payload, "PATCH", timeout)
+
+
+def _delete(base: str, path: str, timeout: float = 10.0) -> dict:
+    return _request(base, path, None, "DELETE", timeout)
+
+
+# The CRD group the reference registers its PodGroup/Queue types under
+# (pkg/apis/scheduling/v1alpha1/register.go:32).
+CRD_PREFIX = "/apis/scheduling.incubator.k8s.io/v1alpha1"
+
+
+def _cond_field(condition, name: str) -> str:
+    """Condition accessor shared by both status-updater dialects: the cache
+    passes conditions as plain dicts (record_job_status_event); attribute-
+    style objects are accepted too."""
+    if isinstance(condition, dict):
+        return str(condition.get(name, ""))
+    return str(getattr(condition, name, ""))
 
 
 def _get(base: str, path: str, timeout: float = 30.0) -> dict:
@@ -150,19 +179,12 @@ class HttpStatusUpdater(StatusUpdater):
             logger.warning("event batch dropped (%d events)", len(events))
 
     def update_pod_condition(self, pod, condition) -> None:
-        # The cache passes conditions as plain dicts (cache.record_job_status_
-        # event); accept attribute-style objects too.
-        def field(name: str) -> str:
-            if isinstance(condition, dict):
-                return str(condition.get(name, ""))
-            return str(getattr(condition, name, ""))
-
         _post(self.base, "/pod-condition", {
             "namespace": pod.namespace, "name": pod.name,
-            "type": field("type"),
-            "status": field("status"),
-            "reason": field("reason"),
-            "message": field("message"),
+            "type": _cond_field(condition, "type"),
+            "status": _cond_field(condition, "status"),
+            "reason": _cond_field(condition, "reason"),
+            "message": _cond_field(condition, "message"),
         })
 
     def update_pod_group(self, job) -> None:
@@ -177,6 +199,187 @@ class HttpStatusUpdater(StatusUpdater):
                 for c in pg.status.conditions
             ],
         })
+
+
+class K8sBinder(Binder):
+    """Binds as the Kubernetes wire does it: POST the ``pods/binding``
+    subresource with a v1 Binding body (reference ``defaultBinder.Bind``,
+    cache/cache.go:110-123)."""
+
+    def __init__(self, base: str) -> None:
+        self.base = base
+
+    def bind(self, pod, hostname: str) -> None:
+        _post(
+            self.base,
+            f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding",
+            {
+                "apiVersion": "v1",
+                "kind": "Binding",
+                "metadata": {"name": pod.name, "namespace": pod.namespace},
+                "target": {"apiVersion": "v1", "kind": "Node", "name": hostname},
+            },
+        )
+
+    def bind_bulk(self, pairs: list) -> None:
+        # The k8s API has no bulk bind; the reference fires one goroutine per
+        # bind.  Per-pod POSTs here, folding failures into the BulkBindError
+        # contract (listed pairs failed, everything else applied).
+        failed = []
+        for pod, hostname in pairs:
+            try:
+                self.bind(pod, hostname)
+            except Exception:
+                logger.warning("k8s bind failed for %s/%s", pod.namespace, pod.name)
+                failed.append((pod, hostname))
+        if failed:
+            raise BulkBindError(failed)
+
+
+class K8sEvictor(Evictor):
+    """Evicts by DELETEing the pod (reference ``defaultEvictor.Evict``,
+    cache/cache.go:125-144)."""
+
+    def __init__(self, base: str) -> None:
+        self.base = base
+
+    def evict(self, pod) -> None:
+        _delete(self.base, f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}")
+
+
+class K8sVolumeBinder(VolumeBinder):
+    """Volume RPCs in PVC shapes: allocate = the ``selected-node`` annotation
+    the k8s volume binder's AssumePodVolumes writes on delayed-binding
+    claims; bind = the ``bind-completed`` annotation BindPodVolumes
+    finalizes (reference cache.go:189-209).
+
+    Allocation is per-claim and NOT atomic across a pod's claims — exactly
+    the k8s assume-cache model: a conflict mid-pod (some claim already BOUND
+    elsewhere) aborts the task's placement with earlier claims left assumed,
+    and that residue is benign by design because assumed-but-unbound claims
+    are movable (the server re-assigns them on the next allocation; only
+    ``bind-completed`` pins a claim)."""
+
+    def __init__(self, base: str) -> None:
+        self.base = base
+
+    def _patch_claim(self, namespace: str, claim: str, annotations: dict) -> None:
+        _patch(
+            self.base,
+            f"/api/v1/namespaces/{namespace}/persistentvolumeclaims/{claim}",
+            {"metadata": {"annotations": annotations}},
+        )
+
+    def allocate_volumes(self, task, hostname: str) -> None:
+        for claim in task.pod.volume_claims:
+            self._patch_claim(
+                task.pod.namespace, claim,
+                {"volume.kubernetes.io/selected-node": hostname},
+            )
+
+    def bind_volumes(self, task) -> None:
+        for claim in task.pod.volume_claims:
+            self._patch_claim(
+                task.pod.namespace, claim,
+                {"pv.kubernetes.io/bind-completed": "yes"},
+            )
+
+
+class K8sStatusUpdater(StatusUpdater):
+    """Status writes in Kubernetes shapes: pod conditions PATCH the pod's
+    ``status`` subresource (reference ``defaultStatusUpdater.UpdatePodCondition``
+    -> UpdatePodStatus, cache.go:146-187), PodGroup status PATCHes the CRD's
+    status subresource, and lifecycle events POST as v1 Events (Recorder)."""
+
+    RECORDS_EVENTS = True
+    # Bounded like client-go's event broadcaster queue; overflow drops the
+    # OLDEST events (lifecycle events are advisory, never load-bearing).
+    _QUEUE_CAP = 10_000
+
+    def __init__(self, base: str) -> None:
+        self.base = base
+        # The k8s API takes ONE Event per POST, and the reference's Recorder
+        # is asynchronous (client-go's broadcaster queues events and a
+        # background goroutine sends them) — a per-event synchronous POST
+        # from the cycle thread would charge N wire round trips per cycle to
+        # a FailedScheduling backlog of N pods.  Same model here: enqueue,
+        # drain on a daemon thread.
+        self._events: list = []
+        self._ev_lock = threading.Condition()
+        self._ev_stop = False
+        self._ev_thread = threading.Thread(
+            target=self._drain_events, name="k8s-event-recorder", daemon=True
+        )
+        self._ev_thread.start()
+
+    def record_events(self, events: list) -> None:
+        with self._ev_lock:
+            self._events.extend(events)
+            if len(self._events) > self._QUEUE_CAP:
+                del self._events[: len(self._events) - self._QUEUE_CAP]
+            self._ev_lock.notify()
+
+    def _drain_events(self) -> None:
+        while True:
+            with self._ev_lock:
+                while not self._events and not self._ev_stop:
+                    self._ev_lock.wait()
+                if self._ev_stop and not self._events:
+                    return
+                batch, self._events = self._events, []
+            for ev in batch:
+                try:
+                    self._post_event(ev)
+                except Exception:
+                    logger.warning("k8s event dropped for %s", ev.get("name"))
+
+    def _post_event(self, ev: dict) -> None:
+        ns = ev.get("namespace", "default")
+        _post(self.base, f"/api/v1/namespaces/{ns}/events", {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"namespace": ns,
+                         "generateName": f"{ev.get('name', '')}."},
+            "involvedObject": {
+                "kind": "Pod", "namespace": ns, "name": ev.get("name", ""),
+            },
+            "type": ev.get("type", "Normal"),
+            "reason": ev.get("reason", ""),
+            "message": ev.get("message", ""),
+        })
+
+    def update_pod_condition(self, pod, condition) -> None:
+        _patch(
+            self.base,
+            f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/status",
+            {"status": {"conditions": [{
+                "type": _cond_field(condition, "type") or "PodScheduled",
+                "status": _cond_field(condition, "status"),
+                "reason": _cond_field(condition, "reason"),
+                "message": _cond_field(condition, "message"),
+            }]}},
+        )
+
+    def update_pod_group(self, job) -> None:
+        pg = job.pod_group
+        if pg is None:
+            return
+        _patch(
+            self.base,
+            f"{CRD_PREFIX}/namespaces/{pg.namespace}/podgroups/{pg.name}/status",
+            {
+                "apiVersion": "scheduling.incubator.k8s.io/v1alpha1",
+                "kind": "PodGroup",
+                "metadata": {"name": pg.name, "namespace": pg.namespace},
+                "status": {
+                    "phase": str(pg.status.phase),
+                    "conditions": [
+                        {"type": c.type, "status": c.status, "reason": c.reason}
+                        for c in pg.status.conditions
+                    ],
+                },
+            },
+        )
 
 
 class ApiConnector:
@@ -410,18 +613,33 @@ def connect_cache(
     io_workers: Optional[int] = None,
     vocab: Optional[ResourceVocabulary] = None,
     async_io: bool = True,
+    dialect: str = "k8s",
 ) -> tuple:
     """A SchedulerCache whose side effects cross the wire to ``base``.
     Returns ``(cache, connector)`` — call ``connector.start()`` after
-    ``cache.run()`` and ``connector.stop()`` at shutdown."""
+    ``cache.run()`` and ``connector.stop()`` at shutdown.
+
+    ``dialect`` selects the OUTBOUND wire shapes: ``"k8s"`` (default) emits
+    real Kubernetes API calls — pods/binding POSTs, pod DELETEs, status
+    subresource PATCHes, v1 Events, PVC annotation PATCHes — so the
+    connector can front a real API server; ``"legacy"`` keeps the compact
+    bespoke JSON RPCs for older servers."""
+    if dialect == "k8s":
+        binder, evictor = K8sBinder(base), K8sEvictor(base)
+        status, volumes = K8sStatusUpdater(base), K8sVolumeBinder(base)
+    elif dialect == "legacy":
+        binder, evictor = HttpBinder(base), HttpEvictor(base)
+        status, volumes = HttpStatusUpdater(base), HttpVolumeBinder(base)
+    else:
+        raise ValueError(f"unknown wire dialect {dialect!r}")
     cache = SchedulerCache(
         scheduler_name=scheduler_name,
         default_queue=default_queue,
         vocab=vocab,
-        binder=HttpBinder(base),
-        evictor=HttpEvictor(base),
-        status_updater=HttpStatusUpdater(base),
-        volume_binder=HttpVolumeBinder(base),
+        binder=binder,
+        evictor=evictor,
+        status_updater=status,
+        volume_binder=volumes,
         async_io=async_io,
         io_workers=io_workers,
     )

@@ -38,6 +38,11 @@ class MockState:
         self.fail: Dict[str, int] = {}  # op -> remaining injected failures
         self.bind_calls = 0
         self.evict_calls = 0
+        # Wire-shape accounting: how many mutations arrived as real k8s API
+        # calls vs the legacy bespoke RPCs — lets tests assert WHICH dialect
+        # actually crossed the wire, not just that state changed.
+        self.k8s_calls = 0
+        self.legacy_calls = 0
         self.get_calls = 0   # single-object re-fetches (syncTask analogue)
         self.list_calls = 0  # full LISTs (relists show up here)
         self.status_updates: List[Dict] = []
@@ -176,6 +181,8 @@ def make_handler(state: MockState):
                         "get_calls": state.get_calls,
                         "list_calls": state.list_calls,
                         "status_updates": len(state.status_updates),
+                        "k8s_calls": state.k8s_calls,
+                        "legacy_calls": state.legacy_calls,
                         "seq": state.seq,
                     })
                 return
@@ -189,9 +196,132 @@ def make_handler(state: MockState):
                 return
             self._json({"error": "not found"}, 404)
 
+        # -- shared mutation bodies (both dialects route here) ---------------
+
+        def _do_bind(self, pairs, bulk: bool) -> None:
+            failed = []
+            for pair in pairs:
+                with state.lock:
+                    state.bind_calls += 1
+                if state.take_failure("bind"):
+                    failed.append(pair)
+                    continue
+                key = f"{pair.get('namespace', 'default')}/{pair['name']}"
+                with state.lock:
+                    pod = state.objects["pod"].get(key)
+                if pod is None:
+                    failed.append(pair)
+                    continue
+                pod = dict(pod)
+                if isinstance(pod.get("metadata"), dict):
+                    # Real k8s Pod shape: bind lands in spec/status.
+                    pod["spec"] = dict(pod.get("spec", {}))
+                    pod["spec"]["nodeName"] = pair["node"]
+                    pod["status"] = dict(pod.get("status", {}))
+                    pod["status"]["phase"] = "Running"
+                else:
+                    pod["nodeName"] = pair["node"]
+                    pod["phase"] = "Running"
+                # Echo on the watch stream: the scheduler's cache sees its
+                # own bind come back as a pod update, like an informer.
+                state.apply("pod", "update", pod)
+            if not bulk:
+                if failed:
+                    self._json({"error": "bind failed"}, 500)
+                else:
+                    self._json({"ok": True})
+            else:
+                self._json({"failed": failed}, 200 if not failed else 409)
+
+        def _do_evict(self, namespace: str, name: str) -> None:
+            with state.lock:
+                state.evict_calls += 1
+            if state.take_failure("evict"):
+                self._json({"error": "evict failed"}, 500)
+                return
+            key = f"{namespace}/{name}"
+            with state.lock:
+                pod = state.objects["pod"].get(key)
+            if pod is not None:
+                state.apply("pod", "delete", pod)
+            self._json({"ok": True})
+
+        def _do_allocate_volumes(self, node: str, claims) -> None:
+            if state.take_failure("allocate-volumes"):
+                self._json({"error": "allocate-volumes failed"}, 500)
+                return
+            with state.lock:
+                # Assumed-but-unbound claims may move (the k8s assume
+                # cache reconciles stale assumptions); only a BOUND claim
+                # on a different node is a hard topology conflict.
+                for claim in claims:
+                    entry = state.volumes.get(claim)
+                    if entry is not None and entry["bound"] and entry["node"] != node:
+                        self._json(
+                            {"error": f"claim {claim} bound on {entry['node']}"},
+                            409,
+                        )
+                        return
+                for claim in claims:
+                    entry = state.volumes.get(claim)
+                    if entry is None or not entry["bound"]:
+                        state.volumes[claim] = {"node": node, "bound": False}
+            self._json({"ok": True})
+
+        def _do_bind_volumes(self, claims) -> None:
+            if state.take_failure("bind-volumes"):
+                self._json({"error": "bind-volumes failed"}, 500)
+                return
+            with state.lock:
+                for claim in claims:
+                    entry = state.volumes.get(claim)
+                    if entry is None:
+                        self._json({"error": f"claim {claim} never allocated"}, 409)
+                        return
+                    entry["bound"] = True
+            self._json({"ok": True})
+
+        # k8s API path parsing: /api/v1/namespaces/{ns}/{resource}/{name}[/{sub}]
+        @staticmethod
+        def _k8s_parts(path: str):
+            parts = path.strip("/").split("/")
+            if len(parts) >= 5 and parts[0] == "api" and parts[2] == "namespaces":
+                return parts[3], parts[4], parts[5] if len(parts) > 5 else None, (
+                    parts[6] if len(parts) > 6 else None
+                )
+            return None
+
         def do_POST(self) -> None:
             url = urlparse(self.path)
             body = self._body()
+            # --- k8s dialect: POST pods/{name}/binding, POST events ---------
+            k8s = self._k8s_parts(url.path)
+            if k8s is not None:
+                with state.lock:
+                    state.k8s_calls += 1
+                ns, resource, name, sub = k8s
+                if resource == "pods" and sub == "binding":
+                    node = (body.get("target") or {}).get("name", "")
+                    self._do_bind(
+                        [{"namespace": ns, "name": name, "node": node}], bulk=False
+                    )
+                    return
+                if resource == "events" and name is None:
+                    with state.lock:
+                        inv = body.get("involvedObject") or {}
+                        state.event_log.append({
+                            "namespace": inv.get("namespace", ns),
+                            "name": inv.get("name", ""),
+                            "type": body.get("type", "Normal"),
+                            "reason": body.get("reason", ""),
+                            "message": body.get("message", ""),
+                        })
+                        if len(state.event_log) > 50_000:
+                            del state.event_log[:25_000]
+                    self._json({"ok": True}, 201)
+                    return
+                self._json({"error": "not found"}, 404)
+                return
             if url.path == "/objects":
                 state.apply(body["kind"], body.get("op", "add"), body["object"])
                 self._json({"ok": True}, 201)
@@ -202,91 +332,29 @@ def make_handler(state: MockState):
                 self._json({"ok": True})
                 return
             if url.path in ("/bind", "/bind-bulk"):
+                with state.lock:
+                    state.legacy_calls += 1
                 pairs = body["pairs"] if url.path == "/bind-bulk" else [body]
-                failed = []
-                for pair in pairs:
-                    with state.lock:
-                        state.bind_calls += 1
-                    if state.take_failure("bind"):
-                        failed.append(pair)
-                        continue
-                    key = f"{pair.get('namespace', 'default')}/{pair['name']}"
-                    with state.lock:
-                        pod = state.objects["pod"].get(key)
-                    if pod is None:
-                        failed.append(pair)
-                        continue
-                    pod = dict(pod)
-                    if isinstance(pod.get("metadata"), dict):
-                        # Real k8s Pod shape: bind lands in spec/status.
-                        pod["spec"] = dict(pod.get("spec", {}))
-                        pod["spec"]["nodeName"] = pair["node"]
-                        pod["status"] = dict(pod.get("status", {}))
-                        pod["status"]["phase"] = "Running"
-                    else:
-                        pod["nodeName"] = pair["node"]
-                        pod["phase"] = "Running"
-                    # Echo on the watch stream: the scheduler's cache sees its
-                    # own bind come back as a pod update, like an informer.
-                    state.apply("pod", "update", pod)
-                if url.path == "/bind":
-                    if failed:
-                        self._json({"error": "bind failed"}, 500)
-                    else:
-                        self._json({"ok": True})
-                else:
-                    self._json({"failed": failed}, 200 if not failed else 409)
+                self._do_bind(pairs, bulk=url.path == "/bind-bulk")
                 return
             if url.path == "/evict":
                 with state.lock:
-                    state.evict_calls += 1
-                if state.take_failure("evict"):
-                    self._json({"error": "evict failed"}, 500)
-                    return
-                key = f"{body.get('namespace', 'default')}/{body['name']}"
-                with state.lock:
-                    pod = state.objects["pod"].get(key)
-                if pod is not None:
-                    state.apply("pod", "delete", pod)
-                self._json({"ok": True})
+                    state.legacy_calls += 1
+                self._do_evict(body.get("namespace", "default"), body["name"])
                 return
             if url.path == "/allocate-volumes":
-                if state.take_failure("allocate-volumes"):
-                    self._json({"error": "allocate-volumes failed"}, 500)
-                    return
-                node = body["node"]
                 with state.lock:
-                    # Assumed-but-unbound claims may move (the k8s assume
-                    # cache reconciles stale assumptions); only a BOUND claim
-                    # on a different node is a hard topology conflict.
-                    for claim in body.get("claims", []):
-                        entry = state.volumes.get(claim)
-                        if entry is not None and entry["bound"] and entry["node"] != node:
-                            self._json(
-                                {"error": f"claim {claim} bound on {entry['node']}"},
-                                409,
-                            )
-                            return
-                    for claim in body.get("claims", []):
-                        entry = state.volumes.get(claim)
-                        if entry is None or not entry["bound"]:
-                            state.volumes[claim] = {"node": node, "bound": False}
-                self._json({"ok": True})
+                    state.legacy_calls += 1
+                self._do_allocate_volumes(body["node"], body.get("claims", []))
                 return
             if url.path == "/bind-volumes":
-                if state.take_failure("bind-volumes"):
-                    self._json({"error": "bind-volumes failed"}, 500)
-                    return
                 with state.lock:
-                    for claim in body.get("claims", []):
-                        entry = state.volumes.get(claim)
-                        if entry is None:
-                            self._json({"error": f"claim {claim} never allocated"}, 409)
-                            return
-                        entry["bound"] = True
-                self._json({"ok": True})
+                    state.legacy_calls += 1
+                self._do_bind_volumes(body.get("claims", []))
                 return
             if url.path == "/podgroup-status":
+                with state.lock:
+                    state.legacy_calls += 1
                 # Status updates land on the stored object and echo on the
                 # watch stream — the scheduler's own phase write (e.g.
                 # Pending -> Inqueue at enqueue) must survive a relist.  The
@@ -304,15 +372,104 @@ def make_handler(state: MockState):
                 return
             if url.path == "/pod-condition":
                 with state.lock:
+                    state.legacy_calls += 1
+                with state.lock:
                     state.status_updates.append(body)
                 self._json({"ok": True})
                 return
             if url.path == "/events":
+                with state.lock:
+                    state.legacy_calls += 1
                 # Lifecycle event sink (Recorder.Eventf analogue); bounded.
                 with state.lock:
                     state.event_log.extend(body.get("events", []))
                     if len(state.event_log) > 50_000:
                         del state.event_log[:25_000]
+                self._json({"ok": True})
+                return
+            self._json({"error": "not found"}, 404)
+
+        def do_DELETE(self) -> None:
+            # k8s dialect: eviction is a pod DELETE (defaultEvictor,
+            # cache.go:125-144).
+            url = urlparse(self.path)
+            k8s = self._k8s_parts(url.path)
+            if k8s is not None:
+                with state.lock:
+                    state.k8s_calls += 1
+                ns, resource, name, sub = k8s
+                if resource == "pods" and name and sub is None:
+                    self._do_evict(ns, name)
+                    return
+            self._json({"error": "not found"}, 404)
+
+        def do_PATCH(self) -> None:
+            """k8s dialect status writes: pod status subresource, PodGroup
+            CRD status subresource, and PVC annotation patches (the volume
+            binder's assume/bind shapes)."""
+            url = urlparse(self.path)
+            body = self._body()
+            k8s = self._k8s_parts(url.path)
+            if k8s is not None:
+                with state.lock:
+                    state.k8s_calls += 1
+                ns, resource, name, sub = k8s
+                if resource == "pods" and sub == "status":
+                    conds = (body.get("status") or {}).get("conditions", [])
+                    with state.lock:
+                        for c in conds:
+                            state.status_updates.append({
+                                "namespace": ns, "name": name,
+                                "type": c.get("type", ""),
+                                "status": c.get("status", ""),
+                                "reason": c.get("reason", ""),
+                                "message": c.get("message", ""),
+                            })
+                    self._json({"ok": True})
+                    return
+                if resource == "persistentvolumeclaims" and name:
+                    ann = (body.get("metadata") or {}).get("annotations", {})
+                    node = ann.get("volume.kubernetes.io/selected-node")
+                    if node:
+                        self._do_allocate_volumes(node, [name])
+                        return
+                    if ann.get("pv.kubernetes.io/bind-completed") == "yes":
+                        self._do_bind_volumes([name])
+                        return
+                    self._json({"error": "unknown PVC patch"}, 400)
+                    return
+                self._json({"error": "not found"}, 404)
+                return
+            # CRD status: /apis/scheduling.incubator.k8s.io/v1alpha1/
+            #             namespaces/{ns}/podgroups/{name}/status
+            parts = url.path.strip("/").split("/")
+            if (
+                len(parts) == 8
+                and parts[0] == "apis"
+                and parts[1] == "scheduling.incubator.k8s.io"
+                and parts[3] == "namespaces"
+                and parts[5] == "podgroups"
+                and parts[7] == "status"
+            ):
+                ns, name = parts[4], parts[6]
+                status = body.get("status") or {}
+                with state.lock:
+                    state.k8s_calls += 1
+                    state.status_updates.append({
+                        "namespace": ns, "name": name,
+                        "phase": status.get("phase", ""),
+                        "conditions": status.get("conditions", []),
+                    })
+                    key = f"{ns}/{name}"
+                    pg = state.objects["podgroup"].get(key)
+                    if pg is not None and status.get("phase"):
+                        pg = dict(pg)
+                        if isinstance(pg.get("metadata"), dict):
+                            pg["status"] = dict(pg.get("status", {}))
+                            pg["status"]["phase"] = status["phase"]
+                        else:
+                            pg["phase"] = status["phase"]
+                        state.apply_locked("podgroup", "update", pg)
                 self._json({"ok": True})
                 return
             self._json({"error": "not found"}, 404)

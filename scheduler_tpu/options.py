@@ -39,6 +39,10 @@ class ServerOption:
     # "auto" = all visible chips, or an explicit chip count (TPU-native knob;
     # the reference's 16-worker sweep parallelism takes this slot).
     mesh: str = "1"
+    # Outbound wire dialect for --api-server: "k8s" (real Kubernetes API
+    # shapes — pods/binding POSTs, pod DELETEs, status PATCHes) or "legacy"
+    # (the compact bespoke JSON RPCs).
+    api_dialect: str = "k8s"
 
 
 # The reference keeps a mutable global the cache reads back
@@ -114,6 +118,7 @@ def option_from_namespace(ns: argparse.Namespace) -> ServerOption:
         io_workers=ns.io_workers,
         profile_dir=ns.profile_dir,
         mesh=ns.mesh,
+        api_dialect=getattr(ns, "api_dialect", "k8s"),
     )
 
 

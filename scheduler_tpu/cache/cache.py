@@ -758,6 +758,95 @@ class SchedulerCache(Cache):
                 if task is not None:
                     self._resync_failed_bind(task, hostname)
 
+    def evict_bulk(self, tis, reason: str):
+        """Batched ``evict``: ONE mutex hold for the whole batch's local
+        bookkeeping — per-job status-row writes, one releasing-add per node —
+        then the eviction RPCs dispatch in worker-sized chunks with a single
+        batched Evict event emission per chunk (the binds got this treatment
+        in rounds 3-4; evictions still walked task-by-task).  Per-RPC failure
+        keeps ``do_evict``'s exact semantics: resync the pod from the system
+        of record, else restore RUNNING locally.  Returns the input tasks
+        that were found in the cache (RPC failures self-repair async, as the
+        reference's fire-and-forget eviction goroutines do)."""
+        found = []
+        with self.mutex:
+            slow = []  # cache status changed since the session snapshot
+            for ti in tis:
+                try:
+                    job, task = self._find_job_and_task(ti)
+                except KeyError:
+                    logger.warning("evict_bulk: task %s not in cache", ti.uid)
+                    continue
+                found.append((job, task, ti))
+                if task.status != TaskStatus.RUNNING:
+                    slow.append((job, task))
+            slow_ids = {id(t) for _, t in slow}
+            fast = [(j, t) for j, t, _ in found if id(t) not in slow_ids]
+            rows_by_job: dict = {}
+            for job, task in fast:
+                entry = rows_by_job.setdefault(id(job), (job, []))
+                entry[1].append(job.store.row_of[task.uid])
+            for job, rows in rows_by_job.values():
+                job.bulk_update_status_rows(
+                    np.asarray(rows, dtype=np.int64),
+                    TaskStatus.RELEASING,
+                    assume_from=TaskStatus.RUNNING,
+                )
+            tasks_by_node: dict = {}
+            for _, task in fast:
+                if task.node_name and task.node_name in self.nodes:
+                    tasks_by_node.setdefault(task.node_name, []).append(task)
+            for name, ts in tasks_by_node.items():
+                self.nodes[name].bulk_release_tasks(ts, strict=False)
+            # A victim whose LIVE cache status moved between the session
+            # snapshot and this commit (informer event: e.g. a deletion
+            # already marked it RELEASING) takes the generic transition the
+            # per-task evict used — correct for any prior status.
+            for job, task in slow:
+                job.update_task_status(task, TaskStatus.RELEASING)
+                if task.node_name and task.node_name in self.nodes:
+                    node = self.nodes[task.node_name]
+                    if task.uid in node.tasks:
+                        node.update_task(task)
+        if not found:
+            return []
+        chunk = max(16, min(self._BIND_CHUNK, -(-len(found) // self._IO_WORKERS)))
+        for start in range(0, len(found), chunk):
+            self._submit_io(self._evict_rpc_batch(found[start:start + chunk], reason))
+        return [ti for _, _, ti in found]
+
+    def _evict_rpc_batch(self, batch, reason: str):
+        """The RPC half of ``evict_bulk`` for one chunk, run on the IO pool."""
+
+        def run() -> None:
+            emitted = []
+            for _job, task, ti in batch:
+                try:
+                    self.evictor.evict(task.pod)
+                except Exception:
+                    logger.exception("evict of %s failed; resyncing", task.uid)
+                    if self._sync_pod_via_client(task.namespace, task.name):
+                        continue
+                    with self.mutex:
+                        try:
+                            job2, task2 = self._find_job_and_task(ti)
+                        except KeyError:
+                            continue
+                        job2.update_task_status(task2, TaskStatus.RUNNING)
+                        if task2.node_name and task2.node_name in self.nodes:
+                            node2 = self.nodes[task2.node_name]
+                            if task2.uid in node2.tasks:
+                                node2.update_task(task2)
+                    continue
+                emitted.append((task.pod, task.node_name))
+            if emitted:
+                self._pod_event_batch(
+                    emitted, "Normal", "Evict",
+                    lambda p, h: f"Evicted pod {p.namespace}/{p.name} ({reason})",
+                )
+
+        return run
+
     def evict(self, ti: TaskInfo, reason: str) -> None:
         """Mark releasing locally, then dispatch the eviction asynchronously."""
         with self.mutex:

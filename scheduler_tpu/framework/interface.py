@@ -60,6 +60,10 @@ class EventHandler:
     allocate_func: Optional[Callable[[Event], None]] = None
     deallocate_func: Optional[Callable[[Event], None]] = None
     bulk_allocate_func: Optional[Callable[..., None]] = None  # (tasks, plan=None)
+    # Bulk mirror for evictions (preempt/reclaim commit batches of victims):
+    # one call with the task list, state-equivalent to folding
+    # deallocate_func over per-task Events.
+    bulk_deallocate_func: Optional[Callable[..., None]] = None  # (tasks)
 
 
 @dataclass

@@ -141,11 +141,25 @@ class DrfPlugin(Plugin):
                 attr.allocated.add_array(*sum_rows(reqs))
                 self._update_share(attr)
 
+        def on_deallocate_bulk(tasks) -> None:
+            # Vectorized fold of on_deallocate: one dense sum per job, one
+            # share recompute (evictions arrive in per-commit batches).
+            from scheduler_tpu.api.resource import sum_rows
+
+            rows_by_job: Dict[str, list] = {}
+            for task in tasks:
+                rows_by_job.setdefault(task.job, []).append(task.resreq)
+            for job_uid, reqs in rows_by_job.items():
+                attr = self.job_attrs[job_uid]
+                attr.allocated.sub_array(sum_rows(reqs)[0])
+                self._update_share(attr)
+
         ssn.add_event_handler(
             EventHandler(
                 allocate_func=on_allocate,
                 deallocate_func=on_deallocate,
                 bulk_allocate_func=on_allocate_bulk,
+                bulk_deallocate_func=on_deallocate_bulk,
             )
         )
 

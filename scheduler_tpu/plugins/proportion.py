@@ -277,11 +277,26 @@ class ProportionPlugin(Plugin):
                 attr.allocated.add_array(*sum_rows(reqs))
                 self._update_share(attr)
 
+        def on_deallocate_bulk(tasks) -> None:
+            # One dense sum per queue, one share recompute (state-equivalent
+            # to folding on_deallocate over the tasks).
+            from scheduler_tpu.api.resource import sum_rows
+
+            rows_by_queue: Dict[str, list] = {}
+            for task in tasks:
+                queue_uid = ssn.jobs[task.job].queue
+                rows_by_queue.setdefault(queue_uid, []).append(task.resreq)
+            for queue_uid, reqs in rows_by_queue.items():
+                attr = self.queue_attrs[queue_uid]
+                attr.allocated.sub_array(sum_rows(reqs)[0])
+                self._update_share(attr)
+
         ssn.add_event_handler(
             EventHandler(
                 allocate_func=on_allocate,
                 deallocate_func=on_deallocate,
                 bulk_allocate_func=on_allocate_bulk,
+                bulk_deallocate_func=on_deallocate_bulk,
             )
         )
 

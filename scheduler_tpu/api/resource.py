@@ -250,7 +250,14 @@ class ResourceVec:
         stands in for many ``add`` calls)."""
         self._sync()
         self._arr += arr
-        self.has_scalars = self.has_scalars or has_scalars or bool(np.any(arr[2:] != 0.0))
+        # Scalar-presence probe only when scalar dims EXIST: the common
+        # cpu/memory-only vocab otherwise pays a numpy reduction over an
+        # empty slice per call (~3us x thousands of bulk-commit calls).
+        self.has_scalars = (
+            self.has_scalars
+            or has_scalars
+            or (arr.shape[0] > 2 and bool(np.any(arr[2:] != 0.0)))
+        )
         return self
 
     def sub_array(self, arr: np.ndarray) -> "ResourceVec":

@@ -638,12 +638,16 @@ class SchedulerCache(Cache):
                 ]
                 self.bind_bulk(tasks, None)
                 return
+            from scheduler_tpu.api.job_info import batch_update_status_rows
+
+            # Engine rows are unique per job, the gen match proves no drift
+            # (every row is PENDING) — one native scatter for the whole batch.
+            batch_update_status_rows([
+                (cjob, rows, TaskStatus.BINDING, job_rows.get(cjob.uid),
+                 TaskStatus.PENDING)
+                for cjob, rows, _names, _ids in resolved
+            ])
             for cjob, rows, names, _ids in resolved:
-                cjob.bulk_update_status_rows(
-                    rows, TaskStatus.BINDING, net_add=job_rows.get(cjob.uid),
-                    assume_unique=True,  # engine rows: one placement per row
-                    assume_from=TaskStatus.PENDING,  # gen match proves no drift
-                )
                 cjob.set_node_names_rows(rows, names)
             # Per-node batches via ONE stable integer argsort across the whole
             # batch; each group's name resolves from its first member.
@@ -652,6 +656,7 @@ class SchedulerCache(Cache):
                 if resolved
                 else np.zeros(0, dtype=np.int32)
             )
+            names_all = cores_all = None
             if ids_all.shape[0]:
                 names_all = np.concatenate([names for _, _, names, _ in resolved])
                 cores_all = np.concatenate(
@@ -699,25 +704,26 @@ class SchedulerCache(Cache):
                             (row, None, row, count, 0),
                         )
 
-        # Chunk against the WHOLE batch: with many jobs there is already
-        # ample parallelism, and per-job sizing degenerates to floor-size
-        # chunks (1000 jobs x 100 rows -> 7000 submissions of 16).
-        total = sum(len(rows) for _cjob, rows, _names, _ids in resolved)
+        # Chunk against the WHOLE batch, spanning job boundaries: per-job
+        # chunking degenerates to one submission per job (1000 jobs x 100
+        # rows), and the fixed per-chunk cost (submit, tolist, mutex) is what
+        # the chunking exists to amortize.  The flats are the node-grouping
+        # pass's own (pre-argsort) concatenations, built once per batch.
+        if cores_all is None:
+            return
+        total = ids_all.shape[0]
         chunk = max(16, min(self._BIND_CHUNK, -(-total // self._IO_WORKERS)))
-        for cjob, rows, names, _ids in resolved:
-            n = len(rows)
-            for start in range(0, n, chunk):
-                self._submit_io(
-                    self._bind_chunk_columnar,
-                    cjob,
-                    rows[start : start + chunk],
-                    names[start : start + chunk],
-                )
+        for start in range(0, total, chunk):
+            self._submit_io(
+                self._bind_chunk_columnar,
+                cores_all[start : start + chunk],
+                names_all[start : start + chunk],
+            )
 
-    def _bind_chunk_columnar(self, cjob, rows, names) -> None:
+    def _bind_chunk_columnar(self, cores_arr, names) -> None:
         from scheduler_tpu.cache.interface import BulkBindError
 
-        cores = cjob.store.cores[rows].tolist()
+        cores = cores_arr.tolist()
         names_l = names.tolist()
         failed_uids = set()
         try:
@@ -753,7 +759,10 @@ class SchedulerCache(Cache):
                     continue
                 logger.error("bind of %s to %s failed; resyncing", core.uid, hostname)
                 with self.mutex:
-                    row = cjob.store.row_of.get(core.uid)
+                    cjob = self.jobs.get(core.job)
+                    row = (
+                        cjob.store.row_of.get(core.uid) if cjob is not None else None
+                    )
                     task = cjob.view_for_row(row) if row is not None else None
                 if task is not None:
                     self._resync_failed_bind(task, hostname)

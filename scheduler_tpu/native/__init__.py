@@ -111,6 +111,13 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.decode_placement_codes.restype = i64
     lib.run_lengths_i32.argtypes = [f64p, f64p, i32p, i64, i64, i32p]
     lib.run_lengths_i32.restype = None
+    u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i16p = np.ctypeslib.ndpointer(np.int16, flags="C_CONTIGUOUS")
+    lib.batch_status_scatter.argtypes = [
+        i64, u64p, i64p, i64p, i16p, i16p, ctypes.c_int32,
+    ]
+    lib.batch_status_scatter.restype = i64
     _lib = lib
     return _lib
 
@@ -218,3 +225,38 @@ def run_lengths(resreq: np.ndarray, init_resreq: np.ndarray, job_idx: np.ndarray
     ends = np.cumsum(counts) - 1
     out[:] = (ends[gid] - np.arange(t) + 1).astype(np.int32)
     return out
+
+
+def batch_status_scatter(
+    status_arrays, rows_flat: np.ndarray, offsets: np.ndarray,
+    from_vals: np.ndarray, to_vals: np.ndarray, check: bool,
+) -> int:
+    """Write group k's new status over rows ``rows_flat[offsets[k]:offsets[k+1]]``
+    of ``status_arrays[k]`` (int16, C-contiguous).  Returns the first group
+    whose prior values violated ``from_vals[k]`` when ``check`` (else -1).
+    One flat pass over every job's placement rows — the native half of
+    ``job_info.batch_update_status_rows``."""
+    n = len(status_arrays)
+    if n == 0:
+        return -1
+    rows_flat = np.ascontiguousarray(rows_flat, dtype=np.int64)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    from_vals = np.ascontiguousarray(from_vals, dtype=np.int16)
+    to_vals = np.ascontiguousarray(to_vals, dtype=np.int16)
+    lib = _load()
+    if lib is not None:
+        addrs = np.fromiter(
+            (a.ctypes.data for a in status_arrays), dtype=np.uint64, count=n
+        )
+        return int(lib.batch_status_scatter(
+            n, addrs, rows_flat, offsets, from_vals, to_vals,
+            1 if check else 0,
+        ))
+    bad = -1
+    for k in range(n):
+        rows = rows_flat[offsets[k]:offsets[k + 1]]
+        st = status_arrays[k]
+        if check and bad < 0 and not bool(np.all(st[rows] == from_vals[k])):
+            bad = k
+        st[rows] = to_vals[k]
+    return bad

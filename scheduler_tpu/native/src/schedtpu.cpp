@@ -110,4 +110,30 @@ void run_lengths_i32(const double* resreq, const double* init_resreq,
     }
 }
 
+// Batched status scatter over MANY job stores: for group k, the rows
+// rows[offs[k]..offs[k+1]) of the int16 status column at addrs[k] are set to
+// to_vals[k].  With check != 0 a row whose PRIOR value differs from
+// from_vals[k] flags its group; the first flagged group index returns
+// (-1 = clean) so the caller can raise under PANIC_ON_ERROR.  This is the
+// apply phase's ~2000 per-job bulk_update_status_rows calls collapsed into
+// one flat pass (the reference's per-task session bookkeeping slot,
+// session.go:242-297).
+int64_t batch_status_scatter(int64_t n_groups, const uint64_t* addrs,
+                             const int64_t* rows, const int64_t* offs,
+                             const int16_t* from_vals, const int16_t* to_vals,
+                             int32_t check) {
+    int64_t bad = -1;
+    for (int64_t k = 0; k < n_groups; ++k) {
+        int16_t* st = reinterpret_cast<int16_t*>(static_cast<uintptr_t>(addrs[k]));
+        const int16_t to = to_vals[k];
+        const int16_t from = from_vals[k];
+        for (int64_t i = offs[k]; i < offs[k + 1]; ++i) {
+            const int64_t r = rows[i];
+            if (check && bad < 0 && st[r] != from) bad = k;
+            st[r] = to;
+        }
+    }
+    return bad;
+}
+
 }  // extern "C"

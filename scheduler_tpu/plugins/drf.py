@@ -13,6 +13,8 @@ import logging
 import math
 from typing import Dict
 
+import numpy as np
+
 from scheduler_tpu.api.job_info import JobInfo, TaskInfo
 from scheduler_tpu.api.resource import ResourceVec, share as share_fn
 from scheduler_tpu.framework.arguments import Arguments
@@ -36,17 +38,32 @@ class DrfPlugin(Plugin):
         self.arguments = arguments
         self.total_resource: ResourceVec = None  # type: ignore[assignment]
         self.job_attrs: Dict[str, _DrfAttr] = {}
+        self._share_mask = None  # memoized participating-dims mask
 
     def name(self) -> str:
         return "drf"
 
     def _calculate_share(self, allocated: ResourceVec) -> float:
-        res = 0.0
-        for rn in self.total_resource.resource_names():
-            s = share_fn(allocated.get(rn), self.total_resource.get(rn))
-            if s > res:
-                res = s
-        return res
+        """Dominant share, vectorized over the total's participating dims
+        (cpu, memory, nonzero scalars): bit-equivalent to folding share_fn
+        over ``resource_names()`` — same division, same 0-total convention —
+        without per-name string lookups (~8us x jobs per commit)."""
+        tot = self.total_resource.array
+        mask = self._share_mask
+        if mask is None or mask.shape[0] != tot.shape[0]:
+            mask = np.zeros(tot.shape[0], dtype=bool)
+            mask[:2] = True
+            mask[2:] = tot[2:] != 0.0
+            self._share_mask = mask
+        a = np.zeros(tot.shape[0])
+        arr = allocated.array
+        n = min(arr.shape[0], tot.shape[0])
+        a[:n] = arr[:n]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            fr = np.where(tot > 0.0, a / np.where(tot > 0.0, tot, 1.0),
+                          (a != 0.0).astype(np.float64))
+        fr = fr[mask]
+        return float(fr.max()) if fr.shape[0] else 0.0
 
     def _update_share(self, attr: _DrfAttr) -> None:
         attr.share = self._calculate_share(attr.allocated)
@@ -166,6 +183,7 @@ class DrfPlugin(Plugin):
     def on_session_close(self, ssn) -> None:
         self.total_resource = None  # type: ignore[assignment]
         self.job_attrs = {}
+        self._share_mask = None  # totals change between sessions
 
 
 def new(arguments: Arguments) -> DrfPlugin:

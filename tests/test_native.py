@@ -142,3 +142,55 @@ def test_fallback_paths_match_native(rng, monkeypatch):
     np.testing.assert_array_equal(native_sum, fb_sum)
     for a, b in zip(native_dec, fb_dec):
         np.testing.assert_array_equal(a, b)
+
+
+def test_batch_status_scatter_native_matches_fallback(monkeypatch):
+    """The batched status scatter (round 5: the apply phase's ~2000 per-job
+    bulk_update_status_rows calls as one flat pass) — native and numpy
+    fallback must agree on writes and on violation detection."""
+    import importlib
+
+    import numpy as np
+
+    from scheduler_tpu import native
+
+    def run(disable_native):
+        if disable_native:
+            monkeypatch.setenv("SCHEDULER_TPU_NATIVE", "0")
+        else:
+            monkeypatch.delenv("SCHEDULER_TPU_NATIVE", raising=False)
+        importlib.reload(native)
+        rng = np.random.default_rng(3)
+        arrays = [
+            np.full(32, 1, dtype=np.int16),
+            np.full(8, 1, dtype=np.int16),
+            np.full(64, 1, dtype=np.int16),
+        ]
+        rows = [
+            rng.choice(32, size=10, replace=False).astype(np.int64),
+            np.asarray([2], dtype=np.int64),
+            rng.choice(64, size=20, replace=False).astype(np.int64),
+        ]
+        offsets = np.asarray([0, 10, 11, 31], dtype=np.int64)
+        flat = np.concatenate(rows)
+        bad = native.batch_status_scatter(
+            arrays, flat, offsets,
+            np.asarray([1, 1, 1], dtype=np.int16),
+            np.asarray([8, 4, 16], dtype=np.int16), True,
+        )
+        assert bad == -1
+        # violation detection: array 1 no longer holds the expected value
+        bad2 = native.batch_status_scatter(
+            [arrays[1]], rows[1], np.asarray([0, 1], dtype=np.int64),
+            np.asarray([1], dtype=np.int16),
+            np.asarray([9], dtype=np.int16), True,
+        )
+        assert bad2 == 0
+        return [a.copy() for a in arrays]
+
+    native_out = run(False)
+    fallback_out = run(True)
+    for a, b in zip(native_out, fallback_out):
+        assert np.array_equal(a, b)
+    monkeypatch.delenv("SCHEDULER_TPU_NATIVE", raising=False)
+    importlib.reload(native)

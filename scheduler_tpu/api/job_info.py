@@ -961,30 +961,10 @@ class JobInfo:
                 )
             if from_val == new_val:
                 return
-            n = rows.shape[0]
-            was_alloc = bool(from_val & _ALLOC_BITS)
-            now_alloc = bool(new_val & _ALLOC_BITS)
-            if was_alloc and not now_alloc:
-                if net_add is not None:
-                    raise ValueError(
-                        "net_add given but batch contains an allocated->non-allocated transition"
-                    )
-                req, _, _ = self.request_matrices()
-                self.allocated.sub_array(self._pad_row(req[rows].sum(axis=0)))
-            elif now_alloc and not was_alloc:
-                if net_add is not None:
-                    self.allocated.add_array(self._pad_row(net_add))
-                else:
-                    req, _, _ = self.request_matrices()
-                    self.allocated.add_array(
-                        self._pad_row(req[rows].sum(axis=0)),
-                        bool(st.has_scalars[rows].any()),
-                    )
             st.status[rows] = new_val
-            st.status_gen += 1
-            self._count_add(from_val, -n)
-            self._count_add(new_val, n)
-            self._index = None  # rebuilt lazily; views stay valid
+            self._apply_batched_status_bookkeeping(
+                rows.shape[0], from_val, new_val, net_add, rows
+            )
             return
         if len(rows) == 1:
             # Scalar fast path: thousands of single-task (shadow-PodGroup)

@@ -23,6 +23,7 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
+import shutil
 import subprocess
 import tempfile
 from typing import Optional, Tuple
@@ -96,6 +97,45 @@ def _load() -> Optional[ctypes.CDLL]:
         logger.warning("failed to load %s: %s; using numpy fallbacks", path, exc)
         return None
 
+    try:
+        _bind_signatures(lib)
+    except AttributeError as exc:
+        # A pre-existing .so from an older source revision can pass build()'s
+        # mtime probe (cp -a/rsync-preserved checkouts, stripped installs)
+        # while lacking newer entry points.  Rebuild once; degrade to the
+        # numpy fallbacks rather than raise out of _load().
+        logger.warning("%s is stale (%s); rebuilding", path, exc)
+        path = build(force=True)
+        if path is None:
+            return None
+        tmp = None
+        try:
+            # dlopen caches handles by path — CDLL(path) would hand back the
+            # stale library just rebuilt over.  Load through a fresh temp copy
+            # (safe to unlink once loaded on Linux).
+            fd, tmp = tempfile.mkstemp(suffix=".so")
+            os.close(fd)
+            shutil.copy(path, tmp)
+            lib = ctypes.CDLL(tmp)
+            _bind_signatures(lib)
+        except (OSError, AttributeError) as exc2:
+            logger.warning(
+                "rebuilt %s still unusable (%s); using numpy fallbacks", path, exc2
+            )
+            return None
+        finally:
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+    _lib = lib
+    return _lib
+
+
+def _bind_signatures(lib: ctypes.CDLL) -> None:
+    """Declare every entry point's signature; raises AttributeError when the
+    loaded library predates one of them."""
     i64 = ctypes.c_int64
     f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
     i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
@@ -118,8 +158,6 @@ def _load() -> Optional[ctypes.CDLL]:
         i64, u64p, i64p, i64p, i16p, i16p, ctypes.c_int32,
     ]
     lib.batch_status_scatter.restype = i64
-    _lib = lib
-    return _lib
 
 
 def available() -> bool:

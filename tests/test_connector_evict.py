@@ -257,3 +257,55 @@ def test_volume_claims_cross_the_wire(wire):
         return entry is not None and entry["bound"] and entry["node"] == node
 
     _wait(bound_with_volume, what="claim data-0 allocated+bound on vol-0's node")
+
+
+def test_volume_allocate_failure_fails_only_that_task(wire):
+    """An AllocateVolumes failure fails ONLY the claim-carrying task's
+    placement (reference session.go:242-247, cache.go:189-209): its claim-free
+    siblings in the same job bind in the same cycles, the failed task stays
+    Pending on the server under a standing fault, and once the fault clears a
+    later cycle allocates the claim and binds the pod.  The claim-bearing job
+    takes the fused engine's host-loop detour (allocate.py split_dynamic) —
+    this exercises that detour over the real wire, with the server's PVC
+    ledger as the observable."""
+    _add("node", {"name": "vol-node", "allocatable": {
+        "cpu": 8000, "memory": 8 * 2**30, "pods": 110}})
+    # Effectively-infinite fault budget: the daemon retries every 0.2s cycle
+    # and may probe several candidate nodes per attempt; a finite budget could
+    # exhaust under CI load and bind vf-pvc before the clear step below.
+    _post("/inject", {"op": "allocate-volumes", "times": 10**9})
+    _add("podgroup", {"name": "vf", "queue": "default", "minMember": 1,
+                      "phase": "Inqueue"})
+    _add("pod", {"name": "vf-pvc", "group": "vf",
+                 "volumeClaims": ["claim-vf"],
+                 "containers": [{"cpu": 100, "memory": 2**27}]})
+    for i in range(4):
+        _add("pod", {"name": f"vf-{i}", "group": "vf",
+                     "containers": [{"cpu": 100, "memory": 2**27}]})
+
+    def siblings_bound():
+        pods = _server_pods()
+        return all(pods.get(f"vf-{i}", {}).get("nodeName") for i in range(4))
+
+    _wait(siblings_bound, what="claim-free vf siblings bound under the fault")
+    # Several more schedule periods under the standing fault: the failure must
+    # stay per-task — the PVC pod keeps retrying and keeps failing while
+    # nothing else regresses.
+    time.sleep(1.5)
+    assert siblings_bound()
+    assert not _server_pods().get("vf-pvc", {}).get("nodeName"), \
+        "PVC pod bound despite AllocateVolumes failing"
+    assert "claim-vf" not in _get("/volumes")
+
+    # Fault clears -> a later cycle allocates the claim and dispatches the pod.
+    _post("/inject", {"op": "allocate-volumes", "times": 0})
+
+    def pvc_bound():
+        pods = _server_pods()
+        node = pods.get("vf-pvc", {}).get("nodeName")
+        if not node:
+            return False
+        entry = _get("/volumes").get("claim-vf")
+        return entry is not None and entry["bound"] and entry["node"] == node
+
+    _wait(pvc_bound, what="vf-pvc bound with claim-vf on its node after heal")

@@ -148,18 +148,19 @@ def test_batch_status_scatter_native_matches_fallback(monkeypatch):
     """The batched status scatter (round 5: the apply phase's ~2000 per-job
     bulk_update_status_rows calls as one flat pass) — native and numpy
     fallback must agree on writes and on violation detection."""
-    import importlib
-
     import numpy as np
 
     from scheduler_tpu import native
 
+    if not native.available():
+        pytest.skip("native library unavailable: parity would be vacuous")
+
     def run(disable_native):
         if disable_native:
-            monkeypatch.setenv("SCHEDULER_TPU_NATIVE", "0")
-        else:
-            monkeypatch.delenv("SCHEDULER_TPU_NATIVE", raising=False)
-        importlib.reload(native)
+            # monkeypatch auto-restores: no env/reload, no state leaked into
+            # later tests regardless of the operator's SCHEDULER_TPU_NATIVE.
+            monkeypatch.setattr(native, "_lib", None)
+            monkeypatch.setattr(native, "_tried", True)  # _load() -> None
         rng = np.random.default_rng(3)
         arrays = [
             np.full(32, 1, dtype=np.int16),
@@ -192,5 +193,3 @@ def test_batch_status_scatter_native_matches_fallback(monkeypatch):
     fallback_out = run(True)
     for a, b in zip(native_out, fallback_out):
         assert np.array_equal(a, b)
-    monkeypatch.delenv("SCHEDULER_TPU_NATIVE", raising=False)
-    importlib.reload(native)

@@ -112,8 +112,11 @@ def _load() -> Optional[ctypes.CDLL]:
         try:
             # dlopen caches handles by path — CDLL(path) would hand back the
             # stale library just rebuilt over.  Load through a fresh temp copy
-            # (safe to unlink once loaded on Linux).
-            fd, tmp = tempfile.mkstemp(suffix=".so")
+            # (safe to unlink once loaded on Linux).  The copy lives next to
+            # the library, not TMPDIR: /tmp may be mounted noexec.
+            fd, tmp = tempfile.mkstemp(
+                suffix=".so", dir=os.path.dirname(path)
+            )
             os.close(fd)
             shutil.copy(path, tmp)
             lib = ctypes.CDLL(tmp)

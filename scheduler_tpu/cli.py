@@ -186,7 +186,20 @@ def run(opt: ServerOption, stop: Optional[threading.Event] = None,
 
     try:
         if opt.enable_leader_election:
-            LeaderElector(opt.lock_file).run(lead, stop)
+            if api_server:
+                # The lock lives in the system of record (the reference's
+                # ConfigMap resource lock, server.go:111-152): a
+                # coordination.k8s.io Lease CAS'd on resourceVersion, so
+                # standbys on other hosts contend correctly.  The file lease
+                # only provides HA between schedulers sharing a disk.
+                from scheduler_tpu.utils.leaderelection import ApiLeaseLock
+
+                elector = LeaderElector(
+                    lock=lambda ident: ApiLeaseLock(api_server, identity=ident)
+                )
+            else:
+                elector = LeaderElector(opt.lock_file)
+            elector.run(lead, stop)
         else:
             lead(stop)
     finally:

@@ -53,6 +53,11 @@ class MockState:
         # conflicts (volume topology), which the scheduler must surface as a
         # failed allocation.
         self.volumes: Dict[str, Dict] = {}
+        # coordination.k8s.io Lease objects (leader election): "ns/name" ->
+        # full Lease doc.  Writes CAS on metadata.resourceVersion the way the
+        # real API server does — the seam ApiLeaseLock locks through.
+        self.leases: Dict[str, Dict] = {}
+        self.lease_rv = 0
 
     @staticmethod
     def key(kind: str, obj: Dict) -> str:
@@ -194,6 +199,15 @@ def make_handler(state: MockState):
                 with state.lock:
                     self._json({"events": list(state.event_log)})
                 return
+            lease = self._lease_parts(url.path)
+            if lease is not None and lease[1] is not None:
+                with state.lock:
+                    doc = state.leases.get(f"{lease[0]}/{lease[1]}")
+                if doc is None:
+                    self._json({"error": "not found"}, 404)
+                else:
+                    self._json(doc)
+                return
             self._json({"error": "not found"}, 404)
 
         # -- shared mutation bodies (both dialects route here) ---------------
@@ -291,9 +305,63 @@ def make_handler(state: MockState):
                 )
             return None
 
+        @staticmethod
+        def _lease_parts(path: str):
+            # /apis/coordination.k8s.io/v1/namespaces/{ns}/leases[/{name}]
+            parts = path.strip("/").split("/")
+            if (
+                len(parts) >= 6 and parts[0] == "apis"
+                and parts[1] == "coordination.k8s.io"
+                and parts[3] == "namespaces" and parts[5] == "leases"
+            ):
+                return parts[4], parts[6] if len(parts) > 6 else None
+            return None
+
+        def _do_lease_write(self, ns: str, name: str, body: Dict,
+                            create: bool) -> None:
+            """Create (POST, 409 when present) or CAS-update (PUT, 409 on a
+            stale resourceVersion) one Lease — client-go resourcelock's
+            server half."""
+            key = f"{ns}/{name}"
+            with state.lock:
+                existing = state.leases.get(key)
+                if create and existing is not None:
+                    self._json({"error": "already exists"}, 409)
+                    return
+                if not create:
+                    if existing is None:
+                        self._json({"error": "not found"}, 404)
+                        return
+                    sent_rv = (body.get("metadata") or {}).get("resourceVersion")
+                    live_rv = existing["metadata"].get("resourceVersion")
+                    if sent_rv != live_rv:
+                        self._json({"error": "resourceVersion conflict"}, 409)
+                        return
+                state.lease_rv += 1
+                doc = {
+                    "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                    "metadata": {
+                        "name": name, "namespace": ns,
+                        "resourceVersion": str(state.lease_rv),
+                    },
+                    "spec": dict(body.get("spec") or {}),
+                }
+                state.leases[key] = doc
+                self._json(doc, 201 if create else 200)
+
         def do_POST(self) -> None:
             url = urlparse(self.path)
             body = self._body()
+            lease = self._lease_parts(url.path)
+            if lease is not None:
+                ns, name = lease
+                if name is None:  # POST to the collection creates
+                    name = (body.get("metadata") or {}).get("name", "")
+                if not name:
+                    self._json({"error": "lease needs a name"}, 422)
+                    return
+                self._do_lease_write(ns, name, body, create=True)
+                return
             # --- k8s dialect: POST pods/{name}/binding, POST events ---------
             k8s = self._k8s_parts(url.path)
             if k8s is not None:
@@ -389,10 +457,28 @@ def make_handler(state: MockState):
                 return
             self._json({"error": "not found"}, 404)
 
+        def do_PUT(self) -> None:
+            # Lease renew/takeover: CAS'd on resourceVersion.
+            url = urlparse(self.path)
+            lease = self._lease_parts(url.path)
+            if lease is not None and lease[1] is not None:
+                self._do_lease_write(
+                    lease[0], lease[1], self._body(), create=False
+                )
+                return
+            self._json({"error": "not found"}, 404)
+
         def do_DELETE(self) -> None:
             # k8s dialect: eviction is a pod DELETE (defaultEvictor,
             # cache.go:125-144).
             url = urlparse(self.path)
+            lease = self._lease_parts(url.path)
+            if lease is not None and lease[1] is not None:
+                with state.lock:
+                    gone = state.leases.pop(f"{lease[0]}/{lease[1]}", None)
+                self._json({"ok": True} if gone else {"error": "not found"},
+                           200 if gone else 404)
+                return
             k8s = self._k8s_parts(url.path)
             if k8s is not None:
                 with state.lock:

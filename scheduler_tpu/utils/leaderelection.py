@@ -1,11 +1,21 @@
-"""Lease-file leader election: the active/standby analogue.
+"""Leader election: the active/standby analogue, over a pluggable lease lock.
 
-Reference: ``cmd/kube-batch/app/server.go:111-152`` — ConfigMap resource lock,
-LeaseDuration 15s / RenewDeadline 10s / RetryPeriod 5s (:49-51), process exits
-when leadership is lost (:147-149).  The authoritative store here is a lease
-file on shared disk instead of the API server: acquire by atomically writing
-(holder, deadline) when the current lease is absent/expired, renew by
-rewriting before the deadline.
+Reference: ``cmd/kube-batch/app/server.go:111-152`` — the lock is a ConfigMap
+resource lock IN THE SHARED STORE (the API server), LeaseDuration 15s /
+RenewDeadline 10s / RetryPeriod 5s (:49-51), process exits when leadership is
+lost (:147-149).
+
+Two lock backends take that slot here:
+
+* ``ApiLeaseLock`` — a ``coordination.k8s.io/v1`` Lease object in the system
+  of record, compare-and-swapped via ``metadata.resourceVersion`` exactly the
+  way client-go's resourcelock does it.  This is the reference-faithful
+  backend: HA works wherever the API server is reachable.
+* ``FileLeaseLock`` — a lease file on disk; atomic-replace + an
+  O_CREAT|O_EXCL claim file serialize contended acquires.  Only provides HA
+  between schedulers sharing that filesystem (the standalone/daemon-on-one-
+  host mode); deployments fronting an API server get ``ApiLeaseLock``
+  automatically (cli.py).
 """
 
 from __future__ import annotations
@@ -16,7 +26,10 @@ import os
 import socket
 import threading
 import time
+import urllib.error
+import urllib.request
 import uuid
+from datetime import datetime, timezone
 from typing import Callable, Optional
 
 logger = logging.getLogger("scheduler_tpu.leaderelection")
@@ -26,22 +39,14 @@ RENEW_DEADLINE = 10.0
 RETRY_PERIOD = 5.0
 
 
-class LeaderElector:
-    def __init__(
-        self,
-        lock_file: str,
-        identity: Optional[str] = None,
-        lease_duration: float = LEASE_DURATION,
-        renew_deadline: float = RENEW_DEADLINE,
-        retry_period: float = RETRY_PERIOD,
-    ) -> None:
-        self.lock_file = lock_file
-        self.identity = identity or f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
-        self.lease_duration = lease_duration
-        self.renew_deadline = renew_deadline
-        self.retry_period = retry_period
+class FileLeaseLock:
+    """(holder, renewed) lease in a file; see module docstring for scope."""
 
-    # -- lease file ---------------------------------------------------------
+    def __init__(self, lock_file: str, identity: str,
+                 lease_duration: float = LEASE_DURATION) -> None:
+        self.lock_file = lock_file
+        self.identity = identity
+        self.lease_duration = lease_duration
 
     def _read(self) -> Optional[dict]:
         try:
@@ -65,7 +70,7 @@ class LeaderElector:
             and time.time() - float(lease.get("renewed", 0.0)) < self.lease_duration
         )
 
-    def _try_acquire_or_renew(self) -> bool:
+    def try_acquire_or_renew(self) -> bool:
         if self._other_holds_live_lease():
             return False
         lease = self._read()
@@ -98,6 +103,181 @@ class LeaderElector:
                 os.unlink(claim)
             except OSError:
                 pass
+
+    def release(self) -> None:
+        """Drop the lease if still ours so a standby takes over instantly."""
+        lease = self._read()
+        if lease is not None and lease.get("holder") == self.identity:
+            try:
+                os.unlink(self.lock_file)
+            except OSError:
+                pass
+
+
+class ApiLeaseLock:
+    """A ``coordination.k8s.io/v1`` Lease in the API server, CAS'd on
+    ``metadata.resourceVersion`` (client-go resourcelock semantics): create
+    when absent, renew our own, take over an expired one — every write
+    carries the resourceVersion it read, so two standbys observing the same
+    expired lease cannot both win (the second PUT 409s)."""
+
+    def __init__(
+        self,
+        base: str,
+        identity: str,
+        name: str = "scheduler-tpu",
+        namespace: str = "kube-system",
+        lease_duration: float = LEASE_DURATION,
+    ) -> None:
+        self.base = base.rstrip("/")
+        self.identity = identity
+        self.name = name
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.path = (
+            f"/apis/coordination.k8s.io/v1/namespaces/{namespace}"
+            f"/leases/{name}"
+        )
+
+    # -- wire ---------------------------------------------------------------
+
+    def _request(self, method: str, path: str, payload: Optional[dict]):
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            self.base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def _now(self) -> str:
+        return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+    def _spec(self) -> dict:
+        # leaseDurationSeconds is int32 on the real wire; fractional values
+        # (sub-second leases only exist in tests) pass through as-is rather
+        # than truncating to 0 == instantly expired.
+        dur = self.lease_duration
+        return {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(dur) if dur >= 1 else dur,
+            "renewTime": self._now(),
+        }
+
+    def _body(self, resource_version: Optional[str]) -> dict:
+        meta = {"name": self.name, "namespace": self.namespace}
+        if resource_version is not None:
+            meta["resourceVersion"] = resource_version
+        return {
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": meta, "spec": self._spec(),
+        }
+
+    @staticmethod
+    def _expired(spec: dict) -> bool:
+        raw = spec.get("renewTime") or ""
+        try:
+            renewed = datetime.fromisoformat(raw.replace("Z", "+00:00"))
+        except ValueError:
+            return True  # unparseable renewTime == never renewed
+        age = (datetime.now(timezone.utc) - renewed).total_seconds()
+        return age >= float(spec.get("leaseDurationSeconds", LEASE_DURATION))
+
+    # -- lock protocol ------------------------------------------------------
+
+    def try_acquire_or_renew(self) -> bool:
+        try:
+            lease = self._request("GET", self.path, None)
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                logger.warning("lease GET failed: %s", e)
+                return False
+            # Absent: create.  A racing creator 409s us — they lead.
+            try:
+                self._request(
+                    "POST",
+                    self.path.rsplit("/", 1)[0],
+                    self._body(None),
+                )
+                return True
+            except urllib.error.HTTPError as e2:
+                if e2.code != 409:
+                    logger.warning("lease create failed: %s", e2)
+                return False
+            except OSError as e2:
+                # URLError/timeouts: a transient outage must read as "not
+                # leading", never escape into the renew thread (a dead
+                # renewer with lost/stop unset would leave a zombie leader).
+                logger.warning("lease create failed: %s", e2)
+                return False
+        except OSError as e:
+            logger.warning("lease GET failed: %s", e)
+            return False
+
+        spec = lease.get("spec", {})
+        rv = (lease.get("metadata") or {}).get("resourceVersion")
+        holder = spec.get("holderIdentity") or ""
+        if holder and holder != self.identity and not self._expired(spec):
+            return False  # live lease held by another scheduler
+        # empty holder == released lease: immediately acquirable via CAS
+        # Renew our own, or take over an expired one — same CAS'd PUT.
+        try:
+            self._request("PUT", self.path, self._body(rv))
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code != 409:
+                logger.warning("lease update failed: %s", e)
+            return False  # lost the CAS race (or transient server error)
+        except OSError as e:
+            logger.warning("lease update failed: %s", e)
+            return False
+
+    def release(self) -> None:
+        """CAS'd hand-back: blank the holder (client-go's release shape) only
+        if the lease is still ours AT the resourceVersion we read — a plain
+        GET-then-DELETE could destroy a lease a standby took over between the
+        two calls (stalled-leader resume), evicting the new leader."""
+        try:
+            lease = self._request("GET", self.path, None)
+            if lease.get("spec", {}).get("holderIdentity") != self.identity:
+                return
+            rv = (lease.get("metadata") or {}).get("resourceVersion")
+            body = self._body(rv)
+            body["spec"]["holderIdentity"] = ""
+            self._request("PUT", self.path, body)
+        except (urllib.error.HTTPError, OSError):
+            pass  # 409 == someone else took over; nothing to hand back
+
+
+class LeaderElector:
+    """Blocks until the lock is held, runs the workload, exits (fatally, like
+    the reference's OnStoppedLeading) when the lease cannot be renewed."""
+
+    def __init__(
+        self,
+        lock_file: Optional[str] = None,
+        identity: Optional[str] = None,
+        lease_duration: float = LEASE_DURATION,
+        renew_deadline: float = RENEW_DEADLINE,
+        retry_period: float = RETRY_PERIOD,
+        lock=None,
+    ) -> None:
+        self.identity = identity or f"{socket.gethostname()}_{uuid.uuid4().hex[:8]}"
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        if lock is None:
+            if lock_file is None:
+                raise ValueError("LeaderElector needs a lock or a lock_file")
+            lock = FileLeaseLock(lock_file, self.identity, lease_duration)
+        elif callable(lock) and not hasattr(lock, "try_acquire_or_renew"):
+            # Lock factory: identity lives HERE (one generator, lock and
+            # elector logs always agree) — the factory receives it.
+            lock = lock(self.identity)
+        self.lock = lock
+
+    def _try_acquire_or_renew(self) -> bool:
+        return self.lock.try_acquire_or_renew()
 
     # -- run loop (leaderelection.RunOrDie equivalent) -----------------------
 
@@ -143,10 +323,4 @@ class LeaderElector:
         finally:
             stop.set()
             renewer.join(timeout=2.0)
-            # Release the lease if still ours so a standby takes over instantly.
-            lease = self._read()
-            if lease is not None and lease.get("holder") == self.identity:
-                try:
-                    os.unlink(self.lock_file)
-                except OSError:
-                    pass
+            self.lock.release()

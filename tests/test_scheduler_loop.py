@@ -208,3 +208,115 @@ def test_profile_dir_failure_does_not_cost_a_cycle(tmp_path):
     sched.run_once()
     assert cache.binder.binds, "cycle must schedule despite profiler failure"
     assert sched.profile_dir is None, "profiling should disable itself"
+
+
+def _lease_rig(port):
+    from scheduler_tpu.connector.mock_server import serve
+
+    server, state = serve(port)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, state, f"http://127.0.0.1:{port}"
+
+
+def test_api_lease_lock_single_holder():
+    """The connector-backed lock: leadership lives in the system of record
+    (reference: ConfigMap resource lock, server.go:111-152) as a
+    coordination.k8s.io Lease.  Two electors against one mock API server —
+    one leads, the other stands by, takeover on release."""
+    from scheduler_tpu.utils.leaderelection import ApiLeaseLock
+
+    server, _, base = _lease_rig(18293)
+    try:
+        order = []
+
+        def workload(name, hold):
+            def lead(stop_event):
+                order.append(name)
+                hold.wait()
+
+            return lead
+
+        def elector(name):
+            return LeaderElector(
+                identity=name,
+                lease_duration=0.5, renew_deadline=0.3, retry_period=0.05,
+                lock=ApiLeaseLock(base, identity=name, lease_duration=0.5),
+            )
+
+        stop_a, hold_a = threading.Event(), threading.Event()
+        ta = threading.Thread(
+            target=elector("a").run, args=(workload("a", hold_a), stop_a),
+            daemon=True)
+        ta.start()
+        deadline = time.time() + 2.0
+        while time.time() < deadline and "a" not in order:
+            time.sleep(0.01)
+        assert order == ["a"]
+
+        stop_b, hold_b = threading.Event(), threading.Event()
+        tb = threading.Thread(
+            target=elector("b").run, args=(workload("b", hold_b), stop_b),
+            daemon=True)
+        tb.start()
+        time.sleep(0.7)
+        assert order == ["a"]  # standby never led while the lease renewed
+
+        hold_a.set()
+        stop_a.set()  # leader exits -> release DELETEs the lease
+        deadline = time.time() + 3.0
+        while time.time() < deadline and "b" not in order:
+            time.sleep(0.02)
+        assert order == ["a", "b"]
+        hold_b.set()
+        stop_b.set()
+        ta.join(timeout=2)
+        tb.join(timeout=2)
+    finally:
+        server.shutdown()
+
+
+def test_api_lease_cas_prevents_split_brain():
+    """resourceVersion CAS: after expiry the takeover PUT must carry the rv
+    it read — a write against a superseded rv 409s, so two standbys racing
+    over the same expired lease cannot both win."""
+    import json as _json
+    import urllib.request
+
+    from scheduler_tpu.utils.leaderelection import ApiLeaseLock
+
+    server, state, base = _lease_rig(18294)
+    try:
+        lock_a = ApiLeaseLock(base, identity="a", lease_duration=0.2)
+        lock_b = ApiLeaseLock(base, identity="b", lease_duration=0.2)
+        assert lock_a.try_acquire_or_renew()   # create
+        assert not lock_b.try_acquire_or_renew()  # live lease held by a
+        stale = lock_a._request("GET", lock_a.path, None)
+        time.sleep(0.3)  # lease expires
+        assert lock_b.try_acquire_or_renew()   # CAS takeover succeeds
+        assert not lock_a.try_acquire_or_renew()  # b's lease is live now
+
+        # The server half of the CAS: a PUT carrying the superseded rv 409s.
+        body = {
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": {
+                "name": lock_a.name, "namespace": lock_a.namespace,
+                "resourceVersion": stale["metadata"]["resourceVersion"],
+            },
+            "spec": {"holderIdentity": "a", "leaseDurationSeconds": 1,
+                     "renewTime": "2026-01-01T00:00:00.000000Z"},
+        }
+        req = urllib.request.Request(
+            base + lock_a.path, data=_json.dumps(body).encode(),
+            method="PUT", headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=5)
+            raise AssertionError("stale-rv PUT was accepted")
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+        with state.lock:
+            holder = state.leases[
+                f"{lock_a.namespace}/{lock_a.name}"
+            ]["spec"]["holderIdentity"]
+        assert holder == "b"
+    finally:
+        server.shutdown()

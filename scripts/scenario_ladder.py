@@ -50,11 +50,14 @@ def measure(name, factory, conf_str, actions, placed_of, cycles=20,
     """``factory()`` returns a fresh ``(build, churn)`` pair (fresh churn
     state per build).  One throwaway build absorbs the jit compile; the
     recorded runs hit the compile cache like the steady scheduler loop."""
+    from scheduler_tpu.harness.measure import link_probe
+
     conf = parse_scheduler_conf(conf_str)
     build0, _ = factory()
     steady_cycle(build0(), conf, actions)  # compile pass, unrecorded
     build, churn = factory()
     cache = build()
+    probe_before = link_probe()
     full_s = steady_cycle(cache, conf, actions)
     placed_full = placed_of(cache)
     rec = {
@@ -62,6 +65,10 @@ def measure(name, factory, conf_str, actions, placed_of, cycles=20,
         "placed_full": placed_full,
         "full_cycle_seconds": round(full_s, 3),
         "full_placed_per_sec": round(placed_full / full_s, 1) if full_s else 0.0,
+        # The bench artifact's regime evidence (bench.py policy), per
+        # scenario: a tunnel-degraded window shows up here, so a slow
+        # number can be attributed to the link instead of the code.
+        "probe_before": probe_before,
     }
     if churn is not None and cycles > 0:
         rng = np.random.default_rng(42)
@@ -129,6 +136,12 @@ def measure(name, factory, conf_str, actions, placed_of, cycles=20,
                 "pod_sched_latency_p99": round(float(np.percentile(pod_lat, 99)), 3),
                 "pod_sched_latency_pods": len(pod_lat),
             })
+    probe_after = link_probe()
+    rec["probe_after"] = probe_after
+    rec["link_degraded"] = any(
+        p["rtt_s"] > 0.35 or p["readback_400k_s"] > 0.45
+        for p in (probe_before, probe_after)
+    )
     print(json.dumps(rec), flush=True)
     if results is not None:
         results.append(rec)

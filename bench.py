@@ -15,8 +15,12 @@ once recorded 26k pods/s for a 138k scheduler, and the JSON carried nothing
 that could tell "bad link" from "regression"):
 
 * every measured cycle carries its host/device phase split
-  (open/engine_init/device/decode/apply/close) and its device-transfer
-  accounting (steady cycles upload ~nothing — ops/transfer_cache.py);
+  (open/engine_init/dispatch/device/decode/apply/close, plus overlap_host —
+  host work done while the device program was already running), its
+  device-transfer accounting (steady cycles upload ~nothing —
+  ops/transfer_cache.py), and its engine-cache outcome (steady cycles
+  delta-refresh the resident engine instead of rebuilding —
+  ops/engine_cache.py);
 * a link probe (tiny-transfer RTT + fixed 400KB readback) runs before and
   after every cycle, so each cycle's surrounding link regime is on record;
 * outlier policy (emitted in the artifact under "policy"): a cycle is
@@ -170,9 +174,15 @@ def main() -> None:
                     "s": round(el, 3),
                     "link_degraded": bad,
                     "phases": {k: round(v, 3) for k, v in ph.items()
-                               if k not in ("uploads", "upload_bytes", "upload_hits")},
+                               if k not in ("uploads", "upload_bytes",
+                                            "upload_hits", "notes")},
                     "uploads": ph.get("uploads", -1),
                     "upload_bytes": ph.get("upload_bytes", -1),
+                    # Persistent-engine evidence: hit = delta-refreshed
+                    # resident engine (engine_init amortized; dispatch
+                    # overlapped the host rebind — the overlap_host phase),
+                    # rebuild/miss = cold build this cycle.
+                    "engine_cache": ph.get("notes", {}).get("engine_cache", "?"),
                 }
                 for (_, el, ph), bad in zip(runs, flags)
             ],

@@ -9,6 +9,7 @@ from scheduler_tpu.api.types import TaskStatus
 from scheduler_tpu.api.unschedule_info import FitErrors
 from scheduler_tpu.apis.objects import PodGroupPhase
 from scheduler_tpu.framework.interface import Action
+from scheduler_tpu.utils import phases
 from scheduler_tpu.utils.scheduler_helper import get_node_list
 
 logger = logging.getLogger("scheduler_tpu.actions.backfill")
@@ -19,6 +20,12 @@ class BackfillAction(Action):
         return "backfill"
 
     def execute(self, ssn) -> None:
+        # Own phase bucket so multi-action measurement protocols can split a
+        # cycle's host time between allocate's engine phases and backfill.
+        with phases.phase("backfill"):
+            self._execute(ssn)
+
+    def _execute(self, ssn) -> None:
         nodes = None  # materialized on the first BestEffort task, not per cycle
         for job in list(ssn.jobs.values()):
             if job.pod_group is not None and job.pod_group.status.phase == PodGroupPhase.PENDING:

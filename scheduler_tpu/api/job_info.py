@@ -961,6 +961,18 @@ class JobInfo:
                 )
             if from_val == new_val:
                 return
+            if (
+                net_add is not None
+                and (from_val & _ALLOC_BITS)
+                and not (new_val & _ALLOC_BITS)
+            ):
+                # Same check _apply_batched_status_bookkeeping performs, but
+                # BEFORE the status scatter: a caller catching the ValueError
+                # must find state untouched, not a written column with stale
+                # counts/allocated/index.
+                raise ValueError(
+                    "net_add given but batch contains an allocated->non-allocated transition"
+                )
             st.status[rows] = new_val
             self._apply_batched_status_bookkeeping(
                 rows.shape[0], from_val, new_val, net_add, rows

@@ -574,7 +574,9 @@ def main() -> None:
     parser.add_argument("--port", type=int, default=18200)
     ns = parser.parse_args()
     server, _state = serve(ns.port)
-    print(f"mock apiserver on :{ns.port}", flush=True)
+    # Report the BOUND port, not the requested one: --port 0 lets the OS
+    # assign a free port and the spawning test reads it back from this line.
+    print(f"mock apiserver on :{server.server_address[1]}", flush=True)
     server.serve_forever()
 
 

@@ -67,6 +67,12 @@ def close_session(ssn: Session) -> None:
 
     JobUpdater(ssn).update_all()
 
+    # A cached cross-cycle engine may outlive this session, but it must not
+    # keep the session's object graph alive (ops/engine_cache.py).
+    from scheduler_tpu.ops import engine_cache
+
+    engine_cache.release_session(ssn)
+
     ssn.jobs = {}
     ssn.nodes = {}
     ssn.queues = {}

@@ -45,11 +45,15 @@ def timed_cycle_phases(cache, conf, actions) -> tuple[float, dict]:
         elapsed = time.perf_counter() - start
     finally:
         gc.unfreeze()
+        notes = phases.take_notes()
         rec = phases.end()
     xfer = transfer_cache.reset_counters()
     rec["uploads"] = xfer["misses"]
     rec["upload_bytes"] = xfer["miss_bytes"]
     rec["upload_hits"] = xfer["hits"]
+    # Non-time annotations (engine-cache hit/miss/rebuild outcome) ride a
+    # side channel so every direct value in ``rec`` stays a float.
+    rec["notes"] = notes
     return elapsed, rec
 
 
@@ -61,15 +65,19 @@ def warm_engine(cache, conf) -> None:
     """Build the engine tensors once without placing anything — the per-job
     caches a live daemon populates between cycles.  ONE definition shared by
     every measurement protocol (bench, ladder, daemon_vs_bench) so they all
-    warm the same state."""
+    warm the same state.  The build goes through the cross-cycle engine
+    cache, so the engine this warms IS the resident the measured cycle
+    delta-refreshes (ops/engine_cache.py) — exactly the steady-state daemon
+    behavior."""
     from scheduler_tpu.actions.allocate import collect_candidates
     from scheduler_tpu.framework import close_session, open_session
+    from scheduler_tpu.ops import engine_cache
     from scheduler_tpu.ops.fused import FusedAllocator
 
     warm_ssn = open_session(cache, conf.tiers)
     cands = collect_candidates(warm_ssn)
     if cands and warm_ssn.nodes and FusedAllocator.supported(warm_ssn, cands):
-        FusedAllocator(warm_ssn, cands)
+        engine_cache.get_engine(warm_ssn, cands)
     close_session(warm_ssn)
 
 

@@ -622,8 +622,9 @@ def mega_allocate(
         # loop").  The cycle's parallel stages (static-mask matmuls, commit
         # scatters, enqueue/fairness totals) stay node-sharded; clusters past
         # the VMEM cap take the node-sharded XLA while-loop instead.
-        from jax import shard_map as _shard_map
         from jax.sharding import PartitionSpec as _P
+
+        from scheduler_tpu.ops.sharded import shard_map as _shard_map
 
         out = _shard_map(
             call,
@@ -641,3 +642,23 @@ def pack_lane_i32(arr: np.ndarray, lanes: int) -> np.ndarray:
     out = np.zeros((1, lanes), dtype=np.int32)
     out[0, : arr.shape[0]] = arr
     return out
+
+
+def build_node_ledgers(idle, task_count, releasing, nb: int, r: int,
+                       has_releasing: bool):
+    """Kernel-layout node ledgers from [N, R] device node state: the packed
+    [16, N] idle + task-count block (rows 0..r-1 idle, row 8 task count) and
+    the [8, N] releasing block.  ONE definition shared by the cold engine
+    build (``FusedAllocator._prepare_mega``) and the cross-cycle delta
+    refresh (``ops/engine_cache.py`` hit path), so the two can never drift."""
+    ns0 = (
+        jnp.zeros((16, nb), jnp.float32)
+        .at[:r].set(idle.T)
+        .at[8].set(task_count.astype(jnp.float32))
+    )
+    rel_t = (
+        jnp.zeros((8, nb), jnp.float32).at[:r].set(releasing.T)
+        if has_releasing
+        else jnp.zeros((8, nb), jnp.float32)
+    )
+    return ns0, rel_t

@@ -29,7 +29,16 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.6 jax: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_experimental
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_experimental(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from scheduler_tpu.ops.predicates import fit_mask, selector_mask

@@ -38,6 +38,10 @@ LEASE_DURATION = 15.0
 RENEW_DEADLINE = 10.0
 RETRY_PERIOD = 5.0
 
+# "Never observed a lease yet" — must compare unequal to every wire
+# resourceVersion INCLUDING a missing one (None), see ApiLeaseLock.
+_RV_UNSEEN = object()
+
 
 class FileLeaseLock:
     """(holder, renewed) lease in a file; see module docstring for scope."""
@@ -138,6 +142,16 @@ class ApiLeaseLock:
             f"/apis/coordination.k8s.io/v1/namespaces/{namespace}"
             f"/leases/{name}"
         )
+        # Locally observed lease staleness (client-go leaderelection
+        # semantics): the rv we last saw and WHEN we saw it on our own
+        # monotonic clock.  Expiry is judged from these, never from the
+        # holder's renewTime, so clock skew between hosts cannot trigger a
+        # premature takeover.  The never-observed sentinel must be distinct
+        # from any wire value — a lease whose metadata carries NO
+        # resourceVersion (rv=None) still gets a first observation that
+        # starts the staleness clock rather than reading as stale-since-boot.
+        self._observed_rv: object = _RV_UNSEEN
+        self._observed_at: float = 0.0
 
     # -- wire ---------------------------------------------------------------
 
@@ -154,13 +168,14 @@ class ApiLeaseLock:
         return datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
 
     def _spec(self) -> dict:
-        # leaseDurationSeconds is int32 on the real wire; fractional values
-        # (sub-second leases only exist in tests) pass through as-is rather
-        # than truncating to 0 == instantly expired.
-        dur = self.lease_duration
+        # leaseDurationSeconds is int32 on the real wire — a real API server
+        # rejects floats, so round (never truncate: 15.9 -> 16, not a
+        # silently shortened 15) and clamp to >= 1 (0 == instantly expired).
+        # The true float stays in self.lease_duration for local expiry math,
+        # which is where sub-second test leases actually bite.
         return {
             "holderIdentity": self.identity,
-            "leaseDurationSeconds": int(dur) if dur >= 1 else dur,
+            "leaseDurationSeconds": max(1, round(self.lease_duration)),
             "renewTime": self._now(),
         }
 
@@ -173,15 +188,22 @@ class ApiLeaseLock:
             "metadata": meta, "spec": self._spec(),
         }
 
-    @staticmethod
-    def _expired(spec: dict) -> bool:
-        raw = spec.get("renewTime") or ""
-        try:
-            renewed = datetime.fromisoformat(raw.replace("Z", "+00:00"))
-        except ValueError:
-            return True  # unparseable renewTime == never renewed
-        age = (datetime.now(timezone.utc) - renewed).total_seconds()
-        return age >= float(spec.get("leaseDurationSeconds", LEASE_DURATION))
+    def _locally_expired(self, rv: Optional[str]) -> bool:
+        """client-go's skew-proof expiry: a foreign lease is expired only
+        after its resourceVersion has sat UNCHANGED for lease_duration of
+        locally observed (monotonic) time.  Any rv movement — including the
+        first observation — restarts the clock; the holder's renewTime never
+        enters the decision (consulting it even once, e.g. on a standby's
+        first look after a restart, would re-open the skewed-clock takeover
+        of a live lease this method exists to prevent).  The cost is that a
+        standby arriving at a long-dead lease idles one extra lease_duration
+        before taking over — exactly client-go's behavior."""
+        now = time.monotonic()
+        if rv != self._observed_rv:
+            self._observed_rv = rv
+            self._observed_at = now
+            return False
+        return now - self._observed_at >= self.lease_duration
 
     # -- lock protocol ------------------------------------------------------
 
@@ -217,7 +239,7 @@ class ApiLeaseLock:
         spec = lease.get("spec", {})
         rv = (lease.get("metadata") or {}).get("resourceVersion")
         holder = spec.get("holderIdentity") or ""
-        if holder and holder != self.identity and not self._expired(spec):
+        if holder and holder != self.identity and not self._locally_expired(rv):
             return False  # live lease held by another scheduler
         # empty holder == released lease: immediately acquirable via CAS
         # Renew our own, or take over an expired one — same CAS'd PUT.

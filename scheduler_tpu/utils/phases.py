@@ -19,19 +19,30 @@ from contextlib import contextmanager
 from typing import Dict, Optional
 
 _current: Optional[Dict[str, float]] = None
+_notes: Optional[Dict[str, object]] = None
 
 
 def begin() -> None:
     """Start collecting phases for one cycle."""
-    global _current
+    global _current, _notes
     _current = {}
+    _notes = {}
 
 
 def end() -> Dict[str, float]:
     """Stop collecting; return {phase: seconds} accumulated since begin()."""
-    global _current
+    global _current, _notes
     out, _current = _current, None
+    _notes = None
     return out or {}
+
+
+def take_notes() -> Dict[str, object]:
+    """Non-time annotations recorded during the cycle (e.g. the engine-cache
+    hit/miss/rebuild outcome).  Read BEFORE ``end()`` — kept separate from the
+    {phase: seconds} map so artifact consumers can keep rounding every phase
+    value as a float."""
+    return dict(_notes) if _notes is not None else {}
 
 
 def active() -> bool:
@@ -41,6 +52,13 @@ def active() -> bool:
 def add(name: str, secs: float) -> None:
     if _current is not None:
         _current[name] = _current.get(name, 0.0) + secs
+
+
+def note(name: str, value) -> None:
+    """Attach a non-time annotation to the cycle being measured (no-op when
+    no measurement protocol is active, like ``add``)."""
+    if _notes is not None:
+        _notes[name] = value
 
 
 @contextmanager

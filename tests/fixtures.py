@@ -142,3 +142,21 @@ def add_running_workload(cache, rng, queues, n_nodes, n_jobs,
                 groupname=g, nodename=target, phase="Running",
                 priority=priority))
     return remaining
+
+
+def spawn_mock_server():
+    """Mock-apiserver subprocess on an OS-assigned port.  ONE definition of
+    the port-0 + banner-readback protocol (the server prints the BOUND port;
+    fixed ports collide under parallel runs / leftover listeners), shared by
+    every wire fixture so the readback cannot drift between modules.
+    Returns ``(proc, base_url)``; the caller owns proc termination."""
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "scheduler_tpu.connector.mock_server",
+         "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    line = proc.stdout.readline()
+    assert "mock apiserver" in line, line
+    return proc, f"http://127.0.0.1:{int(line.rsplit(':', 1)[1])}"

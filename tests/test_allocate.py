@@ -197,13 +197,15 @@ class TestDeviceHostParity:
         used = {}
         from scheduler_tpu.ops.fused import FusedAllocator
 
-        orig = FusedAllocator._execute
+        # readback is the one seam every fused execution path crosses (the
+        # bulk path dispatches async and collects here; _execute wraps it).
+        orig = FusedAllocator.readback
 
         def spy(self):
             used["yes"] = True
             return orig(self)
 
-        monkeypatch.setattr(FusedAllocator, "_execute", spy)
+        monkeypatch.setattr(FusedAllocator, "readback", spy)
         monkeypatch.setenv("SCHEDULER_TPU_DEVICE", "1")
         cache = make_cluster(n_nodes=3)
         add_gang(cache, "gang1", n_tasks=3, min_member=3)

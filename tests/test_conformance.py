@@ -23,9 +23,6 @@ import scheduler_tpu.actions  # noqa: F401
 import scheduler_tpu.plugins  # noqa: F401
 from tests.conformance_server import start_conformance_server
 
-PORT = 18281
-BASE = f"http://127.0.0.1:{PORT}"
-
 CONF = """
 actions: "enqueue, allocate"
 tiers:
@@ -72,8 +69,10 @@ def _pod(name: str, group: str, extra_spec: dict | None = None) -> dict:
 
 
 @pytest.fixture(scope="module")
-def rig():
-    server, store = start_conformance_server(PORT)
+def rig(tmp_path_factory):
+    # Port 0 + readback: fixed ports collide under parallel test runs.
+    server, store = start_conformance_server(0)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
 
     # Seed: full k8s documents only.
     store.put("queue", {
@@ -106,21 +105,18 @@ def rig():
     ]}))
     store.put("pod", _pod("cp-plain", "cg", {"priorityClassName": "high"}))
 
-    import tempfile
-
     from scheduler_tpu import cli
     from scheduler_tpu.options import ServerOption
 
-    conf_path = tempfile.mktemp(suffix=".yaml")
-    with open(conf_path, "w") as f:
-        f.write(CONF)
+    conf_path = tmp_path_factory.mktemp("conformance") / "scheduler.yaml"
+    conf_path.write_text(CONF)
     opt = ServerOption(
-        scheduler_conf=conf_path, schedule_period=0.2,
-        listen_address=":18282", io_workers=2,
+        scheduler_conf=str(conf_path), schedule_period=0.2,
+        listen_address="127.0.0.1:0", io_workers=2,
     )
     stop = threading.Event()
     t = threading.Thread(
-        target=cli.run, kwargs=dict(opt=opt, stop=stop, api_server=BASE),
+        target=cli.run, kwargs=dict(opt=opt, stop=stop, api_server=base),
         daemon=True)
     t.start()
     try:

@@ -7,8 +7,6 @@ subprocess — the scheduler and its system of record share no memory.
 """
 
 import json
-import subprocess
-import sys
 import threading
 import time
 import urllib.error
@@ -19,8 +17,9 @@ import pytest
 import scheduler_tpu.actions  # noqa: F401
 import scheduler_tpu.plugins  # noqa: F401
 
-PORT = 18261
-BASE = f"http://127.0.0.1:{PORT}"
+# Assigned by the wire fixture: the mock server binds port 0 and reports the
+# OS-chosen port back (fixed ports collide under parallel runs / leftovers).
+BASE = ""
 
 CONF = """
 actions: "enqueue, allocate"
@@ -51,30 +50,26 @@ def _add(kind, obj):
 
 
 @pytest.fixture(scope="module")
-def wire():
+def wire(tmp_path_factory):
     """Mock server subprocess + daemon thread, shared by the module's tests."""
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "scheduler_tpu.connector.mock_server",
-         "--port", str(PORT)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-    assert "mock apiserver" in proc.stdout.readline()
+    global BASE
+    from tests.fixtures import spawn_mock_server
+
+    proc, BASE = spawn_mock_server()
 
     _add("queue", {"name": "default", "weight": 1})
     for i in range(3):
         _add("node", {"name": f"wn-{i}", "allocatable": {
             "cpu": 4000, "memory": 16 * 2**30, "pods": 110}})
 
-    import tempfile
-
     from scheduler_tpu import cli
     from scheduler_tpu.options import ServerOption
 
-    conf_path = tempfile.mktemp(suffix=".yaml")
-    with open(conf_path, "w") as f:
-        f.write(CONF)
+    conf_path = tmp_path_factory.mktemp("connector") / "scheduler.yaml"
+    conf_path.write_text(CONF)
     opt = ServerOption(
-        scheduler_conf=conf_path, schedule_period=0.2,
-        listen_address=":18262", io_workers=2,
+        scheduler_conf=str(conf_path), schedule_period=0.2,
+        listen_address="127.0.0.1:0", io_workers=2,
     )
     stop = threading.Event()
     t = threading.Thread(
@@ -163,9 +158,9 @@ def test_watch_echo_keeps_single_task():
     from scheduler_tpu.connector import connect_cache
     from scheduler_tpu.connector.mock_server import serve
 
-    server, _state = serve(18263)
+    server, _state = serve(0)
     threading.Thread(target=server.serve_forever, daemon=True).start()
-    base = "http://127.0.0.1:18263"
+    base = f"http://127.0.0.1:{server.server_address[1]}"
     conn = None
     try:
         def post(path, payload):
@@ -326,14 +321,14 @@ class TestOutboundDialects:
                 "containers": [{"cpu": 100, "memory": 2**20}],
                 "volumeClaims": ["claim-a"] if name == "p1" else []}})
 
-    def _drive(self, port, dialect):
+    def _drive(self, dialect):
         from scheduler_tpu.api.types import TaskStatus
         from scheduler_tpu.connector import connect_cache
         from scheduler_tpu.connector.mock_server import serve
 
-        server, state = serve(port)
+        server, state = serve(0)
         threading.Thread(target=server.serve_forever, daemon=True).start()
-        base = f"http://127.0.0.1:{port}"
+        base = f"http://127.0.0.1:{server.server_address[1]}"
         conn = None
         try:
             def post(path, payload):
@@ -407,11 +402,11 @@ class TestOutboundDialects:
             server.shutdown()
 
     def test_k8s_dialect_round_trip(self):
-        counts = self._drive(18281, "k8s")
+        counts = self._drive("k8s")
         assert counts["k8s"] >= 5, counts  # binds+delete+patches+events
         assert counts["legacy"] == 0, counts
 
     def test_legacy_dialect_round_trip(self):
-        counts = self._drive(18282, "legacy")
+        counts = self._drive("legacy")
         assert counts["legacy"] >= 3, counts
         assert counts["k8s"] == 0, counts

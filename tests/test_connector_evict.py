@@ -13,8 +13,6 @@ the wire place correctly end-to-end (round-2 verdict missing #1).
 """
 
 import json
-import subprocess
-import sys
 import threading
 import time
 import urllib.request
@@ -24,8 +22,9 @@ import pytest
 import scheduler_tpu.actions  # noqa: F401
 import scheduler_tpu.plugins  # noqa: F401
 
-PORT = 18265
-BASE = f"http://127.0.0.1:{PORT}"
+# Assigned by the wire fixture: the mock server binds port 0 and reports the
+# OS-chosen port back (fixed ports collide under parallel runs / leftovers).
+BASE = ""
 
 # The reference's production conf: all five actions (config/kube-batch-conf.yaml).
 CONF = """
@@ -72,12 +71,11 @@ def _wait(pred, timeout=90, what="condition"):
 
 
 @pytest.fixture(scope="module")
-def wire():
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "scheduler_tpu.connector.mock_server",
-         "--port", str(PORT)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-    assert "mock apiserver" in proc.stdout.readline()
+def wire(tmp_path_factory):
+    global BASE
+    from tests.fixtures import spawn_mock_server
+
+    proc, BASE = spawn_mock_server()
 
     _add("queue", {"name": "default", "weight": 1})
     _add("queue", {"name": "q1", "weight": 1})
@@ -88,17 +86,14 @@ def wire():
     _add("node", {"name": "big-0", "allocatable": {
         "cpu": 3000, "memory": 3 * 2**30, "pods": 110}})
 
-    import tempfile
-
     from scheduler_tpu import cli
     from scheduler_tpu.options import ServerOption
 
-    conf_path = tempfile.mktemp(suffix=".yaml")
-    with open(conf_path, "w") as f:
-        f.write(CONF)
+    conf_path = tmp_path_factory.mktemp("connector_evict") / "scheduler.yaml"
+    conf_path.write_text(CONF)
     opt = ServerOption(
-        scheduler_conf=conf_path, schedule_period=0.2,
-        listen_address=":18266", io_workers=2,
+        scheduler_conf=str(conf_path), schedule_period=0.2,
+        listen_address="127.0.0.1:0", io_workers=2,
     )
     stop = threading.Event()
     t = threading.Thread(

@@ -1,0 +1,203 @@
+"""Cross-cycle engine-cache parity: a cache-hit delta-refreshed resident
+engine must place bitwise-identically to a cold-built engine, across
+mutation sequences (steady state, workload churn, node add/remove, resource
+change, new jobs, vocab growth).
+
+The trajectory protocol mirrors ``test_fuzz_parity``: two identical caches
+run the SAME cycle + mutation sequence, one with the cross-cycle engine
+cache enabled (``ops/engine_cache.py`` — steady cycles delta-refresh the
+resident engine, ``FusedAllocator.update``) and one with it disabled (cold
+``FusedAllocator.__init__`` every cycle, the pre-cache behavior).  After
+every cycle the cumulative binds and every task status must match exactly.
+The cached run must also actually EXERCISE both cache paths (hits and
+misses/rebuilds) or the parity claim is vacuous.
+"""
+
+import os
+
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.api.types import TaskStatus
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import close_session, get_action, open_session
+from scheduler_tpu.ops import engine_cache
+from tests.fixtures import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    make_vocab,
+)
+
+CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: proportion
+  - name: predicates
+  - name: binpack
+"""
+
+
+def build_cluster(n_queues: int) -> SchedulerCache:
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    queues = [f"q{i}" for i in range(n_queues)]
+    for i, q in enumerate(queues):
+        cache.add_queue(build_queue(q, weight=i + 1))
+    for i in range(4):
+        cache.add_node(build_node(f"n{i:02d}",
+                                  {"cpu": 4000, "memory": 8 * 1024**3}))
+
+    # Running workload to churn (evictions flip node dynamic state between
+    # cycles without touching any pending job's store).
+    for j in range(2):
+        g = f"run{j}"
+        cache.add_pod_group(build_pod_group(g, queue=queues[j % n_queues],
+                                            min_member=1, phase="Running"))
+        for t in range(2):
+            cache.add_pod(build_pod(
+                name=f"{g}-{t}", nodename=f"n{(j * 2 + t) % 4:02d}",
+                phase="Running",
+                req={"cpu": 1000, "memory": 1024**3}, groupname=g))
+
+    # A forever-pending gang (requests no node can hold): its store never
+    # moves, so steady cycles keep a stable layout token — the hit path.
+    cache.add_pod_group(build_pod_group("stuck", queue=queues[0],
+                                        min_member=1))
+    cache.add_pod(build_pod(name="stuck-0",
+                            req={"cpu": 64000, "memory": 256 * 1024**3},
+                            groupname="stuck"))
+
+    # A schedulable gang for the first cycle to place.
+    cache.add_pod_group(build_pod_group("gang0", queue=queues[-1],
+                                        min_member=2))
+    for t in range(2):
+        cache.add_pod(build_pod(name=f"gang0-{t}",
+                                req={"cpu": 500, "memory": 1024**3},
+                                groupname="gang0"))
+    return cache
+
+
+# -- deterministic mutations (keyed on stable names, never uids) -------------
+
+def evict_one_running(cache) -> None:
+    tasks = [
+        t for job in cache.jobs.values() for t in job.tasks.values()
+        if t.node_name and t.status == TaskStatus.RUNNING
+    ]
+    if tasks:
+        cache.evict(min(tasks, key=lambda t: t.name), "parity churn")
+
+
+def add_node(cache) -> None:
+    cache.add_node(build_node("nz-added", {"cpu": 4000, "memory": 8 * 1024**3}))
+
+
+def remove_node(cache) -> None:
+    cache.delete_node(build_node("nz-added", {}))
+
+
+def grow_node_resources(cache) -> None:
+    cache.update_node(build_node("n00", {"cpu": 8000, "memory": 16 * 1024**3}))
+
+
+def add_job(cache) -> None:
+    q = sorted(cache.queues)[0]
+    cache.add_pod_group(build_pod_group("late", queue=q, min_member=1))
+    cache.add_pod(build_pod(name="late-0",
+                            req={"cpu": 500, "memory": 1024**3},
+                            groupname="late"))
+
+
+def grow_vocab(cache) -> None:
+    q = sorted(cache.queues)[0]
+    cache.add_node(build_node(
+        "ngpu", {"cpu": 4000, "memory": 8 * 1024**3, "nvidia.com/gpu": 2}))
+    cache.add_pod_group(build_pod_group("gpujob", queue=q, min_member=1))
+    cache.add_pod(build_pod(
+        name="gpujob-0",
+        req={"cpu": 500, "memory": 1024**3, "nvidia.com/gpu": 1},
+        groupname="gpujob"))
+
+
+# One entry per cycle: mutation applied BEFORE that cycle (None = steady).
+# A cycle that PLACES something changes the pending set, so the cycle after
+# it rebuilds; the hit path needs two quiet cycles in a row.
+MUTATIONS = [
+    None,                 # cold first cycle (miss; places gang0)
+    None,                 # gang0 left the pending set: rebuild
+    None,                 # steady: hit, zero-delta refresh
+    evict_one_running,    # releasing appears: trace shape flips, rebuild
+    None,                 # node dynamic churn settled: hit or rebuild
+    None,                 # steady: hit
+    add_node,             # node count + generation move: key change (miss)
+    grow_node_resources,  # spec change, same shape: token change (rebuild)
+    add_job,              # pending set changes: token change (rebuild)
+    remove_node,          # back to a 4-node key
+    grow_vocab,           # vocab width grows: key change (miss)
+    None,                 # gpujob left the pending set: rebuild
+    None,                 # settle: steady-state hit on the final shape
+]
+
+
+def run_trajectory(n_queues: int, env: dict) -> list:
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        cache = build_cluster(n_queues)
+        conf = parse_scheduler_conf(CONF)
+        out = []
+        for mutate in MUTATIONS:
+            if mutate is not None:
+                mutate(cache)
+            ssn = open_session(cache, conf.tiers)
+            get_action("allocate").execute(ssn)
+            # Capture BEFORE close_session (it nils the job maps); key on
+            # task NAMES — uids are a process-global counter and differ
+            # between the two separately built caches.
+            statuses = {
+                t.name: t.status.name
+                for job in ssn.jobs.values()
+                for t in job.tasks.values()
+            }
+            close_session(ssn)
+            out.append((dict(cache.binder.binds), statuses))
+        return out
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.mark.parametrize("n_queues", [1, 2])
+def test_cache_hit_engine_matches_cold_build(n_queues):
+    base_env = {"SCHEDULER_TPU_DEVICE": "1", "SCHEDULER_TPU_FUSED": "1"}
+
+    engine_cache.clear()
+    engine_cache.reset_counters()
+    cached = run_trajectory(
+        n_queues, {**base_env, "SCHEDULER_TPU_ENGINE_CACHE": "1"})
+    stats = engine_cache.reset_counters()
+    engine_cache.clear()
+
+    cold = run_trajectory(
+        n_queues, {**base_env, "SCHEDULER_TPU_ENGINE_CACHE": "0"})
+
+    assert len(cached) == len(cold) == len(MUTATIONS)
+    for i, (got, want) in enumerate(zip(cached, cold)):
+        assert got[0] == want[0], f"cycle {i}: binds diverge"
+        assert got[1] == want[1], f"cycle {i}: task statuses diverge"
+
+    # The parity above is only meaningful if the cached run actually took
+    # the delta path AND the invalidation paths.
+    assert stats["hits"] >= 2, f"delta path never exercised: {stats}"
+    assert stats["misses"] >= 2, f"key invalidation never exercised: {stats}"
+    assert stats["rebuilds"] >= 1, f"token rebuild never exercised: {stats}"

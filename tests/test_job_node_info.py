@@ -106,6 +106,38 @@ class TestJobInfo:
         # original untouched
         assert job.ready_task_num() == 0
 
+    def test_bulk_assume_from_invalid_net_add_leaves_state_untouched(self):
+        """net_add is only valid for non-allocated -> allocated batches; an
+        allocated -> non-allocated batch carrying one must raise BEFORE the
+        status column scatter, so a caller catching the ValueError finds
+        status, counts and the allocated aggregate exactly as they were."""
+        import numpy as np
+
+        vocab = make_vocab()
+        job = JobInfo("default/pg1", vocab)
+        tasks = [task(vocab, f"p{i}") for i in range(3)]
+        for t in tasks:
+            job.add_task_info(t)
+        for t in tasks:
+            job.update_task_status(t, TaskStatus.ALLOCATED)
+        st = job.store
+        status_before = st.status[: st.n].copy()
+        gen_before = st.status_gen
+        alloc_before = job.allocated.milli_cpu
+        counts_before = dict(job._counts)
+
+        rows = np.array([st.row_of[t.uid] for t in tasks], dtype=np.int64)
+        with pytest.raises(ValueError, match="net_add"):
+            job.bulk_update_status_rows(
+                rows, TaskStatus.RELEASING,
+                net_add=np.array([3000.0, 300.0]),
+                assume_from=TaskStatus.ALLOCATED,
+            )
+        assert np.array_equal(st.status[: st.n], status_before)
+        assert st.status_gen == gen_before
+        assert job.allocated.milli_cpu == alloc_before
+        assert dict(job._counts) == counts_before
+
 
 class TestNodeInfo:
     def test_set_node_accounting(self):

@@ -210,12 +210,14 @@ def test_profile_dir_failure_does_not_cost_a_cycle(tmp_path):
     assert sched.profile_dir is None, "profiling should disable itself"
 
 
-def _lease_rig(port):
+def _lease_rig():
     from scheduler_tpu.connector.mock_server import serve
 
-    server, state = serve(port)
+    # Bind port 0 and read the assignment back: fixed ports collide under
+    # parallel runs / leftover listeners and fail with EADDRINUSE.
+    server, state = serve(0)
     threading.Thread(target=server.serve_forever, daemon=True).start()
-    return server, state, f"http://127.0.0.1:{port}"
+    return server, state, f"http://127.0.0.1:{server.server_address[1]}"
 
 
 def test_api_lease_lock_single_holder():
@@ -225,7 +227,7 @@ def test_api_lease_lock_single_holder():
     one leads, the other stands by, takeover on release."""
     from scheduler_tpu.utils.leaderelection import ApiLeaseLock
 
-    server, _, base = _lease_rig(18293)
+    server, _, base = _lease_rig()
     try:
         order = []
 
@@ -275,6 +277,89 @@ def test_api_lease_lock_single_holder():
         server.shutdown()
 
 
+def test_api_lease_fractional_duration_wire_format():
+    """leaseDurationSeconds is int32 on the real wire: a fractional
+    lease_duration must go out as max(1, round(dur)) — never a float a real
+    API server would reject, never a truncated 0 (== instantly expired) —
+    while local expiry math keeps the true float."""
+    from scheduler_tpu.utils.leaderelection import ApiLeaseLock
+
+    server, state, base = _lease_rig()
+    try:
+        lock = ApiLeaseLock(base, identity="frac", lease_duration=0.2)
+        assert lock.lease_duration == 0.2  # float preserved for local math
+        assert lock.try_acquire_or_renew()
+        with state.lock:
+            spec = state.leases[f"{lock.namespace}/{lock.name}"]["spec"]
+        assert spec["leaseDurationSeconds"] == 1
+        assert isinstance(spec["leaseDurationSeconds"], int)
+
+        lock15_9 = ApiLeaseLock(base, identity="frac", name="l2",
+                                lease_duration=15.9)
+        assert lock15_9.try_acquire_or_renew()
+        with state.lock:
+            spec = state.leases[f"{lock15_9.namespace}/l2"]["spec"]
+        assert spec["leaseDurationSeconds"] == 16  # round, not truncate
+    finally:
+        server.shutdown()
+
+
+def test_api_lease_expiry_uses_local_observation_not_holder_clock():
+    """Clock-skew hardening (client-go semantics): a standby judges expiry
+    by how long the lease's resourceVersion sat unchanged on ITS OWN clock.
+    A live lease whose holder's renewTime is skewed far into the past must
+    NOT be stolen while the holder keeps renewing (each renew moves the rv,
+    restarting the standby's staleness clock)."""
+    from scheduler_tpu.utils.leaderelection import ApiLeaseLock
+
+    server, state, base = _lease_rig()
+    try:
+        holder = ApiLeaseLock(base, identity="a", lease_duration=0.4)
+        standby = ApiLeaseLock(base, identity="b", lease_duration=0.4)
+        assert holder.try_acquire_or_renew()
+        # The standby's first look records (rv, now) and NEVER consults
+        # renewTime — a restarted standby must not steal a live lease off
+        # the holder's skewed clock either (client-go semantics).
+        assert not standby.try_acquire_or_renew()
+        key = f"{holder.namespace}/{holder.name}"
+        # Holder renews (rv moves) faster than lease_duration, but its clock
+        # is skewed: renewTime always reads as long-expired.  The standby
+        # must keep standing by — rv movement restarts its staleness clock.
+        for _ in range(3):
+            time.sleep(0.15)
+            assert holder.try_acquire_or_renew()
+            with state.lock:
+                state.leases[key]["spec"]["renewTime"] = \
+                    "2020-01-01T00:00:00.000000Z"
+            assert not standby.try_acquire_or_renew(), \
+                "standby stole a live lease off the holder's skewed clock"
+
+        # Holder stops renewing: rv freezes, and after lease_duration of
+        # locally observed staleness the standby takes over.
+        deadline = time.time() + 5.0
+        taken = False
+        while time.time() < deadline and not taken:
+            time.sleep(0.1)
+            taken = standby.try_acquire_or_renew()
+        assert taken, "standby never took over a genuinely stale lease"
+    finally:
+        server.shutdown()
+
+
+def test_api_lease_missing_rv_first_observation_starts_clock():
+    """A lease whose metadata carries NO resourceVersion must still get a
+    real first observation: rv=None must not alias the never-observed
+    sentinel and read as stale-since-boot (instant takeover of a live
+    lease)."""
+    from scheduler_tpu.utils.leaderelection import ApiLeaseLock
+
+    lock = ApiLeaseLock("http://127.0.0.1:1", identity="x", lease_duration=0.2)
+    assert not lock._locally_expired(None)   # first look: clock starts
+    assert not lock._locally_expired(None)   # still within lease_duration
+    time.sleep(0.25)
+    assert lock._locally_expired(None)       # genuinely stale now
+
+
 def test_api_lease_cas_prevents_split_brain():
     """resourceVersion CAS: after expiry the takeover PUT must carry the rv
     it read — a write against a superseded rv 409s, so two standbys racing
@@ -284,7 +369,7 @@ def test_api_lease_cas_prevents_split_brain():
 
     from scheduler_tpu.utils.leaderelection import ApiLeaseLock
 
-    server, state, base = _lease_rig(18294)
+    server, state, base = _lease_rig()
     try:
         lock_a = ApiLeaseLock(base, identity="a", lease_duration=0.2)
         lock_b = ApiLeaseLock(base, identity="b", lease_duration=0.2)

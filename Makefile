@@ -1,7 +1,7 @@
 # Build/test entry points (reference: Makefile + hack/make-rules).
 PY ?= python
 
-.PHONY: all native test test-fast bench bench-smoke verify wheel clean
+.PHONY: all native test test-fast bench bench-smoke bench-gate verify wheel clean
 
 all: native
 
@@ -21,6 +21,11 @@ bench:
 
 bench-smoke:
 	$(PY) bench.py --smoke
+
+# Perf regression gate: newest BENCH_r*.json vs the previous round,
+# healthy-regime cycles only; exits non-zero past a >10% pods/s drop.
+bench-gate:
+	$(PY) scripts/bench_gate.py
 
 # Installable artifact (reference `make images` slot): build the wheel and
 # verify it carries the entrypoints and the native kernel source.

@@ -183,6 +183,12 @@ def main() -> None:
                     # overlapped the host rebind — the overlap_host phase),
                     # rebuild/miss = cold build this cycle.
                     "engine_cache": ph.get("notes", {}).get("engine_cache", "?"),
+                    # Cohort-placement evidence (docs/COHORT.md): engine
+                    # flavor, cohorts seen by the build, device loop steps,
+                    # tasks placed per step, multi-node chunk placements and
+                    # fallback steps — proof the cohort path engaged (or a
+                    # record of why it didn't).
+                    "cohort": ph.get("notes", {}).get("cohort", {}),
                 }
                 for (_, el, ph), bad in zip(runs, flags)
             ],

@@ -21,7 +21,6 @@ handlers, gang dispatch and cache bind semantics are identical.
 from __future__ import annotations
 
 import logging
-import os
 from collections import deque
 from typing import Dict, List
 
@@ -30,6 +29,7 @@ from scheduler_tpu.api.types import TaskStatus
 from scheduler_tpu.api.unschedule_info import FitError, FitErrors, NODE_RESOURCE_FIT_FAILED
 from scheduler_tpu.apis.objects import PodGroupPhase
 from scheduler_tpu.framework.interface import Action
+from scheduler_tpu.utils.envflags import env_bool
 from scheduler_tpu.utils.priority_queue import PriorityQueue
 from scheduler_tpu.utils.scheduler_helper import (
     get_node_list,
@@ -43,11 +43,11 @@ logger = logging.getLogger("scheduler_tpu.actions.allocate")
 
 
 def _device_enabled() -> bool:
-    return os.environ.get("SCHEDULER_TPU_DEVICE", "1") not in ("0", "false")
+    return env_bool("SCHEDULER_TPU_DEVICE", True)
 
 
 def _fused_enabled() -> bool:
-    return os.environ.get("SCHEDULER_TPU_FUSED", "1") not in ("0", "false")
+    return env_bool("SCHEDULER_TPU_FUSED", True)
 
 
 def _strict_order_mode() -> str:
@@ -64,7 +64,12 @@ def _strict_order_mode() -> str:
     * ``1``/``true``/``always``: always the exact interleaved host loop.
     * ``0``/``false``/``never``: always static-first (the round-3 default).
     """
-    raw = os.environ.get("SCHEDULER_TPU_STRICT_ORDER", "auto").lower()
+    from scheduler_tpu.utils.envflags import env_str
+
+    raw = env_str(
+        "SCHEDULER_TPU_STRICT_ORDER", "auto",
+        choices=("auto", "always", "never", "0", "1", "true", "false"),
+    )
     if raw in ("1", "true", "always"):
         return "always"
     if raw in ("0", "false", "never"):
@@ -171,7 +176,7 @@ def apply_fused_results(ssn, candidates: List[JobInfo], results, plan_fn=None) -
     rows, apply placements (bulk by default, per-row when SCHEDULER_TPU_BULK=0).
     ``plan_fn`` lazily builds the engine's CommitPlan — only the bulk path
     consumes it, so the per-row path never pays for its construction."""
-    bulk = os.environ.get("SCHEDULER_TPU_BULK", "1") not in ("0", "false")
+    bulk = env_bool("SCHEDULER_TPU_BULK", True)
     placements = []
     for job in candidates:
         for task, node_name, pipelined, failed in results.get(job.uid, []):
@@ -345,7 +350,7 @@ class AllocateAction(Action):
                 ssn, candidates, eager_dispatch=True
             )
         phases.note("engine_cache", cache_status)
-        if os.environ.get("SCHEDULER_TPU_BULK", "1") in ("0", "false"):
+        if not env_bool("SCHEDULER_TPU_BULK", True):
             # Per-row commit requested: object decode + per-task session ops.
             results = engine.run()
             apply_fused_results(ssn, candidates, results, plan_fn=None)
@@ -354,6 +359,10 @@ class AllocateAction(Action):
             engine.dispatch()  # non-blocking; no-op when the hit already launched
         with phases.phase("device"):
             engine.readback()  # blocking collect of the dispatched program
+        # Cohort evidence (docs/COHORT.md): cohorts seen by the build, device
+        # steps taken, tasks per step, chunk placements, fallback steps —
+        # the bench artifact's proof that the cohort path engaged.
+        phases.note("cohort", engine.run_stats())
         with phases.phase("decode"):
             items, node_batches, failures = engine.run_columnar()  # reuses codes
         with phases.phase("apply"):

@@ -1,5 +1,22 @@
 """Backfill: place zero-request (BestEffort) tasks wherever predicates pass
-(reference ``actions/backfill/backfill.go``)."""
+(reference ``actions/backfill/backfill.go``).
+
+Cohort fast-start (round 6, docs/COHORT.md): BestEffort pods overwhelmingly
+share one predicate signature (selector, tolerations, affinity spec), and the
+reference's per-task sweep re-scans the same failing node prefix for every
+one of them.  When every registered predicate is signature-static (the
+plugin promised so by registering a ``static_predicate_fn``) and the task
+carries no scan-dynamic predicate (host ports / inter-pod affinity), a node
+that failed for the previous same-signature task provably fails for the next
+one too — static predicates see identical inputs, and the only live gate,
+pod count, is monotone during backfill (allocations only add pods).  The
+sweep therefore starts at the last same-signature success index — capped at
+the first node whose BIND failed (it passed predicates, so its failure is
+transient and the next task must retry it).  The fallback is total: any
+task whose fast-started sweep finds nothing rescans from node zero
+(identical to the reference loop, and it keeps the per-node FitErrors
+record complete), and tasks outside the gate never fast-start.
+"""
 
 from __future__ import annotations
 
@@ -11,6 +28,7 @@ from scheduler_tpu.apis.objects import PodGroupPhase
 from scheduler_tpu.framework.interface import Action
 from scheduler_tpu.utils import phases
 from scheduler_tpu.utils.scheduler_helper import get_node_list
+from scheduler_tpu.utils.sweep import static_predicate_sig
 
 logger = logging.getLogger("scheduler_tpu.actions.backfill")
 
@@ -25,8 +43,44 @@ class BackfillAction(Action):
         with phases.phase("backfill"):
             self._execute(ssn)
 
+    def _sweep(self, ssn, task, nodes, start, fe, end=None):
+        """The reference's first-passing-node sweep over ``[start, end)``;
+        returns ``(winning index or None, first bind-failure index or
+        None)``.  Errors accumulate into ``fe``.  The bind-failure index
+        matters for the cohort cache: a node that PASSED predicates but
+        failed the bind is a transient failure, not a provable one, so the
+        next same-signature task must retry it."""
+        first_bind_fail = None
+        for idx in range(start, len(nodes) if end is None else end):
+            node = nodes[idx]
+            try:
+                ssn.predicate_fn(task, node)
+            except Exception as err:
+                logger.debug("backfill predicate failed for %s on %s: %s",
+                             task.uid, node.name, err)
+                fe.set_node_error(node.name, err)
+                continue
+            try:
+                ssn.allocate(task, node.name)
+            except Exception as err:
+                logger.error("backfill bind of %s on %s failed: %s",
+                             task.uid, node.name, err)
+                fe.set_node_error(node.name, err)
+                if first_bind_fail is None:
+                    first_bind_fail = idx
+                continue
+            return idx, first_bind_fail
+        return None, first_bind_fail
+
     def _execute(self, ssn) -> None:
         nodes = None  # materialized on the first BestEffort task, not per cycle
+        # Cohort fast-start applies only when every registered predicate is
+        # signature-static (sound prefix skipping needs it).  Per task,
+        # ``static_predicate_sig`` — the SAME signature + scan-dynamic
+        # carve-out the preempt/reclaim SweepCache uses — returns None for
+        # host-port / inter-pod-affinity pods, which opt out individually.
+        cohorts_sound = set(ssn.predicate_fns) <= set(ssn.static_predicate_fns)
+        start_at: dict = {}  # predicate signature -> proven-failing prefix end
         for job in list(ssn.jobs.values()):
             if job.pod_group is not None and job.pod_group.status.phase == PodGroupPhase.PENDING:
                 continue
@@ -39,27 +93,28 @@ class BackfillAction(Action):
                     continue  # only BestEffort tasks backfill
                 if nodes is None:
                     nodes = get_node_list(ssn.nodes)
-                allocated = False
+                key = static_predicate_sig(task) if cohorts_sound else None
+                start = start_at.get(key, 0) if key is not None else 0
                 fe = FitErrors()
-                for node in nodes:
-                    try:
-                        ssn.predicate_fn(task, node)
-                    except Exception as err:
-                        logger.debug("backfill predicate failed for %s on %s: %s",
-                                     task.uid, node.name, err)
-                        fe.set_node_error(node.name, err)
-                        continue
-                    try:
-                        ssn.allocate(task, node.name)
-                    except Exception as err:
-                        logger.error("backfill bind of %s on %s failed: %s",
-                                     task.uid, node.name, err)
-                        fe.set_node_error(node.name, err)
-                        continue
-                    allocated = True
-                    break
-                if not allocated:
+                won, bind_fail = self._sweep(ssn, task, nodes, start, fe)
+                if won is None and start > 0:
+                    # Fallback: distrust the cohort cache and sweep the
+                    # skipped prefix too.  It fails again by construction —
+                    # but sweeping it (into the SAME FitErrors, completing
+                    # the per-node record) rather than assuming so means a
+                    # violated proof surfaces as a reference-exact placement
+                    # instead of a lost one.  The suffix already swept; no
+                    # need to pay it twice.
+                    won, bind_fail = self._sweep(
+                        ssn, task, nodes, 0, fe, end=start
+                    )
+                if won is None:
                     job.nodes_fit_errors[task.uid] = fe
+                elif key is not None:
+                    # Cache only the prefix that provably fails for the
+                    # signature: everything before the first bind failure
+                    # (those nodes passed predicates and must be retried).
+                    start_at[key] = won if bind_fail is None else min(won, bind_fail)
 
 
 def new() -> BackfillAction:

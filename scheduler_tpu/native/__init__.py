@@ -86,7 +86,9 @@ def _load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if os.environ.get("SCHEDULER_TPU_NATIVE", "1") in ("0", "false"):
+    from scheduler_tpu.utils.envflags import env_bool
+
+    if not env_bool("SCHEDULER_TPU_NATIVE", True):
         return None
     path = build()
     if path is None:

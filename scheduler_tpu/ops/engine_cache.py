@@ -49,31 +49,38 @@ from typing import Optional, Tuple
 logger = logging.getLogger("scheduler_tpu.ops.engine_cache")
 
 # Environment flags that change which device program a build selects (mega /
-# mesh / pallas gating).  Part of the key: tests flip these between runs and
-# a resident engine built under other flags must not serve them.
+# mesh / pallas / cohort gating).  Part of the key: tests flip these between
+# runs and a resident engine built under other flags must not serve them.
+# SCHEDULER_TPU_COHORT matters because the resident engine stashes the traced
+# cohort chunk count in its mega kwargs — the cohort TABLES themselves
+# (signature ids, run lengths, per-signature requests) are layout-derived and
+# already pinned by the layout token below, so a hit can never serve stale
+# cohorts: any change to the pending row set, request rows, priorities or
+# queue of a candidate job moves the token and forces a rebuild.
 _ENV_KEYS = (
     "SCHEDULER_TPU_MEGA",
     "SCHEDULER_TPU_MESH",
     "SCHEDULER_TPU_STEP_KERNEL",
     "SCHEDULER_TPU_PALLAS",
     "SCHEDULER_TPU_FUSED_STATIC_LIMIT",
+    "SCHEDULER_TPU_COHORT",
 )
 
 _scope_counter = itertools.count(1)
 
 
 def _enabled() -> bool:
-    return os.environ.get("SCHEDULER_TPU_ENGINE_CACHE", "1") not in ("0", "false")
+    from scheduler_tpu.utils.envflags import env_bool
+
+    return env_bool("SCHEDULER_TPU_ENGINE_CACHE", True)
 
 
 def _cap() -> int:
     """Resident engine entries (engines hold full host layouts + device
     buffers; the steady daemon needs exactly one per session shape)."""
-    try:
-        cap = int(os.environ.get("SCHEDULER_TPU_ENGINE_CACHE_ENTRIES", "2"))
-    except ValueError:
-        cap = 2
-    return max(1, cap)
+    from scheduler_tpu.utils.envflags import env_int
+
+    return env_int("SCHEDULER_TPU_ENGINE_CACHE_ENTRIES", 2, minimum=1)
 
 
 def _cache_scope(cache) -> Optional[int]:

@@ -113,6 +113,24 @@ def _donation_ok() -> bool:
 # longer than this just take multiple steps; keep it a power of two.
 MAX_BATCH = 128
 
+
+def _cohort_chunks() -> int:
+    """Placement chunks per cohort step (ops/megakernel.py cohort loop;
+    docs/COHORT.md).  ``SCHEDULER_TPU_COHORT``: ``auto`` (default) enables 4
+    chunks on accelerator backends and 1 (off) elsewhere — interpret-mode
+    CPU runs pay real trace/compile time per chunk for no wall-clock win, so
+    tests opt in explicitly; an integer forces the count (1 disables)."""
+    from scheduler_tpu.utils.envflags import env_int
+
+    raw = os.environ.get("SCHEDULER_TPU_COHORT", "auto")
+    if raw.strip().lower() == "auto":
+        try:
+            on_accel = jax.default_backend() in ("tpu", "axon")
+        except Exception:  # pragma: no cover - backend probing
+            on_accel = False
+        return 4 if on_accel else 1
+    return env_int("SCHEDULER_TPU_COHORT", 1, minimum=1, maximum=8)
+
 # Comparators the fused job-selection chain understands, keyed by plugin name.
 _KNOWN_JOB_ORDER = ("priority", "gang", "drf")
 
@@ -272,10 +290,16 @@ def fused_allocate(
         plim2d = pods_limit_f[None, :]
         smask_dummy = jnp.ones((1, n), dtype=bool)
         sscore_dummy = jnp.zeros((1, n), dtype=jnp.float32)
+        # Cohort capacity: with run batching live, the kernel also returns
+        # the winner's epsilon-fit capacity count and pod-count room, so the
+        # batch sizing below never touches the (possibly sharded) node
+        # ledgers outside the kernel (docs/COHORT.md).
+        with_capacity = batch_runs
         if mesh is None:
             step_select = _pk.make_placement_step(
                 r_dim, r8, n, weights, use_static, enforce_pod_count,
                 _CPU_IDX, _MEM_IDX, interpret=_pk._interpret(),
+                with_capacity=with_capacity,
             )
         else:
             # SHARDED fast engine (VERDICT r3 #6): each chip runs the pallas
@@ -289,17 +313,20 @@ def fused_allocate(
 
             from scheduler_tpu.ops.sharded import NODE_AXIS as _NAXIS
             from scheduler_tpu.ops.sharded import shard_map as _shard_map
-            from scheduler_tpu.ops.sharded import two_level_winner as _winner
+            from scheduler_tpu.ops.sharded import (
+                two_level_winner_with_capacity as _winner_cap,
+            )
 
             n_local = n // mesh.size
             local_step = _pk.make_placement_step(
                 r_dim, r8, n_local, weights, use_static, enforce_pod_count,
                 _CPU_IDX, _MEM_IDX, interpret=_pk._interpret(),
+                with_capacity=with_capacity,
             )
 
             def _local_select(ns_l, alloc_l, sm_l, ss_l, gate_l, plim_l,
                               initq_c, req_c, mins_l):
-                lbest, lscore = local_step(
+                lbest, lscore, lcap, lpods = local_step(
                     ns_l, alloc_l, sm_l, ss_l, gate_l, plim_l,
                     initq_c, req_c, mins_l,
                 )
@@ -309,8 +336,14 @@ def fused_allocate(
                 # any_feasible masks the all-infeasible case regardless.
                 lbest = jnp.minimum(lbest, n_local - 1)
                 shard_i = jax.lax.axis_index(_NAXIS)
-                win = _winner(lscore, lbest + shard_i * n_local)
-                return win[1].astype(jnp.int32), win[0]
+                # The winner row CARRIES the winning shard's capacity count
+                # and pod room, so the cohort batch sizing never gathers
+                # from the sharded node ledgers.
+                score, gbest, cap, pods = _winner_cap(
+                    lscore, lbest + shard_i * n_local,
+                    lcap.astype(jnp.float32), lpods.astype(jnp.float32),
+                )
+                return gbest, score, cap, pods
 
             def step_select(ns_g, alloc_g, sm_g, ss_g, gate_g, plim_g,
                             initq_c, req_c, mins_l):
@@ -322,7 +355,7 @@ def fused_allocate(
                         _P(None, _NAXIS), _P(None, _NAXIS), _P(None, _NAXIS),
                         _P(), _P(), _P(),
                     ),
-                    out_specs=(_P(), _P()),
+                    out_specs=(_P(), _P(), _P(), _P()),
                     check_vma=False,
                 )(ns_g, alloc_g, sm_g, ss_g, gate_g, plim_g,
                   initq_c, req_c, mins_l)
@@ -489,7 +522,7 @@ def fused_allocate(
             req_c = jax.lax.dynamic_slice(req_T, (0, t_idx), (r8, 1))
             smask_row = static_mask[t_idx][None, :] if use_static else smask_dummy
             sscore_row = static_score[t_idx][None, :] if use_static else sscore_dummy
-            best, best_score = step_select(
+            best, best_score, kern_cap, kern_pods = step_select(
                 node_state, alloc_T, smask_row, sscore_row,
                 gate2d, plim2d, initq_c, req_c, mins_c,
             )
@@ -581,13 +614,16 @@ def fused_allocate(
             hi0 = jnp.minimum(run_len[t_idx], jnp.int32(MAX_BATCH))
             hi0 = jnp.minimum(hi0, room)
             if enforce_pod_count:
-                tc_best = (
-                    node_state[r8, best] if step_kernel
-                    else node_state[best, 2 * r_dim]
-                )
-                hi0 = jnp.minimum(
-                    hi0, pods_limit[best] - tc_best.astype(jnp.int32)
-                )
+                if step_kernel:
+                    # Pod room came out of the selection kernel with the
+                    # winner (and, on a mesh, rode the two-level winner
+                    # tuple) — no gather from the sharded node ledger.
+                    hi0 = jnp.minimum(hi0, kern_pods)
+                else:
+                    tc_best = node_state[best, 2 * r_dim]
+                    hi0 = jnp.minimum(
+                        hi0, pods_limit[best] - tc_best.astype(jnp.int32)
+                    )
             hi0 = jnp.maximum(hi0, 1)
 
             # Largest j such that the j-th sequential placement still fits:
@@ -597,32 +633,34 @@ def fused_allocate(
             # scalar binary search costs ~8x more tiny sequential ops per
             # placement step).
             if step_kernel:
-                idle_b = jax.lax.dynamic_slice(
-                    node_state, (0, best), (r_dim, 1)
-                )[:, 0]
+                # The kernel already counted the winner's capacity over the
+                # SAME 128-candidate epsilon-fit grid; the fit is a prefix
+                # in j, so min-ing the count against hi0 equals masking the
+                # grid at hi0.
+                fit_count = jnp.maximum(jnp.minimum(kern_cap, hi0), 1)
             else:
                 idle_b = idle[best]
-            js = jnp.arange(1, MAX_BATCH + 1, dtype=jnp.int32)
-            avail = idle_b[None, :] - (js - 1).astype(idle_b.dtype)[:, None] * req[None, :]
-            ok_js = fit_mask(init_req, avail, mins)
-            if score_bound:
-                # Top-2 bound: placement j still picks `best` iff its score
-                # after j-1 placements beats the runner-up (whose score, like
-                # every other node's, is unchanged by placements on best) —
-                # ties break to the lowest index exactly like the argmax.
-                # Prefix-AND because non-binpack scores are not monotone.
-                others = jnp.where(jnp.arange(n) == best, neg_inf, masked_score)
-                second = jnp.max(others)
-                second_idx = jnp.argmax(others)
-                alloc_b = jnp.broadcast_to(
-                    allocatable[best][None, :], (MAX_BATCH, r_dim)
-                )
-                s_js = dynamic_score(req, avail, alloc_b, *weights)
-                if use_static:
-                    s_js = s_js + static_score[t_idx, best]
-                ok_s = (s_js > second) | ((s_js == second) & (best < second_idx))
-                ok_js = ok_js & (jnp.cumprod(ok_s.astype(jnp.int32)) > 0)
-            fit_count = jnp.max(jnp.where(ok_js & (js <= hi0), js, 1))
+                js = jnp.arange(1, MAX_BATCH + 1, dtype=jnp.int32)
+                avail = idle_b[None, :] - (js - 1).astype(idle_b.dtype)[:, None] * req[None, :]
+                ok_js = fit_mask(init_req, avail, mins)
+                if score_bound:
+                    # Top-2 bound: placement j still picks `best` iff its score
+                    # after j-1 placements beats the runner-up (whose score, like
+                    # every other node's, is unchanged by placements on best) —
+                    # ties break to the lowest index exactly like the argmax.
+                    # Prefix-AND because non-binpack scores are not monotone.
+                    others = jnp.where(jnp.arange(n) == best, neg_inf, masked_score)
+                    second = jnp.max(others)
+                    second_idx = jnp.argmax(others)
+                    alloc_b = jnp.broadcast_to(
+                        allocatable[best][None, :], (MAX_BATCH, r_dim)
+                    )
+                    s_js = dynamic_score(req, avail, alloc_b, *weights)
+                    if use_static:
+                        s_js = s_js + static_score[t_idx, best]
+                    ok_s = (s_js > second) | ((s_js == second) & (best < second_idx))
+                    ok_js = ok_js & (jnp.cumprod(ok_s.astype(jnp.int32)) > 0)
+                fit_count = jnp.max(jnp.where(ok_js & (js <= hi0), js, 1))
             m = jnp.where(alloc_here, fit_count, 1)
         else:
             m = jnp.int32(1)
@@ -805,9 +843,18 @@ class FusedAllocator:
         # Execution + cross-cycle state (reset here so a rebuild-in-place via
         # ``update`` can never leak a previous cycle's results or ownership).
         self._dev = None          # in-flight device result (dispatch pending)
+        self._dev_stats = None    # in-flight cohort/step evidence (mega only)
+        self._stats_raw = None    # collected evidence of the last readback
         self._encoded = None      # decoded int32 codes of the last readback
         self._layout_token = None  # ops/engine_cache.py layout fingerprint
         self._job_uids = None     # survives release(); _rebind restores jobs
+        # Cohort evidence (docs/COHORT.md): host-side cohort table summary
+        # (filled where the run merge is computed) + the resolved chunk count.
+        self.cohort_count = 0     # maximal identical-shape runs of length >= 2
+        self.cohort_tasks = 0     # tasks covered by those runs
+        self.cohort_spill = False  # some cohort must split across nodes
+        self.cohort_chunks = _cohort_chunks()
+        self.cohort_effective = 1  # chunks the device program actually traces
         vocab = next(iter(ssn.nodes.values())).vocab
         policy = DevicePolicy(vocab)
         r = vocab.size
@@ -1069,6 +1116,53 @@ class FusedAllocator:
                 merge_host = same & ~jb_change
             merge_any = bool(merge_host.any())
             if merge_any:
+                # Cohort table summary (host evidence; with static tensors
+                # the device-side merge below may sub-split, so this is an
+                # upper bound on the cohorts the kernel sees).
+                starts = merge_host & ~np.concatenate(
+                    [[False], merge_host[:-1]]
+                )
+                self.cohort_count = int(starts.sum())
+                self.cohort_tasks = int(merge_host.sum()) + self.cohort_count
+                # Spill estimate gating the multi-chunk cohort step: chunks
+                # only pay when cohorts SPLIT across nodes, and each traced
+                # chunk multiplies the step's placement stage whether it
+                # engages or not.  A cohort provably spills when its length
+                # exceeds even the most optimistic single-node capacity —
+                # per resource, the cluster-wide max idle over the request
+                # (ratios are scale-invariant, so raw host columns do).
+                # This is deliberately conservative: partially-filled nodes
+                # mid-cycle cause extra dynamic spills the estimate misses,
+                # but those engage too rarely (~10% of steps on bench
+                # shapes) to buy back the per-step cost of extra chunks.
+                start_idx = np.nonzero(
+                    np.concatenate([starts, [False]])
+                )[0]
+                bounds = np.nonzero(
+                    np.concatenate([[True], ~merge_host, [True]])
+                )[0]
+                run_len_of = np.diff(bounds)  # lengths of ALL maximal runs
+                lens = run_len_of[np.searchsorted(bounds[:-1], start_idx)]
+                max_idle = (
+                    st.nodes.idle.max(axis=0)
+                    if st.nodes.count
+                    else np.zeros(req_m.shape[1])
+                )
+                reqs = req_m[start_idx]
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    cap = np.where(reqs > 0, max_idle[None, :] / reqs, np.inf)
+                cap_s = np.floor(cap.min(axis=1))
+                if "pod_count" in ssn.device_dynamic_gates:
+                    pods_room = int(
+                        (st.nodes.pods_limit - st.nodes.task_count).max()
+                    ) if st.nodes.count else 0
+                    cap_s = np.minimum(cap_s, pods_room)
+                # Kernel runs are clipped to MAX_BATCH, so a longer cohort
+                # only spills in-kernel if a 128-task segment does.
+                self.cohort_spill = bool(
+                    (np.minimum(lens, MAX_BATCH) > cap_s).any()
+                )
+            if merge_any:
                 merge = jnp.asarray(merge_host)
                 if self.use_static:
                     merge = merge & jnp.all(
@@ -1187,7 +1281,9 @@ class FusedAllocator:
         # supersedes both XLA paths.
         self.use_mega = False
         self._mega = None
-        mega_enabled = os.environ.get("SCHEDULER_TPU_MEGA", "1") not in ("0", "false")
+        from scheduler_tpu.utils.envflags import env_bool
+
+        mega_enabled = env_bool("SCHEDULER_TPU_MEGA", True)
         if step_ok and mega_enabled:
             from scheduler_tpu.ops import megakernel as _mk
 
@@ -1327,8 +1423,10 @@ class FusedAllocator:
         sig_req[:r, :s_count] = uniq_rows[:, :r].T
         sig_req[8 : 8 + r, :s_count] = uniq_rows[:, r:].T
 
-        task_sig = np.zeros((1, tb), dtype=np.int32)
-        task_sig[0, :t] = inverse.astype(np.int32)
+        # Cohort tables ride the windowed [ceil(T/128), 128] layout: the
+        # kernel reads them with a 1-row dynamic sublane window instead of a
+        # full-width [1, T] masked reduce (megakernel.read_task_i32).
+        task_sig = _mk.pack_task_table_i32(inverse.astype(np.int32), tb)
 
         jb = nums.shape[0]
         j_pad = -(-(jb + _mk.MAX_BATCH) // 128) * 128
@@ -1373,12 +1471,11 @@ class FusedAllocator:
                 .at[:s_count]
                 .set(static_score_dev[rep])
             )
-            msig = np.zeros((1, tb), dtype=np.int32)
-            msig[0, :t] = static_sids
+            msig = _mk.pack_task_table_i32(static_sids.astype(np.int32), tb)
         else:
             smask = jnp.zeros((8, nb), jnp.float32)
             sscore = jnp.zeros((8, nb), jnp.float32)
-            msig = np.zeros((1, tb), dtype=np.int32)
+            msig = _mk.pack_task_table_i32(np.zeros(0, np.int32), tb)
 
         # Multi-queue mode: the queue tensors REPLICATE onto the job lanes
         # (deserved/allocated-at-open of each job's queue, plus the queue
@@ -1431,6 +1528,11 @@ class FusedAllocator:
             def replicate(x):
                 return x
 
+        t_rows = _mk.task_table_rows(tb)
+        run2 = jnp.pad(
+            run_dev.astype(jnp.int32), (0, t_rows * 128 - tb),
+            constant_values=1,
+        ).reshape(t_rows, 128)
         self._mega_args = (
             replicate(ns0),
             replicate(alloc_t),
@@ -1439,7 +1541,7 @@ class FusedAllocator:
             replicate(state.pods_limit.astype(jnp.float32)[None, :]),
             to_device(sig_req),
             to_device(task_sig),
-            replicate(run_dev.astype(jnp.int32).reshape(1, tb)),
+            replicate(run2),
             to_device(job_off),
             to_device(job_num),
             to_device(job_def),
@@ -1458,6 +1560,20 @@ class FusedAllocator:
             to_device(misc),
         )
         mins_f32 = np.asarray(policy.scaled_mins(r), dtype=np.float32)
+        # Cohort chunks engage only where a run can continue past a node's
+        # capacity cut: run batching live, no releasing ledger (pipelined
+        # placements end every pop), AND the host spill estimate says some
+        # cohort must actually split across nodes — every traced chunk
+        # multiplies the step's placement stage whether it engages or not,
+        # so sessions whose cohorts each fit one node keep the 1-chunk
+        # program.  The kernel re-gates the first two identically; this
+        # mirror keeps the evidence (`run_stats`) honest.
+        cohort_eff = (
+            self.cohort_chunks
+            if (self.batch_runs and not self.has_releasing and self.cohort_spill)
+            else 1
+        )
+        self.cohort_effective = cohort_eff
         self._mega_kw = dict(
             r_dim=r,
             weights=self.weights,
@@ -1475,6 +1591,8 @@ class FusedAllocator:
             multi_queue=multi_queue,
             queue_proportion="proportion" in self.queue_comparators,
             overused_gate=self.overused_gate,
+            cohort=cohort_eff,
+            t_cap=tb,
             mesh=mesh,
             interpret=_pk._interpret(),
         )
@@ -1518,6 +1636,8 @@ class FusedAllocator:
         try:
             self._encoded = None
             self._dev = None
+            self._dev_stats = None
+            self._stats_raw = None
             if eager_dispatch:
                 self.dispatch()
                 t0 = _time.perf_counter()
@@ -1764,17 +1884,11 @@ class FusedAllocator:
             sized = ssn.jobs.values() if jobs is None else jobs
             pending = sum(job.pending_eligible_count() for job in sized)
             t_bucket = bucket(max(pending, 1))
-            try:
-                limit = int(
-                    os.environ.get(
-                        "SCHEDULER_TPU_FUSED_STATIC_LIMIT", str(160 * 1024 * 1024)
-                    )
-                )
-            except ValueError:
-                logger.warning(
-                    "malformed SCHEDULER_TPU_FUSED_STATIC_LIMIT; using 160MiB default"
-                )
-                limit = 160 * 1024 * 1024
+            from scheduler_tpu.utils.envflags import env_int
+
+            limit = env_int(
+                "SCHEDULER_TPU_FUSED_STATIC_LIMIT", 160 * 1024 * 1024
+            )
             if 5 * t_bucket * n_bucket > limit:
                 return False
         if set(ssn.job_order_fns) - set(_KNOWN_JOB_ORDER):
@@ -1809,9 +1923,9 @@ class FusedAllocator:
         compile time).  NOTE: ranked/sorted batching (lexsort / top_k) is off
         the table on this TPU stack — those ops hang the axon compiler — so the
         scan stays one-task-at-a-time and speed comes from unrolling."""
-        import os
+        from scheduler_tpu.utils.envflags import env_int
 
-        return max(1, int(os.environ.get("SCHEDULER_TPU_WINDOW", "8")))
+        return env_int("SCHEDULER_TPU_WINDOW", 8, minimum=1)
 
     @property
     def args(self):
@@ -1898,11 +2012,14 @@ class FusedAllocator:
             from scheduler_tpu.ops import megakernel as _mk
 
             try:
-                self._dev = _mk.mega_allocate(*self._mega_args, **self._mega_kw)
+                self._dev, self._dev_stats = _mk.mega_allocate(
+                    *self._mega_args, **self._mega_kw
+                )
                 return
             except Exception:  # pragma: no cover - backend-specific
                 logger.exception("mega kernel failed; falling back to XLA path")
                 self.use_mega = False
+        self._dev_stats = None
         self._dev = fused_allocate(
             *self.args,
             comparators=self.comparators,
@@ -1926,8 +2043,12 @@ class FusedAllocator:
         if self._dev is None:
             self.dispatch()
         dev, self._dev = self._dev, None
+        stats_dev, self._dev_stats = self._dev_stats, None
         try:
             encoded = self._readback(dev)
+            self._stats_raw = (
+                np.asarray(stats_dev) if stats_dev is not None else None
+            )
         except Exception:  # pragma: no cover - backend-specific
             if not self.use_mega:
                 raise
@@ -1938,6 +2059,41 @@ class FusedAllocator:
             return self.readback()
         self._encoded = encoded
         return encoded
+
+    def run_stats(self) -> dict:
+        """Cohort/step evidence of the last executed device program — the
+        ``phases.note()`` payload allocate records per cycle so the bench
+        artifact can PROVE the cohort path engaged (number of cohorts, loop
+        steps, tasks placed per step, chunk placements, fallback steps).
+        Device counters exist on the mega path only; the XLA paths report
+        the host-side cohort table and placement count."""
+        out = {
+            "engine": (
+                "mega" if self.use_mega
+                else ("step_kernel" if self.step_kernel else "xla")
+            ),
+            "cohorts": self.cohort_count,
+            "cohort_chunks": self.cohort_effective if self.use_mega else 1,
+        }
+        enc = self._encoded
+        if enc is not None:
+            t = self.flat_count
+            codes = enc[:t]
+            out["placed"] = int(
+                ((codes >= 0) | (codes <= _PIPE_BASE)).sum()
+            )
+        raw = self._stats_raw
+        if raw is not None:
+            from scheduler_tpu.ops import megakernel as _mk
+
+            steps = int(raw[_mk.STATS_STEPS])
+            out["steps"] = steps
+            out["cohort_steps"] = int(raw[_mk.STATS_COHORT_STEPS])
+            out["chunk_placed"] = int(raw[_mk.STATS_CHUNK_PLACED])
+            out["fallback_steps"] = steps - out["cohort_steps"]
+            if steps > 0 and "placed" in out:
+                out["tasks_per_step"] = round(out["placed"] / steps, 2)
+        return out
 
     def _execute(self) -> np.ndarray:
         self._dev = None  # force a fresh launch (parity tests flip engine flags)

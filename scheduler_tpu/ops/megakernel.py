@@ -15,11 +15,39 @@ ride a second VMEM ledger (pipelined placements, ``-3 - node`` codes),
 static [T, N] mask/score tensors dedupe into per-signature VMEM rows, and
 batched identical-request runs carry the top-2 score bound in-kernel — so
 the kernel now also covers churn states mid-eviction and predicates/
-nodeorder sessions.  The host shim (``FusedAllocator``) gates on
-``mega_supported`` and falls back to the XLA program otherwise;
-``tests/test_megakernel.py`` asserts the gate engages and pins the two
-programs bit-for-bit (the three-engine and fuzz parity suites exercise the
-kernel against the host loop as well).
+nodeorder sessions.  Round 5 added multi-queue proportion selection on the
+job lanes.  The host shim (``FusedAllocator``) gates on ``mega_supported``
+and falls back to the XLA program otherwise; ``tests/test_megakernel.py``
+asserts the gate engages and pins the two programs bit-for-bit (the
+three-engine and fuzz parity suites exercise the kernel against the host
+loop as well).
+
+COHORT PLACEMENT (round 6, docs/COHORT.md): the engine build groups each
+job's pending tasks into cohorts of identical shape — the ``req_sig``
+task-order tie-break plus the static-signature run merge already make those
+cohorts contiguous runs in flat task order.  Two kernel-side changes exploit
+that structure:
+
+* **Multi-chunk cohort steps.**  One loop step used to place at most one
+  batched run segment on ONE node, ending the step whenever that node's
+  capacity (epsilon fit, pod count, top-2 score bound) cut the batch.  With
+  ``cohort > 1`` the step body unrolls up to ``cohort`` placement *chunks*:
+  each chunk re-runs the full fit + score + masked-argmax selection stage on
+  the live VMEM ledgers and places the next segment of the SAME cohort —
+  so a cohort that spills across several nodes drains in one step.  Chunks
+  skip only what is provably invariant inside a cohort (job selection, the
+  task-table reads); every placement decision is recomputed exactly, so the
+  codes are bit-identical to the one-chunk scan (the cohort parity suite,
+  ``tests/test_cohort_parity.py``).  Chunks disengage — falling back to the
+  one-segment step — whenever the scan could diverge: the pop ends (first
+  infeasible task, gang went ready, job drained), the run is exhausted, the
+  session has releasing capacity (pipelined placements end every pop), or a
+  dirty re-entered job makes the cross-job cursor order non-trivial.
+* **Windowed cohort tables.**  The per-task signature / run-length /
+  static-signature columns are laid out ``[ceil(T/128), 128]`` and read with
+  a dynamic 1-row sublane window + 128-lane masked reduce, instead of the
+  full-width ``[1, T]`` masked reduce that cost ~T/128 vregs per read —
+  at 100k tasks those three reads were the largest per-step cost left.
 
 Layout notes (mosaic on this TPU stack):
 
@@ -28,12 +56,17 @@ Layout notes (mosaic on this TPU stack):
 * Dynamic LANE indexing is not available (lowering bug / SIGABRT on roll),
   so every "read column j" is a masked reduce and every "update column j"
   is a masked add — each one full-width VPU op, which is exactly the
-  per-step cost model the kernel optimizes for.
+  per-step cost model the kernel optimizes for.  Dynamic SUBLANE slicing IS
+  available (``pl.ds``), which is what the windowed cohort-table reads and
+  the 2-row result write window ride.
 * Requests are stored per-SIGNATURE ([16, S]: req rows 0..7, init rows
   8..15) with an i32 signature id per task — identical-request runs share
   rows, which caps VMEM at a few MB for 100k tasks.
-* Scalar loop state (current job, cursor, dirty count) is the
-  ``lax.while_loop`` carry; misc dynamic counts arrive via one SMEM vector.
+* Scalar loop state (current job, cursor, dirty count, evidence counters)
+  is the ``lax.while_loop`` carry; misc dynamic counts arrive via one SMEM
+  vector, and the step/cohort evidence counters leave through a second
+  (SMEM) output so the host can prove the cohort path engaged
+  (bench ``detail.cycles[].cohort``).
 """
 
 from __future__ import annotations
@@ -56,9 +89,23 @@ MAX_BATCH = 128
 
 _BIG_I32 = 2**31 - 1
 
+# Stats row layout (second kernel output, i32[8]):
+#   [0] loop steps taken
+#   [1] steps where the cohort chunk path engaged (chunk 1 ran)
+#   [2] placements made by chunks >= 1 (the multi-node cohort surplus)
+STATS_STEPS = 0
+STATS_COHORT_STEPS = 1
+STATS_CHUNK_PLACED = 2
+
 
 def _lane_iota(shape):
     return jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+
+
+def task_table_rows(t_pad: int) -> int:
+    """Rows of the windowed [rows, 128] cohort-table layout for a t_pad-long
+    per-task column (task_sig / run_len / msig)."""
+    return max(1, -(-t_pad // 128))
 
 
 def mega_supported(
@@ -106,7 +153,7 @@ def mega_supported(
         "cross_batch", "batch_runs", "has_releasing", "use_static",
         "score_bound", "mins", "cpu_idx", "mem_idx",
         "multi_queue", "queue_proportion", "overused_gate",
-        "mesh", "interpret",
+        "cohort", "t_cap", "mesh", "interpret",
     ),
 )
 def mega_allocate(
@@ -116,8 +163,8 @@ def mega_allocate(
     gate: jnp.ndarray,       # bool [1, N]
     plim: jnp.ndarray,       # f32 [1, N]
     sig_req: jnp.ndarray,    # f32 [16, S]  rows 0..7 resreq, 8..15 init_resreq
-    task_sig: jnp.ndarray,   # i32 [1, T]
-    run_len: jnp.ndarray,    # i32 [1, T]
+    task_sig: jnp.ndarray,   # i32 [Tr, 128] cohort table: signature id/task
+    run_len: jnp.ndarray,    # i32 [Tr, 128] cohort table: run length/task
     job_off: jnp.ndarray,    # i32 [1, J]
     job_num: jnp.ndarray,    # i32 [1, J]
     job_deficit: jnp.ndarray,   # i32 [1, J] ready-break deficit
@@ -127,7 +174,7 @@ def mega_allocate(
     js_drf0: jnp.ndarray,    # f32 [8, J] drf allocated at session open
     drf_safe: jnp.ndarray,   # f32 [8, 1] totals (1 where absent)
     drf_mask: jnp.ndarray,   # f32 [8, 1] 1 where total > 0
-    msig: jnp.ndarray,       # i32 [1, T] static-signature id per task
+    msig: jnp.ndarray,       # i32 [Tr, 128] cohort table: static-sig id/task
     smask: jnp.ndarray,      # f32 [S_pad, N] static mask rows (1.0/0.0)
     sscore: jnp.ndarray,     # f32 [S_pad, N] static score rows
     jqueue: jnp.ndarray,     # i32 [1, J] queue index per job — doubles as the
@@ -153,27 +200,40 @@ def mega_allocate(
     queue_proportion: bool,
     overused_gate: bool,
     interpret: bool,
+    cohort: int = 1,
+    t_cap: int = 0,
     mesh=None,
-) -> jnp.ndarray:
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     n = ns0.shape[1]
-    t_pad = task_sig.shape[1]
+    t_rows = task_sig.shape[0]
+    t_pad = t_rows * 128
+    if t_cap <= 0:
+        t_cap = t_pad
     j_pad = job_off.shape[1]
     s_pad = smask.shape[0]
+    # Cohort chunks require a run to continue past a capacity cut: no run
+    # batching means no cohorts, and a releasing ledger means pops can end
+    # on pipelined placements chunks do not model.  Downgrading HERE (not at
+    # the caller) makes the gate impossible to bypass.
+    if not batch_runs or has_releasing:
+        cohort = 1
+    cohort = max(1, int(cohort))
     # The 2-row write window must fit even when rowlo is the last real row.
-    t_sub = (t_pad - 1) // 128 + 2
+    t_sub = t_rows + 1
     lr_w, bal_w, bp_w = (float(w) for w in weights)
-    max_steps = t_pad + 8
+    max_steps = t_cap + 8
 
     def kernel(ns0_ref, alloc_ref, rel0_ref, gate_ref, plim_ref, sigr_ref,
                tsig_ref, rlen_ref, joff_ref, jnum_ref, jdef_ref, jgang_ref,
                jprio_ref, jtb_ref, jdrf0_ref, dsafe_ref, dmask_ref,
                msig_ref, smask_ref, sscore_ref, jq_ref, jqd_ref, jqa0_ref,
-               misc_ref, out_ref, ns, js):
+               misc_ref, out_ref, stats_ref, ns, js):
         neg_inf = float("-inf")
         pos_inf = float("inf")
         lane_n = _lane_iota((1, n))
         lane_j = _lane_iota((1, j_pad))
         lane_s = _lane_iota((1, sigr_ref.shape[1]))
+        lane_w = _lane_iota((1, 128))
 
         # State into VMEM scratch; result initialized to UNPLACED.
         # Layout: rows [0..8) idle, row 8 task_count, rows [16..24) the
@@ -212,8 +272,18 @@ def mega_allocate(
         def read_f32(vec, lanes, idx):
             return jnp.sum(jnp.where(lanes == idx, vec, 0.0))
 
+        def read_task_i32(ref, idx):
+            """Windowed cohort-table read: dynamic 1-row sublane slice +
+            128-lane masked reduce.  Replaces the full-width [1, T] masked
+            reduce (~T/128 vregs per read; at 100k tasks the three per-step
+            task reads were the largest remaining step cost)."""
+            rowlo = idx // 128
+            row = ref[pl.ds(rowlo, 1), :]
+            return jnp.max(jnp.where(lane_w == idx - rowlo * 128, row,
+                                     jnp.int32(-_BIG_I32 - 1)))
+
         def body(state):
-            cur, cursor, n_dirty, steps = state
+            cur, cursor, n_dirty, steps, coh_steps, chunk_pl = state
 
             # ---- selection (branchless; matches fused.py cursor mode, or
             # its full queue+job chain in multi-queue mode) ----
@@ -300,18 +370,17 @@ def mega_allocate(
             off = read_i32(joff, lane_j, cur_safe)
             num_v = read_i32(jnum, lane_j, cur_safe)
             deficit_v = read_i32(jdef, lane_j, cur_safe)
+            deficit_f = deficit_v.astype(jnp.float32)
+            num_f = num_v.astype(jnp.float32)
 
             t_idx = jnp.clip(off + cons.astype(jnp.int32), 0, t_pad - 1)
-            lane_t = _lane_iota((1, t_pad))
-            sig = read_i32(tsig_ref[:], lane_t, t_idx)
-            rl = read_i32(rlen_ref[:], lane_t, t_idx)
+            sig = read_task_i32(tsig_ref, t_idx)
+            rl = read_task_i32(rlen_ref, t_idx)
             if use_static:
                 # Per-signature static mask/score rows (deduped host-side);
                 # dynamic SUBLANE slicing is supported (same pattern as the
                 # out_ref window write below).
-                ms = jnp.clip(
-                    read_i32(msig_ref[:], lane_t, t_idx), 0, s_pad - 1
-                )
+                ms = jnp.clip(read_task_i32(msig_ref, t_idx), 0, s_pad - 1)
                 mrow = smask_ref[pl.ds(ms, 1), :]
                 srow = sscore_ref[pl.ds(ms, 1), :]
 
@@ -321,258 +390,334 @@ def mega_allocate(
                 reqs.append(read_f32(sigr_ref[r : r + 1, :], lane_s, sig))
                 initqs.append(read_f32(sigr_ref[8 + r : 8 + r + 1, :], lane_s, sig))
 
-            # ---- fit + score + masked argmax (rows unrolled) ----
-            feas_idle = gate_v
-            for r in range(r_dim):
-                idle_r = ns[r : r + 1, :]
-                feas_idle = feas_idle & (
-                    (initqs[r] < idle_r) | (jnp.abs(idle_r - initqs[r]) < mins[r])
-                )
-            if has_releasing:
-                # The idle-OR-releasing pre-predicate (allocate.go:80-93):
-                # a task that fits what a releasing victim will free may
-                # PIPELINE onto it.
-                feas_rel = gate_v
+            single0 = num_v == 1
+
+            # ---- cohort chunk loop ----------------------------------------
+            # Chunk 0 is the ordinary placement micro-step; chunks 1..C-1
+            # re-run ONLY its placement stage on the live ledgers and place
+            # the next segment of the SAME cohort (same job or the cursor's
+            # next single-task job of a cross-job run, same request
+            # signature).  Everything a chunk skips — job selection, the
+            # task-table reads — is provably invariant while the cohort
+            # continues, so each chunk is bit-for-bit the step the
+            # sequential scan would have taken next (docs/COHORT.md).
+            act = cur2 >= 0
+            jb = cur_safe          # job-lane base of the current chunk
+            t_c = t_idx            # flat task cursor of the current chunk
+            rl_c = rl              # remaining run length at t_c
+            cons_c = cons          # consumed-in-job before this chunk (f32)
+            nalloc_c = nalloc      # allocated-in-job before this chunk (f32)
+            cur_r = cur2           # running pop state (HALT preserved)
+            cursor_r = cursor2
+            dirty_r = n_dirty2
+            coh_steps2 = coh_steps
+            chunk_pl2 = chunk_pl
+
+            for c in range(cohort):
+                # ---- fit + score + masked argmax (rows unrolled) ----
+                feas_idle = gate_v
                 for r in range(r_dim):
-                    rel_r = ns[16 + r : 16 + r + 1, :]
-                    feas_rel = feas_rel & (
-                        (initqs[r] < rel_r)
-                        | (jnp.abs(rel_r - initqs[r]) < mins[r])
+                    idle_r = ns[r : r + 1, :]
+                    feas_idle = feas_idle & (
+                        (initqs[r] < idle_r)
+                        | (jnp.abs(idle_r - initqs[r]) < mins[r])
                     )
-                feas = feas_idle | feas_rel
-            else:
-                feas = feas_idle
-            if use_static:
-                feas = feas & (mrow > 0.0)
-            if enforce_pod_count:
-                feas = feas & (ns[8:9, :] < plim_v)
+                if has_releasing:
+                    # The idle-OR-releasing pre-predicate (allocate.go:80-93):
+                    # a task that fits what a releasing victim will free may
+                    # PIPELINE onto it.
+                    feas_rel = gate_v
+                    for r in range(r_dim):
+                        rel_r = ns[16 + r : 16 + r + 1, :]
+                        feas_rel = feas_rel & (
+                            (initqs[r] < rel_r)
+                            | (jnp.abs(rel_r - initqs[r]) < mins[r])
+                        )
+                    feas = feas_idle | feas_rel
+                else:
+                    feas = feas_idle
+                if use_static:
+                    feas = feas & (mrow > 0.0)
+                if enforce_pod_count:
+                    feas = feas & (ns[8:9, :] < plim_v)
 
-            score = jnp.zeros((1, n), jnp.float32)
-            if lr_w or bal_w or bp_w:
-                a_c = alloc_ref[cpu_idx : cpu_idx + 1, :]
-                a_m = alloc_ref[mem_idx : mem_idx + 1, :]
-                safe_c = jnp.where(a_c > 0, a_c, 1.0)
-                safe_m = jnp.where(a_m > 0, a_m, 1.0)
-                req_c = a_c - ns[cpu_idx : cpu_idx + 1, :] + reqs[cpu_idx]
-                req_m = a_m - ns[mem_idx : mem_idx + 1, :] + reqs[mem_idx]
-                if bp_w:
-                    fc = jnp.clip(req_c / safe_c, 0.0, 1.0)
-                    fm = jnp.clip(req_m / safe_m, 0.0, 1.0)
-                    score = score + bp_w * (((fc + fm) / 2.0) * 10.0)
-                if lr_w:
-                    lc = jnp.clip((a_c - req_c) / safe_c, 0.0, 1.0)
-                    lm = jnp.clip((a_m - req_m) / safe_m, 0.0, 1.0)
-                    score = score + lr_w * (((lc + lm) / 2.0) * 10.0)
-                if bal_w:
-                    fc = jnp.clip(req_c / safe_c, 0.0, 1.0)
-                    fm = jnp.clip(req_m / safe_m, 0.0, 1.0)
-                    score = score + bal_w * ((1.0 - jnp.abs(fc - fm)) * 10.0)
-            if use_static:
-                score = score + srow
+                score = jnp.zeros((1, n), jnp.float32)
+                if lr_w or bal_w or bp_w:
+                    a_c = alloc_ref[cpu_idx : cpu_idx + 1, :]
+                    a_m = alloc_ref[mem_idx : mem_idx + 1, :]
+                    safe_c = jnp.where(a_c > 0, a_c, 1.0)
+                    safe_m = jnp.where(a_m > 0, a_m, 1.0)
+                    req_c = a_c - ns[cpu_idx : cpu_idx + 1, :] + reqs[cpu_idx]
+                    req_m = a_m - ns[mem_idx : mem_idx + 1, :] + reqs[mem_idx]
+                    if bp_w:
+                        fc = jnp.clip(req_c / safe_c, 0.0, 1.0)
+                        fm = jnp.clip(req_m / safe_m, 0.0, 1.0)
+                        score = score + bp_w * (((fc + fm) / 2.0) * 10.0)
+                    if lr_w:
+                        lc = jnp.clip((a_c - req_c) / safe_c, 0.0, 1.0)
+                        lm = jnp.clip((a_m - req_m) / safe_m, 0.0, 1.0)
+                        score = score + lr_w * (((lc + lm) / 2.0) * 10.0)
+                    if bal_w:
+                        fc = jnp.clip(req_c / safe_c, 0.0, 1.0)
+                        fm = jnp.clip(req_m / safe_m, 0.0, 1.0)
+                        score = score + bal_w * ((1.0 - jnp.abs(fc - fm)) * 10.0)
+                if use_static:
+                    score = score + srow
 
-            masked = jnp.where(feas, score, neg_inf)
-            maxv = jnp.max(masked)
-            any_feasible = maxv > neg_inf
-            best = jnp.minimum(
-                jnp.min(jnp.where(masked == maxv, lane_n, jnp.int32(n))),
-                jnp.int32(n - 1),
-            )
-
-            active = cur2 >= 0
-            placed = active & any_feasible
-            failed = active & ~any_feasible
-            single_pop = num_v == 1
-            if has_releasing:
-                alloc_best = (
-                    jnp.max(
-                        jnp.where(lane_n == best, feas_idle.astype(jnp.int32), 0)
-                    )
-                    > 0
+                masked = jnp.where(feas, score, neg_inf)
+                maxv = jnp.max(masked)
+                any_feasible = maxv > neg_inf
+                best = jnp.minimum(
+                    jnp.min(jnp.where(masked == maxv, lane_n, jnp.int32(n))),
+                    jnp.int32(n - 1),
                 )
-                alloc_here = placed & alloc_best
-                pipe_here = placed & ~alloc_best
-            else:
-                alloc_here = placed
-                pipe_here = jnp.asarray(False)
 
-            # ---- run batching (binpack-exact; no score bound here) ----
-            if batch_runs:
-                room = jnp.where(
-                    deficit_v > 0, deficit_v - nalloc.astype(jnp.int32), 1
+                placed = act & any_feasible
+                failed = act & ~any_feasible
+                if has_releasing:
+                    alloc_best = (
+                        jnp.max(
+                            jnp.where(lane_n == best, feas_idle.astype(jnp.int32), 0)
+                        )
+                        > 0
+                    )
+                    alloc_here = placed & alloc_best
+                    pipe_here = placed & ~alloc_best
+                else:
+                    alloc_here = placed
+                    pipe_here = jnp.asarray(False)
+
+                # ---- run batching (binpack-exact; top-2 bound otherwise) --
+                if batch_runs:
+                    room = jnp.where(
+                        deficit_v > 0, deficit_v - nalloc_c.astype(jnp.int32), 1
+                    )
+                    if cross_batch:
+                        room = jnp.where(
+                            single0 & (dirty_r == 0), jnp.int32(MAX_BATCH), room
+                        )
+                    hi0 = jnp.minimum(rl_c, jnp.int32(MAX_BATCH))
+                    hi0 = jnp.minimum(hi0, room)
+                    if enforce_pod_count:
+                        pl_best = read_f32(plim_v, lane_n, best)
+                        tc_best = read_f32(ns[8:9, :], lane_n, best)
+                        hi0 = jnp.minimum(
+                            hi0, (pl_best - tc_best).astype(jnp.int32)
+                        )
+                    hi0 = jnp.maximum(hi0, 1)
+                    js_vec = _lane_iota((1, MAX_BATCH)) + 1
+                    ok = jnp.ones((1, MAX_BATCH), dtype=bool)
+                    for r in range(r_dim):
+                        idle_br = read_f32(ns[r : r + 1, :], lane_n, best)
+                        avail_r = idle_br - (js_vec - 1).astype(jnp.float32) * reqs[r]
+                        ok = ok & (
+                            (initqs[r] < avail_r)
+                            | (jnp.abs(avail_r - initqs[r]) < mins[r])
+                        )
+                    if score_bound:
+                        # Top-2 bound (fused.py score_bound block): placement j
+                        # still picks `best` iff its score after j-1 placements
+                        # beats the runner-up; ties break to the lower index.
+                        # Prefix semantics via first-failure position (no
+                        # cumprod on this backend).
+                        others = jnp.where(lane_n == best, neg_inf, masked)
+                        second = jnp.max(others)
+                        second_idx = jnp.min(
+                            jnp.where(others == second, lane_n, jnp.int32(n))
+                        )
+                        a_c_b = read_f32(
+                            alloc_ref[cpu_idx : cpu_idx + 1, :], lane_n, best
+                        )
+                        a_m_b = read_f32(
+                            alloc_ref[mem_idx : mem_idx + 1, :], lane_n, best
+                        )
+                        idle_c_b = read_f32(
+                            ns[cpu_idx : cpu_idx + 1, :], lane_n, best
+                        )
+                        idle_m_b = read_f32(
+                            ns[mem_idx : mem_idx + 1, :], lane_n, best
+                        )
+                        jm1 = (js_vec - 1).astype(jnp.float32)
+                        avail_c = idle_c_b - jm1 * reqs[cpu_idx]
+                        avail_m = idle_m_b - jm1 * reqs[mem_idx]
+                        safe_cb = jnp.where(a_c_b > 0, a_c_b, 1.0)
+                        safe_mb = jnp.where(a_m_b > 0, a_m_b, 1.0)
+                        reqd_c = a_c_b - avail_c + reqs[cpu_idx]
+                        reqd_m = a_m_b - avail_m + reqs[mem_idx]
+                        s_js = jnp.zeros((1, MAX_BATCH), jnp.float32)
+                        if bp_w:
+                            fc = jnp.clip(reqd_c / safe_cb, 0.0, 1.0)
+                            fm = jnp.clip(reqd_m / safe_mb, 0.0, 1.0)
+                            s_js = s_js + bp_w * (((fc + fm) / 2.0) * 10.0)
+                        if lr_w:
+                            lc = jnp.clip((a_c_b - reqd_c) / safe_cb, 0.0, 1.0)
+                            lm = jnp.clip((a_m_b - reqd_m) / safe_mb, 0.0, 1.0)
+                            s_js = s_js + lr_w * (((lc + lm) / 2.0) * 10.0)
+                        if bal_w:
+                            fc = jnp.clip(reqd_c / safe_cb, 0.0, 1.0)
+                            fm = jnp.clip(reqd_m / safe_mb, 0.0, 1.0)
+                            s_js = s_js + bal_w * ((1.0 - jnp.abs(fc - fm)) * 10.0)
+                        if use_static:
+                            s_js = s_js + read_f32(srow, lane_n, best)
+                        ok_s = (s_js > second) | (
+                            (s_js == second) & (best < second_idx)
+                        )
+                        first_false = jnp.min(
+                            jnp.where(~ok_s, js_vec, jnp.int32(MAX_BATCH + 1))
+                        )
+                        ok = ok & (js_vec < first_false)
+                    fit_count = jnp.max(jnp.where(ok & (js_vec <= hi0), js_vec, 1))
+                    m = jnp.where(alloc_here, fit_count, 1)
+                else:
+                    m = jnp.int32(1)
+                cross_active = (
+                    (single0 & alloc_here) if cross_batch else jnp.asarray(False)
+                )
+
+                consumed = jnp.where(
+                    alloc_here, m, (pipe_here | failed).astype(jnp.int32)
+                )
+                m_alloc = jnp.where(alloc_here, m, 0).astype(jnp.float32)
+                pipe_f = pipe_here.astype(jnp.float32) if has_releasing else 0.0
+
+                # ---- node ledger update (masked column add) ----
+                eq_n = (lane_n == best).astype(jnp.float32)
+                for r in range(r_dim):
+                    ns[r : r + 1, :] = ns[r : r + 1, :] - (reqs[r] * m_alloc) * eq_n
+                if has_releasing:
+                    for r in range(r_dim):
+                        ns[16 + r : 16 + r + 1, :] = (
+                            ns[16 + r : 16 + r + 1, :] - (reqs[r] * pipe_f) * eq_n
+                        )
+                    ns[8:9, :] = ns[8:9, :] + (m_alloc + pipe_f) * eq_n
+                else:
+                    ns[8:9, :] = ns[8:9, :] + m_alloc * eq_n
+
+                # ---- job ledger update (masked window add) ----
+                k = jnp.where(cross_active, m, 1)
+                win = ((lane_j >= jb) & (lane_j < jb + k)).astype(jnp.float32)
+                cons_add = jnp.where(
+                    cross_active, 1.0, consumed.astype(jnp.float32)
+                )
+                alloc_add = jnp.where(cross_active, 1.0, m_alloc)
+                left_add = jnp.where(
+                    cross_active, 0.0, failed.astype(jnp.float32)
+                )
+                js[0:1, :] = js[0:1, :] + cons_add * win
+                js[1:2, :] = js[1:2, :] + alloc_add * win
+                js[2:3, :] = js[2:3, :] + left_add * win
+                drf_scale = jnp.where(cross_active, 1.0, m_alloc + pipe_f)
+                for r in range(r_dim):
+                    js[8 + r : 8 + r + 1, :] = (
+                        js[8 + r : 8 + r + 1, :] + (reqs[r] * drf_scale) * win
+                    )
+                if multi_queue:
+                    # proportion's allocate handler: the placement grows the
+                    # queue's allocated (proportion.go:236-246) — replicated
+                    # to EVERY lane whose job shares the selected job's queue.
+                    q_sel = read_i32(jq_v, lane_j, jb)
+                    qwin = (jq_v == q_sel).astype(jnp.float32)
+                    for r in range(r_dim):
+                        js[16 + r : 16 + r + 1, :] = (
+                            js[16 + r : 16 + r + 1, :] + (reqs[r] * drf_scale) * qwin
+                        )
+
+                # ---- result write (2-row window around t_c) ----
+                code = jnp.where(
+                    alloc_here,
+                    best,
+                    jnp.where(
+                        pipe_here,
+                        jnp.int32(PIPE_BASE) - best,
+                        jnp.where(failed, jnp.int32(FAILED), jnp.int32(UNPLACED)),
+                    ),
+                )
+                wcount = jnp.where(act, consumed, 0)
+                rowlo = t_c // 128
+                blk = out_ref[pl.ds(rowlo, 2), :]
+                gidx = (
+                    rowlo * 128
+                    + jax.lax.broadcasted_iota(jnp.int32, (2, 128), 0) * 128
+                    + jax.lax.broadcasted_iota(jnp.int32, (2, 128), 1)
+                )
+                wmask = (gidx >= t_c) & (gidx < t_c + wcount)
+                out_ref[pl.ds(rowlo, 2), :] = jnp.where(wmask, code, blk)
+
+                # ---- pop end / running scalars ----
+                row_after_alloc = nalloc_c + jnp.where(cross_active, 1.0, m_alloc)
+                became_ready = placed & (row_after_alloc >= deficit_f)
+                cons_after = cons_c + jnp.where(
+                    cross_active, 1.0, consumed.astype(jnp.float32)
+                )
+                drained = cons_after >= num_f
+                end_pop = failed | became_ready | drained
+                cur_r = jnp.where(
+                    act,
+                    jnp.where(~end_pop, jb, jnp.int32(-1)),
+                    cur_r,
+                )
+                dirty_r = dirty_r + (act & became_ready & ~drained).astype(
+                    jnp.int32
                 )
                 if cross_batch:
-                    room = jnp.where(
-                        single_pop & (n_dirty2 == 0), jnp.int32(MAX_BATCH), room
-                    )
-                hi0 = jnp.minimum(rl, jnp.int32(MAX_BATCH))
-                hi0 = jnp.minimum(hi0, room)
-                if enforce_pod_count:
-                    pl_best = read_f32(plim_v, lane_n, best)
-                    tc_best = read_f32(ns[8:9, :], lane_n, best)
-                    hi0 = jnp.minimum(
-                        hi0, (pl_best - tc_best).astype(jnp.int32)
-                    )
-                hi0 = jnp.maximum(hi0, 1)
-                js_vec = _lane_iota((1, MAX_BATCH)) + 1
-                ok = jnp.ones((1, MAX_BATCH), dtype=bool)
-                for r in range(r_dim):
-                    idle_br = read_f32(ns[r : r + 1, :], lane_n, best)
-                    avail_r = idle_br - (js_vec - 1).astype(jnp.float32) * reqs[r]
-                    ok = ok & (
-                        (initqs[r] < avail_r)
-                        | (jnp.abs(avail_r - initqs[r]) < mins[r])
-                    )
-                if score_bound:
-                    # Top-2 bound (fused.py score_bound block): placement j
-                    # still picks `best` iff its score after j-1 placements
-                    # beats the runner-up; ties break to the lower index.
-                    # Prefix semantics via first-failure position (no cumprod
-                    # on this backend).
-                    others = jnp.where(lane_n == best, neg_inf, masked)
-                    second = jnp.max(others)
-                    second_idx = jnp.min(
-                        jnp.where(others == second, lane_n, jnp.int32(n))
-                    )
-                    a_c_b = read_f32(
-                        alloc_ref[cpu_idx : cpu_idx + 1, :], lane_n, best
-                    )
-                    a_m_b = read_f32(
-                        alloc_ref[mem_idx : mem_idx + 1, :], lane_n, best
-                    )
-                    idle_c_b = read_f32(
-                        ns[cpu_idx : cpu_idx + 1, :], lane_n, best
-                    )
-                    idle_m_b = read_f32(
-                        ns[mem_idx : mem_idx + 1, :], lane_n, best
-                    )
-                    jm1 = (js_vec - 1).astype(jnp.float32)
-                    avail_c = idle_c_b - jm1 * reqs[cpu_idx]
-                    avail_m = idle_m_b - jm1 * reqs[mem_idx]
-                    safe_cb = jnp.where(a_c_b > 0, a_c_b, 1.0)
-                    safe_mb = jnp.where(a_m_b > 0, a_m_b, 1.0)
-                    reqd_c = a_c_b - avail_c + reqs[cpu_idx]
-                    reqd_m = a_m_b - avail_m + reqs[mem_idx]
-                    s_js = jnp.zeros((1, MAX_BATCH), jnp.float32)
-                    if bp_w:
-                        fc = jnp.clip(reqd_c / safe_cb, 0.0, 1.0)
-                        fm = jnp.clip(reqd_m / safe_mb, 0.0, 1.0)
-                        s_js = s_js + bp_w * (((fc + fm) / 2.0) * 10.0)
-                    if lr_w:
-                        lc = jnp.clip((a_c_b - reqd_c) / safe_cb, 0.0, 1.0)
-                        lm = jnp.clip((a_m_b - reqd_m) / safe_mb, 0.0, 1.0)
-                        s_js = s_js + lr_w * (((lc + lm) / 2.0) * 10.0)
-                    if bal_w:
-                        fc = jnp.clip(reqd_c / safe_cb, 0.0, 1.0)
-                        fm = jnp.clip(reqd_m / safe_mb, 0.0, 1.0)
-                        s_js = s_js + bal_w * ((1.0 - jnp.abs(fc - fm)) * 10.0)
-                    if use_static:
-                        s_js = s_js + read_f32(srow, lane_n, best)
-                    ok_s = (s_js > second) | (
-                        (s_js == second) & (best < second_idx)
-                    )
-                    first_false = jnp.min(
-                        jnp.where(~ok_s, js_vec, jnp.int32(MAX_BATCH + 1))
-                    )
-                    ok = ok & (js_vec < first_false)
-                fit_count = jnp.max(jnp.where(ok & (js_vec <= hi0), js_vec, 1))
-                m = jnp.where(alloc_here, fit_count, 1)
-            else:
-                m = jnp.int32(1)
-            cross_active = (
-                (single_pop & alloc_here) if cross_batch else jnp.asarray(False)
-            )
+                    if c == 0:
+                        cursor_r = cursor_r + jnp.where(cross_active, m - 1, 0)
+                    else:
+                        # A chunk that ran via the cursor cheap-sel emulation
+                        # replays the selection's +1 advance plus the
+                        # cross-batch m-1, i.e. +m per retired single-task
+                        # job batch (and +1 when the head's placement failed,
+                        # exactly like a real selection followed by a fail).
+                        sel_adv = act & single0
+                        cursor_r = (
+                            cursor_r
+                            + sel_adv.astype(jnp.int32)
+                            + jnp.where(cross_active, m - 1, 0)
+                        )
+                if c >= 1:
+                    # Evidence counts ALLOCATIONS only — a chunk whose
+                    # placement failed consumed a task but placed nothing,
+                    # and "chunk_placed > 0" must mean real multi-node wins.
+                    chunk_pl2 = chunk_pl2 + jnp.where(act & alloc_here, m, 0)
 
-            consumed = jnp.where(
-                alloc_here, m, (pipe_here | failed).astype(jnp.int32)
-            )
-            m_alloc = jnp.where(alloc_here, m, 0).astype(jnp.float32)
-            pipe_f = pipe_here.astype(jnp.float32) if has_releasing else 0.0
-
-            # ---- node ledger update (masked column add) ----
-            eq_n = (lane_n == best).astype(jnp.float32)
-            for r in range(r_dim):
-                ns[r : r + 1, :] = ns[r : r + 1, :] - (reqs[r] * m_alloc) * eq_n
-            if has_releasing:
-                for r in range(r_dim):
-                    ns[16 + r : 16 + r + 1, :] = (
-                        ns[16 + r : 16 + r + 1, :] - (reqs[r] * pipe_f) * eq_n
+                if c + 1 < cohort:
+                    # Continue the cohort into another chunk only when the
+                    # sequential scan's next step is provably this same
+                    # cohort: the run has tasks left AND either the pop
+                    # continues on the same job (in-job) or the retired
+                    # single-task batch hands to the cursor's next head with
+                    # no dirty job that could outrank it (cross).
+                    cont_injob = act & alloc_here & ~end_pop & (rl_c > consumed)
+                    if cross_batch:
+                        cont_cross = (
+                            act & cross_active & (dirty_r == 0) & (rl_c > m)
+                        )
+                    else:
+                        cont_cross = jnp.asarray(False)
+                    act_next = cont_injob | cont_cross
+                    if c == 0:
+                        coh_steps2 = coh_steps2 + act_next.astype(jnp.int32)
+                    step_used = jnp.where(act, consumed, 0)
+                    t_c = jnp.minimum(t_c + step_used, jnp.int32(t_pad - 1))
+                    rl_c = rl_c - step_used
+                    adv_f = jnp.where(
+                        act,
+                        jnp.where(cross_active, 1.0, consumed.astype(jnp.float32)),
+                        0.0,
                     )
-                ns[8:9, :] = ns[8:9, :] + (m_alloc + pipe_f) * eq_n
-            else:
-                ns[8:9, :] = ns[8:9, :] + m_alloc * eq_n
+                    if cross_batch:
+                        jb = jnp.where(cont_cross, jb + m, jb)
+                        cons_c = jnp.where(cont_cross, 0.0, cons_c + adv_f)
+                        nalloc_c = jnp.where(cont_cross, 0.0, nalloc_c + m_alloc)
+                    else:
+                        cons_c = cons_c + adv_f
+                        nalloc_c = nalloc_c + m_alloc
+                    act = act_next
 
-            # ---- job ledger update (masked window add) ----
-            k = jnp.where(cross_active, m, 1)
-            win = ((lane_j >= cur_safe) & (lane_j < cur_safe + k)).astype(
-                jnp.float32
-            )
-            cons_add = jnp.where(cross_active, 1.0, consumed.astype(jnp.float32))
-            alloc_add = jnp.where(cross_active, 1.0, m_alloc)
-            left_add = jnp.where(
-                cross_active, 0.0, failed.astype(jnp.float32)
-            )
-            js[0:1, :] = js[0:1, :] + cons_add * win
-            js[1:2, :] = js[1:2, :] + alloc_add * win
-            js[2:3, :] = js[2:3, :] + left_add * win
-            drf_scale = jnp.where(cross_active, 1.0, m_alloc + pipe_f)
-            for r in range(r_dim):
-                js[8 + r : 8 + r + 1, :] = (
-                    js[8 + r : 8 + r + 1, :] + (reqs[r] * drf_scale) * win
-                )
-            if multi_queue:
-                # proportion's allocate handler: the placement grows the
-                # queue's allocated (proportion.go:236-246) — replicated to
-                # EVERY lane whose job shares the selected job's queue.
-                q_sel = read_i32(jq_v, lane_j, cur_safe)
-                qwin = (jq_v == q_sel).astype(jnp.float32)
-                for r in range(r_dim):
-                    js[16 + r : 16 + r + 1, :] = (
-                        js[16 + r : 16 + r + 1, :] + (reqs[r] * drf_scale) * qwin
-                    )
-
-            # ---- result write (2-row window around t_idx) ----
-            code = jnp.where(
-                alloc_here,
-                best,
-                jnp.where(
-                    pipe_here,
-                    jnp.int32(PIPE_BASE) - best,
-                    jnp.where(failed, jnp.int32(FAILED), jnp.int32(UNPLACED)),
-                ),
-            )
-            wcount = jnp.where(active, consumed, 0)
-            rowlo = t_idx // 128
-            blk = out_ref[pl.ds(rowlo, 2), :]
-            gidx = (
-                rowlo * 128
-                + jax.lax.broadcasted_iota(jnp.int32, (2, 128), 0) * 128
-                + jax.lax.broadcasted_iota(jnp.int32, (2, 128), 1)
-            )
-            wmask = (gidx >= t_idx) & (gidx < t_idx + wcount)
-            out_ref[pl.ds(rowlo, 2), :] = jnp.where(wmask, code, blk)
-
-            # ---- pop end ----
-            row_after_alloc = nalloc + jnp.where(cross_active, 1.0, m_alloc)
-            became_ready = placed & (row_after_alloc >= deficit_v.astype(jnp.float32))
-            cons_after = cons + jnp.where(
-                cross_active, 1.0, consumed.astype(jnp.float32)
-            )
-            drained = cons_after >= num_v.astype(jnp.float32)
-            end_pop = failed | became_ready | drained
-            cur3 = jnp.where(
-                cur2 == HALT, jnp.int32(HALT),
-                jnp.where(active & ~end_pop, cur2, jnp.int32(-1)),
-            )
-            n_dirty3 = n_dirty2 + (active & became_ready & ~drained).astype(
-                jnp.int32
-            )
-            cursor3 = cursor2 + (
-                jnp.where(cross_active, m - 1, 0) if cross_batch else 0
-            )
-            return cur3, cursor3, n_dirty3, steps + 1
+            return cur_r, cursor_r, dirty_r, steps + 1, coh_steps2, chunk_pl2
 
         def cond(state):
-            cur, cursor, n_dirty, steps = state
+            cur, cursor, n_dirty, steps, _coh, _cpl = state
             if multi_queue:
                 # No cursor liveness to consult: the body's selection step
                 # discovers exhaustion itself (chain -> HALT), costing at
@@ -584,18 +729,32 @@ def mega_allocate(
                 )
             return alive & (steps < max_steps)
 
-        jax.lax.while_loop(
+        final = jax.lax.while_loop(
             cond, body,
-            (jnp.int32(-1), jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+            (jnp.int32(-1), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+             jnp.int32(0), jnp.int32(0)),
         )
+        stats_ref[0, STATS_STEPS] = final[3]
+        stats_ref[0, STATS_COHORT_STEPS] = final[4]
+        stats_ref[0, STATS_CHUNK_PLACED] = final[5]
+        for i in range(3, 8):
+            stats_ref[0, i] = jnp.int32(0)
 
     call = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((t_sub, 128), jnp.int32),
+        out_shape=(
+            jax.ShapeDtypeStruct((t_sub, 128), jnp.int32),
+            jax.ShapeDtypeStruct((1, 8), jnp.int32),
+        ),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(23)
         ] + [pl.BlockSpec(memory_space=pltpu.SMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        # Evidence counters are scalars — SMEM, like the step kernel's
+        # scalar outputs (mosaic rejects scalar stores to VMEM refs).
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
         scratch_shapes=[
             # idle+count rows, plus the releasing ledger rows when live.
             pltpu.VMEM((24 if has_releasing else 16, n), jnp.float32),
@@ -626,21 +785,30 @@ def mega_allocate(
 
         from scheduler_tpu.ops.sharded import shard_map as _shard_map
 
-        out = _shard_map(
+        out, stats = _shard_map(
             call,
             mesh=mesh,
             in_specs=tuple(_P() for _ in operands),
-            out_specs=_P(),
+            out_specs=(_P(), _P()),
             check_vma=False,
         )(*operands)
     else:
-        out = call(*operands)
-    return out.reshape(-1)[:t_pad]
+        out, stats = call(*operands)
+    return out.reshape(-1)[:t_cap], stats[0]
 
 
 def pack_lane_i32(arr: np.ndarray, lanes: int) -> np.ndarray:
     out = np.zeros((1, lanes), dtype=np.int32)
     out[0, : arr.shape[0]] = arr
+    return out
+
+
+def pack_task_table_i32(arr: np.ndarray, t_pad: int, fill: int = 0) -> np.ndarray:
+    """Pack a per-task i32 column into the windowed [ceil(t_pad/128), 128]
+    cohort-table layout the kernel reads with a 1-row sublane window."""
+    rows = task_table_rows(t_pad)
+    out = np.full((rows, 128), fill, dtype=np.int32)
+    out.reshape(-1)[: arr.shape[0]] = arr
     return out
 
 

@@ -34,7 +34,6 @@ tile — label vocabularies are small (tens of pairs), so no K-loop is needed.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -47,7 +46,9 @@ TILE_N = 128
 
 
 def pallas_enabled() -> bool:
-    return os.environ.get("SCHEDULER_TPU_PALLAS", "1") not in ("0", "false")
+    from scheduler_tpu.utils.envflags import env_bool
+
+    return env_bool("SCHEDULER_TPU_PALLAS", True)
 
 
 def _interpret() -> bool:
@@ -64,9 +65,15 @@ def _pad_to(x: np.ndarray, rows: int, cols: int) -> np.ndarray:
 def step_kernel_enabled() -> bool:
     """The placement-step kernel has its own off switch on top of the global
     pallas gate (SCHEDULER_TPU_STEP_KERNEL=0 restores the XLA step path)."""
-    return pallas_enabled() and os.environ.get(
-        "SCHEDULER_TPU_STEP_KERNEL", "1"
-    ) not in ("0", "false")
+    from scheduler_tpu.utils.envflags import env_bool
+
+    return pallas_enabled() and env_bool("SCHEDULER_TPU_STEP_KERNEL", True)
+
+
+# Candidate grid width of the in-kernel cohort capacity count — MUST equal
+# ops/fused.py MAX_BATCH so the kernel's count is bit-identical to the XLA
+# batch block's [MAX_BATCH, R] epsilon-fit grid.
+CAP_GRID = 128
 
 
 def make_placement_step(
@@ -79,6 +86,7 @@ def make_placement_step(
     cpu_idx: int,
     mem_idx: int,
     interpret: bool,
+    with_capacity: bool = False,
 ):
     """One micro-step's selection stage as a single kernel.
 
@@ -94,16 +102,23 @@ def make_placement_step(
       req       f32 [r8, 1]      request (pad rows 0: no score effect)
       mins      f32 [r8, 1]      epsilon thresholds
 
-    Outputs: best (i32 [1,1] lowest-index argmax of the masked score) and
-    its masked score (f32 [1,1]; -inf == nothing feasible).  Scoring
-    reproduces ops/scoring.dynamic_score exactly (same formulas, f32).
+    Outputs: best (i32 [1,1] lowest-index argmax of the masked score), its
+    masked score (f32 [1,1]; -inf == nothing feasible), and — the cohort
+    variant (``with_capacity``, docs/COHORT.md) — the winner's capacity
+    count (largest j <= CAP_GRID such that the j-th sequential placement of
+    this request still epsilon-fits the winner: the floor(free/req)
+    equivalent, computed on the SAME grid as the XLA batch block so the two
+    agree bit-for-bit) plus its pod-count room.  Without ``with_capacity``
+    the two extra outputs are zeros.  Scoring reproduces
+    ops/scoring.dynamic_score exactly (same formulas, f32).
     """
     lr_w, bal_w, bp_w = (float(w) for w in weights)
     neg_inf = float("-inf")  # python literal: pallas kernels cannot close over
     # traced jnp constants (they must be passed as inputs)
 
     def kernel(ns_ref, alloc_ref, smask_ref, sscore_ref, gate_ref, plim_ref,
-               initq_ref, req_ref, mins_ref, best_ref, score_ref):
+               initq_ref, req_ref, mins_ref, best_ref, score_ref, cap_ref,
+               pods_ref):
         idle = ns_ref[0:r8, :]
         initq = initq_ref[:]
         minsv = mins_ref[:]
@@ -145,23 +160,52 @@ def make_placement_step(
         best = jnp.min(jnp.where(masked == maxv, lanes, jnp.int32(n)))
         best_ref[0, 0] = best
         score_ref[0, 0] = maxv
+        if with_capacity:
+            # Winner's column via one-hot masked sum (exact: single term),
+            # then the sequential-placement fit grid — identical arithmetic
+            # to the XLA batch block (idle_b - (j-1)*req, epsilon rule).
+            onehot = lanes == best
+            idle_b = jnp.sum(jnp.where(onehot, idle, 0.0), axis=1,
+                             keepdims=True)
+            jsv = jax.lax.broadcasted_iota(
+                jnp.int32, (1, CAP_GRID), 1
+            ) + 1
+            avail = idle_b - (jsv - 1).astype(jnp.float32) * req_ref[:]
+            okb = (initq < avail) | (jnp.abs(avail - initq) < minsv)
+            ok_all = jnp.all(okb, axis=0, keepdims=True)
+            cap_ref[0, 0] = jnp.max(jnp.where(ok_all, jsv, 0))
+            if enforce_pod_count:
+                tc_b = jnp.sum(
+                    jnp.where(onehot, ns_ref[r8 : r8 + 1, :], 0.0)
+                )
+                pl_b = jnp.sum(jnp.where(onehot, plim_ref[:], 0.0))
+                pods_ref[0, 0] = (pl_b - tc_b).astype(jnp.int32)
+            else:
+                pods_ref[0, 0] = jnp.int32(CAP_GRID)
+        else:
+            cap_ref[0, 0] = jnp.int32(0)
+            pods_ref[0, 0] = jnp.int32(0)
 
     def call(ns, alloc, smask, sscore, gate, plim, initq, req, mins):
-        best, score = pl.pallas_call(
+        best, score, cap, pods = pl.pallas_call(
             kernel,
             out_shape=(
                 jax.ShapeDtypeStruct((1, 1), jnp.int32),
                 jax.ShapeDtypeStruct((1, 1), jnp.float32),
+                jax.ShapeDtypeStruct((1, 1), jnp.int32),
+                jax.ShapeDtypeStruct((1, 1), jnp.int32),
             ),
             # Scalar results live in SMEM — mosaic rejects scalar stores to
             # VMEM refs.
             out_specs=(
                 pl.BlockSpec(memory_space=pltpu.SMEM),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
             ),
             interpret=interpret,
         )(ns, alloc, smask, sscore, gate, plim, initq, req, mins)
-        return best[0, 0], score[0, 0]
+        return best[0, 0], score[0, 0], cap[0, 0], pods[0, 0]
 
     return call
 

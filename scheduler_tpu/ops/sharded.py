@@ -64,6 +64,31 @@ def two_level_winner(lscore, global_idx, extra=(), axis=NODE_AXIS):
     return all_cand[jnp.argmax(all_cand[:, 0])]
 
 
+def two_level_winner_with_capacity(lscore, global_idx, cap, pod_room,
+                                   axis=NODE_AXIS):
+    """Two-level argmax whose winning row CARRIES the winning shard's cohort
+    capacity count and pod-count room (docs/COHORT.md).
+
+    Each chip's selection kernel counts, alongside its local (score, index)
+    candidate, how many sequential placements of the current cohort's
+    request still epsilon-fit its best node (the floor(free/req) equivalent,
+    ``pallas_kernels.make_placement_step(with_capacity=True)``) and how much
+    pod-count room that node has.  Riding those two counts on the winner
+    tuple means the batch sizing in ``ops/fused.py`` never gathers from the
+    node-sharded ledgers — the only per-step ICI traffic stays the one tiny
+    all-gather.  Counts travel as f32 (exact: both are <= 128 and node pod
+    capacities are far below 2^24).  Returns
+    ``(score, global_index, capacity, pod_room)`` with the indices/counts
+    back as i32."""
+    win = two_level_winner(lscore, global_idx, extra=(cap, pod_room), axis=axis)
+    return (
+        win[0],
+        win[1].astype(jnp.int32),
+        win[2].astype(jnp.int32),
+        win[3].astype(jnp.int32),
+    )
+
+
 def node_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for [N, ...] node-major tensors: rows split over the mesh."""
     return NamedSharding(mesh, P(NODE_AXIS))

@@ -29,7 +29,6 @@ bench artifact can prove whether a cycle's device phase included uploads.
 from __future__ import annotations
 
 import hashlib
-import os
 import threading
 from collections import OrderedDict
 from typing import Tuple
@@ -38,11 +37,9 @@ import numpy as np
 
 
 def _cap_bytes() -> int:
-    try:
-        mb = int(os.environ.get("SCHEDULER_TPU_XFER_CACHE_MB", "256"))
-    except ValueError:
-        mb = 256
-    return max(0, mb) * 1024 * 1024
+    from scheduler_tpu.utils.envflags import env_int
+
+    return env_int("SCHEDULER_TPU_XFER_CACHE_MB", 256, minimum=0) * 1024 * 1024
 
 
 class TransferCache:

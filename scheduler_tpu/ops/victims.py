@@ -48,7 +48,6 @@ measurement.
 from __future__ import annotations
 
 import logging
-import os
 from typing import Dict, Optional
 
 import numpy as np
@@ -87,11 +86,11 @@ class VictimGate:
     def __init__(self, ssn, kind: str) -> None:
         self.ssn = ssn
         self.kind = kind
-        self.enabled = os.environ.get(
-            "SCHEDULER_TPU_VICTIM_GATE", "1"
-        ) not in ("0", "false") and os.environ.get(
-            "SCHEDULER_TPU_SWEEP", "1"
-        ) not in ("0", "false")
+        from scheduler_tpu.utils.envflags import env_bool
+
+        self.enabled = env_bool("SCHEDULER_TPU_VICTIM_GATE", True) and env_bool(
+            "SCHEDULER_TPU_SWEEP", True
+        )
         self._built = False
         self._counts: Optional[np.ndarray] = None     # i64 [N, Q]
         self._min_req: Optional[np.ndarray] = None    # f64 [N, Q, R] elementwise min

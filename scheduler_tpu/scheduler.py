@@ -85,7 +85,9 @@ class Scheduler:
     # SCHEDULER_TPU_GC_FREEZE=0 opts out.
     @staticmethod
     def _gc_freeze_enabled() -> bool:
-        return os.environ.get("SCHEDULER_TPU_GC_FREEZE", "1") not in ("0", "false")
+        from scheduler_tpu.utils.envflags import env_bool
+
+        return env_bool("SCHEDULER_TPU_GC_FREEZE", True)
 
     def run_once(self) -> None:
         """One scheduling cycle (scheduler.go:88-102)."""

@@ -49,6 +49,28 @@ from scheduler_tpu.utils.scheduler_helper import (
 )
 
 
+def static_predicate_sig(task: TaskInfo) -> Optional[tuple]:
+    """Signature of everything the STATIC predicates read from a task —
+    tasks sharing it see identical static-predicate results on every node.
+    Returns None when the task carries a scan-dynamic predicate (host
+    ports, inter-pod (anti-)affinity) and therefore needs the exact
+    per-task path.  ONE definition shared by the preempt/reclaim sweep
+    cache below and backfill's cohort fast-start (actions/backfill.py) so
+    the soundness carve-out can never drift between them."""
+    pod = task.pod
+    if pod is None:
+        return None
+    aff = pod.affinity
+    if pod.host_ports or (aff and (aff.pod_affinity or aff.pod_anti_affinity)):
+        return None
+    return (
+        repr(sorted(pod.node_selector.items())),
+        repr(pod.tolerations),
+        repr(aff.node_required) if aff else "",
+        repr(getattr(aff, "node_preferred", None)) if aff else "",
+    )
+
+
 class SweepCache:
     """sig -> best-first node list, memoized for one action execution."""
 
@@ -56,7 +78,7 @@ class SweepCache:
         self.ssn = ssn
         self._cache: Dict[tuple, List[NodeInfo]] = {}
         self._node_list: Optional[List[NodeInfo]] = None  # lazy: hunts only
-        import os
+        from scheduler_tpu.utils.envflags import env_bool
 
         scoring = set(ssn.node_order_fns) | set(ssn.node_map_fns)
         self.enabled = (
@@ -66,7 +88,7 @@ class SweepCache:
             # affinity preferences) depend on live placements: no caching.
             and scoring <= {"nodeorder", "binpack"}
             and not ssn.batch_node_order_fns
-            and os.environ.get("SCHEDULER_TPU_SWEEP", "1") not in ("0", "false")
+            and env_bool("SCHEDULER_TPU_SWEEP", True)
         )
         # The pod-count live gate applies exactly when the predicates plugin's
         # predicate would run in the dispatch (registered AND tier-enabled).
@@ -79,17 +101,10 @@ class SweepCache:
     def task_sig(self, task: TaskInfo) -> Optional[tuple]:
         """Everything the cached sweep depends on; None -> task needs the
         exact per-task path (scan-dynamic predicates)."""
-        pod = task.pod
-        aff = pod.affinity
-        if pod.host_ports or (aff and (aff.pod_affinity or aff.pod_anti_affinity)):
+        sig = static_predicate_sig(task)
+        if sig is None:
             return None
-        return (
-            task.req_sig,
-            repr(sorted(pod.node_selector.items())),
-            repr(pod.tolerations),
-            repr(aff.node_required) if aff else "",
-            repr(getattr(aff, "node_preferred", None)) if aff else "",
-        )
+        return (task.req_sig,) + sig
 
     def ordered_nodes(self, task: TaskInfo) -> Optional[List[NodeInfo]]:
         """Best-first candidate nodes for this task, memoized by signature.

@@ -1,0 +1,111 @@
+"""CI perf gate: fail on a >10% pods/s regression between bench rounds.
+
+Compares the two newest ``BENCH_r*.json`` artifacts in the repo root (or a
+directory given as argv[1]).  Regression math uses HEALTHY cycles only —
+per-cycle ``link_degraded`` flags recorded by bench.py's bracketing link
+probes — so a degraded-tunnel window can never fail (or excuse) a build:
+
+* fewer than MIN_HEALTHY healthy cycles in either artifact -> exit 0 with a
+  "cannot judge" note (the artifact itself documents the link regime);
+* healthy-median pods/s of the newest artifact below (1 - TOLERANCE) x the
+  previous round's -> exit 2 with both medians printed;
+* otherwise exit 0.
+
+Exit codes: 0 pass / cannot judge, 1 usage or malformed artifact, 2
+regression.  Wired as ``make bench-gate``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+
+TOLERANCE = 0.10
+# Medians over fewer than 3 healthy cycles are single-run noise on a
+# tunneled TPU (±0.5s jitter on ~0.6s cycles) — bench.py itself only calls
+# a round "healthy" at >= 3 healthy cycles, and the gate must not judge on
+# less than the artifact itself trusts.
+MIN_HEALTHY = 3
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def find_artifacts(root: Path):
+    """BENCH_r*.json sorted by round number (not mtime: artifacts are
+    checked in, and a fresh clone flattens timestamps)."""
+    pairs = []
+    for p in root.glob("BENCH_r*.json"):
+        m = _ROUND_RE.search(p.name)
+        if m:
+            pairs.append((int(m.group(1)), p))
+    return [p for _, p in sorted(pairs)]
+
+
+def _unwrap(doc: dict) -> dict:
+    """Accept both the raw bench.py JSON line and the driver's wrapper
+    (which nests it under ``parsed``, with the stdout tail as a fallback)."""
+    if "metric" in doc:
+        return doc
+    if isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    tail = doc.get("tail", "")
+    for line in reversed(tail.strip().splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    return doc
+
+
+def healthy_median_pods_per_sec(path: Path):
+    """Median binds/s over the artifact's link-healthy cycles, or None when
+    too few are healthy to judge.  Falls back to the artifact's top-level
+    value only when per-cycle data is absent AND the regime was healthy."""
+    doc = _unwrap(json.loads(path.read_text()))
+    detail = doc.get("detail", {})
+    binds = detail.get("binds")
+    cycles = detail.get("cycles")
+    if not cycles or not binds:
+        if detail.get("regime") == "healthy" and doc.get("value"):
+            return float(doc["value"])
+        return None
+    rates = sorted(
+        binds / c["s"]
+        for c in cycles
+        if not c.get("link_degraded") and c.get("s")
+    )
+    if len(rates) < MIN_HEALTHY:
+        return None
+    return rates[len(rates) // 2]
+
+
+def main(argv) -> int:
+    root = Path(argv[1]) if len(argv) > 1 else Path(__file__).resolve().parent.parent
+    artifacts = find_artifacts(root)
+    if len(artifacts) < 2:
+        print(f"bench-gate: need two BENCH_r*.json under {root}, "
+              f"found {len(artifacts)}; nothing to compare")
+        return 0
+    prev_path, new_path = artifacts[-2], artifacts[-1]
+    try:
+        prev = healthy_median_pods_per_sec(prev_path)
+        new = healthy_median_pods_per_sec(new_path)
+    except (json.JSONDecodeError, KeyError, TypeError, ZeroDivisionError) as err:
+        print(f"bench-gate: malformed artifact: {err}")
+        return 1
+    if prev is None or new is None:
+        which = prev_path.name if prev is None else new_path.name
+        print(f"bench-gate: {which} has too few link-healthy cycles; "
+              "cannot judge (see its per-cycle probes)")
+        return 0
+    floor = (1.0 - TOLERANCE) * prev
+    verdict = "REGRESSION" if new < floor else "ok"
+    print(
+        f"bench-gate: {prev_path.name} healthy-median {prev:,.0f} pods/s -> "
+        f"{new_path.name} {new:,.0f} pods/s (floor {floor:,.0f}): {verdict}"
+    )
+    return 2 if new < floor else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,369 @@
+"""Cohort placement parity: cohort-on vs cohort-off must be bitwise-identical
+wherever the multi-chunk path engages, and fall back cleanly where it can't.
+
+The cohort path (ops/megakernel.py chunk loop, docs/COHORT.md) lets one
+device step place a cohort of identical-shape tasks across several nodes.
+Its correctness contract is the same as the engine-cache parity suite's:
+the optimized path must produce EXACTLY the codes of the unoptimized scan
+on every trajectory — chunks only re-partition the scan's steps, never its
+decisions.  These tests sweep scorer mixes (binpack-only, mixed
+static+dynamic), 1- and 2-queue sessions, a gang whose cohort only
+partially fits, and a fuzz of random cohort-heavy clusters; engagement is
+asserted through the kernel's evidence counters so the suite cannot pass
+vacuously, and the releasing-session fallback is pinned as well.
+"""
+
+import numpy as np
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.actions.allocate import collect_candidates
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import close_session, open_session
+from scheduler_tpu.ops.fused import FusedAllocator
+from tests.fixtures import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    make_vocab,
+)
+
+BINPACK_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+"""
+
+STATIC_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: predicates
+  - name: nodeorder
+"""
+
+MULTIQ_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: proportion
+  - name: binpack
+"""
+
+
+def _spill_cluster(conf_str, queues=("default",), node_cpu=1600, n_nodes=6,
+                   gang_size=10, n_gangs=3, selectors=False):
+    """Identical-request gangs much larger than one node's cpu room (~3
+    tasks of 500m per node): every cohort MUST spill across several nodes,
+    which is exactly the shape the multi-chunk step accelerates."""
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    for q in queues:
+        cache.add_queue(build_queue(q))
+    for i in range(n_nodes):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": node_cpu, "memory": 64 * 2**30, "pods": 110},
+            labels={"zone": "za" if i % 2 else "zb"},
+        ))
+    for g in range(n_gangs):
+        q = queues[g % len(queues)]
+        cache.add_pod_group(build_pod_group(f"g{g}", min_member=gang_size,
+                                            queue=q))
+        for i in range(gang_size):
+            pod = build_pod(
+                name=f"g{g}-{i}", req={"cpu": 500, "memory": 2**30},
+                groupname=f"g{g}", priority=g % 2,
+            )
+            if selectors:
+                pod.node_selector = {"zone": "za" if g % 2 else "zb"}
+            cache.add_pod(pod)
+    conf = parse_scheduler_conf(conf_str)
+    return open_session(cache, conf.tiers)
+
+
+def _engine(monkeypatch, ssn, chunks):
+    monkeypatch.setenv("SCHEDULER_TPU_COHORT", str(chunks))
+    return FusedAllocator(ssn, collect_candidates(ssn))
+
+
+def _codes_and_stats(engine):
+    codes = engine._execute().copy()
+    return codes, engine.run_stats()
+
+
+@pytest.mark.parametrize("conf,selectors", [
+    (BINPACK_CONF, False),
+    (STATIC_CONF, True),
+], ids=["binpack-only", "static+score-bound"])
+def test_cohort_on_off_parity_and_engagement(monkeypatch, conf, selectors):
+    """Cohort-on codes == cohort-off codes bit-for-bit, on a cluster where
+    cohorts must spill across nodes — and the evidence counters prove the
+    chunk path actually engaged (no vacuous pass)."""
+    ssn = _spill_cluster(conf, selectors=selectors)
+    try:
+        on = _engine(monkeypatch, ssn, 4)
+        assert on.use_mega, "cohort suite expects the mega kernel"
+        assert on.batch_runs, "identical requests must form runs"
+        assert on.cohort_effective > 1
+        codes_on, stats_on = _codes_and_stats(on)
+
+        off = _engine(monkeypatch, ssn, 1)
+        assert off.use_mega and off.cohort_effective == 1
+        codes_off, stats_off = _codes_and_stats(off)
+
+        np.testing.assert_array_equal(codes_on, codes_off)
+        assert stats_on["placed"] > 0
+        # Engagement: chunks placed tasks beyond chunk 0, in fewer steps.
+        assert stats_on["cohort_steps"] > 0
+        assert stats_on["chunk_placed"] > 0
+        assert stats_on["steps"] < stats_off["steps"]
+        assert stats_on["tasks_per_step"] > 1.0
+        # The host cohort table saw the cohorts too.
+        assert on.cohort_count >= 3
+    finally:
+        close_session(ssn)
+
+
+def test_cohort_matches_xla_while_loop(monkeypatch):
+    """Absolute anchor: the chunked mega kernel equals the (chunk-free) XLA
+    while-loop program bit-for-bit, not just its own chunk-off variant."""
+    ssn = _spill_cluster(BINPACK_CONF)
+    try:
+        engine = _engine(monkeypatch, ssn, 4)
+        assert engine.use_mega
+        mega = engine._execute().copy()
+        engine.use_mega = False
+        xla = engine._execute().copy()
+        np.testing.assert_array_equal(mega, xla)
+        assert int((mega >= 0).sum()) > 0
+    finally:
+        close_session(ssn)
+
+
+def test_cohort_two_queue_parity(monkeypatch):
+    """Multi-queue mega (proportion on the job lanes): in-job cohort chunks
+    must stay exact under live queue-share selection."""
+    ssn = _spill_cluster(MULTIQ_CONF, queues=("qa", "qb"), n_gangs=4)
+    try:
+        on = _engine(monkeypatch, ssn, 4)
+        assert on.use_mega and on.cohort_effective > 1
+        codes_on, stats_on = _codes_and_stats(on)
+        off = _engine(monkeypatch, ssn, 1)
+        codes_off, _ = _codes_and_stats(off)
+        np.testing.assert_array_equal(codes_on, codes_off)
+        assert stats_on["cohort_steps"] > 0
+    finally:
+        close_session(ssn)
+
+
+def test_cohort_partial_fit_gang(monkeypatch):
+    """A gang whose cohort only PARTIALLY fits: the chunk that finds nothing
+    feasible must record the same first-failure code as the sequential scan
+    (the job then leaves the rotation, gang holdback unbinds it on commit)."""
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    # Two nodes x 2 cpu-slots of room = 4 slots for a 7-task identical
+    # cohort (the third 500m task would need 1500m > 1100m idle).
+    for i in range(2):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": 1100, "memory": 64 * 2**30, "pods": 110}))
+    cache.add_pod_group(build_pod_group("g0", min_member=7))
+    for i in range(7):
+        cache.add_pod(build_pod(name=f"g0-{i}",
+                                req={"cpu": 500, "memory": 2**30},
+                                groupname="g0"))
+    ssn = open_session(cache, parse_scheduler_conf(BINPACK_CONF).tiers)
+    try:
+        on = _engine(monkeypatch, ssn, 4)
+        assert on.use_mega and on.cohort_effective > 1
+        codes_on, stats_on = _codes_and_stats(on)
+        off = _engine(monkeypatch, ssn, 1)
+        codes_off, _ = _codes_and_stats(off)
+        np.testing.assert_array_equal(codes_on, codes_off)
+        t = on.flat_count
+        assert int((codes_on[:t] == -2).sum()) == 1, "first-failure code"
+        assert int((codes_on[:t] >= 0).sum()) == 4
+        assert stats_on["cohort_steps"] > 0
+    finally:
+        close_session(ssn)
+
+
+def test_cohort_falls_back_with_releasing(monkeypatch):
+    """Releasing capacity (pipeline arm) gates the chunk path OFF — the
+    fallback one-segment scan must engage and say so in the evidence."""
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    for i in range(3):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": 4000, "memory": 8 * 2**30, "pods": 10}))
+    for j in range(3):
+        cache.add_pod_group(build_pod_group(f"run{j}", min_member=1,
+                                            phase="Running"))
+        cache.add_pod(build_pod(
+            name=f"run{j}-0", req={"cpu": 3000, "memory": 6 * 2**30},
+            groupname=f"run{j}", nodename=f"n{j}", phase="Running"))
+    cache.add_pod_group(build_pod_group("want", min_member=4))
+    for i in range(4):
+        cache.add_pod(build_pod(name=f"want-{i}",
+                                req={"cpu": 2500, "memory": 5 * 2**30},
+                                groupname="want"))
+    ssn = open_session(cache, parse_scheduler_conf(BINPACK_CONF).tiers)
+    try:
+        for job in ssn.jobs.values():
+            if job.uid.endswith(("run0", "run1")):
+                for t in list(job.tasks.values()):
+                    ssn.evict(t, "test")
+        engine = _engine(monkeypatch, ssn, 4)
+        assert engine.has_releasing
+        # The gate downgrades to one chunk; evidence records the fallback.
+        assert engine.cohort_effective == 1
+        codes, stats = _codes_and_stats(engine)
+        assert stats["cohort_chunks"] == 1 or not engine.use_mega
+        if "cohort_steps" in stats:
+            assert stats["cohort_steps"] == 0
+        assert int((codes <= -3).sum()) > 0, "expected pipelined placements"
+    finally:
+        close_session(ssn)
+
+
+def test_backfill_cohort_fast_start_preserves_semantics():
+    """Backfill's cohort fast-start (actions/backfill.py): many BestEffort
+    pods sharing one predicate signature must land exactly where the
+    reference's per-task full sweep puts them — filling each node to its
+    pod cap in name order — and a signature no node accepts must record
+    per-node errors for EVERY node (total-fallback path)."""
+    from scheduler_tpu.framework import get_action
+
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    for i in range(3):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": 4000, "memory": 8 * 2**30, "pods": 2},
+            labels={"zone": "za"}))
+    # 5 BestEffort pods, one signature: pod-count caps (2/node) force the
+    # sweep forward; fast-start must follow exactly.
+    for i in range(5):
+        cache.add_pod_group(build_pod_group(f"be{i}", min_member=1))
+        cache.add_pod(build_pod(name=f"be{i}-0", req={}, groupname=f"be{i}"))
+    # One pod whose selector no node satisfies: full per-node error record.
+    cache.add_pod_group(build_pod_group("lost", min_member=1))
+    lost = build_pod(name="lost-0", req={}, groupname="lost",
+                     selector={"zone": "nowhere"})
+    cache.add_pod(lost)
+    conf = parse_scheduler_conf(STATIC_CONF)
+    ssn = open_session(cache, conf.tiers)
+    try:
+        get_action("backfill").execute(ssn)
+        placed = {
+            t.name: t.node_name
+            for job in ssn.jobs.values() for t in job.tasks.values()
+            if t.node_name
+        }
+        assert placed == {
+            "be0-0": "n0", "be1-0": "n0",
+            "be2-0": "n1", "be3-0": "n1",
+            "be4-0": "n2",
+        }
+        lost_job = next(j for j in ssn.jobs.values() if j.uid.endswith("lost"))
+        (fe,) = lost_job.nodes_fit_errors.values()
+        assert len(fe.nodes) == 3, "errors for every node, not just the tail"
+    finally:
+        close_session(ssn)
+
+
+def test_backfill_transient_bind_failure_is_retried():
+    """The fast-start cache must cap at the first BIND failure: a node that
+    passed predicates but failed ssn.allocate transiently is not provably
+    failing, so the next same-signature task has to retry it (caching the
+    success index unconditionally would skip it forever)."""
+    from scheduler_tpu.framework import get_action
+
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    for i in range(3):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": 4000, "memory": 8 * 2**30, "pods": 2}))
+    for i in range(3):
+        cache.add_pod_group(build_pod_group(f"be{i}", min_member=1))
+        cache.add_pod(build_pod(name=f"be{i}-0", req={}, groupname=f"be{i}"))
+    ssn = open_session(cache, parse_scheduler_conf(STATIC_CONF).tiers)
+    try:
+        real_allocate = ssn.allocate
+        tripped = []
+
+        def flaky_allocate(task, node_name):
+            if task.name == "be1-0" and node_name == "n0" and not tripped:
+                tripped.append(True)
+                raise RuntimeError("transient bind failure")
+            return real_allocate(task, node_name)
+
+        ssn.allocate = flaky_allocate
+        get_action("backfill").execute(ssn)
+        placed = {
+            t.name: t.node_name
+            for job in ssn.jobs.values() for t in job.tasks.values()
+            if t.node_name
+        }
+        # be0 -> n0; be1 bind-fails on n0 and lands on n1; be2 must RETRY
+        # n0 (which still has pod room) rather than fast-start past it.
+        assert placed == {"be0-0": "n0", "be1-0": "n1", "be2-0": "n0"}
+    finally:
+        close_session(ssn)
+
+
+@pytest.mark.parametrize("seed", [7, 17, 27, 37])
+def test_cohort_fuzz_random_clusters(monkeypatch, seed):
+    """Fuzz: random cohort-heavy clusters (few request shapes, random node
+    pod rooms, mixed gang sizes incl. single-task jobs for the cross-job
+    arm) — cohort-on placements must equal cohort-off bit-for-bit."""
+    rng = np.random.default_rng(seed)
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    for i in range(int(rng.integers(3, 8))):
+        cache.add_node(build_node(
+            f"n{i:02d}",
+            {"cpu": float(rng.choice([4000, 8000, 16000])),
+             "memory": float(rng.choice([8, 16, 32])) * 2**30,
+             "pods": int(rng.integers(2, 6))},
+        ))
+    shapes = [
+        {"cpu": 500, "memory": 2**30},
+        {"cpu": 1000, "memory": 2 * 2**30},
+    ]
+    for g in range(int(rng.integers(2, 7))):
+        size = int(rng.integers(1, 9))
+        cache.add_pod_group(build_pod_group(
+            f"g{g}", min_member=int(rng.integers(1, size + 1))))
+        shape = shapes[int(rng.integers(0, len(shapes)))]
+        for i in range(size):
+            cache.add_pod(build_pod(name=f"g{g}-{i}", req=dict(shape),
+                                    groupname=f"g{g}",
+                                    priority=int(rng.integers(0, 2))))
+    ssn = open_session(cache, parse_scheduler_conf(BINPACK_CONF).tiers)
+    try:
+        on = _engine(monkeypatch, ssn, 4)
+        if not on.use_mega:
+            pytest.skip("mega gate did not engage on this draw")
+        codes_on, _ = _codes_and_stats(on)
+        off = _engine(monkeypatch, ssn, 1)
+        codes_off, _ = _codes_and_stats(off)
+        np.testing.assert_array_equal(codes_on, codes_off)
+    finally:
+        close_session(ssn)

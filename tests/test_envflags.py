@@ -1,0 +1,86 @@
+"""Hardened SCHEDULER_TPU_* env parsing: malformed values must warn and fall
+back to defaults — never crash a scheduling cycle (utils/envflags.py)."""
+
+import logging
+
+import pytest
+
+from scheduler_tpu.utils import envflags
+from scheduler_tpu.utils.envflags import env_bool, env_int, env_str
+
+
+@pytest.fixture(autouse=True)
+def _fresh_warn_dedup():
+    envflags._warned.clear()
+    yield
+    envflags._warned.clear()
+
+
+def test_env_int_parses_and_defaults(monkeypatch):
+    monkeypatch.delenv("X_INT", raising=False)
+    assert env_int("X_INT", 7) == 7
+    monkeypatch.setenv("X_INT", " 42 ")
+    assert env_int("X_INT", 7) == 42
+    monkeypatch.setenv("X_INT", "-3")
+    assert env_int("X_INT", 7, minimum=1) == 1
+    monkeypatch.setenv("X_INT", "99")
+    assert env_int("X_INT", 7, maximum=8) == 8
+
+
+def test_env_int_malformed_warns_and_falls_back(monkeypatch, caplog):
+    monkeypatch.setenv("X_INT", "eight")
+    with caplog.at_level(logging.WARNING, logger="scheduler_tpu.utils.envflags"):
+        assert env_int("X_INT", 7) == 7
+    assert "X_INT" in caplog.text and "eight" in caplog.text
+    # Dedup: the same (flag, value) pair warns once, not at cycle rate.
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="scheduler_tpu.utils.envflags"):
+        assert env_int("X_INT", 7) == 7
+    assert caplog.text == ""
+
+
+def test_env_bool_semantics(monkeypatch):
+    monkeypatch.delenv("X_BOOL", raising=False)
+    assert env_bool("X_BOOL", True) is True
+    assert env_bool("X_BOOL", False) is False
+    for off in ("0", "false", "FALSE", "no", "off"):
+        monkeypatch.setenv("X_BOOL", off)
+        assert env_bool("X_BOOL", True) is False
+    for on in ("1", "true", "True", "yes", "on"):
+        monkeypatch.setenv("X_BOOL", on)
+        assert env_bool("X_BOOL", False) is True
+
+
+def test_env_bool_malformed_warns_and_falls_back(monkeypatch, caplog):
+    monkeypatch.setenv("X_BOOL", "yess")
+    with caplog.at_level(logging.WARNING, logger="scheduler_tpu.utils.envflags"):
+        assert env_bool("X_BOOL", True) is True
+        assert env_bool("X_BOOL", False) is False
+    assert "yess" in caplog.text
+
+
+def test_env_str_choices(monkeypatch, caplog):
+    monkeypatch.setenv("X_STR", "Auto")
+    assert env_str("X_STR", "never", choices=("auto", "always", "never")) == "auto"
+    monkeypatch.setenv("X_STR", "garbage")
+    with caplog.at_level(logging.WARNING, logger="scheduler_tpu.utils.envflags"):
+        assert env_str("X_STR", "auto", choices=("auto",)) == "auto"
+    assert "garbage" in caplog.text
+
+
+def test_window_size_survives_malformed_env(monkeypatch):
+    """The crash this satellite fixes: _window_size() used int() on the raw
+    env value and took the whole allocate action down on a typo."""
+    from scheduler_tpu.ops.fused import FusedAllocator, _cohort_chunks
+
+    monkeypatch.setenv("SCHEDULER_TPU_WINDOW", "not-a-number")
+    assert FusedAllocator._window_size() == 8
+    monkeypatch.setenv("SCHEDULER_TPU_COHORT", "lots")
+    assert _cohort_chunks() == 1  # malformed int -> default, clamped >= 1
+
+
+def test_engine_cache_cap_survives_malformed_env(monkeypatch):
+    from scheduler_tpu.ops.engine_cache import _cap
+
+    monkeypatch.setenv("SCHEDULER_TPU_ENGINE_CACHE_ENTRIES", "many")
+    assert _cap() == 2

@@ -1,7 +1,7 @@
 # Build/test entry points (reference: Makefile + hack/make-rules).
 PY ?= python
 
-.PHONY: all native test test-fast bench bench-smoke bench-gate verify wheel clean
+.PHONY: all native test test-fast bench bench-smoke bench-gate lint verify wheel clean
 
 all: native
 
@@ -33,12 +33,18 @@ wheel:
 	$(PY) -m pip wheel --no-build-isolation --no-deps -w dist/ . -q
 	$(PY) scripts/check_wheel.py dist/
 
-# Lint gate (reference `make verify`: gofmt/golint/compile slots): byte-compile
-# everything, the AST lint (unused imports, whitespace hygiene), then the
-# wheel build + content check.
-verify: wheel
-	$(PY) -m compileall -q scheduler_tpu tests scripts bench.py __graft_entry__.py
+# schedlint: the repo-native static-analysis gate (docs/STATIC_ANALYSIS.md) —
+# engine-flag cache drift, host-sync leaks, donation safety, lock order,
+# doc artifact references.  Plus the generic hygiene lint.
+lint:
+	$(PY) scripts/schedlint.py
 	$(PY) scripts/lint.py
+
+# Lint gate (reference `make verify`: gofmt/golint/compile slots): byte-compile
+# everything, schedlint + the AST hygiene lint, then the wheel build +
+# content check.
+verify: lint wheel
+	$(PY) -m compileall -q scheduler_tpu tests scripts bench.py __graft_entry__.py
 
 clean:
 	find . -name '__pycache__' -type d -exec rm -rf {} + 2>/dev/null || true

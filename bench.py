@@ -40,7 +40,6 @@ how the steady-state scheduler loop runs (compile once, re-run every period).
 from __future__ import annotations
 
 import json
-import os
 import sys
 import time
 
@@ -114,11 +113,19 @@ def _classify(runs: list, probes: list[dict]) -> list[bool]:
 
 
 def main() -> None:
+    from scheduler_tpu.utils.envflags import env_int
+    from scheduler_tpu.utils import sanitize
+
     smoke = "--smoke" in sys.argv
-    n_nodes = int(os.environ.get("SCHEDULER_TPU_BENCH_NODES", 100 if smoke else 10_000))
-    n_pods = int(os.environ.get("SCHEDULER_TPU_BENCH_PODS", 500 if smoke else 100_000))
-    tasks_per_job = int(os.environ.get("SCHEDULER_TPU_BENCH_GANG", 100))
-    n_queues = int(os.environ.get("SCHEDULER_TPU_BENCH_QUEUES", 1))
+    n_nodes = env_int("SCHEDULER_TPU_BENCH_NODES", 100 if smoke else 10_000, minimum=1)
+    n_pods = env_int("SCHEDULER_TPU_BENCH_PODS", 500 if smoke else 100_000, minimum=1)
+    tasks_per_job = env_int("SCHEDULER_TPU_BENCH_GANG", 100, minimum=1)
+    n_queues = env_int("SCHEDULER_TPU_BENCH_QUEUES", 1, minimum=1)
+    # SCHEDULER_TPU_SANITIZE=1: debug-NaN checking process-wide plus a
+    # transfer guard around the device phase (utils/sanitize.py) — the run
+    # FAILS on any implicit host transfer mid-device-phase, and the artifact
+    # records that the numbers were taken under sanitize overhead.
+    sanitized = sanitize.arm()
 
     # Warmup at the REAL shapes: the steady-state scheduler loop compiles once
     # per (node-bucket, task-bucket) pair and re-runs every period, so the
@@ -168,6 +175,7 @@ def main() -> None:
             "binds": binds,
             "cycle_seconds": round(elapsed, 3),
             "regime": regime,
+            "sanitize": sanitized,
             "policy": POLICY,
             "cycles": [
                 {

@@ -1,0 +1,12 @@
+"""schedlint: repo-native static analysis for the device engine and host
+threads (docs/STATIC_ANALYSIS.md).
+
+CLI: ``python scripts/schedlint.py`` / ``make lint``.
+"""
+
+from scheduler_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Repo,
+    pass_names,
+    run_passes,
+)

@@ -1,0 +1,316 @@
+"""schedlint core: the repo model, finding type and pass runner.
+
+The device engine's correctness rests on invariants no unit test checks
+directly (docs/STATIC_ANALYSIS.md): engine flags must participate in the
+engine-cache key, jitted code must not host-sync mid-cycle, donated buffers
+die at dispatch, lock acquisition must stay acyclic, and docs must not cite
+artifacts that were never committed.  Each invariant is one AST/text pass
+over a ``Repo`` — an in-memory snapshot of the tree that tests can also
+construct from literal source snippets, so every pass has a regression
+corpus without touching the real tree.
+
+Escape hatch: a finding on a line carrying ``# schedlint: ignore[rule]``
+(Python) or ``<!-- schedlint: ignore[rule] -->`` (Markdown) is suppressed;
+``ignore[*]`` suppresses every rule on the line.  The comment is the audit
+trail — every use should say WHY the invariant doesn't apply.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+
+_IGNORE_RE = re.compile(
+    r"(?:#|<!--)\s*schedlint:\s*ignore\[([A-Za-z0-9_*,\s-]+)\]"
+)
+
+
+def _line_ignores(text: str) -> Dict[int, Set[str]]:
+    """{lineno: {rules}} for every schedlint ignore comment in ``text``.
+    An end-of-line comment suppresses its own line; a STANDALONE comment
+    line suppresses the following line (for multi-line statements whose
+    AST anchor has no room for a trailing comment)."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        m = _IGNORE_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        # Standalone = nothing but the ignore comment on the line (a
+        # Markdown heading "## …" also starts with '#', so the test is
+        # "empty before the comment marker", not "starts with a marker").
+        standalone = not line[: m.start()].strip()
+        target = i + 1 if standalone else i
+        out.setdefault(target, set()).update(rules)
+    return out
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class PyModule:
+    path: str
+    text: str
+    tree: ast.AST
+    ignores: Dict[int, Set[str]] = field(default_factory=dict)
+
+
+@dataclass
+class Doc:
+    path: str
+    text: str
+    ignores: Dict[int, Set[str]] = field(default_factory=dict)
+
+
+class Repo:
+    """The analyzed tree: parsed Python modules, Markdown docs, and a file
+    index for existence checks.  ``from_root`` walks a real checkout;
+    the test corpus builds one from literal snippets instead."""
+
+    def __init__(
+        self,
+        modules: Sequence[PyModule] = (),
+        docs: Sequence[Doc] = (),
+        existing: Optional[Iterable[str]] = None,
+        root: Optional[Path] = None,
+    ) -> None:
+        self.modules = list(modules)
+        self.docs = list(docs)
+        self.root = root
+        # Existence model: relative paths (for exact checks) + basenames
+        # (slashless citations like ``BENCH_r05.json`` pass if the file
+        # exists anywhere in the tree).
+        self._paths: Set[str] = set(existing or ())
+        self._basenames: Set[str] = {p.rsplit("/", 1)[-1] for p in self._paths}
+        self._indexed = root is None  # sources/git index = authoritative
+        self.errors: List[Finding] = []
+
+    # -- construction ---------------------------------------------------------
+
+    _SKIP_DIRS = {
+        ".git", "__pycache__", ".t1seed", "build", "dist", "deploy",
+        ".pytest_cache", "node_modules",
+    }
+
+    @classmethod
+    def from_root(
+        cls,
+        root: Path,
+        py_targets: Sequence[str],
+        doc_targets: Sequence[str],
+    ) -> "Repo":
+        """Parse ``py_targets`` (files or directories, relative to root) and
+        ``doc_targets`` (glob patterns); index the tree for existence checks.
+
+        The existence index prefers ``git ls-files`` (tracked + staged):
+        the round-5 failure was an artifact that existed in the CHECKOUT but
+        was never committed, and a filesystem walk cannot tell the
+        difference — cite a new artifact, ``git add`` it.  Non-git
+        checkouts fall back to the filesystem walk."""
+        root = Path(root)
+        repo = cls(root=root)
+        indexed = cls._git_index(root)
+        repo._indexed = indexed is not None
+        for rel in sorted(indexed if indexed is not None else cls._walk_tree(root)):
+            repo._paths.add(rel)
+            repo._basenames.add(rel.rsplit("/", 1)[-1])
+        for target in py_targets:
+            p = root / target
+            files = (
+                sorted(x for x in p.rglob("*.py") if cls._keep(x))
+                if p.is_dir() else [p] if p.suffix == ".py" and p.exists() else []
+            )
+            for f in files:
+                rel = f.relative_to(root).as_posix()
+                text = f.read_text()
+                try:
+                    tree = ast.parse(text)
+                except SyntaxError as err:
+                    repo.errors.append(Finding(
+                        "parse", rel, err.lineno or 0,
+                        f"syntax error: {err.msg}",
+                    ))
+                    continue
+                repo.modules.append(
+                    PyModule(rel, text, tree, _line_ignores(text))
+                )
+        for pattern in doc_targets:
+            for f in sorted(root.glob(pattern)):
+                if not f.is_file():
+                    continue
+                rel = f.relative_to(root).as_posix()
+                text = f.read_text()
+                repo.docs.append(Doc(rel, text, _line_ignores(text)))
+        return repo
+
+    @classmethod
+    def _keep(cls, path: Path) -> bool:
+        return not (set(path.parts) & cls._SKIP_DIRS)
+
+    @classmethod
+    def _git_index(cls, root: Path) -> Optional[List[str]]:
+        """Tracked + staged paths from git, or None when unavailable."""
+        import subprocess
+
+        try:
+            out = subprocess.run(
+                ["git", "ls-files", "--cached"],
+                cwd=root, capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
+        if out.returncode != 0:
+            return None
+        return [line for line in out.stdout.splitlines() if line]
+
+    @classmethod
+    def _walk_tree(cls, root: Path) -> Iterable[str]:
+        import os
+
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [
+                d for d in dirnames
+                if d not in cls._SKIP_DIRS and not d.endswith(".egg-info")
+            ]
+            rel = Path(dirpath).relative_to(root).as_posix()
+            prefix = "" if rel == "." else rel + "/"
+            for f in filenames:
+                yield prefix + f
+
+    @classmethod
+    def from_sources(
+        cls,
+        py: Optional[Dict[str, str]] = None,
+        docs: Optional[Dict[str, str]] = None,
+        existing: Iterable[str] = (),
+    ) -> "Repo":
+        """Test constructor: ``{relpath: source}`` maps, no filesystem."""
+        modules = [
+            PyModule(path, text, ast.parse(text), _line_ignores(text))
+            for path, text in (py or {}).items()
+        ]
+        doc_objs = [
+            Doc(path, text, _line_ignores(text))
+            for path, text in (docs or {}).items()
+        ]
+        return cls(modules, doc_objs, existing=existing)
+
+    # -- queries --------------------------------------------------------------
+
+    def exists(self, rel: str) -> bool:
+        if rel in self._paths:
+            return True
+        # Filesystem fallback only when no authoritative index was built
+        # (non-git checkout): with a git index, an unstaged file citing
+        # artifact MUST fail — that is the evidence-hygiene rule.
+        return (
+            not self._indexed
+            and self.root is not None
+            and (self.root / rel).exists()
+        )
+
+    def basename_exists(self, name: str) -> bool:
+        return name in self._basenames
+
+    def module(self, suffix: str) -> Optional[PyModule]:
+        """The unique module whose path ends with ``suffix`` (None if absent)."""
+        for m in self.modules:
+            if m.path.endswith(suffix):
+                return m
+        return None
+
+
+# -- pass registry ------------------------------------------------------------
+
+PassFn = Callable[[Repo], List[Finding]]
+_PASSES: "Dict[str, PassFn]" = {}
+
+
+def register(name: str) -> Callable[[PassFn], PassFn]:
+    def deco(fn: PassFn) -> PassFn:
+        _PASSES[name] = fn
+        return fn
+    return deco
+
+
+def pass_names() -> List[str]:
+    import scheduler_tpu.analysis.passes  # noqa: F401  registration side effects
+
+    return sorted(_PASSES)
+
+
+def run_passes(
+    repo: Repo, rules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    """Run the selected passes (default: all) and filter through the
+    per-line ignore comments.  Parse errors always surface."""
+    import scheduler_tpu.analysis.passes  # noqa: F401  registration side effects
+
+    selected = list(rules) if rules else pass_names()
+    unknown = sorted(set(selected) - set(_PASSES))
+    if unknown:
+        raise ValueError(f"unknown schedlint rule(s): {', '.join(unknown)}")
+    ignores = {m.path: m.ignores for m in repo.modules}
+    ignores.update({d.path: d.ignores for d in repo.docs})
+    findings = list(repo.errors)
+    for name in selected:
+        for f in _PASSES[name](repo):
+            suppress = ignores.get(f.path, {}).get(f.line, set())
+            if f.rule in suppress or "*" in suppress:
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# -- shared AST helpers -------------------------------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def const_ints(node: ast.AST) -> Set[int]:
+    """Int constants from a literal int or tuple/list of ints (the shape of
+    ``static_argnums=`` / ``donate_argnums=`` values)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        }
+    return set()
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents

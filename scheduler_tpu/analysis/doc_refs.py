@@ -1,0 +1,67 @@
+"""Pass ``doc-refs``: every artifact a doc cites must exist in-tree.
+
+Round 5 shipped docs referencing ``LADDER_r05.json`` and ``docs/PERF_r05.md``
+that were never committed (VERDICT "what's missing"; ROADMAP "evidence
+hygiene").  This pass scans the maintained docs (``README.md``,
+``docs/*.md``) for backtick-quoted repo paths and fails on any that resolve
+nowhere.
+
+Only citations that look like THIS repo's artifacts are checked: a
+whitelisted extension set (.md/.json/.py/.txt/.toml/.cfg/.yaml/.yml), with
+trailing ``:line`` ranges stripped.  Reference-repo citations (Go paths like
+``pkg/scheduler/allocate.go:46``) are out of scope by extension.  A
+slashless citation (``BENCH_r05.json``) passes if the basename exists
+anywhere in the tree; a pathful one must exist relative to the repo root,
+to the doc's own directory, or to the package root (docs cite engine files
+package-relative: ``ops/fused.py`` = ``scheduler_tpu/ops/fused.py``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from scheduler_tpu.analysis.core import Doc, Finding, Repo, register
+
+RULE = "doc-refs"
+
+_SPAN_RE = re.compile(r"`([^`]+)`")
+_LINE_SUFFIX_RE = re.compile(r":[0-9][0-9,:+-]*$")
+_CHECKED_EXTS = ("md", "json", "py", "txt", "toml", "cfg", "yaml", "yml")
+_PATH_RE = re.compile(
+    r"^[A-Za-z0-9_][A-Za-z0-9_./-]*\.(?:%s)$" % "|".join(_CHECKED_EXTS)
+)
+
+
+def _candidates(line: str):
+    for span in _SPAN_RE.findall(line):
+        cand = _LINE_SUFFIX_RE.sub("", span.strip())
+        if "*" in cand or "<" in cand or " " in cand:
+            continue
+        if _PATH_RE.match(cand):
+            yield cand
+
+
+def _check_doc(repo: Repo, doc: Doc, out: List[Finding]) -> None:
+    doc_dir = doc.path.rsplit("/", 1)[0] + "/" if "/" in doc.path else ""
+    for lineno, line in enumerate(doc.text.splitlines(), 1):
+        for cand in _candidates(line):
+            roots = ("", doc_dir, "scheduler_tpu/")
+            ok = any(repo.exists(root + cand) for root in roots)
+            if not ok and "/" not in cand:
+                ok = repo.basename_exists(cand)
+            if not ok:
+                out.append(Finding(
+                    RULE, doc.path, lineno,
+                    f"cited artifact '{cand}' does not exist in-tree; "
+                    "commit it in the same PR or correct the citation "
+                    "(ROADMAP evidence-hygiene rule)",
+                ))
+
+
+@register(RULE)
+def doc_refs(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    for doc in repo.docs:
+        _check_doc(repo, doc, out)
+    return out

@@ -1,0 +1,161 @@
+"""Pass ``donation``: donated device buffers die at dispatch.
+
+``donate_argnums`` lets XLA reuse an input buffer for the output (the
+engine-cache delta scatter updates resident node ledgers in place this way).
+The contract is one-way: after the call, the donated buffer is INVALID — on
+accelerator backends reading it returns deleted-buffer errors at best and
+stale bytes at worst, and the CPU backend silently copies, so a test suite
+on CPU never catches the bug.  This pass finds call sites of
+donating functions and flags any later read of the donated argument in the
+same enclosing function, unless the call rebinds the result to the same
+name (``buf = scatter(buf, ...)`` — the idiomatic safe shape).
+
+Aliases are followed one level (``scatter = _donated if ok else _plain``),
+matching how the engine picks its scatter variant per backend.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from scheduler_tpu.analysis.core import (
+    Finding, Repo, const_ints, dotted, parent_map, register,
+)
+
+RULE = "donation"
+
+
+def donated_functions(repo: Repo) -> Dict[str, Set[int]]:
+    """{bare function name: donated positions} across the repo."""
+    out: Dict[str, Set[int]] = {}
+    for mod in repo.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                fn = dotted(dec.func) or ""
+                leaf = fn.rsplit(".", 1)[-1]
+                is_jit_ish = leaf == "partial" and any(
+                    (dotted(a) or "").endswith("jit") for a in dec.args
+                )
+                if not (is_jit_ish or fn.endswith("jit")):
+                    continue
+                for kw in dec.keywords:
+                    if kw.arg != "donate_argnums":
+                        continue
+                    nums = const_ints(kw.value)
+                    if nums:
+                        out.setdefault(node.name, set()).update(nums)
+    return out
+
+
+def _stmt_of(node: ast.AST, parents) -> Optional[ast.stmt]:
+    while node in parents:
+        if isinstance(node, ast.stmt):
+            return node
+        node = parents[node]
+    return node if isinstance(node, ast.stmt) else None
+
+
+def _assign_targets(stmt: ast.stmt) -> List[str]:
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    out: List[str] = []
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out.extend(d for d in (dotted(e) for e in t.elts) if d)
+        else:
+            d = dotted(t)
+            if d:
+                out.append(d)
+    return out
+
+
+@register(RULE)
+def donation(repo: Repo) -> List[Finding]:
+    donated = donated_functions(repo)
+    if not donated:
+        return []
+    out: List[Finding] = []
+    for mod in repo.modules:
+        funcs = [
+            n for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for fn in funcs:
+            # One-level aliases: any local bound to an expression that
+            # mentions a donating function inherits its donated positions.
+            callables: Dict[str, Set[int]] = dict(donated)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt = node.targets[0]
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    mentioned: Set[int] = set()
+                    for ref in ast.walk(node.value):
+                        if isinstance(ref, ast.Name) and ref.id in donated:
+                            mentioned |= donated[ref.id]
+                    if mentioned:
+                        callables[tgt.id] = mentioned
+            parents = None
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                fname = dotted(call.func)
+                if fname is None:
+                    continue
+                positions = callables.get(fname.rsplit(".", 1)[-1])
+                if not positions:
+                    continue
+                if parents is None:
+                    parents = parent_map(fn)
+                stmt = _stmt_of(call, parents)
+                if stmt is None:
+                    continue
+                rebound = set(_assign_targets(stmt))
+                for pos in sorted(positions):
+                    if pos >= len(call.args):
+                        continue
+                    key = dotted(call.args[pos])
+                    if key is None:  # temporary expression: nothing survives
+                        continue
+                    if key in rebound:
+                        continue  # buf = f(buf, ...): later reads see the result
+                    # "After the call" in left-to-right evaluation order: any
+                    # load positioned past the call's closing paren — the
+                    # call's own arguments sit inside its span and are
+                    # excluded naturally, while `f(buf, v) + buf[0]` (same
+                    # statement, after the call) is caught.
+                    call_end = (
+                        call.end_lineno or call.lineno,
+                        call.end_col_offset or 0,
+                    )
+                    for later in ast.walk(fn):
+                        if not isinstance(later, (ast.Name, ast.Attribute)):
+                            continue
+                        if not isinstance(getattr(later, "ctx", None), ast.Load):
+                            continue
+                        if dotted(later) != key:
+                            continue
+                        if (later.lineno, later.col_offset) < call_end:
+                            continue
+                        parent = parents.get(later)
+                        if isinstance(parent, ast.Attribute) and parent.attr in (
+                            "shape", "dtype", "ndim", "size"
+                        ):
+                            continue  # metadata survives donation (aval)
+                        out.append(Finding(
+                            RULE, mod.path, later.lineno,
+                            f"donated buffer '{key}' (argument {pos} of "
+                            f"'{fname}') is read after dispatch; the buffer "
+                            "is invalidated by donation — rebind the result "
+                            "or pass a copy",
+                        ))
+                        break  # one finding per donated arg per call
+    return out

@@ -1,0 +1,127 @@
+"""Pass ``env-drift`` / ``raw-env``: engine flags vs the engine-cache key.
+
+The cross-cycle engine cache (``ops/engine_cache.py``) keys resident engines
+on the ``SCHEDULER_TPU_*`` flags that select the device program.  A flag that
+an ``ops/`` module reads but that is missing from ``_ENV_KEYS`` is the silent
+failure class PR 1/2 created: flip the flag, and a resident engine built
+under the OLD value keeps serving cycles.  Two rules:
+
+* ``env-drift`` — every ``SCHEDULER_TPU_*`` flag read inside ``ops/`` must be
+  registered in ``engine_cache._ENV_KEYS``.  Reads that are genuinely
+  re-evaluated per dispatch (never baked into cached engine state) carry a
+  ``# schedlint: ignore[env-drift]`` with the justification.
+* ``raw-env`` — every ``SCHEDULER_TPU_*`` READ anywhere must go through
+  ``utils/envflags`` (``env_bool``/``env_int``/``env_str``): raw
+  ``os.environ`` reads skip the warn-once malformed-value fallback, so an
+  operator typo crashes the cycle instead of degrading to the default.
+  Writes (``os.environ[k] = v``) are fine — envflags owns parsing, not
+  mutation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from scheduler_tpu.analysis.core import (
+    Finding, PyModule, Repo, const_str, dotted, register,
+)
+
+ENV_PREFIX = "SCHEDULER_TPU_"
+ENVFLAG_FUNCS = {"env_bool", "env_int", "env_str"}
+ENV_KEYS_MODULE = "ops/engine_cache.py"
+ENV_KEYS_NAME = "_ENV_KEYS"
+
+
+def registered_keys(repo: Repo) -> Optional[Set[str]]:
+    """The ``_ENV_KEYS`` tuple from ``ops/engine_cache.py`` (None when the
+    module or the literal is missing — the drift rule then has no registry
+    to check against and reports that instead of guessing)."""
+    mod = repo.module(ENV_KEYS_MODULE)
+    if mod is None:
+        return None
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name) and tgt.id == ENV_KEYS_NAME:
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    keys = {const_str(e) for e in node.value.elts}
+                    if None not in keys:
+                        return keys  # type: ignore[return-value]
+    return None
+
+
+def flag_reads(mod: PyModule) -> Iterator[Tuple[int, str, bool]]:
+    """(line, flag, via_envflags) for every SCHEDULER_TPU_* read."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            fn = dotted(node.func)
+            if fn is not None and fn.rsplit(".", 1)[-1] in ENVFLAG_FUNCS:
+                flag = const_str(node.args[0]) if node.args else None
+                if flag and flag.startswith(ENV_PREFIX):
+                    yield node.lineno, flag, True
+            elif fn is not None and (
+                fn.endswith("environ.get") or fn.rsplit(".", 1)[-1] == "getenv"
+            ):
+                flag = const_str(node.args[0]) if node.args else None
+                if flag and flag.startswith(ENV_PREFIX):
+                    yield node.lineno, flag, False
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+            base = dotted(node.value)
+            if base is not None and base.endswith("environ"):
+                flag = const_str(node.slice)
+                if flag and flag.startswith(ENV_PREFIX):
+                    yield node.lineno, flag, False
+
+
+@register("raw-env")
+def raw_env(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in repo.modules:
+        if mod.path.endswith("utils/envflags.py"):
+            continue  # the one legitimate os.environ owner
+        for line, flag, via_envflags in flag_reads(mod):
+            if via_envflags:
+                continue
+            out.append(Finding(
+                "raw-env", mod.path, line,
+                f"raw os.environ read of {flag}; route it through "
+                "utils/envflags (env_bool/env_int/env_str) so malformed "
+                "values warn and degrade instead of crashing the cycle",
+            ))
+    return out
+
+
+@register("env-drift")
+def env_drift(repo: Repo) -> List[Finding]:
+    keys = registered_keys(repo)
+    out: List[Finding] = []
+    ops_modules = [
+        m for m in repo.modules
+        if "/ops/" in f"/{m.path}" or m.path.startswith("ops/")
+    ]
+    if keys is None:
+        if ops_modules:
+            anchor = repo.module(ENV_KEYS_MODULE)
+            out.append(Finding(
+                "env-drift",
+                anchor.path if anchor else ops_modules[0].path, 1,
+                f"cannot resolve {ENV_KEYS_NAME} in {ENV_KEYS_MODULE}; the "
+                "engine-cache key registry must stay a literal tuple of "
+                "flag-name constants",
+            ))
+        return out
+    for mod in ops_modules:
+        for line, flag, _ in flag_reads(mod):
+            if flag in keys:
+                continue
+            out.append(Finding(
+                "env-drift", mod.path, line,
+                f"{flag} is read under ops/ but is not in "
+                f"engine_cache.{ENV_KEYS_NAME}: a resident cached engine "
+                "built under a different value would keep serving cycles. "
+                "Register it, or justify with a schedlint ignore if the "
+                "read is re-evaluated on every dispatch",
+            ))
+    return out

@@ -1,0 +1,300 @@
+"""Pass ``lock-order``: acyclic lock acquisition across host threads.
+
+The host side runs real threads — the cache's informer event handlers, the
+scheduler loop, the IO executor, leader election — and every lock is
+discovered syntactically (``threading.Lock/RLock/Condition`` assignments).
+The pass builds the acquisition graph: an edge A→B when ``with B`` executes
+while A is held, either by direct nesting or through a function call
+(callees resolved by bare name across the analyzed modules, transitively).
+Findings:
+
+* a cycle in the graph (the classic ABBA deadlock shape);
+* re-acquisition of a NON-reentrant lock while held (self-edge; ``RLock``
+  self-edges are fine — the cache mutex relies on reentrancy by design);
+* a bare ``lock.acquire()`` call — outside ``with``, an exception between
+  acquire and release leaks the lock and hangs every other thread.
+
+Locks are keyed by attribute/variable name: two classes naming an attribute
+``mutex`` share a node.  That deliberately over-approximates — a false edge
+can only matter if it completes a cycle, and the escape hatch documents it.
+``Condition(some_lock)`` aliases to its underlying lock's node.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from scheduler_tpu.analysis.core import Finding, Repo, dotted, register
+
+RULE = "lock-order"
+
+# Attribute calls with these names are near-always builtin container /
+# threading-primitive method calls (``self._entries.pop(...)``,
+# ``cond.wait(...)``), not repo functions — matching them by bare name
+# manufactures edges out of dict traffic, and Condition methods by
+# definition operate on an ALREADY-held lock.  Plain-name calls
+# (``clear()``) still match repo functions.
+_CONTAINER_METHODS = {
+    "add", "append", "clear", "copy", "discard", "extend", "get", "insert",
+    "items", "keys", "move_to_end", "pop", "popitem", "remove", "reverse",
+    "setdefault", "sort", "update", "values",
+    # threading / executor primitives
+    "cancel", "is_set", "join", "locked", "notify", "notify_all", "put",
+    "result", "set", "shutdown", "start", "submit", "task_done", "wait",
+    "wait_for",
+}
+
+_CTORS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "Semaphore": "lock",
+    "BoundedSemaphore": "lock",
+}
+
+
+def _lock_ctor(call: ast.AST) -> Optional[str]:
+    """Lock kind when ``call`` constructs a threading primitive."""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = dotted(call.func)
+    if fn is None:
+        return None
+    leaf = fn.rsplit(".", 1)[-1]
+    if leaf not in _CTORS:
+        return None
+    if "." in fn and not fn.startswith("threading."):
+        return None  # some other module's Lock factory
+    return _CTORS[leaf]
+
+
+def _target_key(node: ast.AST) -> Optional[str]:
+    """Lock node key for an assignment target: bare name for globals,
+    attribute name for ``self.X`` (classes naming the same attr merge)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class _Locks:
+    def __init__(self) -> None:
+        self.kinds: Dict[str, str] = {}
+        self.alias: Dict[str, str] = {}  # Condition(lock) -> underlying node
+
+    def canonical(self, name: str) -> str:
+        seen = set()
+        while name in self.alias and name not in seen:
+            seen.add(name)
+            name = self.alias[name]
+        return name
+
+    def resolve(self, expr: ast.AST) -> Optional[str]:
+        """Lock node for a ``with`` item / attribute chain, or None."""
+        key = _target_key(expr)
+        if key is not None and key in self.kinds:
+            return self.canonical(key)
+        return None
+
+
+def discover_locks(repo: Repo) -> _Locks:
+    locks = _Locks()
+    for mod in repo.modules:
+        for node in ast.walk(mod.tree):
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            kind = _lock_ctor(value)
+            if kind is None:
+                continue
+            for tgt in targets:
+                key = _target_key(tgt)
+                if key is None:
+                    continue
+                if kind == "condition":
+                    if value.args:
+                        # Condition(lock): acquisitions go to the wrapped lock.
+                        under = _target_key(value.args[0])
+                        if under is not None and under != key:
+                            locks.alias[key] = under
+                            locks.kinds.setdefault(key, "condition")
+                            continue
+                    else:
+                        # A bare Condition() is backed by a fresh RLock —
+                        # re-entry while held is safe by construction.
+                        kind = "rlock"
+                # Same attribute name on different classes merges to one
+                # node; on a kind conflict keep the reentrant reading so a
+                # name shared with some other class's RLock can never
+                # manufacture a self-deadlock finding.
+                prev = locks.kinds.get(key)
+                if prev is not None and prev != kind and "rlock" in (prev, kind):
+                    kind = "rlock"
+                locks.kinds[key] = kind
+    return locks
+
+
+class _FuncInfo:
+    __slots__ = ("direct", "calls", "edges", "bare_acquires")
+
+    def __init__(self) -> None:
+        self.direct: Set[str] = set()
+        # (held locks at the call site, callee bare name, path, line)
+        self.calls: List[Tuple[Tuple[str, ...], str, str, int]] = []
+        # (held, acquired, path, line) from direct with-nesting
+        self.edges: List[Tuple[str, str, str, int]] = []
+        self.bare_acquires: List[Tuple[str, str, int]] = []
+
+
+def _analyze_function(
+    fn: ast.AST, locks: _Locks, path: str
+) -> _FuncInfo:
+    info = _FuncInfo()
+
+    def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+        if node is not fn and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return  # nested def: runs later, not under the current holds
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in node.items:
+                visit(item.context_expr, held)
+                lock = locks.resolve(item.context_expr)
+                if lock is not None:
+                    # Earlier items of the same `with a, b:` are already
+                    # held when this one acquires — they edge too.
+                    for h in held + tuple(acquired):
+                        info.edges.append((h, lock, path, node.lineno))
+                    info.direct.add(lock)
+                    acquired.append(lock)
+            inner = held + tuple(acquired)
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func)
+            if fname is not None:
+                leaf = fname.rsplit(".", 1)[-1]
+                if leaf == "acquire" and isinstance(node.func, ast.Attribute):
+                    lock = locks.resolve(node.func.value)
+                    if lock is not None:
+                        info.bare_acquires.append((lock, path, node.lineno))
+                elif not (
+                    isinstance(node.func, ast.Attribute)
+                    and leaf in _CONTAINER_METHODS
+                ):
+                    info.calls.append((held, leaf, path, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    visit(fn, ())
+    return info
+
+
+@register(RULE)
+def lock_order(repo: Repo) -> List[Finding]:
+    locks = discover_locks(repo)
+    out: List[Finding] = []
+    if not locks.kinds:
+        return out
+
+    # Per bare function name: union of infos (name collisions merge —
+    # conservative for cycle detection).
+    table: Dict[str, List[_FuncInfo]] = {}
+    infos: List[_FuncInfo] = []
+    for mod in repo.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = _analyze_function(node, locks, mod.path)
+                infos.append(info)
+                table.setdefault(node.name, []).append(info)
+
+    for info in infos:
+        for lock, path, line in info.bare_acquires:
+            out.append(Finding(
+                RULE, path, line,
+                f"bare '{lock}.acquire()' — acquire locks with "
+                "'with' so exceptions can never leak the hold",
+            ))
+
+    # Transitive acquire sets: locks a call to <name> may take, to fixpoint.
+    total: Dict[str, Set[str]] = {}
+    for name, fns in table.items():
+        total[name] = set()
+        for f in fns:
+            total[name] |= f.direct
+    changed = True
+    while changed:
+        changed = False
+        for name, fns in table.items():
+            acc = set(total[name])
+            for f in fns:
+                for _, callee, _, _ in f.calls:
+                    acc |= total.get(callee, set())
+            if acc != total[name]:
+                total[name] = acc
+                changed = True
+
+    # Edges: direct with-nesting plus call-through acquisition.
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    for info in infos:
+        for h, l, path, line in info.edges:
+            edges.setdefault((h, l), (path, line))
+        for held, callee, path, line in info.calls:
+            if not held:
+                continue
+            for l in total.get(callee, set()):
+                for h in held:
+                    edges.setdefault((h, l), (path, line))
+
+    # Self-edges: re-acquiring a non-reentrant lock while held.
+    for (a, b), (path, line) in sorted(edges.items()):
+        if a == b and locks.kinds.get(a) != "rlock":
+            out.append(Finding(
+                RULE, path, line,
+                f"non-reentrant lock '{a}' may be acquired while already "
+                "held (self-deadlock); use RLock or restructure",
+            ))
+
+    # Cycles among distinct locks: DFS over the edge graph.
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        if a != b:
+            graph.setdefault(a, set()).add(b)
+    for cycle in _find_cycles(graph):
+        first_edge = (cycle[0], cycle[1 % len(cycle)])
+        path, line = edges.get(first_edge, ("", 0))
+        pretty = " -> ".join(cycle + (cycle[0],))
+        out.append(Finding(
+            RULE, path or repo.modules[0].path, line,
+            f"lock acquisition cycle {pretty}: two threads taking these "
+            "locks in opposite orders deadlock",
+        ))
+    return out
+
+
+def _find_cycles(graph: Dict[str, Set[str]]) -> List[Tuple[str, ...]]:
+    """Elementary cycles, deduplicated by node set (one finding per cycle)."""
+    seen: Set[frozenset] = set()
+    cycles: List[Tuple[str, ...]] = []
+    for start in sorted(graph):
+        stack: List[Tuple[str, Tuple[str, ...]]] = [(start, (start,))]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start and len(path) > 1:
+                    key = frozenset(path)
+                    if key not in seen:
+                        seen.add(key)
+                        cycles.append(path)
+                elif nxt not in path and len(path) < 8:
+                    stack.append((nxt, path + (nxt,)))
+    return cycles

@@ -72,7 +72,9 @@ _scope_counter = itertools.count(1)
 def _enabled() -> bool:
     from scheduler_tpu.utils.envflags import env_bool
 
-    return env_bool("SCHEDULER_TPU_ENGINE_CACHE", True)
+    # Gates the cache itself (off -> every cycle cold-builds); by definition
+    # not part of the key of the entries it controls.
+    return env_bool("SCHEDULER_TPU_ENGINE_CACHE", True)  # schedlint: ignore[env-drift]
 
 
 def _cap() -> int:
@@ -80,7 +82,8 @@ def _cap() -> int:
     buffers; the steady daemon needs exactly one per session shape)."""
     from scheduler_tpu.utils.envflags import env_int
 
-    return env_int("SCHEDULER_TPU_ENGINE_CACHE_ENTRIES", 2, minimum=1)
+    # Residency cap, re-read at every insertion — never baked into an entry.
+    return env_int("SCHEDULER_TPU_ENGINE_CACHE_ENTRIES", 2, minimum=1)  # schedlint: ignore[env-drift]
 
 
 def _cache_scope(cache) -> Optional[int]:

@@ -41,7 +41,6 @@ from __future__ import annotations
 
 import functools
 import logging
-import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -120,10 +119,10 @@ def _cohort_chunks() -> int:
     chunks on accelerator backends and 1 (off) elsewhere — interpret-mode
     CPU runs pay real trace/compile time per chunk for no wall-clock win, so
     tests opt in explicitly; an integer forces the count (1 disables)."""
-    from scheduler_tpu.utils.envflags import env_int
+    from scheduler_tpu.utils.envflags import env_int, env_str
 
-    raw = os.environ.get("SCHEDULER_TPU_COHORT", "auto")
-    if raw.strip().lower() == "auto":
+    raw = env_str("SCHEDULER_TPU_COHORT", "auto")
+    if raw == "auto":
         try:
             on_accel = jax.default_backend() in ("tpu", "axon")
         except Exception:  # pragma: no cover - backend probing
@@ -1925,7 +1924,10 @@ class FusedAllocator:
         scan stays one-task-at-a-time and speed comes from unrolling."""
         from scheduler_tpu.utils.envflags import env_int
 
-        return env_int("SCHEDULER_TPU_WINDOW", 8, minimum=1)
+        # Re-read at every dispatch and passed as a static jit arg — a
+        # resident cached engine honors a changed value on its next launch,
+        # so the flag never goes stale and stays out of _ENV_KEYS.
+        return env_int("SCHEDULER_TPU_WINDOW", 8, minimum=1)  # schedlint: ignore[env-drift]
 
     @property
     def args(self):
@@ -1991,12 +1993,15 @@ class FusedAllocator:
         [-3-(nb-1), nb-1] ∪ {-1, -2}).  The narrowing runs as an XLA op
         AFTER the kernel — in-kernel int16 stores are catastrophically slow
         on this backend — and costs ~nothing while the tunneled transfer is
-        the device phase's floor."""
+        the device phase's floor.  The fetch is an EXPLICIT device_get —
+        this is the cycle's one sanctioned collect point, and explicit
+        transfers stay legal under the sanitize-mode transfer guard
+        (utils/sanitize.py)."""
         if self.n_bucket <= 30000 and (self._mesh is None or self.use_mega):
             # Mega output is replicated even on a mesh; only the node-sharded
             # XLA program's output skips the narrowing jit.
-            return np.asarray(_narrow16(dev)).astype(np.int32)
-        return np.asarray(dev)
+            return jax.device_get(_narrow16(dev)).astype(np.int32)
+        return jax.device_get(dev)
 
     def dispatch(self) -> None:
         """Launch the device program WITHOUT blocking (JAX dispatches
@@ -2008,34 +2013,44 @@ class FusedAllocator:
         bookkeeping) before paying the blocking collect."""
         if self._dev is not None:
             return
+        from scheduler_tpu.utils import sanitize
+
         if self.use_mega:
             from scheduler_tpu.ops import megakernel as _mk
 
             try:
-                self._dev, self._dev_stats = _mk.mega_allocate(
-                    *self._mega_args, **self._mega_kw
-                )
+                with sanitize.guard():
+                    self._dev, self._dev_stats = _mk.mega_allocate(
+                        *self._mega_args, **self._mega_kw
+                    )
                 return
-            except Exception:  # pragma: no cover - backend-specific
+            except Exception as err:  # pragma: no cover - backend-specific
+                if sanitize.is_violation(err):
+                    raise  # sanitizer finding, not a backend failure
                 logger.exception("mega kernel failed; falling back to XLA path")
                 self.use_mega = False
         self._dev_stats = None
-        self._dev = fused_allocate(
-            *self.args,
-            comparators=self.comparators,
-            queue_comparators=self.queue_comparators,
-            overused_gate=self.overused_gate,
-            use_static=self.use_static,
-            n_queues=len(self.queue_uids),
-            weights=self.weights,
-            enforce_pod_count=self.enforce_pod_count,
-            window=self._window_size(),
-            batch_runs=self.batch_runs,
-            sorted_jobs=True,
-            has_releasing=self.has_releasing,
-            step_kernel=self.step_kernel,
-            mesh=self._mesh,
-        )
+        # Under SCHEDULER_TPU_SANITIZE the launch runs inside a transfer
+        # guard: every program input must already be device-resident (the
+        # engine stages via transfer_cache.to_device / device_put), so an
+        # implicit host->device upload here is a staging bug, not traffic.
+        with sanitize.guard():
+            self._dev = fused_allocate(
+                *self.args,
+                comparators=self.comparators,
+                queue_comparators=self.queue_comparators,
+                overused_gate=self.overused_gate,
+                use_static=self.use_static,
+                n_queues=len(self.queue_uids),
+                weights=self.weights,
+                enforce_pod_count=self.enforce_pod_count,
+                window=self._window_size(),
+                batch_runs=self.batch_runs,
+                sorted_jobs=True,
+                has_releasing=self.has_releasing,
+                step_kernel=self.step_kernel,
+                mesh=self._mesh,
+            )
 
     def readback(self) -> np.ndarray:
         """Blocking collect of the dispatched program's placement codes
@@ -2044,13 +2059,16 @@ class FusedAllocator:
             self.dispatch()
         dev, self._dev = self._dev, None
         stats_dev, self._dev_stats = self._dev_stats, None
+        from scheduler_tpu.utils import sanitize
+
         try:
-            encoded = self._readback(dev)
-            self._stats_raw = (
-                np.asarray(stats_dev) if stats_dev is not None else None
-            )
-        except Exception:  # pragma: no cover - backend-specific
-            if not self.use_mega:
+            with sanitize.guard():
+                encoded = self._readback(dev)
+                self._stats_raw = (
+                    jax.device_get(stats_dev) if stats_dev is not None else None
+                )
+        except Exception as err:  # pragma: no cover - backend-specific
+            if not self.use_mega or sanitize.is_violation(err):
                 raise
             # Async launches surface kernel failures at collect time; same
             # fallback as a dispatch-time failure.

@@ -17,7 +17,6 @@ power-of-two node buckets always divide evenly.
 from __future__ import annotations
 
 import logging
-import os
 from typing import Optional, Tuple
 
 import numpy as np
@@ -29,7 +28,9 @@ _cached_key: Optional[str] = None
 
 
 def mesh_spec() -> str:
-    return os.environ.get("SCHEDULER_TPU_MESH", "1")
+    from scheduler_tpu.utils.envflags import env_str
+
+    return env_str("SCHEDULER_TPU_MESH", "1")
 
 
 def get_mesh():

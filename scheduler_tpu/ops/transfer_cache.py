@@ -39,7 +39,10 @@ import numpy as np
 def _cap_bytes() -> int:
     from scheduler_tpu.utils.envflags import env_int
 
-    return env_int("SCHEDULER_TPU_XFER_CACHE_MB", 256, minimum=0) * 1024 * 1024
+    # Byte budget of the content-addressed upload cache, re-read per upload;
+    # entries are keyed by content hash, so the cap can never serve a stale
+    # program — it only bounds residency.
+    return env_int("SCHEDULER_TPU_XFER_CACHE_MB", 256, minimum=0) * 1024 * 1024  # schedlint: ignore[env-drift]
 
 
 class TransferCache:

@@ -88,6 +88,10 @@ class VictimGate:
         self.kind = kind
         from scheduler_tpu.utils.envflags import env_bool
 
+        # VictimGate is built fresh by every preempt/reclaim execution (one
+        # session, one cycle) and is never resident in the engine cache, so
+        # these gates are re-read per cycle and stay out of _ENV_KEYS.
+        # schedlint: ignore[env-drift]
         self.enabled = env_bool("SCHEDULER_TPU_VICTIM_GATE", True) and env_bool(
             "SCHEDULER_TPU_SWEEP", True
         )

@@ -1,0 +1,93 @@
+"""``SCHEDULER_TPU_SANITIZE=1``: runtime sanitizers for the device phase.
+
+The static side of schedlint (``scheduler_tpu/analysis``) proves the
+*syntactic* host-sync invariants; this module proves the *dynamic* ones,
+the way the reference leans on Go's race detector as a standing gate:
+
+* **transfer guard** — ``jax.transfer_guard("disallow")`` armed around the
+  device phase (``FusedAllocator.dispatch`` + ``readback``).  Any IMPLICIT
+  host<->device transfer mid-phase — a forgotten host numpy argument, a
+  stray ``np.asarray`` on a device buffer — raises instead of silently
+  serializing the pipelined cycle.  Explicit transfers
+  (``jax.device_put`` staging, ``jax.device_get`` readback) stay legal:
+  the invariant is "no transfer the engine didn't *mean*".
+* **debug-NaN checking** — ``jax_debug_nans`` process-wide, so a fairness
+  share or score kernel that manufactures a NaN fails the cycle loudly
+  instead of corrupting placements downstream.
+
+Zero cost when off: ``guard()`` is a null context and ``arm()`` a no-op
+unless the flag is set.  Sanitize mode is diagnostic — expect recompiles
+and slower cycles; ``bench.py`` records ``detail.sanitize`` so a sanitized
+artifact can never masquerade as a perf number.
+"""
+
+from __future__ import annotations
+
+import logging
+from contextlib import contextmanager
+
+logger = logging.getLogger("scheduler_tpu.utils.sanitize")
+
+_armed = False
+
+
+def enabled() -> bool:
+    from scheduler_tpu.utils.envflags import env_bool
+
+    return env_bool("SCHEDULER_TPU_SANITIZE", False)
+
+
+def arm() -> bool:
+    """Arm the process-wide sanitizers when the flag is set (idempotent).
+    Returns whether sanitize mode is on."""
+    global _armed
+    if not enabled():
+        return False
+    if not _armed:
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
+        _armed = True
+        logger.warning(
+            "SCHEDULER_TPU_SANITIZE=1: debug-NaN checking on, device phase "
+            "runs under transfer_guard('disallow') — diagnostic mode, "
+            "expect recompiles and slower cycles"
+        )
+    return True
+
+
+def disarm() -> None:
+    """Undo ``arm()`` (tests must not leak debug-NaN mode process-wide)."""
+    global _armed
+    if _armed:
+        import jax
+
+        jax.config.update("jax_debug_nans", False)
+        _armed = False
+
+
+def is_violation(err: BaseException) -> bool:
+    """Is this exception a sanitizer finding — a transfer-guard trip or a
+    debug-NaN FloatingPointError?  Engine fallback paths (mega -> XLA) must
+    RE-RAISE these instead of swallowing them as backend failures — a
+    sanitizer that degrades to a slower-but-working path has found a bug
+    and then hidden it."""
+    if not enabled():
+        return False
+    if isinstance(err, FloatingPointError):
+        return True  # jax_debug_nans raises FloatingPointError on NaN/inf
+    msg = str(err)
+    return "isallowed" in msg and "transfer" in msg.lower()
+
+
+@contextmanager
+def guard():
+    """Transfer guard for the device phase: null when sanitize is off."""
+    if not enabled():
+        yield
+        return
+    arm()
+    import jax
+
+    with jax.transfer_guard("disallow"):
+        yield

@@ -46,7 +46,7 @@ TS0 = 1_700_000_000.0
 
 
 def measure(name, factory, conf_str, actions, placed_of, cycles=20,
-            results=None):
+            results=None, extra=None):
     """``factory()`` returns a fresh ``(build, churn)`` pair (fresh churn
     state per build).  One throwaway build absorbs the jit compile; the
     recorded runs hit the compile cache like the steady scheduler loop."""
@@ -62,6 +62,10 @@ def measure(name, factory, conf_str, actions, placed_of, cycles=20,
     placed_full = placed_of(cache)
     rec = {
         "scenario": name,
+        # Scenario-shape evidence (e.g. scenario 4's pending-task count):
+        # the JSON must carry the scale it actually ran at, so a mis-built
+        # scenario can't hide behind the BASELINE.md label.
+        **(extra or {}),
         "placed_full": placed_full,
         "full_cycle_seconds": round(full_s, 3),
         "full_placed_per_sec": round(placed_full / full_s, 1) if full_s else 0.0,
@@ -354,7 +358,10 @@ def _s3_build_churn(n_nodes, n_pods, per_job, alive):
 def scenario4(scale, cycles, results):
     n_nodes = int(1000 * scale)
     n_run = int(25_000 * scale)
-    n_pend = int(25_000 * scale)
+    # BASELINE.md scenario 4: "50k pending tasks" over-subscribing the
+    # running fat queue (an earlier build halved this to 25k and the JSON
+    # carried nothing that said so — the record now ships the real count).
+    n_pend = int(50_000 * scale)
     gang = 50
 
     def factory():
@@ -370,7 +377,8 @@ tiers:
   - name: proportion
 """
     measure("4-two-queue-reclaim", factory, conf, ("reclaim",),
-            lambda c: len(c.evictor.evicts), cycles, results)
+            lambda c: len(c.evictor.evicts), cycles, results,
+            extra={"pending_tasks": n_pend, "running_tasks": n_run})
 
 
 def _s4_build_churn(n_nodes, n_run, n_pend, gang, alive):

@@ -15,7 +15,15 @@ import sys
 # chip count is insufficient).
 # Single source of truth for the flag — test modules import this rather than
 # re-parsing the env var (drift would change skip-vs-fail behavior).
-USE_TPU = os.environ.get("SCHEDULER_TPU_TEST_TPU", "").lower() in ("1", "true")
+# envflags is jax-free, so reading it here keeps the before-any-jax-import
+# contract while malformed values warn instead of silently counting as off.
+# The path insert must come first: pytest may run from any cwd and the
+# package is driven from the checkout, not an install.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scheduler_tpu.utils.envflags import env_bool  # noqa: E402
+
+USE_TPU = env_bool("SCHEDULER_TPU_TEST_TPU", False)
 _use_tpu = USE_TPU
 if not _use_tpu:
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -32,5 +40,3 @@ import jax  # noqa: E402
 
 if not _use_tpu:
     jax.config.update("jax_platforms", "cpu")
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

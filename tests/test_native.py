@@ -13,9 +13,9 @@ def rng():
 
 
 def test_native_builds_and_loads():
-    import os
+    from scheduler_tpu.utils.envflags import env_bool
 
-    if os.environ.get("SCHEDULER_TPU_NATIVE", "1") in ("0", "false"):
+    if not env_bool("SCHEDULER_TPU_NATIVE", True):
         pytest.skip("native explicitly disabled via SCHEDULER_TPU_NATIVE")
     assert native.build() is not None
     assert native.available()

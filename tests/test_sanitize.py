@@ -1,0 +1,103 @@
+"""SCHEDULER_TPU_SANITIZE: the runtime half of schedlint.
+
+The fast tests pin the guard mechanics (null when off, trips on implicit
+transfers when on, explicit transfers stay legal).  The slow test is the
+acceptance gate: a full flagship-shaped allocate cycle under
+``transfer_guard("disallow")`` + debug-NaN — the device phase performs ZERO
+implicit host transfers or the cycle raises."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from scheduler_tpu.utils import sanitize
+
+
+@pytest.fixture
+def sanitize_on(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_SANITIZE", "1")
+    yield
+    # debug-NaN is armed process-wide; never leak it into other tests.
+    sanitize.disarm()
+
+
+def test_guard_is_null_when_off(monkeypatch):
+    import jax
+
+    monkeypatch.delenv("SCHEDULER_TPU_SANITIZE", raising=False)
+    assert sanitize.arm() is False
+    f = jax.jit(lambda x: x * 2)
+    with sanitize.guard():
+        # Implicit host->device transfer: legal with the sanitizer off.
+        out = f(np.ones(4, np.float32))
+    assert float(out[0]) == 2.0
+
+
+def test_guard_trips_on_implicit_transfer(sanitize_on):
+    import jax
+
+    assert sanitize.arm() is True
+    f = jax.jit(lambda x: x * 2)
+    f(jax.device_put(np.ones(4, np.float32)))  # compile outside the guard
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        with sanitize.guard():
+            f(np.ones(4, np.float32))  # host numpy arg: implicit upload
+
+
+def test_violation_is_not_a_backend_failure(sanitize_on):
+    """The mega->XLA fallback must re-raise guard trips (a sanitizer that
+    hides its finding behind a slower working path is useless)."""
+    import jax
+
+    f = jax.jit(lambda x: x * 2)
+    f(jax.device_put(np.ones(2, np.float32)))
+    try:
+        with sanitize.guard():
+            f(np.ones(2, np.float32))
+    except Exception as err:
+        assert sanitize.is_violation(err)
+    else:
+        pytest.fail("guard did not trip")
+    assert not sanitize.is_violation(RuntimeError("mosaic lowering failed"))
+    # debug-NaN findings surface as FloatingPointError: also a violation.
+    assert sanitize.is_violation(FloatingPointError("invalid value (nan)"))
+
+
+def test_guard_allows_explicit_transfers(sanitize_on):
+    import jax
+
+    f = jax.jit(lambda x: x * 2)
+    with sanitize.guard():
+        dev = f(jax.device_put(np.ones(4, np.float32)))
+        host = jax.device_get(dev)  # the readback idiom: explicit, legal
+    assert host[0] == 2.0
+
+
+@pytest.mark.slow
+def test_device_phase_is_transfer_clean_under_sanitize(sanitize_on):
+    """Flagship-shaped allocate cycle with the transfer guard armed around
+    dispatch+readback (ops/fused.py): every engine input must already be
+    device-resident and the collect must be explicit.  Any implicit
+    transfer in the device phase raises and fails this test."""
+    import scheduler_tpu.actions  # noqa: F401  registry side effects
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.harness import make_synthetic_cluster
+    from scheduler_tpu.harness.measure import steady_cycle
+
+    conf = parse_scheduler_conf(
+        """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+"""
+    )
+    cluster = make_synthetic_cluster(64, 256, tasks_per_job=16)
+    assert sanitize.arm() is True
+    steady_cycle(cluster.cache, conf, ("allocate",))
+    assert len(cluster.cache.binder.binds) == 256

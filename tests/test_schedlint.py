@@ -1,0 +1,473 @@
+"""schedlint regression corpus: every pass must trip on its violation
+fixture and stay quiet on the clean twin (docs/STATIC_ANALYSIS.md).
+
+The fixtures are the distilled versions of real failure classes: the
+env-flag cache-drift PR 1/2 created, the host-sync leaks the pipelined
+cycle forbids, donated-buffer reuse, ABBA lock orders, and round-5's
+dangling doc artifacts."""
+
+from __future__ import annotations
+
+import textwrap
+
+from scheduler_tpu.analysis import Repo, run_passes
+
+
+def findings(rule, py=None, docs=None, existing=()):
+    repo = Repo.from_sources(
+        py={k: textwrap.dedent(v) for k, v in (py or {}).items()},
+        docs={k: textwrap.dedent(v) for k, v in (docs or {}).items()},
+        existing=existing,
+    )
+    return [f for f in run_passes(repo, [rule])]
+
+
+ENGINE_CACHE_STUB = """
+    _ENV_KEYS = (
+        "SCHEDULER_TPU_MEGA",
+        "SCHEDULER_TPU_COHORT",
+    )
+"""
+
+
+# -- env-drift ----------------------------------------------------------------
+
+def test_env_drift_trips_on_unregistered_ops_flag():
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+        "scheduler_tpu/ops/fast.py": """
+            from scheduler_tpu.utils.envflags import env_bool
+            def gate():
+                return env_bool("SCHEDULER_TPU_TURBO", True)
+        """,
+    })
+    assert len(out) == 1
+    assert out[0].rule == "env-drift"
+    assert "SCHEDULER_TPU_TURBO" in out[0].message
+    assert out[0].path == "scheduler_tpu/ops/fast.py"
+
+
+def test_env_drift_clean_on_registered_flag_and_outside_ops():
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+        "scheduler_tpu/ops/fast.py": """
+            from scheduler_tpu.utils.envflags import env_bool
+            def gate():
+                return env_bool("SCHEDULER_TPU_MEGA", True)
+        """,
+        # utils/ reads are not engine-program-selecting: out of drift scope.
+        "scheduler_tpu/utils/knob.py": """
+            from scheduler_tpu.utils.envflags import env_bool
+            def gate():
+                return env_bool("SCHEDULER_TPU_OTHER", True)
+        """,
+    })
+    assert out == []
+
+
+def test_env_drift_ignore_comment_suppresses():
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+        "scheduler_tpu/ops/fast.py": """
+            from scheduler_tpu.utils.envflags import env_int
+            def window():
+                # re-read per dispatch, never resident
+                return env_int("SCHEDULER_TPU_W", 8)  # schedlint: ignore[env-drift]
+        """,
+    })
+    assert out == []
+
+
+def test_env_drift_reports_missing_registry():
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/fast.py": """
+            from scheduler_tpu.utils.envflags import env_bool
+            def gate():
+                return env_bool("SCHEDULER_TPU_TURBO", True)
+        """,
+    })
+    assert len(out) == 1 and "_ENV_KEYS" in out[0].message
+
+
+# -- raw-env ------------------------------------------------------------------
+
+def test_raw_env_trips_on_os_environ_read():
+    out = findings("raw-env", py={
+        "scheduler_tpu/ops/fast.py": """
+            import os
+            def gate():
+                a = os.environ.get("SCHEDULER_TPU_TURBO", "1")
+                b = os.environ["SCHEDULER_TPU_BOOST"]
+                return a, b
+        """,
+    })
+    assert [f.line for f in out] == [4, 5]
+
+
+def test_raw_env_and_drift_catch_os_getenv():
+    out = findings("raw-env", py={
+        "scheduler_tpu/ops/fast.py": """
+            import os
+            def gate():
+                return os.getenv("SCHEDULER_TPU_TURBO", "1")
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_TURBO" in out[0].message
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+        "scheduler_tpu/ops/fast.py": """
+            import os
+            def gate():
+                return os.getenv("SCHEDULER_TPU_TURBO", "1")
+        """,
+    })
+    assert len(out) == 1 and out[0].rule == "env-drift"
+
+
+def test_raw_env_allows_writes_and_envflags_reads():
+    out = findings("raw-env", py={
+        "scheduler_tpu/cli.py": """
+            import os
+            from scheduler_tpu.utils.envflags import env_str
+            def setup(opt):
+                os.environ["SCHEDULER_TPU_MESH"] = opt
+                return env_str("SCHEDULER_TPU_MESH", "1")
+        """,
+        # envflags itself is the one sanctioned os.environ owner.
+        "scheduler_tpu/utils/envflags.py": """
+            import os
+            def env_str(name, default):
+                return os.environ.get("SCHEDULER_TPU_ANY", default)
+        """,
+    })
+    assert out == []
+
+
+# -- host-sync ----------------------------------------------------------------
+
+def test_host_sync_trips_on_concretization_in_jit():
+    out = findings("host-sync", py={
+        "scheduler_tpu/ops/k.py": """
+            import jax
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    y = float(x)
+                    return y
+                return x.item()
+        """,
+    })
+    rules = sorted((f.line, f.rule) for f in out)
+    assert len(out) == 3  # branch, float(), .item()
+    assert all(r == "host-sync" for _, r in rules)
+
+
+def test_host_sync_trips_on_np_pull_and_nested_loop_body():
+    out = findings("host-sync", py={
+        "scheduler_tpu/ops/k.py": """
+            import functools
+            import jax
+            import numpy as np
+            @functools.partial(jax.jit, static_argnames=("flag",))
+            def f(x, flag):
+                def body(state):
+                    return np.asarray(state)
+                if flag:
+                    return body(x)
+                return x
+        """,
+    })
+    assert len(out) == 1 and "np.asarray" in out[0].message
+
+
+def test_host_sync_clean_on_static_branches_and_shape():
+    out = findings("host-sync", py={
+        "scheduler_tpu/ops/k.py": """
+            import functools
+            import jax
+            import numpy as np
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def f(x, mode, opt=None):
+                if mode == "fast":        # static arg: trace-time branch
+                    return x * 2
+                if opt is None:           # identity check: trace-time
+                    n = int(x.shape[0])   # shapes are static under jit
+                    return x + n
+                return x
+        """,
+    })
+    assert out == []
+
+
+def test_host_sync_pallas_kernel_body_counts_as_traced():
+    out = findings("host-sync", py={
+        "scheduler_tpu/ops/pk.py": """
+            from jax.experimental import pallas as pl
+            def kernel(x_ref, o_ref):
+                if x_ref[0] > 0:
+                    o_ref[0] = 1.0
+            def call(x):
+                return pl.pallas_call(kernel, out_shape=x)(x)
+        """,
+    })
+    assert len(out) == 1 and "branch" in out[0].message.lower()
+
+
+def test_host_sync_sees_call_form_jit():
+    out = findings("host-sync", py={
+        "scheduler_tpu/ops/k.py": """
+            import jax
+            def _impl(x, mode):
+                if x > 0:
+                    return float(x)
+                return x
+            f = jax.jit(_impl, static_argnames=("mode",))
+        """,
+    })
+    assert len(out) == 2  # branch on x + float(x); mode stays static
+    assert all("_impl" in f.message for f in out)
+
+
+def test_host_sync_block_until_ready_outside_readback():
+    out = findings("host-sync", py={
+        "scheduler_tpu/ops/engine.py": """
+            import jax
+            def dispatch(dev):
+                jax.block_until_ready(dev)
+            def readback(dev):
+                return jax.block_until_ready(dev)
+        """,
+    })
+    assert len(out) == 1 and out[0].line == 4
+
+
+# -- donation -----------------------------------------------------------------
+
+DONATED_DEF = """
+    import functools
+    import jax
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def scatter(buf, vals):
+        return buf.at[0].set(vals)
+"""
+
+
+def test_donation_trips_on_read_after_dispatch():
+    out = findings("donation", py={
+        "scheduler_tpu/ops/d.py": DONATED_DEF + """
+    def caller(buf, vals):
+        out = scatter(buf, vals)
+        return out + buf.sum()
+""",
+    })
+    assert len(out) == 1
+    assert "buf" in out[0].message and "after dispatch" in out[0].message
+
+
+def test_donation_same_statement_read_after_call():
+    # Left-to-right evaluation: buf[0] on the RIGHT of the call reads the
+    # donated buffer after dispatch; on the LEFT it reads before — legal.
+    out = findings("donation", py={
+        "scheduler_tpu/ops/d.py": DONATED_DEF + """
+    def bad(buf, vals):
+        return scatter(buf, vals) + buf[0]
+    def fine(buf, vals):
+        return buf[0] + scatter(buf, vals)
+""",
+    })
+    assert len(out) == 1
+    assert "after dispatch" in out[0].message
+
+
+def test_donation_clean_on_rebind():
+    out = findings("donation", py={
+        "scheduler_tpu/ops/d.py": DONATED_DEF + """
+    def caller(buf, vals):
+        buf = scatter(buf, vals)
+        return buf.sum()
+""",
+    })
+    assert out == []
+
+
+def test_donation_follows_backend_alias():
+    # The engine's real shape: pick the donated variant per backend.
+    out = findings("donation", py={
+        "scheduler_tpu/ops/d.py": DONATED_DEF + """
+    def plain(buf, vals):
+        return buf.at[0].set(vals)
+    def caller(buf, vals, on_tpu):
+        op = scatter if on_tpu else plain
+        dev = op(buf, vals)
+        return dev + buf[0], buf.shape
+""",
+    })
+    # buf[0] after donation through the backend-picked alias is flagged;
+    # buf.shape is not (array metadata survives donation).
+    assert len(out) == 1 and "buf" in out[0].message
+
+
+# -- lock-order ---------------------------------------------------------------
+
+def test_lock_order_trips_on_abba_cycle():
+    out = findings("lock-order", py={
+        "scheduler_tpu/cache/c.py": """
+            import threading
+            class A:
+                def __init__(self):
+                    self.mu_a = threading.Lock()
+                    self.mu_b = threading.Lock()
+                def ab(self):
+                    with self.mu_a:
+                        with self.mu_b:
+                            pass
+                def ba(self):
+                    with self.mu_b:
+                        with self.mu_a:
+                            pass
+        """,
+    })
+    assert len(out) == 1 and "cycle" in out[0].message
+
+
+def test_lock_order_trips_on_multi_item_with_abba():
+    out = findings("lock-order", py={
+        "scheduler_tpu/cache/c.py": """
+            import threading
+            class A:
+                def __init__(self):
+                    self.mu_a = threading.Lock()
+                    self.mu_b = threading.Lock()
+                def ab(self):
+                    with self.mu_a, self.mu_b:
+                        pass
+                def ba(self):
+                    with self.mu_b, self.mu_a:
+                        pass
+        """,
+    })
+    assert len(out) == 1 and "cycle" in out[0].message
+
+
+def test_lock_order_trips_on_cycle_through_call():
+    out = findings("lock-order", py={
+        "scheduler_tpu/cache/c.py": """
+            import threading
+            mu_a = threading.Lock()
+            mu_b = threading.Lock()
+            def takes_b():
+                with mu_b:
+                    return 1
+            def ab():
+                with mu_a:
+                    return takes_b()
+            def ba():
+                with mu_b:
+                    with mu_a:
+                        pass
+        """,
+    })
+    assert len(out) == 1 and "cycle" in out[0].message
+
+
+def test_lock_order_trips_on_bare_acquire_and_nonreentrant_self():
+    out = findings("lock-order", py={
+        "scheduler_tpu/cache/c.py": """
+            import threading
+            class C:
+                def __init__(self):
+                    self.mu = threading.Lock()
+                def bare(self):
+                    self.mu.acquire()
+                def reenter(self):
+                    with self.mu:
+                        with self.mu:
+                            pass
+        """,
+    })
+    msgs = sorted(f.message for f in out)
+    assert len(out) == 2
+    assert any("acquire()" in m for m in msgs)
+    assert any("non-reentrant" in m for m in msgs)
+
+
+def test_lock_order_clean_on_rlock_reentry_and_ordered_nesting():
+    out = findings("lock-order", py={
+        "scheduler_tpu/cache/c.py": """
+            import threading
+            class C:
+                def __init__(self):
+                    self.mutex = threading.RLock()
+                    self.inner = threading.Lock()
+                def outer(self):
+                    with self.mutex:
+                        with self.mutex:      # RLock: reentrancy by design
+                            with self.inner:  # consistent order, no cycle
+                                pass
+        """,
+    })
+    assert out == []
+
+
+# -- doc-refs -----------------------------------------------------------------
+
+def test_doc_refs_trips_on_dangling_artifact():
+    out = findings("doc-refs", docs={
+        "docs/ROUND9.md": """
+            Evidence: `LADDER_r09.json` and `docs/PERF_r09.md`.
+        """,
+    }, existing=["docs/ROUND9.md"])
+    assert sorted(f.message for f in out)
+    assert len(out) == 2
+    assert all("does not exist" in f.message for f in out)
+
+
+def test_doc_refs_resolves_root_docdir_package_and_reference_repo():
+    out = findings("doc-refs", docs={
+        "docs/ROUND9.md": """
+            See `BENCH_r09.json`, `docs/PERF_r09.md`, `ops/fused.py:12-40`,
+            and the reference's `pkg/scheduler/allocate.go:46-72`.
+        """,
+    }, existing=[
+        "BENCH_r09.json", "docs/PERF_r09.md", "scheduler_tpu/ops/fused.py",
+    ])
+    assert out == []
+
+
+def test_doc_refs_ignore_comment_suppresses():
+    out = findings("doc-refs", docs={
+        "docs/ROUND9.md": """
+            Planned artifact: `docs/PERF_r10.md` <!-- schedlint: ignore[doc-refs] -->
+        """,
+    }, existing=["docs/ROUND9.md"])
+    assert out == []
+
+
+def test_doc_refs_ignore_works_on_heading_lines():
+    # A Markdown heading starts with '#': the trailing ignore must apply to
+    # the heading ITSELF, not be misread as a standalone comment for the
+    # next line.
+    out = findings("doc-refs", docs={
+        "docs/ROUND9.md": """
+            ## Planned: `docs/PERF_r10.md` <!-- schedlint: ignore[doc-refs] -->
+            And `docs/PERF_r11.md` is still a finding.
+        """,
+    }, existing=["docs/ROUND9.md"])
+    assert len(out) == 1 and "PERF_r11" in out[0].message
+
+
+# -- the committed tree itself ------------------------------------------------
+
+def test_committed_tree_is_clean():
+    """The acceptance gate as a test: schedlint exits 0 on the repo."""
+    import importlib.util
+    from pathlib import Path
+
+    cli_path = Path(__file__).resolve().parent.parent / "scripts" / "schedlint.py"
+    spec = importlib.util.spec_from_file_location("schedlint_cli", cli_path)
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+
+    repo = Repo.from_root(Path(cli.ROOT), cli.PY_TARGETS, cli.DOC_TARGETS)
+    out = run_passes(repo)
+    assert out == [], "\n".join(str(f) for f in out)

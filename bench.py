@@ -197,6 +197,12 @@ def main() -> None:
                     # fallback steps — proof the cohort path engaged (or a
                     # record of why it didn't).
                     "cohort": ph.get("notes", {}).get("cohort", {}),
+                    # Queue-chain evidence (docs/QUEUE_DELTA.md), present on
+                    # multi-queue cycles (SCHEDULER_TPU_BENCH_QUEUES > 1):
+                    # which chain ran ("delta" vs the kill-switch "full"
+                    # recompute) and the kernel's delta-update /
+                    # full-recompute counters.
+                    "queue_chain": ph.get("notes", {}).get("queue_chain", {}),
                 }
                 for (_, el, ph), bad in zip(runs, flags)
             ],

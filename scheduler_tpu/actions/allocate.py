@@ -361,8 +361,14 @@ class AllocateAction(Action):
             engine.readback()  # blocking collect of the dispatched program
         # Cohort evidence (docs/COHORT.md): cohorts seen by the build, device
         # steps taken, tasks per step, chunk placements, fallback steps —
-        # the bench artifact's proof that the cohort path engaged.
-        phases.note("cohort", engine.run_stats())
+        # the bench artifact's proof that the cohort path engaged.  Queue-
+        # chain evidence (docs/QUEUE_DELTA.md) rides its own note so the
+        # multi-queue bench block can surface it per cycle.
+        stats = engine.run_stats()
+        queue_chain = stats.pop("queue_chain", None)
+        phases.note("cohort", stats)
+        if queue_chain is not None:
+            phases.note("queue_chain", queue_chain)
         with phases.phase("decode"):
             items, node_batches, failures = engine.run_columnar()  # reuses codes
         with phases.phase("apply"):

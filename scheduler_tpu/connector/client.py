@@ -15,7 +15,10 @@ binds/evictions out).  This module is that seam over HTTP/JSON:
   remaining drift, exactly the reference's crash-tolerant reconcile model.
 
 Transport is stdlib ``urllib`` — the wire format, not the client library, is
-the contract.
+the contract.  Outbound RPCs share one client-side QPS+burst token bucket
+(``TokenBucket``; ``SCHEDULER_TPU_QPS`` / ``SCHEDULER_TPU_BURST``) — the
+reference kube-client's flowcontrol limiter, replacing the io-worker-count
+approximation (VERDICT #50).
 """
 
 from __future__ import annotations
@@ -23,9 +26,10 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 import urllib.error
 import urllib.request
-from typing import Optional
+from typing import Callable, Optional
 
 from scheduler_tpu.api.vocab import ResourceVocabulary
 from scheduler_tpu.cache.cache import SchedulerCache
@@ -49,10 +53,77 @@ from scheduler_tpu.connector.wire import (
 logger = logging.getLogger("scheduler_tpu.connector")
 
 
+class TokenBucket:
+    """Client-side QPS + burst rate limiter for the outbound RPCs — the
+    reference's kube-client flowcontrol limiter (its ``--kube-api-qps`` /
+    ``--kube-api-burst`` flags), which the connector previously only
+    APPROXIMATED with the io-worker pool size (VERDICT #50: a concurrency
+    bound is not a rate bound — N workers retiring fast RPCs exceed any
+    intended QPS).
+
+    Semantics match client-go's ``tokenBucketRateLimiter``: a bucket of
+    ``burst`` tokens refills continuously at ``qps`` tokens/second;
+    ``acquire`` takes one token, going into DEBT when the bucket is empty
+    and sleeping until its token's refill time — so concurrent callers are
+    paced at exactly ``qps`` once the burst is spent, in arrival order of
+    their bucket reservations.  The clock and sleep are injectable so tests
+    drive time deterministically; the lock is held only for the reservation
+    arithmetic, never across a sleep."""
+
+    def __init__(
+        self,
+        qps: float,
+        burst: int,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
+        if qps <= 0:
+            raise ValueError(f"qps must be positive, got {qps}")
+        self.qps = float(qps)
+        self.burst = float(max(1, burst))
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._tokens = self.burst
+        self._last = clock()
+
+    def acquire(self) -> float:
+        """Reserve one request slot, blocking until it is due.  Returns the
+        seconds slept (0.0 within the burst) — surfaced for tests and for
+        callers that want to log throttling."""
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.qps
+            )
+            self._last = now
+            self._tokens -= 1.0
+            wait = 0.0 if self._tokens >= 0.0 else -self._tokens / self.qps
+        if wait > 0.0:
+            self._sleep(wait)
+        return wait
+
+
+def rate_limiter_from_env() -> Optional[TokenBucket]:
+    """The connector's limiter as configured by ``SCHEDULER_TPU_QPS`` /
+    ``SCHEDULER_TPU_BURST``.  QPS unset or <= 0 disables limiting (today's
+    behavior); BURST defaults to ceil(qps) — one second of headroom, like
+    the reference's qps<=burst convention."""
+    from scheduler_tpu.utils.envflags import env_float, env_int
+
+    qps = env_float("SCHEDULER_TPU_QPS", 0.0, minimum=0.0)
+    if qps <= 0.0:
+        return None
+    burst = env_int("SCHEDULER_TPU_BURST", int(-(-qps // 1)), minimum=1)
+    return TokenBucket(qps, burst)
+
+
 def _request(
     base: str, path: str, payload: Optional[dict], method: str,
-    timeout: float = 10.0,
+    timeout: float = 10.0, limiter: Optional[TokenBucket] = None,
 ) -> dict:
+    if limiter is not None:
+        limiter.acquire()
     req = urllib.request.Request(
         base + path,
         data=None if payload is None else json.dumps(payload).encode(),
@@ -63,16 +134,19 @@ def _request(
         return json.loads(resp.read() or b"{}")
 
 
-def _post(base: str, path: str, payload: dict, timeout: float = 10.0) -> dict:
-    return _request(base, path, payload, "POST", timeout)
+def _post(base: str, path: str, payload: dict, timeout: float = 10.0,
+          limiter: Optional[TokenBucket] = None) -> dict:
+    return _request(base, path, payload, "POST", timeout, limiter)
 
 
-def _patch(base: str, path: str, payload: dict, timeout: float = 10.0) -> dict:
-    return _request(base, path, payload, "PATCH", timeout)
+def _patch(base: str, path: str, payload: dict, timeout: float = 10.0,
+           limiter: Optional[TokenBucket] = None) -> dict:
+    return _request(base, path, payload, "PATCH", timeout, limiter)
 
 
-def _delete(base: str, path: str, timeout: float = 10.0) -> dict:
-    return _request(base, path, None, "DELETE", timeout)
+def _delete(base: str, path: str, timeout: float = 10.0,
+            limiter: Optional[TokenBucket] = None) -> dict:
+    return _request(base, path, None, "DELETE", timeout, limiter)
 
 
 # The CRD group the reference registers its PodGroup/Queue types under
@@ -95,13 +169,15 @@ def _get(base: str, path: str, timeout: float = 30.0) -> dict:
 
 
 class HttpBinder(Binder):
-    def __init__(self, base: str) -> None:
+    def __init__(self, base: str,
+                 limiter: Optional[TokenBucket] = None) -> None:
         self.base = base
+        self.limiter = limiter
 
     def bind(self, pod, hostname: str) -> None:
         _post(self.base, "/bind", {
             "namespace": pod.namespace, "name": pod.name, "node": hostname,
-        })
+        }, limiter=self.limiter)
 
     def bind_bulk(self, pairs: list) -> None:
         payload = {"pairs": [
@@ -109,7 +185,7 @@ class HttpBinder(Binder):
             for pod, hostname in pairs
         ]}
         try:
-            _post(self.base, "/bind-bulk", payload)
+            _post(self.base, "/bind-bulk", payload, limiter=self.limiter)
         except urllib.error.HTTPError as err:
             if err.code != 409:
                 raise  # transport/unknown failure: caller assumes nothing applied
@@ -125,11 +201,15 @@ class HttpBinder(Binder):
 
 
 class HttpEvictor(Evictor):
-    def __init__(self, base: str) -> None:
+    def __init__(self, base: str,
+                 limiter: Optional[TokenBucket] = None) -> None:
         self.base = base
+        self.limiter = limiter
 
     def evict(self, pod) -> None:
-        _post(self.base, "/evict", {"namespace": pod.namespace, "name": pod.name})
+        _post(self.base, "/evict",
+              {"namespace": pod.namespace, "name": pod.name},
+              limiter=self.limiter)
 
 
 class HttpVolumeBinder(VolumeBinder):
@@ -142,8 +222,10 @@ class HttpVolumeBinder(VolumeBinder):
     failed bind raises into the bind path's existing resync machinery.
     """
 
-    def __init__(self, base: str) -> None:
+    def __init__(self, base: str,
+                 limiter: Optional[TokenBucket] = None) -> None:
         self.base = base
+        self.limiter = limiter
 
     def allocate_volumes(self, task, hostname: str) -> None:
         claims = task.pod.volume_claims
@@ -152,7 +234,7 @@ class HttpVolumeBinder(VolumeBinder):
         _post(self.base, "/allocate-volumes", {
             "namespace": task.pod.namespace, "name": task.pod.name,
             "node": hostname, "claims": list(claims),
-        })
+        }, limiter=self.limiter)
 
     def bind_volumes(self, task) -> None:
         claims = task.pod.volume_claims
@@ -161,7 +243,7 @@ class HttpVolumeBinder(VolumeBinder):
         _post(self.base, "/bind-volumes", {
             "namespace": task.pod.namespace, "name": task.pod.name,
             "claims": list(claims),
-        })
+        }, limiter=self.limiter)
 
 
 class HttpStatusUpdater(StatusUpdater):
@@ -169,12 +251,15 @@ class HttpStatusUpdater(StatusUpdater):
     # the reference's Recorder.Eventf against the API server.
     RECORDS_EVENTS = True
 
-    def __init__(self, base: str) -> None:
+    def __init__(self, base: str,
+                 limiter: Optional[TokenBucket] = None) -> None:
         self.base = base
+        self.limiter = limiter
 
     def record_events(self, events: list) -> None:
         try:
-            _post(self.base, "/events", {"events": events})
+            _post(self.base, "/events", {"events": events},
+                  limiter=self.limiter)
         except Exception:
             logger.warning("event batch dropped (%d events)", len(events))
 
@@ -185,7 +270,7 @@ class HttpStatusUpdater(StatusUpdater):
             "status": _cond_field(condition, "status"),
             "reason": _cond_field(condition, "reason"),
             "message": _cond_field(condition, "message"),
-        })
+        }, limiter=self.limiter)
 
     def update_pod_group(self, job) -> None:
         pg = job.pod_group
@@ -198,7 +283,7 @@ class HttpStatusUpdater(StatusUpdater):
                 {"type": c.type, "status": c.status, "reason": c.reason}
                 for c in pg.status.conditions
             ],
-        })
+        }, limiter=self.limiter)
 
 
 class K8sBinder(Binder):
@@ -206,8 +291,10 @@ class K8sBinder(Binder):
     subresource with a v1 Binding body (reference ``defaultBinder.Bind``,
     cache/cache.go:110-123)."""
 
-    def __init__(self, base: str) -> None:
+    def __init__(self, base: str,
+                 limiter: Optional[TokenBucket] = None) -> None:
         self.base = base
+        self.limiter = limiter
 
     def bind(self, pod, hostname: str) -> None:
         _post(
@@ -219,6 +306,7 @@ class K8sBinder(Binder):
                 "metadata": {"name": pod.name, "namespace": pod.namespace},
                 "target": {"apiVersion": "v1", "kind": "Node", "name": hostname},
             },
+            limiter=self.limiter,
         )
 
     def bind_bulk(self, pairs: list) -> None:
@@ -240,11 +328,15 @@ class K8sEvictor(Evictor):
     """Evicts by DELETEing the pod (reference ``defaultEvictor.Evict``,
     cache/cache.go:125-144)."""
 
-    def __init__(self, base: str) -> None:
+    def __init__(self, base: str,
+                 limiter: Optional[TokenBucket] = None) -> None:
         self.base = base
+        self.limiter = limiter
 
     def evict(self, pod) -> None:
-        _delete(self.base, f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}")
+        _delete(self.base,
+                f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}",
+                limiter=self.limiter)
 
 
 class K8sVolumeBinder(VolumeBinder):
@@ -260,14 +352,17 @@ class K8sVolumeBinder(VolumeBinder):
     are movable (the server re-assigns them on the next allocation; only
     ``bind-completed`` pins a claim)."""
 
-    def __init__(self, base: str) -> None:
+    def __init__(self, base: str,
+                 limiter: Optional[TokenBucket] = None) -> None:
         self.base = base
+        self.limiter = limiter
 
     def _patch_claim(self, namespace: str, claim: str, annotations: dict) -> None:
         _patch(
             self.base,
             f"/api/v1/namespaces/{namespace}/persistentvolumeclaims/{claim}",
             {"metadata": {"annotations": annotations}},
+            limiter=self.limiter,
         )
 
     def allocate_volumes(self, task, hostname: str) -> None:
@@ -296,8 +391,10 @@ class K8sStatusUpdater(StatusUpdater):
     # OLDEST events (lifecycle events are advisory, never load-bearing).
     _QUEUE_CAP = 10_000
 
-    def __init__(self, base: str) -> None:
+    def __init__(self, base: str,
+                 limiter: Optional[TokenBucket] = None) -> None:
         self.base = base
+        self.limiter = limiter
         # The k8s API takes ONE Event per POST, and the reference's Recorder
         # is asynchronous (client-go's broadcaster queues events and a
         # background goroutine sends them) — a per-event synchronous POST
@@ -346,7 +443,7 @@ class K8sStatusUpdater(StatusUpdater):
             "type": ev.get("type", "Normal"),
             "reason": ev.get("reason", ""),
             "message": ev.get("message", ""),
-        })
+        }, limiter=self.limiter)
 
     def update_pod_condition(self, pod, condition) -> None:
         _patch(
@@ -358,6 +455,7 @@ class K8sStatusUpdater(StatusUpdater):
                 "reason": _cond_field(condition, "reason"),
                 "message": _cond_field(condition, "message"),
             }]}},
+            limiter=self.limiter,
         )
 
     def update_pod_group(self, job) -> None:
@@ -379,6 +477,7 @@ class K8sStatusUpdater(StatusUpdater):
                     ],
                 },
             },
+            limiter=self.limiter,
         )
 
 
@@ -614,6 +713,7 @@ def connect_cache(
     vocab: Optional[ResourceVocabulary] = None,
     async_io: bool = True,
     dialect: str = "k8s",
+    limiter: Optional[TokenBucket] = None,
 ) -> tuple:
     """A SchedulerCache whose side effects cross the wire to ``base``.
     Returns ``(cache, connector)`` — call ``connector.start()`` after
@@ -623,13 +723,31 @@ def connect_cache(
     real Kubernetes API calls — pods/binding POSTs, pod DELETEs, status
     subresource PATCHes, v1 Events, PVC annotation PATCHes — so the
     connector can front a real API server; ``"legacy"`` keeps the compact
-    bespoke JSON RPCs for older servers."""
+    bespoke JSON RPCs for older servers.
+
+    ``limiter`` rate-limits the OUTBOUND RPCs (binds, evictions, status
+    writes, events, volume claims) through ONE shared token bucket — the
+    reference's single kube-client QPS/burst budget.  ``None`` reads
+    ``SCHEDULER_TPU_QPS`` / ``SCHEDULER_TPU_BURST`` (unset = unlimited).
+    The inbound watch long-poll is deliberately outside the budget: it is a
+    single sequential poller whose rate the server's timeout already bounds,
+    and starving ingestion behind a bind backlog would stall cache sync.
+    Advisory lifecycle events DO share the budget — that is the reference's
+    behavior too (client-go's event broadcaster posts through the same
+    rate-limited client), and it means a large event backlog paces binds;
+    size QPS for both, or pass a bigger dedicated ``limiter`` here (the
+    event queue is bounded at ``K8sStatusUpdater._QUEUE_CAP`` and sheds
+    oldest-first, so the tax is bounded)."""
+    if limiter is None:
+        limiter = rate_limiter_from_env()
     if dialect == "k8s":
-        binder, evictor = K8sBinder(base), K8sEvictor(base)
-        status, volumes = K8sStatusUpdater(base), K8sVolumeBinder(base)
+        binder, evictor = K8sBinder(base, limiter), K8sEvictor(base, limiter)
+        status = K8sStatusUpdater(base, limiter)
+        volumes = K8sVolumeBinder(base, limiter)
     elif dialect == "legacy":
-        binder, evictor = HttpBinder(base), HttpEvictor(base)
-        status, volumes = HttpStatusUpdater(base), HttpVolumeBinder(base)
+        binder, evictor = HttpBinder(base, limiter), HttpEvictor(base, limiter)
+        status = HttpStatusUpdater(base, limiter)
+        volumes = HttpVolumeBinder(base, limiter)
     else:
         raise ValueError(f"unknown wire dialect {dialect!r}")
     cache = SchedulerCache(

@@ -57,6 +57,10 @@ logger = logging.getLogger("scheduler_tpu.ops.engine_cache")
 # already pinned by the layout token below, so a hit can never serve stale
 # cohorts: any change to the pending row set, request rows, priorities or
 # queue of a candidate job moves the token and forces a rebuild.
+# SCHEDULER_TPU_QUEUE_DELTA matters because the resolved delta/full choice is
+# baked into BOTH traced programs (the mega kernel's scratch-row layout and
+# the XLA loop's carry) — a resident engine built under one chain must not
+# serve the other (docs/QUEUE_DELTA.md).
 _ENV_KEYS = (
     "SCHEDULER_TPU_MEGA",
     "SCHEDULER_TPU_MESH",
@@ -64,6 +68,7 @@ _ENV_KEYS = (
     "SCHEDULER_TPU_PALLAS",
     "SCHEDULER_TPU_FUSED_STATIC_LIMIT",
     "SCHEDULER_TPU_COHORT",
+    "SCHEDULER_TPU_QUEUE_DELTA",
 )
 
 _scope_counter = itertools.count(1)

@@ -58,6 +58,7 @@ from scheduler_tpu.ops.allocator import (
     score_weights,
 )
 from scheduler_tpu.ops.device import DevicePolicy, pad_rows, scale_columns
+from scheduler_tpu.ops.pallas_kernels import queue_share_overused
 from scheduler_tpu.ops.predicates import fit_mask
 from scheduler_tpu.ops.scoring import dynamic_score
 from scheduler_tpu.utils.scheduler_helper import (
@@ -130,6 +131,18 @@ def _cohort_chunks() -> int:
         return 4 if on_accel else 1
     return env_int("SCHEDULER_TPU_COHORT", 1, minimum=1, maximum=8)
 
+
+def _queue_delta_enabled() -> bool:
+    """Kill-switch for the delta-maintained multi-queue chain
+    (docs/QUEUE_DELTA.md): ``SCHEDULER_TPU_QUEUE_DELTA=0`` restores the
+    full per-step share recompute in both the mega kernel and the XLA
+    while-loop — the A/B lever the parity suite and the bench evidence
+    flip.  Registered in ``engine_cache._ENV_KEYS``: the resolved value is
+    baked into a resident engine's traced programs."""
+    from scheduler_tpu.utils.envflags import env_bool
+
+    return env_bool("SCHEDULER_TPU_QUEUE_DELTA", True)
+
 # Comparators the fused job-selection chain understands, keyed by plugin name.
 _KNOWN_JOB_ORDER = ("priority", "gang", "drf")
 
@@ -139,7 +152,7 @@ _KNOWN_JOB_ORDER = ("priority", "gang", "drf")
     static_argnames=(
         "comparators", "queue_comparators", "overused_gate", "use_static",
         "n_queues", "weights", "enforce_pod_count", "window", "batch_runs",
-        "sorted_jobs", "has_releasing", "step_kernel", "mesh",
+        "sorted_jobs", "has_releasing", "step_kernel", "queue_delta", "mesh",
     ),
 )
 def fused_allocate(
@@ -194,6 +207,7 @@ def fused_allocate(
     sorted_jobs: bool = False,
     has_releasing: bool = True,
     step_kernel: bool = False,
+    queue_delta: bool = False,
     mesh=None,
 ):
     n = idle.shape[0]
@@ -202,6 +216,13 @@ def fused_allocate(
     pos_inf = jnp.float32(jnp.inf)
     big_i32 = jnp.int32(2**31 - 1)
     track_queue_alloc = bool(queue_comparators) or overused_gate
+    # Delta-maintained queue chain (docs/QUEUE_DELTA.md): carry live [Q]
+    # share / overused vectors, refreshed per placement for the one queue a
+    # placement touches, instead of re-deriving both from the [Q, R] ledger
+    # at every queue pop.  Mirrors the mega kernel's scratch-row delta so
+    # the two programs share one cost model and one kill-switch.
+    use_queue_delta = queue_delta and track_queue_alloc
+    r_dim = resreq.shape[1]
 
     # Cursor-mode selection (single-queue + host-pre-sorted jobs): among
     # never-yet-selected jobs every comparator key is FROZEN — priority is
@@ -263,7 +284,6 @@ def fused_allocate(
     #   job_state  f32 [J, 3+R]:   cursor | n_alloc | left-count | drf alloc
     # (f32 counts are exact below 2^24 — far above any task count here; the
     # single packed row makes each step ONE job scatter instead of two.)
-    r_dim = resreq.shape[1]
     pods_limit_f = pods_limit.astype(jnp.float32)
     if step_kernel:
         # Kernel-mode layout: everything node-sided transposes ONCE here
@@ -313,7 +333,7 @@ def fused_allocate(
             from scheduler_tpu.ops.sharded import NODE_AXIS as _NAXIS
             from scheduler_tpu.ops.sharded import shard_map as _shard_map
             from scheduler_tpu.ops.sharded import (
-                two_level_winner_with_capacity as _winner_cap,
+                two_level_winner_with_queue as _winner_capq,
             )
 
             n_local = n // mesh.size
@@ -324,7 +344,7 @@ def fused_allocate(
             )
 
             def _local_select(ns_l, alloc_l, sm_l, ss_l, gate_l, plim_l,
-                              initq_c, req_c, mins_l):
+                              initq_c, req_c, mins_l, qid_f):
                 lbest, lscore, lcap, lpods = local_step(
                     ns_l, alloc_l, sm_l, ss_l, gate_l, plim_l,
                     initq_c, req_c, mins_l,
@@ -335,29 +355,33 @@ def fused_allocate(
                 # any_feasible masks the all-infeasible case regardless.
                 lbest = jnp.minimum(lbest, n_local - 1)
                 shard_i = jax.lax.axis_index(_NAXIS)
-                # The winner row CARRIES the winning shard's capacity count
-                # and pod room, so the cohort batch sizing never gathers
-                # from the sharded node ledgers.
-                score, gbest, cap, pods = _winner_cap(
+                # The winner row CARRIES the winning shard's capacity count,
+                # pod room AND the selected job's queue id: every value the
+                # post-reduce bookkeeping (batch sizing, share delta)
+                # consumes arrives on the winner tuple (docs/QUEUE_DELTA.md;
+                # the id is replicated either way — this is a data-flow
+                # invariant, not a saved collective).
+                score, gbest, cap, pods, qid = _winner_capq(
                     lscore, lbest + shard_i * n_local,
                     lcap.astype(jnp.float32), lpods.astype(jnp.float32),
+                    qid_f,
                 )
-                return gbest, score, cap, pods
+                return gbest, score, cap, pods, qid
 
             def step_select(ns_g, alloc_g, sm_g, ss_g, gate_g, plim_g,
-                            initq_c, req_c, mins_l):
+                            initq_c, req_c, mins_l, qid_f):
                 return _shard_map(
                     _local_select,
                     mesh=mesh,
                     in_specs=(
                         _P(None, _NAXIS), _P(None, _NAXIS), _P(None, _NAXIS),
                         _P(None, _NAXIS), _P(None, _NAXIS), _P(None, _NAXIS),
-                        _P(), _P(), _P(),
+                        _P(), _P(), _P(), _P(),
                     ),
-                    out_specs=(_P(), _P(), _P(), _P()),
+                    out_specs=(_P(), _P(), _P(), _P(), _P()),
                     check_vma=False,
                 )(ns_g, alloc_g, sm_g, ss_g, gate_g, plim_g,
-                  initq_c, req_c, mins_l)
+                  initq_c, req_c, mins_l, qid_f)
     job_task_num_f = job_task_num.astype(jnp.float32)
     job_gang_order_f = job_gang_order.astype(jnp.float32)
     job_deficit_f = job_deficit.astype(jnp.float32)
@@ -394,7 +418,7 @@ def fused_allocate(
             cand = cand & (masked == jnp.min(masked))
         return cand
 
-    def select_job(job_state, q_alloc, sel_mask=None):
+    def select_job(job_state, q_alloc, q_share, q_over, sel_mask=None):
         elig = eligible(job_state)
         if sel_mask is not None:
             # Cursor-mode chain branch: restrict to dirty jobs (index below
@@ -420,25 +444,37 @@ def fused_allocate(
                                 num_segments=queue_rank.shape[0]) > 0
         ) & queue_has_jobs
         if overused_gate:
-            # proportion Overused == deserved.less_equal(allocated): per dim
-            # (d < a) | (|a - d| < eps), all dims (proportion.go:198-209) —
-            # algebraically identical to d - a < eps (single compare).
-            le = (queue_deserved - q_alloc) < mins[None, :]
-            q_has = q_has & ~jnp.all(le, axis=-1)
+            if use_queue_delta:
+                # Maintained overused vector (one bool per queue, refreshed
+                # per placement for the one touched queue) — exact, not an
+                # approximation: only a placement moves a queue's allocated.
+                q_has = q_has & ~q_over
+            else:
+                # proportion Overused == deserved.less_equal(allocated): per
+                # dim (d < a) | (|a - d| < eps), all dims
+                # (proportion.go:198-209) — algebraically identical to
+                # d - a < eps (single compare).
+                le = (queue_deserved - q_alloc) < mins[None, :]
+                q_has = q_has & ~jnp.all(le, axis=-1)
         cand_q = q_has
         for qname in queue_comparators:
             if qname == "proportion":
-                # share = max over included dims of allocated/deserved, with
-                # the 0-total convention (helpers Share: 0/0 -> 0, x/0 -> 1);
-                # scalar dims with deserved == 0 are excluded from the max
-                # (resource_names semantics), i.e. contribute 0.
-                d = queue_deserved
-                frac = jnp.where(d > 0, q_alloc / jnp.where(d > 0, d, 1.0), 0.0)
-                cpumem = jnp.arange(d.shape[1]) < 2
-                frac = jnp.where(
-                    (d <= 0) & cpumem[None, :] & (q_alloc > 0), 1.0, frac
-                )
-                qkey = jnp.max(frac, axis=-1)
+                if use_queue_delta:
+                    qkey = q_share
+                else:
+                    # share = max over included dims of allocated/deserved,
+                    # with the 0-total convention (helpers Share: 0/0 -> 0,
+                    # x/0 -> 1); scalar dims with deserved == 0 are excluded
+                    # from the max (resource_names semantics), i.e.
+                    # contribute 0.  Same arithmetic as
+                    # pallas_kernels.queue_share_overused, vectorized.
+                    d = queue_deserved
+                    frac = jnp.where(d > 0, q_alloc / jnp.where(d > 0, d, 1.0), 0.0)
+                    cpumem = jnp.arange(d.shape[1]) < 2
+                    frac = jnp.where(
+                        (d <= 0) & cpumem[None, :] & (q_alloc > 0), 1.0, frac
+                    )
+                    qkey = jnp.max(frac, axis=-1)
             else:  # pragma: no cover - guarded by `supported`
                 raise ValueError(f"unknown queue comparator {qname}")
             masked_q = jnp.where(cand_q, qkey, pos_inf)
@@ -464,7 +500,8 @@ def fused_allocate(
         ``window`` of these per iteration to amortize loop overhead (the
         semantics are IDENTICAL to window=1 — this is pure unrolling; a
         micro-step whose job pool is exhausted is a masked no-op)."""
-        (node_state, job_state, q_alloc, cur, out, steps, cursor, n_dirty) = state
+        (node_state, job_state, q_alloc, q_share, q_over, last_q, cur, out,
+         steps, cursor, n_dirty) = state
         idle = None if step_kernel else node_state[:, :r_dim]
 
         # Selection only runs when the previous pop ended (lax.cond, not
@@ -484,6 +521,8 @@ def fused_allocate(
                     lambda: select_job(
                         job_state,
                         q_alloc,
+                        q_share,
+                        q_over,
                         jnp.arange(j_cap, dtype=jnp.int32) <= cursor0,
                     ),
                     lambda: jnp.where(
@@ -499,12 +538,40 @@ def fused_allocate(
             cursor = cursor0 + advanced.astype(jnp.int32)
             n_dirty = n_dirty - (newly & (sel != cursor0)).astype(jnp.int32)
             cur = sel
+        elif use_queue_delta:
+            # Lazy delta refresh (docs/QUEUE_DELTA.md): a pop is one job is
+            # ONE queue, so everything that moved since the last selection
+            # is the previous pop's queue — refresh exactly that row of the
+            # maintained share/overused vectors INSIDE the selection branch
+            # (executed once per pop, not once per step; the mega kernel is
+            # branchless, so there the refresh rides each placement
+            # instead).  Read-after-write from the live q_alloc keeps the
+            # refreshed values bit-identical to a full recompute's.
+            def _select_with_refresh():
+                a_row = q_alloc[last_q]
+                d_row = queue_deserved[last_q]
+                share_s, over_s = queue_share_overused(
+                    [d_row[r] for r in range(r_dim)],
+                    [a_row[r] for r in range(r_dim)],
+                    [mins[r] for r in range(r_dim)],
+                    r_dim,
+                )
+                qs = q_share.at[last_q].set(share_s)
+                qo = q_over.at[last_q].set(over_s)
+                return select_job(job_state, q_alloc, qs, qo), qs, qo
+
+            cur, q_share, q_over = jax.lax.cond(
+                cur == -1,
+                _select_with_refresh,
+                lambda: (cur, q_share, q_over),
+            )
         else:
             cur = jax.lax.cond(
                 cur == -1,
-                lambda: select_job(job_state, q_alloc),
+                lambda: select_job(job_state, q_alloc, q_share, q_over),
                 lambda: cur,
             )
+        cur_safe = jnp.clip(cur, 0, j_real_cap - 1)
 
         t_idx = jnp.clip(
             job_task_offset[cur] + job_state[cur, 0].astype(jnp.int32), 0, t_cap - 1
@@ -521,10 +588,22 @@ def fused_allocate(
             req_c = jax.lax.dynamic_slice(req_T, (0, t_idx), (r8, 1))
             smask_row = static_mask[t_idx][None, :] if use_static else smask_dummy
             sscore_row = static_score[t_idx][None, :] if use_static else sscore_dummy
-            best, best_score, kern_cap, kern_pods = step_select(
-                node_state, alloc_T, smask_row, sscore_row,
-                gate2d, plim2d, initq_c, req_c, mins_c,
-            )
+            kern_qid = None
+            if mesh is None:
+                best, best_score, kern_cap, kern_pods = step_select(
+                    node_state, alloc_T, smask_row, sscore_row,
+                    gate2d, plim2d, initq_c, req_c, mins_c,
+                )
+            else:
+                # The selected job's queue id rides the winner tuple over
+                # the collective (sharded.two_level_winner_with_queue); the
+                # share bookkeeping below then consumes winner-tuple values
+                # only, never per-job columns after the reduce.
+                best, best_score, kern_cap, kern_pods, kern_qid = step_select(
+                    node_state, alloc_T, smask_row, sscore_row,
+                    gate2d, plim2d, initq_c, req_c, mins_c,
+                    job_queue[cur_safe].astype(jnp.float32),
+                )
             any_feasible = best_score > neg_inf
             # Nothing feasible -> the kernel's argmin sentinel is n (out of
             # range); clamp so downstream gathers/scatters stay in bounds
@@ -582,7 +661,6 @@ def fused_allocate(
             pipe_here = jnp.asarray(False)
         failed = active & ~any_feasible
 
-        cur_safe = jnp.clip(cur, 0, j_real_cap - 1)
         single_pop = job_task_num[cur_safe] == 1
 
         if batch_runs:
@@ -738,8 +816,14 @@ def fused_allocate(
             job_state = job_state.at[cur_safe].add(job_row)
         if track_queue_alloc:
             # proportion's allocate event handler: queue allocated grows on
-            # every placement too (proportion.go:236-246).
-            q_alloc = q_alloc.at[job_queue[cur_safe]].add(placed_copies * req)
+            # every placement too (proportion.go:236-246).  The delta path
+            # only REMEMBERS which queue this pop touches (last_q); the
+            # share/overused refresh is deferred to the next selection,
+            # where it costs once per pop instead of once per step.
+            q_idx = kern_qid if (step_kernel and mesh is not None) else job_queue[cur_safe]
+            q_alloc = q_alloc.at[q_idx].add(placed_copies * req)
+            if use_queue_delta:
+                last_q = q_idx
 
         code = jnp.where(
             alloc_here, best.astype(jnp.int32),
@@ -774,7 +858,8 @@ def fused_allocate(
             if cross_batch:
                 cursor = cursor + jnp.where(cross_active, m - 1, 0)
 
-        return (node_state, job_state, q_alloc, cur, out, steps + 1, cursor, n_dirty)
+        return (node_state, job_state, q_alloc, q_share, q_over, last_q, cur,
+                out, steps + 1, cursor, n_dirty)
 
     def body(state):
         for _ in range(window):
@@ -782,7 +867,7 @@ def fused_allocate(
         return state
 
     def cond(state):
-        (_, job_state, _, cur, _, steps, cursor, n_dirty) = state
+        (_, job_state, _, _, _, _, cur, _, steps, cursor, n_dirty) = state
         if cursor_mode:
             # Scalar liveness: every eligible job is fresh (past the cursor),
             # dirty, or the one currently in-pop.
@@ -804,6 +889,19 @@ def fused_allocate(
         node_state0 = jnp.concatenate(
             [idle, releasing, task_count.astype(idle.dtype)[:, None]], axis=1
         )
+    if use_queue_delta:
+        # Maintained [Q] share/overused vectors seeded from the open-state
+        # ledgers with the SAME arithmetic select_job's full recompute uses
+        # (one shared definition: pallas_kernels.queue_share_overused).
+        share0, over0 = queue_share_overused(
+            [queue_deserved[:, r] for r in range(r_dim)],
+            [queue_alloc_init[:, r] for r in range(r_dim)],
+            [mins[r] for r in range(r_dim)],
+            r_dim,
+        )
+    else:
+        share0 = jnp.zeros(queue_rank.shape[0], dtype=jnp.float32)
+        over0 = jnp.zeros(queue_rank.shape[0], dtype=bool)
     init = (
         node_state0,
         jnp.concatenate(
@@ -814,6 +912,9 @@ def fused_allocate(
             axis=1,
         ),
         queue_alloc_init,
+        share0,
+        over0,
+        jnp.zeros((), dtype=jnp.int32),  # last_q: queue the last pop touched
         jnp.asarray(-1, dtype=jnp.int32),
         # Padded by MAX_BATCH so the run write-window never clamps at the tail.
         jnp.full(t_cap + MAX_BATCH, UNPLACED, dtype=jnp.int32),
@@ -822,7 +923,7 @@ def fused_allocate(
         jnp.zeros((), dtype=jnp.int32),  # dirty (re-eligible) job count
     )
     final = jax.lax.while_loop(cond, body, init)
-    return final[4][:t_cap]
+    return final[7][:t_cap]
 
 
 class FusedAllocator:
@@ -854,6 +955,11 @@ class FusedAllocator:
         self.cohort_spill = False  # some cohort must split across nodes
         self.cohort_chunks = _cohort_chunks()
         self.cohort_effective = 1  # chunks the device program actually traces
+        # Delta-maintained multi-queue chain (docs/QUEUE_DELTA.md): resolved
+        # once per build and baked into both traced programs; the env flag is
+        # part of the engine-cache key so a resident engine never serves a
+        # flipped switch.
+        self.queue_delta = _queue_delta_enabled()
         vocab = next(iter(ssn.nodes.values())).vocab
         policy = DevicePolicy(vocab)
         r = vocab.size
@@ -1590,6 +1696,7 @@ class FusedAllocator:
             multi_queue=multi_queue,
             queue_proportion="proportion" in self.queue_comparators,
             overused_gate=self.overused_gate,
+            queue_delta=self.queue_delta,
             cohort=cohort_eff,
             t_cap=tb,
             mesh=mesh,
@@ -1709,6 +1816,10 @@ class FusedAllocator:
         if self.use_static != bool(ssn.device_predicates or ssn.device_scorers):
             return False
         if self.enforce_pod_count != ("pod_count" in ssn.device_dynamic_gates):
+            return False
+        if self.queue_delta != _queue_delta_enabled():
+            # Pinned by the cache key's env flags in the cached flow; this
+            # re-check covers direct update() callers (parity tests).
             return False
         queue_names = sorted(
             ssn.queues, key=lambda q: (ssn.queues[q].creation_timestamp, q)
@@ -2049,6 +2160,7 @@ class FusedAllocator:
                 sorted_jobs=True,
                 has_releasing=self.has_releasing,
                 step_kernel=self.step_kernel,
+                queue_delta=self.queue_delta,
                 mesh=self._mesh,
             )
 
@@ -2093,6 +2205,16 @@ class FusedAllocator:
             "cohorts": self.cohort_count,
             "cohort_chunks": self.cohort_effective if self.use_mega else 1,
         }
+        if self.queue_comparators or self.overused_gate:
+            # Queue-chain evidence (docs/QUEUE_DELTA.md): which chain the
+            # traced program maintains — "delta" (live share/overused state,
+            # O(R) per placement) or "full" (kill-switch off: whole-chain
+            # recompute per step).  The mega path adds the kernel's own
+            # counters below.
+            out["queue_chain"] = {
+                "queues": len(self.queue_uids),
+                "mode": "delta" if self.queue_delta else "full",
+            }
         enc = self._encoded
         if enc is not None:
             t = self.flat_count
@@ -2111,6 +2233,17 @@ class FusedAllocator:
             out["fallback_steps"] = steps - out["cohort_steps"]
             if steps > 0 and "placed" in out:
                 out["tasks_per_step"] = round(out["placed"] / steps, 2)
+            if "queue_chain" in out:
+                # Kernel counters: delta updates applied vs full recomputes
+                # paid — exactly one of the two is nonzero, proving which
+                # chain the executed program ran (bench detail
+                # ``queue_chain``).
+                out["queue_chain"]["delta_updates"] = int(
+                    raw[_mk.STATS_QDELTA_UPDATES]
+                )
+                out["queue_chain"]["full_recomputes"] = int(
+                    raw[_mk.STATS_QFULL_RECOMPUTES]
+                )
         return out
 
     def _execute(self) -> np.ndarray:

@@ -49,6 +49,18 @@ that structure:
   full-width ``[1, T]`` masked reduce that cost ~T/128 vregs per read —
   at 100k tasks those three reads were the largest per-step cost left.
 
+DELTA-MAINTAINED QUEUE CHAIN (docs/QUEUE_DELTA.md): round 5's multi-queue
+mode re-derived the whole proportion chain — per-dim share ratios and the
+overused gate over every queue's replicated ledger rows — on EVERY while
+step, even though a step's placement moves exactly ONE queue's allocated
+vector.  The chain state is now delta-maintained: scratch rows 24/25 carry
+the live per-lane share and overused flag of each lane's queue, the queue
+pop reads them with two masked reduces, and each placement refreshes just
+the winning queue's lanes from the post-update ledger rows (read-after-write
+keeps the f32 values bit-identical to a full recompute).  The
+``queue_delta`` static arg is the kill-switch (``SCHEDULER_TPU_QUEUE_DELTA``
+host-side); evidence counters 3/4 of the stats output prove which path ran.
+
 Layout notes (mosaic on this TPU stack):
 
 * Nodes ride the LANE axis ([row, N]) so per-resource rows broadcast against
@@ -80,6 +92,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from scheduler_tpu.ops.pallas_kernels import queue_share_overused
+
 # Result encoding — MUST match ops/fused.py.
 UNPLACED = -1
 FAILED = -2
@@ -93,9 +107,15 @@ _BIG_I32 = 2**31 - 1
 #   [0] loop steps taken
 #   [1] steps where the cohort chunk path engaged (chunk 1 ran)
 #   [2] placements made by chunks >= 1 (the multi-node cohort surplus)
+#   [3] queue-share delta updates applied (multi-queue delta path: one per
+#       placement whose queue ledger moved — proof the delta engaged)
+#   [4] full queue-chain recomputes (multi-queue with the delta kill-switch
+#       off: one per step — the pre-delta cost model, for A/B evidence)
 STATS_STEPS = 0
 STATS_COHORT_STEPS = 1
 STATS_CHUNK_PLACED = 2
+STATS_QDELTA_UPDATES = 3
+STATS_QFULL_RECOMPUTES = 4
 
 
 def _lane_iota(shape):
@@ -126,8 +146,10 @@ def mega_supported(
     # (n_static_sigs, capped so mask+score fit the scratch budget), and
     # batched runs carry the top-2 score bound in-kernel.  Round 5 killed
     # the single-queue restriction: multi-queue sessions carry proportion's
-    # live per-queue shares REPLICATED ON THE JOB LANES (8 extra scratch
-    # rows) and run queue selection as a lexicographic masked reduce —
+    # live per-queue ledgers REPLICATED ON THE JOB LANES (8 extra scratch
+    # rows, plus the delta-maintained share/overused rows of
+    # docs/QUEUE_DELTA.md) and run queue selection as a lexicographic masked
+    # reduce —
     # ``multi_queue`` is the caller's promise that its queue chain is the
     # builtin proportion one (FusedAllocator.supported already enforces
     # queue_order_fns/overused_fns ⊆ {proportion}).  The parameters stay
@@ -152,7 +174,7 @@ def mega_supported(
         "r_dim", "weights", "enforce_pod_count", "comparators",
         "cross_batch", "batch_runs", "has_releasing", "use_static",
         "score_bound", "mins", "cpu_idx", "mem_idx",
-        "multi_queue", "queue_proportion", "overused_gate",
+        "multi_queue", "queue_proportion", "overused_gate", "queue_delta",
         "cohort", "t_cap", "mesh", "interpret",
     ),
 )
@@ -200,6 +222,7 @@ def mega_allocate(
     queue_proportion: bool,
     overused_gate: bool,
     interpret: bool,
+    queue_delta: bool = True,
     cohort: int = 1,
     t_cap: int = 0,
     mesh=None,
@@ -218,6 +241,10 @@ def mega_allocate(
     if not batch_runs or has_releasing:
         cohort = 1
     cohort = max(1, int(cohort))
+    # Delta-maintained queue chain (docs/QUEUE_DELTA.md): live share/overused
+    # scratch rows exist only when there is share state to maintain — a
+    # multi-queue session whose chain is rank-only has nothing to delta.
+    use_qdelta = queue_delta and multi_queue and (queue_proportion or overused_gate)
     # The 2-row write window must fit even when rowlo is the last real row.
     t_sub = t_rows + 1
     lr_w, bal_w, bp_w = (float(w) for w in weights)
@@ -244,6 +271,13 @@ def mega_allocate(
         # selection then needs no queue->job gather (dynamic lane indexing
         # is unavailable), just lane-wise reduces, and the ledger update is
         # one masked add over lanes sharing the selected job's queue id.
+        # With the DELTA-MAINTAINED chain (docs/QUEUE_DELTA.md) two more
+        # rows ride along: row 24 the live per-lane SHARE of the lane's
+        # queue (max over dims of allocated/deserved), row 25 its OVERUSED
+        # flag (1.0 = gated).  Selection then reads two maintained rows
+        # instead of re-deriving shares over all dims every step; each
+        # placement refreshes exactly the winning queue's lanes from the
+        # post-update ledger rows (read-after-write => bit-identical).
         ns[0:16, :] = ns0_ref[:, :]
         if has_releasing:
             ns[16:24, :] = rel0_ref[:, :]
@@ -251,6 +285,16 @@ def mega_allocate(
         js[8:16, :] = jdrf0_ref[:, :]
         if multi_queue:
             js[16:24, :] = jqa0_ref[:, :]
+        if use_qdelta:
+            share0, over0 = queue_share_overused(
+                [jqd_ref[r : r + 1, :] for r in range(r_dim)],
+                [jqa0_ref[r : r + 1, :] for r in range(r_dim)],
+                mins, r_dim,
+            )
+            if queue_proportion:
+                js[24:25, :] = share0
+            if overused_gate:
+                js[25:26, :] = over0.astype(jnp.float32)
         out_ref[:, :] = jnp.full((t_sub, 128), UNPLACED, jnp.int32)
 
         n_real = misc_ref[0, 0]
@@ -283,7 +327,7 @@ def mega_allocate(
                                      jnp.int32(-_BIG_I32 - 1)))
 
         def body(state):
-            cur, cursor, n_dirty, steps, coh_steps, chunk_pl = state
+            cur, cursor, n_dirty, steps, coh_steps, chunk_pl, qd_evt = state
 
             # ---- selection (branchless; matches fused.py cursor mode, or
             # its full queue+job chain in multi-queue mode) ----
@@ -297,29 +341,38 @@ def mega_allocate(
                 # queue's jobs, tiebreak by queue rank (== queue index) —
                 # then the job chain below runs within the surviving queue.
                 cand = elig
-                if overused_gate:
-                    # Overused == deserved.less_equal(allocated), per dim
-                    # d - a < eps, ALL dims (proportion.go:198-209).
-                    over = None
-                    for r in range(r_dim):
-                        le_r = (jqd_ref[r : r + 1, :] - js[16 + r : 16 + r + 1, :]) < mins[r]
-                        over = le_r if over is None else (over & le_r)
-                    cand = cand & ~over
-                if queue_proportion:
-                    # share = max over dims of allocated/deserved with the
-                    # 0-total convention (0/0 -> 0; cpu/mem x/0 -> 1).
-                    frac = jnp.zeros((1, j_pad), jnp.float32)
-                    for r in range(r_dim):
-                        d_r = jqd_ref[r : r + 1, :]
-                        a_r = js[16 + r : 16 + r + 1, :]
-                        fr = jnp.where(
-                            d_r > 0.0, a_r / jnp.where(d_r > 0.0, d_r, 1.0), 0.0
+                if use_qdelta:
+                    # Delta-maintained chain: the live share/overused values
+                    # sit in scratch rows 24/25 (refreshed per placement for
+                    # the ONE queue a placement touches), so the pop is two
+                    # masked reduces instead of ~O(R) full-width re-derives
+                    # per step (docs/QUEUE_DELTA.md op-count table).
+                    if overused_gate:
+                        cand = cand & (js[25:26, :] < 0.5)
+                    if queue_proportion:
+                        maskedq = jnp.where(cand, js[24:25, :], pos_inf)
+                        cand = cand & (maskedq == jnp.min(maskedq))
+                else:
+                    if overused_gate:
+                        # Overused == deserved.less_equal(allocated), per dim
+                        # d - a < eps, ALL dims (proportion.go:198-209).
+                        over = None
+                        for r in range(r_dim):
+                            le_r = (jqd_ref[r : r + 1, :] - js[16 + r : 16 + r + 1, :]) < mins[r]
+                            over = le_r if over is None else (over & le_r)
+                        cand = cand & ~over
+                    if queue_proportion:
+                        # share = max over dims of allocated/deserved with the
+                        # 0-total convention (0/0 -> 0; cpu/mem x/0 -> 1) —
+                        # same arithmetic as queue_share_overused, kept
+                        # full-width here as the A/B full-recompute path.
+                        frac, _ = queue_share_overused(
+                            [jqd_ref[r : r + 1, :] for r in range(r_dim)],
+                            [js[16 + r : 16 + r + 1, :] for r in range(r_dim)],
+                            mins, r_dim,
                         )
-                        if r < 2:  # cpu/memory dims (vocab order is fixed)
-                            fr = jnp.where((d_r <= 0.0) & (a_r > 0.0), 1.0, fr)
-                        frac = jnp.maximum(frac, fr)
-                    maskedq = jnp.where(cand, frac, pos_inf)
-                    cand = cand & (maskedq == jnp.min(maskedq))
+                        maskedq = jnp.where(cand, frac, pos_inf)
+                        cand = cand & (maskedq == jnp.min(maskedq))
                 qrank = jnp.where(cand, jq_v, jnp.int32(_BIG_I32))
                 cand = cand & (qrank == jnp.min(qrank))
             else:
@@ -412,6 +465,7 @@ def mega_allocate(
             dirty_r = n_dirty2
             coh_steps2 = coh_steps
             chunk_pl2 = chunk_pl
+            qd_evt2 = qd_evt
 
             for c in range(cohort):
                 # ---- fit + score + masked argmax (rows unrolled) ----
@@ -616,11 +670,49 @@ def mega_allocate(
                     # queue's allocated (proportion.go:236-246) — replicated
                     # to EVERY lane whose job shares the selected job's queue.
                     q_sel = read_i32(jq_v, lane_j, jb)
-                    qwin = (jq_v == q_sel).astype(jnp.float32)
+                    qwin_b = jq_v == q_sel
+                    qwin = qwin_b.astype(jnp.float32)
                     for r in range(r_dim):
                         js[16 + r : 16 + r + 1, :] = (
                             js[16 + r : 16 + r + 1, :] + (reqs[r] * drf_scale) * qwin
                         )
+                    if use_qdelta:
+                        # Delta refresh of the maintained share/overused rows
+                        # for EXACTLY the queue this placement touched (only
+                        # the winning job's queue ledger moved — every other
+                        # queue's values are still current by induction).
+                        # The new allocated values are read back AFTER the
+                        # masked add above, so the scalar chain folds the
+                        # very f32 values a full recompute would read —
+                        # bit-identical by construction, O(R) reads + two
+                        # masked writes instead of O(R) full-width derives
+                        # at the next selection.
+                        a_new = [
+                            read_f32(js[16 + r : 16 + r + 1, :], lane_j, jb)
+                            for r in range(r_dim)
+                        ]
+                        d_q = [
+                            read_f32(jqd_ref[r : r + 1, :], lane_j, jb)
+                            for r in range(r_dim)
+                        ]
+                        share_new, over_new = queue_share_overused(
+                            d_q, a_new, mins, r_dim
+                        )
+                        if queue_proportion:
+                            js[24:25, :] = jnp.where(
+                                qwin_b, share_new, js[24:25, :]
+                            )
+                        if overused_gate:
+                            js[25:26, :] = jnp.where(
+                                qwin_b, over_new.astype(jnp.float32),
+                                js[25:26, :],
+                            )
+                        # Evidence: count placements whose queue ledger
+                        # actually moved (a no-op step writes back unchanged
+                        # values and must not claim a delta).
+                        qd_evt2 = qd_evt2 + (
+                            act & (alloc_here | pipe_here)
+                        ).astype(jnp.int32)
 
                 # ---- result write (2-row window around t_c) ----
                 code = jnp.where(
@@ -714,10 +806,11 @@ def mega_allocate(
                         nalloc_c = nalloc_c + m_alloc
                     act = act_next
 
-            return cur_r, cursor_r, dirty_r, steps + 1, coh_steps2, chunk_pl2
+            return (cur_r, cursor_r, dirty_r, steps + 1, coh_steps2,
+                    chunk_pl2, qd_evt2)
 
         def cond(state):
-            cur, cursor, n_dirty, steps, _coh, _cpl = state
+            cur, cursor, n_dirty, steps, _coh, _cpl, _qd = state
             if multi_queue:
                 # No cursor liveness to consult: the body's selection step
                 # discovers exhaustion itself (chain -> HALT), costing at
@@ -732,12 +825,20 @@ def mega_allocate(
         final = jax.lax.while_loop(
             cond, body,
             (jnp.int32(-1), jnp.int32(0), jnp.int32(0), jnp.int32(0),
-             jnp.int32(0), jnp.int32(0)),
+             jnp.int32(0), jnp.int32(0), jnp.int32(0)),
         )
         stats_ref[0, STATS_STEPS] = final[3]
         stats_ref[0, STATS_COHORT_STEPS] = final[4]
         stats_ref[0, STATS_CHUNK_PLACED] = final[5]
-        for i in range(3, 8):
+        stats_ref[0, STATS_QDELTA_UPDATES] = final[6]
+        # Full-recompute count: on the kill-switch path every step re-derives
+        # the whole share chain, so the count IS the step count; zero when the
+        # delta path (or a single-queue program) traced instead.
+        if multi_queue and (queue_proportion or overused_gate) and not use_qdelta:
+            stats_ref[0, STATS_QFULL_RECOMPUTES] = final[3]
+        else:
+            stats_ref[0, STATS_QFULL_RECOMPUTES] = jnp.int32(0)
+        for i in range(5, 8):
             stats_ref[0, i] = jnp.int32(0)
 
     call = pl.pallas_call(
@@ -759,8 +860,12 @@ def mega_allocate(
             # idle+count rows, plus the releasing ledger rows when live.
             pltpu.VMEM((24 if has_releasing else 16, n), jnp.float32),
             # js: cons/alloc/left + drf, plus the per-lane queue-allocated
-            # replica rows in multi-queue mode.
-            pltpu.VMEM((24 if multi_queue else 16, j_pad), jnp.float32),
+            # replica rows in multi-queue mode, plus the delta-maintained
+            # share/overused rows (24/25; padded to the 8-sublane tile).
+            pltpu.VMEM(
+                (32 if use_qdelta else (24 if multi_queue else 16), j_pad),
+                jnp.float32,
+            ),
         ],
         interpret=interpret,
     )

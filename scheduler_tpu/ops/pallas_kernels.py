@@ -76,6 +76,42 @@ def step_kernel_enabled() -> bool:
 CAP_GRID = 128
 
 
+def queue_share_overused(deserved, allocated, mins, r_dim: int):
+    """Proportion's share + overused arithmetic, the ONE definition every
+    queue-chain implementation derives from (docs/QUEUE_DELTA.md).
+
+    ``deserved`` / ``allocated`` / ``mins`` are per-dim sequences — scalars
+    (the mega kernel's per-placement delta update), ``[1, J]`` lane rows (the
+    kernel's scratch-row init), or ``[Q]`` columns (the XLA loop's carry
+    init and per-placement refresh) — indexed ``0..r_dim-1`` in vocabulary
+    order.  Returns ``(share, overused)``:
+
+      share    = max over dims of allocated/deserved with the 0-total
+                 convention (helpers Share: 0/0 -> 0; cpu/mem — the first
+                 two vocab dims — x/0 -> 1; other dims with deserved == 0
+                 contribute 0, the resource_names exclusion)
+      overused = deserved.less_equal(allocated): per dim d - a < eps, ALL
+                 dims (proportion.go:198-209)
+
+    Dim order is ascending everywhere so every caller folds the f32 max in
+    the same sequence — together with the read-after-write rule in the delta
+    callers this is what makes delta-maintained values BIT-IDENTICAL to a
+    full recompute, not merely close.
+    """
+    share = None
+    over = None
+    for r in range(r_dim):
+        d = deserved[r]
+        a = allocated[r]
+        fr = jnp.where(d > 0.0, a / jnp.where(d > 0.0, d, 1.0), 0.0)
+        if r < 2:  # cpu/memory dims (vocabulary order is fixed)
+            fr = jnp.where((d <= 0.0) & (a > 0.0), 1.0, fr)
+        share = fr if share is None else jnp.maximum(share, fr)
+        le = (d - a) < mins[r]
+        over = le if over is None else over & le
+    return share, over
+
+
 def make_placement_step(
     r_dim: int,
     r8: int,

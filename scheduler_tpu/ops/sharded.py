@@ -79,13 +79,38 @@ def two_level_winner_with_capacity(lscore, global_idx, cap, pod_room,
     all-gather.  Counts travel as f32 (exact: both are <= 128 and node pod
     capacities are far below 2^24).  Returns
     ``(score, global_index, capacity, pod_room)`` with the indices/counts
-    back as i32."""
-    win = two_level_winner(lscore, global_idx, extra=(cap, pod_room), axis=axis)
+    back as i32.  (Thin wrapper over ``two_level_winner_with_queue`` with a
+    zero queue id — single-queue callers that want the capacity counts
+    without the queue lane.)"""
+    score, gbest, cap_i, pods_i, _ = two_level_winner_with_queue(
+        lscore, global_idx, cap, pod_room, jnp.float32(0.0), axis=axis
+    )
+    return score, gbest, cap_i, pods_i
+
+
+def two_level_winner_with_queue(lscore, global_idx, cap, pod_room, queue_id,
+                                axis=NODE_AXIS):
+    """Two-level argmax whose winning row ALSO carries the selected job's
+    queue id (docs/QUEUE_DELTA.md).
+
+    The queue id is a job-side value and is replicated on every chip either
+    way — riding it on the candidate tuple buys no saved collective; what it
+    buys is a structural invariant: everything the post-reduce bookkeeping
+    (cohort batch sizing, multi-queue share delta) consumes arrives ON the
+    winner row, so the step's data flow after the collective never touches
+    per-job columns and the ICI traffic is exactly one tiny all-gather with
+    one extra f32 lane.  The id travels as f32 (exact below 2^24 queues,
+    same argument as the global node index).  Returns
+    ``(score, global_index, capacity, pod_room, queue_id)``."""
+    win = two_level_winner(
+        lscore, global_idx, extra=(cap, pod_room, queue_id), axis=axis
+    )
     return (
         win[0],
         win[1].astype(jnp.int32),
         win[2].astype(jnp.int32),
         win[3].astype(jnp.int32),
+        win[4].astype(jnp.int32),
     )
 
 

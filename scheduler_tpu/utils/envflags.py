@@ -16,6 +16,7 @@ returns the DEFAULT instead of silently counting as "on".
 from __future__ import annotations
 
 import logging
+import math
 import os
 from typing import Optional
 
@@ -58,6 +59,38 @@ def env_int(
         except (ValueError, AttributeError):
             _warn_once(name, raw, default)
             val = default
+    if minimum is not None and val < minimum:
+        val = minimum
+    if maximum is not None and val > maximum:
+        val = maximum
+    return val
+
+
+def env_float(
+    name: str,
+    default: float,
+    *,
+    minimum: Optional[float] = None,
+    maximum: Optional[float] = None,
+) -> float:
+    """Float env flag (rate limits, thresholds): malformed values warn and
+    yield ``default``; ``minimum``/``maximum`` clamp silently, like
+    ``env_int``.  Non-finite values (nan/inf parse as floats!) count as
+    malformed — a rate limiter fed ``inf`` must degrade to the default,
+    not divide by it."""
+    raw = os.environ.get(name)
+    if raw is None:
+        val = default
+    else:
+        try:
+            val = float(raw.strip())
+        except (ValueError, AttributeError):
+            _warn_once(name, raw, default)
+            val = default
+        else:
+            if not math.isfinite(val):
+                _warn_once(name, raw, default)
+                val = default
     if minimum is not None and val < minimum:
         val = minimum
     if maximum is not None and val > maximum:

@@ -1,9 +1,18 @@
 """CI perf gate: fail on a >10% pods/s regression between bench rounds.
 
-Compares the two newest ``BENCH_r*.json`` artifacts in the repo root (or a
-directory given as argv[1]).  Regression math uses HEALTHY cycles only —
-per-cycle ``link_degraded`` flags recorded by bench.py's bracketing link
-probes — so a degraded-tunnel window can never fail (or excuse) a build:
+Compares the two newest artifacts of each bench FAMILY in the repo root (or
+a directory given as argv[1]):
+
+* ``BENCH_r*.json``     — the single-queue 100k-pod flagship;
+* ``BENCH_MQ_r*.json``  — the two-queue 100k-pod flagship
+  (``SCHEDULER_TPU_BENCH_QUEUES=2``, first-class since the delta-maintained
+  queue chain, docs/QUEUE_DELTA.md).
+
+Families gate independently (a regression in either fails the build); a
+family with fewer than two artifacts is simply not judged yet.  Regression
+math uses HEALTHY cycles only — per-cycle ``link_degraded`` flags recorded
+by bench.py's bracketing link probes — so a degraded-tunnel window can
+never fail (or excuse) a build:
 
 * fewer than MIN_HEALTHY healthy cycles in either artifact -> exit 0 with a
   "cannot judge" note (the artifact itself documents the link regime);
@@ -29,17 +38,21 @@ TOLERANCE = 0.10
 # less than the artifact itself trusts.
 MIN_HEALTHY = 3
 
-_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+_ROUND_RE = re.compile(r"BENCH(_MQ)?_r(\d+)\.json$")
+
+# (family label, filename infix) — the artifact naming contract.
+FAMILIES = (("single-queue", ""), ("two-queue", "_MQ"))
 
 
-def find_artifacts(root: Path):
-    """BENCH_r*.json sorted by round number (not mtime: artifacts are
-    checked in, and a fresh clone flattens timestamps)."""
+def find_artifacts(root: Path, infix: str = ""):
+    """One family's ``BENCH{infix}_r*.json`` sorted by round number (not
+    mtime: artifacts are checked in, and a fresh clone flattens
+    timestamps)."""
     pairs = []
-    for p in root.glob("BENCH_r*.json"):
+    for p in root.glob(f"BENCH{infix}_r*.json"):
         m = _ROUND_RE.search(p.name)
-        if m:
-            pairs.append((int(m.group(1)), p))
+        if m and (m.group(1) or "") == infix:
+            pairs.append((int(m.group(2)), p))
     return [p for _, p in sorted(pairs)]
 
 
@@ -79,32 +92,39 @@ def healthy_median_pods_per_sec(path: Path):
     return rates[len(rates) // 2]
 
 
-def main(argv) -> int:
-    root = Path(argv[1]) if len(argv) > 1 else Path(__file__).resolve().parent.parent
-    artifacts = find_artifacts(root)
+def gate_family(root: Path, label: str, infix: str) -> int:
+    """Gate one artifact family; same exit-code contract as main()."""
+    artifacts = find_artifacts(root, infix)
     if len(artifacts) < 2:
-        print(f"bench-gate: need two BENCH_r*.json under {root}, "
-              f"found {len(artifacts)}; nothing to compare")
+        print(f"bench-gate[{label}]: need two BENCH{infix}_r*.json under "
+              f"{root}, found {len(artifacts)}; nothing to compare")
         return 0
     prev_path, new_path = artifacts[-2], artifacts[-1]
     try:
         prev = healthy_median_pods_per_sec(prev_path)
         new = healthy_median_pods_per_sec(new_path)
     except (json.JSONDecodeError, KeyError, TypeError, ZeroDivisionError) as err:
-        print(f"bench-gate: malformed artifact: {err}")
+        print(f"bench-gate[{label}]: malformed artifact: {err}")
         return 1
     if prev is None or new is None:
         which = prev_path.name if prev is None else new_path.name
-        print(f"bench-gate: {which} has too few link-healthy cycles; "
-              "cannot judge (see its per-cycle probes)")
+        print(f"bench-gate[{label}]: {which} has too few link-healthy "
+              "cycles; cannot judge (see its per-cycle probes)")
         return 0
     floor = (1.0 - TOLERANCE) * prev
     verdict = "REGRESSION" if new < floor else "ok"
     print(
-        f"bench-gate: {prev_path.name} healthy-median {prev:,.0f} pods/s -> "
-        f"{new_path.name} {new:,.0f} pods/s (floor {floor:,.0f}): {verdict}"
+        f"bench-gate[{label}]: {prev_path.name} healthy-median "
+        f"{prev:,.0f} pods/s -> {new_path.name} {new:,.0f} pods/s "
+        f"(floor {floor:,.0f}): {verdict}"
     )
     return 2 if new < floor else 0
+
+
+def main(argv) -> int:
+    root = Path(argv[1]) if len(argv) > 1 else Path(__file__).resolve().parent.parent
+    # Gate every family; report all verdicts, exit on the worst.
+    return max(gate_family(root, label, infix) for label, infix in FAMILIES)
 
 
 if __name__ == "__main__":

@@ -1,7 +1,13 @@
 """Phase breakdown of one full-scale allocate cycle (host vs device vs apply).
 
-Usage: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_cycle.py [nodes] [pods]
+Usage: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_cycle.py [nodes] [pods] [queues]
 (APPEND to PYTHONPATH — TPU hosts carry the axon backend's site dir in it.)
+
+``queues`` > 1 profiles the MULTI-QUEUE cycle: proportion joins the plugin
+tiers (live share ordering + overused gate on device) and the pods spread
+over that many weighted queues — the two-queue flagship shape whose queue
+chain is delta-maintained (docs/QUEUE_DELTA.md; flip
+``SCHEDULER_TPU_QUEUE_DELTA=0`` to profile the full-recompute chain A/B).
 
 Protocol matches the bench (harness/measure): a fresh cluster per measured
 cycle, engine tensors warmed without placing, GC frozen around the cycle.
@@ -30,13 +36,23 @@ tiers:
   - name: priority
   - name: gang
   - name: drf
-  - name: binpack
+{proportion}  - name: binpack
 """
 
 
-def run(n_nodes: int, n_pods: int, label: str) -> None:
-    conf = parse_scheduler_conf(CONF)
-    cluster = make_synthetic_cluster(n_nodes, n_pods, tasks_per_job=100)
+def run(n_nodes: int, n_pods: int, label: str, n_queues: int = 1) -> None:
+    proportion = "  - name: proportion\n" if n_queues > 1 else ""
+    conf = parse_scheduler_conf(CONF.format(proportion=proportion))
+    queues = (
+        tuple(f"q{i}" for i in range(n_queues))
+        if n_queues > 1
+        else ("default",)
+    )
+    weights = {q: i + 1 for i, q in enumerate(queues)}
+    cluster = make_synthetic_cluster(
+        n_nodes, n_pods, tasks_per_job=100,
+        queues=queues, queue_weights=weights,
+    )
     warm_engine(cluster.cache, conf)
 
     from scheduler_tpu.actions.allocate import collect_candidates, record_fused_failures
@@ -69,7 +85,11 @@ def run(n_nodes: int, n_pods: int, label: str) -> None:
     finally:
         gc.unfreeze()
 
-    print(f"[{label}] nodes={n_nodes} pods={n_pods} binds={len(cluster.cache.binder.binds)}")
+    print(f"[{label}] nodes={n_nodes} pods={n_pods} queues={n_queues} "
+          f"binds={len(cluster.cache.binder.binds)}")
+    qc = engine.run_stats().get("queue_chain")
+    if qc:
+        print(f"  queue_chain         {qc}")
     print(f"  open_session        {t1 - t0:8.3f}s")
     print(f"  candidates          {t2 - t1:8.3f}s")
     print(f"  engine init         {t3 - t2:8.3f}s")
@@ -83,5 +103,6 @@ def run(n_nodes: int, n_pods: int, label: str) -> None:
 if __name__ == "__main__":
     n_nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
     n_pods = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
-    run(n_nodes, n_pods, "compile")  # first run pays the jit compile
-    run(n_nodes, n_pods, "steady")
+    n_queues = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+    run(n_nodes, n_pods, "compile", n_queues)  # first run pays the jit compile
+    run(n_nodes, n_pods, "steady", n_queues)

@@ -6,7 +6,7 @@ import logging
 import pytest
 
 from scheduler_tpu.utils import envflags
-from scheduler_tpu.utils.envflags import env_bool, env_int, env_str
+from scheduler_tpu.utils.envflags import env_bool, env_float, env_int, env_str
 
 
 @pytest.fixture(autouse=True)
@@ -37,6 +37,27 @@ def test_env_int_malformed_warns_and_falls_back(monkeypatch, caplog):
     with caplog.at_level(logging.WARNING, logger="scheduler_tpu.utils.envflags"):
         assert env_int("X_INT", 7) == 7
     assert caplog.text == ""
+
+
+def test_env_float_parses_clamps_and_falls_back(monkeypatch, caplog):
+    monkeypatch.delenv("X_FLT", raising=False)
+    assert env_float("X_FLT", 2.5) == 2.5
+    monkeypatch.setenv("X_FLT", " 12.5 ")
+    assert env_float("X_FLT", 0.0) == 12.5
+    monkeypatch.setenv("X_FLT", "-1")
+    assert env_float("X_FLT", 0.0, minimum=0.0) == 0.0
+    monkeypatch.setenv("X_FLT", "1e9")
+    assert env_float("X_FLT", 0.0, maximum=100.0) == 100.0
+    with caplog.at_level(logging.WARNING, logger="scheduler_tpu.utils.envflags"):
+        monkeypatch.setenv("X_FLT", "fast")
+        assert env_float("X_FLT", 3.0) == 3.0
+        # nan/inf PARSE as floats but are config poison (a rate limiter fed
+        # inf must degrade, not divide by it): treated as malformed.
+        monkeypatch.setenv("X_FLT", "inf")
+        assert env_float("X_FLT", 3.0) == 3.0
+        monkeypatch.setenv("X_FLT", "nan")
+        assert env_float("X_FLT", 3.0) == 3.0
+    assert "fast" in caplog.text and "inf" in caplog.text
 
 
 def test_env_bool_semantics(monkeypatch):

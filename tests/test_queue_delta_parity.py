@@ -1,0 +1,237 @@
+"""Delta-maintained queue chain parity: delta vs full recompute must be
+bitwise-identical wherever the multi-queue chain runs (docs/QUEUE_DELTA.md).
+
+The delta path (ops/megakernel.py scratch rows 24/25, ops/fused.py q_share/
+q_over carry) keeps proportion's live share and overused state maintained
+incrementally — O(R) per placement for the one queue a placement touches —
+instead of re-deriving the whole chain every step.  Its correctness
+contract is the cohort suite's: the optimized chain must reproduce EXACTLY
+the codes of the full-recompute chain on every trajectory, because the
+maintained values are the very f32 values a recompute would derive
+(read-after-write, one shared ``queue_share_overused`` definition).
+
+Coverage: {2, 3}-queue sessions x cohort chunks on/off x mega vs XLA
+anchors, a mutation-trajectory fuzz (modeled on ``test_engine_cache_parity``
+/ ``test_cohort_parity``), and kernel-counter assertions that the delta
+path actually engaged — no vacuous passes.
+"""
+
+import numpy as np
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.actions.allocate import collect_candidates
+from scheduler_tpu.api.types import TaskStatus
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import close_session, get_action, open_session
+from scheduler_tpu.ops.fused import FusedAllocator
+from tests.fixtures import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    make_vocab,
+)
+from tests.test_cohort_parity import MULTIQ_CONF, _spill_cluster
+
+OVERUSED_CONF = MULTIQ_CONF  # proportion registers the overused gate too
+
+
+def _engine(monkeypatch, ssn, *, delta: bool, chunks: int = 1):
+    monkeypatch.setenv("SCHEDULER_TPU_QUEUE_DELTA", "1" if delta else "0")
+    monkeypatch.setenv("SCHEDULER_TPU_COHORT", str(chunks))
+    return FusedAllocator(ssn, collect_candidates(ssn))
+
+
+def _run(engine):
+    codes = engine._execute().copy()
+    return codes, engine.run_stats()
+
+
+@pytest.mark.parametrize("queues,chunks", [
+    (("qa", "qb"), 1),
+    (("qa", "qb"), 4),
+    (("qa", "qb", "qc"), 1),
+    (("qa", "qb", "qc"), 4),
+], ids=["2q", "2q-cohort", "3q", "3q-cohort"])
+def test_delta_vs_full_mega_parity_and_engagement(monkeypatch, queues, chunks):
+    """Mega kernel: delta-maintained codes == full-recompute codes
+    bit-for-bit, with the kernel's own counters proving which chain ran
+    (delta_updates > 0 on one side, full_recomputes > 0 on the other)."""
+    ssn = _spill_cluster(MULTIQ_CONF, queues=queues, n_gangs=2 * len(queues))
+    try:
+        on = _engine(monkeypatch, ssn, delta=True, chunks=chunks)
+        assert on.use_mega, "delta suite expects the mega kernel"
+        assert on.queue_delta
+        if chunks > 1:
+            assert on.cohort_effective > 1, "cohort x delta interplay"
+        codes_on, stats_on = _run(on)
+
+        off = _engine(monkeypatch, ssn, delta=False, chunks=chunks)
+        assert off.use_mega and not off.queue_delta
+        codes_off, stats_off = _run(off)
+
+        np.testing.assert_array_equal(codes_on, codes_off)
+        assert stats_on["placed"] > 0
+        qc_on, qc_off = stats_on["queue_chain"], stats_off["queue_chain"]
+        assert qc_on["mode"] == "delta" and qc_off["mode"] == "full"
+        assert qc_on["delta_updates"] > 0, "delta path never engaged"
+        assert qc_on["full_recomputes"] == 0
+        assert qc_off["full_recomputes"] > 0
+        assert qc_off["delta_updates"] == 0
+        # Same placements -> same step count: the delta repartitions per-step
+        # WORK, never the scan's decisions.
+        assert stats_on["steps"] == stats_off["steps"]
+    finally:
+        close_session(ssn)
+
+
+def test_delta_matches_xla_anchors(monkeypatch):
+    """Absolute anchors: mega-delta == XLA-delta == XLA-full bit-for-bit
+    (the XLA while-loop carries its own q_share/q_over delta; its full mode
+    is the round-5 program unchanged)."""
+    ssn = _spill_cluster(MULTIQ_CONF, queues=("qa", "qb"), n_gangs=4)
+    try:
+        eng = _engine(monkeypatch, ssn, delta=True, chunks=1)
+        assert eng.use_mega
+        mega_delta, _ = _run(eng)
+        eng.use_mega = False
+        xla_delta, _ = _run(eng)
+
+        eng_full = _engine(monkeypatch, ssn, delta=False, chunks=1)
+        eng_full.use_mega = False
+        xla_full, _ = _run(eng_full)
+
+        np.testing.assert_array_equal(mega_delta, xla_delta)
+        np.testing.assert_array_equal(xla_delta, xla_full)
+        assert int((mega_delta >= 0).sum()) > 0
+    finally:
+        close_session(ssn)
+
+
+def test_delta_survives_overused_queue(monkeypatch):
+    """A queue pushed past its deserved share must be gated identically by
+    the maintained overused flag and the full recompute — including the
+    all-overused HALT endgame (allocate ends, tasks stay pending)."""
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    # Tiny cluster: qa's single gang overshoots its deserved slice, so the
+    # overused gate must flip qa off mid-action while qb drains.
+    cache.add_queue(build_queue("qa", weight=1))
+    cache.add_queue(build_queue("qb", weight=9))
+    for i in range(2):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": 2000, "memory": 8 * 2**30, "pods": 110}))
+    for g, q in (("ga", "qa"), ("gb", "qb")):
+        cache.add_pod_group(build_pod_group(g, min_member=1, queue=q))
+        for i in range(4):
+            cache.add_pod(build_pod(
+                name=f"{g}-{i}", req={"cpu": 400, "memory": 2**30},
+                groupname=g))
+    ssn = open_session(cache, parse_scheduler_conf(OVERUSED_CONF).tiers)
+    try:
+        on = _engine(monkeypatch, ssn, delta=True)
+        codes_on, stats_on = _run(on)
+        off = _engine(monkeypatch, ssn, delta=False)
+        codes_off, _ = _run(off)
+        np.testing.assert_array_equal(codes_on, codes_off)
+        assert stats_on["queue_chain"]["delta_updates"] > 0
+    finally:
+        close_session(ssn)
+
+
+# -- mutation-trajectory fuzz (modeled on test_engine_cache_parity) ----------
+
+def _fuzz_cluster(rng, n_queues: int) -> SchedulerCache:
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    queues = [f"q{i}" for i in range(n_queues)]
+    for i, q in enumerate(queues):
+        cache.add_queue(build_queue(q, weight=int(rng.integers(1, 4))))
+    for i in range(int(rng.integers(3, 6))):
+        cache.add_node(build_node(
+            f"n{i:02d}",
+            {"cpu": float(rng.choice([2000, 4000, 8000])),
+             "memory": float(rng.choice([8, 16])) * 2**30,
+             "pods": int(rng.integers(4, 12))},
+        ))
+    shapes = [
+        {"cpu": 500, "memory": 2**30},
+        {"cpu": 1000, "memory": 2 * 2**30},
+    ]
+    for g in range(int(rng.integers(3, 7))):
+        size = int(rng.integers(1, 8))
+        q = queues[g % n_queues]
+        cache.add_pod_group(build_pod_group(
+            f"g{g}", queue=q, min_member=int(rng.integers(1, size + 1))))
+        shape = shapes[int(rng.integers(0, len(shapes)))]
+        for i in range(size):
+            cache.add_pod(build_pod(
+                name=f"g{g}-{i}", req=dict(shape), groupname=f"g{g}",
+                priority=int(rng.integers(0, 2))))
+    return cache
+
+
+def _mutate(cache, rng, step: int) -> None:
+    """Deterministic churn between cycles: evict a running task, add a late
+    job on a random queue, or leave the cycle steady."""
+    roll = int(rng.integers(0, 3))
+    if roll == 0:
+        tasks = sorted(
+            (t for job in cache.jobs.values() for t in job.tasks.values()
+             if t.node_name and t.status == TaskStatus.RUNNING),
+            key=lambda t: t.name,
+        )
+        if tasks:
+            cache.evict(tasks[0], "delta-parity churn")
+    elif roll == 1:
+        q = sorted(cache.queues)[int(rng.integers(0, len(cache.queues)))]
+        cache.add_pod_group(build_pod_group(
+            f"late{step}", queue=q, min_member=1))
+        cache.add_pod(build_pod(
+            name=f"late{step}-0", req={"cpu": 500, "memory": 2**30},
+            groupname=f"late{step}"))
+
+
+def _trajectory(seed: int, n_queues: int, env: dict, monkeypatch) -> list:
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    rng = np.random.default_rng(seed)
+    cache = _fuzz_cluster(rng, n_queues)
+    conf = parse_scheduler_conf(MULTIQ_CONF)
+    out = []
+    for step in range(5):
+        _mutate(cache, rng, step)
+        ssn = open_session(cache, conf.tiers)
+        get_action("allocate").execute(ssn)
+        statuses = {
+            t.name: t.status.name
+            for job in ssn.jobs.values()
+            for t in job.tasks.values()
+        }
+        close_session(ssn)
+        out.append((dict(cache.binder.binds), statuses))
+    return out
+
+
+@pytest.mark.parametrize("seed", [11, 23])
+@pytest.mark.parametrize("n_queues", [2, 3])
+@pytest.mark.parametrize("chunks", ["1", "4"])
+def test_delta_fuzz_trajectories(monkeypatch, seed, n_queues, chunks):
+    """Whole-action fuzz: the same 5-cycle mutation trajectory (random
+    multi-queue clusters, evictions, late jobs) must produce identical
+    binds and task statuses with the delta chain on and off — cohort
+    chunks on and off ride the same sweep."""
+    base = {"SCHEDULER_TPU_COHORT": chunks}
+    delta = _trajectory(
+        seed, n_queues, {**base, "SCHEDULER_TPU_QUEUE_DELTA": "1"},
+        monkeypatch)
+    full = _trajectory(
+        seed, n_queues, {**base, "SCHEDULER_TPU_QUEUE_DELTA": "0"},
+        monkeypatch)
+    assert len(delta) == len(full) == 5
+    for i, (got, want) in enumerate(zip(delta, full)):
+        assert got[0] == want[0], f"cycle {i}: binds diverge"
+        assert got[1] == want[1], f"cycle {i}: task statuses diverge"

@@ -1,0 +1,178 @@
+"""Client-side rate limiting (connector/client.py TokenBucket): a real
+QPS+burst token bucket on the outbound RPCs, replacing the io-worker-count
+approximation (VERDICT #50 — a concurrency bound is not a rate bound).
+
+Timing is driven entirely through injected clock/sleep hooks: no test here
+ever sleeps for real, and the pacing assertions are exact arithmetic on the
+bucket's reservations rather than wall-clock tolerances.
+"""
+
+import threading
+
+import pytest
+
+from scheduler_tpu.connector import client as client_mod
+from scheduler_tpu.connector.client import (
+    HttpBinder,
+    K8sBinder,
+    TokenBucket,
+    rate_limiter_from_env,
+)
+
+
+class FakeTime:
+    """A monotonic clock + sleep pair where sleeping IS advancing time."""
+
+    def __init__(self) -> None:
+        self.now = 0.0
+        self.sleeps = []
+
+    def clock(self) -> float:
+        return self.now
+
+    def sleep(self, s: float) -> None:
+        self.sleeps.append(s)
+        self.now += s
+
+
+def make_bucket(qps, burst):
+    ft = FakeTime()
+    return TokenBucket(qps, burst, clock=ft.clock, sleep=ft.sleep), ft
+
+
+def test_burst_then_paced():
+    bucket, ft = make_bucket(qps=2.0, burst=2)
+    # The burst is free...
+    assert bucket.acquire() == 0.0
+    assert bucket.acquire() == 0.0
+    # ...then every acquire is paced at exactly 1/qps, debt accumulating
+    # across back-to-back callers (client-go tokenBucketRateLimiter).
+    assert bucket.acquire() == pytest.approx(0.5)
+    assert bucket.acquire() == pytest.approx(0.5)
+    assert ft.sleeps == pytest.approx([0.5, 0.5])
+
+
+def test_refill_caps_at_burst():
+    bucket, ft = make_bucket(qps=10.0, burst=3)
+    for _ in range(3):
+        assert bucket.acquire() == 0.0
+    # A long idle period refills to burst, NOT unbounded: exactly 3 free
+    # tokens again no matter how long the gap was.
+    ft.now += 60.0
+    for _ in range(3):
+        assert bucket.acquire() == 0.0
+    assert bucket.acquire() == pytest.approx(0.1)
+
+
+def test_partial_refill():
+    bucket, ft = make_bucket(qps=4.0, burst=1)
+    assert bucket.acquire() == 0.0
+    # Half a token has refilled after 1/8s at 4 qps: the next acquire owes
+    # the other half -> 0.125s.
+    ft.now += 0.125
+    assert bucket.acquire() == pytest.approx(0.125)
+
+
+def test_concurrent_acquires_are_paced_not_lost():
+    """N threads racing one bucket must reserve N distinct slots: total
+    sleep equals the arithmetic series of a 1/qps-paced queue, and no two
+    callers share a reservation (the lock covers the debt arithmetic)."""
+    ft = FakeTime()
+    lock = threading.Lock()
+
+    def locked_sleep(s):
+        with lock:
+            ft.sleeps.append(s)
+
+    bucket = TokenBucket(5.0, 1, clock=ft.clock, sleep=locked_sleep)
+    threads = [threading.Thread(target=bucket.acquire) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # Frozen clock: one burst token, then debts of 1, 2, ... 5 tokens at
+    # 5 qps -> sleeps {0.2, 0.4, 0.6, 0.8, 1.0} in some order.
+    waits = sorted(ft.sleeps)
+    assert waits == pytest.approx([0.2 * i for i in range(1, 6)])
+
+
+def test_qps_must_be_positive():
+    with pytest.raises(ValueError):
+        TokenBucket(0.0, 1)
+
+
+def test_env_wiring(monkeypatch):
+    monkeypatch.delenv("SCHEDULER_TPU_QPS", raising=False)
+    monkeypatch.delenv("SCHEDULER_TPU_BURST", raising=False)
+    assert rate_limiter_from_env() is None  # unset -> unlimited
+
+    monkeypatch.setenv("SCHEDULER_TPU_QPS", "12.5")
+    limiter = rate_limiter_from_env()
+    assert limiter is not None
+    assert limiter.qps == 12.5
+    assert limiter.burst == 13  # default burst = ceil(qps)
+
+    monkeypatch.setenv("SCHEDULER_TPU_BURST", "40")
+    assert rate_limiter_from_env().burst == 40
+
+    # Malformed values degrade to the default (= off), never raise.
+    monkeypatch.setenv("SCHEDULER_TPU_QPS", "fast")
+    assert rate_limiter_from_env() is None
+
+
+class _CountingLimiter(TokenBucket):
+    def __init__(self):
+        super().__init__(1000.0, 1000)
+        self.calls = 0
+
+    def acquire(self):
+        self.calls += 1
+        return 0.0
+
+
+def test_outbound_rpcs_consult_the_limiter(monkeypatch):
+    """Every outbound RPC — both dialects — passes through the shared
+    bucket before touching the wire."""
+    sent = []
+
+    class _Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def read(self):
+            return b"{}"
+
+    def fake_urlopen(req, timeout=None):
+        sent.append(req.full_url)
+        return _Resp()
+
+    monkeypatch.setattr(client_mod.urllib.request, "urlopen", fake_urlopen)
+    limiter = _CountingLimiter()
+
+    class Pod:
+        namespace, name = "ns", "p0"
+
+    K8sBinder("http://x", limiter).bind(Pod, "n0")
+    HttpBinder("http://x", limiter).bind(Pod, "n0")
+    assert limiter.calls == 2 and len(sent) == 2
+
+
+def test_connect_cache_threads_one_shared_limiter(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_QPS", "7")
+    cache, connector = client_mod.connect_cache(
+        "http://127.0.0.1:1", async_io=False
+    )
+    try:
+        binder = cache.binder
+        assert binder.limiter is not None
+        # ONE budget across binder/evictor/status/volumes, like the
+        # reference's single kube client.
+        assert binder.limiter is cache.evictor.limiter
+        assert binder.limiter is cache.status_updater.limiter
+        assert binder.limiter is cache.volume_binder.limiter
+        assert binder.limiter.qps == 7.0
+    finally:
+        connector.stop()

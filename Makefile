@@ -35,10 +35,10 @@ wheel:
 
 # schedlint: the repo-native static-analysis gate (docs/STATIC_ANALYSIS.md) —
 # engine-flag cache drift, host-sync leaks, donation safety, lock order,
-# doc artifact references.  Plus the generic hygiene lint.
+# doc artifact references, the scratch/stats row-layout registry, and the
+# generic hygiene lint (one CLI; scripts/lint.py remains as a shim).
 lint:
 	$(PY) scripts/schedlint.py
-	$(PY) scripts/lint.py
 
 # Lint gate (reference `make verify`: gofmt/golint/compile slots): byte-compile
 # everything, schedlint + the AST hygiene lint, then the wheel build +

@@ -126,6 +126,13 @@ def main() -> None:
     # FAILS on any implicit host transfer mid-device-phase, and the artifact
     # records that the numbers were taken under sanitize overhead.
     sanitized = sanitize.arm()
+    # SCHEDULER_TPU_TSAN=1: Eraser-style lockset race sanitizer over the
+    # shared-state hot spots (utils/tsan.py) — a cross-thread access whose
+    # candidate lockset empties RAISES at the access, and the artifact
+    # carries the race log (empty == the cycle ran race-clean).
+    from scheduler_tpu.utils import tsan
+
+    tsan_armed = tsan.arm()
 
     # Warmup at the REAL shapes: the steady-state scheduler loop compiles once
     # per (node-bucket, task-bucket) pair and re-runs every period, so the
@@ -176,6 +183,7 @@ def main() -> None:
             "cycle_seconds": round(elapsed, 3),
             "regime": regime,
             "sanitize": sanitized,
+            "tsan": {"armed": tsan_armed, "races": tsan.races()},
             "policy": POLICY,
             "cycles": [
                 {

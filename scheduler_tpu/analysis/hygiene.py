@@ -1,0 +1,106 @@
+"""Pass ``hygiene``: the generic lint gate, unified into schedlint.
+
+The checks are the former ``scripts/lint.py`` standalone linter (stdlib-only
+— no third-party linters in the image), now one schedlint pass so the repo
+has ONE analysis CLI and ONE JSON report:
+
+* trailing whitespace and tabs in indentation;
+* unused imports, AST-driven, with the registration-by-import escape hatch
+  (``# noqa`` on the import line), ``__init__.py`` re-export barrels
+  exempt, and a word-occurrence fallback for names that only appear in
+  docstrings/string annotations.
+
+``scripts/lint.py`` survives as a thin shim over
+``scripts/schedlint.py --rules hygiene`` so existing invocations keep
+working; ``make lint`` runs the full schedlint gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Tuple
+
+from scheduler_tpu.analysis.core import Finding, PyModule, Repo, register
+
+RULE = "hygiene"
+
+
+def _imported_names(tree: ast.AST) -> Iterable[Tuple[int, str, bool]]:
+    """(lineno, bound-name, is_star) for every import binding."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                yield node.lineno, alias.asname or alias.name.split(".")[0], False
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    yield node.lineno, "*", True
+                else:
+                    yield node.lineno, alias.asname or alias.name, False
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+    return used
+
+
+def _check_module(mod: PyModule) -> List[Finding]:
+    out: List[Finding] = []
+    lines = mod.text.splitlines()
+    for i, line in enumerate(lines, 1):
+        if line != line.rstrip():
+            out.append(Finding(RULE, mod.path, i, "trailing whitespace"))
+        stripped_len = len(line) - len(line.lstrip(" \t"))
+        if "\t" in line[:stripped_len]:
+            out.append(Finding(RULE, mod.path, i, "tab in indentation"))
+    if mod.path.rsplit("/", 1)[-1] == "__init__.py":
+        return out  # re-export barrels import without local use
+
+    used = _used_names(mod.tree)
+    exported = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        exported |= {
+                            getattr(e, "value", None) for e in node.value.elts
+                        }
+    for lineno, name, star in _imported_names(mod.tree):
+        if star or name in used or name in exported:
+            continue
+        src_line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
+        if "noqa" in src_line:
+            continue
+        # String-annotation / docstring-reference fallback: the name counts
+        # as used if the word appears anywhere beyond its own import line
+        # (quoted forward refs under TYPE_CHECKING are Constants, not Names).
+        word = re.compile(rf"\b{re.escape(name)}\b")
+        if any(
+            word.search(line)
+            for j, line in enumerate(lines, 1)
+            if j != lineno
+        ):
+            continue
+        out.append(Finding(RULE, mod.path, lineno, f"unused import '{name}'"))
+    return out
+
+
+@register(RULE)
+def hygiene(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in repo.modules:
+        out.extend(_check_module(mod))
+    return out

@@ -55,13 +55,18 @@ _CTORS = {
 
 
 def _lock_ctor(call: ast.AST) -> Optional[str]:
-    """Lock kind when ``call`` constructs a threading primitive."""
+    """Lock kind when ``call`` constructs a threading primitive.  Sees
+    through the tsan instrumentation wrapper —
+    ``tsan.wrap_lock(threading.Lock(), name)`` (utils/tsan.py) — so
+    sanitizer-instrumented locks stay in the acquisition graph."""
     if not isinstance(call, ast.Call):
         return None
     fn = dotted(call.func)
     if fn is None:
         return None
     leaf = fn.rsplit(".", 1)[-1]
+    if leaf == "wrap_lock" and call.args:
+        return _lock_ctor(call.args[0])
     if leaf not in _CTORS:
         return None
     if "." in fn and not fn.startswith("threading."):

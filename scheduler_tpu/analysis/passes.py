@@ -4,4 +4,6 @@ from scheduler_tpu.analysis import doc_refs  # noqa: F401
 from scheduler_tpu.analysis import donation  # noqa: F401
 from scheduler_tpu.analysis import env_drift  # noqa: F401
 from scheduler_tpu.analysis import host_sync  # noqa: F401
+from scheduler_tpu.analysis import hygiene  # noqa: F401
 from scheduler_tpu.analysis import lock_order  # noqa: F401
+from scheduler_tpu.analysis import row_layout  # noqa: F401

@@ -1,0 +1,622 @@
+"""Pass ``row-layout``: the scratch/stats row registry, machine-checked.
+
+The device engine's row layouts (``scheduler_tpu/ops/layout.py``) are APIs
+between the kernel that writes a row, the host shim that reads it back and
+the bench plumbing that publishes it.  This pass re-reads the registry AS
+DATA (ast over the analyzed ``Repo``, so the test corpus can supply fixture
+registries) and verifies four invariant families:
+
+1. **Bare literals.**  In a module that registers a buffer (``BUFFERS``),
+   any subscript of that buffer whose row-start expression contains an
+   integer constant but references no registry name is a finding — every
+   scratch/stats row index must go through the registry.  Checked on the
+   slice LOWER bound and on plain indexes of the registered axis (uppers
+   are starts-plus-span and ride the same names in practice).
+2. **Registry integrity.**  Within a namespace, two names whose row regions
+   overlap are a collision unless declared in ``ALIASES``; spans, liveness
+   flags and buffer bindings must refer to declared names.
+3. **Guard dataflow** (``DATAFLOW_NAMESPACES``).  Buffer accesses are
+   collected together with the engine-flavor ``if`` guards around them
+   (``FLAVOR_FLAGS``).  A row touched without its declared liveness guards
+   (``LIVE_WHEN``) — or READ under guards no WRITE covers (no store whose
+   positive guard set is a subset of the read's) — is a row some engine
+   flavor reads but never writes: the exact failure class a scratch-row
+   edit introduces.
+4. **Stats round-trip.**  Every ``STATS`` row with a declared artifact key
+   (``STATS_KEYS``) must be written by the kernel, surface under that key
+   in ``FusedAllocator.run_stats`` (ops/fused.py), ride its ``phases.note``
+   channel (actions/), and be consumed by the bench cycle detail
+   (bench.py) — so an evidence counter can never silently fall out of the
+   artifact.
+
+The pass also drift-checks the generated row tables in the docs
+(``DOC_TABLES`` + ``scripts/gen_layout_doc.py``): the markdown between the
+``<!-- layout:NS:begin/end -->`` markers must equal the table rendered from
+the registry.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from scheduler_tpu.analysis.core import Finding, PyModule, Repo, dotted, register
+
+RULE = "row-layout"
+
+LAYOUT_SUFFIX = "ops/layout.py"
+RUN_STATS_SUFFIX = "ops/fused.py"
+NOTE_DIR = "actions/"
+BENCH_SUFFIX = "bench.py"
+STATS_NAMESPACE = "STATS"
+
+_META_KEYS = (
+    "SPANS", "ALIASES", "FLAVOR_FLAGS", "LIVE_WHEN", "BUFFERS",
+    "DATAFLOW_NAMESPACES", "STATS_KEYS", "DOC_TABLES", "DOC_ROWS",
+)
+
+
+@dataclass
+class Registry:
+    path: str
+    namespaces: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    spans: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    aliases: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    flavor_flags: Tuple[str, ...] = ()
+    live_when: Dict[str, Dict[str, Tuple[str, ...]]] = field(default_factory=dict)
+    buffers: Dict[str, Dict[str, Tuple[str, int]]] = field(default_factory=dict)
+    dataflow_namespaces: Tuple[str, ...] = ()
+    stats_keys: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    doc_tables: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    doc_rows: Dict[str, Dict[str, str]] = field(default_factory=dict)
+
+    def region(self, ns: str, name: str) -> Tuple[int, int]:
+        start = self.namespaces[ns][name]
+        span = self.spans.get(ns, {}).get(name, 1)
+        return start, start + span
+
+    def names_in(self, ns: str, lo: int, hi: int) -> List[str]:
+        """Registry names whose region intersects [lo, hi)."""
+        out = []
+        for name in self.namespaces.get(ns, ()):
+            a, b = self.region(ns, name)
+            if a < hi and lo < b:
+                out.append(name)
+        return out
+
+
+def parse_registry_source(text: str, path: str = LAYOUT_SUFFIX) -> Registry:
+    """Build a Registry from layout-module SOURCE (everything in the layout
+    module is literal by contract; non-literal metadata is ignored)."""
+    tree = ast.parse(text)
+    reg = Registry(path=path)
+    meta: Dict[str, object] = {}
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            rows: Dict[str, int] = {}
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Constant)
+                    and isinstance(stmt.value.value, int)
+                ):
+                    rows[stmt.targets[0].id] = stmt.value.value
+            if rows:
+                reg.namespaces[node.name] = rows
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name) and tgt.id in _META_KEYS:
+                try:
+                    meta[tgt.id] = ast.literal_eval(node.value)
+                except ValueError:
+                    pass
+    reg.spans = meta.get("SPANS", {}) or {}
+    reg.aliases = meta.get("ALIASES", {}) or {}
+    reg.flavor_flags = tuple(meta.get("FLAVOR_FLAGS", ()) or ())
+    reg.live_when = {
+        ns: {k: tuple(v) for k, v in rows.items()}
+        for ns, rows in (meta.get("LIVE_WHEN", {}) or {}).items()
+    }
+    reg.buffers = {
+        mod: {b: (nsax[0], int(nsax[1])) for b, nsax in bufs.items()}
+        for mod, bufs in (meta.get("BUFFERS", {}) or {}).items()
+    }
+    reg.dataflow_namespaces = tuple(meta.get("DATAFLOW_NAMESPACES", ()) or ())
+    reg.stats_keys = {
+        k: (v[0], v[1]) for k, v in (meta.get("STATS_KEYS", {}) or {}).items()
+    }
+    reg.doc_tables = {
+        k: tuple(v) for k, v in (meta.get("DOC_TABLES", {}) or {}).items()
+    }
+    reg.doc_rows = meta.get("DOC_ROWS", {}) or {}
+    return reg
+
+
+def render_table(reg: Registry, ns: str) -> List[str]:
+    """Markdown row table for one namespace — the ONE rendering shared by
+    ``scripts/gen_layout_doc.py`` (writer) and this pass (drift check)."""
+    alias_of = reg.aliases.get(ns, {})
+    descs = reg.doc_rows.get(ns, {})
+    rows = sorted(
+        reg.namespaces.get(ns, {}).items(),
+        key=lambda kv: (kv[1], kv[0] in alias_of, kv[0]),
+    )
+    out = [f"| rows | name ({ns}) | content |", "|---|---|---|"]
+    for name, start in rows:
+        lo, hi = reg.region(ns, name)
+        span = f"{lo}" if hi == lo + 1 else f"{lo}..{hi - 1}"
+        if name in alias_of:
+            desc = f"alias of `{alias_of[name]}`"
+            extra = descs.get(name)
+            if extra:
+                desc += f": {extra}"
+        else:
+            desc = descs.get(name, "")
+        out.append(f"| {span} | `{name}` | {desc} |")
+    return out
+
+
+# -- registry integrity -------------------------------------------------------
+
+def _check_registry(reg: Registry) -> List[Finding]:
+    out: List[Finding] = []
+
+    def bad(msg: str) -> None:
+        out.append(Finding(RULE, reg.path, 1, msg))
+
+    for ns, rows in reg.spans.items():
+        for name in rows:
+            if name not in reg.namespaces.get(ns, {}):
+                bad(f"SPANS names unknown row {ns}.{name}")
+    for ns, amap in reg.aliases.items():
+        for a, b in amap.items():
+            if (
+                a not in reg.namespaces.get(ns, {})
+                or b not in reg.namespaces.get(ns, {})
+            ):
+                bad(f"ALIASES names unknown row {ns}.{a} -> {ns}.{b}")
+    for ns, rows in reg.live_when.items():
+        for name, flags in rows.items():
+            if name not in reg.namespaces.get(ns, {}):
+                bad(f"LIVE_WHEN names unknown row {ns}.{name}")
+            for fl in flags:
+                if fl not in reg.flavor_flags:
+                    bad(
+                        f"LIVE_WHEN flag '{fl}' for {ns}.{name} is not in "
+                        "FLAVOR_FLAGS"
+                    )
+    for mod, bufs in reg.buffers.items():
+        for buf, (ns, _axis) in bufs.items():
+            if ns not in reg.namespaces:
+                bad(f"BUFFERS binds '{buf}' ({mod}) to unknown namespace {ns}")
+    for name in reg.stats_keys:
+        if name not in reg.namespaces.get(STATS_NAMESPACE, {}):
+            bad(f"STATS_KEYS names unknown stats row {name}")
+
+    # Collisions: overlapping regions not related through ALIASES.
+    for ns, rows in reg.namespaces.items():
+        amap = reg.aliases.get(ns, {})
+
+        def canonical(n: str) -> str:
+            seen = set()
+            while n in amap and n not in seen:
+                seen.add(n)
+                n = amap[n]
+            return n
+
+        names = sorted(rows)
+        for i, a in enumerate(names):
+            for b in names[i + 1:]:
+                alo, ahi = reg.region(ns, a)
+                blo, bhi = reg.region(ns, b)
+                if alo < bhi and blo < ahi and canonical(a) != canonical(b):
+                    bad(
+                        f"row collision in {ns}: {a} [{alo}, {ahi}) overlaps "
+                        f"{b} [{blo}, {bhi}) and they are not declared "
+                        "aliases"
+                    )
+    return out
+
+
+# -- code access collection ---------------------------------------------------
+
+@dataclass
+class Access:
+    ns: str
+    names: Tuple[str, ...]       # registry names the access covers
+    is_store: bool
+    guards: Tuple[str, ...]      # positive flavor flags in force ("!x" = not)
+    path: str
+    line: int
+
+
+class _LayoutNames:
+    """Resolves ``NS.NAME`` / alias / ``layout.NS.NAME`` attribute chains in
+    one module to registry (namespace, name) pairs."""
+
+    def __init__(self, reg: Registry, tree: ast.AST) -> None:
+        self.reg = reg
+        self.class_alias: Dict[str, str] = {}   # local name -> namespace
+        self.module_alias: Set[str] = set()     # local name -> layout module
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module and node.module.endswith("layout"):
+                    for a in node.names:
+                        if a.name in reg.namespaces:
+                            self.class_alias[a.asname or a.name] = a.name
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.endswith(".layout"):
+                        self.module_alias.add(a.asname or a.name)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, val = node.targets[0], node.value
+                if isinstance(tgt, ast.Name):
+                    vd = dotted(val) if isinstance(
+                        val, (ast.Name, ast.Attribute)
+                    ) else None
+                    if vd:
+                        leaf = vd.rsplit(".", 1)[-1]
+                        if leaf in reg.namespaces:
+                            self.class_alias[tgt.id] = leaf
+
+    def resolve(self, node: ast.AST) -> Optional[Tuple[str, str]]:
+        """(namespace, row name) when ``node`` is a registry reference."""
+        if not isinstance(node, ast.Attribute):
+            return None
+        d = dotted(node)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if len(parts) == 2 and parts[0] in self.class_alias:
+            ns = self.class_alias[parts[0]]
+            if parts[1] in self.reg.namespaces.get(ns, {}):
+                return ns, parts[1]
+        if len(parts) >= 3 and ".".join(parts[:-2]) in self.module_alias:
+            ns, name = parts[-2], parts[-1]
+            if name in self.reg.namespaces.get(ns, {}):
+                return ns, name
+        return None
+
+    def refs_in(self, expr: ast.AST) -> List[Tuple[str, str]]:
+        out = []
+        for node in ast.walk(expr):
+            r = self.resolve(node)
+            if r is not None:
+                out.append(r)
+        return out
+
+    def eval_const(self, expr: ast.AST) -> Optional[int]:
+        """Integer value of an expression over constants, registry names and
+        +/-; None when it involves anything dynamic."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+            return expr.value
+        r = self.resolve(expr)
+        if r is not None:
+            return self.reg.namespaces[r[0]][r[1]]
+        if isinstance(expr, ast.BinOp) and isinstance(
+            expr.op, (ast.Add, ast.Sub)
+        ):
+            a = self.eval_const(expr.left)
+            b = self.eval_const(expr.right)
+            if a is not None and b is not None:
+                return a + b if isinstance(expr.op, ast.Add) else a - b
+        return None
+
+
+def _has_int_constant(expr: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Constant) and isinstance(n.value, int)
+        for n in ast.walk(expr)
+    )
+
+
+def _guard_flags(test: ast.AST, flags: Sequence[str]) -> Tuple[List[str], List[str]]:
+    """(body guards, orelse guards) contributed by an ``if`` test — only
+    plain flavor-flag names (optionally under ``not`` / ``and``) count; any
+    other condition contributes nothing."""
+    if isinstance(test, ast.Name) and test.id in flags:
+        return [test.id], ["!" + test.id]
+    if (
+        isinstance(test, ast.UnaryOp)
+        and isinstance(test.op, ast.Not)
+        and isinstance(test.operand, ast.Name)
+        and test.operand.id in flags
+    ):
+        return ["!" + test.operand.id], [test.operand.id]
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        body: List[str] = []
+        for v in test.values:
+            b, _ = _guard_flags(v, flags)
+            body.extend(b)
+        return body, []  # negation of a conjunction is not a conjunction
+    return [], []
+
+
+def _collect_accesses(
+    mod: PyModule,
+    reg: Registry,
+    buffers: Dict[str, Tuple[str, int]],
+) -> Tuple[List[Access], List[Finding]]:
+    names = _LayoutNames(reg, mod.tree)
+    accesses: List[Access] = []
+    findings: List[Finding] = []
+
+    def row_expr(sub: ast.Subscript, axis: int) -> Optional[ast.AST]:
+        sl = sub.slice
+        if isinstance(sl, ast.Tuple):
+            if axis >= len(sl.elts):
+                return None
+            return sl.elts[axis]
+        return sl if axis == 0 else None
+
+    def record(sub: ast.Subscript, guards: Tuple[str, ...]) -> None:
+        base = sub.value
+        if not isinstance(base, ast.Name) or base.id not in buffers:
+            return
+        ns, axis = buffers[base.id]
+        expr = row_expr(sub, axis)
+        if expr is None:
+            return
+        start = expr.lower if isinstance(expr, ast.Slice) else expr
+        upper = expr.upper if isinstance(expr, ast.Slice) else None
+        if start is not None:
+            if _has_int_constant(start) and not names.refs_in(start):
+                findings.append(Finding(
+                    RULE, mod.path, sub.lineno,
+                    f"bare row index into '{base.id}' ({ns}): name the row "
+                    "through the layout registry (ops/layout.py)",
+                ))
+                return
+        if ns not in reg.namespaces:
+            return
+        # Coverage: evaluate [lo, hi) where possible; fall back to the
+        # region of the referenced name (dynamic offsets stay in-region).
+        lo = 0 if start is None else names.eval_const(start)
+        refs = names.refs_in(start) if start is not None else []
+        if lo is None:
+            if not refs:
+                return
+            lo, default_hi = reg.region(*refs[0])
+        else:
+            default_hi = lo + 1
+        if isinstance(expr, ast.Slice):
+            hi = names.eval_const(upper) if upper is not None else None
+            if hi is None:
+                hi = default_hi if refs or start is None else lo + 1
+        else:
+            hi = default_hi
+        covered = tuple(reg.names_in(ns, lo, hi))
+        if not covered:
+            return
+        accesses.append(Access(
+            ns, covered, isinstance(sub.ctx, ast.Store), guards,
+            mod.path, sub.lineno,
+        ))
+
+    def visit(node: ast.AST, guards: Tuple[str, ...]) -> None:
+        if isinstance(node, ast.If):
+            body_g, else_g = _guard_flags(node.test, reg.flavor_flags)
+            visit(node.test, guards)
+            for stmt in node.body:
+                visit(stmt, guards + tuple(body_g))
+            for stmt in node.orelse:
+                visit(stmt, guards + tuple(else_g))
+            return
+        if isinstance(node, ast.Subscript):
+            record(node, guards)
+        for child in ast.iter_child_nodes(node):
+            visit(child, guards)
+
+    visit(mod.tree, ())
+    return accesses, findings
+
+
+def _positives(guards: Tuple[str, ...]) -> Set[str]:
+    return {g for g in guards if not g.startswith("!")}
+
+
+def _check_dataflow(reg: Registry, accesses: List[Access]) -> List[Finding]:
+    out: List[Finding] = []
+    flow = [a for a in accesses if a.ns in reg.dataflow_namespaces]
+
+    # Liveness: every touch of a row carries its declared guards.
+    for a in flow:
+        pos = _positives(a.guards)
+        for name in a.names:
+            need = set(reg.live_when.get(a.ns, {}).get(name, ()))
+            missing = need - pos
+            if missing:
+                out.append(Finding(
+                    RULE, a.path, a.line,
+                    f"{a.ns}.{name} accessed outside its liveness guards "
+                    f"(missing {', '.join(sorted(missing))}): the row does "
+                    "not exist on this flavor's scratch",
+                ))
+
+    # Read coverage: every read needs a write on a guard subset.
+    writes: Dict[Tuple[str, str], List[Set[str]]] = {}
+    for a in flow:
+        if a.is_store:
+            for name in a.names:
+                writes.setdefault((a.ns, name), []).append(_positives(a.guards))
+    for a in flow:
+        if a.is_store:
+            continue
+        pos = _positives(a.guards)
+        for name in a.names:
+            cands = writes.get((a.ns, name), [])
+            if not any(w <= pos for w in cands):
+                out.append(Finding(
+                    RULE, a.path, a.line,
+                    f"{a.ns}.{name} is read here but no write covers this "
+                    "flavor path (read-without-write)",
+                ))
+    return out
+
+
+# -- stats round-trip ---------------------------------------------------------
+
+def _function_strings(mod: PyModule, fn_name: str) -> Optional[Set[str]]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == fn_name:
+            return {
+                n.value for n in ast.walk(node)
+                if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            }
+    return None
+
+
+def _note_channels(mod: PyModule) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and node.args:
+            d = dotted(node.func)
+            if d and d.rsplit(".", 1)[-1] == "note":
+                if isinstance(node.args[0], ast.Constant) and isinstance(
+                    node.args[0].value, str
+                ):
+                    out.add(node.args[0].value)
+    return out
+
+
+def _module_at(repo: Repo, suffix: str) -> Optional[PyModule]:
+    """The module at ``suffix`` with a path-component boundary (so
+    ``bench.py`` can never match ``daemon_vs_bench.py``)."""
+    for m in repo.modules:
+        if m.path == suffix or m.path.endswith("/" + suffix):
+            return m
+    return None
+
+
+def _check_stats_roundtrip(
+    repo: Repo, reg: Registry, accesses: List[Access], stats_bound: bool
+) -> List[Finding]:
+    if not reg.stats_keys:
+        return []
+    out: List[Finding] = []
+    stored = {
+        name
+        for a in accesses
+        if a.ns == STATS_NAMESPACE and a.is_store
+        for name in a.names
+    }
+
+    fused = _module_at(repo, RUN_STATS_SUFFIX)
+    run_stats_strs = _function_strings(fused, "run_stats") if fused else None
+    channels: Set[str] = set()
+    for mod in repo.modules:
+        if NOTE_DIR in mod.path:
+            channels |= _note_channels(mod)
+    bench = _module_at(repo, BENCH_SUFFIX)
+    bench_strs = (
+        {
+            n.value for n in ast.walk(bench.tree)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)
+        }
+        if bench else None
+    )
+
+    for name, (channel, key) in sorted(reg.stats_keys.items()):
+        if stats_bound and name not in stored:
+            out.append(Finding(
+                RULE, reg.path, 1,
+                f"stats row {name} has artifact key '{key}' but no kernel "
+                "write stores it",
+            ))
+        if run_stats_strs is not None and key not in run_stats_strs:
+            out.append(Finding(
+                RULE, fused.path, 1,
+                f"stats row {name}: key '{key}' does not surface in "
+                "run_stats() — the evidence counter falls out of the "
+                "artifact",
+            ))
+        if channels and channel not in channels:
+            out.append(Finding(
+                RULE, reg.path, 1,
+                f"stats row {name}: no phases.note('{channel}', ...) call "
+                f"under {NOTE_DIR} carries it into the cycle notes",
+            ))
+        if bench_strs is not None and channel not in bench_strs:
+            out.append(Finding(
+                RULE, bench.path, 1,
+                f"stats row {name}: bench cycle detail never consumes note "
+                f"channel '{channel}'",
+            ))
+    return out
+
+
+# -- doc tables ---------------------------------------------------------------
+
+def marker_lines(ns: str) -> Tuple[str, str]:
+    return (
+        f"<!-- layout:{ns}:begin (generated by scripts/gen_layout_doc.py; "
+        "do not edit) -->",
+        f"<!-- layout:{ns}:end -->",
+    )
+
+
+def _check_doc_tables(repo: Repo, reg: Registry) -> List[Finding]:
+    out: List[Finding] = []
+    docs = {d.path: d for d in repo.docs}
+    for path, namespaces in sorted(reg.doc_tables.items()):
+        doc = docs.get(path)
+        if doc is None:
+            continue  # doc-targets subsetting (--changed) may omit it
+        lines = doc.text.splitlines()
+        for ns in namespaces:
+            begin, end = marker_lines(ns)
+            try:
+                b = lines.index(begin)
+                e = lines.index(end, b)
+            except ValueError:
+                out.append(Finding(
+                    RULE, path, 1,
+                    f"missing generated layout table for {ns} (run "
+                    "scripts/gen_layout_doc.py)",
+                ))
+                continue
+            got = [ln.strip() for ln in lines[b + 1 : e] if ln.strip()]
+            want = render_table(reg, ns)
+            if got != want:
+                out.append(Finding(
+                    RULE, path, b + 1,
+                    f"layout table for {ns} is stale (run "
+                    "scripts/gen_layout_doc.py)",
+                ))
+    return out
+
+
+# -- the pass -----------------------------------------------------------------
+
+@register(RULE)
+def row_layout(repo: Repo) -> List[Finding]:
+    layout_mod = repo.module(LAYOUT_SUFFIX)
+    if layout_mod is None:
+        return []
+    reg = parse_registry_source(layout_mod.text, layout_mod.path)
+    out = _check_registry(reg)
+
+    accesses: List[Access] = []
+    stats_bound = False
+    for mod in repo.modules:
+        for suffix, buffers in reg.buffers.items():
+            if mod.path == suffix or mod.path.endswith("/" + suffix):
+                acc, findings = _collect_accesses(mod, reg, buffers)
+                accesses.extend(acc)
+                out.extend(findings)
+                # The "stats row never stored" check wants a KERNEL-side
+                # binding in scope; the run_stats module only READS them.
+                host_side = mod.path == RUN_STATS_SUFFIX or mod.path.endswith(
+                    "/" + RUN_STATS_SUFFIX
+                )
+                if not host_side:
+                    stats_bound = stats_bound or any(
+                        ns == STATS_NAMESPACE for ns, _ in buffers.values()
+                    )
+    out.extend(_check_dataflow(reg, accesses))
+    out.extend(_check_stats_roundtrip(repo, reg, accesses, stats_bound))
+    out.extend(_check_doc_tables(repo, reg))
+    return out

@@ -77,13 +77,19 @@ class TokenBucket:
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
     ) -> None:
+        from scheduler_tpu.utils import tsan
+
         if qps <= 0:
             raise ValueError(f"qps must be positive, got {qps}")
         self.qps = float(qps)
         self.burst = float(max(1, burst))
         self._clock = clock
         self._sleep = sleep
-        self._lock = threading.Lock()
+        # Instrumented for the lockset sanitizer (SCHEDULER_TPU_TSAN=1):
+        # one bucket is shared by every io-worker via connect_cache.
+        tag = tsan.obj_tag(self)
+        self._lock = tsan.wrap_lock(threading.Lock(), f"{tag}._lock")
+        self._tsan_bucket = f"{tag}.tokens"
         self._tokens = self.burst
         self._last = clock()
 
@@ -91,7 +97,10 @@ class TokenBucket:
         """Reserve one request slot, blocking until it is due.  Returns the
         seconds slept (0.0 within the burst) — surfaced for tests and for
         callers that want to log throttling."""
+        from scheduler_tpu.utils import tsan
+
         with self._lock:
+            tsan.access(self._tsan_bucket)
             now = self._clock()
             self._tokens = min(
                 self.burst, self._tokens + (now - self._last) * self.qps

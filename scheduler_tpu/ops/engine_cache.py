@@ -173,7 +173,15 @@ def layout_token(ssn, jobs) -> Optional[tuple]:
 
 class EngineCache:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        from scheduler_tpu.utils import tsan
+
+        # Instrumented for the lockset sanitizer (SCHEDULER_TPU_TSAN=1,
+        # utils/tsan.py): the resident table and counters are shared between
+        # the scheduler loop and whoever drains cycle stats.
+        tag = tsan.obj_tag(self)
+        self._lock = tsan.wrap_lock(threading.Lock(), f"{tag}._lock")
+        self._tsan_entries = f"{tag}.entries"
+        self._tsan_counters = f"{tag}.counters"
         self._entries: "OrderedDict[tuple, object]" = OrderedDict()
         self.hits = 0
         self.misses = 0
@@ -198,6 +206,8 @@ class EngineCache:
         token = layout_token(ssn, jobs) if key is not None else None
         if key is None or token is None:
             return FusedAllocator(ssn, jobs), "off"
+        from scheduler_tpu.utils import tsan
+
         with self._lock:
             # Popped while in use: a concurrent session under the same key
             # cold-builds its own engine rather than sharing mutable state.
@@ -205,6 +215,7 @@ class EngineCache:
             # CLOSES (release_session), never here — re-inserting now would
             # let a same-key session pop an engine that is still mid-cycle
             # (dispatch in flight, decode pending) and corrupt it.
+            tsan.access(self._tsan_entries)
             engine = self._entries.pop(key, None)
         if engine is None:
             engine = FusedAllocator(ssn, jobs)
@@ -216,6 +227,7 @@ class EngineCache:
             )
         engine._cache_key = key
         with self._lock:
+            tsan.access(self._tsan_counters)
             if status == "hit":
                 self.hits += 1
             elif status == "rebuild":
@@ -232,7 +244,11 @@ class EngineCache:
         return engine, status
 
     def stats(self) -> dict:
+        from scheduler_tpu.utils import tsan
+
         with self._lock:
+            tsan.access(self._tsan_counters, write=False)
+            tsan.access(self._tsan_entries, write=False)
             return {
                 "hits": self.hits,
                 "misses": self.misses,
@@ -242,7 +258,10 @@ class EngineCache:
 
     def reset_counters(self) -> dict:
         """Snapshot and zero the counters (per-cycle accounting)."""
+        from scheduler_tpu.utils import tsan
+
         with self._lock:
+            tsan.access(self._tsan_counters)
             snap = {
                 "hits": self.hits,
                 "misses": self.misses,
@@ -260,6 +279,8 @@ class EngineCache:
         re-insertion is also the concurrency guarantee: between get_engine
         and here the engine is in no dict, so a same-key session can never
         share it mid-cycle."""
+        from scheduler_tpu.utils import tsan
+
         lent = getattr(ssn, "_engine_cache_lent", None)
         if not lent:
             return
@@ -270,6 +291,7 @@ class EngineCache:
             if key is None or not _enabled():
                 continue
             with self._lock:
+                tsan.access(self._tsan_entries)
                 self._entries[key] = engine
                 self._entries.move_to_end(key)
                 cap = _cap()
@@ -277,7 +299,10 @@ class EngineCache:
                     self._entries.popitem(last=False)
 
     def clear(self) -> None:
+        from scheduler_tpu.utils import tsan
+
         with self._lock:
+            tsan.access(self._tsan_entries)
             self._entries.clear()
 
 

@@ -58,6 +58,7 @@ from scheduler_tpu.ops.allocator import (
     score_weights,
 )
 from scheduler_tpu.ops.device import DevicePolicy, pad_rows, scale_columns
+from scheduler_tpu.ops.layout import JOB_STATE, SIG_REQ, STATS
 from scheduler_tpu.ops.pallas_kernels import queue_share_overused
 from scheduler_tpu.ops.predicates import fit_mask
 from scheduler_tpu.ops.scoring import dynamic_score
@@ -387,7 +388,9 @@ def fused_allocate(
     job_deficit_f = job_deficit.astype(jnp.float32)
 
     def eligible(job_state):
-        return (job_state[:, 2] == 0) & (job_state[:, 0] < job_task_num_f)
+        return (job_state[:, JOB_STATE.LEFT] == 0) & (
+            job_state[:, JOB_STATE.CONSUMED] < job_task_num_f
+        )
 
     # Single-queue sessions (the common case) skip the whole queue-selection
     # block at trace time: every eligible job is in queue 0.  Decided by the
@@ -405,11 +408,15 @@ def fused_allocate(
             if name == "priority":
                 key, sentinel = -job_priority, big_i32
             elif name == "gang":
-                key = ((job_gang_order_f - job_state[:, 1]) <= 0).astype(jnp.int32)
+                key = (
+                    (job_gang_order_f - job_state[:, JOB_STATE.ALLOCATED]) <= 0
+                ).astype(jnp.int32)
                 sentinel = big_i32
             elif name == "drf":
                 frac = jnp.where(
-                    total_mask[None, :], job_state[:, 3:] / total_safe[None, :], 0.0
+                    total_mask[None, :],
+                    job_state[:, JOB_STATE.DRF:] / total_safe[None, :],
+                    0.0,
                 )
                 key, sentinel = jnp.max(frac, axis=-1), pos_inf
             else:  # pragma: no cover - guarded by `supported`
@@ -574,7 +581,9 @@ def fused_allocate(
         cur_safe = jnp.clip(cur, 0, j_real_cap - 1)
 
         t_idx = jnp.clip(
-            job_task_offset[cur] + job_state[cur, 0].astype(jnp.int32), 0, t_cap - 1
+            job_task_offset[cur]
+            + job_state[cur, JOB_STATE.CONSUMED].astype(jnp.int32),
+            0, t_cap - 1,
         )
         init_req = init_resreq[t_idx]
         req = resreq[t_idx]
@@ -676,7 +685,8 @@ def fused_allocate(
             # every placement, so the batch must stay at 1.
             room = jnp.where(
                 deficit_v > 0,
-                deficit_v - job_state[cur_safe, 1].astype(jnp.int32),
+                deficit_v
+                - job_state[cur_safe, JOB_STATE.ALLOCATED].astype(jnp.int32),
                 1,
             )
             if cross_batch:
@@ -807,7 +817,7 @@ def fused_allocate(
             rowmask = (i_idx < k) & (cross_active | (i_idx == 0))
             rows = base[None, :] * rowmask[:, None].astype(job_state.dtype)
             seg = jax.lax.dynamic_slice(
-                job_state, (cur_safe, 0), (MAX_BATCH, 3 + r_dim)
+                job_state, (cur_safe, 0), (MAX_BATCH, JOB_STATE.DRF + r_dim)
             )
             job_state = jax.lax.dynamic_update_slice(
                 job_state, seg + rows, (cur_safe, 0)
@@ -844,9 +854,9 @@ def fused_allocate(
 
         row_after = job_state[cur_safe]
         became_ready = (alloc_here | pipe_here) & (
-            row_after[1] >= job_deficit_f[cur_safe]
+            row_after[JOB_STATE.ALLOCATED] >= job_deficit_f[cur_safe]
         )
-        drained = row_after[0] >= job_task_num_f[cur_safe]
+        drained = row_after[JOB_STATE.CONSUMED] >= job_task_num_f[cur_safe]
         end_pop = failed | became_ready | drained
         cur = jnp.where(
             cur == HALT, HALT, jnp.where(active & ~end_pop, cur, -1)
@@ -906,7 +916,7 @@ def fused_allocate(
         node_state0,
         jnp.concatenate(
             [
-                jnp.zeros((j_cap, 3), dtype=job_alloc_init.dtype),
+                jnp.zeros((j_cap, JOB_STATE.DRF), dtype=job_alloc_init.dtype),
                 job_alloc_init,
             ],
             axis=1,
@@ -1525,8 +1535,8 @@ class FusedAllocator:
             return  # request mix too wide for the per-signature table
         s_pad = max(128, -(-s_count // 128) * 128)
         sig_req = np.zeros((16, s_pad), dtype=np.float32)
-        sig_req[:r, :s_count] = uniq_rows[:, :r].T
-        sig_req[8 : 8 + r, :s_count] = uniq_rows[:, r:].T
+        sig_req[SIG_REQ.REQ : SIG_REQ.REQ + r, :s_count] = uniq_rows[:, :r].T
+        sig_req[SIG_REQ.INIT : SIG_REQ.INIT + r, :s_count] = uniq_rows[:, r:].T
 
         # Cohort tables ride the windowed [ceil(T/128), 128] layout: the
         # kernel reads them with a 1-row dynamic sublane window instead of a
@@ -2224,12 +2234,10 @@ class FusedAllocator:
             )
         raw = self._stats_raw
         if raw is not None:
-            from scheduler_tpu.ops import megakernel as _mk
-
-            steps = int(raw[_mk.STATS_STEPS])
+            steps = int(raw[STATS.STEPS])
             out["steps"] = steps
-            out["cohort_steps"] = int(raw[_mk.STATS_COHORT_STEPS])
-            out["chunk_placed"] = int(raw[_mk.STATS_CHUNK_PLACED])
+            out["cohort_steps"] = int(raw[STATS.COHORT_STEPS])
+            out["chunk_placed"] = int(raw[STATS.CHUNK_PLACED])
             out["fallback_steps"] = steps - out["cohort_steps"]
             if steps > 0 and "placed" in out:
                 out["tasks_per_step"] = round(out["placed"] / steps, 2)
@@ -2239,10 +2247,10 @@ class FusedAllocator:
                 # chain the executed program ran (bench detail
                 # ``queue_chain``).
                 out["queue_chain"]["delta_updates"] = int(
-                    raw[_mk.STATS_QDELTA_UPDATES]
+                    raw[STATS.QDELTA_UPDATES]
                 )
                 out["queue_chain"]["full_recomputes"] = int(
-                    raw[_mk.STATS_QFULL_RECOMPUTES]
+                    raw[STATS.QFULL_RECOMPUTES]
                 )
         return out
 

@@ -1,0 +1,246 @@
+"""Declarative scratch-row / stats-row layout registry for the device engine.
+
+The mega kernel's hottest invariants used to live in comments: "scratch rows
+24/25 carry the live share/overused values", "stats row 3 counts delta
+updates", "request rows 0..7, init rows 8..15".  Every one of those rows is
+an API between at least two modules — the kernel that writes it, the host
+shim that reads it back, the bench plumbing that publishes it — and a bare
+integer index cannot be cross-checked by anything.  This module is the ONE
+place a row gets a name, a span, and a liveness condition; the ops modules
+(``megakernel.py``, ``fused.py``, ``pallas_kernels.py``, ``sharded.py``)
+index through these names, and schedlint's ``row-layout`` pass
+(``scheduler_tpu/analysis/row_layout.py``, docs/STATIC_ANALYSIS.md) verifies
+mechanically that
+
+* no bare integer row index into a registered buffer survives in ``ops/``,
+* no two names in a namespace collide or overlap (unless declared aliases),
+* every row READ on some engine flavor is WRITTEN on that flavor's path
+  (guard-condition dataflow over the kernel body), and
+* every stats row's name round-trips ``FusedAllocator.run_stats()`` →
+  ``phases.note()`` → bench ``detail.cycles[]`` keys.
+
+EVERYTHING in this module is a literal: the analysis pass (and the doc
+generator, ``scripts/gen_layout_doc.py``) re-reads this file as data via
+``ast`` — no imports, no computed values in the declarations.  The
+generated tables in ``docs/QUEUE_DELTA.md`` / ``docs/DEVICE_ENGINE.md`` are
+derived from here and drift-checked by the same pass.
+"""
+
+from __future__ import annotations
+
+
+class NODE_SCRATCH:
+    """Mega-kernel node scratch ``ns`` (VMEM f32 [16|24, N], nodes on lanes).
+    ``has_releasing`` sessions extend the block with the releasing ledger."""
+
+    IDLE = 0         # span 8: live idle vector, rows 0..r_dim-1 (pad rows 0)
+    TASK_COUNT = 8   # live per-node task count (pods-limit gate)
+    RELEASING = 16   # span 8: live releasing ledger (pipelined placements)
+
+
+class JOB_SCRATCH:
+    """Mega-kernel job scratch ``js`` (VMEM f32 [16|24|32, J], jobs on lanes)."""
+
+    CONSUMED = 0     # tasks consumed from the job's pending run
+    ALLOCATED = 1    # tasks actually placed (gang-ready arithmetic)
+    LEFT = 2         # nonzero once a placement failed (pop ended)
+    DRF = 8          # span 8: live drf allocated per job
+    QUEUE_ALLOC = 16  # span 8: live allocated of the job's QUEUE, per lane
+    SHARE = 24       # maintained share of the lane's queue (delta chain)
+    OVERUSED = 25    # maintained overused flag of the lane's queue
+
+
+class STATS:
+    """Mega-kernel evidence counters (second kernel output, SMEM i32[1, 8]).
+    Kernel-side the stats index rides the LANE axis (``stats_ref[0, row]``);
+    host-side ``run_stats`` reads the squeezed i32[8] vector (``raw[row]``)."""
+
+    STEPS = 0             # loop steps taken
+    COHORT_STEPS = 1      # steps where the cohort chunk path engaged
+    CHUNK_PLACED = 2      # placements made by chunks >= 1 (multi-node wins)
+    QDELTA_UPDATES = 3    # queue-share delta updates applied (delta chain)
+    QFULL_RECOMPUTES = 4  # full queue-chain recomputes (kill-switch path)
+    UNUSED = 5            # span 3: zeroed tail, reserved
+
+
+STATS_WIDTH = 8
+
+
+class SIG_REQ:
+    """Mega-kernel per-signature request table (f32 [16, S]): identical-
+    request runs share one column, indexed by an i32 signature id per task."""
+
+    REQ = 0    # span 8: resource request rows, 0..r_dim-1 live
+    INIT = 8   # span 8: init (gate) request rows
+
+
+class JOB_STATE:
+    """XLA while-loop per-job carry columns (``ops/fused.py`` job_state,
+    f32 [J, 3 + 8]) — the host-loop twin of ``JOB_SCRATCH`` rows 0..2/8..15."""
+
+    CONSUMED = 0
+    ALLOCATED = 1
+    LEFT = 2
+    DRF = 3    # span 8: drf allocated, columns 3..3+r_dim-1 live
+
+
+class WINNER:
+    """Sharded two-level winner tuple lanes (``ops/sharded.py``): one packed
+    f32 candidate row per chip, all-gathered over ICI.  Lanes 2..3 are the
+    per-call-site ``extra`` slots — capacity/pod-room on the cohort path,
+    fit bits on the plain scan path (declared aliases below)."""
+
+    SCORE = 0
+    INDEX = 1
+    CAP = 2        # cohort capacity count (two_level_winner_with_capacity)
+    PODS = 3       # pod-count room of the winning node
+    QUEUE = 4      # selected job's queue id (two_level_winner_with_queue)
+    FIT_IDLE = 2   # alias of CAP: plain-scan extra lane 0 (idle-fit bit)
+    FIT_REL = 3    # alias of PODS: plain-scan extra lane 1 (releasing-fit bit)
+
+
+# -- registry metadata (ALL literal: consumed as data by the analysis pass) ---
+
+# Multi-row regions: {namespace: {name: span}}; undeclared names span 1 row.
+SPANS = {
+    "NODE_SCRATCH": {"IDLE": 8, "RELEASING": 8},
+    "JOB_SCRATCH": {"DRF": 8, "QUEUE_ALLOC": 8},
+    "STATS": {"UNUSED": 3},
+    "SIG_REQ": {"REQ": 8, "INIT": 8},
+    "JOB_STATE": {"DRF": 8},
+}
+
+# Intentional same-row aliases: {namespace: {alias_name: canonical_name}}.
+# Any other pair of names resolving to overlapping rows is a collision.
+ALIASES = {
+    "WINNER": {"FIT_IDLE": "CAP", "FIT_REL": "PODS"},
+}
+
+# Engine-flavor gate flags the kernel builders branch on.  The row-layout
+# pass tracks ``if <flag>:`` guards around buffer accesses against LIVE_WHEN.
+FLAVOR_FLAGS = (
+    "multi_queue", "use_qdelta", "queue_proportion", "overused_gate",
+    "has_releasing", "use_static", "batch_runs", "cross_batch",
+    "score_bound", "enforce_pod_count", "step_kernel", "cursor_mode",
+)
+
+# Liveness: the flags that must ALL be true for a row to exist on a flavor's
+# path.  Every code access must sit under (at least) these guards, and every
+# read must be covered by a write whose guards are a subset of the read's.
+LIVE_WHEN = {
+    "NODE_SCRATCH": {
+        "RELEASING": ("has_releasing",),
+    },
+    "JOB_SCRATCH": {
+        "QUEUE_ALLOC": ("multi_queue",),
+        "SHARE": ("use_qdelta", "queue_proportion"),
+        "OVERUSED": ("use_qdelta", "overused_gate"),
+    },
+}
+
+# Buffer bindings: {module path suffix: {local name: (namespace, axis)}}.
+# ``axis`` is the tuple position of the row index in a subscript (the mega
+# scratch indexes rows on axis 0; the kernel-side stats ref on axis 1).
+BUFFERS = {
+    "ops/megakernel.py": {
+        "ns": ("NODE_SCRATCH", 0),
+        "js": ("JOB_SCRATCH", 0),
+        "stats_ref": ("STATS", 1),
+        "sigr_ref": ("SIG_REQ", 0),
+    },
+    "ops/fused.py": {
+        "raw": ("STATS", 0),
+        "job_state": ("JOB_STATE", 1),
+        "sig_req": ("SIG_REQ", 0),
+    },
+    "ops/pallas_kernels.py": {
+        "ns_ref": ("STEP_NODE", 0),
+    },
+    "ops/sharded.py": {
+        "win": ("WINNER", 0),
+        "all_cand": ("WINNER", 1),
+    },
+}
+
+# Namespaces whose accesses get the guard-condition DATAFLOW check (VMEM
+# scratch written and read inside one kernel body); the others only get the
+# bare-literal and collision checks.
+DATAFLOW_NAMESPACES = ("NODE_SCRATCH", "JOB_SCRATCH")
+
+# Stats round-trip: {row name: (phases.note channel, artifact key)}.  The
+# pass verifies the key appears in ``run_stats`` (ops/fused.py), the channel
+# in a ``phases.note`` call (actions/allocate.py), and the channel again in
+# the bench cycle-detail plumbing (bench.py).
+STATS_KEYS = {
+    "STEPS": ("cohort", "steps"),
+    "COHORT_STEPS": ("cohort", "cohort_steps"),
+    "CHUNK_PLACED": ("cohort", "chunk_placed"),
+    "QDELTA_UPDATES": ("queue_chain", "delta_updates"),
+    "QFULL_RECOMPUTES": ("queue_chain", "full_recomputes"),
+}
+
+# Generated documentation tables: {doc path: (namespaces...)} — rendered by
+# scripts/gen_layout_doc.py between ``<!-- layout:NS:begin/end -->`` markers
+# and drift-checked by the row-layout pass.
+DOC_TABLES = {
+    "docs/QUEUE_DELTA.md": ("JOB_SCRATCH",),
+    "docs/DEVICE_ENGINE.md": ("NODE_SCRATCH", "JOB_SCRATCH", "STATS"),
+}
+
+# Row descriptions for the generated doc tables (same text as the class
+# comments above; kept literal so the renderer needs no runtime import).
+DOC_ROWS = {
+    "NODE_SCRATCH": {
+        "IDLE": "live idle vector, rows 0..r_dim-1 live (pad rows 0)",
+        "TASK_COUNT": "live per-node task count (pods-limit gate)",
+        "RELEASING": "live releasing ledger (pipelined placements; "
+                     "`has_releasing` sessions only)",
+    },
+    "JOB_SCRATCH": {
+        "CONSUMED": "tasks consumed from the job's pending run",
+        "ALLOCATED": "tasks actually placed (gang-ready arithmetic)",
+        "LEFT": "nonzero once a placement failed (pop ended)",
+        "DRF": "live drf allocated per job",
+        "QUEUE_ALLOC": "live `allocated` of each job's QUEUE, replicated "
+                       "per lane (`multi_queue` only)",
+        "SHARE": "maintained share of the lane's queue (delta path)",
+        "OVERUSED": "maintained overused flag of the lane's queue "
+                    "(delta path)",
+    },
+    "STATS": {
+        "STEPS": "loop steps taken",
+        "COHORT_STEPS": "steps where the cohort chunk path engaged",
+        "CHUNK_PLACED": "placements made by chunks >= 1 (multi-node wins)",
+        "QDELTA_UPDATES": "queue-share delta updates applied (delta chain "
+                          "engaged)",
+        "QFULL_RECOMPUTES": "full queue-chain recomputes (kill-switch path)",
+        "UNUSED": "zeroed tail, reserved",
+    },
+}
+
+
+class STEP_NODE:
+    """Placement-step kernel packed node state (``pallas_kernels.py``
+    ``ns_ref``, f32 [r8 + 8, n]): the idle block is r8 = padded r_dim rows,
+    so the task-count row floats at ``STEP_NODE.IDLE + r8`` — dynamic, not
+    declarable as a constant (the bare-literal rule still applies to the
+    static starts)."""
+
+    IDLE = 0
+
+
+# -- derived helpers (runtime convenience; NOT parsed by the pass) ------------
+
+def node_scratch_rows(has_releasing: bool) -> int:
+    """Sublane rows of the mega kernel's node scratch allocation."""
+    return NODE_SCRATCH.RELEASING + (8 if has_releasing else 0)
+
+
+def job_scratch_rows(multi_queue: bool, use_qdelta: bool) -> int:
+    """Sublane rows of the mega kernel's job scratch allocation (the delta
+    rows pad to the next 8-sublane tile)."""
+    if use_qdelta:
+        return -(-(JOB_SCRATCH.OVERUSED + 1) // 8) * 8
+    if multi_queue:
+        return JOB_SCRATCH.SHARE
+    return JOB_SCRATCH.QUEUE_ALLOC

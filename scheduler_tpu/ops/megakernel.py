@@ -53,7 +53,8 @@ DELTA-MAINTAINED QUEUE CHAIN (docs/QUEUE_DELTA.md): round 5's multi-queue
 mode re-derived the whole proportion chain — per-dim share ratios and the
 overused gate over every queue's replicated ledger rows — on EVERY while
 step, even though a step's placement moves exactly ONE queue's allocated
-vector.  The chain state is now delta-maintained: scratch rows 24/25 carry
+vector.  The chain state is now delta-maintained: the ``JOB_SCRATCH.SHARE``
+/ ``JOB_SCRATCH.OVERUSED`` scratch rows (named in ``ops/layout.py``) carry
 the live per-lane share and overused flag of each lane's queue, the queue
 pop reads them with two masked reduces, and each placement refreshes just
 the winning queue's lanes from the post-update ledger rows (read-after-write
@@ -92,6 +93,15 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from scheduler_tpu.ops.layout import (
+    JOB_SCRATCH as JROW,
+    NODE_SCRATCH as NROW,
+    SIG_REQ,
+    STATS,
+    STATS_WIDTH,
+    job_scratch_rows,
+    node_scratch_rows,
+)
 from scheduler_tpu.ops.pallas_kernels import queue_share_overused
 
 # Result encoding — MUST match ops/fused.py.
@@ -103,19 +113,9 @@ MAX_BATCH = 128
 
 _BIG_I32 = 2**31 - 1
 
-# Stats row layout (second kernel output, i32[8]):
-#   [0] loop steps taken
-#   [1] steps where the cohort chunk path engaged (chunk 1 ran)
-#   [2] placements made by chunks >= 1 (the multi-node cohort surplus)
-#   [3] queue-share delta updates applied (multi-queue delta path: one per
-#       placement whose queue ledger moved — proof the delta engaged)
-#   [4] full queue-chain recomputes (multi-queue with the delta kill-switch
-#       off: one per step — the pre-delta cost model, for A/B evidence)
-STATS_STEPS = 0
-STATS_COHORT_STEPS = 1
-STATS_CHUNK_PLACED = 2
-STATS_QDELTA_UPDATES = 3
-STATS_QFULL_RECOMPUTES = 4
+# Scratch and stats row layouts live in ops/layout.py (NODE_SCRATCH /
+# JOB_SCRATCH / STATS / SIG_REQ): one registry, machine-checked against this
+# kernel's reads and writes by schedlint's row-layout pass.
 
 
 def _lane_iota(shape):
@@ -263,28 +263,29 @@ def mega_allocate(
         lane_w = _lane_iota((1, 128))
 
         # State into VMEM scratch; result initialized to UNPLACED.
-        # Layout: rows [0..8) idle, row 8 task_count, rows [16..24) the
-        # RELEASING ledger (present only when the session has releasing
-        # resources — the scratch is 16 rows otherwise).  The job scratch
-        # gains rows [16..24) in multi-queue mode: the LIVE queue-allocated
-        # vector of each job's queue, REPLICATED per job lane — queue
-        # selection then needs no queue->job gather (dynamic lane indexing
-        # is unavailable), just lane-wise reduces, and the ledger update is
-        # one masked add over lanes sharing the selected job's queue id.
-        # With the DELTA-MAINTAINED chain (docs/QUEUE_DELTA.md) two more
-        # rows ride along: row 24 the live per-lane SHARE of the lane's
-        # queue (max over dims of allocated/deserved), row 25 its OVERUSED
-        # flag (1.0 = gated).  Selection then reads two maintained rows
-        # instead of re-deriving shares over all dims every step; each
+        # Layout (ops/layout.py): NROW.IDLE..IDLE+7 idle, NROW.TASK_COUNT,
+        # then the RELEASING ledger at NROW.RELEASING (present only when the
+        # session has releasing resources — the scratch is 16 rows
+        # otherwise).  The job scratch gains the JROW.QUEUE_ALLOC block in
+        # multi-queue mode: the LIVE queue-allocated vector of each job's
+        # queue, REPLICATED per job lane — queue selection then needs no
+        # queue->job gather (dynamic lane indexing is unavailable), just
+        # lane-wise reduces, and the ledger update is one masked add over
+        # lanes sharing the selected job's queue id.  With the
+        # DELTA-MAINTAINED chain (docs/QUEUE_DELTA.md) two more rows ride
+        # along: JROW.SHARE, the live per-lane SHARE of the lane's queue
+        # (max over dims of allocated/deserved), and JROW.OVERUSED, its
+        # overused flag (1.0 = gated).  Selection then reads two maintained
+        # rows instead of re-deriving shares over all dims every step; each
         # placement refreshes exactly the winning queue's lanes from the
         # post-update ledger rows (read-after-write => bit-identical).
-        ns[0:16, :] = ns0_ref[:, :]
+        ns[NROW.IDLE : NROW.RELEASING, :] = ns0_ref[:, :]
         if has_releasing:
-            ns[16:24, :] = rel0_ref[:, :]
-        js[0:8, :] = jnp.zeros((8, j_pad), jnp.float32)
-        js[8:16, :] = jdrf0_ref[:, :]
+            ns[NROW.RELEASING : NROW.RELEASING + 8, :] = rel0_ref[:, :]
+        js[JROW.CONSUMED : JROW.DRF, :] = jnp.zeros((JROW.DRF, j_pad), jnp.float32)
+        js[JROW.DRF : JROW.QUEUE_ALLOC, :] = jdrf0_ref[:, :]
         if multi_queue:
-            js[16:24, :] = jqa0_ref[:, :]
+            js[JROW.QUEUE_ALLOC : JROW.SHARE, :] = jqa0_ref[:, :]
         if use_qdelta:
             share0, over0 = queue_share_overused(
                 [jqd_ref[r : r + 1, :] for r in range(r_dim)],
@@ -292,9 +293,9 @@ def mega_allocate(
                 mins, r_dim,
             )
             if queue_proportion:
-                js[24:25, :] = share0
+                js[JROW.SHARE : JROW.SHARE + 1, :] = share0
             if overused_gate:
-                js[25:26, :] = over0.astype(jnp.float32)
+                js[JROW.OVERUSED : JROW.OVERUSED + 1, :] = over0.astype(jnp.float32)
         out_ref[:, :] = jnp.full((t_sub, 128), UNPLACED, jnp.int32)
 
         n_real = misc_ref[0, 0]
@@ -331,9 +332,9 @@ def mega_allocate(
 
             # ---- selection (branchless; matches fused.py cursor mode, or
             # its full queue+job chain in multi-queue mode) ----
-            cons_row = js[0:1, :]
-            alloc_row = js[1:2, :]
-            left_row = js[2:3, :]
+            cons_row = js[JROW.CONSUMED : JROW.CONSUMED + 1, :]
+            alloc_row = js[JROW.ALLOCATED : JROW.ALLOCATED + 1, :]
+            left_row = js[JROW.LEFT : JROW.LEFT + 1, :]
             elig = (left_row == 0.0) & (cons_row < jnum_f) & (jnum > 0)
             if multi_queue:
                 # Queue pop on the job lanes (fused.py select_job multi-queue
@@ -343,14 +344,17 @@ def mega_allocate(
                 cand = elig
                 if use_qdelta:
                     # Delta-maintained chain: the live share/overused values
-                    # sit in scratch rows 24/25 (refreshed per placement for
-                    # the ONE queue a placement touches), so the pop is two
-                    # masked reduces instead of ~O(R) full-width re-derives
-                    # per step (docs/QUEUE_DELTA.md op-count table).
+                    # sit in the SHARE/OVERUSED scratch rows (refreshed per
+                    # placement for the ONE queue a placement touches), so
+                    # the pop is two masked reduces instead of ~O(R)
+                    # full-width re-derives per step (docs/QUEUE_DELTA.md
+                    # op-count table).
                     if overused_gate:
-                        cand = cand & (js[25:26, :] < 0.5)
+                        cand = cand & (js[JROW.OVERUSED : JROW.OVERUSED + 1, :] < 0.5)
                     if queue_proportion:
-                        maskedq = jnp.where(cand, js[24:25, :], pos_inf)
+                        maskedq = jnp.where(
+                            cand, js[JROW.SHARE : JROW.SHARE + 1, :], pos_inf
+                        )
                         cand = cand & (maskedq == jnp.min(maskedq))
                 else:
                     if overused_gate:
@@ -358,7 +362,10 @@ def mega_allocate(
                         # d - a < eps, ALL dims (proportion.go:198-209).
                         over = None
                         for r in range(r_dim):
-                            le_r = (jqd_ref[r : r + 1, :] - js[16 + r : 16 + r + 1, :]) < mins[r]
+                            le_r = (
+                                jqd_ref[r : r + 1, :]
+                                - js[JROW.QUEUE_ALLOC + r : JROW.QUEUE_ALLOC + r + 1, :]
+                            ) < mins[r]
                             over = le_r if over is None else (over & le_r)
                         cand = cand & ~over
                     if queue_proportion:
@@ -368,7 +375,10 @@ def mega_allocate(
                         # full-width here as the A/B full-recompute path.
                         frac, _ = queue_share_overused(
                             [jqd_ref[r : r + 1, :] for r in range(r_dim)],
-                            [js[16 + r : 16 + r + 1, :] for r in range(r_dim)],
+                            [
+                                js[JROW.QUEUE_ALLOC + r : JROW.QUEUE_ALLOC + r + 1, :]
+                                for r in range(r_dim)
+                            ],
                             mins, r_dim,
                         )
                         maskedq = jnp.where(cand, frac, pos_inf)
@@ -388,7 +398,9 @@ def mega_allocate(
                     cand = cand & (masked == jnp.min(masked))
                 elif name == "drf":
                     frac = jnp.where(
-                        dmask_ref[:] > 0.0, js[8:16, :] / dsafe_ref[:], 0.0
+                        dmask_ref[:] > 0.0,
+                        js[JROW.DRF : JROW.QUEUE_ALLOC, :] / dsafe_ref[:],
+                        0.0,
                     )
                     key = jnp.max(frac, axis=0, keepdims=True)
                     masked = jnp.where(cand, key, pos_inf)
@@ -440,8 +452,12 @@ def mega_allocate(
             reqs = []
             initqs = []
             for r in range(r_dim):
-                reqs.append(read_f32(sigr_ref[r : r + 1, :], lane_s, sig))
-                initqs.append(read_f32(sigr_ref[8 + r : 8 + r + 1, :], lane_s, sig))
+                reqs.append(read_f32(
+                    sigr_ref[SIG_REQ.REQ + r : SIG_REQ.REQ + r + 1, :], lane_s, sig
+                ))
+                initqs.append(read_f32(
+                    sigr_ref[SIG_REQ.INIT + r : SIG_REQ.INIT + r + 1, :], lane_s, sig
+                ))
 
             single0 = num_v == 1
 
@@ -471,7 +487,7 @@ def mega_allocate(
                 # ---- fit + score + masked argmax (rows unrolled) ----
                 feas_idle = gate_v
                 for r in range(r_dim):
-                    idle_r = ns[r : r + 1, :]
+                    idle_r = ns[NROW.IDLE + r : NROW.IDLE + r + 1, :]
                     feas_idle = feas_idle & (
                         (initqs[r] < idle_r)
                         | (jnp.abs(idle_r - initqs[r]) < mins[r])
@@ -482,7 +498,7 @@ def mega_allocate(
                     # PIPELINE onto it.
                     feas_rel = gate_v
                     for r in range(r_dim):
-                        rel_r = ns[16 + r : 16 + r + 1, :]
+                        rel_r = ns[NROW.RELEASING + r : NROW.RELEASING + r + 1, :]
                         feas_rel = feas_rel & (
                             (initqs[r] < rel_r)
                             | (jnp.abs(rel_r - initqs[r]) < mins[r])
@@ -493,7 +509,9 @@ def mega_allocate(
                 if use_static:
                     feas = feas & (mrow > 0.0)
                 if enforce_pod_count:
-                    feas = feas & (ns[8:9, :] < plim_v)
+                    feas = feas & (
+                        ns[NROW.TASK_COUNT : NROW.TASK_COUNT + 1, :] < plim_v
+                    )
 
                 score = jnp.zeros((1, n), jnp.float32)
                 if lr_w or bal_w or bp_w:
@@ -501,8 +519,16 @@ def mega_allocate(
                     a_m = alloc_ref[mem_idx : mem_idx + 1, :]
                     safe_c = jnp.where(a_c > 0, a_c, 1.0)
                     safe_m = jnp.where(a_m > 0, a_m, 1.0)
-                    req_c = a_c - ns[cpu_idx : cpu_idx + 1, :] + reqs[cpu_idx]
-                    req_m = a_m - ns[mem_idx : mem_idx + 1, :] + reqs[mem_idx]
+                    req_c = (
+                        a_c
+                        - ns[NROW.IDLE + cpu_idx : NROW.IDLE + cpu_idx + 1, :]
+                        + reqs[cpu_idx]
+                    )
+                    req_m = (
+                        a_m
+                        - ns[NROW.IDLE + mem_idx : NROW.IDLE + mem_idx + 1, :]
+                        + reqs[mem_idx]
+                    )
                     if bp_w:
                         fc = jnp.clip(req_c / safe_c, 0.0, 1.0)
                         fm = jnp.clip(req_m / safe_m, 0.0, 1.0)
@@ -554,7 +580,10 @@ def mega_allocate(
                     hi0 = jnp.minimum(hi0, room)
                     if enforce_pod_count:
                         pl_best = read_f32(plim_v, lane_n, best)
-                        tc_best = read_f32(ns[8:9, :], lane_n, best)
+                        tc_best = read_f32(
+                            ns[NROW.TASK_COUNT : NROW.TASK_COUNT + 1, :],
+                            lane_n, best,
+                        )
                         hi0 = jnp.minimum(
                             hi0, (pl_best - tc_best).astype(jnp.int32)
                         )
@@ -562,7 +591,9 @@ def mega_allocate(
                     js_vec = _lane_iota((1, MAX_BATCH)) + 1
                     ok = jnp.ones((1, MAX_BATCH), dtype=bool)
                     for r in range(r_dim):
-                        idle_br = read_f32(ns[r : r + 1, :], lane_n, best)
+                        idle_br = read_f32(
+                            ns[NROW.IDLE + r : NROW.IDLE + r + 1, :], lane_n, best
+                        )
                         avail_r = idle_br - (js_vec - 1).astype(jnp.float32) * reqs[r]
                         ok = ok & (
                             (initqs[r] < avail_r)
@@ -586,10 +617,12 @@ def mega_allocate(
                             alloc_ref[mem_idx : mem_idx + 1, :], lane_n, best
                         )
                         idle_c_b = read_f32(
-                            ns[cpu_idx : cpu_idx + 1, :], lane_n, best
+                            ns[NROW.IDLE + cpu_idx : NROW.IDLE + cpu_idx + 1, :],
+                            lane_n, best,
                         )
                         idle_m_b = read_f32(
-                            ns[mem_idx : mem_idx + 1, :], lane_n, best
+                            ns[NROW.IDLE + mem_idx : NROW.IDLE + mem_idx + 1, :],
+                            lane_n, best,
                         )
                         jm1 = (js_vec - 1).astype(jnp.float32)
                         avail_c = idle_c_b - jm1 * reqs[cpu_idx]
@@ -637,15 +670,24 @@ def mega_allocate(
                 # ---- node ledger update (masked column add) ----
                 eq_n = (lane_n == best).astype(jnp.float32)
                 for r in range(r_dim):
-                    ns[r : r + 1, :] = ns[r : r + 1, :] - (reqs[r] * m_alloc) * eq_n
+                    ns[NROW.IDLE + r : NROW.IDLE + r + 1, :] = (
+                        ns[NROW.IDLE + r : NROW.IDLE + r + 1, :]
+                        - (reqs[r] * m_alloc) * eq_n
+                    )
+                tcount = ns[NROW.TASK_COUNT : NROW.TASK_COUNT + 1, :]
                 if has_releasing:
                     for r in range(r_dim):
-                        ns[16 + r : 16 + r + 1, :] = (
-                            ns[16 + r : 16 + r + 1, :] - (reqs[r] * pipe_f) * eq_n
+                        ns[NROW.RELEASING + r : NROW.RELEASING + r + 1, :] = (
+                            ns[NROW.RELEASING + r : NROW.RELEASING + r + 1, :]
+                            - (reqs[r] * pipe_f) * eq_n
                         )
-                    ns[8:9, :] = ns[8:9, :] + (m_alloc + pipe_f) * eq_n
+                    ns[NROW.TASK_COUNT : NROW.TASK_COUNT + 1, :] = (
+                        tcount + (m_alloc + pipe_f) * eq_n
+                    )
                 else:
-                    ns[8:9, :] = ns[8:9, :] + m_alloc * eq_n
+                    ns[NROW.TASK_COUNT : NROW.TASK_COUNT + 1, :] = (
+                        tcount + m_alloc * eq_n
+                    )
 
                 # ---- job ledger update (masked window add) ----
                 k = jnp.where(cross_active, m, 1)
@@ -657,13 +699,20 @@ def mega_allocate(
                 left_add = jnp.where(
                     cross_active, 0.0, failed.astype(jnp.float32)
                 )
-                js[0:1, :] = js[0:1, :] + cons_add * win
-                js[1:2, :] = js[1:2, :] + alloc_add * win
-                js[2:3, :] = js[2:3, :] + left_add * win
+                js[JROW.CONSUMED : JROW.CONSUMED + 1, :] = (
+                    js[JROW.CONSUMED : JROW.CONSUMED + 1, :] + cons_add * win
+                )
+                js[JROW.ALLOCATED : JROW.ALLOCATED + 1, :] = (
+                    js[JROW.ALLOCATED : JROW.ALLOCATED + 1, :] + alloc_add * win
+                )
+                js[JROW.LEFT : JROW.LEFT + 1, :] = (
+                    js[JROW.LEFT : JROW.LEFT + 1, :] + left_add * win
+                )
                 drf_scale = jnp.where(cross_active, 1.0, m_alloc + pipe_f)
                 for r in range(r_dim):
-                    js[8 + r : 8 + r + 1, :] = (
-                        js[8 + r : 8 + r + 1, :] + (reqs[r] * drf_scale) * win
+                    js[JROW.DRF + r : JROW.DRF + r + 1, :] = (
+                        js[JROW.DRF + r : JROW.DRF + r + 1, :]
+                        + (reqs[r] * drf_scale) * win
                     )
                 if multi_queue:
                     # proportion's allocate handler: the placement grows the
@@ -673,8 +722,9 @@ def mega_allocate(
                     qwin_b = jq_v == q_sel
                     qwin = qwin_b.astype(jnp.float32)
                     for r in range(r_dim):
-                        js[16 + r : 16 + r + 1, :] = (
-                            js[16 + r : 16 + r + 1, :] + (reqs[r] * drf_scale) * qwin
+                        js[JROW.QUEUE_ALLOC + r : JROW.QUEUE_ALLOC + r + 1, :] = (
+                            js[JROW.QUEUE_ALLOC + r : JROW.QUEUE_ALLOC + r + 1, :]
+                            + (reqs[r] * drf_scale) * qwin
                         )
                     if use_qdelta:
                         # Delta refresh of the maintained share/overused rows
@@ -688,7 +738,10 @@ def mega_allocate(
                         # masked writes instead of O(R) full-width derives
                         # at the next selection.
                         a_new = [
-                            read_f32(js[16 + r : 16 + r + 1, :], lane_j, jb)
+                            read_f32(
+                                js[JROW.QUEUE_ALLOC + r : JROW.QUEUE_ALLOC + r + 1, :],
+                                lane_j, jb,
+                            )
                             for r in range(r_dim)
                         ]
                         d_q = [
@@ -699,13 +752,14 @@ def mega_allocate(
                             d_q, a_new, mins, r_dim
                         )
                         if queue_proportion:
-                            js[24:25, :] = jnp.where(
-                                qwin_b, share_new, js[24:25, :]
+                            js[JROW.SHARE : JROW.SHARE + 1, :] = jnp.where(
+                                qwin_b, share_new,
+                                js[JROW.SHARE : JROW.SHARE + 1, :],
                             )
                         if overused_gate:
-                            js[25:26, :] = jnp.where(
+                            js[JROW.OVERUSED : JROW.OVERUSED + 1, :] = jnp.where(
                                 qwin_b, over_new.astype(jnp.float32),
-                                js[25:26, :],
+                                js[JROW.OVERUSED : JROW.OVERUSED + 1, :],
                             )
                         # Evidence: count placements whose queue ledger
                         # actually moved (a no-op step writes back unchanged
@@ -827,25 +881,25 @@ def mega_allocate(
             (jnp.int32(-1), jnp.int32(0), jnp.int32(0), jnp.int32(0),
              jnp.int32(0), jnp.int32(0), jnp.int32(0)),
         )
-        stats_ref[0, STATS_STEPS] = final[3]
-        stats_ref[0, STATS_COHORT_STEPS] = final[4]
-        stats_ref[0, STATS_CHUNK_PLACED] = final[5]
-        stats_ref[0, STATS_QDELTA_UPDATES] = final[6]
+        stats_ref[0, STATS.STEPS] = final[3]
+        stats_ref[0, STATS.COHORT_STEPS] = final[4]
+        stats_ref[0, STATS.CHUNK_PLACED] = final[5]
+        stats_ref[0, STATS.QDELTA_UPDATES] = final[6]
         # Full-recompute count: on the kill-switch path every step re-derives
         # the whole share chain, so the count IS the step count; zero when the
         # delta path (or a single-queue program) traced instead.
         if multi_queue and (queue_proportion or overused_gate) and not use_qdelta:
-            stats_ref[0, STATS_QFULL_RECOMPUTES] = final[3]
+            stats_ref[0, STATS.QFULL_RECOMPUTES] = final[3]
         else:
-            stats_ref[0, STATS_QFULL_RECOMPUTES] = jnp.int32(0)
-        for i in range(5, 8):
+            stats_ref[0, STATS.QFULL_RECOMPUTES] = jnp.int32(0)
+        for i in range(STATS.UNUSED, STATS_WIDTH):
             stats_ref[0, i] = jnp.int32(0)
 
     call = pl.pallas_call(
         kernel,
         out_shape=(
             jax.ShapeDtypeStruct((t_sub, 128), jnp.int32),
-            jax.ShapeDtypeStruct((1, 8), jnp.int32),
+            jax.ShapeDtypeStruct((1, STATS_WIDTH), jnp.int32),
         ),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(23)
@@ -858,12 +912,13 @@ def mega_allocate(
         ),
         scratch_shapes=[
             # idle+count rows, plus the releasing ledger rows when live.
-            pltpu.VMEM((24 if has_releasing else 16, n), jnp.float32),
+            pltpu.VMEM((node_scratch_rows(has_releasing), n), jnp.float32),
             # js: cons/alloc/left + drf, plus the per-lane queue-allocated
             # replica rows in multi-queue mode, plus the delta-maintained
-            # share/overused rows (24/25; padded to the 8-sublane tile).
+            # share/overused rows (padded to the 8-sublane tile) — all sized
+            # from the layout registry (ops/layout.py).
             pltpu.VMEM(
-                (32 if use_qdelta else (24 if multi_queue else 16), j_pad),
+                (job_scratch_rows(multi_queue, use_qdelta), j_pad),
                 jnp.float32,
             ),
         ],
@@ -925,9 +980,9 @@ def build_node_ledgers(idle, task_count, releasing, nb: int, r: int,
     build (``FusedAllocator._prepare_mega``) and the cross-cycle delta
     refresh (``ops/engine_cache.py`` hit path), so the two can never drift."""
     ns0 = (
-        jnp.zeros((16, nb), jnp.float32)
-        .at[:r].set(idle.T)
-        .at[8].set(task_count.astype(jnp.float32))
+        jnp.zeros((NROW.RELEASING, nb), jnp.float32)
+        .at[NROW.IDLE : NROW.IDLE + r].set(idle.T)
+        .at[NROW.TASK_COUNT].set(task_count.astype(jnp.float32))
     )
     rel_t = (
         jnp.zeros((8, nb), jnp.float32).at[:r].set(releasing.T)

@@ -41,6 +41,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from scheduler_tpu.ops.layout import STEP_NODE
+
 TILE_T = 128
 TILE_N = 128
 
@@ -155,7 +157,9 @@ def make_placement_step(
     def kernel(ns_ref, alloc_ref, smask_ref, sscore_ref, gate_ref, plim_ref,
                initq_ref, req_ref, mins_ref, best_ref, score_ref, cap_ref,
                pods_ref):
-        idle = ns_ref[0:r8, :]
+        # Packed layout (ops/layout.py STEP_NODE): the idle block spans the
+        # first r8 rows, so the task-count row floats at IDLE + r8.
+        idle = ns_ref[STEP_NODE.IDLE : r8, :]
         initq = initq_ref[:]
         minsv = mins_ref[:]
         fit = (initq < idle) | (jnp.abs(idle - initq) < minsv)

@@ -41,6 +41,7 @@ except ImportError:  # pre-0.6 jax: experimental module, check_rep kwarg
         )
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from scheduler_tpu.ops.layout import WINNER
 from scheduler_tpu.ops.predicates import fit_mask, selector_mask
 from scheduler_tpu.ops.scoring import dynamic_score
 
@@ -55,13 +56,15 @@ def two_level_winner(lscore, global_idx, extra=(), axis=NODE_AXIS):
     ties break to the lowest shard — combined with each shard's lowest-local-
     row argmax that is the lowest global index, bit-matching the single-chip
     kernel's deterministic argmax.  Returns the winner's packed row."""
+    # Lane order is the WINNER layout (ops/layout.py): SCORE, INDEX, then
+    # the per-call-site extra lanes (capacity/pod-room or the fit bits).
     cand = jnp.stack([
         lscore,
         global_idx.astype(jnp.float32),
         *extra,
     ])
     all_cand = jax.lax.all_gather(cand, axis)
-    return all_cand[jnp.argmax(all_cand[:, 0])]
+    return all_cand[jnp.argmax(all_cand[:, WINNER.SCORE])]
 
 
 def two_level_winner_with_capacity(lscore, global_idx, cap, pod_room,
@@ -106,11 +109,11 @@ def two_level_winner_with_queue(lscore, global_idx, cap, pod_room, queue_id,
         lscore, global_idx, extra=(cap, pod_room, queue_id), axis=axis
     )
     return (
-        win[0],
-        win[1].astype(jnp.int32),
-        win[2].astype(jnp.int32),
-        win[3].astype(jnp.int32),
-        win[4].astype(jnp.int32),
+        win[WINNER.SCORE],
+        win[WINNER.INDEX].astype(jnp.int32),
+        win[WINNER.CAP].astype(jnp.int32),
+        win[WINNER.PODS].astype(jnp.int32),
+        win[WINNER.QUEUE].astype(jnp.int32),
     )
 
 
@@ -185,10 +188,10 @@ def sharded_place_scan(
                 extra=(fit_idle[lbest].astype(jnp.float32),
                        fit_rel[lbest].astype(jnp.float32)),
             )
-            any_feasible = win[0] > neg_inf
-            g_best = win[1].astype(jnp.int32)
-            fit_i_best = win[2] > 0
-            fit_r_best = win[3] > 0
+            any_feasible = win[WINNER.SCORE] > neg_inf
+            g_best = win[WINNER.INDEX].astype(jnp.int32)
+            fit_i_best = win[WINNER.FIT_IDLE] > 0
+            fit_r_best = win[WINNER.FIT_REL] > 0
 
             active = (~stopped) & is_valid
             placed = active & any_feasible

@@ -47,7 +47,13 @@ def _cap_bytes() -> int:
 
 class TransferCache:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        from scheduler_tpu.utils import tsan
+
+        # Instrumented for the lockset sanitizer (SCHEDULER_TPU_TSAN=1):
+        # uploads arrive from the scheduler loop AND the io-worker pool.
+        tag = tsan.obj_tag(self)
+        self._lock = tsan.wrap_lock(threading.Lock(), f"{tag}._lock")
+        self._tsan_pool = f"{tag}.pool"
         self._entries: "OrderedDict[Tuple, object]" = OrderedDict()
         self._bytes = 0
         self.hits = 0
@@ -71,8 +77,11 @@ class TransferCache:
         digest = hashlib.blake2b(memoryview(host).cast("B"), digest_size=16).digest()
         # Sharding objects are hashable and eq-compare by mesh devices + spec,
         # so distinct device sets can never alias (str() would drop the ids).
+        from scheduler_tpu.utils import tsan
+
         key = (host.dtype.str, host.shape, digest, sharding)
         with self._lock:
+            tsan.access(self._tsan_pool)
             dev = self._entries.get(key)
             if dev is not None:
                 self._entries.move_to_end(key)
@@ -81,6 +90,7 @@ class TransferCache:
                 return dev
         dev = jax.device_put(host, sharding)
         with self._lock:
+            tsan.access(self._tsan_pool)
             self.misses += 1
             self.miss_bytes += nbytes
             # Re-check: a concurrent miss on the same content may have landed
@@ -96,7 +106,10 @@ class TransferCache:
         return dev
 
     def stats(self) -> dict:
+        from scheduler_tpu.utils import tsan
+
         with self._lock:
+            tsan.access(self._tsan_pool, write=False)
             return {
                 "hits": self.hits,
                 "misses": self.misses,
@@ -108,7 +121,10 @@ class TransferCache:
 
     def reset_counters(self) -> dict:
         """Snapshot and zero the hit/miss counters (per-cycle accounting)."""
+        from scheduler_tpu.utils import tsan
+
         with self._lock:
+            tsan.access(self._tsan_pool)
             snap = {
                 "hits": self.hits,
                 "misses": self.misses,
@@ -120,7 +136,10 @@ class TransferCache:
             return snap
 
     def clear(self) -> None:
+        from scheduler_tpu.utils import tsan
+
         with self._lock:
+            tsan.access(self._tsan_pool)
             self._entries.clear()
             self._bytes = 0
 

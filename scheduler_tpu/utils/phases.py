@@ -9,7 +9,11 @@ split so the artifact can defend itself (VERDICT r4 weak #1).
 Passive by default: ``phase()`` is a no-op context manager until a
 measurement protocol calls ``begin()``, so the production scheduler loop
 pays two ``None`` checks per action, nothing more.  Not thread-safe by
-design — measurement protocols are single-threaded by the one-core rule.
+design — measurement protocols are single-threaded by the one-core rule,
+and the lockset sanitizer (``SCHEDULER_TPU_TSAN=1``, ``utils/tsan.py``)
+turns that prose rule into a CHECKED one: every buffer mutation reports an
+access, so a second thread noting into a live cycle is a reported race
+instead of a silently corrupted artifact.
 """
 
 from __future__ import annotations
@@ -18,13 +22,18 @@ import time
 from contextlib import contextmanager
 from typing import Dict, Optional
 
+from scheduler_tpu.utils import tsan
+
 _current: Optional[Dict[str, float]] = None
 _notes: Optional[Dict[str, object]] = None
+
+_TSAN_FIELD = "phases.cycle_buffers"
 
 
 def begin() -> None:
     """Start collecting phases for one cycle."""
     global _current, _notes
+    tsan.access(_TSAN_FIELD)
     _current = {}
     _notes = {}
 
@@ -32,6 +41,7 @@ def begin() -> None:
 def end() -> Dict[str, float]:
     """Stop collecting; return {phase: seconds} accumulated since begin()."""
     global _current, _notes
+    tsan.access(_TSAN_FIELD)
     out, _current = _current, None
     _notes = None
     return out or {}
@@ -42,6 +52,7 @@ def take_notes() -> Dict[str, object]:
     hit/miss/rebuild outcome).  Read BEFORE ``end()`` — kept separate from the
     {phase: seconds} map so artifact consumers can keep rounding every phase
     value as a float."""
+    tsan.access(_TSAN_FIELD, write=False)
     return dict(_notes) if _notes is not None else {}
 
 
@@ -51,6 +62,7 @@ def active() -> bool:
 
 def add(name: str, secs: float) -> None:
     if _current is not None:
+        tsan.access(_TSAN_FIELD)
         _current[name] = _current.get(name, 0.0) + secs
 
 
@@ -58,6 +70,7 @@ def note(name: str, value) -> None:
     """Attach a non-time annotation to the cycle being measured (no-op when
     no measurement protocol is active, like ``add``)."""
     if _notes is not None:
+        tsan.access(_TSAN_FIELD)
         _notes[name] = value
 
 

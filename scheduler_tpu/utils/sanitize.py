@@ -67,11 +67,16 @@ def disarm() -> None:
 
 
 def is_violation(err: BaseException) -> bool:
-    """Is this exception a sanitizer finding — a transfer-guard trip or a
-    debug-NaN FloatingPointError?  Engine fallback paths (mega -> XLA) must
-    RE-RAISE these instead of swallowing them as backend failures — a
-    sanitizer that degrades to a slower-but-working path has found a bug
-    and then hidden it."""
+    """Is this exception a sanitizer finding — a transfer-guard trip, a
+    debug-NaN FloatingPointError, or a lockset race from the tsan half
+    (``SCHEDULER_TPU_TSAN=1``, utils/tsan.py)?  Engine fallback paths
+    (mega -> XLA) must RE-RAISE these instead of swallowing them as backend
+    failures — a sanitizer that degrades to a slower-but-working path has
+    found a bug and then hidden it."""
+    from scheduler_tpu.utils import tsan
+
+    if tsan.enabled() and isinstance(err, tsan.TsanRaceError):
+        return True
     if not enabled():
         return False
     if isinstance(err, FloatingPointError):

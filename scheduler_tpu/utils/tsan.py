@@ -1,0 +1,206 @@
+"""``SCHEDULER_TPU_TSAN=1``: Eraser-style lockset race sanitizer.
+
+schedlint's static ``lock-order`` pass proves the acquisition graph stays
+acyclic, but it can only model cross-thread discipline, never witness it:
+the async pipelined cycle runs real threads (the scheduler loop, the cache's
+io-worker pool, the connector's watch thread), and the invariant that every
+shared field is consistently guarded by SOME lock is dynamic.  This module
+is the classic Eraser lockset algorithm (Savage et al. 1997) over the
+repo's known shared-state hot spots:
+
+* the engine cache's resident-entry table and counters
+  (``ops/engine_cache.py``),
+* the transfer cache's device-buffer pool (``ops/transfer_cache.py``),
+* the per-cycle phase/note buffers (``utils/phases.py`` — unlocked BY
+  DESIGN under the one-core measurement rule; the sanitizer is what turns
+  that prose rule into a checked one),
+* the connector's shared ``TokenBucket`` (``connector/client.py``).
+
+Mechanics: each instrumented lock is created through ``wrap_lock`` (the
+locks the static pass discovers — ``lock_order.py`` sees through the
+wrapper), which records acquire/release in a per-thread held set.  Each
+``access(field, write=)`` call drives the per-field state machine
+virgin → exclusive(first thread) → shared / shared-modified; on every
+access by a second thread the field's candidate lockset intersects with
+the locks currently held, and a field that goes LOCKSET-EMPTY in a
+modified state is a race: recorded in ``races()`` and raised as
+``TsanRaceError`` at the offending access — which ``sanitize.is_violation``
+recognizes, so the mega→XLA fallback RE-RAISES it instead of swallowing it
+as a backend failure (same contract as transfer-guard trips).
+
+Zero cost when off: ``access`` and the lock proxy check one module flag.
+Diagnostic mode like ``SCHEDULER_TPU_SANITIZE``; ``bench.py`` arms it from
+the environment and records ``detail.tsan`` in the artifact.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, FrozenSet, List, Optional, Set
+
+_VIRGIN, _EXCLUSIVE, _SHARED, _SHARED_MOD = range(4)
+
+_armed = False
+_mu = threading.Lock()  # guards the field table and race log
+_tls = threading.local()  # .held: per-thread set of held instrumented locks
+_fields: Dict[str, "_FieldState"] = {}
+_races: List[str] = []
+_reported: Set[str] = set()
+
+
+class TsanRaceError(RuntimeError):
+    """A shared field's candidate lockset went empty under modification."""
+
+
+class _FieldState:
+    __slots__ = ("state", "owner", "lockset")
+
+    def __init__(self, owner: int) -> None:
+        self.state = _EXCLUSIVE
+        self.owner = owner
+        self.lockset: Optional[Set[str]] = None
+
+
+def enabled() -> bool:
+    from scheduler_tpu.utils.envflags import env_bool
+
+    return env_bool("SCHEDULER_TPU_TSAN", False)
+
+
+def arm() -> bool:
+    """Arm the lockset sanitizer when the flag is set (idempotent).
+    Returns whether tsan mode is on."""
+    global _armed
+    if not enabled():
+        return False
+    if not _armed:
+        reset()
+        _armed = True
+    return True
+
+
+def disarm() -> None:
+    """Undo ``arm()`` and drop all field state (tests must not leak)."""
+    global _armed
+    _armed = False
+    reset()
+
+
+def reset() -> None:
+    """Forget every field's lockset history and recorded race."""
+    with _mu:
+        _fields.clear()
+        _races.clear()
+        _reported.clear()
+
+
+def races() -> List[str]:
+    with _mu:
+        return list(_races)
+
+
+def obj_tag(obj: object) -> str:
+    """Per-instance suffix for lock/field names: two instances of one class
+    have DIFFERENT locks, and sharing a name would let thread A's hold of
+    instance-1's lock vouch for thread B's access under instance-2's."""
+    return f"{type(obj).__name__}#{id(obj):x}"
+
+
+def _held() -> Dict[str, int]:
+    # Name -> hold count, so nested acquires of a wrapped RLock stay held
+    # until the LAST release.  (A dict literal, not ``set()``: lock-order
+    # resolves plain-name calls to same-named repo functions, which would
+    # manufacture call-through edges out of every instrumented hold.)
+    s = getattr(_tls, "held", None)
+    if s is None:
+        s = _tls.held = {}
+    return s
+
+
+class TsanLock:
+    """Lock proxy that records acquire/release in the per-thread held set.
+    Wraps (does not subclass) so the same proxy covers Lock and RLock."""
+
+    __slots__ = ("_lock", "name")
+
+    def __init__(self, lock, name: str) -> None:
+        self._lock = lock
+        self.name = name
+
+    def acquire(self, *args, **kwargs) -> bool:
+        # The proxy IS the with-support: __enter__/__exit__ pair this
+        # forward with release, so the bare-acquire rule does not apply.
+        got = self._lock.acquire(*args, **kwargs)  # schedlint: ignore[lock-order]
+        if got and _armed:
+            held = _held()
+            held[self.name] = held.get(self.name, 0) + 1
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        if _armed:
+            held = _held()
+            n = held.get(self.name, 0) - 1
+            if n > 0:
+                held[self.name] = n
+            else:
+                held.pop(self.name, None)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "TsanLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def wrap_lock(lock, name: str) -> TsanLock:
+    """Instrument a threading lock.  Call at CREATION time —
+    ``self._lock = tsan.wrap_lock(threading.Lock(), ...)`` — so the static
+    ``lock-order`` pass keeps discovering the underlying constructor."""
+    return TsanLock(lock, name)
+
+
+def access(field: str, write: bool = True) -> None:
+    """Drive the Eraser state machine for one shared-field access.
+
+    Raises ``TsanRaceError`` (once per field) when the field's candidate
+    lockset goes empty while the field has been modified by more than one
+    thread's history — i.e. no single lock consistently guarded it.
+    """
+    if not _armed:
+        return
+    held: FrozenSet[str] = frozenset(_held())
+    me = threading.get_ident()
+    with _mu:
+        st = _fields.get(field)
+        if st is None:
+            _fields[field] = _FieldState(me)
+            return
+        if st.state == _EXCLUSIVE and st.owner == me:
+            return  # still single-threaded: no lockset discipline required
+        if st.state == _EXCLUSIVE:
+            # Second thread: lockset initializes to what IT holds now.
+            # (Set comprehension, not set(): lock-order resolves plain-name
+            # calls to repo functions by bare name, and a builtin call here
+            # would manufacture call-through edges out of the table lock.)
+            st.lockset = {name for name in held}
+            st.state = _SHARED_MOD if write else _SHARED
+        else:
+            assert st.lockset is not None
+            st.lockset &= held
+            if write:
+                st.state = _SHARED_MOD
+        if st.state == _SHARED_MOD and not st.lockset and field not in _reported:
+            _reported.add(field)
+            msg = (
+                f"data race on '{field}': candidate lockset went empty in "
+                f"thread {threading.current_thread().name} "
+                f"(held: {sorted(held) or 'nothing'}) — no single lock "
+                "consistently guards this field across threads"
+            )
+            _races.append(msg)
+            raise TsanRaceError(msg)

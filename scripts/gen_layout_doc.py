@@ -1,0 +1,77 @@
+"""Regenerate the scratch/stats row tables in the docs from the layout
+registry (``scheduler_tpu/ops/layout.py``).
+
+The registry's ``DOC_TABLES`` names which namespaces render into which doc;
+each table lives between ``<!-- layout:NS:begin … -->`` / ``<!-- layout:NS:end -->``
+markers.  The rendering is the ONE in ``analysis/row_layout.py`` — the same
+function schedlint's ``row-layout`` pass uses for the drift check, so a doc
+this script wrote can never fail the gate.
+
+Usage:
+  python scripts/gen_layout_doc.py          # rewrite the tables in place
+  python scripts/gen_layout_doc.py --check  # exit 1 if any table is stale
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+LAYOUT_PATH = ROOT / "scheduler_tpu" / "ops" / "layout.py"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--check", action="store_true")
+    args = ap.parse_args()
+
+    from scheduler_tpu.analysis.row_layout import (
+        marker_lines, parse_registry_source, render_table,
+    )
+
+    reg = parse_registry_source(LAYOUT_PATH.read_text())
+    stale = 0
+    missing = 0
+    for rel, namespaces in sorted(reg.doc_tables.items()):
+        doc = ROOT / rel
+        lines = doc.read_text().splitlines()
+        for ns in namespaces:
+            begin, end = marker_lines(ns)
+            table = render_table(reg, ns)
+            try:
+                b = lines.index(begin)
+                e = lines.index(end, b)
+            except ValueError:
+                print(f"{rel}: no {ns} markers — add\n  {begin}\n  {end}")
+                missing += 1
+                continue
+            # Same per-line strip as the row-layout pass's drift check, so
+            # the two gates can never disagree on one tree.
+            if [ln.strip() for ln in lines[b + 1 : e] if ln.strip()] != table:
+                stale += 1
+                if args.check:
+                    print(f"{rel}: {ns} table is stale")
+                else:
+                    lines[b + 1 : e] = table
+                    print(f"{rel}: {ns} table regenerated")
+        if not args.check:
+            doc.write_text("\n".join(lines) + "\n")
+    if missing:
+        # Markers cannot be invented in place — fail BOTH modes so a silent
+        # "regenerated" never hides a table that was never inserted.
+        print(f"gen_layout_doc: {missing} table(s) without markers")
+        return 1
+    if args.check and stale:
+        print(f"gen_layout_doc: {stale} stale table(s); run without --check")
+        return 1
+    if args.check:
+        print("gen_layout_doc: all tables current")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,122 +1,35 @@
-"""Minimal lint gate (the reference's ``make verify`` gofmt/golint slot).
+"""DEPRECATED shim: the hygiene lint now lives inside schedlint.
 
-Stdlib-only (no linters in the image): AST-driven unused-import detection
-plus whitespace hygiene (tabs in indentation, trailing whitespace).  Exits
-nonzero with file:line diagnostics.
-
-Usage: python scripts/lint.py [paths...]   (default: the package + tests)
+The whitespace + unused-import checks this script used to implement are
+schedlint's ``hygiene`` pass (``scheduler_tpu/analysis/hygiene.py``), so
+the repo has ONE analysis CLI and ONE JSON report.  This shim keeps
+``python scripts/lint.py`` working by delegating to
+``scripts/schedlint.py --rules hygiene``; positional path arguments (the
+old interface) are ignored — the pass always runs over the standard
+analyzed surface.
 """
 
 from __future__ import annotations
 
-import ast
+import subprocess
 import sys
 from pathlib import Path
 
-DEFAULT_PATHS = [
-    "scheduler_tpu", "tests", "scripts", "bench.py", "__graft_entry__.py",
-]
-
-
-def imported_names(tree: ast.AST):
-    """(lineno, bound-name, is_star) for every import binding."""
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                name = alias.asname or alias.name.split(".")[0]
-                yield node.lineno, name, False
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "__future__":
-                continue
-            for alias in node.names:
-                if alias.name == "*":
-                    yield node.lineno, "*", True
-                else:
-                    yield node.lineno, alias.asname or alias.name, False
-
-
-def used_names(tree: ast.AST) -> set:
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            root = node
-            while isinstance(root, ast.Attribute):
-                root = root.value
-            if isinstance(root, ast.Name):
-                used.add(root.id)
-    return used
-
-
-def check_file(path: Path) -> list:
-    problems = []
-    text = path.read_text()
-    lines = text.splitlines()
-    for i, line in enumerate(lines, 1):
-        if line != line.rstrip():
-            problems.append(f"{path}:{i}: trailing whitespace")
-        stripped_len = len(line) - len(line.lstrip(" \t"))
-        if "\t" in line[:stripped_len]:
-            problems.append(f"{path}:{i}: tab in indentation")
-    try:
-        tree = ast.parse(text)
-    except SyntaxError as err:
-        return [f"{path}:{err.lineno}: syntax error: {err.msg}"]
-    if path.name == "__init__.py":
-        return problems  # re-export barrels import without local use
-    # "# noqa" on the import line suppresses (registration-by-import pattern).
-    used = used_names(tree)
-    exported = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Assign):
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name) and tgt.id == "__all__":
-                    if isinstance(node.value, (ast.List, ast.Tuple)):
-                        exported |= {
-                            getattr(e, "value", None) for e in node.value.elts
-                        }
-    import re
-
-    for lineno, name, star in imported_names(tree):
-        if star:
-            continue
-        if name in used or name in exported:
-            continue
-        src_line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
-        if "noqa" in src_line:
-            continue
-        # String-annotation / docstring-reference fallback: the name counts
-        # as used if the word appears anywhere beyond its own import line
-        # (quoted forward refs under TYPE_CHECKING are Constants, not Names).
-        word = re.compile(rf"\b{re.escape(name)}\b")
-        uses = sum(
-            len(word.findall(line))
-            for j, line in enumerate(lines, 1)
-            if j != lineno
-        )
-        if uses > 0:
-            continue
-        problems.append(f"{path}:{lineno}: unused import '{name}'")
-    return problems
-
 
 def main() -> int:
-    targets = sys.argv[1:] or DEFAULT_PATHS
-    files = []
-    for t in targets:
-        p = Path(t)
-        if p.is_dir():
-            files.extend(sorted(p.rglob("*.py")))
-        elif p.suffix == ".py":
-            files.append(p)
-    problems = []
-    for f in files:
-        problems.extend(check_file(f))
-    for p in problems:
-        print(p)
-    print(f"lint: {len(files)} files, {len(problems)} problem(s)")
-    return 1 if problems else 0
+    args = ["--rules", "hygiene"]
+    if "--json" in sys.argv[1:]:
+        args.append("--json")
+    ignored = [a for a in sys.argv[1:] if a != "--json"]
+    if ignored:
+        print(
+            f"lint.py shim: ignoring {ignored} — hygiene runs over the "
+            "standard schedlint surface",
+            file=sys.stderr,
+        )
+    return subprocess.call(
+        [sys.executable, str(Path(__file__).with_name("schedlint.py")), *args]
+    )
 
 
 if __name__ == "__main__":

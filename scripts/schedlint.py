@@ -1,7 +1,7 @@
 """schedlint CLI: the repo-native static-analysis gate (``make lint``).
 
-Runs the five engine/thread invariant passes (docs/STATIC_ANALYSIS.md) over
-the tree and exits non-zero on findings:
+Runs the engine/thread invariant passes (docs/STATIC_ANALYSIS.md) over the
+tree and exits non-zero on findings:
 
   env-drift   ops/ flag reads must be in engine_cache._ENV_KEYS
   raw-env     SCHEDULER_TPU_* reads go through utils/envflags
@@ -9,14 +9,26 @@ the tree and exits non-zero on findings:
   donation    donated buffers are never read after dispatch
   lock-order  lock acquisition stays acyclic; no bare .acquire()
   doc-refs    docs only cite artifacts that exist in-tree
+  row-layout  scratch/stats rows go through ops/layout.py: no bare row
+              literals, no collisions, per-flavor read-implies-write
+              dataflow, stats evidence round-trips to the bench artifact
+  hygiene     whitespace + unused imports (the former scripts/lint.py)
 
 Usage: python scripts/schedlint.py [--rules r1,r2] [--list-rules] [--json]
+                                   [--changed]
+
+``--changed`` restricts analysis to files touched since HEAD (``git diff``
++ untracked) for a fast pre-commit run.  Cross-module passes see the few
+anchor modules they need (the env-key and row-layout registries) but
+findings are reported for changed files only — the full gate is the
+authority (``make lint`` / CI).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 import time
 from pathlib import Path
@@ -30,12 +42,59 @@ sys.path.insert(0, str(ROOT))
 PY_TARGETS = ("scheduler_tpu", "scripts", "tests", "bench.py", "__graft_entry__.py")
 DOC_TARGETS = ("README.md", "docs/*.md")
 
+# Registry modules cross-module passes read even when unchanged (env-drift's
+# _ENV_KEYS, row-layout's ops/layout.py); findings on them are still
+# filtered to the changed set.
+CHANGED_ANCHORS = (
+    "scheduler_tpu/ops/engine_cache.py",
+    "scheduler_tpu/ops/layout.py",
+)
+
+
+def _git_changed() -> "list[str] | None":
+    """Paths touched since HEAD (tracked diffs + untracked), or None when
+    git is unavailable."""
+    out: list[str] = []
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            res = subprocess.run(
+                cmd, cwd=ROOT, capture_output=True, text=True, timeout=30
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
+        if res.returncode != 0:
+            return None
+        out.extend(line for line in res.stdout.splitlines() if line)
+    return sorted(set(out))
+
+
+def _in_scope_py(rel: str) -> bool:
+    if not rel.endswith(".py"):
+        return False
+    return any(
+        rel == t or rel.startswith(t + "/")
+        for t in PY_TARGETS
+    )
+
+
+def _in_scope_doc(rel: str) -> bool:
+    return rel == "README.md" or (
+        rel.startswith("docs/") and rel.endswith(".md") and "/" not in rel[5:]
+    )
+
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--rules", help="comma-separated subset of passes to run")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument(
+        "--changed", action="store_true",
+        help="analyze only files changed since HEAD (fast pre-commit mode)",
+    )
     args = ap.parse_args()
 
     from scheduler_tpu.analysis import Repo, pass_names, run_passes
@@ -46,9 +105,19 @@ def main() -> int:
         return 0
 
     t0 = time.perf_counter()
-    repo = Repo.from_root(ROOT, PY_TARGETS, DOC_TARGETS)
+    changed = _git_changed() if args.changed else None
+    if args.changed and changed is not None:
+        py = [p for p in changed if _in_scope_py(p)]
+        py += [a for a in CHANGED_ANCHORS if a not in py]
+        docs = [p for p in changed if _in_scope_doc(p)]
+        repo = Repo.from_root(ROOT, tuple(py), tuple(docs))
+    else:
+        repo = Repo.from_root(ROOT, PY_TARGETS, DOC_TARGETS)
     rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
     findings = run_passes(repo, rules)
+    if args.changed and changed is not None:
+        keep = set(changed)
+        findings = [f for f in findings if f.path in keep]
     elapsed = time.perf_counter() - t0
 
     if args.as_json:
@@ -62,6 +131,7 @@ def main() -> int:
         print(
             f"schedlint: {len(repo.modules)} modules, {len(repo.docs)} docs, "
             f"{len(findings)} finding(s), {elapsed:.2f}s"
+            + (" [--changed]" if args.changed else "")
         )
     return 1 if findings else 0
 

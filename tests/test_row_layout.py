@@ -1,0 +1,356 @@
+"""row-layout regression corpus: the scratch/stats row registry checks.
+
+Fixture pairs per sub-check (docs/STATIC_ANALYSIS.md): bare row literals,
+registry collisions/aliases, liveness + read-without-write guard dataflow,
+the stats evidence round-trip, and the generated doc tables — plus the
+committed-tree gate (the real registry vs the real kernels)."""
+
+from __future__ import annotations
+
+import textwrap
+
+from scheduler_tpu.analysis import Repo, run_passes
+from scheduler_tpu.analysis.row_layout import (
+    marker_lines,
+    parse_registry_source,
+    render_table,
+)
+
+
+def findings(py=None, docs=None, existing=()):
+    repo = Repo.from_sources(
+        py={k: textwrap.dedent(v) for k, v in (py or {}).items()},
+        docs={k: textwrap.dedent(v) for k, v in (docs or {}).items()},
+        existing=existing,
+    )
+    return run_passes(repo, ["row-layout"])
+
+
+LAYOUT = """
+    class JOB:
+        CONS = 0
+        DRF = 8
+        SHARE = 24
+
+    SPANS = {"JOB": {"DRF": 8}}
+    ALIASES = {}
+    FLAVOR_FLAGS = ("multi_queue", "use_qdelta")
+    LIVE_WHEN = {"JOB": {"SHARE": ("use_qdelta",)}}
+    BUFFERS = {"ops/kern.py": {"js": ("JOB", 0)}}
+    DATAFLOW_NAMESPACES = ("JOB",)
+    STATS_KEYS = {}
+    DOC_TABLES = {}
+    DOC_ROWS = {}
+"""
+
+
+# -- bare literals ------------------------------------------------------------
+
+def test_bare_row_literal_trips():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": LAYOUT,
+        "scheduler_tpu/ops/kern.py": """
+            from scheduler_tpu.ops.layout import JOB
+            def kernel(js, x):
+                js[24:25, :] = x
+        """,
+    })
+    assert len(out) == 1 and "bare row index" in out[0].message
+    assert out[0].path == "scheduler_tpu/ops/kern.py"
+
+
+def test_named_rows_and_unregistered_buffers_clean():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": LAYOUT,
+        "scheduler_tpu/ops/kern.py": """
+            from scheduler_tpu.ops.layout import JOB
+            def kernel(js, other, x, r):
+                js[JOB.SHARE : JOB.SHARE + 1, :] = x  # named: fine
+                js[JOB.DRF + r : JOB.DRF + r + 1, :] = x
+                other[24:25, :] = x   # not a registered buffer
+        """,
+    })
+    # JOB.SHARE access sits under no guards but LIVE_WHEN demands use_qdelta.
+    assert [f for f in out if "bare row index" in f.message] == []
+
+
+def test_bare_literal_checks_the_registered_axis_only():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": LAYOUT.replace(
+            '{"js": ("JOB", 0)}', '{"stats_ref": ("JOB", 1)}'
+        ),
+        "scheduler_tpu/ops/kern.py": """
+            from scheduler_tpu.ops.layout import JOB
+            def kernel(stats_ref, v):
+                stats_ref[0, JOB.CONS] = v   # axis-0 literal 0 is structural
+                stats_ref[0, 3] = v          # axis-1 literal: a row index
+        """,
+    })
+    assert len(out) == 1 and "bare row index" in out[0].message
+
+
+# -- registry integrity -------------------------------------------------------
+
+def test_collision_trips_and_alias_is_allowed():
+    bad = LAYOUT.replace("SHARE = 24", "SHARE = 24\n        CLASH = 10")
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": bad,
+        "scheduler_tpu/ops/kern.py": "",
+    })
+    # CLASH = 10 lands inside DRF's declared span [8, 16).
+    assert len(out) == 1 and "collision" in out[0].message
+
+    aliased = bad.replace(
+        'ALIASES = {}', 'ALIASES = {"JOB": {"CLASH": "DRF"}}'
+    )
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": aliased,
+        "scheduler_tpu/ops/kern.py": "",
+    })
+    assert out == []
+
+
+def test_unknown_names_in_metadata_trip():
+    bad = LAYOUT.replace(
+        'LIVE_WHEN = {"JOB": {"SHARE": ("use_qdelta",)}}',
+        'LIVE_WHEN = {"JOB": {"GHOST": ("warp",)}}',
+    )
+    out = findings(py={"scheduler_tpu/ops/layout.py": bad})
+    msgs = " / ".join(f.message for f in out)
+    assert "unknown row JOB.GHOST" in msgs
+    assert "not in FLAVOR_FLAGS" in msgs
+
+
+# -- guard dataflow -----------------------------------------------------------
+
+def test_liveness_guard_violation_trips():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": LAYOUT,
+        "scheduler_tpu/ops/kern.py": """
+            from scheduler_tpu.ops.layout import JOB
+            def kernel(js, x, use_qdelta):
+                js[JOB.SHARE : JOB.SHARE + 1, :] = x  # missing the guard
+        """,
+    })
+    assert len(out) == 1 and "liveness" in out[0].message
+
+
+def test_read_without_write_trips_and_covered_read_is_clean():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": LAYOUT,
+        "scheduler_tpu/ops/kern.py": """
+            from scheduler_tpu.ops.layout import JOB
+            def kernel(js, x, multi_queue, use_qdelta):
+                if multi_queue:
+                    if use_qdelta:
+                        js[JOB.SHARE : JOB.SHARE + 1, :] = x
+                if use_qdelta:
+                    y = js[JOB.SHARE : JOB.SHARE + 1, :]
+                return y
+        """,
+    })
+    # The read's flavor (use_qdelta without multi_queue) has no write.
+    assert len(out) == 1 and "read-without-write" in out[0].message
+
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": LAYOUT,
+        "scheduler_tpu/ops/kern.py": """
+            from scheduler_tpu.ops.layout import JOB
+            def kernel(js, x, multi_queue, use_qdelta):
+                if use_qdelta:
+                    js[JOB.SHARE : JOB.SHARE + 1, :] = x
+                if multi_queue:
+                    if use_qdelta:
+                        y = js[JOB.SHARE : JOB.SHARE + 1, :]
+                        return y
+        """,
+    })
+    assert out == []
+
+
+def test_else_branch_does_not_inherit_the_flag():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": LAYOUT,
+        "scheduler_tpu/ops/kern.py": """
+            from scheduler_tpu.ops.layout import JOB
+            def kernel(js, x, use_qdelta):
+                if use_qdelta:
+                    js[JOB.SHARE : JOB.SHARE + 1, :] = x
+                else:
+                    y = js[JOB.SHARE : JOB.SHARE + 1, :]
+                    return y
+        """,
+    })
+    # The else-branch read runs exactly when the row does NOT exist.
+    assert any("liveness" in f.message for f in out)
+    assert any("read-without-write" in f.message for f in out)
+
+
+# -- stats round-trip ---------------------------------------------------------
+
+STATS_LAYOUT = """
+    class STATS:
+        STEPS = 0
+
+    SPANS = {}
+    ALIASES = {}
+    FLAVOR_FLAGS = ()
+    LIVE_WHEN = {}
+    BUFFERS = {"ops/kern.py": {"stats_ref": ("STATS", 1)}}
+    DATAFLOW_NAMESPACES = ()
+    STATS_KEYS = {"STEPS": ("cohort", "steps")}
+    DOC_TABLES = {}
+    DOC_ROWS = {}
+"""
+
+KERNEL_STORE = """
+    from scheduler_tpu.ops.layout import STATS
+    def kernel(stats_ref, final):
+        stats_ref[0, STATS.STEPS] = final
+"""
+
+GOOD_RUN_STATS = """
+    def run_stats(self):
+        return {"steps": 1}
+"""
+
+GOOD_NOTE = """
+    from scheduler_tpu.utils import phases
+    def execute(stats):
+        phases.note("cohort", stats)
+"""
+
+GOOD_BENCH = '''
+    def detail(ph):
+        return {"cohort": ph.get("notes", {}).get("cohort", {})}
+'''
+
+
+def test_stats_roundtrip_clean():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": STATS_LAYOUT,
+        "scheduler_tpu/ops/kern.py": KERNEL_STORE,
+        "scheduler_tpu/ops/fused.py": GOOD_RUN_STATS,
+        "scheduler_tpu/actions/allocate.py": GOOD_NOTE,
+        "bench.py": GOOD_BENCH,
+    })
+    assert out == []
+
+
+def test_stats_roundtrip_trips_on_each_broken_link():
+    # Key missing from run_stats.
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": STATS_LAYOUT,
+        "scheduler_tpu/ops/kern.py": KERNEL_STORE,
+        "scheduler_tpu/ops/fused.py": """
+            def run_stats(self):
+                return {"step_count": 1}
+        """,
+        "scheduler_tpu/actions/allocate.py": GOOD_NOTE,
+        "bench.py": GOOD_BENCH,
+    })
+    assert len(out) == 1 and "run_stats" in out[0].message
+
+    # Note channel never recorded under actions/.
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": STATS_LAYOUT,
+        "scheduler_tpu/ops/kern.py": KERNEL_STORE,
+        "scheduler_tpu/ops/fused.py": GOOD_RUN_STATS,
+        "scheduler_tpu/actions/allocate.py": """
+            from scheduler_tpu.utils import phases
+            def execute(stats):
+                phases.note("engine_cache", stats)
+        """,
+        "bench.py": GOOD_BENCH,
+    })
+    assert len(out) == 1 and "phases.note" in out[0].message
+
+    # Bench detail never consumes the channel.
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": STATS_LAYOUT,
+        "scheduler_tpu/ops/kern.py": KERNEL_STORE,
+        "scheduler_tpu/ops/fused.py": GOOD_RUN_STATS,
+        "scheduler_tpu/actions/allocate.py": GOOD_NOTE,
+        "bench.py": "def detail(ph):\n    return {}\n",
+    })
+    assert len(out) == 1 and "bench" in out[0].message
+
+    # Declared stats row the kernel never stores.
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": STATS_LAYOUT,
+        "scheduler_tpu/ops/kern.py": """
+            from scheduler_tpu.ops.layout import STATS
+            def kernel(stats_ref, i):
+                x = stats_ref[0, i]
+                return x
+        """,
+        "scheduler_tpu/ops/fused.py": GOOD_RUN_STATS,
+        "scheduler_tpu/actions/allocate.py": GOOD_NOTE,
+        "bench.py": GOOD_BENCH,
+    })
+    assert len(out) == 1 and "no kernel write" in out[0].message
+
+
+# -- generated doc tables -----------------------------------------------------
+
+DOC_LAYOUT = LAYOUT.replace(
+    "DOC_TABLES = {}", 'DOC_TABLES = {"docs/ROWS.md": ("JOB",)}'
+).replace(
+    "DOC_ROWS = {}",
+    'DOC_ROWS = {"JOB": {"CONS": "consumed", "DRF": "drf", "SHARE": "share"}}',
+)
+
+
+def _rendered_doc():
+    reg = parse_registry_source(textwrap.dedent(DOC_LAYOUT))
+    begin, end = marker_lines("JOB")
+    return "\n".join([begin, *render_table(reg, "JOB"), end, ""])
+
+
+def test_doc_table_missing_and_stale_trip():
+    out = findings(
+        py={"scheduler_tpu/ops/layout.py": DOC_LAYOUT},
+        docs={"docs/ROWS.md": "no markers here\n"},
+    )
+    assert len(out) == 1 and "missing generated layout table" in out[0].message
+
+    begin, end = marker_lines("JOB")
+    out = findings(
+        py={"scheduler_tpu/ops/layout.py": DOC_LAYOUT},
+        docs={"docs/ROWS.md": f"{begin}\n| old | table |\n{end}\n"},
+    )
+    assert len(out) == 1 and "stale" in out[0].message
+
+
+def test_doc_table_current_is_clean():
+    out = findings(
+        py={"scheduler_tpu/ops/layout.py": DOC_LAYOUT},
+        docs={"docs/ROWS.md": _rendered_doc()},
+    )
+    assert out == []
+
+
+def test_render_table_shape():
+    reg = parse_registry_source(textwrap.dedent(DOC_LAYOUT))
+    table = render_table(reg, "JOB")
+    assert table[0].startswith("| rows | name (JOB)")
+    assert "| 8..15 | `DRF` | drf |" in table
+    assert "| 24 | `SHARE` | share |" in table
+
+
+# -- the committed tree -------------------------------------------------------
+
+def test_committed_kernels_have_no_bare_row_literals():
+    """The acceptance criterion as a test: the row-layout pass is clean on
+    the real registry + the four adopted ops modules (megakernel, fused,
+    pallas_kernels, sharded) and the real docs."""
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    repo = Repo.from_root(
+        root,
+        ("scheduler_tpu/ops", "scheduler_tpu/actions", "bench.py"),
+        ("docs/*.md",),
+    )
+    out = run_passes(repo, ["row-layout"])
+    assert out == [], "\n".join(str(f) for f in out)

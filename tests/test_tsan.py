@@ -1,0 +1,195 @@
+"""SCHEDULER_TPU_TSAN: the Eraser-style lockset race sanitizer.
+
+Fast tests pin the mechanics (held-set tracking, the per-field state
+machine, the seeded unlocked write that MUST trip, the locked twin that
+must stay silent, the sanitize.is_violation contract).  The slow test is
+the acceptance gate: full allocate cycles with the sanitizer armed — mega
+and XLA engine flavors, one and two queues — finish with an empty race
+log."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from scheduler_tpu.utils import tsan
+
+
+@pytest.fixture
+def tsan_on(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_TSAN", "1")
+    assert tsan.arm() is True
+    yield
+    tsan.disarm()
+
+
+def _in_thread(fn):
+    err: list = []
+
+    def run():
+        try:
+            fn()
+        except BaseException as e:  # surfaced to the test thread
+            err.append(e)
+
+    t = threading.Thread(target=run, name="tsan-fixture")
+    t.start()
+    t.join(30)
+    assert not t.is_alive()
+    if err:
+        raise err[0]
+
+
+def test_noop_when_off(monkeypatch):
+    monkeypatch.delenv("SCHEDULER_TPU_TSAN", raising=False)
+    assert tsan.arm() is False
+    lock = tsan.wrap_lock(threading.Lock(), "off.lock")
+    with lock:
+        tsan.access("off.field")
+    _in_thread(lambda: tsan.access("off.field"))  # no state, no race
+    assert tsan.races() == []
+
+
+def test_wrapped_lock_tracks_held_set(tsan_on):
+    lock = tsan.wrap_lock(threading.Lock(), "held.lock")
+    assert not lock.locked()
+    with lock:
+        assert lock.locked()
+        assert "held.lock" in tsan._held()
+    assert "held.lock" not in tsan._held()
+
+
+def test_rlock_reentry_keeps_the_hold(tsan_on):
+    """Nested acquires of a wrapped RLock must stay in the held set until
+    the LAST release (hold counting, not dict-of-names)."""
+    lock = tsan.wrap_lock(threading.RLock(), "re.lock")
+    with lock:
+        with lock:
+            assert "re.lock" in tsan._held()
+        assert "re.lock" in tsan._held()  # inner release must not drop it
+    assert "re.lock" not in tsan._held()
+
+
+def test_single_thread_needs_no_locks(tsan_on):
+    for _ in range(3):
+        tsan.access("solo.field")  # exclusive: no discipline required
+    assert tsan.races() == []
+
+
+def test_seeded_unlocked_write_trips(tsan_on):
+    """The acceptance fixture: one thread mutates under the lock, a second
+    mutates WITHOUT it — the candidate lockset empties and the race raises
+    at the offending access."""
+    lock = tsan.wrap_lock(threading.Lock(), "seeded.lock")
+
+    def locked_writer():
+        for _ in range(3):
+            with lock:
+                tsan.access("seeded.field")
+
+    _in_thread(locked_writer)
+    with pytest.raises(tsan.TsanRaceError, match="seeded.field"):
+        tsan.access("seeded.field")  # second thread, no lock held
+    assert any("seeded.field" in r for r in tsan.races())
+    # Reported once per field: the next access must not raise again.
+    tsan.access("seeded.field")
+
+
+def test_consistently_locked_twin_is_silent(tsan_on):
+    lock = tsan.wrap_lock(threading.Lock(), "clean.lock")
+
+    def writer():
+        for _ in range(3):
+            with lock:
+                tsan.access("clean.field")
+
+    _in_thread(writer)
+    with lock:
+        tsan.access("clean.field")
+    assert tsan.races() == []
+
+
+def test_read_only_sharing_is_silent_until_a_write(tsan_on):
+    tsan.access("ro.field")  # owner writes once while exclusive
+    _in_thread(lambda: tsan.access("ro.field", write=False))
+    assert tsan.races() == []  # shared, not shared-modified
+    with pytest.raises(tsan.TsanRaceError):
+        _in_thread(lambda: tsan.access("ro.field", write=True))
+
+
+def test_shared_token_bucket_is_race_clean(tsan_on):
+    """The real hot spot: one TokenBucket paced by several io-worker-like
+    threads — every access rides the bucket's own wrapped lock."""
+    from scheduler_tpu.connector.client import TokenBucket
+
+    clock = [0.0]
+    bucket = TokenBucket(
+        qps=1000.0, burst=2, clock=lambda: clock[0],
+        sleep=lambda s: clock.__setitem__(0, clock[0] + s),
+    )
+    threads = [
+        threading.Thread(target=lambda: [bucket.acquire() for _ in range(5)])
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert tsan.races() == []
+
+
+def test_race_is_a_sanitizer_violation(tsan_on):
+    """The mega->XLA fallback must RE-RAISE lockset races, exactly like
+    transfer-guard trips (utils/sanitize.is_violation)."""
+    from scheduler_tpu.utils import sanitize
+
+    err = tsan.TsanRaceError("data race on 'x'")
+    assert sanitize.is_violation(err)
+    assert not sanitize.is_violation(RuntimeError("mosaic lowering failed"))
+
+
+def test_violation_requires_the_flag(monkeypatch):
+    monkeypatch.delenv("SCHEDULER_TPU_TSAN", raising=False)
+    from scheduler_tpu.utils import sanitize
+
+    assert not sanitize.is_violation(tsan.TsanRaceError("data race on 'x'"))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mega", ["1", "0"])
+@pytest.mark.parametrize("queues", [1, 2])
+def test_full_cycle_is_race_clean_under_tsan(tsan_on, monkeypatch, mega, queues):
+    """Acceptance: a flagship-shaped allocate cycle with the lockset
+    sanitizer armed — mega and XLA flavors, single- and two-queue — runs to
+    completion with an EMPTY race log (the engine cache, transfer cache,
+    phase buffers and connector bucket all keep their lock discipline)."""
+    import scheduler_tpu.actions  # noqa: F401  registry side effects
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.harness import make_synthetic_cluster
+    from scheduler_tpu.harness.measure import steady_cycle
+
+    monkeypatch.setenv("SCHEDULER_TPU_MEGA", mega)
+    proportion = "  - name: proportion\n" if queues > 1 else ""
+    conf = parse_scheduler_conf(
+        """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+"""
+        + proportion
+        + "  - name: binpack\n"
+    )
+    qnames = tuple(f"q{i}" for i in range(queues)) if queues > 1 else ("default",)
+    cluster = make_synthetic_cluster(
+        64, 256, tasks_per_job=16,
+        queues=qnames, queue_weights={q: i + 1 for i, q in enumerate(qnames)},
+    )
+    tsan.reset()
+    steady_cycle(cluster.cache, conf, ("allocate",))
+    assert len(cluster.cache.binder.binds) == 256
+    assert tsan.races() == []

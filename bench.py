@@ -133,6 +133,12 @@ def main() -> None:
     from scheduler_tpu.utils import tsan
 
     tsan_armed = tsan.arm()
+    # SCHEDULER_TPU_SHARDCHECK=1: live-sharding assertions at dispatch/
+    # readback against the registry (utils/shardcheck.py, docs/SHARDING.md);
+    # the artifact carries the violation count (0 == placement-clean).
+    from scheduler_tpu.utils import shardcheck
+
+    shardcheck.reset()
 
     # Warmup at the REAL shapes: the steady-state scheduler loop compiles once
     # per (node-bucket, task-bucket) pair and re-runs every period, so the
@@ -184,6 +190,10 @@ def main() -> None:
             "regime": regime,
             "sanitize": sanitized,
             "tsan": {"armed": tsan_armed, "races": tsan.races()},
+            "shardcheck": {
+                "armed": shardcheck.enabled(),
+                "violations": shardcheck.violations(),
+            },
             "policy": POLICY,
             "cycles": [
                 {

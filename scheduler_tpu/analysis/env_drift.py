@@ -28,9 +28,18 @@ from scheduler_tpu.analysis.core import (
 )
 
 ENV_PREFIX = "SCHEDULER_TPU_"
-ENVFLAG_FUNCS = {"env_bool", "env_int", "env_str"}
+# Scheduler-owned flags without the prefix (reference-inherited names):
+# raw-env covers their reads too.  Deliberately NOT jax/XLA process flags
+# (JAX_PLATFORMS, XLA_FLAGS) — those are mutated via the documented
+# save/restore pattern, and envflags owns parsing, not mutation.
+EXTRA_FLAGS = ("PANIC_ON_ERROR",)
+ENVFLAG_FUNCS = {"env_bool", "env_int", "env_float", "env_str"}
 ENV_KEYS_MODULE = "ops/engine_cache.py"
 ENV_KEYS_NAME = "_ENV_KEYS"
+
+
+def _covered(flag: str) -> bool:
+    return flag.startswith(ENV_PREFIX) or flag in EXTRA_FLAGS
 
 
 def registered_keys(repo: Repo) -> Optional[Set[str]]:
@@ -53,25 +62,26 @@ def registered_keys(repo: Repo) -> Optional[Set[str]]:
 
 
 def flag_reads(mod: PyModule) -> Iterator[Tuple[int, str, bool]]:
-    """(line, flag, via_envflags) for every SCHEDULER_TPU_* read."""
+    """(line, flag, via_envflags) for every scheduler-flag read
+    (``SCHEDULER_TPU_*`` plus the EXTRA_FLAGS names)."""
     for node in ast.walk(mod.tree):
         if isinstance(node, ast.Call):
             fn = dotted(node.func)
             if fn is not None and fn.rsplit(".", 1)[-1] in ENVFLAG_FUNCS:
                 flag = const_str(node.args[0]) if node.args else None
-                if flag and flag.startswith(ENV_PREFIX):
+                if flag and _covered(flag):
                     yield node.lineno, flag, True
             elif fn is not None and (
                 fn.endswith("environ.get") or fn.rsplit(".", 1)[-1] == "getenv"
             ):
                 flag = const_str(node.args[0]) if node.args else None
-                if flag and flag.startswith(ENV_PREFIX):
+                if flag and _covered(flag):
                     yield node.lineno, flag, False
         elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
             base = dotted(node.value)
             if base is not None and base.endswith("environ"):
                 flag = const_str(node.slice)
-                if flag and flag.startswith(ENV_PREFIX):
+                if flag and _covered(flag):
                     yield node.lineno, flag, False
 
 
@@ -114,7 +124,9 @@ def env_drift(repo: Repo) -> List[Finding]:
         return out
     for mod in ops_modules:
         for line, flag, _ in flag_reads(mod):
-            if flag in keys:
+            # Only prefixed engine flags participate in the cache key;
+            # EXTRA_FLAGS names are raw-env's concern, not drift's.
+            if not flag.startswith(ENV_PREFIX) or flag in keys:
                 continue
             out.append(Finding(
                 "env-drift", mod.path, line,

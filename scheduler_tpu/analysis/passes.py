@@ -7,3 +7,4 @@ from scheduler_tpu.analysis import host_sync  # noqa: F401
 from scheduler_tpu.analysis import hygiene  # noqa: F401
 from scheduler_tpu.analysis import lock_order  # noqa: F401
 from scheduler_tpu.analysis import row_layout  # noqa: F401
+from scheduler_tpu.analysis import sharding  # noqa: F401

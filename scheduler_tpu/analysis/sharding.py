@@ -1,0 +1,636 @@
+"""Pass ``sharding``: the sharding-spec registry, machine-checked.
+
+The sharded engine's scaling rests on one comm contract — "per task, the
+only ICI traffic is the D candidate tuples / one small all-gather per scan
+step" (``ops/sharded.py``) — and until round 6 it lived only in a
+docstring.  ``ops/layout.py`` now declares sharding as data (``SHARD_AXES``,
+``SHARDING`` families, per-call-site ``SHARD_SITES`` signatures with
+loop-carry pairs, ``COLLECTIVE_BUDGET``, ``SHARDED_HOST_BINDINGS``,
+``FUSED_ARG_FAMILIES``); this pass re-reads that registry AS DATA (ast over
+the analyzed ``Repo``, so the test corpus can supply fixture registries)
+and verifies:
+
+1. **Registry integrity.**  Family specs are tuples over declared axis
+   values; sites/budgets/bindings refer to declared families; every
+   declared site carries a collective budget; carry indices are in range.
+2. **Site specs.**  Every ``shard_map`` call site in the analyzed ``ops/``
+   modules (the engine — tests and measurement drivers build ad-hoc
+   probes on purpose, env-drift's scoping rule) must extract to registry
+   families: a ``P(...)`` literal whose spec is no
+   declared family, an unresolvable axis name, or a site absent from
+   ``SHARD_SITES`` is a finding — new sharded entry points must be declared
+   (and budgeted) before they ship.  Declared sites are checked
+   family-by-family against ``in_specs``/``out_specs``.  The same family
+   check covers ``NamedSharding(mesh, P(...))`` and
+   ``with_sharding_constraint`` literals.
+3. **Loop-carried donation.**  For each declared ``carry`` pair the
+   out-spec must equal the in-spec — pjit's pre-partitioning rule for
+   donated carries (``out_axis_resources == in_axis_resources``); a
+   mismatch forces a cross-chip reshard of the ledger every cycle.
+4. **Host materialization.**  ``np.asarray``/``jax.device_get`` of a name
+   bound in ``SHARDED_HOST_BINDINGS`` outside ``readback()``/
+   ``_readback()`` is a mid-cycle collect of registry-sharded state.
+5. **Axis pinning.**  A module-level assignment of a declared axis name
+   (``NODE_AXIS = ...``) must carry the registry's literal value.
+6. **Doc drift.**  The generated tables in ``docs/SHARDING.md`` (family +
+   site/budget, rendered by ``scripts/gen_layout_doc.py`` between
+   ``<!-- layout:SHARDING/SHARD_SITES:begin/end -->`` markers) must match
+   this registry — same renderer, so a regenerated doc always passes.
+
+The compiled-HLO half of the budget check needs a device backend and lives
+in ``scripts/shard_budget.py`` (AOT-lower on a simulated
+``--xla_force_host_platform_device_count`` mesh, count
+all-gather/all-reduce/collective-permute per step in the optimized HLO);
+``make lint`` runs both.  The runtime half is ``utils/shardcheck.py``
+(``SCHEDULER_TPU_SHARDCHECK=1``).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from scheduler_tpu.analysis.core import Finding, PyModule, Repo, dotted, register
+from scheduler_tpu.analysis.row_layout import LAYOUT_SUFFIX, marker_lines
+
+RULE = "sharding"
+
+_P_NAMES = ("P", "_P", "PartitionSpec")
+_READBACK_FNS = ("readback", "_readback")
+_SHARD_META = (
+    "SHARD_AXES", "SHARDING", "SHARD_SITES", "COLLECTIVE_BUDGET",
+    "SHARDED_HOST_BINDINGS", "FUSED_ARG_FAMILIES", "SHARD_DOC",
+    "SHARD_DOC_ROWS",
+)
+
+# A spec is a tuple of axis values / None; "*<family>" marks the variadic
+# declared form and VARIADIC the extracted `tuple(P() for _ in ...)` form.
+Spec = Tuple[Optional[str], ...]
+VARIADIC = "*"
+
+
+def trim_spec(spec: Spec) -> Spec:
+    """Drop trailing replicated axes: jax treats ``P('nodes', None)`` and
+    ``P('nodes')`` as the same placement, so the registry does too."""
+    out = list(spec)
+    while out and out[-1] is None:
+        out.pop()
+    return tuple(out)
+
+
+@dataclass
+class ShardRegistry:
+    path: str
+    axes: Dict[str, str] = field(default_factory=dict)
+    families: Dict[str, Spec] = field(default_factory=dict)
+    sites: Dict[str, dict] = field(default_factory=dict)
+    budgets: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    host_bindings: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    fused_families: Tuple[str, ...] = ()
+    doc_path: str = ""
+    doc_rows: Dict[str, str] = field(default_factory=dict)
+
+    def family_of(self, spec: Spec) -> Optional[str]:
+        spec = trim_spec(spec)
+        for name, fspec in self.families.items():
+            if trim_spec(fspec) == spec:
+                return name
+        return None
+
+
+def parse_shard_registry(text: str, path: str = LAYOUT_SUFFIX) -> ShardRegistry:
+    """Build a ShardRegistry from layout-module SOURCE (literal by
+    contract; non-literal metadata is ignored, integrity checks catch the
+    rest)."""
+    tree = ast.parse(text)
+    meta: Dict[str, object] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name) and tgt.id in _SHARD_META:
+                try:
+                    meta[tgt.id] = ast.literal_eval(node.value)
+                except ValueError:
+                    pass
+    reg = ShardRegistry(path=path)
+    reg.axes = dict(meta.get("SHARD_AXES", {}) or {})
+    reg.families = {
+        name: tuple(spec)
+        for name, spec in (meta.get("SHARDING", {}) or {}).items()
+    }
+    reg.sites = {
+        site: {
+            "in": tuple(sig.get("in", ())),
+            "out": tuple(sig.get("out", ())),
+            "carry": tuple(tuple(c) for c in sig.get("carry", ())),
+        }
+        for site, sig in (meta.get("SHARD_SITES", {}) or {}).items()
+    }
+    reg.budgets = {
+        site: dict(b) for site, b in (meta.get("COLLECTIVE_BUDGET", {}) or {}).items()
+    }
+    reg.host_bindings = {
+        mod: tuple(names)
+        for mod, names in (meta.get("SHARDED_HOST_BINDINGS", {}) or {}).items()
+    }
+    reg.fused_families = tuple(meta.get("FUSED_ARG_FAMILIES", ()) or ())
+    reg.doc_path = str(meta.get("SHARD_DOC", "") or "")
+    reg.doc_rows = dict(meta.get("SHARD_DOC_ROWS", {}) or {})
+    return reg
+
+
+def format_spec(spec: Spec) -> str:
+    return "P({})".format(
+        ", ".join("None" if a is None else repr(a) for a in spec)
+    )
+
+
+def _format_family(reg: ShardRegistry, fam: str) -> str:
+    if fam.startswith(VARIADIC):
+        return f"{format_spec(reg.families.get(fam[1:], ()))}…"
+    return format_spec(reg.families.get(fam, ()))
+
+
+def render_family_table(reg: ShardRegistry) -> List[str]:
+    """Markdown family table — the ONE rendering shared by
+    ``scripts/gen_layout_doc.py`` (writer) and this pass (drift check)."""
+    out = ["| family | spec | content |", "|---|---|---|"]
+    for name, spec in sorted(reg.families.items()):
+        out.append(
+            f"| `{name}` | `{format_spec(spec)}` | "
+            f"{reg.doc_rows.get(name, '')} |"
+        )
+    return out
+
+
+def render_site_table(reg: ShardRegistry) -> List[str]:
+    """Markdown shard-site + collective-budget table (same sharing rule)."""
+    out = [
+        "| site | in_specs | out_specs | carried | budget / step |",
+        "|---|---|---|---|---|",
+    ]
+    for site in sorted(reg.sites):
+        sig = reg.sites[site]
+        ins = ", ".join(f"`{f}`" for f in sig["in"]) or "—"
+        outs = ", ".join(f"`{f}`" for f in sig["out"]) or "—"
+        carry = ", ".join(f"{i}→{o}" for i, o in sig["carry"]) or "—"
+        budget = reg.budgets.get(site, {})
+        bud = ", ".join(
+            f"{k}={v}" for k, v in sorted(budget.items())
+        ) or "undeclared"
+        out.append(f"| `{site}` | {ins} | {outs} | {carry} | {bud} |")
+    return out
+
+
+# -- registry integrity -------------------------------------------------------
+
+def _check_registry(reg: ShardRegistry) -> List[Finding]:
+    out: List[Finding] = []
+
+    def bad(msg: str) -> None:
+        out.append(Finding(RULE, reg.path, 1, msg))
+
+    axis_values = set(reg.axes.values())
+    for name, spec in reg.families.items():
+        for a in spec:
+            if a is not None and a not in axis_values:
+                bad(f"SHARDING family {name} uses undeclared axis {a!r}")
+
+    def known(fam: str) -> bool:
+        return fam.lstrip(VARIADIC) in reg.families
+
+    for site, sig in reg.sites.items():
+        for slot in ("in", "out"):
+            for fam in sig[slot]:
+                if not known(fam):
+                    bad(f"SHARD_SITES {site} {slot} names unknown family "
+                        f"{fam!r}")
+        for pair in sig["carry"]:
+            if len(pair) != 2:
+                bad(f"SHARD_SITES {site} carry pair {pair!r} is not "
+                    "(in_index, out_index)")
+                continue
+            i, o = pair
+            variadic_in = any(f.startswith(VARIADIC) for f in sig["in"])
+            if not variadic_in and not (
+                0 <= i < len(sig["in"]) and 0 <= o < len(sig["out"])
+            ):
+                bad(f"SHARD_SITES {site} carry pair ({i}, {o}) is out of "
+                    "range")
+        if site not in reg.budgets:
+            bad(f"shard_map site {site} has no COLLECTIVE_BUDGET entry: "
+                "declare its per-step all-gather/all-reduce budget")
+    for site in reg.budgets:
+        if site not in reg.sites:
+            bad(f"COLLECTIVE_BUDGET names unknown site {site}")
+    for fam in reg.fused_families:
+        if fam not in reg.families:
+            bad(f"FUSED_ARG_FAMILIES names unknown family {fam!r}")
+    return out
+
+
+# -- axis / spec resolution ---------------------------------------------------
+
+class _AxisEnv:
+    """Per-module resolution of axis-name references (``NODE_AXIS``,
+    ``from …sharded import NODE_AXIS as _NAXIS``, ``X = NODE_AXIS``) to the
+    registry's literal axis values."""
+
+    def __init__(self, reg: ShardRegistry, mod: PyModule) -> None:
+        self.reg = reg
+        self.values: Dict[str, str] = {}
+        self.pin_findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if a.name in reg.axes:
+                        self.values[a.asname or a.name] = reg.axes[a.name]
+        # Module-level assignments: the defining module pins the value.
+        for node in mod.tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt, val = node.targets[0], node.value
+            if not isinstance(tgt, ast.Name):
+                continue
+            if tgt.id in reg.axes:
+                if (
+                    isinstance(val, ast.Constant)
+                    and val.value == reg.axes[tgt.id]
+                ):
+                    self.values[tgt.id] = reg.axes[tgt.id]
+                else:
+                    self.pin_findings.append(Finding(
+                        RULE, mod.path, node.lineno,
+                        f"axis {tgt.id} must carry the registry value "
+                        f"{reg.axes[tgt.id]!r} (SHARD_AXES, ops/layout.py)",
+                    ))
+            elif isinstance(val, (ast.Name, ast.Attribute)):
+                d = dotted(val)
+                leaf = d.rsplit(".", 1)[-1] if d else None
+                if leaf in reg.axes:
+                    self.values[tgt.id] = reg.axes[leaf]
+            elif isinstance(val, ast.Constant) and isinstance(val.value, str):
+                # Any module-level string constant can name an axis in a
+                # P(...) — resolving it lets the finding show the actual
+                # (undeclared) spec instead of "unresolvable".
+                self.values[tgt.id] = val.value
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Axis value for one P(...) argument; the string "?" marks an
+        unresolvable reference (distinct from None = replicated axis)."""
+        if isinstance(node, ast.Constant):
+            if node.value is None or isinstance(node.value, str):
+                return node.value
+            return "?"
+        d = dotted(node)
+        if d is not None:
+            leaf = d.rsplit(".", 1)[-1]
+            if leaf in self.values:
+                return self.values[leaf]
+            if leaf in self.reg.axes:
+                return self.reg.axes[leaf]
+        return "?"
+
+
+def _is_p_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func)
+    return d is not None and d.rsplit(".", 1)[-1] in _P_NAMES
+
+
+def _extract_spec(
+    call: ast.Call, env: _AxisEnv
+) -> Union[Spec, None, str]:
+    """Spec tuple of one P(...) call; None = dynamic (``P(*spec)`` built
+    from the registry — skipped); "?" = contains an unresolvable name."""
+    if any(isinstance(a, ast.Starred) for a in call.args) or call.keywords:
+        return None
+    spec: List[Optional[str]] = []
+    for a in call.args:
+        v = env.resolve(a)
+        if v == "?":
+            return "?"
+        spec.append(v)
+    return tuple(spec)
+
+
+def _extract_spec_list(
+    node: ast.AST, env: _AxisEnv
+) -> Union[List[Union[Spec, str]], str, None]:
+    """The in_specs/out_specs value of a shard_map call: a list of spec
+    tuples, VARIADIC for the ``tuple(P() for …)`` form, or None when the
+    value is a pass-through name (wrapper shims)."""
+    if _is_p_call(node):
+        one = _extract_spec(node, env)
+        return None if one is None else [one]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[Union[Spec, str]] = []
+        for el in node.elts:
+            if not _is_p_call(el):
+                return None
+            one = _extract_spec(el, env)
+            if one is None:
+                return None
+            out.append(one)
+        return out
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "tuple"
+        and len(node.args) == 1
+        and isinstance(node.args[0], (ast.GeneratorExp, ast.ListComp))
+        and _is_p_call(node.args[0].elt)
+    ):
+        one = _extract_spec(node.args[0].elt, env)
+        if isinstance(one, tuple):
+            return VARIADIC + (env.reg.family_of(one) or "?")
+    return None
+
+
+def _enclosing_functions(tree: ast.AST) -> Dict[ast.AST, List[ast.FunctionDef]]:
+    """node -> stack of enclosing FunctionDefs (outermost first)."""
+    out: Dict[ast.AST, List[ast.FunctionDef]] = {}
+
+    def walk(node: ast.AST, stack: List[ast.FunctionDef]) -> None:
+        out[node] = stack
+        child_stack = (
+            stack + [node] if isinstance(node, ast.FunctionDef) else stack
+        )
+        for child in ast.iter_child_nodes(node):
+            walk(child, child_stack)
+
+    walk(tree, [])
+    return out
+
+
+def _site_key(mod: PyModule, fns: List[ast.FunctionDef]) -> str:
+    name = fns[-1].name if fns else "<module>"
+    return f"{mod.path}::{name}"
+
+
+def _match_site(reg: ShardRegistry, mod_path: str, fn_name: str) -> Optional[str]:
+    for site in reg.sites:
+        smod, sfn = site.split("::", 1)
+        if sfn == fn_name and (
+            mod_path == smod or mod_path.endswith("/" + smod)
+        ):
+            return site
+    return None
+
+
+def _check_families(
+    reg: ShardRegistry,
+    extracted: Sequence[Union[Spec, str]],
+    declared: Sequence[str],
+) -> Optional[str]:
+    """None when the extracted spec list matches the declared family list,
+    else a human-readable mismatch description."""
+    if isinstance(extracted, str):  # variadic extraction
+        if tuple(declared) == (extracted,):
+            return None
+        return (f"variadic {extracted} specs vs declared "
+                f"({', '.join(declared)})")
+    if any(f.startswith(VARIADIC) for f in declared):
+        base = declared[0].lstrip(VARIADIC)
+        want = trim_spec(reg.families.get(base, ()))
+        if all(trim_spec(s) == want for s in extracted):
+            return None
+        return f"declared *{base} but a spec differs"
+    if len(extracted) != len(declared):
+        return (f"{len(extracted)} specs vs {len(declared)} declared")
+    for i, (spec, fam) in enumerate(zip(extracted, declared)):
+        if trim_spec(spec) != trim_spec(reg.families.get(fam, ("?",))):
+            return (f"position {i}: {format_spec(spec)} != declared "
+                    f"{fam} {_format_family(reg, fam)}")
+    return None
+
+
+def _is_passthrough(call: ast.Call, fns: List[ast.FunctionDef]) -> bool:
+    """A compat shim forwarding its own in_specs/out_specs parameters
+    (``ops/sharded.py``'s pre-0.6 shard_map wrapper) is not a spec site."""
+    if not fns:
+        return False
+    params = set()
+    for fn in fns:
+        a = fn.args
+        params |= {p.arg for p in a.args + a.kwonlyargs + a.posonlyargs}
+    names = []
+    for kw in call.keywords:
+        if kw.arg in ("in_specs", "out_specs"):
+            if not isinstance(kw.value, ast.Name):
+                return False
+            names.append(kw.value.id)
+    return len(names) == 2 and all(n in params for n in names)
+
+
+def _check_sites(
+    reg: ShardRegistry, mod: PyModule, env: _AxisEnv
+) -> List[Finding]:
+    out: List[Finding] = []
+    enclosing = _enclosing_functions(mod.tree)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        leaf = d.rsplit(".", 1)[-1] if d else None
+        if leaf is None:
+            continue
+
+        if leaf.endswith("shard_map"):
+            fns = enclosing.get(node, [])
+            if _is_passthrough(node, fns):
+                continue
+            kw = {k.arg: k.value for k in node.keywords}
+            specs: Dict[str, Union[List[Union[Spec, str]], str, None]] = {}
+            for slot in ("in_specs", "out_specs"):
+                if slot not in kw:
+                    specs[slot] = None
+                    continue
+                got = _extract_spec_list(kw[slot], env)
+                specs[slot] = got
+                bad_specs = [
+                    s for s in (got if isinstance(got, list) else [])
+                    if s == "?" or (
+                        isinstance(s, tuple) and reg.family_of(s) is None
+                    )
+                ]
+                for s in bad_specs:
+                    out.append(Finding(
+                        RULE, mod.path, node.lineno,
+                        f"{slot} carries "
+                        + ("an unresolvable axis name" if s == "?" else
+                           f"undeclared sharding {format_spec(s)}")
+                        + ": every spec must be a SHARDING family "
+                          "(ops/layout.py)",
+                    ))
+                if isinstance(got, str) and got.endswith("?"):
+                    out.append(Finding(
+                        RULE, mod.path, node.lineno,
+                        f"variadic {slot} does not extract to a declared "
+                        "family",
+                    ))
+            site = _match_site(
+                reg, mod.path, fns[-1].name if fns else "<module>"
+            )
+            if site is None:
+                out.append(Finding(
+                    RULE, mod.path, node.lineno,
+                    f"unregistered shard_map site "
+                    f"{_site_key(mod, fns)}: declare it in ops/layout.py "
+                    "SHARD_SITES with a COLLECTIVE_BUDGET entry",
+                ))
+                continue
+            sig = reg.sites[site]
+            for slot, decl_key in (("in_specs", "in"), ("out_specs", "out")):
+                got = specs[slot]
+                if got is None:
+                    continue  # dynamic construction: runtime shardcheck's job
+                if isinstance(got, list) and any(
+                    s == "?" or reg.family_of(s) is None  # type: ignore[arg-type]
+                    for s in got
+                ):
+                    continue  # already reported above
+                mismatch = _check_families(reg, got, sig[decl_key])
+                if mismatch:
+                    out.append(Finding(
+                        RULE, mod.path, node.lineno,
+                        f"{site} {slot} mismatch vs SHARD_SITES: {mismatch}",
+                    ))
+            # Loop-carried donated buffers: out-spec == in-spec.
+            ins, outs = specs["in_specs"], specs["out_specs"]
+            if isinstance(ins, list) and isinstance(outs, list):
+                for pair in sig["carry"]:
+                    if len(pair) != 2:
+                        continue  # malformed: _check_registry reported it
+                    i, o = pair
+                    if (
+                        i < len(ins) and o < len(outs)
+                        and isinstance(ins[i], tuple)
+                        and isinstance(outs[o], tuple)
+                        and trim_spec(ins[i]) != trim_spec(outs[o])
+                    ):
+                        out.append(Finding(
+                            RULE, mod.path, node.lineno,
+                            f"{site} loop-carried buffer {i} is donated "
+                            f"with in-spec {format_spec(ins[i])} but "
+                            f"out-spec {format_spec(outs[o])}: carries "
+                            "must keep out_specs == in_specs (pjit "
+                            "pre-partitioning)",
+                        ))
+
+        elif leaf in ("NamedSharding", "with_sharding_constraint"):
+            for arg in node.args:
+                if not _is_p_call(arg):
+                    continue
+                spec = _extract_spec(arg, env)
+                if spec is None:
+                    continue
+                if spec == "?" or reg.family_of(spec) is None:
+                    out.append(Finding(
+                        RULE, mod.path, node.lineno,
+                        f"{leaf} carries "
+                        + ("an unresolvable axis name" if spec == "?" else
+                           f"undeclared sharding {format_spec(spec)}")
+                        + ": every spec must be a SHARDING family "
+                          "(ops/layout.py)",
+                    ))
+    return out
+
+
+# -- host materialization -----------------------------------------------------
+
+_MATERIALIZE_LEAVES = ("asarray", "array", "device_get")
+
+
+def _check_host_materialization(
+    reg: ShardRegistry, mod: PyModule, bindings: Tuple[str, ...]
+) -> List[Finding]:
+    out: List[Finding] = []
+    enclosing = _enclosing_functions(mod.tree)
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        d = dotted(node.func)
+        if d is None or d.rsplit(".", 1)[-1] not in _MATERIALIZE_LEAVES:
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Name) and arg.id in bindings):
+            continue
+        fns = enclosing.get(node, [])
+        if any(fn.name in _READBACK_FNS for fn in fns):
+            continue
+        out.append(Finding(
+            RULE, mod.path, node.lineno,
+            f"host materialization of registry-sharded buffer "
+            f"'{arg.id}' outside readback(): mid-cycle collect of "
+            "(possibly) node-sharded state",
+        ))
+    return out
+
+
+# -- doc tables ---------------------------------------------------------------
+
+def _check_doc(repo: Repo, reg: ShardRegistry) -> List[Finding]:
+    if not reg.doc_path:
+        return []
+    out: List[Finding] = []
+    doc = next((d for d in repo.docs if d.path == reg.doc_path), None)
+    if doc is None:
+        return []  # doc-targets subsetting (--changed) may omit it
+    lines = doc.text.splitlines()
+    for ns, table in (
+        ("SHARDING", render_family_table(reg)),
+        ("SHARD_SITES", render_site_table(reg)),
+    ):
+        begin, end = marker_lines(ns)
+        try:
+            b = lines.index(begin)
+            e = lines.index(end, b)
+        except ValueError:
+            out.append(Finding(
+                RULE, reg.doc_path, 1,
+                f"missing generated sharding table for {ns} (run "
+                "scripts/gen_layout_doc.py)",
+            ))
+            continue
+        got = [ln.strip() for ln in lines[b + 1 : e] if ln.strip()]
+        if got != table:
+            out.append(Finding(
+                RULE, reg.doc_path, b + 1,
+                f"sharding table for {ns} is stale (run "
+                "scripts/gen_layout_doc.py)",
+            ))
+    return out
+
+
+# -- the pass -----------------------------------------------------------------
+
+@register(RULE)
+def sharding(repo: Repo) -> List[Finding]:
+    layout_mod = repo.module(LAYOUT_SUFFIX)
+    if layout_mod is None:
+        return []
+    reg = parse_shard_registry(layout_mod.text, layout_mod.path)
+    if not reg.families:
+        return []
+    out = _check_registry(reg)
+
+    for mod in repo.modules:
+        if mod.path == layout_mod.path:
+            continue
+        # The registry governs the ENGINE: ops/ modules only (env-drift's
+        # scoping rule).  Tests and measurement drivers build ad-hoc
+        # shard_map probes on purpose; the parity suites pin those.
+        if not ("/ops/" in f"/{mod.path}" or mod.path.startswith("ops/")):
+            continue
+        env = _AxisEnv(reg, mod)
+        out.extend(env.pin_findings)
+        out.extend(_check_sites(reg, mod, env))
+        for suffix, names in reg.host_bindings.items():
+            if mod.path == suffix or mod.path.endswith("/" + suffix):
+                out.extend(_check_host_materialization(reg, mod, names))
+    out.extend(_check_doc(repo, reg))
+    return out

@@ -69,6 +69,11 @@ _ENV_KEYS = (
     "SCHEDULER_TPU_FUSED_STATIC_LIMIT",
     "SCHEDULER_TPU_COHORT",
     "SCHEDULER_TPU_QUEUE_DELTA",
+    # Shardcheck (utils/shardcheck.py) only READS live shardings at
+    # dispatch/readback — it never changes the traced program — but a
+    # resident engine must not straddle a flag flip mid-diagnosis: keyed so
+    # arming the sanitizer always starts from a fresh, fully-checked build.
+    "SCHEDULER_TPU_SHARDCHECK",
 )
 
 _scope_counter = itertools.count(1)

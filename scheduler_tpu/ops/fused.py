@@ -2134,11 +2134,14 @@ class FusedAllocator:
         bookkeeping) before paying the blocking collect."""
         if self._dev is not None:
             return
-        from scheduler_tpu.utils import sanitize
+        from scheduler_tpu.utils import sanitize, shardcheck
 
         if self.use_mega:
             from scheduler_tpu.ops import megakernel as _mk
 
+            # Whole-loop kernel operands run REPLICATED on a mesh by design
+            # (docs/DEVICE_ENGINE.md): every position checks as replicated.
+            shardcheck.check_dispatch(self._mesh, self._mega_args, families=())
             try:
                 with sanitize.guard():
                     self._dev, self._dev_stats = _mk.mega_allocate(
@@ -2151,6 +2154,11 @@ class FusedAllocator:
                 logger.exception("mega kernel failed; falling back to XLA path")
                 self.use_mega = False
         self._dev_stats = None
+        # SCHEDULER_TPU_SHARDCHECK=1: every staged input's live .sharding
+        # against the registry family of its position (utils/shardcheck.py)
+        # — a mis-sharded buffer computes the right answer through silent
+        # resharding collectives, so only this check catches it.
+        shardcheck.check_dispatch(self._mesh, self.args)
         # Under SCHEDULER_TPU_SANITIZE the launch runs inside a transfer
         # guard: every program input must already be device-resident (the
         # engine stages via transfer_cache.to_device / device_put), so an
@@ -2181,8 +2189,12 @@ class FusedAllocator:
             self.dispatch()
         dev, self._dev = self._dev, None
         stats_dev, self._dev_stats = self._dev_stats, None
-        from scheduler_tpu.utils import sanitize
+        from scheduler_tpu.utils import sanitize, shardcheck
 
+        # Placement codes and stats are per-task/per-counter values: they
+        # must come back replicated, never node-sharded (out_specs drift).
+        shardcheck.check_result(self._mesh, dev)
+        shardcheck.check_result(self._mesh, stats_dev, where="readback.stats")
         try:
             with sanitize.guard():
                 encoded = self._readback(dev)

@@ -229,6 +229,131 @@ class STEP_NODE:
     IDLE = 0
 
 
+# -- sharding registry (schedlint ``sharding`` pass; docs/SHARDING.md) --------
+#
+# The sharded engine's comm contract used to live in a docstring
+# (``ops/sharded.py``: "per task, the only ICI traffic is the D candidate
+# tuples / one small all-gather per scan step").  Like the row layouts above,
+# that contract is an API between modules — the shard_map sites that declare
+# specs, the mesh staging that places buffers, the runtime that reads them
+# back — so it is declared HERE as data and verified three ways:
+# statically (``analysis/sharding.py`` walks every shard_map/NamedSharding
+# site against these tables), at compile time (``scripts/shard_budget.py``
+# AOT-lowers the sharded engine on a simulated mesh and counts collectives
+# in the compiled HLO against COLLECTIVE_BUDGET), and at runtime
+# (``utils/shardcheck.py``, SCHEDULER_TPU_SHARDCHECK=1, asserts live
+# ``.sharding`` at dispatch/readback).  Everything literal, same contract as
+# the row registry.
+
+# The one mesh axis: ops code references it as ``sharded.NODE_AXIS``; the
+# sharding pass checks the module-level assignment still carries this value.
+SHARD_AXES = {"NODE_AXIS": "nodes"}
+
+# Buffer families -> PartitionSpec argument tuple (None = replicated axis).
+SHARDING = {
+    "node_major": ("nodes",),
+    "node_trailing": (None, "nodes"),
+    "replicated": (),
+}
+
+# Per-call-site shard_map signatures, keyed "module suffix::enclosing def".
+# ``"*replicated"`` is the variadic form (``tuple(P() for _ in operands)``).
+# ``carry`` pairs (in_index, out_index) are loop-carried (donated on the
+# engine-cache hit path) buffers whose out-spec MUST equal their in-spec —
+# the pjit pre-partitioning rule the multi-host GSPMD refactor relies on.
+SHARD_SITES = {
+    "ops/sharded.py::sharded_place_scan": {
+        "in": ("node_major", "node_major", "node_major", "node_major",
+               "node_major", "replicated", "replicated", "replicated",
+               "node_trailing", "node_trailing", "replicated", "replicated"),
+        "out": ("node_major", "node_major", "node_major",
+                "replicated", "replicated", "replicated"),
+        "carry": ((0, 0), (1, 1), (2, 2)),
+    },
+    "ops/sharded.py::sharded_selector_mask": {
+        "in": ("replicated", "node_major"),
+        "out": ("node_trailing",),
+    },
+    "ops/fused.py::step_select": {
+        "in": ("node_trailing", "node_trailing", "node_trailing",
+               "node_trailing", "node_trailing", "node_trailing",
+               "replicated", "replicated", "replicated", "replicated"),
+        "out": ("replicated", "replicated", "replicated", "replicated",
+                "replicated"),
+    },
+    "ops/megakernel.py::mega_allocate": {
+        "in": ("*replicated",),
+        "out": ("replicated", "replicated"),
+    },
+}
+
+# Per-site collective budget in the COMPILED HLO, counted per loop step
+# (collectives inside the scan/while body appear once in the HLO text).
+# The scan step's contract: exactly ONE all-gather — the WINNER-tuple-width
+# candidate gather — and zero all-reduces/permutes.  Any collective kind not
+# listed budgets to zero.  ``scripts/shard_budget.py`` enforces the sites it
+# can lower standalone; the sharding pass checks every site declares one.
+COLLECTIVE_BUDGET = {
+    "ops/sharded.py::sharded_place_scan": {
+        "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
+    },
+    "ops/sharded.py::sharded_selector_mask": {
+        "all-gather": 0, "all-reduce": 0, "collective-permute": 0,
+    },
+    "ops/fused.py::step_select": {
+        "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
+    },
+    "ops/megakernel.py::mega_allocate": {
+        "all-gather": 0, "all-reduce": 0, "collective-permute": 0,
+    },
+}
+
+# Host-materialization guard: local names bound to registry-sharded device
+# values per module.  ``np.asarray``/``jax.device_get`` of these outside
+# ``readback()``/``_readback()`` is a mid-cycle collect of (possibly)
+# node-sharded state — the exact host-sync class the pipelined cycle bans.
+SHARDED_HOST_BINDINGS = {
+    "ops/fused.py": ("dev", "stats_dev"),
+}
+
+# ``fused_allocate`` positional argument families: the ONE row both the mesh
+# staging (``ops/mesh.py`` shard_fused_args) and the runtime shardcheck
+# (``utils/shardcheck.py``) derive their spec lists from.  Positions past
+# the tuple are replicated (job/queue/task tables, scalars).  The
+# node_trailing entries degrade to replicated when the static tensors are
+# [*, 1] dummies (use_static off) — a unit axis cannot shard.
+FUSED_ARG_FAMILIES = (
+    "node_major",      # idle [N, R]
+    "node_major",      # releasing [N, R]
+    "node_major",      # task_count [N]
+    "node_major",      # allocatable [N, R]
+    "node_major",      # pods_limit [N]
+    "node_major",      # node_gate [N]
+    "replicated",      # mins [R]
+    "replicated",      # init_resreq [T, R]
+    "replicated",      # resreq [T, R]
+    "node_trailing",   # static_mask [T, N]
+    "node_trailing",   # static_score [T, N]
+)
+
+# Generated sharding tables (docs/SHARDING.md, between
+# ``<!-- layout:SHARDING/SHARD_SITES:begin/end -->`` markers), rendered by
+# scripts/gen_layout_doc.py and drift-checked by the sharding pass.
+SHARD_DOC = "docs/SHARDING.md"
+
+SHARD_DOC_ROWS = {
+    "node_major": "[N, …] node ledgers and vectors (idle / releasing / "
+                  "task-count / allocatable / pods-limit / gate): rows "
+                  "split over the mesh; only the owning chip mutates its "
+                  "shard",
+    "node_trailing": "[T, N] / [rows, N] node-lane matrices (static "
+                     "mask/score, kernel-layout ledgers): trailing node "
+                     "axis split, leading axes replicated",
+    "replicated": "job/queue/task tables, winner tuples, scalars: "
+                  "identical on every chip",
+}
+
+
 # -- derived helpers (runtime convenience; NOT parsed by the pass) ------------
 
 def node_scratch_rows(has_releasing: bool) -> int:

@@ -74,18 +74,17 @@ def get_mesh():
 def shard_fused_args(mesh, args: Tuple) -> Tuple:
     """Place ``FusedAllocator.args`` onto the mesh: node-axis tensors shard
     over NODE_AXIS, [T, N] static tensors shard on their node axis, and
-    everything else replicates.  Positions follow ``fused_allocate``'s
-    signature.  Both mesh size and node buckets are powers of two, so the
-    axis divides whenever the bucket is at least mesh-sized; tiny clusters
-    (bucket < mesh) stay single-chip rather than crash device_put."""
+    everything else replicates.  The position->family row is the sharding
+    registry's ``FUSED_ARG_FAMILIES`` (ops/layout.py) — the SAME data the
+    runtime shardcheck asserts against at dispatch, so staging and check
+    can never drift.  Both mesh size and node buckets are powers of two, so
+    the axis divides whenever the bucket is at least mesh-sized; tiny
+    clusters (bucket < mesh) stay single-chip rather than crash
+    device_put."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from scheduler_tpu.ops.sharded import (
-        NODE_AXIS,
-        node_sharding,
-        task_node_sharding,
-    )
+    from scheduler_tpu.ops.layout import FUSED_ARG_FAMILIES, SHARDING
 
     n_bucket = args[0].shape[0]
     if n_bucket % mesh.size != 0:
@@ -95,26 +94,17 @@ def shard_fused_args(mesh, args: Tuple) -> Tuple:
         )
         return args
 
-    node0 = node_sharding(mesh)
-    rep = NamedSharding(mesh, P())
+    by_family = {
+        fam: NamedSharding(mesh, P(*spec)) for fam, spec in SHARDING.items()
+    }
 
-    def static_spec(a):
+    def spec_for(i, a):
+        fam = FUSED_ARG_FAMILIES[i] if i < len(FUSED_ARG_FAMILIES) else "replicated"
         # [1, 1] dummies (use_static off) cannot shard their unit axis.
-        if a.ndim == 2 and a.shape[1] > 1:
-            return task_node_sharding(mesh)
-        return rep
+        if fam == "node_trailing" and not (a.ndim == 2 and a.shape[1] > 1):
+            fam = "replicated"
+        return by_family[fam]
 
-    specs = [
-        node0,            # idle [N, R]
-        node0,            # releasing [N, R]
-        node0,            # task_count [N]
-        node0,            # allocatable [N, R]
-        node0,            # pods_limit [N]
-        node0,            # node_gate [N]
-        rep,              # mins [R]
-        rep,              # init_resreq [T, R]
-        rep,              # resreq [T, R]
-        static_spec(args[9]),   # static_mask [T, N]
-        static_spec(args[10]),  # static_score [T, N]
-    ] + [rep] * (len(args) - 11)
-    return tuple(jax.device_put(a, s) for a, s in zip(args, specs))
+    return tuple(
+        jax.device_put(a, spec_for(i, a)) for i, a in enumerate(args)
+    )

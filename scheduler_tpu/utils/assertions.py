@@ -8,7 +8,6 @@ the test suite does to catch resource-arithmetic bugs early.
 from __future__ import annotations
 
 import logging
-import os
 import traceback
 from typing import Callable, Union
 
@@ -20,7 +19,11 @@ class AssertionViolation(AssertionError):
 
 
 def _panic_on_error() -> bool:
-    return os.environ.get("PANIC_ON_ERROR", "").lower() in ("1", "true", "yes")
+    from scheduler_tpu.utils.envflags import env_bool
+
+    # Unset -> log-and-continue (the reference default); malformed values
+    # warn once and keep that default instead of silently counting as off.
+    return env_bool("PANIC_ON_ERROR", False)
 
 
 def assert_that(condition: bool, message: Union[str, Callable[[], str]]) -> None:

@@ -1,0 +1,132 @@
+"""``SCHEDULER_TPU_SHARDCHECK=1``: runtime half of the sharding registry.
+
+The static ``sharding`` pass (``scheduler_tpu/analysis/sharding.py``) proves
+the *declared* specs at every shard_map/NamedSharding site, and
+``scripts/shard_budget.py`` proves the *compiled* collective pattern; this
+module proves the *live* one, the ``SANITIZE``/``TSAN`` precedent applied to
+placement: at dispatch and readback, every engine buffer's actual
+``.sharding`` is checked against the family the registry
+(``ops/layout.py`` ``FUSED_ARG_FAMILIES`` / ``SHARDING``) declares for its
+position.  The failure class is silent: a replicated table accidentally
+node-sharded (or a ledger resharded onto the wrong axis) still computes the
+right answer — GSPMD inserts resharding collectives — it just turns the
+one-all-gather-per-step contract into per-step ledger traffic.
+
+Check semantics (degradation-tolerant by design):
+
+* an array with no ``.sharding`` (host numpy mid-staging) or a
+  non-NamedSharding placement (single-device default) is never partitioned
+  — always consistent;
+* a fully-REPLICATED NamedSharding is consistent with every family (the
+  mega whole-loop kernel runs replicated on purpose; small clusters degrade
+  to replication when the node bucket cannot divide the mesh);
+* a PARTITIONED NamedSharding must match its family's spec exactly — a
+  replicated-family buffer partitioned over any axis, or a node-family
+  buffer partitioned differently than declared, is a violation.
+
+Violations are counted (``violations()`` -> bench ``detail.shardcheck``)
+and routed through ``utils/assertions.assert_that`` — loud log by default,
+raise under ``PANIC_ON_ERROR`` (the test regime).  Zero cost when off:
+every entry point checks one env flag.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence, Tuple
+
+logger = logging.getLogger("scheduler_tpu.utils.shardcheck")
+
+_violation_log: list = []
+
+
+def enabled() -> bool:
+    from scheduler_tpu.utils.envflags import env_bool
+
+    return env_bool("SCHEDULER_TPU_SHARDCHECK", False)
+
+
+def violations() -> int:
+    return len(_violation_log)
+
+
+def violation_log() -> list:
+    return list(_violation_log)
+
+
+def reset() -> None:
+    _violation_log.clear()
+
+
+def _record(where: str, what: str, msg: str) -> None:
+    from scheduler_tpu.utils.assertions import assert_that
+
+    _violation_log.append({"where": where, "what": what, "msg": msg})
+    assert_that(False, f"shardcheck[{where}] {what}: {msg}")
+
+
+def _trim(spec: Sequence) -> Tuple:
+    """Spec tuple without trailing replicated axes — the ONE normalization
+    rule (``analysis/sharding.trim_spec``), shared with the static pass so
+    runtime check and lint can never disagree on what matches a family."""
+    from scheduler_tpu.analysis.sharding import trim_spec
+
+    return trim_spec(tuple(spec))
+
+
+def _partition_of(a) -> Optional[Tuple]:
+    """The array's trimmed partition tuple, or None when it cannot be
+    partitioned (no sharding metadata / single-device / non-named)."""
+    sh = getattr(a, "sharding", None)
+    if sh is None:
+        return None
+    spec = getattr(sh, "spec", None)
+    if spec is None:
+        return None
+    return _trim(tuple(spec))
+
+
+def _family_spec(fam: str) -> Tuple:
+    from scheduler_tpu.ops.layout import SHARDING
+
+    return _trim(SHARDING[fam])
+
+
+def _check_one(a, fam: str, where: str, what: str) -> None:
+    got = _partition_of(a)
+    if got is None or got == ():
+        return  # unpartitioned / replicated: consistent with every family
+    want = _family_spec(fam)
+    if got != want:
+        _record(
+            where, what,
+            f"sharding {got} does not match registry family '{fam}' "
+            f"{want} (ops/layout.py SHARDING)",
+        )
+
+
+def check_dispatch(mesh, args: Sequence, families: Optional[Sequence[str]] = None,
+                   where: str = "dispatch") -> None:
+    """Assert the device program's inputs against the registry.  With
+    ``families=None`` the positional row is ``FUSED_ARG_FAMILIES``
+    (positions past it replicated); pass ``families=()`` for the
+    all-replicated mega operands.  ``mesh`` is accepted for symmetry with
+    the staging seam — the check itself reads each array's live sharding,
+    so it also covers the mesh-off regime (nothing may be partitioned)."""
+    if not enabled():
+        return
+    if families is None:
+        from scheduler_tpu.ops.layout import FUSED_ARG_FAMILIES
+
+        families = FUSED_ARG_FAMILIES
+    for i, a in enumerate(args):
+        fam = families[i] if i < len(families) else "replicated"
+        _check_one(a, fam, where, f"arg[{i}]")
+
+
+def check_result(mesh, dev, where: str = "readback") -> None:
+    """The placement-code (and stats) outputs are per-task values — they
+    must come back replicated/unpartitioned, never node-sharded."""
+    if not enabled() or dev is None:
+        return
+    _check_one(dev, "replicated", where, "result")

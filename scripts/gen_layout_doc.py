@@ -1,11 +1,14 @@
-"""Regenerate the scratch/stats row tables in the docs from the layout
-registry (``scheduler_tpu/ops/layout.py``).
+"""Regenerate the scratch/stats row AND sharding tables in the docs from
+the layout registry (``scheduler_tpu/ops/layout.py``).
 
-The registry's ``DOC_TABLES`` names which namespaces render into which doc;
-each table lives between ``<!-- layout:NS:begin … -->`` / ``<!-- layout:NS:end -->``
-markers.  The rendering is the ONE in ``analysis/row_layout.py`` — the same
-function schedlint's ``row-layout`` pass uses for the drift check, so a doc
-this script wrote can never fail the gate.
+The registry's ``DOC_TABLES`` names which row namespaces render into which
+doc, and ``SHARD_DOC`` names the doc carrying the sharding family and
+shard-site/budget tables; each table lives between
+``<!-- layout:NS:begin … -->`` / ``<!-- layout:NS:end -->`` markers.  The
+renderings are the ONES in ``analysis/row_layout.py`` /
+``analysis/sharding.py`` — the same functions schedlint's ``row-layout``
+and ``sharding`` passes use for the drift checks, so a doc this script
+wrote can never fail the gate.
 
 Usage:
   python scripts/gen_layout_doc.py          # rewrite the tables in place
@@ -32,16 +35,38 @@ def main() -> int:
     from scheduler_tpu.analysis.row_layout import (
         marker_lines, parse_registry_source, render_table,
     )
+    from scheduler_tpu.analysis.sharding import (
+        parse_shard_registry, render_family_table, render_site_table,
+    )
 
-    reg = parse_registry_source(LAYOUT_PATH.read_text())
+    source = LAYOUT_PATH.read_text()
+    reg = parse_registry_source(source)
+    sreg = parse_shard_registry(source)
     stale = 0
     missing = 0
-    for rel, namespaces in sorted(reg.doc_tables.items()):
+
+    # {doc: [(namespace, rendered table), ...]} — row tables plus the
+    # sharding family/site tables, one rewrite loop for all of them.
+    plans = {
+        rel: [(ns, render_table(reg, ns)) for ns in namespaces]
+        for rel, namespaces in sorted(reg.doc_tables.items())
+    }
+    if sreg.doc_path:
+        plans.setdefault(sreg.doc_path, []).extend([
+            ("SHARDING", render_family_table(sreg)),
+            ("SHARD_SITES", render_site_table(sreg)),
+        ])
+
+    for rel, tables in sorted(plans.items()):
         doc = ROOT / rel
+        if not doc.exists():
+            print(f"{rel}: missing doc — create it with the markers for "
+                  + ", ".join(ns for ns, _ in tables))
+            missing += len(tables)
+            continue
         lines = doc.read_text().splitlines()
-        for ns in namespaces:
+        for ns, table in tables:
             begin, end = marker_lines(ns)
-            table = render_table(reg, ns)
             try:
                 b = lines.index(begin)
                 e = lines.index(end, b)
@@ -49,8 +74,8 @@ def main() -> int:
                 print(f"{rel}: no {ns} markers — add\n  {begin}\n  {end}")
                 missing += 1
                 continue
-            # Same per-line strip as the row-layout pass's drift check, so
-            # the two gates can never disagree on one tree.
+            # Same per-line strip as the analysis passes' drift checks, so
+            # the gates can never disagree on one tree.
             if [ln.strip() for ln in lines[b + 1 : e] if ln.strip()] != table:
                 stale += 1
                 if args.check:

@@ -12,16 +12,23 @@ tree and exits non-zero on findings:
   row-layout  scratch/stats rows go through ops/layout.py: no bare row
               literals, no collisions, per-flavor read-implies-write
               dataflow, stats evidence round-trips to the bench artifact
+  sharding    shard_map/NamedSharding specs, loop-carry donation and
+              collective budgets go through the ops/layout.py sharding
+              registry (the compiled-HLO budget half is
+              scripts/shard_budget.py; both run under ``make lint``)
   hygiene     whitespace + unused imports (the former scripts/lint.py)
 
 Usage: python scripts/schedlint.py [--rules r1,r2] [--list-rules] [--json]
                                    [--changed]
 
-``--changed`` restricts analysis to files touched since HEAD (``git diff``
-+ untracked) for a fast pre-commit run.  Cross-module passes see the few
-anchor modules they need (the env-key and row-layout registries) but
-findings are reported for changed files only — the full gate is the
-authority (``make lint`` / CI).
+``--changed`` analyzes the files touched since HEAD (``git diff`` +
+untracked) PLUS their transitive reverse dependencies in the in-repo
+import graph, for a fast pre-commit run.  Round 5 shipped this mode as a
+documented under-approximation — a change to ``ops/layout.py`` silently
+dropped the row-layout findings it caused in ``ops/megakernel.py`` —
+so the changed set now expands through "who imports me" edges before
+analysis, and findings are reported for the whole expanded set.  The full
+gate (``make lint`` / CI) remains the authority for doc-target subsetting.
 """
 
 from __future__ import annotations
@@ -80,6 +87,79 @@ def _in_scope_py(rel: str) -> bool:
     )
 
 
+def _scope_files() -> "list[str]":
+    """Every analyzable .py path under PY_TARGETS (repo-relative)."""
+    out: list[str] = []
+    for target in PY_TARGETS:
+        p = ROOT / target
+        if p.is_dir():
+            out.extend(
+                f.relative_to(ROOT).as_posix()
+                for f in sorted(p.rglob("*.py"))
+                if "__pycache__" not in f.parts
+            )
+        elif p.suffix == ".py" and p.exists():
+            out.append(target)
+    return out
+
+
+def _imported_files(tree, known: "set[str]") -> "set[str]":
+    """Repo-relative files an AST imports, resolved against ``known``
+    (``a.b.c`` -> a/b/c.py or a/b/c/__init__.py; ``from a.b import c``
+    also tries a/b/c.py)."""
+    import ast
+
+    def paths_of(module: str) -> "list[str]":
+        base = module.replace(".", "/")
+        return [base + ".py", base + "/__init__.py"]
+
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        candidates: list[str] = []
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                candidates.extend(paths_of(a.name))
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            candidates.extend(paths_of(node.module))
+            for a in node.names:
+                candidates.extend(paths_of(f"{node.module}.{a.name}"))
+        out.update(c for c in candidates if c in known)
+    return out
+
+
+def _expand_reverse_deps(changed_py: "list[str]") -> "set[str]":
+    """The changed set plus its transitive REVERSE dependencies: a finding
+    caused by an edit often lands in the module that IMPORTS the edited one
+    (a registry row removed from ops/layout.py trips row-layout in
+    megakernel.py), so the fast mode must analyze those too.
+
+    Cost note: building the graph parses every in-scope file, and the Repo
+    re-parses the expanded subset — correctness bought back at ~20% speedup
+    over the full gate instead of the old mode's larger-but-unsound one.
+    The win scales with diff locality (a leaf-module edit analyzes a
+    handful of files); registry edits legitimately pull in most of ops/."""
+    import ast
+
+    files = _scope_files()
+    known = set(files)
+    importers: "dict[str, set[str]]" = {}
+    for rel in files:
+        try:
+            tree = ast.parse((ROOT / rel).read_text())
+        except (OSError, SyntaxError):
+            continue
+        for dep in _imported_files(tree, known):
+            importers.setdefault(dep, set()).add(rel)
+    expanded = set(changed_py)
+    frontier = list(changed_py)
+    while frontier:
+        for rel in importers.get(frontier.pop(), ()):
+            if rel not in expanded:
+                expanded.add(rel)
+                frontier.append(rel)
+    return expanded
+
+
 def _in_scope_doc(rel: str) -> bool:
     return rel == "README.md" or (
         rel.startswith("docs/") and rel.endswith(".md") and "/" not in rel[5:]
@@ -106,8 +186,12 @@ def main() -> int:
 
     t0 = time.perf_counter()
     changed = _git_changed() if args.changed else None
+    expanded: "set[str] | None" = None
     if args.changed and changed is not None:
-        py = [p for p in changed if _in_scope_py(p)]
+        expanded = _expand_reverse_deps(
+            [p for p in changed if _in_scope_py(p)]
+        )
+        py = sorted(expanded)
         py += [a for a in CHANGED_ANCHORS if a not in py]
         docs = [p for p in changed if _in_scope_doc(p)]
         repo = Repo.from_root(ROOT, tuple(py), tuple(docs))
@@ -116,7 +200,7 @@ def main() -> int:
     rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
     findings = run_passes(repo, rules)
     if args.changed and changed is not None:
-        keep = set(changed)
+        keep = set(changed) | (expanded or set())
         findings = [f for f in findings if f.path in keep]
     elapsed = time.perf_counter() - t0
 
@@ -128,10 +212,16 @@ def main() -> int:
     else:
         for f in findings:
             print(f)
+        extra = ""
+        if args.changed and changed is not None:
+            n_changed = sum(1 for p in changed if _in_scope_py(p))
+            extra = (
+                f" [--changed: {n_changed} edited + "
+                f"{len(expanded or ()) - n_changed} reverse deps]"
+            )
         print(
             f"schedlint: {len(repo.modules)} modules, {len(repo.docs)} docs, "
-            f"{len(findings)} finding(s), {elapsed:.2f}s"
-            + (" [--changed]" if args.changed else "")
+            f"{len(findings)} finding(s), {elapsed:.2f}s" + extra
         )
     return 1 if findings else 0
 

@@ -1,0 +1,200 @@
+"""Compiled-HLO collective-budget check for the sharded engine.
+
+The sharding registry (``scheduler_tpu/ops/layout.py`` ``COLLECTIVE_BUDGET``)
+declares, per shard_map site, how many collectives of each kind the compiled
+program may run per loop step — the scan step's contract is exactly ONE
+all-gather (the WINNER-tuple candidate gather) and zero all-reduces.  The
+static ``sharding`` pass proves the *specs*; this script proves the
+*compiled collective pattern*: it AOT-lowers the standalone sharded entry
+points at a small shape on a simulated mesh
+(``--xla_force_host_platform_device_count``, CPU-friendly — no TPU needed),
+then counts ``all-gather``/``all-reduce``/``collective-permute`` (and any
+other collective, budgeted implicitly to zero) instructions in the
+optimized HLO text.  Collectives inside the scan's while body appear once
+in the text, so the count IS the per-step count.
+
+Run by ``make lint`` and the CI simulated-mesh job.  Exit non-zero when any
+site exceeds its declared budget — an accidental GSPMD-inferred collective
+(e.g. an argmax over a sharded axis resharding mid-step) fails the gate
+before it ships to a real pod.
+
+Usage: python scripts/shard_budget.py [--devices N] [--verbose]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+LAYOUT_PATH = ROOT / "scheduler_tpu" / "ops" / "layout.py"
+DEFAULT_DEVICES = 4
+
+
+def force_host_devices(n: int = DEFAULT_DEVICES) -> None:
+    """Simulate an ``n``-chip mesh on CPU.  MUST run before jax imports —
+    XLA reads the flag once at backend init."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+# Opcode position: after the "=" of an instruction definition, with any
+# result type in between — including tuple types ("(f32[...], f32[...])",
+# the shape async collectives ALWAYS carry) and tiled layouts
+# ("{1,0:T(8,128)}"), which is why this is "anything but a newline" rather
+# than a type-shaped character class.  The negative lookbehind keeps
+# operand REFERENCES (%all-gather.1) from matching; ``-start`` counts the
+# async op once at its definition and the paired ``-done`` (which ``(``
+# cannot follow directly) not at all.
+_COLLECTIVE_RE = re.compile(
+    r"=[^\n]*?(?<![\w%-])"
+    r"(all-gather|all-reduce|reduce-scatter|collective-permute|all-to-all)"
+    r"(?:-start)?\("
+)
+
+
+def count_collectives(hlo_text: str) -> dict:
+    """{collective kind: instruction count} over compiled HLO text."""
+    counts: dict = {}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
+
+
+def check_counts(site: str, counts: dict, budget: dict) -> list:
+    """Budget findings for one site (kinds absent from the budget allow
+    zero)."""
+    out = []
+    for kind, n in sorted(counts.items()):
+        allowed = budget.get(kind, 0)
+        if n > allowed:
+            out.append(
+                f"{site}: {n} {kind} op(s) in compiled HLO exceeds the "
+                f"declared budget of {allowed} per step "
+                f"(ops/layout.py COLLECTIVE_BUDGET)"
+            )
+    return out
+
+
+def _small_problem(n_nodes: int = 8, n_tasks: int = 4, r: int = 3) -> dict:
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    return dict(
+        idle=rng.uniform(1, 8, (n_nodes, r)).astype(np.float32),
+        releasing=rng.uniform(0, 2, (n_nodes, r)).astype(np.float32),
+        task_count=np.zeros(n_nodes, np.int32),
+        allocatable=rng.uniform(1, 8, (n_nodes, r)).astype(np.float32),
+        pods_limit=np.full(n_nodes, 10, np.int32),
+        mins=np.full(r, 1e-2, np.float32),
+        init_resreq=rng.uniform(0.5, 2, (n_tasks, r)).astype(np.float32),
+        resreq=rng.uniform(0.5, 2, (n_tasks, r)).astype(np.float32),
+        static_mask=np.ones((n_tasks, n_nodes), bool),
+        static_score=np.zeros((n_tasks, n_nodes), np.float32),
+        valid=np.ones(n_tasks, bool),
+        ready_deficit=np.asarray(100, np.int32),
+    )
+
+
+def _mesh(n: int):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from scheduler_tpu.ops.sharded import NODE_AXIS
+
+    devices = jax.devices()
+    if len(devices) < n:
+        raise SystemExit(
+            f"shard_budget: need {n} devices, have {len(devices)} — run "
+            "with XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{n} (set before jax initializes)"
+        )
+    return Mesh(np.array(devices[:n]), (NODE_AXIS,))
+
+
+def _hlo_place_scan(mesh) -> str:
+    import jax.numpy as jnp
+
+    from scheduler_tpu.ops.sharded import sharded_place_scan
+
+    p = _small_problem()
+    lowered = sharded_place_scan.lower(
+        *[jnp.asarray(v) for v in p.values()],
+        mesh=mesh, weights=(1.0, 1.0, 0.0), enforce_pod_count=True,
+    )
+    return lowered.compile().as_text()
+
+
+def _hlo_selector_mask(mesh) -> str:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scheduler_tpu.ops.sharded import sharded_selector_mask
+
+    rng = np.random.default_rng(0)
+    sel = rng.uniform(size=(4, 5)) > 0.5
+    labels = rng.uniform(size=(8, 5)) > 0.5
+    lowered = sharded_selector_mask.lower(
+        jnp.asarray(sel), jnp.asarray(labels), mesh=mesh
+    )
+    return lowered.compile().as_text()
+
+
+# Sites this script can lower standalone (the in-engine sites —
+# fused step_select, the replicated mega call — ride the same primitives
+# and are covered by the spec pass + the sharded parity tests).
+LOWERABLE = {
+    "ops/sharded.py::sharded_place_scan": _hlo_place_scan,
+    "ops/sharded.py::sharded_selector_mask": _hlo_selector_mask,
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--devices", type=int, default=DEFAULT_DEVICES)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+
+    force_host_devices(args.devices)
+
+    from scheduler_tpu.analysis.sharding import parse_shard_registry
+
+    reg = parse_shard_registry(LAYOUT_PATH.read_text())
+    if not reg.budgets:
+        print("shard_budget: no COLLECTIVE_BUDGET declared; nothing to check")
+        return 1
+
+    mesh = _mesh(args.devices)
+    failures = []
+    checked = 0
+    for site, lower in sorted(LOWERABLE.items()):
+        budget = reg.budgets.get(site)
+        if budget is None:
+            failures.append(f"{site}: lowerable site has no budget entry")
+            continue
+        counts = count_collectives(lower(mesh))
+        checked += 1
+        if args.verbose:
+            print(f"{site}: collectives={counts} budget={budget}")
+        failures.extend(check_counts(site, counts, budget))
+    for msg in failures:
+        print(msg)
+    print(
+        f"shard_budget: {checked} site(s) lowered on a {args.devices}-device "
+        f"simulated mesh, {len(failures)} finding(s)"
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

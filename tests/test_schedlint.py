@@ -471,3 +471,32 @@ def test_committed_tree_is_clean():
     repo = Repo.from_root(Path(cli.ROOT), cli.PY_TARGETS, cli.DOC_TARGETS)
     out = run_passes(repo)
     assert out == [], "\n".join(str(f) for f in out)
+
+
+# -- --changed reverse-dependency expansion -----------------------------------
+
+def test_changed_mode_expands_to_reverse_dependencies():
+    """PR-5's documented under-approximation, fixed: a --changed run seeded
+    with ops/layout.py must pull in the modules that (transitively) import
+    it, so cross-module findings (row-layout, sharding, env-drift links)
+    are not dropped."""
+    import importlib.util
+    from pathlib import Path
+
+    cli_path = Path(__file__).resolve().parent.parent / "scripts" / "schedlint.py"
+    spec = importlib.util.spec_from_file_location("schedlint_cli_rd", cli_path)
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+
+    expanded = cli._expand_reverse_deps(["scheduler_tpu/ops/layout.py"])
+    # Direct importers of the registry...
+    assert "scheduler_tpu/ops/megakernel.py" in expanded
+    assert "scheduler_tpu/ops/sharded.py" in expanded
+    # ...and transitive ones (fused imports megakernel/sharded; the engine
+    # cache imports fused; bench rides the whole stack through actions).
+    assert "scheduler_tpu/ops/fused.py" in expanded
+    assert "scheduler_tpu/ops/engine_cache.py" in expanded
+
+    # A leaf module with no importers expands to itself only.
+    leaf = cli._expand_reverse_deps(["bench.py"])
+    assert leaf == {"bench.py"}

@@ -1,0 +1,481 @@
+"""sharding regression corpus: the sharding-spec registry checks.
+
+Fixture pairs per sub-check (docs/SHARDING.md): spec mismatches vs the
+declared site families, undeclared specs/sites, loop-carry in!=out,
+host materialization outside readback, axis pinning, doc drift — plus the
+compiled-HLO collective budget (pass on the real scan, fail on a seeded
+extra all-gather), a 4-host-device ``two_level_winner`` parity test vs the
+single-chip argmax, the runtime shardcheck sanitizer, and the
+committed-tree gate.
+
+Device-count note: these tests need only FOUR devices (the CI
+simulated-mesh job forces exactly 4; the default conftest path forces 8
+and the tests use the first 4)."""
+
+from __future__ import annotations
+
+import textwrap
+
+import numpy as np
+import pytest
+
+from scheduler_tpu.analysis import Repo, run_passes
+from scheduler_tpu.analysis.row_layout import marker_lines
+from scheduler_tpu.analysis.sharding import (
+    parse_shard_registry,
+    render_family_table,
+    render_site_table,
+)
+
+
+def findings(py=None, docs=None, existing=()):
+    repo = Repo.from_sources(
+        py={k: textwrap.dedent(v) for k, v in (py or {}).items()},
+        docs={k: textwrap.dedent(v) for k, v in (docs or {}).items()},
+        existing=existing,
+    )
+    return run_passes(repo, ["sharding"])
+
+
+SLAYOUT = """
+    SHARD_AXES = {"NODE_AXIS": "nodes"}
+    SHARDING = {
+        "node_major": ("nodes",),
+        "node_trailing": (None, "nodes"),
+        "replicated": (),
+    }
+    SHARD_SITES = {
+        "ops/kern.py::scan": {
+            "in": ("node_major", "replicated"),
+            "out": ("node_major", "replicated"),
+            "carry": ((0, 0),),
+        },
+        "ops/kern.py::broadcast": {
+            "in": ("replicated", "replicated"),
+            "out": ("replicated",),
+        },
+    }
+    COLLECTIVE_BUDGET = {
+        "ops/kern.py::scan": {"all-gather": 1, "all-reduce": 0},
+        "ops/kern.py::broadcast": {"all-gather": 0, "all-reduce": 0},
+    }
+    SHARDED_HOST_BINDINGS = {"ops/kern.py": ("dev",)}
+    FUSED_ARG_FAMILIES = ("node_major", "replicated")
+    SHARD_DOC = ""
+    SHARD_DOC_ROWS = {}
+"""
+
+KERN_OK = """
+    NODE_AXIS = "nodes"
+
+    def scan(x, y, mesh):
+        return shard_map(
+            step, mesh=mesh,
+            in_specs=(P(NODE_AXIS), P()),
+            out_specs=(P(NODE_AXIS), P()),
+        )(x, y)
+"""
+
+
+def test_clean_declared_site_passes():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT,
+        "scheduler_tpu/ops/kern.py": KERN_OK,
+    })
+    assert out == [], "\n".join(str(f) for f in out)
+
+
+def test_replicated_site_is_not_a_false_positive():
+    """Replicated-buffer guard: an all-replicated site declared as such
+    must stay silent (the mega whole-loop pattern)."""
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT,
+        "scheduler_tpu/ops/kern.py": """
+            def broadcast(x, y, mesh):
+                return shard_map(
+                    body, mesh=mesh,
+                    in_specs=(P(), P()),
+                    out_specs=P(),
+                )(x, y)
+        """,
+    })
+    assert out == [], "\n".join(str(f) for f in out)
+
+
+def test_spec_mismatch_trips():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT,
+        "scheduler_tpu/ops/kern.py": KERN_OK.replace(
+            "in_specs=(P(NODE_AXIS), P()),", "in_specs=(P(), P()),"
+        ),
+    })
+    # The replicated in-spec also breaks the carry (in != out).
+    mismatch = [f for f in out if "in_specs mismatch" in f.message]
+    assert len(mismatch) == 1 and "position 0" in mismatch[0].message
+
+
+def test_trailing_none_normalizes():
+    """P('nodes', None) is the same placement as P('nodes') — no finding."""
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT,
+        "scheduler_tpu/ops/kern.py": KERN_OK.replace(
+            "in_specs=(P(NODE_AXIS), P()),",
+            "in_specs=(P(NODE_AXIS, None), P()),",
+        ),
+    })
+    assert out == [], "\n".join(str(f) for f in out)
+
+
+def test_undeclared_spec_trips():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT,
+        "scheduler_tpu/ops/kern.py": KERN_OK.replace(
+            'NODE_AXIS = "nodes"', 'NODE_AXIS = "nodes"\n    JOBS = "jobs"'
+        ).replace("in_specs=(P(NODE_AXIS), P()),",
+                  "in_specs=(P(JOBS), P()),"),
+    })
+    assert any("undeclared sharding" in f.message for f in out)
+
+
+def test_unregistered_site_trips():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT,
+        "scheduler_tpu/ops/kern.py": KERN_OK.replace(
+            "def scan(", "def rogue("
+        ),
+    })
+    assert len(out) == 1 and "unregistered shard_map site" in out[0].message
+    assert "ops/kern.py::rogue" in out[0].message
+
+
+def test_carry_out_spec_mismatch_trips():
+    """The pjit pre-partitioning rule: a loop-carried (donated) buffer whose
+    out-spec differs from its in-spec reshards the ledger every cycle."""
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT,
+        "scheduler_tpu/ops/kern.py": KERN_OK.replace(
+            "out_specs=(P(NODE_AXIS), P()),",
+            "out_specs=(P(None, NODE_AXIS), P()),",
+        ),
+    })
+    carry = [f for f in out if "loop-carried" in f.message]
+    assert len(carry) == 1 and "out_specs == in_specs" in carry[0].message
+
+
+def test_malformed_carry_pair_reports_without_crashing():
+    """A carry entry that is not a 2-tuple must surface as an integrity
+    finding — and must not abort the run when a matching site exists."""
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT.replace(
+            '"carry": ((0, 0),),', '"carry": ((0, 0, 1),),'
+        ),
+        "scheduler_tpu/ops/kern.py": KERN_OK,
+    })
+    assert any("is not (in_index, out_index)" in f.message for f in out)
+
+
+def test_missing_budget_is_an_integrity_finding():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT.replace(
+            '"ops/kern.py::scan": {"all-gather": 1, "all-reduce": 0},', ""
+        ),
+        "scheduler_tpu/ops/kern.py": KERN_OK,
+    })
+    assert any("no COLLECTIVE_BUDGET entry" in f.message for f in out)
+
+
+def test_host_materialization_trips_outside_readback():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT,
+        "scheduler_tpu/ops/kern.py": KERN_OK + """
+    def decode(dev):
+        return np.asarray(dev)
+
+    def readback(dev):
+        return jax.device_get(dev)
+""",
+    })
+    assert len(out) == 1 and "host materialization" in out[0].message
+    assert "'dev'" in out[0].message
+
+
+def test_axis_pin_mismatch_trips():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT,
+        "scheduler_tpu/ops/kern.py": KERN_OK.replace(
+            'NODE_AXIS = "nodes"', 'NODE_AXIS = "chips"'
+        ),
+    })
+    assert any("must carry the registry value" in f.message for f in out)
+
+
+def test_namedsharding_undeclared_spec_trips():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT,
+        "scheduler_tpu/ops/kern.py": """
+            NODE_AXIS = "nodes"
+
+            def place(mesh):
+                good = NamedSharding(mesh, P(NODE_AXIS))
+                bad = NamedSharding(mesh, P(NODE_AXIS, NODE_AXIS))
+                return good, bad
+        """,
+    })
+    assert len(out) == 1 and "undeclared sharding" in out[0].message
+
+
+def test_passthrough_wrapper_is_not_a_site():
+    """The pre-0.6 compat shim forwards its own in_specs/out_specs
+    parameters — not a spec site, no finding."""
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT,
+        "scheduler_tpu/ops/kern.py": """
+            def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+                return _experimental_shard_map(
+                    f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                )
+        """,
+    })
+    assert out == [], "\n".join(str(f) for f in out)
+
+
+# -- doc drift ----------------------------------------------------------------
+
+def _doc_text(sreg) -> str:
+    fb, fe = marker_lines("SHARDING")
+    sb, se = marker_lines("SHARD_SITES")
+    return "\n".join(
+        [fb, *render_family_table(sreg), fe, "", sb,
+         *render_site_table(sreg), se, ""]
+    )
+
+
+def test_doc_drift_trips_and_regenerated_doc_passes():
+    slayout = SLAYOUT.replace('SHARD_DOC = ""', 'SHARD_DOC = "docs/S.md"')
+    sreg = parse_shard_registry(textwrap.dedent(slayout))
+    good = _doc_text(sreg)
+
+    out = findings(
+        py={"scheduler_tpu/ops/layout.py": slayout},
+        docs={"docs/S.md": good},
+    )
+    assert out == [], "\n".join(str(f) for f in out)
+
+    out = findings(
+        py={"scheduler_tpu/ops/layout.py": slayout},
+        docs={"docs/S.md": good.replace("all-gather=1", "all-gather=7")},
+    )
+    assert len(out) == 1 and "stale" in out[0].message
+
+    out = findings(
+        py={"scheduler_tpu/ops/layout.py": slayout},
+        docs={"docs/S.md": "no markers at all\n"},
+    )
+    assert len(out) == 2 and all(
+        "missing generated sharding table" in f.message for f in out
+    )
+
+
+# -- the committed tree -------------------------------------------------------
+
+def test_committed_tree_is_sharding_clean():
+    """The acceptance criterion as a test: the sharding pass is clean on
+    the real registry, the real ops modules and the real docs."""
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    repo = Repo.from_root(
+        root,
+        ("scheduler_tpu/ops", "scheduler_tpu/analysis", "bench.py"),
+        ("docs/*.md",),
+    )
+    out = run_passes(repo, ["sharding"])
+    assert out == [], "\n".join(str(f) for f in out)
+
+
+# -- compiled-HLO collective budget -------------------------------------------
+
+def _mesh4():
+    import jax
+    from jax.sharding import Mesh
+
+    from scheduler_tpu.ops.sharded import NODE_AXIS
+    from tests.conftest import USE_TPU
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        if USE_TPU:
+            pytest.skip(f"needs 4 devices, have {len(devices)}")
+        raise AssertionError(
+            f"forced host device count regressed (got {len(devices)})"
+        )
+    return Mesh(np.array(devices[:4]), (NODE_AXIS,))
+
+
+def test_budget_passes_on_the_real_scan_and_counts_one_all_gather():
+    """ops/sharded.py's declared budget holds in the compiled HLO: exactly
+    one all-gather per scan step, zero all-reduces/permutes."""
+    from scripts.shard_budget import (
+        LOWERABLE, check_counts, count_collectives,
+    )
+    from scheduler_tpu.ops import layout
+
+    mesh = _mesh4()
+    site = "ops/sharded.py::sharded_place_scan"
+    counts = count_collectives(LOWERABLE[site](mesh))
+    assert counts == {"all-gather": 1}
+    assert check_counts(site, counts, layout.COLLECTIVE_BUDGET[site]) == []
+
+
+def test_seeded_extra_all_gather_fails_the_budget():
+    """A second (data-dependent, so the combiner cannot merge them)
+    all-gather in the step MUST exceed the one-per-step budget."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from scripts.shard_budget import check_counts, count_collectives
+    from scheduler_tpu.ops.sharded import NODE_AXIS, shard_map
+
+    mesh = _mesh4()
+
+    def body(x):
+        g1 = jax.lax.all_gather(x, NODE_AXIS)
+        # Depends on g1's value: XLA's all-gather combiner cannot fuse it.
+        g2 = jax.lax.all_gather(x + g1.sum(), NODE_AXIS)
+        return g1.sum() + g2.sum()
+
+    fn = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=P(NODE_AXIS), out_specs=P(),
+        check_vma=False,
+    ))
+    hlo = fn.lower(jnp.ones(8, jnp.float32)).compile().as_text()
+    counts = count_collectives(hlo)
+    assert counts.get("all-gather", 0) >= 2
+    budget = {"all-gather": 1, "all-reduce": 0}
+    bad = check_counts("seeded", counts, budget)
+    assert len(bad) == 1 and "exceeds the declared budget" in bad[0]
+
+
+def test_count_collectives_handles_real_hlo_shapes():
+    """The counter must see async (tuple-typed) and layout-annotated
+    collective definitions — the forms real backends emit — and must NOT
+    count ``-done`` ops or operand references."""
+    from scripts.shard_budget import count_collectives
+
+    hlo = "\n".join([
+        # Async pair: -start (tuple result type) counts once, -done never.
+        "  %ags.1 = (f32[2,3]{1,0}, f32[8,3]{1,0}) all-gather-start(f32[2,3]{1,0} %p0), replica_groups={}",
+        "  %agd.1 = f32[8,3]{1,0} all-gather-done((f32[2,3]{1,0}, f32[8,3]{1,0}) %ags.1)",
+        # Tiled layout annotation on the result type.
+        "  %ag2 = f32[8,3]{1,0:T(8,128)} all-gather(f32[2,3]{1,0} %p1), dimensions={0}",
+        # Operand references must not count.
+        "  %use = f32[] add(f32[] %all-reduce.5, f32[] %c0)",
+        # Plain sync form.
+        "  %ar = f32[3]{0} all-reduce(f32[3]{0} %p2), to_apply=%sum",
+    ])
+    assert count_collectives(hlo) == {"all-gather": 2, "all-reduce": 1}
+
+
+# -- 4-device two_level_winner parity -----------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_two_level_winner_matches_single_chip_argmax(seed):
+    """The two-level candidate reduction on a 4-host-device mesh selects
+    the same (score, index) as the single-chip argmax — including the
+    lowest-index tie rule the kernels rely on."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from scheduler_tpu.ops.layout import WINNER
+    from scheduler_tpu.ops.sharded import NODE_AXIS, shard_map, two_level_winner
+
+    mesh = _mesh4()
+    rng = np.random.default_rng(seed)
+    scores = rng.uniform(0.0, 10.0, 32).astype(np.float32)
+    if seed == 2:  # cross-shard tie: the LOWEST global index must win
+        scores[5] = scores[29] = 11.0
+
+    def local(sc):
+        lbest = jnp.argmax(sc)
+        off = jax.lax.axis_index(NODE_AXIS) * sc.shape[0]
+        win = two_level_winner(sc[lbest], lbest + off)
+        return win[WINNER.SCORE], win[WINNER.INDEX].astype(jnp.int32)
+
+    score, idx = jax.jit(shard_map(
+        local, mesh=mesh, in_specs=P(NODE_AXIS), out_specs=(P(), P()),
+        check_vma=False,
+    ))(jnp.asarray(scores))
+    assert int(idx) == int(np.argmax(scores))
+    assert float(score) == float(scores.max())
+
+
+# -- runtime shardcheck (SCHEDULER_TPU_SHARDCHECK=1) --------------------------
+
+def test_shardcheck_seeded_violation_trips(monkeypatch):
+    """A replicated-family buffer partitioned over the node axis MUST be
+    recorded (and raise under PANIC_ON_ERROR, the conftest regime)."""
+    import jax
+    import jax.numpy as jnp
+
+    from scheduler_tpu.ops.sharded import node_sharding
+    from scheduler_tpu.utils import shardcheck
+    from scheduler_tpu.utils.assertions import AssertionViolation
+
+    mesh = _mesh4()
+    monkeypatch.setenv("SCHEDULER_TPU_SHARDCHECK", "1")
+    shardcheck.reset()
+    bad = jax.device_put(jnp.zeros((8, 3)), node_sharding(mesh))
+    with pytest.raises(AssertionViolation, match="shardcheck"):
+        shardcheck.check_dispatch(mesh, [bad], families=("replicated",))
+    assert shardcheck.violations() == 1
+    assert shardcheck.violation_log()[0]["what"] == "arg[0]"
+    shardcheck.reset()
+
+
+def test_shardcheck_accepts_registry_shardings(monkeypatch):
+    """Exact-family and replicated placements are both consistent; numpy
+    (unstaged) values are out of scope."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from scheduler_tpu.ops.sharded import node_sharding
+    from scheduler_tpu.utils import shardcheck
+
+    mesh = _mesh4()
+    monkeypatch.setenv("SCHEDULER_TPU_SHARDCHECK", "1")
+    shardcheck.reset()
+    good = jax.device_put(jnp.zeros((8, 3)), node_sharding(mesh))
+    rep = jax.device_put(jnp.zeros((4,)), NamedSharding(mesh, P()))
+    shardcheck.check_dispatch(
+        mesh, [good, rep, np.zeros(3)],
+        families=("node_major", "replicated", "replicated"),
+    )
+    shardcheck.check_result(mesh, rep)
+    assert shardcheck.violations() == 0
+
+
+def test_shardcheck_full_engine_cycle_is_clean(monkeypatch):
+    """Acceptance: a real allocate cycle under SCHEDULER_TPU_SHARDCHECK=1
+    (single-chip regime — nothing may be partitioned) is violation-clean
+    and produces placements."""
+    import scheduler_tpu.actions  # noqa: F401
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.actions.allocate import collect_candidates
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.framework import close_session, open_session
+    from scheduler_tpu.ops.fused import FusedAllocator
+    from scheduler_tpu.utils import shardcheck
+    from tests.test_fused import CONF, build_cluster
+
+    monkeypatch.setenv("SCHEDULER_TPU_SHARDCHECK", "1")
+    shardcheck.reset()
+    cache = build_cluster(seed=0, n_nodes=8, n_jobs=4)
+    ssn = open_session(cache, parse_scheduler_conf(CONF).tiers)
+    eng = FusedAllocator(ssn, collect_candidates(ssn))
+    codes = eng._execute()
+    close_session(ssn)
+    assert shardcheck.violations() == 0, shardcheck.violation_log()
+    assert int((np.asarray(codes) >= 0).sum()) > 0

@@ -300,11 +300,11 @@ class SchedulerCache(Cache):
 
     def prune_absent(
         self,
-        pod_uids: set,
-        node_names: set,
-        podgroup_keys: set,
-        queue_names: set,
-        priority_class_names: set,
+        pod_uids: Optional[set] = None,
+        node_names: Optional[set] = None,
+        podgroup_keys: Optional[set] = None,
+        queue_names: Optional[set] = None,
+        priority_class_names: Optional[set] = None,
     ) -> int:
         """Delete every cached object ABSENT from a full LIST of the system of
         record.  The reference informer's relist is a store replace
@@ -312,37 +312,48 @@ class SchedulerCache(Cache):
         horizon was lost stays a ghost forever — e.g. a dead pod permanently
         holding node resources.  Shadow PodGroups are local-only synthesized
         objects and are never pruned (their pods are, which GCs the group).
+
+        A ``None`` survivor set means that kind was NOT relisted and stays
+        untouched — the k8s reflector wire relists one resource at a time
+        (per-resource watch histories expire independently), while the
+        journal protocol's global relist passes all five sets.
         Returns the number of objects removed."""
         removed = 0
         with self.mutex:
-            for job in list(self.jobs.values()):
-                ghost_pods = [
-                    task.pod
-                    for task in list(job.tasks.values())
-                    if task.pod.uid not in pod_uids
-                ]
-                for pod in ghost_pods:
-                    self._delete_pod_locked(pod)
-                    removed += 1
-                pg = job.pod_group
-                if pg is not None and not pg.shadow and \
-                        f"{pg.namespace}/{pg.name}" not in podgroup_keys:
-                    self.delete_pod_group(pg)
-                    removed += 1
-            for name in list(self.nodes):
-                if name not in node_names:
-                    self.node_generation += 1
-                    del self.nodes[name]
-                    self.node_ledger.detach(name)
-                    removed += 1
-            for name in list(self.queues):
-                if name not in queue_names:
-                    del self.queues[name]
-                    removed += 1
-            for name in list(self.priority_classes):
-                if name not in priority_class_names:
-                    del self.priority_classes[name]
-                    removed += 1
+            if pod_uids is not None or podgroup_keys is not None:
+                for job in list(self.jobs.values()):
+                    if pod_uids is not None:
+                        ghost_pods = [
+                            task.pod
+                            for task in list(job.tasks.values())
+                            if task.pod.uid not in pod_uids
+                        ]
+                        for pod in ghost_pods:
+                            self._delete_pod_locked(pod)
+                            removed += 1
+                    pg = job.pod_group
+                    if podgroup_keys is not None and pg is not None \
+                            and not pg.shadow and \
+                            f"{pg.namespace}/{pg.name}" not in podgroup_keys:
+                        self.delete_pod_group(pg)
+                        removed += 1
+            if node_names is not None:
+                for name in list(self.nodes):
+                    if name not in node_names:
+                        self.node_generation += 1
+                        del self.nodes[name]
+                        self.node_ledger.detach(name)
+                        removed += 1
+            if queue_names is not None:
+                for name in list(self.queues):
+                    if name not in queue_names:
+                        del self.queues[name]
+                        removed += 1
+            if priority_class_names is not None:
+                for name in list(self.priority_classes):
+                    if name not in priority_class_names:
+                        del self.priority_classes[name]
+                        removed += 1
         return removed
 
     # -- snapshot (cache.go:584-654) -------------------------------------------

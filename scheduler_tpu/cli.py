@@ -157,6 +157,9 @@ def run(opt: ServerOption, stop: Optional[threading.Event] = None,
             default_queue=opt.default_queue,
             io_workers=opt.io_workers,
             dialect=getattr(opt, "api_dialect", "k8s") or "k8s",
+            # Inbound protocol: journal or per-resource k8s LIST+WATCH
+            # (docs/INGEST.md); None defers to SCHEDULER_TPU_WIRE.
+            wire=getattr(opt, "wire", None),
         )
     elif synthetic:
         from scheduler_tpu.harness import make_synthetic_cluster
@@ -238,6 +241,13 @@ def main(argv: Optional[List[str]] = None) -> None:
         "--api-dialect", default="k8s", choices=("k8s", "legacy"),
         help="outbound wire shapes: real Kubernetes API calls (default) or "
              "the compact legacy JSON RPCs",
+    )
+    parser.add_argument(
+        "--wire", default=None, choices=("journal", "k8s"),
+        help="inbound ingestion protocol: the bespoke state/watch journal "
+             "or Kubernetes-conformant per-resource LIST+WATCH reflectors "
+             "(docs/INGEST.md); unset defers to SCHEDULER_TPU_WIRE "
+             "(default journal)",
     )
     ns = parser.parse_args(argv)
     if getattr(ns, "version", False):

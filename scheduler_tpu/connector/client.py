@@ -8,6 +8,10 @@ binds/evictions out).  This module is that seam over HTTP/JSON:
   thread long-polls ``GET /watch?since=seq`` and applies add/update/delete
   events for pods / nodes / podgroups / queues / priority classes through the
   cache's event-handler methods — the informer fan-in (event_handlers.go).
+  This is the *journal* wire; ``SCHEDULER_TPU_WIRE=k8s`` swaps ingestion for
+  the Kubernetes-conformant per-resource LIST+WATCH reflectors in
+  ``connector/reflector.py`` (same ``_apply`` seam, real apiserver protocol
+  — see ``docs/INGEST.md`` for the protocol table).
 * **RPCs out**: Binder / Evictor / StatusUpdater implementations POST to the
   server.  A failed bind raises; the cache's existing resync path reverts the
   local Binding state so the next cycle retries (errTasks semantics,
@@ -25,6 +29,7 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import threading
 import time
 import urllib.error
@@ -41,6 +46,7 @@ from scheduler_tpu.cache.interface import (
     VolumeBinder,
 )
 from scheduler_tpu.connector.wire import (
+    CRD_PREFIX,
     parse_node,
     parse_pod,
     parse_pod_group,
@@ -113,6 +119,47 @@ class TokenBucket:
         return wait
 
 
+class Backoff:
+    """Jittered exponential backoff for the connector's retry loops — the
+    client-go ``wait.Backoff`` the reference's reflectors retry through.
+
+    A dead or rebooting API server used to be hammered in a tight 1s
+    warn-and-retry loop by every watcher at once; with N schedulers (leader
+    + standbys, each with per-resource reflectors) that is a synchronized
+    reconnect stampede exactly when the server is least able to absorb it.
+    ``next()`` returns the current delay with multiplicative jitter
+    (``delay * (1 + jitter*rand)``, so delays from different processes
+    decorrelate) and doubles the base up to ``cap``; ``reset()`` on any
+    success returns to the floor.  The RNG is injectable for deterministic
+    tests."""
+
+    def __init__(
+        self,
+        base: float = 0.5,
+        cap: float = 30.0,
+        factor: float = 2.0,
+        jitter: float = 0.5,
+        rng: Callable[[], float] = random.random,
+    ) -> None:
+        if base <= 0 or factor < 1.0 or cap < base:
+            raise ValueError(f"malformed backoff ({base=}, {factor=}, {cap=})")
+        self.base = base
+        self.cap = cap
+        self.factor = factor
+        self.jitter = jitter
+        self._rng = rng
+        self._delay = base
+
+    def next(self) -> float:
+        """The delay to sleep NOW; advances the schedule."""
+        delay = self._delay * (1.0 + self.jitter * self._rng())
+        self._delay = min(self.cap, self._delay * self.factor)
+        return delay
+
+    def reset(self) -> None:
+        self._delay = self.base
+
+
 def rate_limiter_from_env() -> Optional[TokenBucket]:
     """The connector's limiter as configured by ``SCHEDULER_TPU_QPS`` /
     ``SCHEDULER_TPU_BURST``.  QPS unset or <= 0 disables limiting (today's
@@ -156,11 +203,6 @@ def _patch(base: str, path: str, payload: dict, timeout: float = 10.0,
 def _delete(base: str, path: str, timeout: float = 10.0,
             limiter: Optional[TokenBucket] = None) -> dict:
     return _request(base, path, None, "DELETE", timeout, limiter)
-
-
-# The CRD group the reference registers its PodGroup/Queue types under
-# (pkg/apis/scheduling/v1alpha1/register.go:32).
-CRD_PREFIX = "/apis/scheduling.incubator.k8s.io/v1alpha1"
 
 
 def _cond_field(condition, name: str) -> str:
@@ -490,15 +532,35 @@ class K8sStatusUpdater(StatusUpdater):
         )
 
 
-class ApiConnector:
-    """list+watch ingestion loop binding a SchedulerCache to a server."""
+class ConnectorBase:
+    """The protocol-independent ingestion half shared by BOTH inbound wire
+    protocols: the parse-and-apply seam (``_dispatch``), per-event failure
+    recovery (single-object resync, then kind-level dirty), and the
+    ``sync_pod`` client slot the cache's bind-failure paths call.
 
-    def __init__(self, cache: SchedulerCache, base: str) -> None:
+    Two subclasses speak the actual wires (docs/INGEST.md):
+
+    * ``ApiConnector`` (here) — the bespoke journal protocol: one global
+      LIST (``GET /state``) + one sequence-cursor long-poll
+      (``GET /watch?since=seq``).
+    * ``reflector.K8sApiConnector`` — Kubernetes-conformant per-resource
+      LIST + WATCH streams with resourceVersion cursors and ``410 Gone``
+      relist recovery, the way client-go informs the reference's cache.
+
+    Everything the cache sees is identical between them — same ``_apply``
+    calls, same parsers, same resync semantics — which is what makes the
+    journal-vs-k8s bind-parity test meaningful."""
+
+    def __init__(self, cache: SchedulerCache, base: str,
+                 limiter: Optional[TokenBucket] = None) -> None:
         self.cache = cache
         self.base = base
-        self.seq = 0
+        # LISTs and relists pay the shared outbound QPS budget (a relist
+        # storm is exactly the full-inventory burst the reference's
+        # flowcontrol limiter exists to pace); the watch long-polls stay
+        # deliberately OUTSIDE it — see connect_cache's docstring.
+        self.limiter = limiter
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
         self.synced = threading.Event()
         # Set when an event failed to apply: the cache may be divergent for
         # that object, so the loop re-LISTs (full store replace) instead of
@@ -507,6 +569,13 @@ class ApiConnector:
         self._dirty = False
 
     # -- event application ---------------------------------------------------
+
+    def _mark_dirty(self, kind: str) -> None:
+        """Kind ``kind`` may have diverged beyond single-object repair; the
+        owning loop must re-LIST.  The journal protocol relists everything
+        (one global inventory); the reflector overrides this to relist only
+        the affected resource."""
+        self._dirty = True
 
     def _apply(self, kind: str, op: str, obj: dict) -> None:
         try:
@@ -520,7 +589,7 @@ class ApiConnector:
             # watch-horizon loss.  Only when the re-fetch itself fails does
             # the store fall back to a replace.
             if not self._resync_object(kind, obj):
-                self._dirty = True
+                self._mark_dirty(kind)
 
     def _object_key(self, kind: str, obj: dict) -> str:
         if kind in ("pod", "podgroup"):
@@ -529,13 +598,9 @@ class ApiConnector:
 
     def get_object(self, kind: str, key: str) -> Optional[dict]:
         """GET one object from the system of record; None == 404 (deleted).
-        Transport errors raise."""
-        try:
-            return _get(self.base, f"/objects/{kind}/{key}", timeout=10.0)
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return None
-            raise
+        Transport errors raise.  Protocol-specific: the journal fetches
+        ``/objects/{kind}/{key}``, the k8s wire the typed resource path."""
+        raise NotImplementedError
 
     def _resync_object(self, kind: str, obj: dict) -> bool:
         """Re-fetch one object and re-apply it as the current truth (delete
@@ -624,6 +689,39 @@ class ApiConnector:
                         return core.pod
         return None
 
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def wait_for_cache_sync(self, timeout: float = 60.0) -> bool:
+        """Block until the initial LIST has seeded the cache
+        (cache.WaitForCacheSync, cache.go:364-384)."""
+        return self.synced.wait(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class ApiConnector(ConnectorBase):
+    """Journal-protocol ingestion loop: one global LIST (``GET /state``) +
+    one sequence-cursor long-poll (``GET /watch?since=seq``) feeding the
+    SchedulerCache.  The bespoke predecessor of the k8s reflector wire
+    (``SCHEDULER_TPU_WIRE=journal``, docs/INGEST.md)."""
+
+    def __init__(self, cache: SchedulerCache, base: str,
+                 limiter: Optional[TokenBucket] = None) -> None:
+        super().__init__(cache, base, limiter)
+        self.seq = 0
+        self._thread: Optional[threading.Thread] = None
+        self._backoff = Backoff()
+
+    def get_object(self, kind: str, key: str) -> Optional[dict]:
+        try:
+            return _get(self.base, f"/objects/{kind}/{key}", timeout=10.0)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
     def list_and_seed(self) -> None:
         """The initial LIST: seed the cache, remember the watch cursor.  A
         RE-list (watch horizon lost) is a full store REPLACE, like the
@@ -633,6 +731,10 @@ class ApiConnector:
         event pruned from the server's bounded history) must not survive as a
         ghost holding node resources."""
         relist = self.synced.is_set()
+        if self.limiter is not None:
+            # LIST/relist shares the outbound QPS budget; the watch
+            # long-poll below deliberately does not (see connect_cache).
+            self.limiter.acquire()
         state = _get(self.base, "/state")
         self.seq = int(state.get("seq", 0))
         for q in state.get("queues", []):
@@ -663,22 +765,27 @@ class ApiConnector:
         # LIST first, with retries: the daemon and its system of record start
         # concurrently in any orchestrated deploy — a refused connection at
         # boot must resync, not crash (cache.Run/WaitForCacheSync semantics).
+        # All retry paths back off with jittered exponential delays (shared
+        # Backoff, reset on any success): a dead server must not be hammered
+        # at a fixed cadence by a fleet of reconnecting schedulers.
         while not self._stop.is_set() and not self.synced.is_set():
             try:
                 self.list_and_seed()
+                self._backoff.reset()
             except Exception:
                 logger.warning("initial LIST failed; retrying", exc_info=True)
-                self._stop.wait(1.0)
+                self._stop.wait(self._backoff.next())
         while not self._stop.is_set():
             try:
                 payload = _get(
                     self.base, f"/watch?since={self.seq}&timeout=5", timeout=30
                 )
+                self._backoff.reset()
             except Exception:
                 if self._stop.is_set():
                     return
                 logger.warning("watch poll failed; retrying", exc_info=True)
-                self._stop.wait(1.0)
+                self._stop.wait(self._backoff.next())
                 continue
             if payload.get("relist") or self._dirty:
                 # Watch horizon passed our cursor ("resourceVersion too
@@ -688,10 +795,11 @@ class ApiConnector:
                 self._dirty = False
                 try:
                     self.list_and_seed()
+                    self._backoff.reset()
                 except Exception:
                     self._dirty = True
                     logger.warning("relist failed; retrying", exc_info=True)
-                    self._stop.wait(1.0)
+                    self._stop.wait(self._backoff.next())
                 continue
             for event in payload.get("events", []):
                 self.seq = max(self.seq, int(event["seq"]))
@@ -703,15 +811,20 @@ class ApiConnector:
         )
         self._thread.start()
 
-    def wait_for_cache_sync(self, timeout: float = 60.0) -> bool:
-        """Block until the initial LIST has seeded the cache
-        (cache.WaitForCacheSync, cache.go:364-384)."""
-        return self.synced.wait(timeout)
-
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+
+
+def wire_from_env() -> str:
+    """The inbound wire protocol as configured by ``SCHEDULER_TPU_WIRE``:
+    ``journal`` (default — the bespoke ``GET /state`` + ``GET /watch?since``
+    journal) or ``k8s`` (per-resource LIST+WATCH reflectors with
+    resourceVersion cursors, connector/reflector.py)."""
+    from scheduler_tpu.utils.envflags import env_str
+
+    return env_str("SCHEDULER_TPU_WIRE", "journal", choices=("journal", "k8s"))
 
 
 def connect_cache(
@@ -723,6 +836,7 @@ def connect_cache(
     async_io: bool = True,
     dialect: str = "k8s",
     limiter: Optional[TokenBucket] = None,
+    wire: Optional[str] = None,
 ) -> tuple:
     """A SchedulerCache whose side effects cross the wire to ``base``.
     Returns ``(cache, connector)`` — call ``connector.start()`` after
@@ -734,13 +848,22 @@ def connect_cache(
     connector can front a real API server; ``"legacy"`` keeps the compact
     bespoke JSON RPCs for older servers.
 
-    ``limiter`` rate-limits the OUTBOUND RPCs (binds, evictions, status
-    writes, events, volume claims) through ONE shared token bucket — the
-    reference's single kube-client QPS/burst budget.  ``None`` reads
-    ``SCHEDULER_TPU_QPS`` / ``SCHEDULER_TPU_BURST`` (unset = unlimited).
-    The inbound watch long-poll is deliberately outside the budget: it is a
-    single sequential poller whose rate the server's timeout already bounds,
-    and starving ingestion behind a bind backlog would stall cache sync.
+    ``wire`` selects the INBOUND ingestion protocol (docs/INGEST.md):
+    ``"journal"`` (default) keeps the bespoke global-journal long-poll;
+    ``"k8s"`` ingests the way client-go does — per-resource LIST
+    (``/api/v1/pods``, …) + chunked WATCH streams with resourceVersion
+    cursors and ``410 Gone`` relist recovery (connector/reflector.py).
+    ``None`` reads ``SCHEDULER_TPU_WIRE``.
+
+    ``limiter`` rate-limits the outbound RPCs (binds, evictions, status
+    writes, events, volume claims) AND the inbound LISTs/relists through
+    ONE shared token bucket — the reference's single kube-client QPS/burst
+    budget.  ``None`` reads ``SCHEDULER_TPU_QPS`` / ``SCHEDULER_TPU_BURST``
+    (unset = unlimited).  The inbound watch long-polls are deliberately
+    outside the budget: each is a single sequential poller whose rate the
+    server's stream timeout already bounds, and starving ingestion behind a
+    bind backlog would stall cache sync — but a LIST is a full-inventory
+    burst (and a relist storm is the classic thundering herd), so those pay.
     Advisory lifecycle events DO share the budget — that is the reference's
     behavior too (client-go's event broadcaster posts through the same
     rate-limited client), and it means a large event backlog paces binds;
@@ -770,6 +893,15 @@ def connect_cache(
         async_io=async_io,
         io_workers=io_workers,
     )
-    connector = ApiConnector(cache, base)
+    if wire is None:
+        wire = wire_from_env()
+    if wire == "k8s":
+        from scheduler_tpu.connector.reflector import K8sApiConnector
+
+        connector: ConnectorBase = K8sApiConnector(cache, base, limiter=limiter)
+    elif wire == "journal":
+        connector = ApiConnector(cache, base, limiter=limiter)
+    else:
+        raise ValueError(f"unknown inbound wire protocol {wire!r}")
     cache.client = lambda: connector  # the reference Cache.Client() slot
     return cache, connector

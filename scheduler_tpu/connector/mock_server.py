@@ -1,16 +1,35 @@
 """Mock API server: the system-of-record process for connector e2e tests.
 
 Stands in for the reference's Kubernetes API server (the scheduler's only
-communication backend, SURVEY §2.1): holds the authoritative object store,
-serves LIST (``GET /state``) + WATCH (``GET /watch?since=N`` long-poll), and
+communication backend, SURVEY §2.1): holds the authoritative object store and
 accepts the scheduler's side effects (``POST /bind | /bind-bulk | /evict |
-/pod-condition | /podgroup-status``).  Binds mutate the store and are echoed
-back on the watch stream as pod updates — the informer echo that makes the
-scheduler's cache converge on the server's truth.
+/pod-condition | /podgroup-status`` and their k8s-dialect twins).  Binds
+mutate the store and are echoed back on the watch stream as pod updates —
+the informer echo that makes the scheduler's cache converge on the server's
+truth.
+
+Ingestion is served in BOTH wire protocols (docs/INGEST.md) over one store
+and one monotonic version counter:
+
+* journal — LIST ``GET /state`` + WATCH ``GET /watch?since=N`` long-poll;
+* k8s apiserver mode — per-resource LIST (``GET /api/v1/pods`` …) returning
+  ``{Kind}List`` envelopes with ``metadata.resourceVersion``, plus chunked
+  WATCH streams (``?watch=1&resourceVersion=RV``) of newline-delimited
+  ADDED/MODIFIED/DELETED events, BOOKMARK emission at stream close
+  (``allowWatchBookmarks=true``), and real ``410 Gone`` Status objects —
+  at the HTTP layer for cursors behind the bounded history's compaction
+  horizon, and as mid-stream ERROR events — which must drive the
+  reflector's relist-and-replace recovery.
 
 Failure injection (``POST /inject {"op": "bind", "times": K}``) makes the
 next K bind calls fail with HTTP 500, which must drive the scheduler's
-resync-and-retry path (reference errTasks queue, cache.go:559-581).
+resync-and-retry path (reference errTasks queue, cache.go:559-581).  The
+ingest-side injections: ``{"op": "watch-gone:pod", "times": 1}`` ends the
+next pod watch window with an ERROR 410; ``{"op": "compact-history"}``
+drops the whole journal (etcd compaction analogue — every cursor behind
+``seq`` now 410s); ``{"op": "silent-delete", "kind": "pod", "key":
+"ns/name"}`` removes an object WITHOUT a journal event, manufacturing
+exactly the ghost a relist must prune.
 
 Run standalone:  python -m scheduler_tpu.connector.mock_server --port 18200
 """
@@ -22,8 +41,61 @@ import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List
+from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
+
+from scheduler_tpu.connector.wire import LIST_RESOURCES
+
+# kind -> (collection path, item Kind); the reflector wire's routing table.
+_K8S_COLLECTIONS = {path: (kind, k8s_kind) for kind, path, k8s_kind in LIST_RESOURCES}
+
+_WATCH_TYPE_OF = {"add": "ADDED", "update": "MODIFIED", "delete": "DELETED"}
+
+
+def _gone_status() -> Dict:
+    """The Status object a real apiserver sends for an expired cursor."""
+    return {
+        "kind": "Status", "apiVersion": "v1", "status": "Failure",
+        "reason": "Expired", "message": "too old resource version",
+        "code": 410,
+    }
+
+
+def _with_rv(obj: Dict, seq: int) -> Dict:
+    """Deep-copy ``obj`` with its wire resourceVersion stamped where the
+    client's ``wire.obj_rv`` looks for it (metadata for k8s-shaped docs,
+    top-level for the compact dialect)."""
+    obj = json.loads(json.dumps(obj))
+    if isinstance(obj.get("metadata"), dict):
+        obj["metadata"]["resourceVersion"] = str(seq)
+    else:
+        obj["resourceVersion"] = str(seq)
+    return obj
+
+
+def _k8s_object_route(path: str) -> Optional[Tuple[str, str]]:
+    """Single-object GET routing for the k8s wire (the syncTask re-fetch
+    shape): path -> (kind, store key), or None."""
+    parts = [p for p in path.strip("/").split("/") if p]
+    if parts[:3] == ["api", "v1", "nodes"] and len(parts) == 4:
+        return "node", parts[3]
+    if (
+        parts[:3] == ["api", "v1", "namespaces"] and len(parts) == 6
+        and parts[4] == "pods"
+    ):
+        return "pod", f"{parts[3]}/{parts[5]}"
+    if parts[:3] == ["apis", "scheduling.incubator.k8s.io", "v1alpha1"]:
+        rest = parts[3:]
+        if len(rest) == 2 and rest[0] == "queues":
+            return "queue", rest[1]
+        if len(rest) == 4 and rest[0] == "namespaces" and rest[2] == "podgroups":
+            return "podgroup", f"{rest[1]}/{rest[3]}"
+    if (
+        parts[:3] == ["apis", "scheduling.k8s.io", "v1"] and len(parts) == 5
+        and parts[3] == "priorityclasses"
+    ):
+        return "priorityclass", parts[4]
+    return None
 
 
 class MockState:
@@ -35,9 +107,17 @@ class MockState:
         }
         self.events: List[Dict] = []  # {seq, kind, op, object}
         self.seq = 0
+        # Highest seq swallowed by history truncation (etcd's compaction
+        # revision): any watch cursor <= a swallowed event is unrecoverable
+        # and gets the relist signal (journal: {"relist": true}; k8s wire:
+        # a real 410 Gone).
+        self.compacted_through = 0
         self.fail: Dict[str, int] = {}  # op -> remaining injected failures
         self.bind_calls = 0
         self.evict_calls = 0
+        # Ordered record of every APPLIED bind (pod key, node) — the
+        # journal-vs-k8s parity tests compare these sequences bitwise.
+        self.bind_log: List[Dict] = []
         # Wire-shape accounting: how many mutations arrived as real k8s API
         # calls vs the legacy bespoke RPCs — lets tests assert WHICH dialect
         # actually crossed the wire, not just that state changed.
@@ -87,8 +167,10 @@ class MockState:
         self.seq += 1
         self.events.append({"seq": self.seq, "kind": kind, "op": op, "object": obj})
         # Bounded history: watchers older than the horizon must re-list
-        # (the "resourceVersion too old" analogue).
+        # ("resourceVersion too old" — the k8s endpoints serve it as a
+        # real 410 Gone Status).
         if len(self.events) > 10_000:
+            self.compacted_through = self.events[4_999]["seq"]
             del self.events[:5_000]
         self.lock.notify_all()
 
@@ -118,8 +200,124 @@ def make_handler(state: MockState):
             length = int(self.headers.get("Content-Length", 0))
             return json.loads(self.rfile.read(length) or b"{}")
 
+        def _stream(self, event: Dict) -> None:
+            """One chunk of a watch stream: a newline-delimited JSON watch
+            event, flushed immediately (HTTP/1.0 close-delimited body)."""
+            self.wfile.write(json.dumps(event).encode() + b"\n")
+            self.wfile.flush()
+
+        def _k8s_list(self, kind: str, k8s_kind: str) -> None:
+            with state.lock:
+                state.list_calls += 1
+                payload = {
+                    "apiVersion": "v1", "kind": f"{k8s_kind}List",
+                    "metadata": {"resourceVersion": str(state.seq)},
+                    "items": [
+                        json.loads(json.dumps(o))
+                        for o in state.objects[kind].values()
+                    ],
+                }
+            self._json(payload)
+
+        def _k8s_watch(self, kind: str, k8s_kind: str, q: Dict) -> None:
+            """Chunked per-resource watch: stream this kind's events after
+            the cursor until the window times out (close with a BOOKMARK
+            when asked) — or end with an ERROR 410 when the history was
+            compacted past the cursor mid-stream (or injected)."""
+            since = int(q.get("resourceVersion", ["0"])[0])
+            timeout = min(float(q.get("timeoutSeconds", ["10"])[0]), 30.0)
+            bookmarks = q.get(
+                "allowWatchBookmarks", ["false"]
+            )[0].lower() in ("true", "1")
+            with state.lock:
+                expired = since < state.compacted_through
+            if expired:
+                # Cursor behind the compaction horizon at watch START: the
+                # real apiserver rejects the request itself.  (Responding
+                # OUTSIDE the lock hold — a stalled reader must not wedge
+                # every other handler thread behind the condition.)
+                self._json(_gone_status(), 410)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            deadline = time.monotonic() + timeout
+            last = since
+            try:
+                while True:
+                    batch: List[Dict] = []
+                    gone = False
+                    bookmark_rv = None
+                    with state.lock:
+                        while True:
+                            if state.take_failure(f"watch-gone:{kind}") or \
+                                    last < state.compacted_through:
+                                gone = True
+                                break
+                            batch = [
+                                e for e in state.events
+                                if e["seq"] > last and e["kind"] == kind
+                            ]
+                            if batch:
+                                break
+                            left = deadline - time.monotonic()
+                            if left <= 0:
+                                # Snapshot the bookmark cursor UNDER the
+                                # lock that just confirmed nothing of this
+                                # kind is pending: a concurrent event after
+                                # release must not be skipped over.
+                                bookmark_rv = state.seq
+                                break
+                            state.lock.wait(left)
+                    for e in batch:
+                        self._stream({
+                            "type": _WATCH_TYPE_OF[e["op"]],
+                            "object": _with_rv(e["object"], e["seq"]),
+                        })
+                        last = e["seq"]
+                    if gone:
+                        self._stream({"type": "ERROR", "object": _gone_status()})
+                        return
+                    if bookmark_rv is not None:
+                        if bookmarks:
+                            self._stream({"type": "BOOKMARK", "object": {
+                                "kind": k8s_kind, "apiVersion": "v1",
+                                "metadata": {
+                                    "resourceVersion": str(max(bookmark_rv, last)),
+                                },
+                            }})
+                        return
+            except (BrokenPipeError, ConnectionResetError):
+                return  # watcher hung up mid-stream
+
         def do_GET(self) -> None:
             url = urlparse(self.path)
+            # ---- k8s apiserver mode: per-resource LIST + WATCH -------------
+            collection = _K8S_COLLECTIONS.get(url.path)
+            if collection is not None:
+                kind, k8s_kind = collection
+                q = parse_qs(url.query)
+                if q.get("watch", ["0"])[0].lower() in ("1", "true"):
+                    self._k8s_watch(kind, k8s_kind, q)
+                else:
+                    self._k8s_list(kind, k8s_kind)
+                return
+            obj_route = _k8s_object_route(url.path)
+            if obj_route is not None:
+                kind, key = obj_route
+                with state.lock:
+                    state.get_calls += 1
+                    obj = state.objects[kind].get(key)
+                if obj is None:
+                    self._json({"error": "not found"}, 404)
+                else:
+                    self._json(obj)
+                return
+            if url.path == "/bind-log":
+                with state.lock:
+                    binds = list(state.bind_log)
+                self._json({"binds": binds})
+                return
             if url.path == "/state":
                 with state.lock:
                     state.list_calls += 1
@@ -145,7 +343,7 @@ def make_handler(state: MockState):
                         if left <= 0:
                             break
                         state.lock.wait(left)
-                    if state.events and since < state.events[0]["seq"] - 1:
+                    if since < state.compacted_through:
                         # History pruned past the watcher's cursor: relist.
                         self._json({"relist": True})
                         return
@@ -239,6 +437,8 @@ def make_handler(state: MockState):
                 # Echo on the watch stream: the scheduler's cache sees its
                 # own bind come back as a pod update, like an informer.
                 state.apply("pod", "update", pod)
+                with state.lock:
+                    state.bind_log.append({"pod": key, "node": pair["node"]})
             if not bulk:
                 if failed:
                     self._json({"error": "bind failed"}, 500)
@@ -395,8 +595,28 @@ def make_handler(state: MockState):
                 self._json({"ok": True}, 201)
                 return
             if url.path == "/inject":
-                with state.lock:
-                    state.fail[body["op"]] = int(body.get("times", 1))
+                op = body["op"]
+                if op == "compact-history":
+                    # etcd compaction analogue: the WHOLE journal is gone —
+                    # every cursor behind the head now gets the relist
+                    # signal (journal {"relist": true} / k8s 410 Gone), and
+                    # active streams are woken to notice mid-window.
+                    with state.lock:
+                        state.compacted_through = state.seq
+                        state.events.clear()
+                        state.lock.notify_all()
+                elif op == "silent-delete":
+                    # Remove an object WITHOUT a journal event — the store
+                    # mutation whose delete the compaction swallowed.  The
+                    # version counter still advances (the mutation was
+                    # real); only the echo is lost, so the object survives
+                    # in every client cache as a ghost until a relist.
+                    with state.lock:
+                        state.objects[body["kind"]].pop(body["key"], None)
+                        state.seq += 1
+                else:
+                    with state.lock:
+                        state.fail[op] = int(body.get("times", 1))
                 self._json({"ok": True})
                 return
             if url.path in ("/bind", "/bind-bulk"):

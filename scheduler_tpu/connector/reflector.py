@@ -1,0 +1,318 @@
+"""Kubernetes-conformant ingestion: per-resource LIST+WATCH reflectors.
+
+This is the inbound half a REAL API server could feed (``SCHEDULER_TPU_WIRE=
+k8s``; docs/INGEST.md).  It ingests cluster state the way client-go's
+reflectors do for the reference's cache (cache/cache.go:256-336 builds one
+informer per resource type):
+
+* **LIST** per resource — ``GET /api/v1/pods`` (and ``/api/v1/nodes``,
+  ``/apis/scheduling.incubator.k8s.io/v1alpha1/podgroups`` …) returning a
+  ``{Kind}List`` envelope whose ``metadata.resourceVersion`` is the watch
+  cursor.
+* **WATCH** per resource — ``GET {path}?watch=1&resourceVersion=RV&
+  timeoutSeconds=T&allowWatchBookmarks=true``, a chunked stream of
+  newline-delimited ``{"type": ADDED|MODIFIED|DELETED|BOOKMARK|ERROR,
+  "object": …}`` events.  Applied events and BOOKMARKs advance the cursor
+  (``wire.obj_rv``); the stream's server-side timeout ends in a bookmark and
+  the client reconnects from its cursor.
+* **410 Gone** — the server's watch history is bounded; a cursor older than
+  its compaction horizon gets HTTP 410 (or a mid-stream ERROR event whose
+  Status object carries ``code: 410``).  Recovery is client-go's
+  relist-and-replace: re-LIST the resource, upsert everything, and prune
+  cached objects the LIST no longer carries — an object deleted during the
+  horizon gap must not survive as a ghost holding node resources.
+
+Events feed the existing ``SchedulerCache`` through the SAME ``_apply`` seam
+the journal client uses (``client.ConnectorBase``), so the two protocols are
+bind-for-bind interchangeable — pinned by the journal-vs-k8s parity test.
+Initial LISTs and every relist pay the shared connector ``TokenBucket``;
+watch streams deliberately do not (see ``client.connect_cache``).  All retry
+paths back off with jittered exponential delays (``client.Backoff``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+from scheduler_tpu.cache.cache import SchedulerCache
+from scheduler_tpu.connector.client import (
+    Backoff,
+    ConnectorBase,
+    TokenBucket,
+    _get,
+)
+from scheduler_tpu.connector.wire import (
+    LIST_RESOURCES,
+    WATCH_OPS,
+    obj_name,
+    obj_rv,
+    object_path,
+    pod_key,
+    pod_uid,
+)
+
+logger = logging.getLogger("scheduler_tpu.connector.reflector")
+
+
+class WatchExpired(Exception):
+    """The server compacted its watch history past our cursor (``410 Gone``,
+    at the HTTP layer or as a mid-stream ERROR Status event): the stream is
+    unrecoverable and the resource must relist-and-replace."""
+
+
+class Reflector:
+    """One resource's LIST+WATCH loop (client-go ``Reflector``): owns the
+    resourceVersion cursor, the per-resource backoff, and the dirty flag
+    that demotes the stream to a relist."""
+
+    def __init__(self, conn: "K8sApiConnector", kind: str, path: str,
+                 watch_timeout: float = 5.0) -> None:
+        self.conn = conn
+        self.kind = kind
+        self.path = path
+        self.watch_timeout = watch_timeout
+        self.rv = 0
+        self.synced = threading.Event()
+        self.backoff = Backoff()
+        # An event failed to apply beyond single-object repair (or a watch
+        # expired): this resource alone relists — the other reflectors'
+        # streams keep flowing.
+        self.dirty = False
+        self.relists = 0  # replace-relists performed (evidence for tests)
+
+    # -- LIST ----------------------------------------------------------------
+
+    def list_and_replace(self) -> None:
+        """LIST the resource; first call seeds, later calls REPLACE: upsert
+        every listed object and prune cached ones the LIST no longer carries
+        (client-go store Replace — ghosts from the horizon gap die here)."""
+        if self.conn.limiter is not None:
+            # The full-inventory burst pays the shared QPS budget; the
+            # watch stream below does not (client.connect_cache docstring).
+            self.conn.limiter.acquire()
+        doc = _get(self.conn.base, self.path)
+        items = doc.get("items", []) or []
+        rv = obj_rv(doc)
+        replace = self.synced.is_set()
+        op = "update" if replace else "add"
+        # Clear the flag BEFORE applying (the journal wire's ordering): an
+        # apply that diverges DURING this relist re-marks the resource dirty
+        # and the run loop relists again — clearing afterwards would swallow
+        # that divergence and resume watching over a known-bad cache.
+        self.dirty = False
+        for item in items:
+            self.conn._apply(self.kind, op, item)
+        if replace:
+            self.conn._prune_kind(self.kind, items)
+            self.relists += 1
+        if rv is not None:
+            self.rv = rv
+        self.synced.set()
+
+    # -- WATCH ---------------------------------------------------------------
+
+    def _watch_url(self) -> str:
+        return (
+            f"{self.conn.base}{self.path}?watch=1&resourceVersion={self.rv}"
+            f"&timeoutSeconds={max(1, int(self.watch_timeout))}"
+            f"&allowWatchBookmarks=true"
+        )
+
+    def watch_once(self) -> None:
+        """One watch stream: connect at the cursor, apply chunked events
+        until the server closes the window (bookmark) or the stream dies.
+        Raises ``WatchExpired`` on ``410 Gone`` in either envelope."""
+        try:
+            resp = urllib.request.urlopen(
+                self._watch_url(), timeout=self.watch_timeout + 30.0
+            )
+        except urllib.error.HTTPError as e:
+            if e.code == 410:
+                raise WatchExpired(f"{self.kind} watch cursor {self.rv}") from e
+            raise
+        with resp:
+            for raw in resp:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                self.handle_event(json.loads(raw))
+                if self.dirty or self.conn._stop.is_set():
+                    # Divergence (or shutdown): stop consuming, relist.
+                    return
+
+    def handle_event(self, event: dict) -> None:
+        """Apply ONE decoded watch event; the golden-stream fixtures drive
+        this directly.  Duplicate echoes are harmless by construction: the
+        cache's event handlers upsert by wire uid, so re-applying an event
+        is idempotent and the cursor max() ignores stale RVs."""
+        etype = event.get("type", "")
+        obj = event.get("object") or {}
+        if etype == "BOOKMARK":
+            rv = obj_rv(obj)
+            if rv is not None:
+                self.rv = max(self.rv, rv)
+            return
+        if etype == "ERROR":
+            # A Status object; code 410 == "resourceVersion too old".
+            if int(obj.get("code", 0)) == 410:
+                raise WatchExpired(f"{self.kind} stream ERROR status")
+            logger.warning("%s watch ERROR event: %s", self.kind, obj)
+            return
+        op = WATCH_OPS.get(etype)
+        if op is None:
+            logger.warning("unknown %s watch event type %r", self.kind, etype)
+            return
+        self.conn._apply(self.kind, op, obj)
+        rv = obj_rv(obj)
+        if rv is not None:
+            self.rv = max(self.rv, rv)
+
+    # -- the per-resource loop ----------------------------------------------
+
+    def run(self) -> None:
+        stop = self.conn._stop
+        while not stop.is_set():
+            if self.dirty or not self.synced.is_set():
+                try:
+                    self.list_and_replace()
+                    self.backoff.reset()
+                except Exception:
+                    if stop.is_set():
+                        return
+                    logger.warning(
+                        "%s relist failed; backing off", self.kind,
+                        exc_info=True,
+                    )
+                    stop.wait(self.backoff.next())
+                continue
+            try:
+                self.watch_once()
+                self.backoff.reset()
+            except WatchExpired:
+                logger.warning(
+                    "%s watch expired (410 Gone); relist-and-replace",
+                    self.kind,
+                )
+                self.dirty = True
+            except Exception:
+                if stop.is_set():
+                    return
+                logger.warning(
+                    "%s watch stream failed; backing off", self.kind,
+                    exc_info=True,
+                )
+                stop.wait(self.backoff.next())
+
+
+class K8sApiConnector(ConnectorBase):
+    """The reflector subsystem: one ``Reflector`` per resource, seeded in
+    dependency order (queues/priority classes before groups before pods —
+    the journal's list_and_seed order), then one watch-stream thread per
+    resource.  Same public surface as the journal ``ApiConnector``:
+    ``start`` / ``wait_for_cache_sync`` / ``stop`` / ``sync_pod``."""
+
+    def __init__(self, cache: SchedulerCache, base: str,
+                 limiter: Optional[TokenBucket] = None,
+                 watch_timeout: float = 5.0) -> None:
+        super().__init__(cache, base, limiter)
+        self.reflectors: List[Reflector] = [
+            Reflector(self, kind, path, watch_timeout=watch_timeout)
+            for kind, path, _ in LIST_RESOURCES
+        ]
+        self._by_kind = {r.kind: r for r in self.reflectors}
+        self._threads: List[threading.Thread] = []
+        self._boot: Optional[threading.Thread] = None
+
+    # -- divergence routing --------------------------------------------------
+
+    def _mark_dirty(self, kind: str) -> None:
+        # Only the affected RESOURCE relists — per-kind stores are exactly
+        # what per-resource reflectors buy over the global journal.
+        r = self._by_kind.get(kind)
+        if r is not None:
+            r.dirty = True
+        else:  # unknown kind: cannot scope the damage
+            self._dirty = True
+
+    def _prune_kind(self, kind: str, items: list) -> None:
+        """Replace semantics for ONE kind: everything cached but absent from
+        the fresh LIST is a ghost.  Uses the cache's relist reconciler with
+        only this kind's survivor set (None == kind untouched); the pod set
+        keys by wire uid — the SAME identity rule ``parse_pod`` uses
+        (wire.pod_uid), or live pods would be pruned as ghosts."""
+        kw = {}
+        if kind == "pod":
+            kw["pod_uids"] = {pod_uid(p) for p in items}
+        elif kind == "node":
+            kw["node_names"] = {obj_name(n) for n in items}
+        elif kind == "podgroup":
+            kw["podgroup_keys"] = {pod_key(g) for g in items}
+        elif kind == "queue":
+            kw["queue_names"] = {obj_name(q) for q in items}
+        elif kind == "priorityclass":
+            kw["priority_class_names"] = {obj_name(pc) for pc in items}
+        else:
+            return
+        removed = self.cache.prune_absent(**kw)
+        if removed:
+            logger.warning("%s relist pruned %d ghost objects", kind, removed)
+
+    # -- single-object re-fetch (syncTask seam) ------------------------------
+
+    def get_object(self, kind: str, key: str) -> Optional[dict]:
+        try:
+            return _get(self.base, object_path(kind, key), timeout=10.0)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _run(self) -> None:
+        # Initial LISTs sequentially, in dependency order, each retried with
+        # backoff (the daemon and its system of record start concurrently in
+        # any orchestrated deploy — a refused connection at boot must
+        # resync, not crash).
+        for r in self.reflectors:
+            while not self._stop.is_set() and not r.synced.is_set():
+                try:
+                    r.list_and_replace()
+                    r.backoff.reset()
+                except Exception:
+                    if self._stop.is_set():
+                        return
+                    logger.warning(
+                        "initial %s LIST failed; retrying", r.kind,
+                        exc_info=True,
+                    )
+                    self._stop.wait(r.backoff.next())
+        if self._stop.is_set():
+            return
+        self.synced.set()
+        for r in self.reflectors:
+            t = threading.Thread(
+                target=r.run, name=f"reflector-{r.kind}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def start(self) -> None:
+        self._boot = threading.Thread(
+            target=self._run, name="reflector-boot", daemon=True
+        )
+        self._boot.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._boot is not None:
+            self._boot.join(timeout=10)
+        for t in self._threads:
+            # Streams notice the stop flag at their next event/bookmark; the
+            # server's stream timeout bounds that wait.
+            t.join(timeout=10)

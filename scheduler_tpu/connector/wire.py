@@ -463,6 +463,72 @@ def parse_pod(p: Dict, default_scheduler: str = "volcano") -> PodSpec:
     return pod
 
 
+# -- k8s LIST+WATCH wire tables (inbound reflector protocol) ------------------
+
+# The CRD group the reference registers its PodGroup/Queue types under
+# (pkg/apis/scheduling/v1alpha1/register.go:32).  Outbound status PATCHes
+# (client.py) and the inbound reflector (reflector.py) MUST speak the same
+# group — one resource, one API path.
+CRD_PREFIX = "/apis/scheduling.incubator.k8s.io/v1alpha1"
+
+# Collection path + item Kind per cache kind, in the dependency order the
+# initial sync seeds them (queues/priority classes before groups before pods,
+# matching the journal protocol's list_and_seed order).  These paths are the
+# LIST endpoints (``GET {path}``) and, with ``?watch=1&resourceVersion=RV``,
+# the WATCH streams — exactly client-go's per-resource reflector surface
+# (reference cache/cache.go:256-336 builds one informer per type).
+LIST_RESOURCES = (
+    ("queue", CRD_PREFIX + "/queues", "Queue"),
+    ("priorityclass", "/apis/scheduling.k8s.io/v1/priorityclasses",
+     "PriorityClass"),
+    ("node", "/api/v1/nodes", "Node"),
+    ("podgroup", CRD_PREFIX + "/podgroups", "PodGroup"),
+    ("pod", "/api/v1/pods", "Pod"),
+)
+
+# k8s watch-event types -> the cache's event-handler ops.  BOOKMARK and ERROR
+# are protocol-level (cursor advance / stream status) and deliberately absent:
+# they never reach the cache.
+WATCH_OPS = {"ADDED": "add", "MODIFIED": "update", "DELETED": "delete"}
+
+
+def object_path(kind: str, key: str) -> str:
+    """Single-object GET path for the k8s wire (the syncTask re-fetch shape):
+    namespaced kinds take ``ns/name`` keys, cluster-scoped kinds bare names."""
+    if kind == "pod":
+        ns, name = key.split("/", 1)
+        return f"/api/v1/namespaces/{ns}/pods/{name}"
+    if kind == "podgroup":
+        ns, name = key.split("/", 1)
+        return f"{CRD_PREFIX}/namespaces/{ns}/podgroups/{name}"
+    if kind == "node":
+        return f"/api/v1/nodes/{key}"
+    if kind == "queue":
+        return f"{CRD_PREFIX}/queues/{key}"
+    if kind == "priorityclass":
+        return f"/apis/scheduling.k8s.io/v1/priorityclasses/{key}"
+    raise ValueError(f"unknown kind {kind!r}")
+
+
+def obj_rv(obj: Dict) -> Optional[int]:
+    """The wire resourceVersion of an object, in either dialect — the cursor
+    the reflector advances on every applied event and bookmark.  Like
+    ``pod_uid`` above, this is THE one identity-adjacent rule both the client
+    and the servers must share: a server stamping RVs where the client does
+    not look would freeze the cursor and replay the whole stream after every
+    reconnect.  k8s envelope: ``metadata.resourceVersion``; compact dialect:
+    top-level ``resourceVersion``.  Absent or malformed == None (the caller
+    keeps its cursor)."""
+    meta = obj.get("metadata")
+    raw = (meta if isinstance(meta, dict) else obj).get("resourceVersion")
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return None
+
+
 def pod_key(obj: Dict) -> str:
     meta = obj.get("metadata")
     if isinstance(meta, dict):

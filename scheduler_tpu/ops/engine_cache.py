@@ -74,6 +74,13 @@ _ENV_KEYS = (
     # resident engine must not straddle a flag flip mid-diagnosis: keyed so
     # arming the sanitizer always starts from a fresh, fully-checked build.
     "SCHEDULER_TPU_SHARDCHECK",
+    # Inbound wire protocol (connector/client.py wire_from_env: journal vs
+    # per-resource k8s LIST+WATCH reflectors, docs/INGEST.md).  Never read by
+    # the engine itself, but registered so a resident engine is pinned to the
+    # ingestion protocol it was diagnosed under — the parity contract says
+    # the protocols are bind-identical, and keying here means a violation of
+    # that contract can never hide behind a warm cache across a flag flip.
+    "SCHEDULER_TPU_WIRE",
 )
 
 _scope_counter = itertools.count(1)

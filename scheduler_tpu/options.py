@@ -43,6 +43,12 @@ class ServerOption:
     # shapes — pods/binding POSTs, pod DELETEs, status PATCHes) or "legacy"
     # (the compact bespoke JSON RPCs).
     api_dialect: str = "k8s"
+    # Inbound ingestion protocol for --api-server: "journal" (the bespoke
+    # GET /state + GET /watch?since=seq journal) or "k8s" (per-resource
+    # LIST+WATCH reflectors with resourceVersion cursors and 410 Gone
+    # relist recovery — docs/INGEST.md).  None defers to SCHEDULER_TPU_WIRE
+    # (default journal).
+    wire: Optional[str] = None
 
 
 # The reference keeps a mutable global the cache reads back
@@ -119,6 +125,7 @@ def option_from_namespace(ns: argparse.Namespace) -> ServerOption:
         profile_dir=ns.profile_dir,
         mesh=ns.mesh,
         api_dialect=getattr(ns, "api_dialect", "k8s"),
+        wire=getattr(ns, "wire", None),
     )
 
 

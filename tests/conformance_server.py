@@ -14,10 +14,26 @@ Surface implemented, and STRICTLY validated — any request this fixture does
 not recognize, or whose body is malformed, is recorded in ``violations``
 (and the conformance test asserts that list is empty):
 
-inbound (the connector's ingestion protocol):
+inbound (the connector's journal ingestion protocol):
   GET /state                      full inventory + watch cursor
   GET /watch?since=N&timeout=T    long-poll journal tail
   GET /objects/{kind}/{key}       single-object re-fetch (404 when absent)
+
+inbound (the Kubernetes reflector protocol, SCHEDULER_TPU_WIRE=k8s):
+  GET /api/v1/pods | /api/v1/nodes
+  GET /apis/scheduling.incubator.k8s.io/v1alpha1/podgroups | …/queues
+  GET /apis/scheduling.k8s.io/v1/priorityclasses
+      LIST: a {Kind}List envelope with metadata.resourceVersion;
+      with ?watch=1&resourceVersion=RV[&timeoutSeconds=T]
+      [&allowWatchBookmarks=true]: a chunked stream of newline-delimited
+      ADDED/MODIFIED/DELETED watch events, closing with a BOOKMARK when
+      requested; a cursor behind the journal's compaction horizon gets a
+      REAL 410 Gone (HTTP status at watch start, ERROR event mid-stream).
+      A watch request without a resourceVersion is a protocol violation.
+  GET single objects at the typed k8s paths (the syncTask re-fetch):
+      /api/v1/namespaces/{ns}/pods/{name}, /api/v1/nodes/{name},
+      …/namespaces/{ns}/podgroups/{name}, …/queues/{name},
+      /apis/scheduling.k8s.io/v1/priorityclasses/{name}
 
 outbound (real Kubernetes API shapes, the k8s dialect):
   POST   /api/v1/namespaces/{ns}/pods/{name}/binding       v1 Binding
@@ -45,16 +61,42 @@ from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
 
 CRD_GROUP = "scheduling.incubator.k8s.io"
 
+# The reflector protocol's collection paths: path -> (store kind, item Kind).
+# Written from the wire contract (docs/INGEST.md), NOT imported from the
+# connector — this fixture is the independent implementation.
+K8S_COLLECTIONS = {
+    "/api/v1/pods": ("pod", "Pod"),
+    "/api/v1/nodes": ("node", "Node"),
+    f"/apis/{CRD_GROUP}/v1alpha1/podgroups": ("podgroup", "PodGroup"),
+    f"/apis/{CRD_GROUP}/v1alpha1/queues": ("queue", "Queue"),
+    "/apis/scheduling.k8s.io/v1/priorityclasses":
+        ("priorityclass", "PriorityClass"),
+}
+
+_EVENT_TYPE = {"add": "ADDED", "update": "MODIFIED", "delete": "DELETED"}
+
+
+def _gone() -> dict:
+    return {
+        "kind": "Status", "apiVersion": "v1", "status": "Failure",
+        "reason": "Expired", "message": "too old resource version",
+        "code": 410,
+    }
+
 
 class DocStore:
-    """Kubernetes-shaped documents + an append-only watch journal."""
+    """Kubernetes-shaped documents + a BOUNDED append-only watch journal
+    (entries past ``journal_cap`` are compacted away; cursors behind the
+    horizon get real 410s on the k8s endpoints)."""
 
-    def __init__(self) -> None:
+    def __init__(self, journal_cap: int = 10_000) -> None:
         self.lock = threading.Condition()
         # (kind, key) -> document; key is "ns/name" for namespaced kinds.
         self.docs: Dict[Tuple[str, str], dict] = {}
         self.seq = 0
         self.journal: List[dict] = []
+        self.journal_cap = journal_cap
+        self.compacted = 0                    # highest seq dropped from journal
         self.events: List[dict] = []          # v1 Events POSTed at us
         self.violations: List[str] = []       # protocol breaches — must stay []
         self.bind_calls = 0
@@ -87,7 +129,20 @@ class DocStore:
                 "seq": self.seq, "kind": kind, "op": op,
                 "object": json.loads(json.dumps(doc)),
             })
+            if len(self.journal) > self.journal_cap:
+                drop = len(self.journal) - self.journal_cap
+                self.compacted = self.journal[drop - 1]["seq"]
+                del self.journal[:drop]
         self.lock.notify_all()
+
+    def compact(self) -> None:
+        """Drop the WHOLE journal (etcd compaction): every watch cursor
+        behind the head must now see 410 Gone and relist.  Test hook for the
+        golden 410 streams."""
+        with self.lock:
+            self.compacted = self.seq
+            self.journal.clear()
+            self.lock.notify_all()
 
     def violation(self, msg: str) -> None:
         with self.lock:
@@ -116,7 +171,7 @@ def _app(store: DocStore):
     def respond(start, code: int, payload: dict):
         body = json.dumps(payload).encode()
         reasons = {200: "OK", 201: "Created", 400: "Bad Request",
-                   404: "Not Found", 409: "Conflict",
+                   404: "Not Found", 409: "Conflict", 410: "Gone",
                    422: "Unprocessable Entity"}
         start(f"{code} {reasons.get(code, 'OK')}",
               [("Content-Type", "application/json"),
@@ -152,6 +207,94 @@ def _app(store: DocStore):
                 if remaining <= 0:
                     return {"events": []}
                 store.lock.wait(remaining)
+
+    def k8s_list_payload(kind: str, k8s_kind: str) -> dict:
+        with store.lock:
+            items = [
+                doc for (k, _), doc in sorted(store.docs.items()) if k == kind
+            ]
+            # Deep-copy under the lock (same tearing hazard as /state).
+            return json.loads(json.dumps({
+                "apiVersion": "v1", "kind": f"{k8s_kind}List",
+                "metadata": {"resourceVersion": str(store.seq)},
+                "items": items,
+            }))
+
+    def k8s_watch_stream(kind: str, k8s_kind: str, since: int,
+                         timeout: float, bookmarks: bool):
+        """Generator of newline-delimited watch-event chunks: the wsgiref
+        handler flushes each yielded block, so events stream as they land."""
+        deadline = time.monotonic() + timeout
+        last = since
+        while True:
+            batch: List[dict] = []
+            gone = False
+            bookmark_rv = None
+            with store.lock:
+                while True:
+                    if last < store.compacted:
+                        gone = True       # horizon passed the cursor mid-stream
+                        break
+                    batch = [
+                        e for e in store.journal
+                        if e["seq"] > last and e["kind"] == kind
+                    ]
+                    if batch:
+                        break
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        # Cursor for the closing bookmark, snapshotted under
+                        # the lock that confirmed nothing of this kind is
+                        # pending — a racing event must not be skipped.
+                        bookmark_rv = store.seq
+                        break
+                    store.lock.wait(left)
+                batch = json.loads(json.dumps(batch))
+            for e in batch:
+                obj = e["object"]
+                obj.setdefault("metadata", {})["resourceVersion"] = str(e["seq"])
+                yield (json.dumps(
+                    {"type": _EVENT_TYPE[e["op"]], "object": obj}
+                ) + "\n").encode()
+                last = e["seq"]
+            if gone:
+                yield (json.dumps({"type": "ERROR", "object": _gone()})
+                       + "\n").encode()
+                return
+            if bookmark_rv is not None:
+                if bookmarks:
+                    yield (json.dumps({"type": "BOOKMARK", "object": {
+                        "kind": k8s_kind, "apiVersion": "v1",
+                        "metadata": {
+                            "resourceVersion": str(max(bookmark_rv, last)),
+                        },
+                    }}) + "\n").encode()
+                return
+
+    def k8s_object_key(path: str) -> Optional[Tuple[str, str]]:
+        """Typed single-object GET paths (the syncTask re-fetch shape)."""
+        parts = [p for p in path.split("/") if p]
+        if parts[:3] == ["api", "v1", "nodes"] and len(parts) == 4:
+            return "node", parts[3]
+        if (
+            parts[:3] == ["api", "v1", "namespaces"] and len(parts) == 6
+            and parts[4] == "pods"
+        ):
+            return "pod", f"{parts[3]}/{parts[5]}"
+        if parts[:2] == ["apis", CRD_GROUP] and len(parts) > 2 \
+                and parts[2] == "v1alpha1":
+            rest = parts[3:]
+            if len(rest) == 2 and rest[0] == "queues":
+                return "queue", rest[1]
+            if len(rest) == 4 and rest[0] == "namespaces" \
+                    and rest[2] == "podgroups":
+                return "podgroup", f"{rest[1]}/{rest[3]}"
+        if (
+            parts[:3] == ["apis", "scheduling.k8s.io", "v1"]
+            and len(parts) == 5 and parts[3] == "priorityclasses"
+        ):
+            return "priorityclass", parts[4]
+        return None
 
     def handle_binding(ns: str, name: str, body: dict, start):
         if (
@@ -207,6 +350,43 @@ def _app(store: DocStore):
                     return respond(start, 404, {"error": "not found"})
                 return respond(start, 200, doc)
             return respond(start, 404, {"error": "bad object path"})
+
+        # ---- inbound: the Kubernetes reflector protocol --------------------
+        if method == "GET" and path in K8S_COLLECTIONS:
+            kind, k8s_kind = K8S_COLLECTIONS[path]
+            if qs.get("watch", "0").lower() in ("1", "true"):
+                if "resourceVersion" not in qs:
+                    # client-go always watches FROM a cursor; a watch
+                    # without one would replay arbitrary history.
+                    store.violation(f"watch without resourceVersion: {path}")
+                    return respond(start, 400, {"error": "no resourceVersion"})
+                try:
+                    since = int(qs["resourceVersion"])
+                    timeout = min(float(qs.get("timeoutSeconds", 10)), 30.0)
+                except ValueError:
+                    store.violation(f"malformed watch params: {qs}")
+                    return respond(start, 400, {"error": "bad watch params"})
+                with store.lock:
+                    if since < store.compacted:
+                        return respond(start, 410, _gone())
+                bookmarks = qs.get(
+                    "allowWatchBookmarks", "false"
+                ).lower() in ("1", "true")
+                start("200 OK", [("Content-Type", "application/json")])
+                return k8s_watch_stream(kind, k8s_kind, since, timeout,
+                                        bookmarks)
+            return respond(start, 200, k8s_list_payload(kind, k8s_kind))
+        if method == "GET":
+            route = k8s_object_key(path)
+            if route is not None:
+                kind, key = route
+                with store.lock:
+                    doc = store.docs.get((kind, key))
+                    if doc is not None:
+                        doc = json.loads(json.dumps(doc))
+                if doc is None:
+                    return respond(start, 404, {"error": "not found"})
+                return respond(start, 200, doc)
 
         # ---- outbound: Kubernetes API shapes ------------------------------
         parts = [p for p in path.split("/") if p]

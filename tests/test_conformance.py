@@ -68,8 +68,12 @@ def _pod(name: str, group: str, extra_spec: dict | None = None) -> dict:
     }
 
 
-@pytest.fixture(scope="module")
-def rig(tmp_path_factory):
+@pytest.fixture(scope="module", params=["journal", "k8s"])
+def rig(request, tmp_path_factory):
+    """One full daemon-against-fixture rig PER INBOUND WIRE: the journal
+    protocol and the Kubernetes reflector protocol (per-resource LIST+WATCH,
+    ``SCHEDULER_TPU_WIRE=k8s``) must both drive the whole session with zero
+    protocol violations — the inbound half of the conformance contract."""
     # Port 0 + readback: fixed ports collide under parallel test runs.
     server, store = start_conformance_server(0)
     base = f"http://127.0.0.1:{server.server_address[1]}"
@@ -113,6 +117,7 @@ def rig(tmp_path_factory):
     opt = ServerOption(
         scheduler_conf=str(conf_path), schedule_period=0.2,
         listen_address="127.0.0.1:0", io_workers=2,
+        wire=request.param,
     )
     stop = threading.Event()
     t = threading.Thread(

@@ -1,0 +1,523 @@
+"""Kubernetes-conformant ingestion (connector/reflector.py, docs/INGEST.md):
+per-resource LIST+WATCH reflectors, resourceVersion cursors, 410 Gone
+relist-and-replace, and protocol parity with the bespoke journal.
+
+Three layers:
+
+* golden watch streams — hand-written event sequences (add / modify /
+  duplicate echo / delete / bookmark / mid-stream 410) fed straight into
+  ``Reflector.handle_event``, and raw chunked streams read off the
+  INDEPENDENT conformance fixture's k8s endpoints;
+* end-to-end against the mock apiserver — ``SCHEDULER_TPU_WIRE=k8s`` seeds
+  the cache from per-resource LISTs, watch events drive updates, and a
+  forced 410 (compacted history + silently-deleted pod) relists and prunes
+  the ghost;
+* journal-vs-k8s parity — identical cluster histories through both inbound
+  protocols must produce BITWISE-identical bind sequences on the server
+  (the acceptance contract that makes the wires interchangeable).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.cache.cache import SchedulerCache
+from scheduler_tpu.connector import client as client_mod
+from scheduler_tpu.connector import reflector as reflector_mod
+from scheduler_tpu.connector.client import ApiConnector, Backoff
+from scheduler_tpu.connector.mock_server import serve
+from scheduler_tpu.connector.reflector import K8sApiConnector, WatchExpired
+from scheduler_tpu.connector.wire import LIST_RESOURCES, obj_rv
+
+from tests.conformance_server import start_conformance_server
+
+CONF = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: predicates
+  - name: nodeorder
+"""
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+# -- golden streams into handle_event ----------------------------------------
+
+
+def _pod_doc(name: str, rv: int, node: str = "") -> dict:
+    doc = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": name, "namespace": "default", "uid": f"uid-{name}",
+            "resourceVersion": str(rv),
+        },
+        "spec": {
+            "schedulerName": "volcano",
+            "containers": [{
+                "name": "main",
+                "resources": {"requests": {"cpu": "100m", "memory": "1Mi"}},
+            }],
+        },
+        "status": {"phase": "Pending"},
+    }
+    if node:
+        doc["spec"]["nodeName"] = node
+        doc["status"]["phase"] = "Running"
+    return doc
+
+
+def _reflector(kind="pod"):
+    cache = SchedulerCache(async_io=False)
+    conn = K8sApiConnector(cache, "http://unused.invalid")
+    return cache, conn, conn._by_kind[kind]
+
+
+def _task_names(cache):
+    with cache.mutex:
+        return sorted(
+            t.name for j in cache.jobs.values() for t in j.tasks.values()
+        )
+
+
+def test_golden_stream_add_modify_duplicate_delete_bookmark():
+    """The canonical event sequence, including a DUPLICATE MODIFIED echo
+    (the at-least-once delivery real watches exhibit after reconnects):
+    the cache must hold exactly one task per wire uid throughout, and the
+    cursor must ride the max applied resourceVersion."""
+    cache, _conn, r = _reflector()
+
+    r.handle_event({"type": "ADDED", "object": _pod_doc("gp-0", 3)})
+    assert _task_names(cache) == ["gp-0"] and r.rv == 3
+
+    modified = {"type": "MODIFIED", "object": _pod_doc("gp-0", 5, node="n0")}
+    r.handle_event(modified)
+    r.handle_event(json.loads(json.dumps(modified)))  # duplicate echo
+    assert _task_names(cache) == ["gp-0"], "duplicate echo duplicated the task"
+    assert r.rv == 5
+
+    # A stale replay (older rv) must not rewind the cursor.
+    r.handle_event({"type": "MODIFIED", "object": _pod_doc("gp-0", 4, node="n0")})
+    assert r.rv == 5
+
+    r.handle_event({"type": "BOOKMARK", "object": {
+        "kind": "Pod", "metadata": {"resourceVersion": "9"}}})
+    assert r.rv == 9 and _task_names(cache) == ["gp-0"]
+
+    r.handle_event({"type": "DELETED", "object": _pod_doc("gp-0", 11)})
+    assert _task_names(cache) == [] and r.rv == 11
+
+
+def test_golden_stream_error_410_raises_watch_expired():
+    _cache, _conn, r = _reflector()
+    with pytest.raises(WatchExpired):
+        r.handle_event({"type": "ERROR", "object": {
+            "kind": "Status", "status": "Failure", "reason": "Expired",
+            "code": 410,
+        }})
+
+
+def test_golden_stream_unknown_type_and_non_410_error_are_skipped():
+    _cache, _conn, r = _reflector()
+    r.handle_event({"type": "ERROR", "object": {"kind": "Status", "code": 500}})
+    r.handle_event({"type": "SYNCED", "object": _pod_doc("gp-x", 7)})
+    assert r.rv == 0  # nothing applied, cursor untouched
+
+
+# -- raw chunked streams off the independent conformance fixture -------------
+
+
+@pytest.fixture()
+def conformance():
+    server, store = start_conformance_server(0)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        yield base, store
+    finally:
+        server.shutdown()
+
+
+def _read_stream(base, path, timeout=10.0):
+    lines = []
+    with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+        for raw in resp:
+            raw = raw.strip()
+            if raw:
+                lines.append(json.loads(raw))
+    return lines
+
+
+def test_conformance_watch_stream_golden_sequence(conformance):
+    """ADDED -> MODIFIED -> DELETED in one chunked window, closed by a
+    BOOKMARK carrying the head resourceVersion."""
+    base, store = conformance
+    pod = _pod_doc("cw-0", 0)
+    del pod["metadata"]["resourceVersion"]  # server stamps RVs, not us
+    store.put("pod", pod)
+    store.put("pod", json.loads(json.dumps(pod)))  # same key -> update
+    store.put("pod", pod, op="delete")
+
+    events = _read_stream(
+        base,
+        "/api/v1/pods?watch=1&resourceVersion=0&timeoutSeconds=1"
+        "&allowWatchBookmarks=true",
+    )
+    assert [e["type"] for e in events] == \
+        ["ADDED", "MODIFIED", "DELETED", "BOOKMARK"]
+    rvs = [obj_rv(e["object"]) for e in events]
+    assert rvs == sorted(rvs) and rvs[0] >= 1, rvs
+    # Streamed objects carry the cursor where the client reads it.
+    assert events[0]["object"]["metadata"]["name"] == "cw-0"
+    assert store.violations == []
+
+
+def test_conformance_watch_410_at_start_and_mid_stream(conformance):
+    """A cursor behind the compaction horizon gets HTTP 410 Gone at watch
+    START; a compaction landing while a stream waits surfaces as a
+    mid-stream ERROR event whose Status carries code 410."""
+    base, store = conformance
+    store.put("node", {
+        "apiVersion": "v1", "kind": "Node", "metadata": {"name": "cn-0"},
+        "status": {"allocatable": {"cpu": "1"}},
+    })
+    store.compact()
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _read_stream(
+            base, "/api/v1/nodes?watch=1&resourceVersion=0&timeoutSeconds=1")
+    assert err.value.code == 410
+    assert json.loads(err.value.read())["code"] == 410
+
+    # Mid-stream: start a watch AT the head, then (atomically) append an
+    # event and compact it away before the stream can deliver it.
+    with store.lock:
+        head = store.seq
+    results = []
+    t = threading.Thread(target=lambda: results.append(_read_stream(
+        base,
+        f"/api/v1/nodes?watch=1&resourceVersion={head}&timeoutSeconds=8",
+    )))
+    t.start()
+    time.sleep(0.3)  # let the stream enter its wait
+    with store.lock:
+        store._put_locked("node", {
+            "apiVersion": "v1", "kind": "Node", "metadata": {"name": "cn-1"},
+            "status": {"allocatable": {"cpu": "1"}},
+        }, "add")
+        store.compacted = store.seq
+        store.journal.clear()
+        store.lock.notify_all()
+    t.join(timeout=10)
+    assert not t.is_alive(), "stream never closed after mid-stream compaction"
+    (events,) = results
+    assert events[-1]["type"] == "ERROR"
+    assert events[-1]["object"]["code"] == 410
+    # Watch-without-cursor is a protocol violation (strict fixture), but
+    # everything this test sent was well-formed.
+    assert store.violations == []
+
+
+def test_conformance_watch_without_cursor_is_a_violation(conformance):
+    base, store = conformance
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _read_stream(base, "/api/v1/pods?watch=1&timeoutSeconds=1")
+    assert err.value.code == 400
+    assert any("resourceVersion" in v for v in store.violations)
+
+
+def test_reflector_consumes_conformance_stream_end_to_end(conformance):
+    """A real Reflector against the independent fixture: LIST seeds, the
+    chunked watch applies adds/deletes, bookmarks advance the cursor past
+    quiet windows."""
+    base, store = conformance
+    store.put("pod", (lambda d: (d["metadata"].pop("resourceVersion"), d)[1])(
+        _pod_doc("rc-0", 0)))
+    cache = SchedulerCache(async_io=False)
+    conn = K8sApiConnector(cache, base, watch_timeout=1.0)
+    conn.start()
+    try:
+        assert conn.wait_for_cache_sync(10)
+        assert _task_names(cache) == ["rc-0"]
+        r = conn._by_kind["pod"]
+        seeded_rv = r.rv
+        pod2 = _pod_doc("rc-1", 0)
+        del pod2["metadata"]["resourceVersion"]
+        store.put("pod", pod2)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(_task_names(cache)) < 2:
+            time.sleep(0.05)
+        assert _task_names(cache) == ["rc-0", "rc-1"]
+        # Quiet windows close with bookmarks: the cursor must keep moving
+        # even though no pod events flow (other kinds bump the global RV).
+        store.put("node", {
+            "apiVersion": "v1", "kind": "Node", "metadata": {"name": "rn-0"},
+            "status": {"allocatable": {"cpu": "1"}},
+        })
+        with store.lock:
+            head = store.seq
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and r.rv < head:
+            time.sleep(0.1)
+        assert r.rv >= head, (r.rv, head)
+        assert r.rv > seeded_rv
+        assert store.violations == []
+    finally:
+        conn.stop()
+
+
+# -- end-to-end against the mock apiserver (SCHEDULER_TPU_WIRE=k8s) ----------
+
+
+def _spawn_mock():
+    server, state = serve(0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, state, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def _seed_cluster(base):
+    """One fixture history, used identically by both parity drives."""
+    _post(base, "/objects", {"kind": "queue",
+                             "object": {"name": "default", "weight": 1}})
+    for i in range(3):
+        _post(base, "/objects", {"kind": "node", "object": {
+            "name": f"pn-{i}",
+            "allocatable": {"cpu": 4000, "memory": 16 * 2**30, "pods": 110},
+        }})
+    _post(base, "/objects", {"kind": "podgroup", "object": {
+        "name": "pg", "queue": "default", "minMember": 4, "phase": "Inqueue"}})
+    for i in range(5):
+        _post(base, "/objects", {"kind": "pod", "object": {
+            "name": f"pp-{i}", "group": "pg",
+            "containers": [{"cpu": 500 + 100 * i, "memory": 2**30}]}})
+
+
+def test_k8s_wire_end_to_end_with_forced_410_ghost_prune(tmp_path):
+    """The acceptance loop: with wire=k8s the scheduler runs end-to-end
+    against the k8s-shaped mock apiserver — LIST seeds the cache, watch
+    events drive updates (the bind echo flips tasks Running), and a forced
+    410 Gone (compacted history hiding a silent delete) triggers a
+    relist-and-replace that prunes the ghost pod."""
+    from scheduler_tpu.api.types import TaskStatus
+    from scheduler_tpu.scheduler import Scheduler
+
+    server, state, base = _spawn_mock()
+    conf = tmp_path / "scheduler.yaml"
+    conf.write_text(CONF)
+    conn = None
+    try:
+        _seed_cluster(base)
+        cache, conn = client_mod.connect_cache(
+            base, async_io=False, wire="k8s")
+        for r in conn.reflectors:
+            r.watch_timeout = 1.0
+        cache.run()
+        conn.start()
+        assert conn.wait_for_cache_sync(15)
+        with cache.mutex:
+            assert len(cache.nodes) == 3
+            assert sum(len(j.tasks) for j in cache.jobs.values()) == 5
+
+        sched = Scheduler(cache, str(conf))
+        sched.run_once()
+
+        # Watch echoes carry the binds back: all five pods flip Running.
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            with cache.mutex:
+                running = sum(
+                    1 for j in cache.jobs.values()
+                    for t in j.tasks.values()
+                    if t.status == TaskStatus.RUNNING
+                )
+            if running == 5:
+                break
+            time.sleep(0.1)
+        assert running == 5, f"only {running}/5 tasks Running via watch echo"
+        assert _get(base, "/stats")["list_calls"] >= 5  # one per resource
+
+        # Forced 410: the server loses pp-4's delete in a compaction.
+        pod_reflector = conn._by_kind["pod"]
+        relists_before = pod_reflector.relists
+        _post(base, "/inject",
+              {"op": "silent-delete", "kind": "pod", "key": "default/pp-4"})
+        _post(base, "/inject", {"op": "compact-history"})
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if "pp-4" not in _task_names(cache):
+                break
+            time.sleep(0.1)
+        assert "pp-4" not in _task_names(cache), "ghost pod survived the relist"
+        assert _task_names(cache) == [f"pp-{i}" for i in range(4)]
+        assert pod_reflector.relists > relists_before
+    finally:
+        if conn is not None:
+            conn.stop()
+        server.shutdown()
+
+
+# -- journal-vs-k8s bind parity ----------------------------------------------
+
+
+def _drive_binds(wire: str, conf_path) -> list:
+    """Seed one fixture history, schedule one cycle over it through the
+    given inbound wire, and return the server's ORDERED bind log."""
+    from scheduler_tpu.scheduler import Scheduler
+
+    server, state, base = _spawn_mock()
+    conn = None
+    try:
+        _seed_cluster(base)
+        cache, conn = client_mod.connect_cache(
+            base, async_io=False, wire=wire)
+        if wire == "k8s":
+            for r in conn.reflectors:
+                r.watch_timeout = 1.0
+        cache.run()
+        conn.start()
+        assert conn.wait_for_cache_sync(15)
+        Scheduler(cache, str(conf_path)).run_once()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if len(_get(base, "/bind-log")["binds"]) >= 5:
+                break
+            time.sleep(0.1)
+        return _get(base, "/bind-log")["binds"]
+    finally:
+        if conn is not None:
+            conn.stop()
+        server.shutdown()
+
+
+def test_journal_and_k8s_wires_produce_identical_bind_sequences(tmp_path):
+    """The parity contract (ISSUE acceptance): identical cluster histories
+    through the journal and k8s protocols yield bitwise-identical ordered
+    (pod, node) bind sequences — the cache cannot tell the wires apart."""
+    conf = tmp_path / "scheduler.yaml"
+    conf.write_text(CONF)
+    journal = _drive_binds("journal", conf)
+    k8s = _drive_binds("k8s", conf)
+    assert len(journal) == 5, journal
+    assert journal == k8s
+
+
+# -- backoff -----------------------------------------------------------------
+
+
+def test_backoff_jittered_doubling_caps_and_resets():
+    b = Backoff(base=1.0, cap=8.0, factor=2.0, jitter=0.5, rng=lambda: 1.0)
+    # delay * (1 + jitter): 1, 2, 4, 8, 8(capped)...
+    assert [b.next() for _ in range(5)] == [1.5, 3.0, 6.0, 12.0, 12.0]
+    b.reset()
+    assert b.next() == 1.5
+    floor = Backoff(base=1.0, cap=8.0, jitter=0.5, rng=lambda: 0.0)
+    assert floor.next() == 1.0  # zero jitter draw == the undecorated delay
+
+
+def test_backoff_rejects_malformed_schedules():
+    for kwargs in ({"base": 0.0}, {"factor": 0.5}, {"base": 2.0, "cap": 1.0}):
+        with pytest.raises(ValueError):
+            Backoff(**kwargs)
+
+
+def test_journal_watch_loop_retries_through_backoff(monkeypatch):
+    """A dead server must be retried on the jittered exponential schedule,
+    not a tight fixed-cadence hammer (connector/client.py retry paths)."""
+    cache = SchedulerCache(async_io=False)
+    conn = ApiConnector(cache, "http://unused.invalid")
+    delays = []
+
+    class Recorder:
+        def next(self):
+            delays.append(1)
+            if len(delays) >= 3:
+                conn._stop.set()
+            return 0.0
+
+        def reset(self):
+            pass
+
+    conn._backoff = Recorder()
+    monkeypatch.setattr(client_mod, "_get",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError("down")))
+    t = threading.Thread(target=conn._watch_loop, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive() and len(delays) >= 3
+
+
+def test_reflector_retries_through_backoff(monkeypatch):
+    cache = SchedulerCache(async_io=False)
+    conn = K8sApiConnector(cache, "http://unused.invalid")
+    r = conn._by_kind["pod"]
+    delays = []
+
+    class Recorder:
+        def next(self):
+            delays.append(1)
+            if len(delays) >= 3:
+                conn._stop.set()
+            return 0.0
+
+        def reset(self):
+            pass
+
+    r.backoff = Recorder()
+    monkeypatch.setattr(reflector_mod, "_get",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError("down")))
+    t = threading.Thread(target=r.run, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive() and len(delays) >= 3
+
+
+# -- envflag coverage ---------------------------------------------------------
+
+
+def test_wire_flag_registered_in_engine_cache_key():
+    """SCHEDULER_TPU_WIRE is in engine_cache._ENV_KEYS: schedlint's
+    env-drift pass anchors on that registry, and a resident engine never
+    straddles a protocol flip."""
+    from scheduler_tpu.ops.engine_cache import _ENV_KEYS
+
+    assert "SCHEDULER_TPU_WIRE" in _ENV_KEYS
+
+
+def test_wire_from_env(monkeypatch):
+    monkeypatch.delenv("SCHEDULER_TPU_WIRE", raising=False)
+    assert client_mod.wire_from_env() == "journal"
+    monkeypatch.setenv("SCHEDULER_TPU_WIRE", "k8s")
+    assert client_mod.wire_from_env() == "k8s"
+    # Malformed values degrade to the default (envflags choices), not raise.
+    monkeypatch.setenv("SCHEDULER_TPU_WIRE", "carrier-pigeon")
+    assert client_mod.wire_from_env() == "journal"
+
+
+def test_connect_cache_env_selects_the_reflector(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_WIRE", "k8s")
+    cache, conn = client_mod.connect_cache("http://127.0.0.1:1", async_io=False)
+    try:
+        assert isinstance(conn, K8sApiConnector)
+        assert [r.kind for r in conn.reflectors] == \
+            [kind for kind, _, _ in LIST_RESOURCES]
+    finally:
+        conn.stop()

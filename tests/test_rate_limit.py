@@ -160,6 +160,72 @@ def test_outbound_rpcs_consult_the_limiter(monkeypatch):
     assert limiter.calls == 2 and len(sent) == 2
 
 
+def test_journal_list_and_relist_pay_the_bucket_watch_does_not(monkeypatch):
+    """Inbound budget routing (docs/INGEST.md): the initial LIST and every
+    relist are full-inventory bursts and pay the shared bucket; the watch
+    long-poll is a single sequential poller and deliberately does not."""
+    from scheduler_tpu.cache.cache import SchedulerCache
+
+    polls = []
+
+    def fake_get(base, path, timeout=30.0):
+        if path.startswith("/watch"):
+            polls.append(path)
+            if len(polls) >= 3:
+                conn._stop.set()
+            return {"events": []}
+        return {"seq": 0}
+
+    monkeypatch.setattr(client_mod, "_get", fake_get)
+    limiter = _CountingLimiter()
+    conn = client_mod.ApiConnector(
+        SchedulerCache(async_io=False), "http://x", limiter=limiter)
+    conn.list_and_seed()
+    assert limiter.calls == 1
+    conn.list_and_seed()  # relist (synced): also paced
+    assert limiter.calls == 2
+    conn._watch_loop()  # already synced: three watch polls, zero acquires
+    assert len(polls) >= 3 and limiter.calls == 2
+
+
+def test_reflector_list_and_relist_pay_the_bucket_watch_does_not(monkeypatch):
+    from scheduler_tpu.cache.cache import SchedulerCache
+    from scheduler_tpu.connector import reflector as reflector_mod
+    from scheduler_tpu.connector.reflector import K8sApiConnector
+
+    monkeypatch.setattr(
+        reflector_mod, "_get",
+        lambda base, path, timeout=30.0: {
+            "items": [], "metadata": {"resourceVersion": "4"}},
+    )
+    limiter = _CountingLimiter()
+    conn = K8sApiConnector(
+        SchedulerCache(async_io=False), "http://x", limiter=limiter)
+    r = conn._by_kind["queue"]
+    r.list_and_replace()
+    r.list_and_replace()
+    assert limiter.calls == 2 and r.relists == 1
+
+    class FakeStream:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def __iter__(self):
+            return iter([
+                b'{"type": "BOOKMARK", "object":'
+                b' {"metadata": {"resourceVersion": "7"}}}\n',
+            ])
+
+    monkeypatch.setattr(reflector_mod.urllib.request, "urlopen",
+                        lambda url, timeout=None: FakeStream())
+    r.watch_once()
+    assert r.rv == 7          # the stream flowed...
+    assert limiter.calls == 2  # ...outside the budget
+
+
 def test_connect_cache_threads_one_shared_limiter(monkeypatch):
     monkeypatch.setenv("SCHEDULER_TPU_QPS", "7")
     cache, connector = client_mod.connect_cache(

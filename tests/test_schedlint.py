@@ -124,6 +124,30 @@ def test_raw_env_and_drift_catch_os_getenv():
     assert len(out) == 1 and out[0].rule == "env-drift"
 
 
+def test_raw_env_covers_the_inbound_wire_flag():
+    """SCHEDULER_TPU_WIRE (inbound protocol selection, docs/INGEST.md) is an
+    ordinary prefixed flag: a raw os.environ read anywhere — the connector
+    included — trips raw-env, while the envflags read the real tree uses
+    (connector/client.py wire_from_env) stays clean."""
+    out = findings("raw-env", py={
+        "scheduler_tpu/connector/client.py": """
+            import os
+            def wire_from_env():
+                return os.environ.get("SCHEDULER_TPU_WIRE", "journal")
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_WIRE" in out[0].message
+    out = findings("raw-env", py={
+        "scheduler_tpu/connector/client.py": """
+            from scheduler_tpu.utils.envflags import env_str
+            def wire_from_env():
+                return env_str("SCHEDULER_TPU_WIRE", "journal",
+                               choices=("journal", "k8s"))
+        """,
+    })
+    assert out == []
+
+
 def test_raw_env_allows_writes_and_envflags_reads():
     out = findings("raw-env", py={
         "scheduler_tpu/cli.py": """

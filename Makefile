@@ -1,7 +1,7 @@
 # Build/test entry points (reference: Makefile + hack/make-rules).
 PY ?= python
 
-.PHONY: all native test test-fast bench bench-smoke bench-gate lint verify wheel clean
+.PHONY: all native test test-fast bench bench-smoke bench-xl bench-flagship bench-gate lint verify wheel clean
 
 all: native
 
@@ -22,8 +22,22 @@ bench:
 bench-smoke:
 	$(PY) bench.py --smoke
 
-# Perf regression gate: newest BENCH_r*.json vs the previous round,
-# healthy-regime cycles only; exits non-zero past a >10% pods/s drop.
+# Multi-host XL flagship shape (1M pods / 100k nodes; env-scalable for CPU
+# containers) with mesh topology metadata on the record.
+bench-xl:
+	$(PY) bench.py --xl
+
+# ONE run that emits every standing TPU-round artifact debt — BENCH_r*.json,
+# the owed BENCH_MQ_r*.json (SCHEDULER_TPU_BENCH_QUEUES=2) and
+# BENCH_XL_r*.json — under a shared round number, then gates the result.
+# Hardware rounds run exactly this, so the MQ artifact can't be forgotten
+# again (ROADMAP "TPU-round debts").
+bench-flagship:
+	$(PY) scripts/bench_flagship.py
+
+# Perf regression gate: newest artifact of each family (BENCH / BENCH_MQ /
+# BENCH_XL) vs its previous round, healthy-regime cycles only; exits
+# non-zero past a >10% pods/s drop or a malformed/topology-less XL artifact.
 bench-gate:
 	$(PY) scripts/bench_gate.py
 
@@ -44,6 +58,7 @@ wheel:
 lint:
 	$(PY) scripts/schedlint.py
 	$(PY) scripts/shard_budget.py
+	$(PY) scripts/shard_budget.py --mesh 2x4
 
 # Lint gate (reference `make verify`: gofmt/golint/compile slots): byte-compile
 # everything, schedlint + the AST hygiene lint, then the wheel build +

@@ -3,8 +3,18 @@
 Scenario = BASELINE.json config #3 (binpack + drf, mixed CPU/mem requests,
 gang PodGroups) at a scale set by env:
 
-  SCHEDULER_TPU_BENCH_NODES  (default 10000)
-  SCHEDULER_TPU_BENCH_PODS   (default 100000)
+  SCHEDULER_TPU_BENCH_NODES  (default 10000; 100000 under --xl)
+  SCHEDULER_TPU_BENCH_PODS   (default 100000; 1000000 under --xl)
+
+``--xl`` runs the multi-host flagship shape — 1M pods onto 100k nodes, the
+``BENCH_XL_r*.json`` artifact family (ROADMAP "Multi-host GSPMD flagship").
+The env overrides still apply, so CPU containers run a scaled shape; what
+makes an artifact XL is the family, the recorded mesh TOPOLOGY
+(``detail.mesh``: spec/devices/processes/axis sizes) and the gate that
+refuses to compare across topologies (``scripts/bench_gate.py``).  An XL
+run that cannot produce complete mesh metadata REFUSES to emit an artifact
+— the round-4 "different backend, not comparable" failure mode,
+machine-caught at emission rather than at review.
 
 Prints ONE JSON line: pods scheduled per second of session-cycle wall time,
 with vs_baseline = value / 100_000 (the north-star target of one 100k-pod
@@ -117,8 +127,11 @@ def main() -> None:
     from scheduler_tpu.utils import sanitize
 
     smoke = "--smoke" in sys.argv
-    n_nodes = env_int("SCHEDULER_TPU_BENCH_NODES", 100 if smoke else 10_000, minimum=1)
-    n_pods = env_int("SCHEDULER_TPU_BENCH_PODS", 500 if smoke else 100_000, minimum=1)
+    xl = "--xl" in sys.argv
+    default_nodes = 100 if smoke else (100_000 if xl else 10_000)
+    default_pods = 500 if smoke else (1_000_000 if xl else 100_000)
+    n_nodes = env_int("SCHEDULER_TPU_BENCH_NODES", default_nodes, minimum=1)
+    n_pods = env_int("SCHEDULER_TPU_BENCH_PODS", default_pods, minimum=1)
     tasks_per_job = env_int("SCHEDULER_TPU_BENCH_GANG", 100, minimum=1)
     n_queues = env_int("SCHEDULER_TPU_BENCH_QUEUES", 1, minimum=1)
     # SCHEDULER_TPU_SANITIZE=1: debug-NaN checking process-wide plus a
@@ -139,6 +152,28 @@ def main() -> None:
     from scheduler_tpu.utils import shardcheck
 
     shardcheck.reset()
+
+    # Mesh topology on the record BEFORE any cycle runs: every artifact
+    # carries it, and an XL run whose REQUESTED mesh silently degraded to
+    # single-chip (malformed spec, too few devices, partial pod) is
+    # REFUSED — XL rounds exist to compare topologies, and an artifact
+    # claiming "spec 4x8" while actually running one chip is exactly the
+    # round-4 "different backend, not comparable" noise, caught at
+    # emission instead of at review.
+    from scheduler_tpu.ops.mesh import mesh_requested, mesh_topology
+
+    mesh_meta = mesh_topology()
+    if xl and mesh_requested(mesh_meta["spec"]) and not mesh_meta["axes"]:
+        print(json.dumps({
+            "metric": "pods_per_sec", "value": 0.0, "unit": "pods/s",
+            "vs_baseline": 0.0,
+            "error": (
+                f"--xl refused: mesh {mesh_meta['spec']!r} was requested "
+                "but degraded to single-chip (see the warning above); an "
+                "XL artifact must run the topology it claims"
+            ),
+        }))
+        sys.exit(1)
 
     # Warmup at the REAL shapes: the steady-state scheduler loop compiles once
     # per (node-bucket, task-bucket) pair and re-runs every period, so the
@@ -186,6 +221,12 @@ def main() -> None:
             "queues": n_queues,
             "pods": n_pods,
             "binds": binds,
+            # Scenario family + mesh topology: which program SHAPE produced
+            # these numbers.  bench_gate refuses to judge XL rounds whose
+            # topologies differ (not comparable) or whose metadata is
+            # missing (not an XL artifact at all).
+            "family": "XL" if xl else "flagship",
+            "mesh": mesh_meta,
             "cycle_seconds": round(elapsed, 3),
             "regime": regime,
             "sanitize": sanitized,

@@ -61,12 +61,15 @@ _READBACK_FNS = ("readback", "_readback")
 _SHARD_META = (
     "SHARD_AXES", "SHARDING", "SHARD_SITES", "COLLECTIVE_BUDGET",
     "SHARDED_HOST_BINDINGS", "FUSED_ARG_FAMILIES", "SHARD_DOC",
-    "SHARD_DOC_ROWS",
+    "SHARD_DOC_ROWS", "SHARD_FAMILY_2D",
 )
 
-# A spec is a tuple of axis values / None; "*<family>" marks the variadic
-# declared form and VARIADIC the extracted `tuple(P() for _ in ...)` form.
-Spec = Tuple[Optional[str], ...]
+# A spec is a tuple of entries, each an axis value, None, or a TUPLE of axis
+# values (one dimension split over multiple mesh axes — the 2-D multi-host
+# families); "*<family>" marks the variadic declared form and VARIADIC the
+# extracted `tuple(P() for _ in ...)` form.
+SpecEntry = Union[Optional[str], Tuple[str, ...]]
+Spec = Tuple[SpecEntry, ...]
 VARIADIC = "*"
 
 
@@ -88,6 +91,7 @@ class ShardRegistry:
     budgets: Dict[str, Dict[str, int]] = field(default_factory=dict)
     host_bindings: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
     fused_families: Tuple[str, ...] = ()
+    family_2d: Dict[str, str] = field(default_factory=dict)
     doc_path: str = ""
     doc_rows: Dict[str, str] = field(default_factory=dict)
 
@@ -116,7 +120,9 @@ def parse_shard_registry(text: str, path: str = LAYOUT_SUFFIX) -> ShardRegistry:
     reg = ShardRegistry(path=path)
     reg.axes = dict(meta.get("SHARD_AXES", {}) or {})
     reg.families = {
-        name: tuple(spec)
+        name: tuple(
+            tuple(e) if isinstance(e, (list, tuple)) else e for e in spec
+        )
         for name, spec in (meta.get("SHARDING", {}) or {}).items()
     }
     reg.sites = {
@@ -135,6 +141,7 @@ def parse_shard_registry(text: str, path: str = LAYOUT_SUFFIX) -> ShardRegistry:
         for mod, names in (meta.get("SHARDED_HOST_BINDINGS", {}) or {}).items()
     }
     reg.fused_families = tuple(meta.get("FUSED_ARG_FAMILIES", ()) or ())
+    reg.family_2d = dict(meta.get("SHARD_FAMILY_2D", {}) or {})
     reg.doc_path = str(meta.get("SHARD_DOC", "") or "")
     reg.doc_rows = dict(meta.get("SHARD_DOC_ROWS", {}) or {})
     return reg
@@ -194,8 +201,10 @@ def _check_registry(reg: ShardRegistry) -> List[Finding]:
     axis_values = set(reg.axes.values())
     for name, spec in reg.families.items():
         for a in spec:
-            if a is not None and a not in axis_values:
-                bad(f"SHARDING family {name} uses undeclared axis {a!r}")
+            members = a if isinstance(a, tuple) else (a,)
+            for m in members:
+                if m is not None and m not in axis_values:
+                    bad(f"SHARDING family {name} uses undeclared axis {m!r}")
 
     def known(fam: str) -> bool:
         return fam.lstrip(VARIADIC) in reg.families
@@ -227,6 +236,21 @@ def _check_registry(reg: ShardRegistry) -> List[Finding]:
     for fam in reg.fused_families:
         if fam not in reg.families:
             bad(f"FUSED_ARG_FAMILIES names unknown family {fam!r}")
+    for fam, twin in reg.family_2d.items():
+        if fam not in reg.families:
+            bad(f"SHARD_FAMILY_2D keys unknown family {fam!r}")
+        if twin not in reg.families:
+            bad(f"SHARD_FAMILY_2D maps {fam!r} to unknown family {twin!r}")
+    if reg.family_2d:
+        # The mesh staging (ops/mesh.py shard_fused_args) keys its sharding
+        # table by the twin map, so every stageable family MUST have a twin
+        # entry — a family added to FUSED_ARG_FAMILIES without one would
+        # pass every other check and KeyError at the first mesh dispatch.
+        for fam in reg.fused_families:
+            if fam in reg.families and fam not in reg.family_2d:
+                bad(f"FUSED_ARG_FAMILIES family {fam!r} has no "
+                    "SHARD_FAMILY_2D entry: mesh staging resolves every "
+                    "stageable family through the twin map")
     return out
 
 
@@ -304,11 +328,22 @@ def _extract_spec(
     call: ast.Call, env: _AxisEnv
 ) -> Union[Spec, None, str]:
     """Spec tuple of one P(...) call; None = dynamic (``P(*spec)`` built
-    from the registry — skipped); "?" = contains an unresolvable name."""
+    from the registry — skipped); "?" = contains an unresolvable name.  A
+    tuple argument — ``P((REPLICA_AXIS, NODE_AXIS))``, one dimension split
+    over several mesh axes (the 2-D families) — extracts to a tuple entry."""
     if any(isinstance(a, ast.Starred) for a in call.args) or call.keywords:
         return None
-    spec: List[Optional[str]] = []
+    spec: List[SpecEntry] = []
     for a in call.args:
+        if isinstance(a, (ast.Tuple, ast.List)):
+            members = []
+            for el in a.elts:
+                v = env.resolve(el)
+                if v == "?" or v is None:
+                    return "?"
+                members.append(v)
+            spec.append(tuple(members))
+            continue
         v = env.resolve(a)
         if v == "?":
             return "?"

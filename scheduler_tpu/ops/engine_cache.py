@@ -129,6 +129,14 @@ def shape_key(ssn) -> Optional[tuple]:
         plugin_sig = ssn.plugin_config_signature()
     except Exception:
         return None
+    # Mesh TOPOLOGY, not just the SCHEDULER_TPU_MESH string (which is
+    # already in _ENV_KEYS): the same spec — "auto", or one RxC string on a
+    # restarted pod — can resolve to different device/process counts, and a
+    # resident engine's sharded buffers are placed for ONE topology.  Keying
+    # the resolved (devices, processes, axis sizes) tuple means residents
+    # can never alias across topologies (docs/SHARDING.md "Multi-host").
+    from scheduler_tpu.ops.mesh import topology_key
+
     return (
         scope,
         len(ssn.nodes),
@@ -136,6 +144,7 @@ def shape_key(ssn) -> Optional[tuple]:
         vocab.size,
         plugin_sig,
         tuple((k, os.environ.get(k)) for k in _ENV_KEYS),
+        topology_key(),
     )
 
 

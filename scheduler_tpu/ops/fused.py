@@ -332,6 +332,12 @@ def fused_allocate(
             from jax.sharding import PartitionSpec as _P
 
             from scheduler_tpu.ops.sharded import NODE_AXIS as _NAXIS
+            from scheduler_tpu.ops.sharded import REPLICA_AXIS as _RAXIS
+            from scheduler_tpu.ops.sharded import (
+                is_multi_host as _is_multi_host,
+                node_shard_axes as _node_shard_axes,
+                shard_linear_index as _shard_linear_index,
+            )
             from scheduler_tpu.ops.sharded import shard_map as _shard_map
             from scheduler_tpu.ops.sharded import (
                 two_level_winner_with_queue as _winner_capq,
@@ -355,7 +361,9 @@ def fused_allocate(
                 # sentinel path) comes with a losing score, and downstream
                 # any_feasible masks the all-infeasible case regardless.
                 lbest = jnp.minimum(lbest, n_local - 1)
-                shard_i = jax.lax.axis_index(_NAXIS)
+                # Replica-major linear shard index: identical offset rule on
+                # the 1-D and 2-D (multi-process) mesh shapes.
+                shard_i = _shard_linear_index(mesh)
                 # The winner row CARRIES the winning shard's capacity count,
                 # pod room AND the selected job's queue id: every value the
                 # post-reduce bookkeeping (batch sizing, share delta)
@@ -365,24 +373,50 @@ def fused_allocate(
                 score, gbest, cap, pods, qid = _winner_capq(
                     lscore, lbest + shard_i * n_local,
                     lcap.astype(jnp.float32), lpods.astype(jnp.float32),
-                    qid_f,
+                    qid_f, axis=_node_shard_axes(mesh),
                 )
                 return gbest, score, cap, pods, qid
 
-            def step_select(ns_g, alloc_g, sm_g, ss_g, gate_g, plim_g,
-                            initq_c, req_c, mins_l, qid_f):
-                return _shard_map(
-                    _local_select,
-                    mesh=mesh,
-                    in_specs=(
-                        _P(None, _NAXIS), _P(None, _NAXIS), _P(None, _NAXIS),
-                        _P(None, _NAXIS), _P(None, _NAXIS), _P(None, _NAXIS),
-                        _P(), _P(), _P(), _P(),
-                    ),
-                    out_specs=(_P(), _P(), _P(), _P(), _P()),
-                    check_vma=False,
-                )(ns_g, alloc_g, sm_g, ss_g, gate_g, plim_g,
-                  initq_c, req_c, mins_l, qid_f)
+            # 1-D/2-D literal shard_map twins (the sharding pass extracts and
+            # checks each against its own SHARD_SITES entry; a computed spec
+            # would be invisible to the static gate — ops/sharded.py rule).
+            if _is_multi_host(mesh):
+                def step_select_2d(ns_g, alloc_g, sm_g, ss_g, gate_g, plim_g,
+                                   initq_c, req_c, mins_l, qid_f):
+                    return _shard_map(
+                        _local_select,
+                        mesh=mesh,
+                        in_specs=(
+                            _P(None, (_RAXIS, _NAXIS)),
+                            _P(None, (_RAXIS, _NAXIS)),
+                            _P(None, (_RAXIS, _NAXIS)),
+                            _P(None, (_RAXIS, _NAXIS)),
+                            _P(None, (_RAXIS, _NAXIS)),
+                            _P(None, (_RAXIS, _NAXIS)),
+                            _P(), _P(), _P(), _P(),
+                        ),
+                        out_specs=(_P(), _P(), _P(), _P(), _P()),
+                        check_vma=False,
+                    )(ns_g, alloc_g, sm_g, ss_g, gate_g, plim_g,
+                      initq_c, req_c, mins_l, qid_f)
+
+                step_select = step_select_2d
+            else:
+                def step_select(ns_g, alloc_g, sm_g, ss_g, gate_g, plim_g,
+                                initq_c, req_c, mins_l, qid_f):
+                    return _shard_map(
+                        _local_select,
+                        mesh=mesh,
+                        in_specs=(
+                            _P(None, _NAXIS), _P(None, _NAXIS),
+                            _P(None, _NAXIS), _P(None, _NAXIS),
+                            _P(None, _NAXIS), _P(None, _NAXIS),
+                            _P(), _P(), _P(), _P(),
+                        ),
+                        out_specs=(_P(), _P(), _P(), _P(), _P()),
+                        check_vma=False,
+                    )(ns_g, alloc_g, sm_g, ss_g, gate_g, plim_g,
+                      initq_c, req_c, mins_l, qid_f)
     job_task_num_f = job_task_num.astype(jnp.float32)
     job_gang_order_f = job_gang_order.astype(jnp.float32)
     job_deficit_f = job_deficit.astype(jnp.float32)
@@ -1796,7 +1830,15 @@ class FusedAllocator:
         recomputing costs microseconds and turns any drifted assumption into
         a rebuild instead of a wrong placement."""
         if self._mesh is not None:
-            return False  # sharded-args refresh not implemented: rebuild
+            # Mesh engines delta-refresh too (the multi-host steady state is
+            # where the pinned carries pay: out-shardings == in-shardings, so
+            # an unchanged resident dispatches with ZERO resharding).  The
+            # topology itself is pinned by the cache key (topology_key); this
+            # identity re-check covers direct update() callers only.
+            from scheduler_tpu.ops.mesh import get_mesh
+
+            if get_mesh() is not self._mesh:
+                return False
         if self.weights != score_weights(ssn):
             return False
         comparators = tuple(
@@ -1908,7 +1950,15 @@ class FusedAllocator:
         dev = self._dyn_dev[name]
         diff = new_host != old_host
         rows = np.nonzero(diff.any(axis=1) if new_host.ndim == 2 else diff)[0]
-        if self._dyn_owned[name] and rows.shape[0] * 4 <= new_host.shape[0]:
+        # Mesh engines re-upload changed tensors wholesale, placed DIRECTLY
+        # at the resident buffer's sharding (one transfer, no device-0
+        # bounce): the donated scatter jit carries no sharding annotations,
+        # and a GSPMD-inferred placement for its output is exactly the
+        # silent-reshard class the registry bans.  The traced program's
+        # in-shardings therefore never move; unchanged tensors (the steady
+        # state) skip all of this.
+        if (self._mesh is None and self._dyn_owned[name]
+                and rows.shape[0] * 4 <= new_host.shape[0]):
             # Pad the scatter to a power-of-two row count (repeating the last
             # row: a duplicate .set of the same value is a no-op) so the jit
             # compile cache keys stay stable across churn-size drift.
@@ -1920,11 +1970,30 @@ class FusedAllocator:
             scatter = _scatter_rows_donated if _donation_ok() else _scatter_rows
             dev = scatter(dev, jnp.asarray(idx), jnp.asarray(vals))
         else:
-            dev = jax.device_put(new_host)
+            dev = jax.device_put(new_host, self._dyn_sharding(name))
         self._dyn_owned[name] = True
         self._dyn_dev[name] = dev
         self._host_dyn[name] = new_host
         return True
+
+    def _dyn_sharding_rep(self):
+        """Replicated placement on this engine's mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        return NamedSharding(self._mesh, _P())
+
+    def _dyn_sharding(self, name: str):
+        """Target placement for a refreshed dynamic node tensor: the
+        resident XLA argument's own sharding when the eager args exist
+        (node-major / its 2-D twin / degraded replication — whatever the
+        staging chose), replication for mega/lazy-args mesh engines, and
+        None (default single-device placement) off the mesh."""
+        if self._mesh is None:
+            return None
+        if self._args is not None:
+            idx = {"idle": 0, "releasing": 1, "task_count": 2}[name]
+            return self._args[idx].sharding
+        return self._dyn_sharding_rep()
 
     def _rewire_args(self, queue_changed: bool) -> None:
         """Swap the refreshed dynamic buffers into whichever argument tuples
@@ -1938,10 +2007,30 @@ class FusedAllocator:
         qd, qa = self._host_queue_fair
         if self._args is not None:
             a = list(self._args)
-            a[0], a[1], a[2] = idle, rel, tc
+            if self._mesh is not None:
+                # Pre-partition the refreshed tensors at the RESIDENT
+                # argument's sharding (whatever shard_fused_args staged —
+                # node-major, its 2-D twin, or degraded replication), so the
+                # traced program's in-shardings never move and the donated
+                # loop carries keep out == in (docs/SHARDING.md).
+                a[0] = jax.device_put(idle, a[0].sharding)
+                a[1] = jax.device_put(rel, a[1].sharding)
+                a[2] = jax.device_put(tc, a[2].sharding)
+            else:
+                a[0], a[1], a[2] = idle, rel, tc
             if queue_changed:
-                a[21] = to_device(qd, np.float32)
-                a[22] = to_device(qa, np.float32)
+                if self._mesh is not None:
+                    # Queue-fair rows were staged REPLICATED on the mesh;
+                    # their refresh must keep that placement or the traced
+                    # program's in-shardings move (recompile + GSPMD
+                    # broadcast per queue-change cycle).
+                    a[21] = to_device(qd, np.float32,
+                                      sharding=self._dyn_sharding_rep())
+                    a[22] = to_device(qa, np.float32,
+                                      sharding=self._dyn_sharding_rep())
+                else:
+                    a[21] = to_device(qd, np.float32)
+                    a[22] = to_device(qa, np.float32)
             self._args = tuple(a)
         elif self._args_parts is not None:
             from scheduler_tpu.ops.placement import NodeState
@@ -1966,6 +2055,13 @@ class FusedAllocator:
             ns0, rel_t = _mk.build_node_ledgers(
                 idle, tc, rel, self.n_bucket, r, self.has_releasing
             )
+            if self._mesh is not None:
+                # Mega operands run REPLICATED on a mesh (the whole-loop
+                # kernel's deliberate distribution choice) — same placement
+                # rule as the cold build's _prepare_mega staging.
+                rep = self._dyn_sharding_rep()
+                ns0 = jax.device_put(ns0, rep)
+                rel_t = jax.device_put(rel_t, rep)
             m = list(self._mega_args)
             m[0] = ns0
             m[2] = rel_t
@@ -1975,8 +2071,12 @@ class FusedAllocator:
                 jq_des[:r, :jb] = np.asarray(qd, dtype=np.float32)[jq].T
                 jq_alloc0 = np.zeros((8, j_pad), dtype=np.float32)
                 jq_alloc0[:r, :jb] = np.asarray(qa, dtype=np.float32)[jq].T
-                m[21] = to_device(jq_des)
-                m[22] = to_device(jq_alloc0)
+                if self._mesh is not None:
+                    m[21] = to_device(jq_des, sharding=rep)
+                    m[22] = to_device(jq_alloc0, sharding=rep)
+                else:
+                    m[21] = to_device(jq_des)
+                    m[22] = to_device(jq_alloc0)
             self._mega_args = tuple(m)
 
     # -- capability probe ----------------------------------------------------

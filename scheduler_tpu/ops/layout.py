@@ -245,15 +245,32 @@ class STEP_NODE:
 # ``.sharding`` at dispatch/readback).  Everything literal, same contract as
 # the row registry.
 
-# The one mesh axis: ops code references it as ``sharded.NODE_AXIS``; the
-# sharding pass checks the module-level assignment still carries this value.
-SHARD_AXES = {"NODE_AXIS": "nodes"}
+# The mesh axes: ops code references them as ``sharded.NODE_AXIS`` /
+# ``sharded.REPLICA_AXIS``; the sharding pass checks the module-level
+# assignments still carry these values.  ``replica`` is the process/pod axis
+# of the 2-D multi-host mesh (``SCHEDULER_TPU_MESH=RxC``).
+SHARD_AXES = {"NODE_AXIS": "nodes", "REPLICA_AXIS": "replica"}
 
-# Buffer families -> PartitionSpec argument tuple (None = replicated axis).
+# Buffer families -> PartitionSpec argument tuple (None = replicated axis;
+# a TUPLE entry splits that dimension over the combined mesh axes, replica-
+# major — the 2-D multi-host twins of the 1-D node families).
 SHARDING = {
     "node_major": ("nodes",),
     "node_trailing": (None, "nodes"),
+    "node_major_2d": (("replica", "nodes"),),
+    "node_trailing_2d": (None, ("replica", "nodes")),
     "replicated": (),
+}
+
+# 1-D family -> its 2-D-mesh twin.  The ONE mapping the mesh staging
+# (``ops/mesh.py`` shard_fused_args) and the runtime shardcheck
+# (``utils/shardcheck.py``) both apply when the mesh is multi-host, so a
+# buffer placed by one is always accepted by the other.  ``replicated`` is
+# its own twin: replication means replication on every mesh shape.
+SHARD_FAMILY_2D = {
+    "node_major": "node_major_2d",
+    "node_trailing": "node_trailing_2d",
+    "replicated": "replicated",
 }
 
 # Per-call-site shard_map signatures, keyed "module suffix::enclosing def".
@@ -262,7 +279,7 @@ SHARDING = {
 # engine-cache hit path) buffers whose out-spec MUST equal their in-spec —
 # the pjit pre-partitioning rule the multi-host GSPMD refactor relies on.
 SHARD_SITES = {
-    "ops/sharded.py::sharded_place_scan": {
+    "ops/sharded.py::_place_scan_1d": {
         "in": ("node_major", "node_major", "node_major", "node_major",
                "node_major", "replicated", "replicated", "replicated",
                "node_trailing", "node_trailing", "replicated", "replicated"),
@@ -270,13 +287,33 @@ SHARD_SITES = {
                 "replicated", "replicated", "replicated"),
         "carry": ((0, 0), (1, 1), (2, 2)),
     },
-    "ops/sharded.py::sharded_selector_mask": {
+    "ops/sharded.py::_place_scan_2d": {
+        "in": ("node_major_2d", "node_major_2d", "node_major_2d",
+               "node_major_2d", "node_major_2d", "replicated", "replicated",
+               "replicated", "node_trailing_2d", "node_trailing_2d",
+               "replicated", "replicated"),
+        "out": ("node_major_2d", "node_major_2d", "node_major_2d",
+                "replicated", "replicated", "replicated"),
+        "carry": ((0, 0), (1, 1), (2, 2)),
+    },
+    "ops/sharded.py::_selector_mask_1d": {
         "in": ("replicated", "node_major"),
         "out": ("node_trailing",),
+    },
+    "ops/sharded.py::_selector_mask_2d": {
+        "in": ("replicated", "node_major_2d"),
+        "out": ("node_trailing_2d",),
     },
     "ops/fused.py::step_select": {
         "in": ("node_trailing", "node_trailing", "node_trailing",
                "node_trailing", "node_trailing", "node_trailing",
+               "replicated", "replicated", "replicated", "replicated"),
+        "out": ("replicated", "replicated", "replicated", "replicated",
+                "replicated"),
+    },
+    "ops/fused.py::step_select_2d": {
+        "in": ("node_trailing_2d", "node_trailing_2d", "node_trailing_2d",
+               "node_trailing_2d", "node_trailing_2d", "node_trailing_2d",
                "replicated", "replicated", "replicated", "replicated"),
         "out": ("replicated", "replicated", "replicated", "replicated",
                 "replicated"),
@@ -294,13 +331,24 @@ SHARD_SITES = {
 # listed budgets to zero.  ``scripts/shard_budget.py`` enforces the sites it
 # can lower standalone; the sharding pass checks every site declares one.
 COLLECTIVE_BUDGET = {
-    "ops/sharded.py::sharded_place_scan": {
+    "ops/sharded.py::_place_scan_1d": {
         "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
     },
-    "ops/sharded.py::sharded_selector_mask": {
+    # The 2-D gather rides the merged (replica, nodes) replica groups —
+    # still ONE all-gather instruction (verified: shard_budget --mesh RxC).
+    "ops/sharded.py::_place_scan_2d": {
+        "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
+    },
+    "ops/sharded.py::_selector_mask_1d": {
+        "all-gather": 0, "all-reduce": 0, "collective-permute": 0,
+    },
+    "ops/sharded.py::_selector_mask_2d": {
         "all-gather": 0, "all-reduce": 0, "collective-permute": 0,
     },
     "ops/fused.py::step_select": {
+        "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
+    },
+    "ops/fused.py::step_select_2d": {
         "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
     },
     "ops/megakernel.py::mega_allocate": {
@@ -349,8 +397,15 @@ SHARD_DOC_ROWS = {
     "node_trailing": "[T, N] / [rows, N] node-lane matrices (static "
                      "mask/score, kernel-layout ledgers): trailing node "
                      "axis split, leading axes replicated",
+    "node_major_2d": "2-D-mesh twin of node_major: node rows split over "
+                     "the COMBINED (replica, nodes) axes, replica-major — "
+                     "every device across every process owns one "
+                     "contiguous node block",
+    "node_trailing_2d": "2-D-mesh twin of node_trailing: trailing node "
+                        "axis split over the combined (replica, nodes) "
+                        "axes, leading axes replicated",
     "replicated": "job/queue/task tables, winner tuples, scalars: "
-                  "identical on every chip",
+                  "identical on every chip (and every process)",
 }
 
 

@@ -3,20 +3,32 @@ available chips.
 
 The node axis is this framework's "big" axis (SURVEY §5: the honest analogue
 of sequence parallelism) — node tensors ([N, R] ledgers, [T, N] static
-mask/score) shard over a 1-D device mesh; job/queue/task tensors replicate.
+mask/score) shard over the device mesh; job/queue/task tensors replicate.
 XLA/GSPMD inserts the collectives (the per-step argmax over the sharded node
 axis becomes a sharded reduce + all-gather over ICI), exactly the
 scaling-book recipe: annotate shardings, let the compiler place collectives.
 
-Enable with ``--mesh auto|N`` (daemon flag) or ``SCHEDULER_TPU_MESH``; the
-default ("1") keeps today's single-chip behavior byte-for-byte.  Mesh sizes
-are clamped to the largest power of two <= available devices so the
-power-of-two node buckets always divide evenly.
+Two mesh shapes (``--mesh`` daemon flag / ``SCHEDULER_TPU_MESH``):
+
+* ``N`` or ``auto`` — a 1-D ``(nodes,)`` mesh over the first power-of-two
+  chips, the single-process case (today's exact behavior).
+* ``RxC`` (e.g. ``2x4``) — a 2-D named ``(replica, nodes)`` mesh, the
+  multi-process GSPMD shape (docs/SHARDING.md "Multi-host"): R is the
+  process/pod axis, C the per-process chip axis, and node ledgers shard
+  node-major over the COMBINED axes — ``jax.devices()`` enumerates every
+  process's devices, so the same spec spans a TPU pod with zero
+  application-code change.  Both factors must be powers of two so the
+  power-of-two node buckets always divide evenly.
+
+The default ("1") keeps single-chip behavior byte-for-byte.  Malformed or
+oversized specs degrade to single-chip with a warning (an engine-choice knob
+must never crash a scheduling cycle).
 """
 
 from __future__ import annotations
 
 import logging
+import re
 from typing import Optional, Tuple
 
 import numpy as np
@@ -26,6 +38,8 @@ logger = logging.getLogger("scheduler_tpu.ops.mesh")
 _cached_mesh = None
 _cached_key: Optional[str] = None
 
+_MESH_2D_RE = re.compile(r"^(\d+)x(\d+)$")
+
 
 def mesh_spec() -> str:
     from scheduler_tpu.utils.envflags import env_str
@@ -33,10 +47,44 @@ def mesh_spec() -> str:
     return env_str("SCHEDULER_TPU_MESH", "1")
 
 
+# Spec values that mean "no mesh" — shared with mesh_requested().
+_OFF_SPECS = ("", "1", "none", "off", "0")
+
+
+def mesh_requested(spec: Optional[str] = None) -> bool:
+    """True when the spec ASKS for a mesh (even one that later degrades).
+    The XL bench uses this to refuse emitting an artifact whose requested
+    topology silently fell back to single-chip."""
+    if spec is None:
+        spec = mesh_spec()
+    return spec.strip().lower() not in _OFF_SPECS
+
+
+def parse_2d_spec(spec: str) -> Optional[Tuple[int, int]]:
+    """``(R, C)`` for a VALID 2-D mesh spec — both factors powers of two,
+    product > 1 — else None.  The ONE parser shared by ``get_mesh`` and
+    ``scripts/shard_budget.py --mesh``, so the budget gate can never
+    certify a shape production would refuse to build."""
+    m = _MESH_2D_RE.match(spec.strip().lower())
+    if not m:
+        return None
+    r, c = int(m.group(1)), int(m.group(2))
+    pow2 = lambda v: v >= 1 and (v & (v - 1)) == 0
+    if not (pow2(r) and pow2(c)) or r * c < 2:
+        return None
+    return r, c
+
+
+def _pow2_floor(want: int, limit: int) -> int:
+    n = 1
+    while n * 2 <= min(want, limit):
+        n *= 2
+    return n
+
+
 def get_mesh():
-    """The configured 1-D node mesh, or None for single-chip (default).
-    Malformed specs degrade to single-chip with a warning (an engine-choice
-    knob must never crash a scheduling cycle)."""
+    """The configured node mesh (1-D or 2-D), or None for single-chip (the
+    default).  Malformed specs degrade to single-chip with a warning."""
     global _cached_mesh, _cached_key
     spec = mesh_spec().strip().lower()
     if spec == _cached_key:
@@ -44,47 +92,121 @@ def get_mesh():
     import jax
     from jax.sharding import Mesh
 
-    from scheduler_tpu.ops.sharded import NODE_AXIS
+    from scheduler_tpu.ops.sharded import NODE_AXIS, REPLICA_AXIS
 
     mesh = None
-    if spec not in ("", "1", "none", "off", "0"):
+    if spec not in _OFF_SPECS:
         devices = jax.devices()
-        if spec == "auto":
-            want = len(devices)
+        if _MESH_2D_RE.match(spec):
+            # 2-D (replica, nodes): both factors must be powers of two and
+            # the product must fit the device count — a partial pod cannot
+            # host the declared process axis, so degrade loudly rather than
+            # silently re-shaping to a topology nobody asked for.
+            parsed = parse_2d_spec(spec)
+            if parsed is None:
+                logger.warning(
+                    "malformed 2-D mesh spec %r (powers-of-two factors, "
+                    "product > 1); staying single-chip", spec,
+                )
+            elif parsed[0] * parsed[1] > len(devices):
+                logger.warning(
+                    "mesh %r needs %d devices but only %d available; "
+                    "staying single-chip", spec, parsed[0] * parsed[1],
+                    len(devices),
+                )
+            else:
+                r, c = parsed
+                mesh = Mesh(
+                    np.asarray(devices[: r * c]).reshape(r, c),
+                    (REPLICA_AXIS, NODE_AXIS),
+                )
         else:
-            try:
-                want = int(spec)
-            except ValueError:
-                logger.warning("malformed mesh spec %r; staying single-chip", spec)
-                want = 1
-        n = 1
-        while n * 2 <= min(want, len(devices)):
-            n *= 2
-        if n > 1:
-            mesh = Mesh(np.asarray(devices[:n]), (NODE_AXIS,))
-        elif want > 1:
-            logger.warning(
-                "mesh %r requested but only %d device(s); staying single-chip",
-                spec, len(devices),
-            )
+            if spec == "auto":
+                want = len(devices)
+            else:
+                try:
+                    want = int(spec)
+                except ValueError:
+                    logger.warning(
+                        "malformed mesh spec %r; staying single-chip", spec
+                    )
+                    want = 1
+            n = _pow2_floor(want, len(devices))
+            if n > 1:
+                mesh = Mesh(np.asarray(devices[:n]), (NODE_AXIS,))
+            elif want > 1:
+                logger.warning(
+                    "mesh %r requested but only %d device(s); staying "
+                    "single-chip", spec, len(devices),
+                )
     _cached_mesh, _cached_key = mesh, spec
     return mesh
 
 
+def mesh_topology(mesh=None) -> dict:
+    """Topology metadata of the ACTIVE mesh regime — the record a bench
+    artifact must carry so two rounds are comparable (the round-4 "different
+    backend, not comparable" failure mode, machine-checked by
+    ``scripts/bench_gate.py`` for the ``BENCH_XL`` family) and the identity
+    the engine cache keys residents on.  ``mesh=None`` reads the configured
+    mesh; single-chip regimes report ``devices=1`` with an empty axes map."""
+    import jax
+
+    if mesh is None:
+        mesh = get_mesh()
+    axes = (
+        {str(name): int(size) for name, size in mesh.shape.items()}
+        if mesh is not None
+        else {}
+    )
+    return {
+        "spec": mesh_spec(),
+        "devices": int(mesh.size) if mesh is not None else 1,
+        "processes": int(jax.process_count()),
+        "axes": axes,
+    }
+
+
+def topology_key(mesh=None) -> Optional[tuple]:
+    """Hashable mesh-topology identity for the engine-cache key: device
+    count, process count, and the ordered (axis name, axis size) pairs.
+    ``None`` when no mesh is configured (single-chip).  The env spec string
+    alone cannot be the identity — ``auto`` resolves to whatever devices the
+    process sees, so the SAME string can mean different topologies across
+    restarts, and a resident engine's buffers must never alias across
+    those."""
+    if mesh is None:
+        mesh = get_mesh()
+    if mesh is None:
+        return None
+    import jax
+
+    return (
+        int(mesh.size),
+        int(jax.process_count()),
+        tuple((str(name), int(size)) for name, size in mesh.shape.items()),
+    )
+
+
 def shard_fused_args(mesh, args: Tuple) -> Tuple:
     """Place ``FusedAllocator.args`` onto the mesh: node-axis tensors shard
-    over NODE_AXIS, [T, N] static tensors shard on their node axis, and
-    everything else replicates.  The position->family row is the sharding
-    registry's ``FUSED_ARG_FAMILIES`` (ops/layout.py) — the SAME data the
-    runtime shardcheck asserts against at dispatch, so staging and check
-    can never drift.  Both mesh size and node buckets are powers of two, so
-    the axis divides whenever the bucket is at least mesh-sized; tiny
-    clusters (bucket < mesh) stay single-chip rather than crash
-    device_put."""
+    over the mesh's node shard axes, [T, N] static tensors shard on their
+    node axis, and everything else replicates.  The position->family row is
+    the sharding registry's ``FUSED_ARG_FAMILIES`` (ops/layout.py) — the
+    SAME data the runtime shardcheck asserts against at dispatch, so staging
+    and check can never drift; on the 2-D mesh each family maps through its
+    registry-declared ``SHARD_FAMILY_2D`` twin (node rows split over the
+    combined replica+nodes axes).  Both mesh size and node buckets are
+    powers of two, so the axis divides whenever the bucket is at least
+    mesh-sized; tiny clusters (bucket < mesh) stay single-chip rather than
+    crash device_put."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from scheduler_tpu.ops.layout import FUSED_ARG_FAMILIES, SHARDING
+    from scheduler_tpu.ops.layout import (
+        FUSED_ARG_FAMILIES, SHARD_FAMILY_2D, SHARDING,
+    )
+    from scheduler_tpu.ops.sharded import is_multi_host
 
     n_bucket = args[0].shape[0]
     if n_bucket % mesh.size != 0:
@@ -94,8 +216,18 @@ def shard_fused_args(mesh, args: Tuple) -> Tuple:
         )
         return args
 
+    multi_host = is_multi_host(mesh)
+
+    def family(fam: str) -> str:
+        return SHARD_FAMILY_2D[fam] if multi_host else fam
+
+    # Key by the BASE (1-D) family names FUSED_ARG_FAMILIES uses — the
+    # twin map's keys — resolving each to the mesh-appropriate spec.  The
+    # 2-D specs name the replica axis and must never be constructed
+    # against a 1-D mesh.
     by_family = {
-        fam: NamedSharding(mesh, P(*spec)) for fam, spec in SHARDING.items()
+        fam: NamedSharding(mesh, P(*SHARDING[family(fam)]))
+        for fam in SHARD_FAMILY_2D
     }
 
     def spec_for(i, a):

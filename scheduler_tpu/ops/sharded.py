@@ -20,6 +20,23 @@ runs on each chip's MXU against its own node shard.
 Written with ``shard_map`` + explicit ``all_gather`` (rather than relying on
 GSPMD to infer the collective from an argmax over a sharded axis) so the
 comm pattern is pinned: one small all-gather per scan step, riding ICI.
+
+Meshes come in two shapes (``ops/mesh.py``):
+
+* **1-D** ``(nodes,)`` — the single-process case, today's exact behavior.
+* **2-D** ``(replica, nodes)`` — the multi-process GSPMD shape
+  (``SCHEDULER_TPU_MESH=RxC``): the ``replica`` axis is the process/pod
+  axis, and node rows shard over the COMBINED ``('replica', 'nodes')``
+  axes — every device across every process owns one contiguous node
+  block, replica-major.  The candidate all-gather rides the same axis
+  tuple, which XLA compiles to ONE all-gather over merged replica groups
+  (verified by ``scripts/shard_budget.py --mesh RxC``), so the per-step
+  comm contract is identical to the 1-D mesh: one WINNER-tuple gather,
+  zero all-reduces.  Because ``jax.devices()`` enumerates all processes'
+  devices, the same code spans a TPU pod with zero application change —
+  the pjit multi-process pattern (SNIPPETS [1]/[3]) with the carries
+  pre-partitioned (out-specs == in-specs, see ``ops/layout.py``
+  ``SHARD_SITES`` carry pairs).
 """
 
 from __future__ import annotations
@@ -46,6 +63,35 @@ from scheduler_tpu.ops.predicates import fit_mask, selector_mask
 from scheduler_tpu.ops.scoring import dynamic_score
 
 NODE_AXIS = "nodes"
+REPLICA_AXIS = "replica"
+
+
+def is_multi_host(mesh: Mesh) -> bool:
+    """True for the 2-D ``(replica, nodes)`` mesh shape — the multi-process
+    GSPMD device phase; False for the single-process 1-D ``(nodes,)`` mesh."""
+    return REPLICA_AXIS in mesh.axis_names
+
+
+def node_shard_axes(mesh: Mesh):
+    """The axis tuple node rows shard (and candidates gather) over: the
+    combined ``('replica', 'nodes')`` on the 2-D mesh, ``('nodes',)`` on the
+    1-D mesh.  Shard k of a node tensor lands on the device with replica-
+    major linear index k, and ``all_gather`` over the same tuple yields
+    candidates in exactly that order — which is what keeps the two-level
+    argmax tie-break at "lowest global node index" across processes."""
+    return (REPLICA_AXIS, NODE_AXIS) if is_multi_host(mesh) else (NODE_AXIS,)
+
+
+def shard_linear_index(mesh: Mesh):
+    """Replica-major linear shard index of the executing device, inside a
+    shard_map body.  Multiplying by the local block length gives the global
+    row offset of this device's node shard on either mesh shape."""
+    if is_multi_host(mesh):
+        return (
+            jax.lax.axis_index(REPLICA_AXIS) * mesh.shape[NODE_AXIS]
+            + jax.lax.axis_index(NODE_AXIS)
+        )
+    return jax.lax.axis_index(NODE_AXIS)
 
 
 def two_level_winner(lscore, global_idx, extra=(), axis=NODE_AXIS):
@@ -55,7 +101,10 @@ def two_level_winner(lscore, global_idx, extra=(), axis=NODE_AXIS):
     float32 (exact below 2^24 nodes); ``jnp.argmax`` takes the FIRST max, so
     ties break to the lowest shard — combined with each shard's lowest-local-
     row argmax that is the lowest global index, bit-matching the single-chip
-    kernel's deterministic argmax.  Returns the winner's packed row."""
+    kernel's deterministic argmax.  ``axis`` may be one axis name or the
+    2-D mesh's ``('replica', 'nodes')`` tuple (the gather then runs over the
+    merged replica groups — still one collective).  Returns the winner's
+    packed row."""
     # Lane order is the WINNER layout (ops/layout.py): SCORE, INDEX, then
     # the per-call-site extra lanes (capacity/pod-room or the fit bits).
     cand = jnp.stack([
@@ -148,18 +197,19 @@ def sharded_place_scan(
     weights: Tuple[float, float, float],
     enforce_pod_count: bool,
 ):
-    """Same contract as ``placement._place_scan`` but node-sharded over ``mesh``.
+    """Same contract as ``placement._place_scan`` but node-sharded over ``mesh``
+    (1-D single-process or 2-D multi-process — see module docstring).
 
     Returns (idle, releasing, task_count, chosen, pipelined, failed) with the
     node tensors still sharded and the per-task outputs replicated.
     """
+    gather_axes = node_shard_axes(mesh)
 
     def shard_fn(idle, releasing, task_count, allocatable, pods_limit, mins,
                  init_resreq, resreq, static_mask, static_score, valid,
                  ready_deficit):
         n_local = idle.shape[0]
-        shard = jax.lax.axis_index(NODE_AXIS)
-        offset = shard * n_local
+        offset = shard_linear_index(mesh) * n_local
         neg_inf = jnp.float32(-jnp.inf)
 
         def step(carry, xs):
@@ -187,6 +237,7 @@ def sharded_place_scan(
                 lscore, lbest + offset,
                 extra=(fit_idle[lbest].astype(jnp.float32),
                        fit_rel[lbest].astype(jnp.float32)),
+                axis=gather_axes,
             )
             any_feasible = win[WINNER.SCORE] > neg_inf
             g_best = win[WINNER.INDEX].astype(jnp.int32)
@@ -234,6 +285,23 @@ def sharded_place_scan(
         )
         return idle, releasing, task_count, chosen, pipelined, failed
 
+    place = _place_scan_2d if is_multi_host(mesh) else _place_scan_1d
+    return place(
+        shard_fn, mesh,
+        idle, releasing, task_count, allocatable, pods_limit, mins,
+        init_resreq, resreq, static_mask, static_score, valid, ready_deficit,
+    )
+
+
+# The 1-D/2-D twins below are DISTINCT shard_map call sites on purpose: each
+# carries literal P(...) specs so schedlint's ``sharding`` pass can extract
+# and check them against ``ops/layout.py`` SHARD_SITES family-by-family —
+# one parameterized site with computed specs would be invisible to the
+# static gate.  The three node-ledger carries keep out-specs == in-specs on
+# BOTH shapes (pjit pre-partitioning: donated engine-cache carries must
+# never reshard between cycles).
+
+def _place_scan_1d(shard_fn, mesh, *operands):
     return shard_map(
         shard_fn,
         mesh=mesh,
@@ -245,25 +313,58 @@ def sharded_place_scan(
             P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(), P(), P(),
         ),
         check_vma=False,
-    )(idle, releasing, task_count, allocatable, pods_limit, mins,
-      init_resreq, resreq, static_mask, static_score, valid, ready_deficit)
+    )(*operands)
+
+
+def _place_scan_2d(shard_fn, mesh, *operands):
+    return shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P((REPLICA_AXIS, NODE_AXIS)), P((REPLICA_AXIS, NODE_AXIS)),
+            P((REPLICA_AXIS, NODE_AXIS)), P((REPLICA_AXIS, NODE_AXIS)),
+            P((REPLICA_AXIS, NODE_AXIS)), P(), P(), P(),
+            P(None, (REPLICA_AXIS, NODE_AXIS)),
+            P(None, (REPLICA_AXIS, NODE_AXIS)), P(), P(),
+        ),
+        out_specs=(
+            P((REPLICA_AXIS, NODE_AXIS)), P((REPLICA_AXIS, NODE_AXIS)),
+            P((REPLICA_AXIS, NODE_AXIS)), P(), P(), P(),
+        ),
+        check_vma=False,
+    )(*operands)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh",))
 def sharded_selector_mask(
-    task_selector: jnp.ndarray,  # bool [T, L] sharded P(tasks) if 2D mesh
-    node_labels: jnp.ndarray,    # bool [N, L] sharded P(nodes)
+    task_selector: jnp.ndarray,  # bool [T, L] replicated
+    node_labels: jnp.ndarray,    # bool [N, L] sharded node-major
     *,
     mesh: Mesh,
 ) -> jnp.ndarray:
     """Session-static label-selector mask, sharded: each chip multiplies its
     task rows against its node shard's label matrix on the MXU, producing the
-    [T, N] mask already laid out in the scan's P(None, nodes) sharding."""
+    [T, N] mask already laid out in the scan's node-trailing sharding (1-D
+    and 2-D mesh twins, same literal-site rule as the place scan)."""
+    mask = _selector_mask_2d if is_multi_host(mesh) else _selector_mask_1d
+    return mask(mesh, task_selector, node_labels)
 
+
+def _selector_mask_1d(mesh, task_selector, node_labels):
     return shard_map(
         selector_mask,
         mesh=mesh,
         in_specs=(P(), P(NODE_AXIS)),
         out_specs=P(None, NODE_AXIS),
+        check_vma=False,
+    )(task_selector, node_labels)
+
+
+def _selector_mask_2d(mesh, task_selector, node_labels):
+    return shard_map(
+        selector_mask,
+        mesh=mesh,
+        in_specs=(P(), P((REPLICA_AXIS, NODE_AXIS))),
+        out_specs=P(None, (REPLICA_AXIS, NODE_AXIS)),
         check_vma=False,
     )(task_selector, node_labels)

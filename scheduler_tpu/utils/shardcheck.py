@@ -86,22 +86,35 @@ def _partition_of(a) -> Optional[Tuple]:
     return _trim(tuple(spec))
 
 
-def _family_spec(fam: str) -> Tuple:
-    from scheduler_tpu.ops.layout import SHARDING
+def _family_spec(fam: str, mesh) -> Tuple:
+    """THE trimmed spec one declared family must carry on this mesh shape:
+    the family's registry-declared 2-D twin (``SHARD_FAMILY_2D``,
+    ops/layout.py — the SAME mapping the mesh staging applies) on a
+    multi-host mesh, the family's own spec otherwise.  Selecting by the
+    live mesh, not accepting the union, keeps the exact-match guarantee: a
+    node ledger split P('nodes') on a 2-D mesh (replicated across the
+    replica axis — a real per-dispatch reshard) is a violation, not a
+    plausible alias."""
+    from scheduler_tpu.ops.layout import SHARD_FAMILY_2D, SHARDING
 
+    if mesh is not None:
+        from scheduler_tpu.ops.sharded import is_multi_host
+
+        if is_multi_host(mesh):
+            fam = SHARD_FAMILY_2D.get(fam, fam)
     return _trim(SHARDING[fam])
 
 
-def _check_one(a, fam: str, where: str, what: str) -> None:
+def _check_one(a, fam: str, mesh, where: str, what: str) -> None:
     got = _partition_of(a)
     if got is None or got == ():
         return  # unpartitioned / replicated: consistent with every family
-    want = _family_spec(fam)
+    want = _family_spec(fam, mesh)
     if got != want:
         _record(
             where, what,
             f"sharding {got} does not match registry family '{fam}' "
-            f"{want} (ops/layout.py SHARDING)",
+            f"{want} on this mesh (ops/layout.py SHARDING)",
         )
 
 
@@ -110,9 +123,10 @@ def check_dispatch(mesh, args: Sequence, families: Optional[Sequence[str]] = Non
     """Assert the device program's inputs against the registry.  With
     ``families=None`` the positional row is ``FUSED_ARG_FAMILIES``
     (positions past it replicated); pass ``families=()`` for the
-    all-replicated mega operands.  ``mesh`` is accepted for symmetry with
-    the staging seam — the check itself reads each array's live sharding,
-    so it also covers the mesh-off regime (nothing may be partitioned)."""
+    all-replicated mega operands.  ``mesh`` selects which spec each family
+    must carry (its 2-D twin on a multi-host mesh); the check reads each
+    array's live sharding, so it also covers the mesh-off regime (nothing
+    may be partitioned)."""
     if not enabled():
         return
     if families is None:
@@ -121,7 +135,7 @@ def check_dispatch(mesh, args: Sequence, families: Optional[Sequence[str]] = Non
         families = FUSED_ARG_FAMILIES
     for i, a in enumerate(args):
         fam = families[i] if i < len(families) else "replicated"
-        _check_one(a, fam, where, f"arg[{i}]")
+        _check_one(a, fam, mesh, where, f"arg[{i}]")
 
 
 def check_result(mesh, dev, where: str = "readback") -> None:
@@ -129,4 +143,4 @@ def check_result(mesh, dev, where: str = "readback") -> None:
     must come back replicated/unpartitioned, never node-sharded."""
     if not enabled() or dev is None:
         return
-    _check_one(dev, "replicated", where, "result")
+    _check_one(dev, "replicated", mesh, where, "result")

@@ -1,0 +1,141 @@
+"""One-shot TPU-round debt emitter: every standing flagship artifact in a
+single run.
+
+A hardware round owes THREE artifacts (ROADMAP "TPU-round debts"):
+
+* ``BENCH_r{n}.json``     — the single-queue 100k-pod flagship;
+* ``BENCH_MQ_r{n}.json``  — the two-queue variant
+  (``SCHEDULER_TPU_BENCH_QUEUES=2``), owed since the PR-4 queue-delta round
+  and forgotten on every hardware round since;
+* ``BENCH_XL_r{n}.json``  — the multi-host 1M-pod/100k-node XL flagship
+  (``bench.py --xl``), with mesh topology metadata recorded.
+
+``make bench-flagship`` runs all three back-to-back with ONE shared round
+number (the next integer after every family's newest artifact, so the
+families stay aligned), writes the artifacts into the repo root, and
+finishes with the regression gate (``scripts/bench_gate.py``) so a
+regression is caught in the same sitting that produced it.  Emission is
+all-or-nothing: every run must succeed BEFORE any artifact file is
+written, so a mid-sequence failure (or an XL refusal over degraded mesh
+metadata) can never leave the round half-emitted and break the shared
+numbering for the next attempt — partial debt is still debt.
+
+Usage: python scripts/bench_flagship.py [--smoke] [--dry-run]
+  --smoke    pass bench.py --smoke (tiny shapes; plumbing verification —
+             artifacts land in a throwaway temp directory, NEVER the repo
+             root, so a smoke run can neither consume a real round number
+             nor feed smoke-scale values to the regression gate)
+  --dry-run  print the plan (round number, files, env) without running
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+# The artifact-naming contract (family infixes, round regex, sorting) has
+# ONE owner: scripts/bench_gate.py.  A new family is added there and this
+# emitter follows.
+from scripts.bench_gate import _ROUND_RE, FAMILIES, find_artifacts  # noqa: E402
+
+# (filename infix, extra bench.py argv, env overrides) per owed artifact.
+RUNS = (
+    ("", (), {}),
+    ("_MQ", (), {"SCHEDULER_TPU_BENCH_QUEUES": "2"}),
+    ("_XL", ("--xl",), {}),
+)
+
+
+def next_round(root: Path) -> int:
+    """One round number past every family's newest artifact — shared across
+    the three emissions so the families stay round-aligned."""
+    rounds = [0]
+    for _, infix in FAMILIES:
+        for p in find_artifacts(root, infix):
+            rounds.append(int(_ROUND_RE.search(p.name).group(2)))
+    return max(rounds) + 1
+
+
+def artifact_name(infix: str, rnd: int) -> str:
+    return f"BENCH{infix}_r{rnd:02d}.json"
+
+
+def run_one(root: Path, args: tuple, env_extra: dict, smoke: bool) -> str:
+    """One bench.py run; returns its artifact JSON line WITHOUT writing a
+    file (emission is deferred until every family's run has succeeded)."""
+    env = dict(os.environ, **env_extra)
+    argv = [sys.executable, str(root / "bench.py"), *args]
+    if smoke:
+        argv.append("--smoke")
+    proc = subprocess.run(
+        argv, cwd=root, env=env, capture_output=True, text=True
+    )
+    # bench.py prints ONE JSON line last; anything before it is noise from
+    # warmup logging.  Keep only the artifact line.
+    line = next(
+        (ln for ln in reversed(proc.stdout.strip().splitlines())
+         if ln.startswith("{")),
+        None,
+    )
+    if proc.returncode != 0 or line is None:
+        sys.stderr.write(proc.stdout + proc.stderr)
+        raise SystemExit(
+            f"bench-flagship: bench.py {' '.join(args) or '(base)'} failed "
+            f"(rc={proc.returncode}); NO artifacts written for this round"
+        )
+    json.loads(line)  # refuse to commit a non-JSON tail as an artifact
+    return line
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args()
+
+    rnd = next_round(ROOT)
+    plan = [
+        (artifact_name(infix, rnd), extra, env)
+        for infix, extra, env in RUNS
+    ]
+    for name, extra, env in plan:
+        print(f"bench-flagship: r{rnd:02d} -> {name} "
+              f"argv={list(extra)} env={env}")
+    if args.dry_run:
+        return 0
+    # Smoke runs are plumbing checks: tiny-shape artifacts must never sit
+    # in the repo root where they would consume a real round number and
+    # feed smoke-scale values to the gate on the next real round.
+    out_root = ROOT
+    if args.smoke:
+        import tempfile
+
+        out_root = Path(tempfile.mkdtemp(prefix="bench-flagship-smoke-"))
+        print(f"bench-flagship: --smoke artifacts -> {out_root}")
+    # Run everything first, write nothing until all three succeeded: a
+    # partial round on disk would desynchronize the families' shared
+    # numbering for every later attempt.
+    lines = [
+        (name, run_one(ROOT, extra, env, args.smoke))
+        for (_, extra, env), (name, _, _) in zip(RUNS, plan)
+    ]
+    for name, line in lines:
+        (out_root / name).write_text(line + "\n")
+        doc = json.loads(line)
+        print(f"bench-flagship: wrote {(out_root / name).name}: "
+              f"{doc.get('value')} {doc.get('unit')} "
+              f"(regime {doc.get('detail', {}).get('regime')})")
+    from scripts.bench_gate import main as gate_main
+
+    return gate_main([__file__, str(out_root)])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,14 @@ a directory given as argv[1]):
 * ``BENCH_r*.json``     — the single-queue 100k-pod flagship;
 * ``BENCH_MQ_r*.json``  — the two-queue 100k-pod flagship
   (``SCHEDULER_TPU_BENCH_QUEUES=2``, first-class since the delta-maintained
-  queue chain, docs/QUEUE_DELTA.md).
+  queue chain, docs/QUEUE_DELTA.md);
+* ``BENCH_XL_r*.json``  — the multi-host 1M-pod/100k-node flagship
+  (``bench.py --xl``, docs/SHARDING.md "Multi-host").  XL artifacts MUST
+  carry complete mesh topology metadata (``detail.mesh``: devices,
+  processes, axis sizes) — a missing topology is a malformed artifact
+  (exit 1), and two XL rounds with DIFFERENT topologies are not compared
+  at all (the round-4 "different backend, not comparable" failure mode,
+  machine-caught).
 
 Families gate independently (a regression in either fails the build); a
 family with fewer than two artifacts is simply not judged yet.  Regression
@@ -38,10 +45,13 @@ TOLERANCE = 0.10
 # less than the artifact itself trusts.
 MIN_HEALTHY = 3
 
-_ROUND_RE = re.compile(r"BENCH(_MQ)?_r(\d+)\.json$")
+_ROUND_RE = re.compile(r"BENCH(_MQ|_XL)?_r(\d+)\.json$")
 
 # (family label, filename infix) — the artifact naming contract.
-FAMILIES = (("single-queue", ""), ("two-queue", "_MQ"))
+FAMILIES = (("single-queue", ""), ("two-queue", "_MQ"), ("xl-multi-host", "_XL"))
+
+# detail.mesh keys every XL artifact must carry, with their types.
+_MESH_KEYS = (("devices", int), ("processes", int), ("axes", dict))
 
 
 def find_artifacts(root: Path, infix: str = ""):
@@ -92,14 +102,52 @@ def healthy_median_pods_per_sec(path: Path):
     return rates[len(rates) // 2]
 
 
+def mesh_identity(path: Path):
+    """The artifact's mesh topology identity (devices, processes, sorted
+    axis items), or None when ``detail.mesh`` is absent or incomplete."""
+    doc = _unwrap(json.loads(path.read_text()))
+    mesh = doc.get("detail", {}).get("mesh")
+    if not isinstance(mesh, dict):
+        return None
+    for key, typ in _MESH_KEYS:
+        if not isinstance(mesh.get(key), typ):
+            return None
+    return (
+        mesh["devices"], mesh["processes"], tuple(sorted(mesh["axes"].items()))
+    )
+
+
 def gate_family(root: Path, label: str, infix: str) -> int:
     """Gate one artifact family; same exit-code contract as main()."""
     artifacts = find_artifacts(root, infix)
+    if infix == "_XL":
+        # Topology is what XL rounds compare; an XL artifact without it is
+        # malformed no matter how many artifacts exist.
+        for p in artifacts:
+            try:
+                ident = mesh_identity(p)
+            except json.JSONDecodeError as err:
+                print(f"bench-gate[{label}]: malformed artifact {p.name}: {err}")
+                return 1
+            if ident is None:
+                print(
+                    f"bench-gate[{label}]: {p.name} is missing mesh topology "
+                    "metadata (detail.mesh devices/processes/axes) — an XL "
+                    "artifact without its topology is not comparable to "
+                    "anything; re-emit via bench.py --xl"
+                )
+                return 1
     if len(artifacts) < 2:
         print(f"bench-gate[{label}]: need two BENCH{infix}_r*.json under "
               f"{root}, found {len(artifacts)}; nothing to compare")
         return 0
     prev_path, new_path = artifacts[-2], artifacts[-1]
+    if infix == "_XL" and mesh_identity(prev_path) != mesh_identity(new_path):
+        print(
+            f"bench-gate[{label}]: {prev_path.name} and {new_path.name} ran "
+            "on different mesh topologies; not comparable (no verdict)"
+        )
+        return 0
     try:
         prev = healthy_median_pods_per_sec(prev_path)
         new = healthy_median_pods_per_sec(new_path)

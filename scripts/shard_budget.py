@@ -18,7 +18,14 @@ site exceeds its declared budget — an accidental GSPMD-inferred collective
 (e.g. an argmax over a sharded axis resharding mid-step) fails the gate
 before it ships to a real pod.
 
-Usage: python scripts/shard_budget.py [--devices N] [--verbose]
+``--mesh RxC`` (e.g. ``--mesh 2x4``) lowers the 2-D multi-host twins
+instead: a named ``(replica, nodes)`` mesh over R*C simulated devices, the
+same shape a multi-process TPU pod runs (docs/SHARDING.md "Multi-host").
+The 2-D candidate gather must still compile to exactly ONE all-gather —
+XLA merges the replica groups over both axes — so the per-step budget is
+identical; ``make lint`` runs both shapes.
+
+Usage: python scripts/shard_budget.py [--devices N] [--mesh 1d|RxC] [--verbose]
 """
 
 from __future__ import annotations
@@ -105,19 +112,45 @@ def _small_problem(n_nodes: int = 8, n_tasks: int = 4, r: int = 3) -> dict:
     )
 
 
-def _mesh(n: int):
+def _parse_mesh_arg(shape: str):
+    """``(R, C)`` for a 2-D --mesh value, None for "1d".  Validation is
+    ops/mesh.py's ``parse_2d_spec`` — the SAME rule production applies —
+    so this gate can never certify a shape ``get_mesh`` would refuse."""
+    if shape == "1d":
+        return None
+    from scheduler_tpu.ops.mesh import parse_2d_spec
+
+    parsed = parse_2d_spec(shape)
+    if parsed is None:
+        raise SystemExit(
+            f"shard_budget: malformed --mesh {shape!r} (want '1d' or 'RxC' "
+            "with powers-of-two factors, product > 1)"
+        )
+    return parsed
+
+
+def _mesh(n: int, shape: str = "1d"):
     import jax
     import numpy as np
     from jax.sharding import Mesh
 
-    from scheduler_tpu.ops.sharded import NODE_AXIS
+    from scheduler_tpu.ops.sharded import NODE_AXIS, REPLICA_AXIS
 
+    parsed = _parse_mesh_arg(shape)
+    if parsed is not None:
+        n = parsed[0] * parsed[1]
     devices = jax.devices()
     if len(devices) < n:
         raise SystemExit(
             f"shard_budget: need {n} devices, have {len(devices)} — run "
             "with XLA_FLAGS=--xla_force_host_platform_device_count="
             f"{n} (set before jax initializes)"
+        )
+    if parsed is not None:
+        r, c = parsed
+        return Mesh(
+            np.array(devices[: r * c]).reshape(r, c),
+            (REPLICA_AXIS, NODE_AXIS),
         )
     return Mesh(np.array(devices[:n]), (NODE_AXIS,))
 
@@ -152,20 +185,39 @@ def _hlo_selector_mask(mesh) -> str:
 
 # Sites this script can lower standalone (the in-engine sites —
 # fused step_select, the replicated mega call — ride the same primitives
-# and are covered by the spec pass + the sharded parity tests).
-LOWERABLE = {
-    "ops/sharded.py::sharded_place_scan": _hlo_place_scan,
-    "ops/sharded.py::sharded_selector_mask": _hlo_selector_mask,
-}
+# and are covered by the spec pass + the sharded parity tests).  The mesh
+# shape selects which twin the dispatchers route to, so the budget verdict
+# lands on the site that actually compiled.
+def lowerable_sites(mesh) -> dict:
+    from scheduler_tpu.ops.sharded import is_multi_host
+
+    if is_multi_host(mesh):
+        return {
+            "ops/sharded.py::_place_scan_2d": _hlo_place_scan,
+            "ops/sharded.py::_selector_mask_2d": _hlo_selector_mask,
+        }
+    return {
+        "ops/sharded.py::_place_scan_1d": _hlo_place_scan,
+        "ops/sharded.py::_selector_mask_1d": _hlo_selector_mask,
+    }
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--devices", type=int, default=DEFAULT_DEVICES)
+    ap.add_argument(
+        "--mesh", default="1d",
+        help="mesh shape: '1d' (default) or 'RxC' for the 2-D multi-host "
+             "twins (overrides --devices with R*C)",
+    )
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
-    force_host_devices(args.devices)
+    # Pre-jax parse (ops/mesh.py is jax-free at import time): the forced
+    # device count must be known before the backend initializes.
+    parsed = _parse_mesh_arg(args.mesh)
+    n_devices = parsed[0] * parsed[1] if parsed else args.devices
+    force_host_devices(n_devices)
 
     from scheduler_tpu.analysis.sharding import parse_shard_registry
 
@@ -174,10 +226,10 @@ def main() -> int:
         print("shard_budget: no COLLECTIVE_BUDGET declared; nothing to check")
         return 1
 
-    mesh = _mesh(args.devices)
+    mesh = _mesh(args.devices, args.mesh)
     failures = []
     checked = 0
-    for site, lower in sorted(LOWERABLE.items()):
+    for site, lower in sorted(lowerable_sites(mesh).items()):
         budget = reg.budgets.get(site)
         if budget is None:
             failures.append(f"{site}: lowerable site has no budget entry")
@@ -190,8 +242,9 @@ def main() -> int:
     for msg in failures:
         print(msg)
     print(
-        f"shard_budget: {checked} site(s) lowered on a {args.devices}-device "
-        f"simulated mesh, {len(failures)} finding(s)"
+        f"shard_budget: {checked} site(s) lowered on a "
+        f"{mesh.size}-device simulated {'x'.join(str(s) for s in mesh.devices.shape)} mesh, "
+        f"{len(failures)} finding(s)"
     )
     return 1 if failures else 0
 

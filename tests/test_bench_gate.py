@@ -1,0 +1,144 @@
+"""bench_gate family handling — in particular the BENCH_XL mesh-topology
+contract: an XL artifact without complete mesh metadata is MALFORMED, and
+two XL rounds on different topologies are never compared (the round-4
+"different backend, not comparable" failure mode, machine-caught).  Plus the
+flagship emitter's shared round numbering."""
+
+from __future__ import annotations
+
+import json
+
+from scripts.bench_flagship import artifact_name, next_round
+from scripts.bench_gate import find_artifacts, gate_family, main as gate_main
+
+
+def _artifact(value: float, mesh=None, cycles=5) -> dict:
+    binds = 10_000
+    doc = {
+        "metric": "pods_per_sec", "value": value, "unit": "pods/s",
+        "vs_baseline": value / 100_000.0,
+        "detail": {
+            "nodes": 1000, "queues": 1, "pods": 10_000, "binds": binds,
+            "regime": "healthy",
+            "cycles": [
+                {"s": binds / value, "link_degraded": False}
+                for _ in range(cycles)
+            ],
+        },
+    }
+    if mesh is not None:
+        doc["detail"]["mesh"] = mesh
+    return doc
+
+
+MESH_2X4 = {"spec": "2x4", "devices": 8, "processes": 1,
+            "axes": {"replica": 2, "nodes": 4}}
+MESH_TPU = {"spec": "4x8", "devices": 32, "processes": 4,
+            "axes": {"replica": 4, "nodes": 8}}
+
+
+def _write(root, name, doc):
+    (root / name).write_text(json.dumps(doc))
+
+
+def test_xl_family_is_recognized_and_segregated(tmp_path):
+    """BENCH_XL_r*.json must land in the XL family only — never be counted
+    as a single-queue artifact by the permissive-prefix glob."""
+    _write(tmp_path, "BENCH_r01.json", _artifact(100.0))
+    _write(tmp_path, "BENCH_XL_r01.json", _artifact(50.0, MESH_2X4))
+    assert [p.name for p in find_artifacts(tmp_path, "")] == ["BENCH_r01.json"]
+    assert [p.name for p in find_artifacts(tmp_path, "_XL")] == [
+        "BENCH_XL_r01.json"
+    ]
+
+
+def test_xl_artifact_without_mesh_metadata_is_malformed(tmp_path):
+    _write(tmp_path, "BENCH_XL_r01.json", _artifact(100.0))  # no mesh
+    assert gate_family(tmp_path, "xl", "_XL") == 1
+
+
+def test_xl_artifact_with_incomplete_mesh_metadata_is_malformed(tmp_path):
+    broken = dict(MESH_2X4)
+    del broken["processes"]
+    _write(tmp_path, "BENCH_XL_r01.json", _artifact(100.0, broken))
+    assert gate_family(tmp_path, "xl", "_XL") == 1
+
+
+def test_xl_rounds_on_different_topologies_are_not_compared(tmp_path):
+    """A 10x drop across a topology change is NOT a regression verdict —
+    the artifacts are not comparable and the gate must say so (exit 0)."""
+    _write(tmp_path, "BENCH_XL_r01.json", _artifact(1000.0, MESH_TPU))
+    _write(tmp_path, "BENCH_XL_r02.json", _artifact(100.0, MESH_2X4))
+    assert gate_family(tmp_path, "xl", "_XL") == 0
+
+
+def test_xl_regression_on_same_topology_fails(tmp_path):
+    _write(tmp_path, "BENCH_XL_r01.json", _artifact(1000.0, MESH_2X4))
+    _write(tmp_path, "BENCH_XL_r02.json", _artifact(100.0, MESH_2X4))
+    assert gate_family(tmp_path, "xl", "_XL") == 2
+
+
+def test_xl_improvement_on_same_topology_passes(tmp_path):
+    _write(tmp_path, "BENCH_XL_r01.json", _artifact(1000.0, MESH_2X4))
+    _write(tmp_path, "BENCH_XL_r02.json", _artifact(1500.0, MESH_2X4))
+    assert gate_family(tmp_path, "xl", "_XL") == 0
+
+
+def test_main_gates_all_three_families_worst_exit_wins(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _artifact(1000.0))
+    _write(tmp_path, "BENCH_r02.json", _artifact(1100.0))
+    _write(tmp_path, "BENCH_MQ_r01.json", _artifact(1000.0))
+    _write(tmp_path, "BENCH_MQ_r02.json", _artifact(100.0))  # regression
+    _write(tmp_path, "BENCH_XL_r01.json", _artifact(500.0, MESH_2X4))
+    assert gate_main(["bench_gate", str(tmp_path)]) == 2
+
+
+def test_other_families_do_not_require_mesh_metadata(tmp_path):
+    """The topology contract is XL-scoped: legacy families keep gating on
+    healthy medians alone (their artifacts predate detail.mesh)."""
+    _write(tmp_path, "BENCH_r01.json", _artifact(1000.0))
+    _write(tmp_path, "BENCH_r02.json", _artifact(990.0))
+    assert gate_family(tmp_path, "single-queue", "") == 0
+
+
+def test_flagship_round_number_is_shared_across_families(tmp_path, monkeypatch):
+    """The emitter picks ONE round number past every family's newest
+    artifact, so the three families stay round-aligned even when one was
+    forgotten in the past (the MQ debt)."""
+    _write(tmp_path, "BENCH_r05.json", _artifact(1.0))
+    _write(tmp_path, "BENCH_MQ_r02.json", _artifact(1.0))
+    assert next_round(tmp_path) == 6
+    assert artifact_name("_XL", 6) == "BENCH_XL_r06.json"
+    assert artifact_name("", 6) == "BENCH_r06.json"
+
+
+def test_flagship_round_starts_at_one_on_empty_root(tmp_path):
+    assert next_round(tmp_path) == 1
+
+
+def test_bench_xl_refuses_when_requested_mesh_degrades():
+    """bench.py --xl with a mesh spec that silently degrades to
+    single-chip (here: 1024x1024 on 8 virtual devices) must exit non-zero
+    WITHOUT emitting an artifact line — an XL artifact claiming a topology
+    it did not run is the round-4 failure mode, caught at emission."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        SCHEDULER_TPU_MESH="1024x1024",
+    )
+    proc = subprocess.run(
+        [sys.executable, str(root / "bench.py"), "--xl", "--smoke"],
+        cwd=root, env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = _json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "refused" in doc["error"] and "1024x1024" in doc["error"]
+    assert doc["value"] == 0.0

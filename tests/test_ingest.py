@@ -420,7 +420,129 @@ def test_journal_and_k8s_wires_produce_identical_bind_sequences(tmp_path):
     assert journal == k8s
 
 
-# -- backoff -----------------------------------------------------------------
+# -- reflector churn soak (slow) ----------------------------------------------
+
+
+def _drive_churn(wire: str, conf_path):
+    """One scripted churn history through one inbound wire: sustained
+    ordered add/modify/delete bursts against the watch stream, a mid-soak
+    history compaction (the k8s wire must take a REAL mid-stream 410 and
+    relist-and-replace; the journal wire sees its ``{"relist": true}``
+    twin), convergence, then one scheduling cycle.  Returns the converged
+    task names, the server's ORDERED bind log, and the pod reflector's
+    relist count (None on the journal wire)."""
+    from scheduler_tpu.scheduler import Scheduler
+
+    server, state, base = _spawn_mock()
+    conn = None
+    try:
+        _post(base, "/objects", {"kind": "queue",
+                                 "object": {"name": "default", "weight": 1}})
+        for i in range(4):
+            _post(base, "/objects", {"kind": "node", "object": {
+                "name": f"cn-{i}",
+                "allocatable": {"cpu": 4000, "memory": 16 * 2**30,
+                                "pods": 110},
+            }})
+        _post(base, "/objects", {"kind": "podgroup", "object": {
+            "name": "churn", "queue": "default", "minMember": 1,
+            "phase": "Inqueue"}})
+
+        cache, conn = client_mod.connect_cache(base, async_io=False, wire=wire)
+        if wire == "k8s":
+            for r in conn.reflectors:
+                r.watch_timeout = 1.0
+        cache.run()
+        conn.start()
+        assert conn.wait_for_cache_sync(15)
+
+        def pod(b, i):
+            return f"churn-{b:02d}-{i}"
+
+        # Sustained ordered churn: every burst adds 6 pods, re-requests 2 of
+        # the previous burst's and deletes 3 of them — ~100 watch events
+        # plus echoes, delivered while the reflectors are live.  Burst 5
+        # compacts the WHOLE history mid-stream: the next k8s watch window
+        # answers 410 Gone and every reflector must relist-and-replace
+        # without dropping or duplicating a single mutation.
+        live = set()
+        bursts = 10
+        for b in range(bursts):
+            for i in range(6):
+                _post(base, "/objects", {"kind": "pod", "object": {
+                    "name": pod(b, i), "group": "churn",
+                    "containers": [{"cpu": 200, "memory": 2**28}]}})
+                live.add(pod(b, i))
+            if b > 0:
+                for i in range(2):
+                    _post(base, "/objects", {"kind": "pod", "op": "update",
+                                             "object": {
+                        "name": pod(b - 1, i), "group": "churn",
+                        "uid": f"wire-default/{pod(b - 1, i)}",
+                        "containers": [{"cpu": 250, "memory": 2**28}]}})
+                for i in range(3, 6):
+                    _post(base, "/objects", {"kind": "pod", "op": "delete",
+                                             "object": {
+                        "name": pod(b - 1, i), "group": "churn",
+                        "uid": f"wire-default/{pod(b - 1, i)}"}})
+                    live.discard(pod(b - 1, i))
+            if b == bursts // 2:
+                # Mid-soak 410, both flavors: the pod stream's cursor rides
+                # the churn and may be fully caught up when the compaction
+                # lands (no HTTP-layer 410 for it), so the injected
+                # mid-stream ERROR Status{410} guarantees the pod reflector
+                # takes at least one relist-and-replace under load.  The
+                # journal wire sees the compaction's {"relist": true} twin.
+                _post(base, "/inject", {"op": "compact-history"})
+                if wire == "k8s":
+                    _post(base, "/inject", {"op": "watch-gone:pod",
+                                            "times": 1})
+
+        want = sorted(live)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if _task_names(cache) == want:
+                break
+            time.sleep(0.1)
+        names = _task_names(cache)
+
+        Scheduler(cache, str(conf_path)).run_once()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if len(_get(base, "/bind-log")["binds"]) >= len(want):
+                break
+            time.sleep(0.1)
+        relists = (
+            conn._by_kind["pod"].relists if wire == "k8s" else None
+        )
+        return names, _get(base, "/bind-log")["binds"], relists
+    finally:
+        if conn is not None:
+            conn.stop()
+        server.shutdown()
+
+
+@pytest.mark.slow
+def test_reflector_churn_soak_survives_410_with_journal_bind_parity(tmp_path):
+    """The soak evidence ROADMAP requires before the default wire flips to
+    k8s: under sustained ordered watch churn with a mid-stream 410, the
+    reflector wire converges to exactly the server's store and produces a
+    bind sequence BITWISE-identical to the journal wire over the same
+    history."""
+    conf = tmp_path / "scheduler.yaml"
+    conf.write_text(CONF)
+    j_names, j_binds, _ = _drive_churn("journal", conf)
+    k_names, k_binds, k_relists = _drive_churn("k8s", conf)
+
+    # Both wires converged to the same (non-trivial) surviving pod set...
+    assert j_names == k_names
+    assert len(k_names) == 6 + 9 * 3  # 6 survivors of the last burst + 3/earlier
+    # ...the mid-soak compaction actually forced the k8s wire through at
+    # least one mid-stream 410 relist (the soak is vacuous otherwise)...
+    assert k_relists and k_relists > 0
+    # ...and the scheduling outcome is bind-for-bind identical.
+    assert len(j_binds) == len(k_names)
+    assert j_binds == k_binds
 
 
 def test_backoff_jittered_doubling_caps_and_resets():

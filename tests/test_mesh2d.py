@@ -1,0 +1,508 @@
+"""Multi-host 2-D mesh device phase (docs/SHARDING.md "Multi-host").
+
+The 2-D ``(replica, nodes)`` named mesh is the multi-process GSPMD shape:
+node ledgers shard node-major over the COMBINED axes, job/queue tables
+replicate, and the per-step comm contract stays one WINNER-tuple all-gather.
+This suite pins, on the 8-virtual-device CPU mesh conftest forces:
+
+* mesh-spec parsing (``SCHEDULER_TPU_MESH=RxC``), degradation rules, and
+  the topology metadata / cache-key identity helpers;
+* bitwise parity of the 2-D sharded scan, selector mask, full fused
+  engine and production allocate action against the single-chip path —
+  including the cross-shard / cross-REPLICA tie rule (lowest global node
+  index wins, exactly the single-chip argmax);
+* the compiled-HLO collective budget on the 2-D mesh (one all-gather);
+* the engine cache keying residents on mesh TOPOLOGY: hit on the same
+  topology, miss on a topology change, never a cross-topology buffer
+  reuse.
+
+Under ``SCHEDULER_TPU_TEST_TPU=1`` these skip when the hardware has fewer
+than 8 chips (same contract as tests/test_sharded.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from scheduler_tpu.ops.placement import _place_scan
+from scheduler_tpu.ops.sharded import (
+    NODE_AXIS,
+    REPLICA_AXIS,
+    is_multi_host,
+    node_shard_axes,
+    sharded_place_scan,
+    sharded_selector_mask,
+)
+from tests.test_sharded import random_problem
+
+
+def make_mesh_2d(r=2, c=4):
+    from tests.conftest import USE_TPU
+
+    devices = jax.devices()
+    if len(devices) < r * c:
+        if USE_TPU:
+            pytest.skip(f"needs {r * c} devices, have {len(devices)}")
+        raise AssertionError(
+            f"conftest must force {r * c} virtual CPU devices "
+            f"(got {len(devices)})"
+        )
+    return Mesh(
+        np.array(devices[: r * c]).reshape(r, c), (REPLICA_AXIS, NODE_AXIS)
+    )
+
+
+SCAN_KEYS = (
+    "idle", "releasing", "task_count", "allocatable", "pods_limit",
+    "mins", "init_resreq", "resreq", "static_mask", "static_score", "valid",
+)
+
+
+def _run_pair(p, deficit, weights, enforce=True):
+    ref = _place_scan(
+        *[jnp.asarray(p[k]) for k in SCAN_KEYS], deficit, weights, enforce,
+    )
+    got = sharded_place_scan(
+        *[jnp.asarray(p[k]) for k in SCAN_KEYS],
+        deficit, mesh=make_mesh_2d(), weights=weights, enforce_pod_count=enforce,
+    )
+    return ref, got
+
+
+# -- mesh construction / helpers ----------------------------------------------
+
+
+def test_mesh_spec_2d_parses_and_caches(monkeypatch):
+    from scheduler_tpu.ops import mesh as mesh_mod
+
+    make_mesh_2d()  # device-count guard
+    monkeypatch.setenv("SCHEDULER_TPU_MESH", "2x4")
+    mesh_mod._cached_key = object()
+    mesh = mesh_mod.get_mesh()
+    assert mesh is not None and is_multi_host(mesh)
+    assert dict(mesh.shape) == {REPLICA_AXIS: 2, NODE_AXIS: 4}
+    assert node_shard_axes(mesh) == (REPLICA_AXIS, NODE_AXIS)
+    assert mesh_mod.get_mesh() is mesh  # memoized per spec string
+
+
+@pytest.mark.parametrize("spec", ["2x", "x4", "3x4", "2x3", "1024x1024"])
+def test_malformed_or_oversized_2d_specs_degrade_to_single_chip(
+    monkeypatch, spec
+):
+    """Non-power-of-two factors, syntax errors and specs larger than the
+    device count must degrade to single-chip (warning), never crash."""
+    from scheduler_tpu.ops import mesh as mesh_mod
+
+    monkeypatch.setenv("SCHEDULER_TPU_MESH", spec)
+    mesh_mod._cached_key = object()
+    assert mesh_mod.get_mesh() is None
+
+
+def test_mesh_topology_metadata_and_key(monkeypatch):
+    from scheduler_tpu.ops import mesh as mesh_mod
+
+    make_mesh_2d()
+    monkeypatch.setenv("SCHEDULER_TPU_MESH", "2x4")
+    mesh_mod._cached_key = object()
+    meta = mesh_mod.mesh_topology()
+    assert meta["devices"] == 8 and meta["processes"] >= 1
+    assert meta["axes"] == {REPLICA_AXIS: 2, NODE_AXIS: 4}
+    key = mesh_mod.topology_key()
+    assert key == (8, meta["processes"], ((REPLICA_AXIS, 2), (NODE_AXIS, 4)))
+
+    # Different topology, same env-string CLASS of config -> different key.
+    monkeypatch.setenv("SCHEDULER_TPU_MESH", "8")
+    mesh_mod._cached_key = object()
+    assert mesh_mod.topology_key() != key
+
+    monkeypatch.setenv("SCHEDULER_TPU_MESH", "1")
+    mesh_mod._cached_key = object()
+    assert mesh_mod.topology_key() is None
+
+
+# -- bitwise parity: scan / selector / winner ---------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("weights", [(0.0, 0.0, 0.0), (1.0, 1.0, 0.0)])
+def test_place_scan_2d_matches_single_chip(seed, weights):
+    rng = np.random.default_rng(seed)
+    p = random_problem(rng)
+    deficit = jnp.asarray(100, dtype=jnp.int32)
+    ref, got = _run_pair(p, deficit, weights)
+    names = ("idle", "releasing", "task_count", "chosen", "pipelined", "failed")
+    for name, a, b in zip(names, ref, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+
+
+def test_place_scan_2d_cross_shard_tie_breaks_to_lowest_global_index():
+    """Identical nodes in DIFFERENT shards — including shards owned by
+    different replica rows — tie on score; the winner must be the lowest
+    global node index, bit-matching the single-chip argmax.  With 32 nodes
+    over 8 devices, local rows 0..3 map to shards (replica, nodes) =
+    (0,0)..(1,3): the tie below spans the replica boundary (nodes 9 and
+    29 live in shards 2 and 7)."""
+    rng = np.random.default_rng(5)
+    p = random_problem(rng)
+    # Uniform everything: every feasible node scores identically per task.
+    p["idle"][:] = 4.0
+    p["releasing"][:] = 0.0
+    p["allocatable"][:] = 8.0
+    p["task_count"][:] = 0
+    p["static_score"][:] = 0.0
+    p["static_mask"][:] = False
+    # Task 0 may only go to nodes 9 or 29 (shards 2 and 7, different
+    # replica rows) — equal scores, so the tie rule decides.
+    p["static_mask"][0, [9, 29]] = True
+    # Task 1: a three-way tie inside and across replica rows.
+    p["static_mask"][1, [13, 14, 30]] = True
+    # Remaining tasks: everything feasible (global all-tie).
+    p["static_mask"][2:, :] = True
+    deficit = jnp.asarray(100, dtype=jnp.int32)
+    ref, got = _run_pair(p, deficit, (1.0, 1.0, 0.0))
+    np.testing.assert_array_equal(np.asarray(ref[3]), np.asarray(got[3]),
+                                  err_msg="chosen")
+    chosen = np.asarray(got[3])
+    assert chosen[0] == 9, "cross-replica tie must break to node 9"
+    assert chosen[1] == 13, "three-way tie must break to node 13"
+
+
+def test_selector_mask_2d_matches_dense():
+    rng = np.random.default_rng(3)
+    t, n, l = 12, 32, 9
+    sel = rng.uniform(size=(t, l)) > 0.7
+    labels = rng.uniform(size=(n, l)) > 0.4
+    got = np.asarray(
+        sharded_selector_mask(
+            jnp.asarray(sel), jnp.asarray(labels), mesh=make_mesh_2d()
+        )
+    )
+    ref = (sel.astype(np.float32) @ (~labels).astype(np.float32).T) == 0
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_two_level_winner_2d_gather_order_is_replica_major():
+    """The candidate gather over ('replica', 'nodes') must order candidates
+    by replica-major linear shard index — the invariant the global-offset
+    math and the lowest-index tie rule both stand on."""
+    from jax.sharding import PartitionSpec as P
+
+    from scheduler_tpu.ops.layout import WINNER
+    from scheduler_tpu.ops.sharded import (
+        shard_linear_index, shard_map, two_level_winner,
+    )
+
+    mesh = make_mesh_2d()
+    scores = np.zeros(32, np.float32)
+    scores[17] = 1.0  # lives in shard 4 = replica row 1, nodes col 0
+
+    def local(sc):
+        lbest = jnp.argmax(sc)
+        off = shard_linear_index(mesh) * sc.shape[0]
+        win = two_level_winner(
+            sc[lbest], lbest + off, axis=node_shard_axes(mesh)
+        )
+        return win[WINNER.SCORE], win[WINNER.INDEX].astype(jnp.int32)
+
+    score, idx = jax.jit(shard_map(
+        local, mesh=mesh, in_specs=P((REPLICA_AXIS, NODE_AXIS)),
+        out_specs=(P(), P()), check_vma=False,
+    ))(jnp.asarray(scores))
+    assert int(idx) == 17 and float(score) == 1.0
+
+
+# -- compiled-HLO budget on the 2-D mesh --------------------------------------
+
+
+def test_budget_holds_on_the_2d_mesh_one_merged_all_gather():
+    """The 2-D candidate gather must compile to exactly ONE all-gather
+    (XLA merges the replica groups over both axes) — the same per-step
+    budget as the 1-D mesh, declared in COLLECTIVE_BUDGET."""
+    from scripts.shard_budget import (
+        check_counts, count_collectives, lowerable_sites,
+    )
+    from scheduler_tpu.ops import layout
+
+    mesh = make_mesh_2d()
+    sites = lowerable_sites(mesh)
+    site = "ops/sharded.py::_place_scan_2d"
+    assert set(sites) == {site, "ops/sharded.py::_selector_mask_2d"}
+    counts = count_collectives(sites[site](mesh))
+    assert counts == {"all-gather": 1}
+    assert check_counts(site, counts, layout.COLLECTIVE_BUDGET[site]) == []
+
+
+# -- full engine + production action on the 2-D mesh --------------------------
+
+
+def _mesh_env(monkeypatch, spec):
+    from scheduler_tpu.ops import mesh as mesh_mod
+
+    if spec is None:
+        monkeypatch.delenv("SCHEDULER_TPU_MESH", raising=False)
+    else:
+        monkeypatch.setenv("SCHEDULER_TPU_MESH", spec)
+    mesh_mod._cached_key = object()  # bust the memo
+
+
+def test_production_2d_mesh_flag_matches_single_chip(monkeypatch):
+    """SCHEDULER_TPU_MESH=2x4 routes the PRODUCTION allocate action through
+    the 2-D mesh; binds must match the single-chip run exactly."""
+    import scheduler_tpu.actions  # noqa: F401
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.framework import close_session, get_action, open_session
+    from scheduler_tpu.ops import mesh as mesh_mod
+    from tests.test_fused import CONF, build_cluster
+
+    make_mesh_2d()  # skip when <8 devices on real hardware
+
+    def run():
+        cache = build_cluster(seed=1, n_nodes=16, n_jobs=8)
+        ssn = open_session(cache, parse_scheduler_conf(CONF).tiers)
+        get_action("allocate").execute(ssn)
+        close_session(ssn)
+        return dict(cache.binder.binds)
+
+    _mesh_env(monkeypatch, None)
+    single = run()
+    _mesh_env(monkeypatch, "2x4")
+    mesh = mesh_mod.get_mesh()
+    assert mesh is not None and is_multi_host(mesh)
+    sharded = run()
+    assert single == sharded
+    assert len(single) > 0
+
+
+def test_sharded_step_kernel_2d_engages_and_matches(monkeypatch):
+    """Under the 2-D mesh the fused selection runs the pallas step kernel
+    per shard inside the step_select_2d shard_map twin; both the mega and
+    the sharded-XLA programs must equal the single-chip codes."""
+    import scheduler_tpu.actions  # noqa: F401
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.actions.allocate import collect_candidates
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.framework import open_session
+    from scheduler_tpu.ops.fused import FusedAllocator
+    from tests.test_fused import CONF, build_cluster
+
+    make_mesh_2d()
+
+    def engine_for(spec):
+        _mesh_env(monkeypatch, spec)
+        cache = build_cluster(seed=3, n_nodes=16, n_jobs=8)
+        ssn = open_session(cache, parse_scheduler_conf(CONF).tiers)
+        return FusedAllocator(ssn, collect_candidates(ssn))
+
+    sharded = engine_for("2x4")
+    assert sharded._mesh is not None and is_multi_host(sharded._mesh)
+    assert sharded.step_kernel, "2-D sharded step kernel must engage"
+    assert sharded.use_mega, "mega (replicated) must engage under the mesh"
+    got_mega = np.asarray(sharded._execute())
+    sharded.use_mega = False
+    got_xla = np.asarray(sharded._execute())
+
+    single = engine_for(None)
+    single.use_mega = False
+    want = np.asarray(single._execute())
+    assert np.array_equal(got_mega, want)
+    assert np.array_equal(got_xla, want)
+    assert int((got_mega >= 0).sum()) > 0
+
+
+def test_2d_partitioned_xla_path_is_shardcheck_clean_and_trips_on_seed(
+    monkeypatch,
+):
+    """With mega forced off, the sharded XLA program's staged args are
+    ACTUALLY partitioned over the combined axes; every buffer must check
+    consistent against its family's 2-D twin, and a seeded
+    replicated-family buffer partitioned node-major must still trip."""
+    import scheduler_tpu.actions  # noqa: F401
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.actions.allocate import collect_candidates
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.framework import open_session
+    from scheduler_tpu.ops.fused import FusedAllocator
+    from scheduler_tpu.ops.mesh import get_mesh
+    from scheduler_tpu.utils import shardcheck
+    from tests.test_fused import CONF, build_cluster
+
+    make_mesh_2d()
+    _mesh_env(monkeypatch, "2x4")
+    monkeypatch.setenv("SCHEDULER_TPU_SHARDCHECK", "1")
+    monkeypatch.setenv("SCHEDULER_TPU_MEGA", "0")
+    shardcheck.reset()
+    cache = build_cluster(seed=3, n_nodes=16, n_jobs=8)
+    ssn = open_session(cache, parse_scheduler_conf(CONF).tiers)
+    eng = FusedAllocator(ssn, collect_candidates(ssn))
+    assert not eng.use_mega and eng._mesh is not None
+    # Node ledger really is split over the combined (replica, nodes) axes.
+    assert tuple(eng.args[0].sharding.spec) == ((REPLICA_AXIS, NODE_AXIS),)
+    codes = np.asarray(eng._execute())
+    assert shardcheck.violations() == 0, shardcheck.violation_log()
+    assert int((codes >= 0).sum()) > 0
+
+    # Seeded violation: a replicated-family arg partitioned over the node
+    # axes must trip (raises under PANIC_ON_ERROR, the conftest regime).
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = get_mesh()
+    bad = list(eng.args)
+    bad[6] = jax.device_put(  # mins [R]: replicated family
+        np.zeros((8,), np.float32),
+        NamedSharding(mesh, P((REPLICA_AXIS, NODE_AXIS))),
+    )
+    with pytest.raises(Exception):
+        shardcheck.check_dispatch(mesh, tuple(bad))
+    assert shardcheck.violations() == 1
+
+    # On a MULTI-HOST mesh the 1-D node spec is itself a violation: a
+    # ledger split only over the per-process chip axis is replicated
+    # across replicas — a real per-dispatch reshard, not an alias.
+    shardcheck.reset()
+    bad = list(eng.args)
+    bad[0] = jax.device_put(
+        np.asarray(bad[0]), NamedSharding(mesh, P(NODE_AXIS))
+    )
+    with pytest.raises(Exception):
+        shardcheck.check_dispatch(mesh, tuple(bad))
+    assert shardcheck.violations() == 1
+
+
+def test_2d_mesh_dispatch_is_shardcheck_clean(monkeypatch):
+    """The staged 2-D program passes the runtime sharding sanitizer: every
+    partitioned buffer matches its registry family's 2-D twin."""
+    import scheduler_tpu.actions  # noqa: F401
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.framework import close_session, get_action, open_session
+    from scheduler_tpu.utils import shardcheck
+    from tests.test_fused import CONF, build_cluster
+
+    make_mesh_2d()
+    _mesh_env(monkeypatch, "2x4")
+    monkeypatch.setenv("SCHEDULER_TPU_SHARDCHECK", "1")
+    shardcheck.reset()
+    cache = build_cluster(seed=2, n_nodes=16, n_jobs=8)
+    ssn = open_session(cache, parse_scheduler_conf(CONF).tiers)
+    get_action("allocate").execute(ssn)
+    close_session(ssn)
+    assert shardcheck.enabled()
+    assert shardcheck.violations() == 0, shardcheck.violation_log()
+    assert len(cache.binder.binds) > 0
+
+
+# -- engine cache: residents keyed on mesh topology ---------------------------
+
+
+def _cycle(cache, conf):
+    from scheduler_tpu.framework import close_session, get_action, open_session
+
+    ssn = open_session(cache, conf.tiers)
+    get_action("allocate").execute(ssn)
+    close_session(ssn)
+    return dict(cache.binder.binds)
+
+
+def test_engine_cache_hits_on_same_topology_misses_on_change(monkeypatch):
+    """The cache key carries the RESOLVED mesh topology: steady cycles on
+    one topology delta-refresh the resident (hits), a topology change is a
+    key change (miss — a fresh engine, never a cross-topology buffer
+    reuse), and returning to the first topology must still never serve the
+    other topology's resident."""
+    import scheduler_tpu.actions  # noqa: F401
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.ops import engine_cache
+    from tests.test_engine_cache_parity import CONF, build_cluster
+
+    make_mesh_2d()
+    monkeypatch.setenv("SCHEDULER_TPU_ENGINE_CACHE", "1")
+    monkeypatch.setenv("SCHEDULER_TPU_ENGINE_CACHE_ENTRIES", "4")
+    engine_cache.clear()
+    engine_cache.reset_counters()
+    cache = build_cluster(1)
+    conf = parse_scheduler_conf(CONF)
+
+    _mesh_env(monkeypatch, "2x4")
+    first = _cycle(cache, conf)   # miss (cold)
+    _cycle(cache, conf)           # rebuild (pending set moved) or hit
+    _cycle(cache, conf)           # steady: hit
+    on_2x4 = engine_cache.reset_counters()
+    assert on_2x4["hits"] >= 1, f"no hit on the steady 2x4 topology: {on_2x4}"
+
+    # Topology change under the SAME env-var class: 2x4 -> 8 (1-D).  Every
+    # cycle on the new topology must MISS (fresh engine) — a hit here would
+    # be a cross-topology buffer reuse.
+    _mesh_env(monkeypatch, "8")
+    got = _cycle(cache, conf)
+    on_8 = engine_cache.reset_counters()
+    assert on_8["misses"] == 1 and on_8["hits"] == 0, on_8
+    assert got == first, "topology change altered placements"
+
+    # Back to 2x4: the ORIGINAL resident may serve again (same key), but
+    # never the 1-D one; placements stay identical either way.
+    _mesh_env(monkeypatch, "2x4")
+    got = _cycle(cache, conf)
+    back = engine_cache.reset_counters()
+    assert back["misses"] == 0, f"returning to a cached topology missed: {back}"
+    assert got == first
+
+
+def test_engine_cache_delta_trajectory_matches_cold_on_2d_mesh(monkeypatch):
+    """The full 13-cycle mutation trajectory of the engine-cache parity
+    suite, run UNDER the 2-D mesh with two queues: every delta-refreshed
+    cycle (node churn, queue-fair drift, node add/remove, vocab growth)
+    must bind bitwise-identically to the cache-off cold builds — the mesh
+    delta path can only ever trade time, never correctness."""
+    from scheduler_tpu.ops import engine_cache
+    from tests.test_engine_cache_parity import MUTATIONS, run_trajectory
+
+    make_mesh_2d()
+    _mesh_env(monkeypatch, "2x4")
+    base_env = {"SCHEDULER_TPU_DEVICE": "1", "SCHEDULER_TPU_FUSED": "1",
+                "SCHEDULER_TPU_MESH": "2x4"}
+    engine_cache.clear()
+    engine_cache.reset_counters()
+    cached = run_trajectory(2, {**base_env, "SCHEDULER_TPU_ENGINE_CACHE": "1"})
+    stats = engine_cache.reset_counters()
+    engine_cache.clear()
+    cold = run_trajectory(2, {**base_env, "SCHEDULER_TPU_ENGINE_CACHE": "0"})
+
+    assert len(cached) == len(cold) == len(MUTATIONS)
+    for i, (got, want) in enumerate(zip(cached, cold)):
+        assert got[0] == want[0], f"cycle {i}: binds diverge on the mesh"
+        assert got[1] == want[1], f"cycle {i}: statuses diverge on the mesh"
+    assert stats["hits"] >= 2, f"mesh delta path never exercised: {stats}"
+
+
+def test_shape_key_embeds_resolved_topology_not_just_the_env_string(
+    monkeypatch,
+):
+    """Two meshes with the same env spec CLASS but different resolved
+    shapes must produce different cache keys even when every env flag
+    matches — the 'auto on a different pod' aliasing hazard."""
+    import scheduler_tpu.actions  # noqa: F401
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.framework import close_session, open_session
+    from scheduler_tpu.ops import engine_cache
+    from scheduler_tpu.ops import mesh as mesh_mod
+    from tests.test_engine_cache_parity import CONF, build_cluster
+
+    mesh_a = make_mesh_2d(2, 4)
+    mesh_b = make_mesh_2d(4, 2)
+    cache = build_cluster(1)
+    ssn = open_session(cache, parse_scheduler_conf(CONF).tiers)
+    try:
+        keys = []
+        for mesh in (mesh_a, mesh_b, None):
+            monkeypatch.setattr(mesh_mod, "get_mesh", lambda m=mesh: m)
+            keys.append(engine_cache.shape_key(ssn))
+        assert None not in keys
+        assert len(set(keys)) == 3, f"topologies alias in the key: {keys}"
+    finally:
+        close_session(ssn)

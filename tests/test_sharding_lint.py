@@ -9,8 +9,10 @@ single-chip argmax, the runtime shardcheck sanitizer, and the
 committed-tree gate.
 
 Device-count note: these tests need only FOUR devices (the CI
-simulated-mesh job forces exactly 4; the default conftest path forces 8
-and the tests use the first 4)."""
+simulated-mesh job and the default conftest path both force 8; the tests
+use the first 4).  The 2-D multi-host fixtures below are pure-AST and need
+no devices at all; the DEVICE-backed 2-D parity suite is
+tests/test_mesh2d.py."""
 
 from __future__ import annotations
 
@@ -239,6 +241,130 @@ def test_passthrough_wrapper_is_not_a_site():
     assert out == [], "\n".join(str(f) for f in out)
 
 
+# -- 2-D (multi-host) families ------------------------------------------------
+
+SLAYOUT2D = """
+    SHARD_AXES = {"NODE_AXIS": "nodes", "REPLICA_AXIS": "replica"}
+    SHARDING = {
+        "node_major": ("nodes",),
+        "node_major_2d": (("replica", "nodes"),),
+        "replicated": (),
+    }
+    SHARD_FAMILY_2D = {"node_major": "node_major_2d",
+                       "replicated": "replicated"}
+    SHARD_SITES = {
+        "ops/kern.py::scan2d": {
+            "in": ("node_major_2d", "replicated"),
+            "out": ("node_major_2d", "replicated"),
+            "carry": ((0, 0),),
+        },
+    }
+    COLLECTIVE_BUDGET = {
+        "ops/kern.py::scan2d": {"all-gather": 1, "all-reduce": 0},
+    }
+    SHARDED_HOST_BINDINGS = {}
+    FUSED_ARG_FAMILIES = ("node_major", "replicated")
+    SHARD_DOC = ""
+    SHARD_DOC_ROWS = {}
+"""
+
+KERN2D_OK = """
+    NODE_AXIS = "nodes"
+    REPLICA_AXIS = "replica"
+
+    def scan2d(x, y, mesh):
+        return shard_map(
+            step, mesh=mesh,
+            in_specs=(P((REPLICA_AXIS, NODE_AXIS)), P()),
+            out_specs=(P((REPLICA_AXIS, NODE_AXIS)), P()),
+        )(x, y)
+"""
+
+
+def test_clean_2d_site_passes():
+    """Tuple-axis specs — one dimension split over the combined
+    (replica, nodes) axes — extract and match their declared 2-D family."""
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT2D,
+        "scheduler_tpu/ops/kern.py": KERN2D_OK,
+    })
+    assert out == [], "\n".join(str(f) for f in out)
+
+
+def test_2d_carry_out_spec_drift_trips():
+    """THE donation-lint fixture for the multi-host mesh: a loop-carried
+    (donated) buffer that goes in split over the combined (replica, nodes)
+    axes but comes out split over 'nodes' alone would reshard the ledger
+    across processes every cycle — the pass must flag the drift."""
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT2D,
+        "scheduler_tpu/ops/kern.py": KERN2D_OK.replace(
+            "out_specs=(P((REPLICA_AXIS, NODE_AXIS)), P()),",
+            "out_specs=(P(NODE_AXIS), P()),",
+        ),
+    })
+    carry = [f for f in out if "loop-carried" in f.message]
+    assert len(carry) == 1 and "out_specs == in_specs" in carry[0].message
+    assert "('replica', 'nodes')" in carry[0].message
+
+
+def test_2d_spec_where_1d_declared_trips():
+    """A 2-D split at a site declared with the 1-D family is a mismatch —
+    the twin mapping is for STAGING, not for silently blessing drift."""
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT2D.replace(
+            '"in": ("node_major_2d", "replicated"),',
+            '"in": ("node_major", "replicated"),',
+        ),
+        "scheduler_tpu/ops/kern.py": KERN2D_OK,
+    })
+    mismatch = [f for f in out if "in_specs mismatch" in f.message]
+    assert len(mismatch) == 1 and "position 0" in mismatch[0].message
+
+
+def test_family_2d_twin_integrity():
+    """SHARD_FAMILY_2D must map declared families to declared families."""
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT2D.replace(
+            '"node_major": "node_major_2d",', '"node_major": "node_sliced",'
+        ),
+        "scheduler_tpu/ops/kern.py": KERN2D_OK,
+    })
+    assert any(
+        "SHARD_FAMILY_2D maps 'node_major' to unknown family" in f.message
+        for f in out
+    )
+
+
+def test_fused_family_without_2d_twin_trips():
+    """Every FUSED_ARG_FAMILIES family must have a SHARD_FAMILY_2D entry —
+    the mesh staging keys its sharding table by the twin map, so a missing
+    twin would KeyError at the first mesh dispatch instead of failing
+    lint."""
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT2D.replace(
+            '"node_major": "node_major_2d",', ""
+        ),
+        "scheduler_tpu/ops/kern.py": KERN2D_OK,
+    })
+    assert any(
+        "'node_major' has no SHARD_FAMILY_2D entry" in f.message for f in out
+    )
+
+
+def test_2d_family_with_undeclared_axis_member_trips():
+    out = findings(py={
+        "scheduler_tpu/ops/layout.py": SLAYOUT2D.replace(
+            '"node_major_2d": (("replica", "nodes"),),',
+            '"node_major_2d": (("pods", "nodes"),),',
+        ),
+        "scheduler_tpu/ops/kern.py": KERN2D_OK,
+    })
+    assert any(
+        "uses undeclared axis 'pods'" in f.message for f in out
+    )
+
+
 # -- doc drift ----------------------------------------------------------------
 
 def _doc_text(sreg) -> str:
@@ -316,13 +442,13 @@ def test_budget_passes_on_the_real_scan_and_counts_one_all_gather():
     """ops/sharded.py's declared budget holds in the compiled HLO: exactly
     one all-gather per scan step, zero all-reduces/permutes."""
     from scripts.shard_budget import (
-        LOWERABLE, check_counts, count_collectives,
+        check_counts, count_collectives, lowerable_sites,
     )
     from scheduler_tpu.ops import layout
 
     mesh = _mesh4()
-    site = "ops/sharded.py::sharded_place_scan"
-    counts = count_collectives(LOWERABLE[site](mesh))
+    site = "ops/sharded.py::_place_scan_1d"
+    counts = count_collectives(lowerable_sites(mesh)[site](mesh))
     assert counts == {"all-gather": 1}
     assert check_counts(site, counts, layout.COLLECTIVE_BUDGET[site]) == []
 

@@ -247,7 +247,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         help="inbound ingestion protocol: the bespoke state/watch journal "
              "or Kubernetes-conformant per-resource LIST+WATCH reflectors "
              "(docs/INGEST.md); unset defers to SCHEDULER_TPU_WIRE "
-             "(default journal)",
+             "(default k8s)",
     )
     ns = parser.parse_args(argv)
     if getattr(ns, "version", False):

@@ -819,12 +819,14 @@ class ApiConnector(ConnectorBase):
 
 def wire_from_env() -> str:
     """The inbound wire protocol as configured by ``SCHEDULER_TPU_WIRE``:
-    ``journal`` (default — the bespoke ``GET /state`` + ``GET /watch?since``
-    journal) or ``k8s`` (per-resource LIST+WATCH reflectors with
-    resourceVersion cursors, connector/reflector.py)."""
+    ``k8s`` (default — per-resource LIST+WATCH reflectors with
+    resourceVersion cursors, connector/reflector.py) or ``journal`` (the
+    bespoke ``GET /state`` + ``GET /watch?since`` journal).  The default
+    flipped to ``k8s`` once the churn-soak evidence landed (docs/INGEST.md
+    "Default wire"); reverting is this one line."""
     from scheduler_tpu.utils.envflags import env_str
 
-    return env_str("SCHEDULER_TPU_WIRE", "journal", choices=("journal", "k8s"))
+    return env_str("SCHEDULER_TPU_WIRE", "k8s", choices=("journal", "k8s"))
 
 
 def connect_cache(
@@ -849,10 +851,10 @@ def connect_cache(
     bespoke JSON RPCs for older servers.
 
     ``wire`` selects the INBOUND ingestion protocol (docs/INGEST.md):
-    ``"journal"`` (default) keeps the bespoke global-journal long-poll;
-    ``"k8s"`` ingests the way client-go does — per-resource LIST
+    ``"k8s"`` (default) ingests the way client-go does — per-resource LIST
     (``/api/v1/pods``, …) + chunked WATCH streams with resourceVersion
-    cursors and ``410 Gone`` relist recovery (connector/reflector.py).
+    cursors and ``410 Gone`` relist recovery (connector/reflector.py);
+    ``"journal"`` keeps the bespoke global-journal long-poll.
     ``None`` reads ``SCHEDULER_TPU_WIRE``.
 
     ``limiter`` rate-limits the outbound RPCs (binds, evictions, status

@@ -47,7 +47,7 @@ class ServerOption:
     # GET /state + GET /watch?since=seq journal) or "k8s" (per-resource
     # LIST+WATCH reflectors with resourceVersion cursors and 410 Gone
     # relist recovery — docs/INGEST.md).  None defers to SCHEDULER_TPU_WIRE
-    # (default journal).
+    # (default k8s).
     wire: Optional[str] = None
 
 

@@ -625,13 +625,15 @@ def test_wire_flag_registered_in_engine_cache_key():
 
 
 def test_wire_from_env(monkeypatch):
+    # Default flipped journal -> k8s in round 9 (docs/INGEST.md "Default
+    # wire"): the churn-soak evidence ROADMAP required now exists.
     monkeypatch.delenv("SCHEDULER_TPU_WIRE", raising=False)
-    assert client_mod.wire_from_env() == "journal"
-    monkeypatch.setenv("SCHEDULER_TPU_WIRE", "k8s")
     assert client_mod.wire_from_env() == "k8s"
+    monkeypatch.setenv("SCHEDULER_TPU_WIRE", "journal")
+    assert client_mod.wire_from_env() == "journal"
     # Malformed values degrade to the default (envflags choices), not raise.
     monkeypatch.setenv("SCHEDULER_TPU_WIRE", "carrier-pigeon")
-    assert client_mod.wire_from_env() == "journal"
+    assert client_mod.wire_from_env() == "k8s"
 
 
 def test_connect_cache_env_selects_the_reflector(monkeypatch):

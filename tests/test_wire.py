@@ -182,7 +182,11 @@ class TestRelistPrune:
                 "name": "p0", "group": "g", "nodeName": "n0", "phase": "Running",
                 "containers": [{"cpu": 500, "memory": 2**20}]}})
 
-            cache, conn = connect_cache(base, async_io=False)
+            # These pin the JOURNAL relist path (list_and_seed is the journal
+            # connector's API; the k8s relist twin lives in
+            # tests/test_ingest.py) — explicit now that the default
+            # wire is k8s (docs/INGEST.md "Default wire").
+            cache, conn = connect_cache(base, async_io=False, wire="journal")
             cache.run()
             conn.start()
             assert conn.wait_for_cache_sync(10)
@@ -235,7 +239,11 @@ class TestRelistPrune:
                 "name": "bare", "schedulerName": "volcano",
                 "containers": [{"cpu": 100, "memory": 2**20}]}})
 
-            cache, conn = connect_cache(base, async_io=False)
+            # These pin the JOURNAL relist path (list_and_seed is the journal
+            # connector's API; the k8s relist twin lives in
+            # tests/test_ingest.py) — explicit now that the default
+            # wire is k8s (docs/INGEST.md "Default wire").
+            cache, conn = connect_cache(base, async_io=False, wire="journal")
             cache.run()
             conn.start()
             assert conn.wait_for_cache_sync(10)

@@ -163,6 +163,14 @@ def main() -> None:
     from scheduler_tpu.ops.mesh import mesh_requested, mesh_topology
 
     mesh_meta = mesh_topology()
+    # Allocator flavor on the record (docs/LP_PLACEMENT.md): greedy is the
+    # default; SCHEDULER_TPU_ALLOCATOR=lp runs the LP-relaxed flavor and
+    # every measured cycle then carries its quality block
+    # (detail.cycles[].lp) — scripts/bench_gate.py judges an LP artifact's
+    # binds against the greedy artifact of the same shape.
+    from scheduler_tpu.ops.lp_place import allocator_flavor
+
+    allocator = allocator_flavor()
     if xl and mesh_requested(mesh_meta["spec"]) and not mesh_meta["axes"]:
         print(json.dumps({
             "metric": "pods_per_sec", "value": 0.0, "unit": "pods/s",
@@ -196,6 +204,28 @@ def main() -> None:
         runs.append(one_cycle(n_nodes, n_pods, tasks_per_job, n_queues))
         probes.append(_probe())
 
+    # An artifact claiming the LP flavor must have RUN it: the allocator is
+    # admission-gated (releasing ledgers, SCHEDULER_TPU_LP_LIMIT), and a
+    # silent fallback to greedy would file a greedy measurement under the
+    # BENCH_LP family — bench_gate's lp-vs-greedy quality check would then
+    # judge greedy against greedy and can never fire.  Same refusal class
+    # as the degraded-mesh XL check above: caught at emission, not review.
+    if allocator == "lp" and not any(
+        ph.get("notes", {}).get("lp") for _, _, ph in runs
+    ):
+        print(json.dumps({
+            "metric": "pods_per_sec", "value": 0.0, "unit": "pods/s",
+            "vs_baseline": 0.0,
+            "error": (
+                "SCHEDULER_TPU_ALLOCATOR=lp was requested but no measured "
+                "cycle engaged the LP allocator (see the engine warning "
+                "above — releasing ledgers, or the [T, N] working set over "
+                "SCHEDULER_TPU_LP_LIMIT); an LP artifact must run the "
+                "flavor it claims"
+            ),
+        }))
+        sys.exit(1)
+
     if any(b != runs[0][0] for b, _, _ in runs) or runs[0][0] == 0:
         print(json.dumps({"metric": "pods_per_sec", "value": 0.0, "unit": "pods/s",
                           "vs_baseline": 0.0,
@@ -226,6 +256,7 @@ def main() -> None:
             # topologies differ (not comparable) or whose metadata is
             # missing (not an XL artifact at all).
             "family": "XL" if xl else "flagship",
+            "allocator": allocator,
             "mesh": mesh_meta,
             "cycle_seconds": round(elapsed, 3),
             "regime": regime,
@@ -262,6 +293,12 @@ def main() -> None:
                     # recompute) and the kernel's delta-update /
                     # full-recompute counters.
                     "queue_chain": ph.get("notes", {}).get("queue_chain", {}),
+                    # LP quality evidence (docs/LP_PLACEMENT.md), present
+                    # when SCHEDULER_TPU_ALLOCATOR=lp ran the cycle: binds,
+                    # fragmentation, DRF distance, iterations/convergence
+                    # and repair fallbacks — what bench_gate.py judges
+                    # against the greedy artifact of the same shape.
+                    "lp": ph.get("notes", {}).get("lp", {}),
                 }
                 for (_, el, ph), bad in zip(runs, flags)
             ],

@@ -366,9 +366,17 @@ class AllocateAction(Action):
         # multi-queue bench block can surface it per cycle.
         stats = engine.run_stats()
         queue_chain = stats.pop("queue_chain", None)
+        # LP quality evidence (docs/LP_PLACEMENT.md), present when the cycle
+        # ran the SCHEDULER_TPU_ALLOCATOR=lp flavor: binds, fragmentation,
+        # DRF distance, iterations-to-converge and repair fallbacks — its
+        # own note channel so the bench can surface it per cycle
+        # (detail.cycles[].lp) and bench_gate can judge it against greedy.
+        lp_stats = stats.pop("lp", None)
         phases.note("cohort", stats)
         if queue_chain is not None:
             phases.note("queue_chain", queue_chain)
+        if lp_stats is not None:
+            phases.note("lp", lp_stats)
         with phases.phase("decode"):
             items, node_batches, failures = engine.run_columnar()  # reuses codes
         with phases.phase("apply"):

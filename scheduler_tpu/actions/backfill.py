@@ -73,6 +73,21 @@ class BackfillAction(Action):
         return None, first_bind_fail
 
     def _execute(self, ssn) -> None:
+        # Allocator flavor selection (docs/LP_PLACEMENT.md): backfill's
+        # population is zero-request (BestEffort) tasks, for which the
+        # LP relaxation's bin-pack objective is vacuous — there is no
+        # resource mass to assign fractionally, and every predicate-passing
+        # node ties.  SCHEDULER_TPU_ALLOCATOR=lp therefore deliberately
+        # keeps backfill on the reference host sweep (a first-passing-node
+        # scan IS the integral optimum here); the flavor is consulted so
+        # the decision is explicit and logged, not accidental.
+        from scheduler_tpu.ops.lp_place import allocator_flavor
+
+        if allocator_flavor() == "lp":
+            logger.debug(
+                "backfill: SCHEDULER_TPU_ALLOCATOR=lp has no effect on "
+                "zero-request tasks; keeping the host sweep"
+            )
         nodes = None  # materialized on the first BestEffort task, not per cycle
         # Cohort fast-start applies only when every registered predicate is
         # signature-static (sound prefix skipping needs it).  Per task,

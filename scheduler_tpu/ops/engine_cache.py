@@ -81,6 +81,17 @@ _ENV_KEYS = (
     # the protocols are bind-identical, and keying here means a violation of
     # that contract can never hide behind a warm cache across a flag flip.
     "SCHEDULER_TPU_WIRE",
+    # Allocator flavor + LP knobs (ops/lp_place.py, docs/LP_PLACEMENT.md).
+    # The flavor selects which device program a build stages (greedy argmax
+    # vs LP relaxation + repair), and every LP knob is baked into the traced
+    # relaxation (iteration count, temperature, tolerance) or its admission
+    # gate (memory limit) — a resident engine built under one setting must
+    # never serve another.
+    "SCHEDULER_TPU_ALLOCATOR",
+    "SCHEDULER_TPU_LP_ITERS",
+    "SCHEDULER_TPU_LP_TAU",
+    "SCHEDULER_TPU_LP_TOL",
+    "SCHEDULER_TPU_LP_LIMIT",
 )
 
 _scope_counter = itertools.count(1)

@@ -1004,6 +1004,21 @@ class FusedAllocator:
         # part of the engine-cache key so a resident engine never serves a
         # flipped switch.
         self.queue_delta = _queue_delta_enabled()
+        # Allocator flavor (docs/LP_PLACEMENT.md): ``greedy`` (default — the
+        # sequential argmax engines, bitwise pre-existing behavior) or ``lp``
+        # (relaxation + repair, ops/lp_place.py).  Resolved once per build;
+        # in the engine-cache key, re-checked by _delta_compatible.  The
+        # actual engagement decision (``use_lp``) waits for the admission
+        # gate below once shapes are known.
+        from scheduler_tpu.ops.lp_place import allocator_flavor
+
+        self.allocator = allocator_flavor()
+        self.use_lp = False
+        self.lp_reason = None         # why lp fell back to greedy, if it did
+        self._lp_dev = None           # in-flight (pref, lp_raw) device pair
+        self._lp_stats_host = None    # collected (pref, lp_raw) of last cycle
+        self._lp_mesh = None          # mesh the LP program actually shards on
+        self.lp_phase = {}            # iterate/repair wall split (readback)
         vocab = next(iter(ssn.nodes.values())).vocab
         policy = DevicePolicy(vocab)
         r = vocab.size
@@ -1396,6 +1411,38 @@ class FusedAllocator:
         mesh = get_mesh()
         self._mesh = mesh
 
+        # LP-relaxed allocator (ops/lp_place.py, docs/LP_PLACEMENT.md):
+        # admission-gated — releasing sessions and [T, N] working sets past
+        # the memory limit keep greedy (logged once per build).  When it
+        # engages, BOTH single-step kernels are skipped: the relaxation is
+        # the data-parallel stage and the repair replay runs the plain XLA
+        # while-loop with the marginals riding the static-tensor seam.
+        if self.allocator == "lp":
+            from scheduler_tpu.ops import lp_place
+
+            self.use_lp, self.lp_reason = lp_place.lp_supported(
+                self.flat_count, self.has_releasing, tb, nb, mesh
+            )
+            # The LP program shards only when the staged args do (tiny
+            # clusters whose node bucket cannot divide the mesh stay
+            # replicated — shard_fused_args degrades them the same way).
+            self._lp_mesh = (
+                mesh
+                if mesh is not None and nb % mesh.size == 0
+                else None
+            )
+            if not self.use_lp:
+                # An empty pending set is the idle-daemon steady state, not
+                # a degraded configuration — only real admission failures
+                # deserve warning volume.
+                log = (
+                    logger.debug if self.flat_count == 0 else logger.warning
+                )
+                log(
+                    "SCHEDULER_TPU_ALLOCATOR=lp unavailable (%s); "
+                    "falling back to greedy", self.lp_reason,
+                )
+
         # Fused selection step kernel (pallas): one launch per micro-step for
         # fit+score+mask+argmax.  Excluded when: the score-bound batch path
         # needs the full masked-score vector; something is releasing (the
@@ -1418,6 +1465,7 @@ class FusedAllocator:
         nb_local = nb // mesh.size if mesh is not None and nb % mesh.size == 0 else nb
         self.step_kernel = bool(
             step_ok
+            and not self.use_lp
             and (mesh is None or nb % mesh.size == 0)
             and not self.has_releasing
             and not score_bound
@@ -1433,7 +1481,7 @@ class FusedAllocator:
         from scheduler_tpu.utils.envflags import env_bool
 
         mega_enabled = env_bool("SCHEDULER_TPU_MEGA", True)
-        if step_ok and mega_enabled:
+        if step_ok and mega_enabled and not self.use_lp:
             from scheduler_tpu.ops import megakernel as _mk
 
             # Multi-queue sessions run the kernel's queue-chain mode (round 5;
@@ -1788,6 +1836,9 @@ class FusedAllocator:
             self._dev = None
             self._dev_stats = None
             self._stats_raw = None
+            self._lp_dev = None
+            self._lp_stats_host = None
+            self.lp_phase = {}
             if eager_dispatch:
                 self.dispatch()
                 t0 = _time.perf_counter()
@@ -1872,6 +1923,12 @@ class FusedAllocator:
         if self.queue_delta != _queue_delta_enabled():
             # Pinned by the cache key's env flags in the cached flow; this
             # re-check covers direct update() callers (parity tests).
+            return False
+        from scheduler_tpu.ops.lp_place import allocator_flavor
+
+        if self.allocator != allocator_flavor():
+            # Same contract as queue_delta: the flavor selects which device
+            # program this engine staged (docs/LP_PLACEMENT.md).
             return False
         queue_names = sorted(
             ssn.queues, key=lambda q: (ssn.queues[q].creation_timestamp, q)
@@ -2236,6 +2293,9 @@ class FusedAllocator:
             return
         from scheduler_tpu.utils import sanitize, shardcheck
 
+        if self.use_lp:
+            self._dispatch_lp()
+            return
         if self.use_mega:
             from scheduler_tpu.ops import megakernel as _mk
 
@@ -2282,6 +2342,62 @@ class FusedAllocator:
                 mesh=self._mesh,
             )
 
+    def _dispatch_lp(self) -> None:
+        """Launch the LP flavor's device chain WITHOUT blocking: the
+        relaxation program (``lp_place.lp_relax`` — fixed-point iterations
+        of matmul/softmax/projection over the full pods×nodes tensor), then
+        the repair replay — the EXISTING XLA while-loop with the relaxed
+        marginals as the static score and the open-state feasibility as the
+        static mask (zero dynamic weights: the per-pod argmax over the
+        marginals, replayed through the in-kernel capacity accounting, so
+        bindings never oversubscribe a node and gang/queue semantics are
+        greedy's own).  The repair consumes the marginals as device arrays,
+        so the whole chain enqueues asynchronously; ``readback`` collects.
+        """
+        from scheduler_tpu.ops import lp_place
+        from scheduler_tpu.utils import sanitize, shardcheck
+
+        self._dev_stats = None
+        args = self.args
+        shardcheck.check_dispatch(self._mesh, args)
+        with sanitize.guard():
+            marginals, feas, pref, lp_raw = lp_place.lp_relax(
+                args[0], args[3], args[2], args[4], args[5],
+                args[9], args[10], args[6], args[7], args[8],
+                iters=lp_place.lp_iters(),
+                tau=lp_place.lp_tau(),
+                tol=lp_place.lp_tol(),
+                weights=self.weights,
+                enforce_pod_count=self.enforce_pod_count,
+                use_static=self.use_static,
+                mesh=self._lp_mesh,
+            )
+            self._lp_dev = (pref, lp_raw)
+            # The marginals/feasibility ride the static-tensor positions of
+            # the staged argument tuple (FUSED_ARG_FAMILIES declares both as
+            # node_trailing — exactly the LP program's out-shardings, so a
+            # mesh dispatch inserts zero resharding).
+            a = list(args)
+            a[9] = feas
+            a[10] = marginals
+            self._dev = fused_allocate(
+                *a,
+                comparators=self.comparators,
+                queue_comparators=self.queue_comparators,
+                overused_gate=self.overused_gate,
+                use_static=True,
+                n_queues=len(self.queue_uids),
+                weights=(0.0, 0.0, 0.0),
+                enforce_pod_count=self.enforce_pod_count,
+                window=self._window_size(),
+                batch_runs=self.batch_runs,
+                sorted_jobs=True,
+                has_releasing=False,
+                step_kernel=False,
+                queue_delta=self.queue_delta,
+                mesh=self._mesh,
+            )
+
     def readback(self) -> np.ndarray:
         """Blocking collect of the dispatched program's placement codes
         (dispatching first when no launch is in flight)."""
@@ -2297,7 +2413,35 @@ class FusedAllocator:
         shardcheck.check_result(self._mesh, stats_dev, where="readback.stats")
         try:
             with sanitize.guard():
-                encoded = self._readback(dev)
+                if self.use_lp and self._lp_dev is not None:
+                    # LP evidence first: the tiny (pref, lp_raw) fetch
+                    # serializes on the relaxation program, so the wall
+                    # split between it and the codes fetch is the honest
+                    # iterate-vs-repair breakdown (scripts/profile_cycle.py
+                    # --allocator lp; both are explicit device_gets inside
+                    # readback — the cycle's sanctioned collect point).
+                    import time as _time
+
+                    from scheduler_tpu.utils import phases
+
+                    t0 = _time.perf_counter()
+                    pref_dev, raw_dev = self._lp_dev
+                    self._lp_dev = None
+                    self._lp_stats_host = (
+                        jax.device_get(pref_dev).astype(np.int32),
+                        jax.device_get(raw_dev),
+                    )
+                    t1 = _time.perf_counter()
+                    encoded = self._readback(dev)
+                    t2 = _time.perf_counter()
+                    self.lp_phase = {
+                        "lp_iterate": t1 - t0, "lp_repair": t2 - t1,
+                    }
+                    if phases.active():
+                        phases.add("lp_iterate", t1 - t0)
+                        phases.add("lp_repair", t2 - t1)
+                else:
+                    encoded = self._readback(dev)
                 self._stats_raw = (
                     jax.device_get(stats_dev) if stats_dev is not None else None
                 )
@@ -2321,7 +2465,8 @@ class FusedAllocator:
         the host-side cohort table and placement count."""
         out = {
             "engine": (
-                "mega" if self.use_mega
+                "lp" if self.use_lp
+                else "mega" if self.use_mega
                 else ("step_kernel" if self.step_kernel else "xla")
             ),
             "cohorts": self.cohort_count,
@@ -2344,6 +2489,28 @@ class FusedAllocator:
             out["placed"] = int(
                 ((codes >= 0) | (codes <= _PIPE_BASE)).sum()
             )
+        if self.use_lp:
+            # LP quality block (docs/LP_PLACEMENT.md): device evidence
+            # (iterations / convergence) plus the host-side quality metrics
+            # of the repaired solution — binds, fragmentation, DRF distance,
+            # repair fallbacks — the bench's ``detail.cycles[].lp`` payload
+            # that scripts/bench_gate.py judges against greedy.
+            from scheduler_tpu.ops import lp_place
+
+            lp: dict = {"tau": lp_place.lp_tau()}
+            if self._lp_stats_host is not None:
+                pref, lp_raw = self._lp_stats_host
+                lp.update(lp_place.lp_stats_dict(lp_raw))
+                if enc is not None:
+                    t = self.flat_count
+                    lp.update(lp_place.lp_quality(
+                        enc[:t], pref[:t],
+                        self.st.tasks.resreq[:t],
+                        self.st.nodes.idle,
+                        self.st.tasks.job_idx[:t],
+                        self.st.nodes.allocatable,
+                    ))
+            out["lp"] = lp
         raw = self._stats_raw
         if raw is not None:
             steps = int(raw[STATS.STEPS])

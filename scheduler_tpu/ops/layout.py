@@ -66,6 +66,28 @@ class STATS:
 STATS_WIDTH = 8
 
 
+class LP_PACK:
+    """LP iteration row-stat pack (f32 [4, T] per shard, ``ops/lp_place.py``
+    -> ``sharded.merge_row_logsumexp``): the one all-gathered tensor per
+    fixed-point iteration — the LP twin of the WINNER candidate tuple."""
+
+    MAX = 0      # per-pod local row max (streaming logsumexp)
+    SUM = 1      # per-pod local sum-exp at the local max
+    ARGMAX = 2   # per-pod local best node, as a GLOBAL index (f32-exact)
+    UPD = 3      # previous projection-update max, broadcast along the row
+
+
+class LP_STATS:
+    """LP-relaxed allocator evidence row (``ops/lp_place.py``, i32[2]):
+    returned replicated by the relaxation program, decoded host-side by
+    ``lp_place.lp_stats_dict`` into the bench ``detail.cycles[].lp``
+    quality block (docs/LP_PLACEMENT.md)."""
+
+    ITERATIONS = 0    # fixed-point iterations executed (always the knob)
+    CONVERGED_AT = 1  # first iteration whose projection update fell under
+                      # SCHEDULER_TPU_LP_TOL (-1: never converged)
+
+
 class SIG_REQ:
     """Mega-kernel per-signature request table (f32 [16, S]): identical-
     request runs share one column, indexed by an i32 signature id per task."""
@@ -153,12 +175,17 @@ BUFFERS = {
         "job_state": ("JOB_STATE", 1),
         "sig_req": ("SIG_REQ", 0),
     },
+    "ops/lp_place.py": {
+        "lp_raw": ("LP_STATS", 0),
+        "pack": ("LP_PACK", 0),
+    },
     "ops/pallas_kernels.py": {
         "ns_ref": ("STEP_NODE", 0),
     },
     "ops/sharded.py": {
         "win": ("WINNER", 0),
         "all_cand": ("WINNER", 1),
+        "all_packs": ("LP_PACK", 1),
     },
 }
 
@@ -322,6 +349,24 @@ SHARD_SITES = {
         "in": ("*replicated",),
         "out": ("replicated", "replicated"),
     },
+    # LP-relaxed allocator iteration (ops/lp_place.py, docs/LP_PLACEMENT.md):
+    # node ledgers/gates shard node-major, the [T, N] static rows trailing,
+    # task tables replicate; out = marginals + feasibility (node-trailing —
+    # they slot straight into the repair program's static-tensor positions)
+    # plus the replicated per-pod preference and evidence rows.
+    "ops/lp_place.py::_lp_iterate_1d": {
+        "in": ("node_major", "node_major", "node_major", "node_major",
+               "node_major", "node_trailing", "node_trailing",
+               "replicated", "replicated", "replicated"),
+        "out": ("node_trailing", "node_trailing", "replicated", "replicated"),
+    },
+    "ops/lp_place.py::_lp_iterate_2d": {
+        "in": ("node_major_2d", "node_major_2d", "node_major_2d",
+               "node_major_2d", "node_major_2d", "node_trailing_2d",
+               "node_trailing_2d", "replicated", "replicated", "replicated"),
+        "out": ("node_trailing_2d", "node_trailing_2d", "replicated",
+                "replicated"),
+    },
 }
 
 # Per-site collective budget in the COMPILED HLO, counted per loop step
@@ -353,6 +398,18 @@ COLLECTIVE_BUDGET = {
     },
     "ops/megakernel.py::mega_allocate": {
         "all-gather": 0, "all-reduce": 0, "collective-permute": 0,
+    },
+    # LP iteration: the row-softmax logsumexp merges through ONE tiny
+    # [4, T] row-stat all-gather per fixed-point iteration (the fori body
+    # appears once in the HLO = the per-iteration count); the capacity
+    # matmul and projection are shard-local.  Same one-collective-per-step
+    # contract as the greedy scan, on both mesh shapes
+    # (verified: shard_budget --mesh 2x4).
+    "ops/lp_place.py::_lp_iterate_1d": {
+        "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
+    },
+    "ops/lp_place.py::_lp_iterate_2d": {
+        "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
     },
 }
 

@@ -1,0 +1,485 @@
+"""LP-relaxed on-device batch placement (docs/LP_PLACEMENT.md).
+
+The greedy engines (``ops/fused.py`` / ``ops/megakernel.py``) place one
+task (or one cohort) per device step — O(pods) *sequential* steps by
+construction, which is the placement inner loop's floor no matter how fast
+a single step gets.  This module is the alternative the original brief
+calls for ("final placement solved as an LP-relaxed bin-pack on device"):
+solve the RELAXED assignment problem over the full pods×nodes score tensor
+with a fixed number of fully data-parallel fixed-point iterations — pure
+matmul/softmax/projection per iteration — then repair the fractional
+solution to integrality by replaying a per-pod argmax over the relaxed
+marginals through the EXISTING in-kernel capacity accounting
+(``fused_allocate``'s XLA while-loop), so bindings never oversubscribe a
+node and the gang / queue-share semantics are untouched.
+
+Relaxation.  Variables ``X[t, n] >= 0`` are fractional assignments with
+``sum_n X[t, n] <= 1`` per pod and per-resource capacity
+``sum_t X[t, n] * req[t, r] <= idle[n, r]`` per node (pod-count room rides
+as one extra capacity column when the pod-count gate is live).  The
+objective is the entropy-smoothed score maximization
+``max sum X * score - tau * sum X * log X`` — the proportional-fairness /
+bin-pack objective over the session's OWN scorer mix (``dynamic_score`` at
+the open ledgers plus the session-static score rows), whose solution is the
+capacity-scaled softmax this module iterates (a Sinkhorn-style scaling:
+CvxCluster, PAPERS arxiv 2605.01614, solves granular allocation 100-1000x
+faster via exactly this class of relaxation; Gavel, arxiv 2008.09213,
+frames scheduling policies as optimization over an allocation matrix).
+
+Iteration (``SCHEDULER_TPU_LP_ITERS`` rounds, each O(1) device steps):
+
+1. row softmax: ``X = softmax((score/tau) + log_v[node])`` per pod row —
+   every pod distributes its unit mass by boosted score;
+2. load: ``load = X^T @ req`` — ONE batched [N, T] x [T, R] matmul;
+3. projection: ``log_v += log(clip(min_r cap/load, ., 1))`` — nodes whose
+   fractional load exceeds capacity scale their boost down (the
+   capacity-respecting normalization against the live node ledgers).
+
+Sharding.  The iteration shards node-major over the same 1-D/2-D meshes as
+the greedy scan (``ops/sharded.py``): logits/marginals split on the node
+axis, the matmul and the projection are shard-local, and the row softmax's
+cross-shard logsumexp merges through ONE all-gather of tiny per-shard row
+stats per iteration — the same one-collective-per-step budget as the scan,
+declared in ``ops/layout.py`` (``SHARD_SITES`` / ``COLLECTIVE_BUDGET``)
+and proven in compiled HLO by ``scripts/shard_budget.py``.
+
+Repair.  The marginals ride the engine's EXISTING static-tensor seam: the
+repair program is ``fused_allocate`` with ``static_score = marginals`` and
+``static_mask = open-state feasibility`` (sound: idle only decreases during
+allocate, so live-fit implies open-fit), zero dynamic weights.  Selection
+order (priority/gang/drf chain, proportion queue shares, overused gate),
+gang atomicity and in-kernel capacity replay are therefore exactly the
+greedy engine's — only the per-node score is the relaxed marginal.
+
+Engaged via ``SCHEDULER_TPU_ALLOCATOR=lp`` (default ``greedy`` — bitwise
+pre-existing behavior, pinned by test).  All knobs are registered in
+``ops/engine_cache._ENV_KEYS``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_tpu.ops.layout import LP_PACK, LP_STATS
+from scheduler_tpu.ops.predicates import fit_mask_batch
+from scheduler_tpu.ops.scoring import dynamic_score
+
+# Finite "never" logit: infeasible (pod, node) pairs.  Finite so the row
+# softmax of an all-infeasible pod stays NaN-free (its mass is zeroed from
+# the merged row max instead).
+NEG = jnp.float32(-1e9)
+
+
+# -- knobs (all in engine_cache._ENV_KEYS: they change the traced program) ----
+
+def allocator_flavor() -> str:
+    """``SCHEDULER_TPU_ALLOCATOR``: ``greedy`` (default — the sequential
+    argmax engines, bitwise pre-existing behavior) or ``lp`` (this
+    module's relaxation + repair)."""
+    from scheduler_tpu.utils.envflags import env_str
+
+    return env_str("SCHEDULER_TPU_ALLOCATOR", "greedy",
+                   choices=("greedy", "lp"))
+
+
+def lp_iters() -> int:
+    """Fixed-point iterations of the relaxation (fixed count => bitwise-
+    deterministic output)."""
+    from scheduler_tpu.utils.envflags import env_int
+
+    return env_int("SCHEDULER_TPU_LP_ITERS", 200, minimum=1, maximum=10_000)
+
+
+def lp_tau() -> float:
+    """Softmax temperature: lower is sharper (closer to the integral
+    argmax), higher spreads mass and converges faster."""
+    from scheduler_tpu.utils.envflags import env_float
+
+    return env_float("SCHEDULER_TPU_LP_TAU", 0.25, minimum=1e-4)
+
+
+def lp_tol() -> float:
+    """Convergence tolerance on the projection update (max |delta log_v|):
+    purely evidentiary — iteration count stays fixed so the output stays
+    deterministic; the first iteration under tolerance is reported as
+    ``converged_at`` in the bench quality block."""
+    from scheduler_tpu.utils.envflags import env_float
+
+    return env_float("SCHEDULER_TPU_LP_TOL", 1e-3, minimum=0.0)
+
+
+def lp_limit_bytes() -> int:
+    """Device-memory admission gate for the [T, N] working set (bytes,
+    PER SHARD).  The relaxation holds ~4 [T, N] f32 temporaries (logits,
+    exponentials, marginals, feasibility/static rows)."""
+    from scheduler_tpu.utils.envflags import env_int
+
+    return env_int("SCHEDULER_TPU_LP_LIMIT", 256 * 1024 * 1024, minimum=1)
+
+
+def lp_supported(
+    flat_count: int, has_releasing: bool, t_bucket: int, n_bucket: int, mesh
+) -> Tuple[bool, Optional[str]]:
+    """Admission gate for the LP flavor: ``(ok, reason-when-not)``.
+
+    * Releasing capacity is not modeled by the relaxation (the pipeline
+      arm has no fractional analogue), so those sessions keep greedy.
+    * The [T, N] working set must fit ``SCHEDULER_TPU_LP_LIMIT`` per
+      shard — greedy has no such tensor and stays the scalable default
+      far past it.
+    """
+    if flat_count == 0:
+        return False, "no pending tasks"
+    if has_releasing:
+        return False, "releasing capacity (pipelined placements) not modeled"
+    shards = mesh.size if mesh is not None else 1
+    per_shard = 16 * t_bucket * max(n_bucket // shards, 1)
+    limit = lp_limit_bytes()
+    if per_shard > limit:
+        return False, (
+            f"[T={t_bucket}, N={n_bucket}] working set "
+            f"~{per_shard // (1024 * 1024)}MB/shard exceeds "
+            f"SCHEDULER_TPU_LP_LIMIT={limit // (1024 * 1024)}MB"
+        )
+    return True, None
+
+
+# -- the relaxation ----------------------------------------------------------
+
+def _logits_and_feasibility(
+    idle, allocatable, task_count, pods_limit, node_gate,
+    static_mask, static_score, mins, init_resreq, resreq,
+    *, weights, tau, enforce_pod_count, use_static,
+):
+    """Open-state feasibility and scaled score logits, on one node block.
+
+    Feasibility is the greedy engine's own open-state rule: epsilon-exact
+    fit of the INIT request against idle, the node gate, the pod-count
+    room, and the session-static mask.  The score is the session's
+    dynamic scorer mix at the open ledgers plus the static rows — the
+    same objective greedy argmaxes, just frozen at open state so the
+    whole tensor is one batched computation.
+    """
+    feas = fit_mask_batch(init_resreq, idle, mins) & node_gate[None, :]
+    if enforce_pod_count:
+        feas = feas & (task_count < pods_limit)[None, :]
+    score = jax.vmap(
+        lambda rq: dynamic_score(rq, idle, allocatable, *weights)
+    )(resreq)
+    if use_static:
+        feas = feas & static_mask
+        score = score + static_score
+    logits = jnp.where(feas, score / jnp.float32(tau), NEG)
+    return logits, feas
+
+
+def _capacity(idle, task_count, pods_limit, resreq, enforce_pod_count):
+    """Per-node capacity columns and matching per-task request columns for
+    the projection step.  The pod-count gate rides as one extra resource
+    column (each assignment consumes one pod slot)."""
+    if enforce_pod_count:
+        t = resreq.shape[0]
+        cap = jnp.concatenate(
+            [idle, (pods_limit - task_count).astype(idle.dtype)[:, None]],
+            axis=1,
+        )
+        req = jnp.concatenate(
+            [resreq, jnp.ones((t, 1), resreq.dtype)], axis=1
+        )
+        return cap, req
+    return idle, resreq
+
+
+def _iterate_block(
+    logits, cap, req_aug, offset, *, iters, tol, merge
+):
+    """The fixed-point loop over one node block (the whole axis single-chip,
+    a shard under ``shard_map``).  ``merge(pack)`` implements the
+    cross-block row-stat reduction: identity single-chip, ONE all-gather
+    plus a streaming logsumexp merge on a mesh.  Returns
+    ``(marginals, pref, lp_raw)`` — marginals for this block's nodes, the
+    replicated per-pod preferred node, and the i32 evidence vector."""
+    t = logits.shape[0]
+
+    def body(i, carry):
+        log_v, _x, _pref, prev_upd, conv = carry
+        z = logits + log_v[None, :]
+        m_l = jnp.max(z, axis=1)
+        e = jnp.exp(z - m_l[:, None])
+        s_l = jnp.sum(e, axis=1)
+        am_l = (jnp.argmax(z, axis=1) + offset).astype(jnp.float32)
+        pack = jnp.stack(
+            [m_l, s_l, am_l, jnp.full((t,), prev_upd, jnp.float32)]
+        )
+        m, s, pref, gupd = merge(pack)
+        # Pods with no feasible node anywhere carry zero mass (their merged
+        # row max is still the NEG sentinel) — the finite sentinel keeps
+        # the softmax NaN-free, the mass gate keeps them out of the loads.
+        mass = (m > NEG * 0.5).astype(logits.dtype)
+        x = e * (jnp.exp(m_l - m) * mass / s)[:, None]
+        # ``gupd`` is the projection update computed at the END of iteration
+        # i-1 (it rode this iteration's gather), so that is the iteration
+        # being certified — without the -1 every convergence report would
+        # be shifted one iteration late.
+        conv = jnp.where(
+            (i > 0) & (gupd < tol) & (conv < 0), i - 1, conv
+        ).astype(jnp.int32)
+        # Projection: ONE [N_block, T] x [T, R'] matmul, then the per-node
+        # min capacity ratio.  scale <= 1 always (boosts only shrink), and
+        # the floor keeps a hopeless node from driving log_v to -inf.
+        load = x.T @ req_aug
+        ratio = jnp.min(
+            jnp.where(load > 1e-9, cap / jnp.maximum(load, 1e-9), jnp.inf),
+            axis=1,
+        )
+        scale = jnp.clip(jnp.minimum(ratio, 1.0), 1e-6, 1.0)
+        upd = jnp.log(scale)
+        return (log_v + upd, x, pref, jnp.max(jnp.abs(upd)), conv)
+
+    init = (
+        jnp.zeros(logits.shape[1], jnp.float32),
+        jnp.zeros(logits.shape, jnp.float32),
+        jnp.zeros((t,), jnp.float32),
+        jnp.float32(jnp.inf),
+        jnp.int32(-1),
+    )
+    _, x, pref, _, conv = jax.lax.fori_loop(0, iters, body, init)
+    lp_raw = jnp.zeros((2,), jnp.int32)
+    lp_raw = lp_raw.at[LP_STATS.ITERATIONS].set(iters)
+    lp_raw = lp_raw.at[LP_STATS.CONVERGED_AT].set(conv)
+    return x, pref.astype(jnp.int32), lp_raw
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "iters", "tau", "tol", "weights", "enforce_pod_count", "use_static",
+        "mesh",
+    ),
+)
+def lp_relax(
+    idle: jnp.ndarray,          # f32 [N, R]  node-major (open ledgers)
+    allocatable: jnp.ndarray,   # f32 [N, R]  node-major
+    task_count: jnp.ndarray,    # i32 [N]     node-major
+    pods_limit: jnp.ndarray,    # i32 [N]     node-major
+    node_gate: jnp.ndarray,     # bool [N]    node-major
+    static_mask: jnp.ndarray,   # bool [T, N] node-trailing ([1, 1] dummy ok)
+    static_score: jnp.ndarray,  # f32 [T, N]  node-trailing ([1, 1] dummy ok)
+    mins: jnp.ndarray,          # f32 [R]     replicated
+    init_resreq: jnp.ndarray,   # f32 [T, R]  replicated
+    resreq: jnp.ndarray,        # f32 [T, R]  replicated
+    *,
+    iters: int,
+    tau: float,
+    tol: float,
+    weights: Tuple[float, float, float],
+    enforce_pod_count: bool,
+    use_static: bool,
+    mesh=None,
+):
+    """Solve the relaxed assignment.  Returns ``(marginals, feasibility,
+    pref, lp_raw)``: the [T, N] fractional marginals and the [T, N]
+    open-state feasibility mask (both node-trailing on a mesh — they slot
+    straight into the repair program's static-tensor positions), the
+    per-pod preferred node (argmax of the relaxed solution, the
+    repair-fallback reference), and the i32 ``LP_STATS`` evidence row."""
+    n = idle.shape[0]
+    if not use_static:
+        # Shape-normalized dummies: [1, N] shards cleanly on the trailing
+        # node axis (the [1, 1] engine dummies cannot), and the body never
+        # reads them when use_static is off (trace-time fold).
+        static_mask = jnp.ones((1, n), dtype=bool)
+        static_score = jnp.zeros((1, n), dtype=jnp.float32)
+
+    build_kw = dict(
+        weights=weights, tau=tau, enforce_pod_count=enforce_pod_count,
+        use_static=use_static,
+    )
+
+    if mesh is None:
+        logits, feas = _logits_and_feasibility(
+            idle, allocatable, task_count, pods_limit, node_gate,
+            static_mask, static_score, mins, init_resreq, resreq, **build_kw,
+        )
+        cap, req_aug = _capacity(
+            idle, task_count, pods_limit, resreq, enforce_pod_count
+        )
+
+        def merge_single(pack):
+            # One block == the whole node axis: the streaming merge is the
+            # identity and the preferred node is the local argmax.
+            return (
+                pack[LP_PACK.MAX], pack[LP_PACK.SUM], pack[LP_PACK.ARGMAX],
+                pack[LP_PACK.UPD, 0],
+            )
+
+        x, pref, lp_raw = _iterate_block(
+            logits, cap, req_aug, jnp.int32(0),
+            iters=iters, tol=tol, merge=merge_single,
+        )
+        return x, feas, pref, lp_raw
+
+    from scheduler_tpu.ops.sharded import (
+        is_multi_host as _is_multi_host,
+        merge_row_logsumexp as _merge_rows,
+        node_shard_axes as _node_shard_axes,
+        shard_linear_index as _shard_linear_index,
+    )
+
+    n_local = n // mesh.size
+    axes = _node_shard_axes(mesh)
+
+    def shard_fn(idle_l, alloc_l, tc_l, plim_l, gate_l, smask_l, sscore_l,
+                 mins_r, initq_r, req_r):
+        logits, feas = _logits_and_feasibility(
+            idle_l, alloc_l, tc_l, plim_l, gate_l, smask_l, sscore_l,
+            mins_r, initq_r, req_r, **build_kw,
+        )
+        cap, req_aug = _capacity(
+            idle_l, tc_l, plim_l, req_r, enforce_pod_count
+        )
+        offset = _shard_linear_index(mesh) * n_local
+
+        def merge_mesh(pack):
+            # ONE tiny all-gather of the [4, T] row-stat pack per
+            # iteration — the LP twin of the scan's winner-tuple gather
+            # (COLLECTIVE_BUDGET, ops/layout.py).
+            return _merge_rows(pack, axes)
+
+        x, pref, lp_raw = _iterate_block(
+            logits, cap, req_aug, offset,
+            iters=iters, tol=tol, merge=merge_mesh,
+        )
+        return x, feas, pref, lp_raw
+
+    iterate = _lp_iterate_2d if _is_multi_host(mesh) else _lp_iterate_1d
+    return iterate(
+        shard_fn, mesh,
+        idle, allocatable, task_count, pods_limit, node_gate,
+        static_mask, static_score, mins, init_resreq, resreq,
+    )
+
+
+# The 1-D/2-D twins are DISTINCT literal shard_map sites on purpose (the
+# ops/sharded.py rule): schedlint's sharding pass extracts each P(...) and
+# checks it against its own SHARD_SITES entry, and scripts/shard_budget.py
+# lowers each and counts collectives in the compiled HLO against
+# COLLECTIVE_BUDGET — a computed spec would be invisible to both gates.
+
+def _lp_iterate_1d(shard_fn, mesh, *operands):
+    from jax.sharding import PartitionSpec as _P
+
+    from scheduler_tpu.ops.sharded import NODE_AXIS as _NAXIS
+    from scheduler_tpu.ops.sharded import shard_map as _shard_map
+
+    return _shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            _P(_NAXIS), _P(_NAXIS), _P(_NAXIS), _P(_NAXIS), _P(_NAXIS),
+            _P(None, _NAXIS), _P(None, _NAXIS), _P(), _P(), _P(),
+        ),
+        out_specs=(_P(None, _NAXIS), _P(None, _NAXIS), _P(), _P()),
+        check_vma=False,
+    )(*operands)
+
+
+def _lp_iterate_2d(shard_fn, mesh, *operands):
+    from jax.sharding import PartitionSpec as _P
+
+    from scheduler_tpu.ops.sharded import NODE_AXIS as _NAXIS
+    from scheduler_tpu.ops.sharded import REPLICA_AXIS as _RAXIS
+    from scheduler_tpu.ops.sharded import shard_map as _shard_map
+
+    return _shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            _P((_RAXIS, _NAXIS)), _P((_RAXIS, _NAXIS)),
+            _P((_RAXIS, _NAXIS)), _P((_RAXIS, _NAXIS)),
+            _P((_RAXIS, _NAXIS)),
+            _P(None, (_RAXIS, _NAXIS)), _P(None, (_RAXIS, _NAXIS)),
+            _P(), _P(), _P(),
+        ),
+        out_specs=(
+            _P(None, (_RAXIS, _NAXIS)), _P(None, (_RAXIS, _NAXIS)),
+            _P(), _P(),
+        ),
+        check_vma=False,
+    )(*operands)
+
+
+# -- host-side evidence -------------------------------------------------------
+
+def lp_stats_dict(lp_raw: np.ndarray) -> dict:
+    """Decode the device evidence row (``converged_at`` is -1 when the
+    projection never fell under ``SCHEDULER_TPU_LP_TOL`` — the run still
+    used every iteration either way; fixed count keeps output bitwise
+    deterministic)."""
+    return {
+        "iterations": int(lp_raw[LP_STATS.ITERATIONS]),
+        "converged_at": int(lp_raw[LP_STATS.CONVERGED_AT]),
+    }
+
+
+def lp_quality(
+    codes: np.ndarray,        # i32 [T] repair placement codes
+    pref: np.ndarray,         # i32 [T] LP-preferred node per pod
+    resreq: np.ndarray,       # f64 [T, R] host request rows (unscaled)
+    idle_open: np.ndarray,    # f64 [N, R] open idle (unscaled)
+    job_idx: np.ndarray,      # i32 [T] job of each flat task
+    allocatable: np.ndarray,  # f64 [N, R]
+) -> dict:
+    """The per-cycle quality block (bench ``detail.cycles[].lp``):
+
+    * ``binds`` — pods the repaired solution placed;
+    * ``repair_fallbacks`` — placed pods whose final node differs from
+      their LP-preferred node (the capacity replay had to deviate);
+    * ``fragmentation`` — 1 - (placeable copies of the mean placed request
+      on the post-cycle ledgers, node by node) / (copies if the same
+      leftover capacity were consolidated); 0 = no capacity stranded;
+    * ``drf_distance`` — max minus mean of per-job dominant shares of this
+      cycle's placements over cluster allocatable; 0 = perfectly even.
+    """
+    placed = codes >= 0
+    binds = int(placed.sum())
+    out = {
+        "binds": binds,
+        "repair_fallbacks": int((placed & (codes != pref)).sum()),
+    }
+    n, r = idle_open.shape
+    load = np.zeros((n, r))
+    if binds:
+        np.add.at(load, codes[placed], resreq[placed])
+    idle_after = np.maximum(idle_open - load, 0.0)
+    ref_req = resreq[placed].mean(axis=0) if binds else (
+        resreq.mean(axis=0) if resreq.shape[0] else np.zeros(r)
+    )
+    pos = ref_req > 0
+    if pos.any() and n:
+        per_node = np.floor(
+            np.min(idle_after[:, pos] / ref_req[pos][None, :], axis=1)
+        )
+        ideal = np.floor(np.min(idle_after[:, pos].sum(axis=0) / ref_req[pos]))
+        out["fragmentation"] = (
+            round(float(1.0 - per_node.sum() / ideal), 4) if ideal > 0 else 0.0
+        )
+    else:
+        out["fragmentation"] = 0.0
+    totals = allocatable.sum(axis=0) if n else np.zeros(r)
+    safe = np.where(totals > 0, totals, 1.0)
+    if binds and job_idx.size:
+        nj = int(job_idx.max()) + 1
+        job_load = np.zeros((nj, r))
+        np.add.at(job_load, job_idx[placed], resreq[placed])
+        dom = (job_load / safe[None, :] * (totals > 0)[None, :]).max(axis=1)
+        dom = dom[np.unique(job_idx[placed])]
+        out["drf_distance"] = round(float(dom.max() - dom.mean()), 6)
+    else:
+        out["drf_distance"] = 0.0
+    return out

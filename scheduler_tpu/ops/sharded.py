@@ -58,7 +58,7 @@ except ImportError:  # pre-0.6 jax: experimental module, check_rep kwarg
         )
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from scheduler_tpu.ops.layout import WINNER
+from scheduler_tpu.ops.layout import LP_PACK, WINNER
 from scheduler_tpu.ops.predicates import fit_mask, selector_mask
 from scheduler_tpu.ops.scoring import dynamic_score
 
@@ -164,6 +164,37 @@ def two_level_winner_with_queue(lscore, global_idx, cap, pod_room, queue_id,
         win[WINNER.PODS].astype(jnp.int32),
         win[WINNER.QUEUE].astype(jnp.int32),
     )
+
+
+def merge_row_logsumexp(pack, axis=NODE_AXIS):
+    """Cross-shard row-stat reduction of the LP relaxation
+    (``ops/lp_place.py``, docs/LP_PLACEMENT.md) — the streaming-logsumexp
+    sibling of ``two_level_winner``: each shard packs per-pod row stats
+    (local max, local sum-exp, local argmax as a global node index, and
+    the previous projection-update max broadcast along the row) into ONE
+    f32 [4, T] tensor, all_gathers the packs over ICI, and merges
+    replicated.
+
+    Riding all four stats on one pack is what keeps the LP iteration at
+    exactly one collective per step (``COLLECTIVE_BUDGET``): the global
+    row max is the max of local maxes, the global sum-exp is the
+    standard streaming merge ``sum_d s_d * exp(m_d - m)``, the preferred
+    node is the winning shard's local argmax (ties to the lowest shard =
+    lowest global index, the two_level_winner rule), and the convergence
+    scalar is the max over shards.  Returns ``(m, s, pref, upd_max)``.
+    """
+    all_packs = jax.lax.all_gather(pack, axis)  # [D, 4, T]
+    m_d = all_packs[:, LP_PACK.MAX, :]
+    m = jnp.max(m_d, axis=0)
+    s = jnp.sum(
+        all_packs[:, LP_PACK.SUM, :] * jnp.exp(m_d - m[None, :]), axis=0
+    )
+    shard_star = jnp.argmax(m_d, axis=0)
+    pref = jnp.take_along_axis(
+        all_packs[:, LP_PACK.ARGMAX, :], shard_star[None, :], axis=0
+    )[0]
+    upd_max = jnp.max(all_packs[:, LP_PACK.UPD, 0])
+    return m, s, pref, upd_max
 
 
 def node_sharding(mesh: Mesh) -> NamedSharding:

@@ -13,7 +13,16 @@ a directory given as argv[1]):
   processes, axis sizes) — a missing topology is a malformed artifact
   (exit 1), and two XL rounds with DIFFERENT topologies are not compared
   at all (the round-4 "different backend, not comparable" failure mode,
-  machine-caught).
+  machine-caught);
+* ``BENCH_LP_r*.json``  — the LP-relaxed allocator flagship
+  (``SCHEDULER_TPU_ALLOCATOR=lp``, docs/LP_PLACEMENT.md).  LP artifacts
+  must record ``detail.allocator == "lp"`` (else malformed, exit 1), and
+  on top of the within-family regression check the newest LP artifact is
+  judged for placement QUALITY against the newest greedy single-queue
+  artifact: on the same shape (nodes/pods/queues), LP binding fewer pods
+  than greedy beyond ``LP_BIND_TOLERANCE`` fails the gate — a relaxation
+  is allowed to trade exactness for parallelism only inside the
+  documented tolerance.  Different shapes are not compared (no verdict).
 
 Families gate independently (a regression in either fails the build); a
 family with fewer than two artifacts is simply not judged yet.  Regression
@@ -45,10 +54,20 @@ TOLERANCE = 0.10
 # less than the artifact itself trusts.
 MIN_HEALTHY = 3
 
-_ROUND_RE = re.compile(r"BENCH(_MQ|_XL)?_r(\d+)\.json$")
+_ROUND_RE = re.compile(r"BENCH(_MQ|_XL|_LP)?_r(\d+)\.json$")
 
 # (family label, filename infix) — the artifact naming contract.
-FAMILIES = (("single-queue", ""), ("two-queue", "_MQ"), ("xl-multi-host", "_XL"))
+FAMILIES = (
+    ("single-queue", ""), ("two-queue", "_MQ"), ("xl-multi-host", "_XL"),
+    ("lp-allocator", "_LP"),
+)
+
+# LP may bind up to this fraction fewer pods than greedy on the same shape
+# before the gate fails (docs/LP_PLACEMENT.md "Quality gate"): the
+# relaxation's repair can legitimately strand a little capacity that the
+# sequential argmax would have used, but a real quality regression (bad
+# temperature, broken projection) binds far fewer and must not ship.
+LP_BIND_TOLERANCE = 0.02
 
 # detail.mesh keys every XL artifact must carry, with their types.
 _MESH_KEYS = (("devices", int), ("processes", int), ("axes", dict))
@@ -117,6 +136,73 @@ def mesh_identity(path: Path):
     )
 
 
+def _shape_of(detail: dict):
+    """The problem shape two artifacts must share to be quality-compared."""
+    return (detail.get("nodes"), detail.get("pods"), detail.get("queues"))
+
+
+def gate_lp_vs_greedy(root: Path) -> int:
+    """Judge the newest LP artifact's placement quality against the newest
+    greedy single-queue artifact (the A/B the LP flavor exists to win or
+    tie): same shape required, ``binds_lp >= binds_greedy * (1 -
+    LP_BIND_TOLERANCE)``.  Exit 0 when nothing to judge / pass, 1 when the
+    LP artifact is malformed, 2 on a quality regression."""
+    lp_arts = find_artifacts(root, "_LP")
+    greedy_arts = find_artifacts(root, "")
+    if not lp_arts:
+        print("bench-gate[lp-vs-greedy]: no BENCH_LP_r*.json; nothing to "
+              "judge")
+        return 0
+    lp_path = lp_arts[-1]
+    try:
+        lp_doc = _unwrap(json.loads(lp_path.read_text()))
+    except json.JSONDecodeError as err:
+        print(f"bench-gate[lp-vs-greedy]: malformed artifact "
+              f"{lp_path.name}: {err}")
+        return 1
+    lp_detail = lp_doc.get("detail", {})
+    if lp_detail.get("allocator") != "lp":
+        print(
+            f"bench-gate[lp-vs-greedy]: {lp_path.name} does not record "
+            "detail.allocator == 'lp' — an LP artifact must be emitted "
+            "under SCHEDULER_TPU_ALLOCATOR=lp (docs/LP_PLACEMENT.md)"
+        )
+        return 1
+    if not greedy_arts:
+        print("bench-gate[lp-vs-greedy]: no greedy BENCH_r*.json to compare "
+              "against; cannot judge")
+        return 0
+    greedy_path = greedy_arts[-1]
+    try:
+        greedy_detail = _unwrap(
+            json.loads(greedy_path.read_text())
+        ).get("detail", {})
+    except json.JSONDecodeError as err:
+        print(f"bench-gate[lp-vs-greedy]: malformed artifact "
+              f"{greedy_path.name}: {err}")
+        return 1
+    if _shape_of(lp_detail) != _shape_of(greedy_detail):
+        print(
+            f"bench-gate[lp-vs-greedy]: {lp_path.name} "
+            f"{_shape_of(lp_detail)} and {greedy_path.name} "
+            f"{_shape_of(greedy_detail)} ran different shapes; not "
+            "comparable (no verdict)"
+        )
+        return 0
+    lp_binds, greedy_binds = lp_detail.get("binds"), greedy_detail.get("binds")
+    if not isinstance(lp_binds, int) or not isinstance(greedy_binds, int):
+        print("bench-gate[lp-vs-greedy]: missing detail.binds; cannot judge")
+        return 0
+    floor = (1.0 - LP_BIND_TOLERANCE) * greedy_binds
+    verdict = "QUALITY REGRESSION" if lp_binds < floor else "ok"
+    print(
+        f"bench-gate[lp-vs-greedy]: greedy {greedy_path.name} "
+        f"{greedy_binds:,} binds -> lp {lp_path.name} {lp_binds:,} binds "
+        f"(floor {floor:,.0f}): {verdict}"
+    )
+    return 2 if lp_binds < floor else 0
+
+
 def gate_family(root: Path, label: str, infix: str) -> int:
     """Gate one artifact family; same exit-code contract as main()."""
     artifacts = find_artifacts(root, infix)
@@ -171,8 +257,10 @@ def gate_family(root: Path, label: str, infix: str) -> int:
 
 def main(argv) -> int:
     root = Path(argv[1]) if len(argv) > 1 else Path(__file__).resolve().parent.parent
-    # Gate every family; report all verdicts, exit on the worst.
-    return max(gate_family(root, label, infix) for label, infix in FAMILIES)
+    # Gate every family, then the LP-vs-greedy quality check; report all
+    # verdicts, exit on the worst.
+    worst = max(gate_family(root, label, infix) for label, infix in FAMILIES)
+    return max(worst, gate_lp_vs_greedy(root))
 
 
 if __name__ == "__main__":

@@ -1,7 +1,16 @@
 """Phase breakdown of one full-scale allocate cycle (host vs device vs apply).
 
-Usage: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_cycle.py [nodes] [pods] [queues]
+Usage: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_cycle.py \
+    [nodes] [pods] [queues] [--allocator {greedy,lp}]
 (APPEND to PYTHONPATH — TPU hosts carry the axon backend's site dir in it.)
+
+``--allocator lp`` profiles the LP-relaxed flavor (docs/LP_PLACEMENT.md):
+sets ``SCHEDULER_TPU_ALLOCATOR`` for the run and splits the device phase
+into the relaxation iterations vs the repair replay vs the readback — the
+engine measures the split at its readback collect points, so no extra
+device syncs are inserted mid-cycle.  The LP quality block (iterations,
+convergence, binds, fragmentation, DRF distance, repair fallbacks) prints
+with the phases.
 
 ``queues`` > 1 profiles the MULTI-QUEUE cycle: proportion joins the plugin
 tiers (live share ordering + overused gate on device) and the pods spread
@@ -86,10 +95,19 @@ def run(n_nodes: int, n_pods: int, label: str, n_queues: int = 1) -> None:
         gc.unfreeze()
 
     print(f"[{label}] nodes={n_nodes} pods={n_pods} queues={n_queues} "
-          f"binds={len(cluster.cache.binder.binds)}")
-    qc = engine.run_stats().get("queue_chain")
+          f"binds={len(cluster.cache.binder.binds)} "
+          f"allocator={engine.allocator}"
+          + ("" if engine.allocator == "greedy" or engine.use_lp
+             else f" (lp fell back: {engine.lp_reason})"))
+    stats = engine.run_stats()
+    qc = stats.get("queue_chain")
     if qc:
         print(f"  queue_chain         {qc}")
+    lp = stats.get("lp")
+    if lp:
+        print(f"  lp                  {lp}")
+        for k, v in sorted(engine.lp_phase.items()):
+            print(f"  {k:<19} {v:8.3f}s")
     print(f"  open_session        {t1 - t0:8.3f}s")
     print(f"  candidates          {t2 - t1:8.3f}s")
     print(f"  engine init         {t3 - t2:8.3f}s")
@@ -101,8 +119,20 @@ def run(n_nodes: int, n_pods: int, label: str, n_queues: int = 1) -> None:
 
 
 if __name__ == "__main__":
-    n_nodes = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
-    n_pods = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
-    n_queues = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+    argv = list(sys.argv[1:])
+    if "--allocator" in argv:
+        i = argv.index("--allocator")
+        flavor = argv[i + 1] if i + 1 < len(argv) else ""
+        if flavor not in ("greedy", "lp"):
+            sys.exit("profile_cycle: --allocator must be 'greedy' or 'lp'")
+        # Set BEFORE any engine builds: the flavor is resolved per build and
+        # sits in the engine-cache key (ops/engine_cache._ENV_KEYS).
+        import os
+
+        os.environ["SCHEDULER_TPU_ALLOCATOR"] = flavor
+        del argv[i : i + 2]
+    n_nodes = int(argv[0]) if len(argv) > 0 else 10_000
+    n_pods = int(argv[1]) if len(argv) > 1 else 100_000
+    n_queues = int(argv[2]) if len(argv) > 2 else 1
     run(n_nodes, n_pods, "compile", n_queues)  # first run pays the jit compile
     run(n_nodes, n_pods, "steady", n_queues)

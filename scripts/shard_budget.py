@@ -168,6 +168,31 @@ def _hlo_place_scan(mesh) -> str:
     return lowered.compile().as_text()
 
 
+def _hlo_lp_iterate(mesh) -> str:
+    """Lower the LP-relaxed allocator's fixed-point iteration
+    (``ops/lp_place.py``, docs/LP_PLACEMENT.md).  The fori body's
+    collectives appear once in the compiled text, so the count IS the
+    per-iteration count — the declared contract is ONE row-stat
+    all-gather per iteration, zero all-reduces."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scheduler_tpu.ops.lp_place import lp_relax
+
+    p = _small_problem()
+    lowered = lp_relax.lower(
+        jnp.asarray(p["idle"]), jnp.asarray(p["allocatable"]),
+        jnp.asarray(p["task_count"]), jnp.asarray(p["pods_limit"]),
+        jnp.asarray(np.ones(p["idle"].shape[0], bool)),
+        jnp.asarray(p["static_mask"]), jnp.asarray(p["static_score"]),
+        jnp.asarray(p["mins"]), jnp.asarray(p["init_resreq"]),
+        jnp.asarray(p["resreq"]),
+        iters=8, tau=0.5, tol=1e-3, weights=(0.0, 0.0, 1.0),
+        enforce_pod_count=True, use_static=False, mesh=mesh,
+    )
+    return lowered.compile().as_text()
+
+
 def _hlo_selector_mask(mesh) -> str:
     import jax.numpy as jnp
     import numpy as np
@@ -195,10 +220,12 @@ def lowerable_sites(mesh) -> dict:
         return {
             "ops/sharded.py::_place_scan_2d": _hlo_place_scan,
             "ops/sharded.py::_selector_mask_2d": _hlo_selector_mask,
+            "ops/lp_place.py::_lp_iterate_2d": _hlo_lp_iterate,
         }
     return {
         "ops/sharded.py::_place_scan_1d": _hlo_place_scan,
         "ops/sharded.py::_selector_mask_1d": _hlo_selector_mask,
+        "ops/lp_place.py::_lp_iterate_1d": _hlo_lp_iterate,
     }
 
 

@@ -41,6 +41,70 @@ def _write(root, name, doc):
     (root / name).write_text(json.dumps(doc))
 
 
+def _lp_artifact(binds: int, allocator="lp", nodes=1000, pods=10_000,
+                 queues=1) -> dict:
+    doc = _artifact(100_000.0)
+    doc["detail"].update(
+        nodes=nodes, pods=pods, queues=queues, binds=binds,
+        allocator=allocator,
+    )
+    return doc
+
+
+def test_lp_family_is_recognized_and_segregated(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _artifact(100.0))
+    _write(tmp_path, "BENCH_LP_r01.json", _lp_artifact(10_000))
+    assert [p.name for p in find_artifacts(tmp_path, "")] == ["BENCH_r01.json"]
+    assert [p.name for p in find_artifacts(tmp_path, "_LP")] == [
+        "BENCH_LP_r01.json"
+    ]
+
+
+def test_lp_within_tolerance_of_greedy_passes(tmp_path):
+    from scripts.bench_gate import gate_lp_vs_greedy
+
+    _write(tmp_path, "BENCH_r01.json", _artifact(100.0))  # binds 10_000
+    _write(tmp_path, "BENCH_LP_r01.json", _lp_artifact(9_900))  # -1%
+    assert gate_lp_vs_greedy(tmp_path) == 0
+
+
+def test_lp_binding_fewer_than_tolerance_fails(tmp_path):
+    from scripts.bench_gate import gate_lp_vs_greedy
+
+    _write(tmp_path, "BENCH_r01.json", _artifact(100.0))  # binds 10_000
+    _write(tmp_path, "BENCH_LP_r01.json", _lp_artifact(9_000))  # -10%
+    assert gate_lp_vs_greedy(tmp_path) == 2
+    # ... and main() propagates the worst exit.
+    assert gate_main(["bench_gate", str(tmp_path)]) == 2
+
+
+def test_lp_on_a_different_shape_is_not_compared(tmp_path):
+    from scripts.bench_gate import gate_lp_vs_greedy
+
+    _write(tmp_path, "BENCH_r01.json", _artifact(100.0))
+    _write(tmp_path, "BENCH_LP_r01.json", _lp_artifact(500, pods=500))
+    assert gate_lp_vs_greedy(tmp_path) == 0
+
+
+def test_lp_artifact_without_allocator_field_is_malformed(tmp_path):
+    """An artifact filed as BENCH_LP but emitted under the greedy flavor
+    (detail.allocator missing or wrong) would judge greedy against greedy
+    — malformed, not comparable."""
+    from scripts.bench_gate import gate_lp_vs_greedy
+
+    _write(tmp_path, "BENCH_r01.json", _artifact(100.0))
+    _write(tmp_path, "BENCH_LP_r01.json", _lp_artifact(9_900, allocator="greedy"))
+    assert gate_lp_vs_greedy(tmp_path) == 1
+
+
+def test_lp_gate_with_no_artifacts_is_silent_pass(tmp_path):
+    from scripts.bench_gate import gate_lp_vs_greedy
+
+    assert gate_lp_vs_greedy(tmp_path) == 0
+    _write(tmp_path, "BENCH_LP_r01.json", _lp_artifact(9_900))
+    assert gate_lp_vs_greedy(tmp_path) == 0  # no greedy artifact: no verdict
+
+
 def test_xl_family_is_recognized_and_segregated(tmp_path):
     """BENCH_XL_r*.json must land in the XL family only — never be counted
     as a single-queue artifact by the permissive-prefix glob."""
@@ -114,6 +178,37 @@ def test_flagship_round_number_is_shared_across_families(tmp_path, monkeypatch):
 
 def test_flagship_round_starts_at_one_on_empty_root(tmp_path):
     assert next_round(tmp_path) == 1
+
+
+def test_bench_lp_refuses_when_the_lp_flavor_never_engages():
+    """bench.py under SCHEDULER_TPU_ALLOCATOR=lp whose admission gate
+    rejects LP on every cycle (here: a 1-byte SCHEDULER_TPU_LP_LIMIT) must
+    exit non-zero WITHOUT emitting an artifact line — a greedy measurement
+    filed as BENCH_LP would make the lp-vs-greedy quality gate judge
+    greedy against greedy (vacuous pass), the same claims-what-it-did-not-
+    run class as the degraded-mesh XL refusal."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        SCHEDULER_TPU_ALLOCATOR="lp",
+        SCHEDULER_TPU_LP_LIMIT="1",
+    )
+    env.pop("SCHEDULER_TPU_MESH", None)
+    proc = subprocess.run(
+        [sys.executable, str(root / "bench.py"), "--smoke"],
+        cwd=root, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = _json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "no measured cycle engaged" in doc["error"]
+    assert doc["value"] == 0.0
 
 
 def test_bench_xl_refuses_when_requested_mesh_degrades():

@@ -1,0 +1,438 @@
+"""LP-relaxed batch placement (ops/lp_place.py, docs/LP_PLACEMENT.md).
+
+The LP flavor's correctness contract is NOT bitwise parity with greedy —
+it is a different optimizer over the same feasible set — so the suite pins
+the invariants that make it shippable instead:
+
+* feasibility: zero node oversubscription, pod-count limits respected,
+  gang (ready-deficit) atomicity and the queue-share chain preserved —
+  structural, because the repair replays through the greedy engine's own
+  in-kernel capacity accounting;
+* quality: on capacity-tight fixtures LP binds at least greedy's count
+  minus the documented tolerance (the bench_gate contract, smoke-scale);
+* determinism: fixed iteration count => bitwise-stable codes across runs;
+* kill-switch: the default flavor is greedy, `SCHEDULER_TPU_ALLOCATOR`
+  unset/`greedy` stages exactly the pre-LP engine (mega/XLA, no LP state),
+  and flipping the flag across engine-cache updates can never serve a
+  stale flavor;
+* mesh: the 1-D 8-device and 2-D 2x4 shapes run the sharded iteration
+  (one row-stat all-gather per iteration, ops/layout.py budget) and
+  produce feasible, deterministic placements that agree with the
+  single-chip LP run — this file rides the mesh CI job.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.actions.allocate import collect_candidates
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import close_session, open_session
+from scheduler_tpu.ops.fused import FusedAllocator
+from tests.fixtures import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    make_vocab,
+)
+
+BINPACK_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+"""
+
+STATIC_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: predicates
+  - name: nodeorder
+"""
+
+MULTIQ_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: proportion
+  - name: binpack
+"""
+
+
+def _cluster(conf_str, queues=("default",), n_nodes=8, node_cpu=4000,
+             n_gangs=4, gang_size=5, req_cpu=900, pods_cap=20):
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    for q in queues:
+        cache.add_queue(build_queue(q, weight=len(q)))
+    for i in range(n_nodes):
+        cache.add_node(build_node(
+            f"n{i:02d}",
+            {"cpu": node_cpu, "memory": 64 * 2**30, "pods": pods_cap},
+        ))
+    for g in range(n_gangs):
+        q = queues[g % len(queues)]
+        cache.add_pod_group(build_pod_group(
+            f"g{g}", min_member=gang_size, queue=q,
+        ))
+        for i in range(gang_size):
+            cache.add_pod(build_pod(
+                name=f"g{g}-{i}",
+                req={"cpu": req_cpu, "memory": 2**30},
+                groupname=f"g{g}", priority=g % 2,
+            ))
+    conf = parse_scheduler_conf(conf_str)
+    return open_session(cache, conf.tiers)
+
+
+def _engine(monkeypatch, ssn, flavor="lp", **env):
+    monkeypatch.setenv("SCHEDULER_TPU_ALLOCATOR", flavor)
+    for k, v in env.items():
+        monkeypatch.setenv(k, str(v))
+    return FusedAllocator(ssn, collect_candidates(ssn))
+
+
+def _assert_feasible(engine, codes):
+    """Zero oversubscription of any node ledger and pod-count limit, on the
+    host snapshot the engine itself was built from."""
+    t = engine.flat_count
+    codes = codes[:t]
+    st = engine.st
+    req = st.tasks.resreq[:t]
+    placed = codes >= 0
+    load = np.zeros_like(st.nodes.idle)
+    counts = np.zeros(st.nodes.count, dtype=np.int64)
+    if placed.any():
+        np.add.at(load, codes[placed], req[placed])
+        np.add.at(counts, codes[placed], 1)
+    # epsilon headroom: the in-kernel fit uses the vocab's epsilon rule.
+    assert (load <= st.nodes.idle + 1e-6).all(), "node ledger oversubscribed"
+    assert (
+        counts <= st.nodes.pods_limit - st.nodes.task_count
+    ).all(), "pod-count limit violated"
+    return placed
+
+
+# -- feasibility + gang/queue invariants --------------------------------------
+
+def test_lp_engages_and_respects_capacity(monkeypatch):
+    ssn = _cluster(BINPACK_CONF)
+    try:
+        eng = _engine(monkeypatch, ssn)
+        assert eng.allocator == "lp" and eng.use_lp, eng.lp_reason
+        assert not eng.use_mega and not eng.step_kernel
+        codes = eng._execute().copy()
+        placed = _assert_feasible(eng, codes)
+        assert placed.sum() == eng.flat_count  # ample capacity: all place
+        stats = eng.run_stats()
+        assert stats["engine"] == "lp"
+        lp = stats["lp"]
+        for key in ("iterations", "converged_at", "binds", "fragmentation",
+                    "drf_distance", "repair_fallbacks"):
+            assert key in lp, key
+        assert lp["binds"] == int(placed.sum())
+        assert lp["iterations"] == 200
+    finally:
+        close_session(ssn)
+
+
+def test_lp_gang_atomicity_under_tight_capacity(monkeypatch):
+    """Room for exactly two of four 5-pod gangs: every gang must place
+    whole-or-not (the repair's ready-deficit arithmetic is greedy's own) —
+    a partial gang is exactly the oversubscription class the in-kernel
+    replay exists to prevent."""
+    ssn = _cluster(BINPACK_CONF, n_nodes=2, node_cpu=5 * 900 + 100,
+                   n_gangs=4, gang_size=5)
+    try:
+        eng = _engine(monkeypatch, ssn)
+        assert eng.use_lp, eng.lp_reason
+        codes = eng._execute().copy()
+        _assert_feasible(eng, codes)
+        t = eng.flat_count
+        per_gang: dict = {}
+        base = 0
+        for job, rows in zip(eng.jobs, eng.job_rows):
+            n = len(rows)
+            placed = int((codes[base:base + n] >= 0).sum())
+            per_gang[job.uid] = (placed, job.min_available)
+            base += n
+        for uid, (placed, min_avail) in per_gang.items():
+            assert placed == 0 or placed >= min_avail, (
+                f"gang {uid} split: {placed}/{min_avail}"
+            )
+        assert sum(p for p, _ in per_gang.values()) == 10  # two full gangs
+    finally:
+        close_session(ssn)
+
+
+def test_lp_respects_queue_share_chain(monkeypatch):
+    """Two weighted queues under proportion: the repair replay pops queues
+    through the SAME live share/overused chain as greedy, so under
+    contention no queue is starved while the other exceeds its share —
+    pinned by comparing per-queue binds against greedy's own split."""
+    ssn = _cluster(MULTIQ_CONF, queues=("qa", "qbb"), n_nodes=2,
+                   node_cpu=5 * 900 + 100, n_gangs=4, gang_size=5)
+    try:
+        greedy = _engine(monkeypatch, ssn, flavor="greedy")
+        codes_g = greedy._execute().copy()
+
+        def per_queue(engine, codes):
+            out: dict = {}
+            base = 0
+            for job, rows in zip(engine.jobs, engine.job_rows):
+                n = len(rows)
+                out[job.queue] = out.get(job.queue, 0) + int(
+                    (codes[base:base + n] >= 0).sum()
+                )
+                base += n
+            return out
+
+        lp = _engine(monkeypatch, ssn, flavor="lp")
+        assert lp.use_lp, lp.lp_reason
+        codes_lp = lp._execute().copy()
+        _assert_feasible(lp, codes_lp)
+        assert per_queue(lp, codes_lp) == per_queue(greedy, codes_g)
+        assert lp.run_stats()["queue_chain"]["queues"] == 2
+    finally:
+        close_session(ssn)
+
+
+def test_lp_respects_session_static_predicates(monkeypatch):
+    """With predicates/nodeorder live (use_static engines) the session's
+    [T, N] mask rides the LP feasibility AND the repair's static-mask
+    position: every placement must satisfy the static predicate mask."""
+    import jax
+
+    from scheduler_tpu.ops.allocator import build_static_tensors_device
+
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    for i in range(6):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": 4000, "memory": 32 * 2**30, "pods": 20},
+            labels={"zone": "za" if i % 2 else "zb"},
+        ))
+    for g in range(3):
+        cache.add_pod_group(build_pod_group(f"g{g}", min_member=4,
+                                            queue="default"))
+        for i in range(4):
+            pod = build_pod(
+                name=f"g{g}-{i}", req={"cpu": 700, "memory": 2**30},
+                groupname=f"g{g}", priority=g % 2,
+            )
+            pod.node_selector = {"zone": "za" if g % 2 else "zb"}
+            cache.add_pod(pod)
+    ssn = open_session(cache, parse_scheduler_conf(STATIC_CONF).tiers)
+    try:
+        eng = _engine(monkeypatch, ssn)
+        assert eng.use_lp and eng.use_static, eng.lp_reason
+        codes = eng._execute().copy()
+        _assert_feasible(eng, codes)
+        t = eng.flat_count
+        mask_dev, _ = build_static_tensors_device(
+            ssn, eng.st, eng.n_bucket, eng._t_bucket
+        )
+        mask = np.asarray(jax.device_get(mask_dev))[:t]
+        placed = codes[:t] >= 0
+        assert placed.sum() == t
+        assert mask[np.arange(t)[placed], codes[:t][placed]].all()
+    finally:
+        close_session(ssn)
+
+
+# -- quality (the bench_gate contract, smoke scale) ---------------------------
+
+@pytest.mark.parametrize("n_nodes,node_cpu", [
+    (8, 4000),            # slack: both place everything
+    (3, 5 * 900 + 100),   # tight: binds limited by capacity
+])
+def test_lp_binds_within_tolerance_of_greedy(monkeypatch, n_nodes, node_cpu):
+    ssn = _cluster(BINPACK_CONF, n_nodes=n_nodes, node_cpu=node_cpu)
+    try:
+        greedy = _engine(monkeypatch, ssn, flavor="greedy")
+        binds_greedy = int((greedy._execute() >= 0).sum())
+        lp = _engine(monkeypatch, ssn, flavor="lp")
+        assert lp.use_lp, lp.lp_reason
+        codes = lp._execute().copy()
+        _assert_feasible(lp, codes)
+        binds_lp = int((codes[:lp.flat_count] >= 0).sum())
+        # The documented gate tolerance (scripts/bench_gate.py
+        # LP_BIND_TOLERANCE, docs/LP_PLACEMENT.md "Quality gate").
+        from scripts.bench_gate import LP_BIND_TOLERANCE
+
+        assert binds_lp >= (1.0 - LP_BIND_TOLERANCE) * binds_greedy
+    finally:
+        close_session(ssn)
+
+
+# -- determinism --------------------------------------------------------------
+
+def test_lp_bitwise_deterministic_across_runs(monkeypatch):
+    ssn = _cluster(BINPACK_CONF, n_nodes=3, node_cpu=5 * 900 + 100)
+    try:
+        eng = _engine(monkeypatch, ssn)
+        a = eng._execute().copy()
+        b = eng._execute().copy()
+        assert (a == b).all()
+        # A second engine built from the same session agrees too.
+        eng2 = _engine(monkeypatch, ssn)
+        c = eng2._execute().copy()
+        assert (a == c).all()
+    finally:
+        close_session(ssn)
+
+
+# -- kill-switch: greedy is bitwise pre-LP ------------------------------------
+
+def test_default_flavor_is_greedy_and_stages_no_lp_state(monkeypatch):
+    monkeypatch.delenv("SCHEDULER_TPU_ALLOCATOR", raising=False)
+    ssn = _cluster(BINPACK_CONF)
+    try:
+        eng = FusedAllocator(ssn, collect_candidates(ssn))
+        assert eng.allocator == "greedy" and not eng.use_lp
+        assert eng._lp_dev is None and eng._lp_stats_host is None
+        # The greedy build stages exactly the pre-LP engine choice (the
+        # mega kernel on this shape) and its stats carry no lp block.
+        assert eng.use_mega
+        eng._execute()
+        stats = eng.run_stats()
+        assert "lp" not in stats and stats["engine"] == "mega"
+    finally:
+        close_session(ssn)
+
+
+def test_greedy_codes_identical_with_and_without_lp_import(monkeypatch):
+    """`greedy` explicitly vs flag-unset produce the same engine choice and
+    bitwise-identical codes — the flavor env read is the ONLY seam, so
+    this pins that default == greedy == pre-PR behavior (the existing
+    parity suites pin greedy's codes against the device/host references)."""
+    ssn = _cluster(BINPACK_CONF)
+    try:
+        monkeypatch.delenv("SCHEDULER_TPU_ALLOCATOR", raising=False)
+        default = FusedAllocator(ssn, collect_candidates(ssn))
+        codes_default = default._execute().copy()
+        explicit = _engine(monkeypatch, ssn, flavor="greedy")
+        codes_explicit = explicit._execute().copy()
+        assert default.use_mega == explicit.use_mega
+        assert (codes_default == codes_explicit).all()
+        # An LP run on the SAME session leaves the greedy engines untouched.
+        lp = _engine(monkeypatch, ssn, flavor="lp")
+        lp._execute()
+        again = _engine(monkeypatch, ssn, flavor="greedy")
+        assert (again._execute() == codes_default).all()
+    finally:
+        close_session(ssn)
+
+
+def test_engine_cache_never_serves_a_stale_flavor(monkeypatch):
+    """A resident engine built under one flavor must rebuild when the flag
+    flips: the flavor is in _ENV_KEYS (key miss) AND _delta_compatible
+    re-checks it for direct update() callers."""
+    from scheduler_tpu.ops.engine_cache import _ENV_KEYS
+
+    for key in ("SCHEDULER_TPU_ALLOCATOR", "SCHEDULER_TPU_LP_ITERS",
+                "SCHEDULER_TPU_LP_TAU", "SCHEDULER_TPU_LP_TOL",
+                "SCHEDULER_TPU_LP_LIMIT"):
+        assert key in _ENV_KEYS, key
+
+    ssn = _cluster(BINPACK_CONF)
+    try:
+        eng = _engine(monkeypatch, ssn, flavor="greedy")
+        monkeypatch.setenv("SCHEDULER_TPU_ALLOCATOR", "lp")
+        assert not eng._delta_compatible(ssn)
+    finally:
+        close_session(ssn)
+
+
+# -- fallback gates -----------------------------------------------------------
+
+def test_lp_falls_back_to_greedy_over_the_memory_limit(monkeypatch):
+    ssn = _cluster(BINPACK_CONF)
+    try:
+        eng = _engine(monkeypatch, ssn, **{"SCHEDULER_TPU_LP_LIMIT": 1})
+        assert eng.allocator == "lp" and not eng.use_lp
+        assert "SCHEDULER_TPU_LP_LIMIT" in eng.lp_reason
+        codes = eng._execute().copy()
+        assert eng.run_stats()["engine"] == "mega"  # greedy engine ran
+        _assert_feasible(eng, codes)
+    finally:
+        close_session(ssn)
+
+
+def test_lp_quality_block_fields(monkeypatch):
+    """The host-side quality math (lp_place.lp_quality) on a hand-checked
+    shape: one node, two identical pods, room for one."""
+    from scheduler_tpu.ops.lp_place import lp_quality
+
+    codes = np.asarray([0, -2], dtype=np.int32)
+    pref = np.asarray([0, 0], dtype=np.int32)
+    req = np.asarray([[2.0, 1.0], [2.0, 1.0]])
+    idle = np.asarray([[3.0, 8.0]])
+    out = lp_quality(codes, pref, req, idle,
+                     np.asarray([0, 0], np.int32), idle)
+    assert out["binds"] == 1
+    assert out["repair_fallbacks"] == 0
+    # leftover (1.0, 7.0) fits zero copies of the (2, 1) request whether
+    # consolidated or not -> no fragmentation measurable.
+    assert out["fragmentation"] == 0.0
+    assert out["drf_distance"] == 0.0
+
+
+# -- mesh (rides the CI mesh job: 8 forced host devices) ----------------------
+
+@pytest.mark.parametrize("spec", ["8", "2x4"])
+def test_lp_mesh_parity_and_feasibility(monkeypatch, spec):
+    """The sharded LP iteration (1-D and 2-D twins, one row-stat all-gather
+    per iteration) produces feasible, bitwise-deterministic placements that
+    bind the same pods as the single-chip LP run."""
+    import jax
+
+    from scheduler_tpu.ops import mesh as mesh_mod
+    from tests.conftest import USE_TPU
+
+    need = 8
+    if len(jax.devices()) < need:
+        if USE_TPU:
+            pytest.skip(f"needs {need} devices")
+        raise AssertionError("conftest must force 8 virtual devices")
+
+    def run(mesh_spec):
+        monkeypatch.setenv("SCHEDULER_TPU_MESH", mesh_spec)
+        mesh_mod._cached_key = object()  # bust the memo
+        ssn = _cluster(BINPACK_CONF, n_nodes=16, n_gangs=4, gang_size=5)
+        try:
+            eng = _engine(monkeypatch, ssn)
+            assert eng.use_lp, eng.lp_reason
+            if mesh_spec != "1":
+                assert eng._lp_mesh is not None
+            codes = eng._execute().copy()
+            _assert_feasible(eng, codes)
+            codes2 = eng._execute().copy()
+            assert (codes == codes2).all()  # per-topology determinism
+            return codes[:eng.flat_count]
+        finally:
+            close_session(ssn)
+            monkeypatch.setenv("SCHEDULER_TPU_MESH", "1")
+            mesh_mod._cached_key = object()
+
+    single = run("1")
+    sharded = run(spec)
+    assert (single >= 0).sum() == (sharded >= 0).sum()
+    # On this fixture the relaxation is numerically stable enough that the
+    # repaired placements agree exactly across topologies.
+    assert (single == sharded).all()

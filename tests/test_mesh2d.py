@@ -229,10 +229,22 @@ def test_budget_holds_on_the_2d_mesh_one_merged_all_gather():
     mesh = make_mesh_2d()
     sites = lowerable_sites(mesh)
     site = "ops/sharded.py::_place_scan_2d"
-    assert set(sites) == {site, "ops/sharded.py::_selector_mask_2d"}
+    assert set(sites) == {
+        site,
+        "ops/sharded.py::_selector_mask_2d",
+        # LP-relaxed allocator iteration (round 9, docs/LP_PLACEMENT.md):
+        # same one-collective-per-step contract, checked below too.
+        "ops/lp_place.py::_lp_iterate_2d",
+    }
     counts = count_collectives(sites[site](mesh))
     assert counts == {"all-gather": 1}
     assert check_counts(site, counts, layout.COLLECTIVE_BUDGET[site]) == []
+    lp_site = "ops/lp_place.py::_lp_iterate_2d"
+    lp_counts = count_collectives(sites[lp_site](mesh))
+    assert lp_counts == {"all-gather": 1}
+    assert check_counts(
+        lp_site, lp_counts, layout.COLLECTIVE_BUDGET[lp_site]
+    ) == []
 
 
 # -- full engine + production action on the 2-D mesh --------------------------

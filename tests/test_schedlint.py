@@ -148,6 +148,56 @@ def test_raw_env_covers_the_inbound_wire_flag():
     assert out == []
 
 
+def test_env_fixtures_cover_the_allocator_flavor_and_lp_knobs():
+    """SCHEDULER_TPU_ALLOCATOR + the LP knobs (ops/lp_place.py,
+    docs/LP_PLACEMENT.md) ride the standard env machinery: a raw read
+    trips raw-env anywhere, an envflags read under ops/ must be in
+    _ENV_KEYS (env-drift catches any future bare read), and the real
+    registration keeps both passes clean."""
+    out = findings("raw-env", py={
+        "scheduler_tpu/ops/lp_place.py": """
+            import os
+            def allocator_flavor():
+                return os.environ.get("SCHEDULER_TPU_ALLOCATOR", "greedy")
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_ALLOCATOR" in out[0].message
+    # envflags read under ops/ WITHOUT registration: env-drift finding per
+    # unregistered flag (flavor + one knob here).
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+        "scheduler_tpu/ops/lp_place.py": """
+            from scheduler_tpu.utils.envflags import env_int, env_str
+            def allocator_flavor():
+                return env_str("SCHEDULER_TPU_ALLOCATOR", "greedy",
+                               choices=("greedy", "lp"))
+            def lp_iters():
+                return env_int("SCHEDULER_TPU_LP_ITERS", 200)
+        """,
+    })
+    assert sorted(f.message.split(" ")[0] for f in out) == [
+        "SCHEDULER_TPU_ALLOCATOR", "SCHEDULER_TPU_LP_ITERS",
+    ]
+    # Registered (the real tree's shape): clean.
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": """
+            _ENV_KEYS = (
+                "SCHEDULER_TPU_ALLOCATOR",
+                "SCHEDULER_TPU_LP_ITERS",
+            )
+        """,
+        "scheduler_tpu/ops/lp_place.py": """
+            from scheduler_tpu.utils.envflags import env_int, env_str
+            def allocator_flavor():
+                return env_str("SCHEDULER_TPU_ALLOCATOR", "greedy",
+                               choices=("greedy", "lp"))
+            def lp_iters():
+                return env_int("SCHEDULER_TPU_LP_ITERS", 200)
+        """,
+    })
+    assert out == []
+
+
 def test_raw_env_allows_writes_and_envflags_reads():
     out = findings("raw-env", py={
         "scheduler_tpu/cli.py": """

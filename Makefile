@@ -1,7 +1,7 @@
 # Build/test entry points (reference: Makefile + hack/make-rules).
 PY ?= python
 
-.PHONY: all native test test-fast bench bench-smoke bench-xl bench-flagship bench-gate lint verify wheel clean
+.PHONY: all native test test-fast bench bench-smoke bench-xl bench-churn bench-flagship bench-gate lint verify wheel clean
 
 all: native
 
@@ -27,6 +27,14 @@ bench-smoke:
 bench-xl:
 	$(PY) bench.py --xl
 
+# Event-driven churn scenario (docs/CHURN.md): seeded Poisson arrivals,
+# lifetimes and bursts streamed through the mock apiserver's watch wire
+# against a mostly-placed cluster while the scheduler runs event-triggered
+# cycles; emits the BENCH_CHURN_r*.json artifact body (shape/rate via
+# SCHEDULER_TPU_CHURN_*).
+bench-churn:
+	$(PY) bench.py --churn
+
 # ONE run that emits every standing TPU-round artifact debt — BENCH_r*.json,
 # the owed BENCH_MQ_r*.json (SCHEDULER_TPU_BENCH_QUEUES=2) and
 # BENCH_XL_r*.json — under a shared round number, then gates the result.
@@ -36,8 +44,10 @@ bench-flagship:
 	$(PY) scripts/bench_flagship.py
 
 # Perf regression gate: newest artifact of each family (BENCH / BENCH_MQ /
-# BENCH_XL) vs its previous round, healthy-regime cycles only; exits
-# non-zero past a >10% pods/s drop or a malformed/topology-less XL artifact.
+# BENCH_XL / BENCH_LP / BENCH_CHURN) vs its previous round, healthy-regime
+# cycles only; exits non-zero past a >10% pods/s drop (or >10% churn-p99
+# RISE, or a churn hit rate below the artifact's own floor) or a
+# malformed/topology-less XL artifact.
 bench-gate:
 	$(PY) scripts/bench_gate.py
 

@@ -12,7 +12,9 @@ from scheduler_tpu.api.vocab import ResourceVocabulary
 
 
 class ClusterInfo:
-    __slots__ = ("jobs", "nodes", "queues", "vocab", "node_generation")
+    __slots__ = (
+        "jobs", "nodes", "queues", "vocab", "node_generation", "dirty_epoch",
+    )
 
     def __init__(self, vocab: ResourceVocabulary) -> None:
         self.vocab = vocab
@@ -23,6 +25,11 @@ class ClusterInfo:
         # cache mutex) — consumers keying caches on it must never read the
         # live counter, which can advance between snapshot and use.
         self.node_generation: int = -1
+        # The owning cache's dirty-set epoch AT SNAPSHOT TIME (same rule):
+        # the engine-cache hit path delta-scatters the rows dirtied between
+        # its last refresh epoch and now (docs/CHURN.md).  -1 == unknown
+        # (bare ClusterInfo in tests) — consumers full-diff.
+        self.dirty_epoch: int = -1
 
     def __repr__(self) -> str:
         return (

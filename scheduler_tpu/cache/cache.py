@@ -92,6 +92,27 @@ class SchedulerCache(Cache):
         self.queues: Dict[str, QueueInfo] = {}
         self.priority_classes: Dict[str, int] = {}
 
+        # Dirty-set plumbing (docs/CHURN.md): which nodes/jobs/queues changed
+        # since any given epoch, so the engine-cache hit path can delta-
+        # scatter exactly the churned node rows instead of re-diffing full
+        # tensors (ops/fused.py _refresh_dynamic).  Every mutation path marks
+        # under the mutex; ``snapshot()`` stamps the epoch onto the
+        # ClusterInfo so a session knows which cache state it froze.  The
+        # maps are bounded: past _DIRTY_CAP live entries a map clears and its
+        # floor advances — queries older than the floor answer "unknown"
+        # (None / -1) and consumers fall back to the full-tensor diff, which
+        # is exactly the pre-dirty-set behavior.  The marks are deliberately
+        # a SUPERSET of real content changes (a no-op rewrite still marks);
+        # consumers content-compare the marked rows, so a spurious mark costs
+        # a row compare, never correctness.
+        self._dirty_epoch = 0
+        self._node_dirty: Dict[str, int] = {}
+        self._job_dirty: Dict[str, int] = {}
+        self._queue_dirty: Dict[str, int] = {}
+        self._node_dirty_floor = 0
+        self._job_dirty_floor = 0
+        self._queue_dirty_floor = 0
+
         self.binder = binder if binder is not None else FakeBinder()
         self.evictor = evictor if evictor is not None else FakeEvictor()
         self.status_updater = status_updater if status_updater is not None else FakeStatusUpdater()
@@ -126,6 +147,47 @@ class SchedulerCache(Cache):
             self._io_pool.submit(fn, *args)
         else:
             fn(*args)
+
+    # -- dirty-set bookkeeping (docs/CHURN.md) --------------------------------
+
+    # Beyond this many live entries per map, per-row bookkeeping costs more
+    # than the vectorized full-tensor diff it replaces: overflow to "unknown".
+    _DIRTY_CAP = 8192
+
+    def _mark_dirty(self, table: str, names) -> None:
+        """Record that ``names`` of ``table`` mutated.  Callers hold the
+        mutex (every call site is a mutation path that already does)."""
+        self._dirty_epoch += 1
+        epoch = self._dirty_epoch
+        d = getattr(self, f"_{table}_dirty")
+        for name in names:
+            d[name] = epoch
+        if len(d) > self._DIRTY_CAP:
+            d.clear()
+            setattr(self, f"_{table}_dirty_floor", epoch)
+
+    def dirty_nodes_since(self, epoch: int):
+        """Names of nodes whose dynamic state may have changed after
+        ``epoch`` (a superset — consumers content-compare), or ``None`` when
+        the answer is unknown (epoch predates the map's floor, or no epoch).
+        """
+        with self.mutex:
+            if epoch < self._node_dirty_floor or epoch < 0:
+                return None
+            return {n for n, e in self._node_dirty.items() if e > epoch}
+
+    def dirty_counts_since(self, epoch: int) -> Dict[str, int]:
+        """Per-table dirty counts since ``epoch`` (evidence for the churn
+        bench and profile_cycle --churn); -1 == unknown (floor overflow)."""
+        out = {}
+        with self.mutex:
+            for table in ("node", "job", "queue"):
+                if epoch < getattr(self, f"_{table}_dirty_floor") or epoch < 0:
+                    out[f"{table}s"] = -1
+                    continue
+                d = getattr(self, f"_{table}_dirty")
+                out[f"{table}s"] = sum(1 for e in d.values() if e > epoch)
+        return out
 
     # -- job/node accessors --------------------------------------------------
 
@@ -184,8 +246,10 @@ class SchedulerCache(Cache):
         task = TaskInfo(pod, self.vocab)
         task.job = job.uid
         job.add_task_info(task)
+        self._mark_dirty("job", (job.uid,))
         if pod.node_name:
             self._get_or_create_node(pod.node_name).add_task(task)
+            self._mark_dirty("node", (pod.node_name,))
 
     def update_pod(self, pod: PodSpec) -> None:
         with self.mutex:
@@ -208,6 +272,7 @@ class SchedulerCache(Cache):
         job = self.jobs.get(job_id)
         self._pod_cond_last.pop(pod.uid, None)
         if job is not None:
+            self._mark_dirty("job", (job.uid,))
             row = job.store.row_of.get(pod.uid)
             task = job.view_for_row(row) if row is not None else None
             if task is not None:
@@ -217,6 +282,7 @@ class SchedulerCache(Cache):
                         self.nodes[task.node_name].remove_task(task)
                     except KeyError:
                         pass
+                    self._mark_dirty("node", (task.node_name,))
             if gc:
                 self._gc_job(job)
 
@@ -238,18 +304,21 @@ class SchedulerCache(Cache):
             self.node_generation += 1
             ni = self._get_or_create_node(node.name)
             ni.set_node(node)
+            self._mark_dirty("node", (node.name,))
 
     def update_node(self, node: NodeSpec) -> None:
         with self.mutex:
             self.node_generation += 1
             ni = self._get_or_create_node(node.name)
             ni.set_node(node)
+            self._mark_dirty("node", (node.name,))
 
     def delete_node(self, node: NodeSpec) -> None:
         with self.mutex:
             self.node_generation += 1
             self.nodes.pop(node.name, None)
             self.node_ledger.detach(node.name)
+            self._mark_dirty("node", (node.name,))
 
     # -- podgroup events ------------------------------------------------------
 
@@ -261,6 +330,7 @@ class SchedulerCache(Cache):
                 job = JobInfo(job_id, self.vocab)
                 self.jobs[job_id] = job
             job.set_pod_group(pg)
+            self._mark_dirty("job", (job_id,))
 
     def update_pod_group(self, pg: PodGroup) -> None:
         self.add_pod_group(pg)
@@ -272,12 +342,14 @@ class SchedulerCache(Cache):
             if job is not None:
                 job.unset_pod_group()
                 self._gc_job(job)
+                self._mark_dirty("job", (job_id,))
 
     # -- queue events ---------------------------------------------------------
 
     def add_queue(self, queue: Queue) -> None:
         with self.mutex:
             self.queues[queue.name] = QueueInfo(queue)
+            self._mark_dirty("queue", (queue.name,))
 
     def update_queue(self, queue: Queue) -> None:
         self.add_queue(queue)
@@ -285,6 +357,7 @@ class SchedulerCache(Cache):
     def delete_queue(self, queue: Queue) -> None:
         with self.mutex:
             self.queues.pop(queue.name, None)
+            self._mark_dirty("queue", (queue.name,))
 
     # -- priority classes ------------------------------------------------------
 
@@ -305,6 +378,7 @@ class SchedulerCache(Cache):
         podgroup_keys: Optional[set] = None,
         queue_names: Optional[set] = None,
         priority_class_names: Optional[set] = None,
+        pod_scope: Optional[str] = None,
     ) -> int:
         """Delete every cached object ABSENT from a full LIST of the system of
         record.  The reference informer's relist is a store replace
@@ -317,8 +391,31 @@ class SchedulerCache(Cache):
         untouched — the k8s reflector wire relists one resource at a time
         (per-resource watch histories expire independently), while the
         journal protocol's global relist passes all five sets.
+
+        ``pod_scope`` narrows the POD prune to one assignment partition —
+        ``"assigned"`` (only pods the cache has on a node are prune
+        candidates) or ``"unassigned"`` (only pending pods are) — matching
+        a partial LIST taken with a ``spec.nodeName`` field selector
+        (docs/INGEST.md "Field-selector relists"): a partition LIST is only
+        authoritative about its own partition, so pruning outside it would
+        kill live pods the LIST deliberately excluded.
         Returns the number of objects removed."""
         removed = 0
+
+        def in_scope(task) -> bool:
+            if pod_scope is None:
+                return True
+            if task.status == TaskStatus.BINDING:
+                # A bind is in flight: WHICH partition the server files this
+                # pod under is unsettled (the partition LISTs snapshot
+                # server state, the cache's node_name is ahead of it), so a
+                # scoped prune must not judge it — a pod absent from LIST A
+                # because its bind persisted after the snapshot would
+                # otherwise be deleted while alive.  The next settled relist
+                # (or the bind echo / failure resync) owns its fate.
+                return False
+            return bool(task.node_name) == (pod_scope == "assigned")
+
         with self.mutex:
             if pod_uids is not None or podgroup_keys is not None:
                 for job in list(self.jobs.values()):
@@ -326,7 +423,7 @@ class SchedulerCache(Cache):
                         ghost_pods = [
                             task.pod
                             for task in list(job.tasks.values())
-                            if task.pod.uid not in pod_uids
+                            if task.pod.uid not in pod_uids and in_scope(task)
                         ]
                         for pod in ghost_pods:
                             self._delete_pod_locked(pod)
@@ -343,11 +440,13 @@ class SchedulerCache(Cache):
                         self.node_generation += 1
                         del self.nodes[name]
                         self.node_ledger.detach(name)
+                        self._mark_dirty("node", (name,))
                         removed += 1
             if queue_names is not None:
                 for name in list(self.queues):
                     if name not in queue_names:
                         del self.queues[name]
+                        self._mark_dirty("queue", (name,))
                         removed += 1
             if priority_class_names is not None:
                 for name in list(self.priority_classes):
@@ -364,6 +463,10 @@ class SchedulerCache(Cache):
         with self.mutex:
             info = ClusterInfo(self.vocab)
             info.node_generation = self.node_generation
+            # Dirty-set epoch at freeze time: the engine-cache hit path asks
+            # "what changed since the snapshot I last refreshed from?"
+            # (dirty_nodes_since), so the snapshot must know its own epoch.
+            info.dirty_epoch = self._dirty_epoch
             # Node state isolation = ONE ledger matrix copy; per-node views
             # materialize lazily (api/node_ledger.py LedgerNodeMap).
             info.nodes = LedgerNodeMap(
@@ -397,6 +500,10 @@ class SchedulerCache(Cache):
                         "queue": clone.pod_group.queue,
                         "priority_class_name": clone.pod_group.priority_class_name,
                         "min_resources": clone.pod_group.min_resources,
+                        # Locality must survive the clone: the wire status
+                        # updaters skip shadow groups (the server has no
+                        # such object to PATCH — connector/client.py).
+                        "shadow": clone.pod_group.shadow,
                     })
                     pg.uid = clone.pod_group.uid
                     pg.creation_timestamp = clone.pod_group.creation_timestamp
@@ -426,6 +533,8 @@ class SchedulerCache(Cache):
             job.update_task_status(task, TaskStatus.BINDING)
             task.node_name = hostname
             node.add_task(task)
+            self._mark_dirty("node", (hostname,))
+            self._mark_dirty("job", (job.uid,))
 
         self._submit_io(self._bind_one, task, hostname)
 
@@ -522,6 +631,8 @@ class SchedulerCache(Cache):
                 node_rows, job_rows = {}, {}
             for task, hostname in resolved:
                 task.node_name = hostname
+            self._mark_dirty("job", by_job)
+            self._mark_dirty("node", by_node)
             for uid, rows in by_job.items():
                 rows[0][0].bulk_update_status(
                     [t for _, t in rows], TaskStatus.BINDING,
@@ -596,6 +707,8 @@ class SchedulerCache(Cache):
                 node.remove_task(task)
             task.node_name = ""
             job.update_task_status(task, TaskStatus.PENDING)
+            self._mark_dirty("node", (hostname,))
+            self._mark_dirty("job", (job.uid,))
 
     # -- columnar commit hooks (TPU-native extension) --------------------------
 
@@ -651,6 +764,7 @@ class SchedulerCache(Cache):
                 return
             from scheduler_tpu.api.job_info import batch_update_status_rows
 
+            self._mark_dirty("job", (cjob.uid for cjob, *_ in resolved))
             # Engine rows are unique per job, the gen match proves no drift
             # (every row is PENDING) — one native scatter for the whole batch.
             batch_update_status_rows([
@@ -683,6 +797,7 @@ class SchedulerCache(Cache):
                     groups.append(
                         (hostname, cores_sorted[bounds[g] : bounds[g + 1]])
                     )
+                self._mark_dirty("node", (nm for nm, _ in groups))
                 # Bind batches are allocated-status only: idle -= row,
                 # used += row, releasing untouched — applied as ONE ledger
                 # scatter over every touched node (records append per node;
@@ -818,6 +933,8 @@ class SchedulerCache(Cache):
                     tasks_by_node.setdefault(task.node_name, []).append(task)
             for name, ts in tasks_by_node.items():
                 self.nodes[name].bulk_release_tasks(ts, strict=False)
+            self._mark_dirty("node", tasks_by_node)
+            self._mark_dirty("job", {job.uid for job, _, _ in found})
             # A victim whose LIVE cache status moved between the session
             # snapshot and this commit (informer event: e.g. a deletion
             # already marked it RELEASING) takes the generic transition the
@@ -828,6 +945,7 @@ class SchedulerCache(Cache):
                     node = self.nodes[task.node_name]
                     if task.uid in node.tasks:
                         node.update_task(task)
+                        self._mark_dirty("node", (task.node_name,))
         if not found:
             return []
         chunk = max(16, min(self._BIND_CHUNK, -(-len(found) // self._IO_WORKERS)))
@@ -853,10 +971,12 @@ class SchedulerCache(Cache):
                         except KeyError:
                             continue
                         job2.update_task_status(task2, TaskStatus.RUNNING)
+                        self._mark_dirty("job", (job2.uid,))
                         if task2.node_name and task2.node_name in self.nodes:
                             node2 = self.nodes[task2.node_name]
                             if task2.uid in node2.tasks:
                                 node2.update_task(task2)
+                                self._mark_dirty("node", (task2.node_name,))
                     continue
                 emitted.append((task.pod, task.node_name))
             if emitted:
@@ -872,10 +992,12 @@ class SchedulerCache(Cache):
         with self.mutex:
             job, task = self._find_job_and_task(ti)
             job.update_task_status(task, TaskStatus.RELEASING)
+            self._mark_dirty("job", (job.uid,))
             if task.node_name and task.node_name in self.nodes:
                 node = self.nodes[task.node_name]
                 if task.uid in node.tasks:
                     node.update_task(task)
+                    self._mark_dirty("node", (task.node_name,))
 
         def do_evict() -> None:
             try:
@@ -890,10 +1012,12 @@ class SchedulerCache(Cache):
                     except KeyError:
                         return
                     job2.update_task_status(task2, TaskStatus.RUNNING)
+                    self._mark_dirty("job", (job2.uid,))
                     if task2.node_name and task2.node_name in self.nodes:
                         node2 = self.nodes[task2.node_name]
                         if task2.uid in node2.tasks:
                             node2.update_task(task2)
+                            self._mark_dirty("node", (task2.node_name,))
                 return
             # Event emission stays OUTSIDE the try: a recorder problem must
             # never roll back an eviction that actually happened.

@@ -214,9 +214,17 @@ def _cond_field(condition, name: str) -> str:
     return str(getattr(condition, name, ""))
 
 
-def _get(base: str, path: str, timeout: float = 30.0) -> dict:
+def _get_sized(base: str, path: str, timeout: float = 30.0) -> tuple:
+    """``_get`` that also returns the payload size in bytes — LIST/relist
+    cost evidence for the reflectors (docs/INGEST.md "Field-selector
+    relists")."""
     with urllib.request.urlopen(base + path, timeout=timeout) as resp:
-        return json.loads(resp.read() or b"{}")
+        body = resp.read() or b"{}"
+    return json.loads(body), len(body)
+
+
+def _get(base: str, path: str, timeout: float = 30.0) -> dict:
+    return _get_sized(base, path, timeout)[0]
 
 
 class HttpBinder(Binder):
@@ -325,16 +333,36 @@ class HttpStatusUpdater(StatusUpdater):
 
     def update_pod_group(self, job) -> None:
         pg = job.pod_group
-        if pg is None:
+        if pg is None or getattr(pg, "shadow", False):
+            # Shadow PodGroups are synthesized locally for bare pods
+            # (cache/util.go:30-63); the system of record has no such
+            # object — pushing its status would 404 every cycle.
             return
         _post(self.base, "/podgroup-status", {
             "namespace": pg.namespace, "name": pg.name,
+            # FULL status fidelity: the push echoes back over the watch
+            # stream and replaces the cached status — a lossy body would
+            # diff "changed" at every session close and re-push forever
+            # (the event loop docs/CHURN.md describes).
             "phase": str(pg.status.phase),
-            "conditions": [
-                {"type": c.type, "status": c.status, "reason": c.reason}
-                for c in pg.status.conditions
-            ],
+            "running": pg.status.running,
+            "succeeded": pg.status.succeeded,
+            "failed": pg.status.failed,
+            "conditions": _encode_pg_conditions(pg),
         }, limiter=self.limiter)
+
+
+def _encode_pg_conditions(pg) -> list:
+    """Full-fidelity condition encoding, shared by both status-updater
+    dialects (the parse twin is ``wire._parse_pg_condition``)."""
+    return [
+        {
+            "type": c.type, "status": c.status, "reason": c.reason,
+            "message": c.message, "transitionID": c.transition_id,
+            "lastTransitionTime": c.last_transition_time,
+        }
+        for c in pg.status.conditions
+    ]
 
 
 class K8sBinder(Binder):
@@ -511,7 +539,10 @@ class K8sStatusUpdater(StatusUpdater):
 
     def update_pod_group(self, job) -> None:
         pg = job.pod_group
-        if pg is None:
+        if pg is None or getattr(pg, "shadow", False):
+            # Shadow PodGroups never exist on the API server (see the
+            # journal updater above): a status PATCH would 404 and abort
+            # the session close for every bare pod in the cluster.
             return
         _patch(
             self.base,
@@ -520,12 +551,15 @@ class K8sStatusUpdater(StatusUpdater):
                 "apiVersion": "scheduling.incubator.k8s.io/v1alpha1",
                 "kind": "PodGroup",
                 "metadata": {"name": pg.name, "namespace": pg.namespace},
+                # Full status, like the journal updater: the PATCH echoes
+                # back through the reflector and must round-trip losslessly
+                # or every session close re-pushes it (docs/CHURN.md).
                 "status": {
                     "phase": str(pg.status.phase),
-                    "conditions": [
-                        {"type": c.type, "status": c.status, "reason": c.reason}
-                        for c in pg.status.conditions
-                    ],
+                    "running": pg.status.running,
+                    "succeeded": pg.status.succeeded,
+                    "failed": pg.status.failed,
+                    "conditions": _encode_pg_conditions(pg),
                 },
             },
             limiter=self.limiter,
@@ -567,6 +601,19 @@ class ConnectorBase:
         # silently drifting until an unrelated relist (the reference's
         # syncTask re-fetch, event_handlers.go:96-114).
         self._dirty = False
+        # Cycle trigger (utils/trigger.py, docs/CHURN.md): when the scheduler
+        # runs SCHEDULER_TPU_TRIGGER=event, every event applied through the
+        # shared ``_apply`` seam notifies it — both inbound protocols route
+        # here, so event pacing is wire-agnostic.  ``events_applied`` counts
+        # regardless, as ingest evidence.
+        self.trigger = None
+        self.events_applied = 0
+        self._events_lock = threading.Lock()  # reflectors apply concurrently
+
+    def set_trigger(self, trigger) -> None:
+        """Attach the scheduler loop's CycleTrigger to this connector's
+        ``_apply`` seam (called by Scheduler._run_event_loop)."""
+        self.trigger = trigger
 
     # -- event application ---------------------------------------------------
 
@@ -590,6 +637,13 @@ class ConnectorBase:
             # the store fall back to a replace.
             if not self._resync_object(kind, obj):
                 self._mark_dirty(kind)
+        # Successful or repaired, the cluster state (probably) moved: one
+        # trigger notify per applied event — the scheduler's debounce window
+        # does the batching, not this hot path.
+        with self._events_lock:
+            self.events_applied += 1
+        if self.trigger is not None:
+            self.trigger.notify()
 
     def _object_key(self, kind: str, obj: dict) -> str:
         if kind in ("pod", "podgroup"):

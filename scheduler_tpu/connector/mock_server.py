@@ -73,6 +73,29 @@ def _with_rv(obj: Dict, seq: int) -> Dict:
     return obj
 
 
+def _pod_node_name(obj: Dict) -> str:
+    """A stored pod's node assignment, in either wire dialect."""
+    if isinstance(obj.get("metadata"), dict):
+        return str((obj.get("spec") or {}).get("nodeName", "") or "")
+    return str(obj.get("nodeName", "") or "")
+
+
+def _parse_field_selector(raw: Optional[str]):
+    """The ``fieldSelector`` subset a real apiserver supports on pod LISTs
+    that this mock implements: ``spec.nodeName=V`` / ``spec.nodeName==V`` /
+    ``spec.nodeName!=V`` (V may be empty — the unassigned partition).
+    Returns ``(op, value)`` with op in ``{"=", "!="}``, None when absent,
+    or raises ValueError on anything else (the real server 400s too)."""
+    if raw is None:
+        return None
+    field = "spec.nodeName"
+    for prefix, op in ((f"{field}!=", "!="), (f"{field}==", "="),
+                       (f"{field}=", "=")):
+        if raw.startswith(prefix):
+            return op, raw[len(prefix):]
+    raise ValueError(f"unsupported fieldSelector {raw!r}")
+
+
 def _k8s_object_route(path: str) -> Optional[Tuple[str, str]]:
     """Single-object GET routing for the k8s wire (the syncTask re-fetch
     shape): path -> (kind, store key), or None."""
@@ -125,6 +148,10 @@ class MockState:
         self.legacy_calls = 0
         self.get_calls = 0   # single-object re-fetches (syncTask analogue)
         self.list_calls = 0  # full LISTs (relists show up here)
+        # Per-LIST evidence: kind, fieldSelector, payload bytes, item count
+        # (k8s endpoints only) — the split-relist tests assert 410 recovery
+        # stopped paying full-cluster payloads.
+        self.list_log: List[Dict] = []
         self.status_updates: List[Dict] = []
         self.event_log: List[Dict] = []  # lifecycle events (Eventf analogue)
         # PVC ledger: claim -> {"node": ..., "bound": bool}; allocate assigns
@@ -206,18 +233,51 @@ def make_handler(state: MockState):
             self.wfile.write(json.dumps(event).encode() + b"\n")
             self.wfile.flush()
 
-        def _k8s_list(self, kind: str, k8s_kind: str) -> None:
+        def _k8s_list(self, kind: str, k8s_kind: str, q: Dict) -> None:
+            raw_sel = q.get("fieldSelector", [None])[0]
+            try:
+                selector = _parse_field_selector(raw_sel)
+            except ValueError as err:
+                self._json({"error": str(err)}, 400)
+                return
+            if selector is not None and kind != "pod":
+                # The real apiserver indexes spec.nodeName for pods only.
+                self._json(
+                    {"error": f"fieldSelector unsupported for {kind}"}, 400
+                )
+                return
             with state.lock:
                 state.list_calls += 1
-                payload = {
-                    "apiVersion": "v1", "kind": f"{k8s_kind}List",
-                    "metadata": {"resourceVersion": str(state.seq)},
-                    "items": [
-                        json.loads(json.dumps(o))
-                        for o in state.objects[kind].values()
-                    ],
-                }
-            self._json(payload)
+                items = list(state.objects[kind].values())
+                if selector is not None:
+                    op, value = selector
+                    items = [
+                        o for o in items
+                        if (_pod_node_name(o) == value) == (op == "=")
+                    ]
+                # Deep-copy UNDER the lock (tear safety), serialize OUTSIDE
+                # it: a full-cluster json.dumps inside the hold would stall
+                # every watch/apply thread for the dump's duration.
+                items = [json.loads(json.dumps(o)) for o in items]
+                rv = str(state.seq)
+            payload = {
+                "apiVersion": "v1", "kind": f"{k8s_kind}List",
+                "metadata": {"resourceVersion": rv},
+                "items": items,
+            }
+            body = json.dumps(payload).encode()
+            with state.lock:
+                # Payload-size evidence for the split-relist tests: how many
+                # bytes each LIST (and its selector) actually cost.
+                state.list_log.append({
+                    "kind": kind, "selector": raw_sel, "bytes": len(body),
+                    "items": len(items),
+                })
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def _k8s_watch(self, kind: str, k8s_kind: str, q: Dict) -> None:
             """Chunked per-resource watch: stream this kind's events after
@@ -241,6 +301,8 @@ def make_handler(state: MockState):
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.end_headers()
+            import bisect
+
             deadline = time.monotonic() + timeout
             last = since
             try:
@@ -254,9 +316,16 @@ def make_handler(state: MockState):
                                     last < state.compacted_through:
                                 gone = True
                                 break
+                            # events are seq-sorted: bisect to the cursor,
+                            # then filter only the TAIL by kind — a full
+                            # journal rescan per wake is O(history) per
+                            # watcher and starves a churn-rate stream.
+                            idx = bisect.bisect_right(
+                                state.events, last, key=lambda e: e["seq"]
+                            )
                             batch = [
-                                e for e in state.events
-                                if e["seq"] > last and e["kind"] == kind
+                                e for e in state.events[idx:]
+                                if e["kind"] == kind
                             ]
                             if batch:
                                 break
@@ -300,7 +369,7 @@ def make_handler(state: MockState):
                 if q.get("watch", ["0"])[0].lower() in ("1", "true"):
                     self._k8s_watch(kind, k8s_kind, q)
                 else:
-                    self._k8s_list(kind, k8s_kind)
+                    self._k8s_list(kind, k8s_kind, q)
                 return
             obj_route = _k8s_object_route(url.path)
             if obj_route is not None:
@@ -654,7 +723,14 @@ def make_handler(state: MockState):
                     pg = state.objects["podgroup"].get(key)
                     if pg is not None and body.get("phase"):
                         pg = dict(pg)
-                        pg["phase"] = body["phase"]
+                        # Store the FULL pushed status (a real apiserver
+                        # persists the whole subresource): the echo must
+                        # round-trip losslessly or the scheduler re-pushes
+                        # an apparently-changed status every session close.
+                        for fld in ("phase", "running", "succeeded",
+                                    "failed", "conditions"):
+                            if body.get(fld) is not None:
+                                pg[fld] = body[fld]
                         state.apply_locked("podgroup", "update", pg)
                 self._json({"ok": True})
                 return
@@ -770,11 +846,18 @@ def make_handler(state: MockState):
                     pg = state.objects["podgroup"].get(key)
                     if pg is not None and status.get("phase"):
                         pg = dict(pg)
+                        # Persist the whole status subresource (see the
+                        # /podgroup-status handler note): lossy storage
+                        # makes the echo perpetually "changed".
                         if isinstance(pg.get("metadata"), dict):
                             pg["status"] = dict(pg.get("status", {}))
-                            pg["status"]["phase"] = status["phase"]
+                            pg["status"].update(status)
                         else:
                             pg["phase"] = status["phase"]
+                            for fld in ("running", "succeeded", "failed",
+                                        "conditions"):
+                                if status.get(fld) is not None:
+                                    pg[fld] = status[fld]
                         state.apply_locked("podgroup", "update", pg)
                 self._json({"ok": True})
                 return
@@ -783,9 +866,18 @@ def make_handler(state: MockState):
     return Handler
 
 
+class _Server(ThreadingHTTPServer):
+    # The churn rig (docs/CHURN.md) floods the server with short-lived
+    # connections (urllib opens one per RPC); the http.server default
+    # listen backlog of 5 drops SYNs under that load and clients stall in
+    # connect.  A real apiserver listens far deeper.
+    request_queue_size = 128
+    daemon_threads = True
+
+
 def serve(port: int):
     state = MockState()
-    server = ThreadingHTTPServer(("127.0.0.1", port), make_handler(state))
+    server = _Server(("127.0.0.1", port), make_handler(state))
     return server, state
 
 

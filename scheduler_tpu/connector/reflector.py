@@ -45,6 +45,7 @@ from scheduler_tpu.connector.client import (
     ConnectorBase,
     TokenBucket,
     _get,
+    _get_sized,
 )
 from scheduler_tpu.connector.wire import (
     LIST_RESOURCES,
@@ -84,35 +85,109 @@ class Reflector:
         # streams keep flowing.
         self.dirty = False
         self.relists = 0  # replace-relists performed (evidence for tests)
+        # Ingest evidence (docs/INGEST.md "Field-selector relists"): every
+        # LIST this reflector paid, in bytes, plus the last relist's
+        # request-by-request breakdown.
+        self.relist_bytes = 0
+        self.last_relist: dict = {}
+        # Pod relists partition by spec.nodeName field selector so a 410
+        # recovery stops paying one full-cluster payload; a server that
+        # 400s the selector (pre-selector conformance targets) demotes this
+        # reflector to classic full relists permanently.
+        self.split_relists = kind == "pod"
 
     # -- LIST ----------------------------------------------------------------
 
     def list_and_replace(self) -> None:
         """LIST the resource; first call seeds, later calls REPLACE: upsert
         every listed object and prune cached ones the LIST no longer carries
-        (client-go store Replace — ghosts from the horizon gap die here)."""
+        (client-go store Replace — ghosts from the horizon gap die here).
+
+        Pod REPLACE relists partition the inventory with ``spec.nodeName``
+        field selectors (``_split_relist``) so 410 recovery pays two
+        partition payloads instead of one full-cluster body; the initial
+        seed stays a single LIST (nothing cached yet to prune, and the
+        dependency-ordered boot wants one request per resource)."""
+        replace = self.synced.is_set()
+        if replace and self.split_relists and self._split_relist():
+            return
         if self.conn.limiter is not None:
             # The full-inventory burst pays the shared QPS budget; the
             # watch stream below does not (client.connect_cache docstring).
             self.conn.limiter.acquire()
-        doc = _get(self.conn.base, self.path)
+        doc, nbytes = _get_sized(self.conn.base, self.path)
         items = doc.get("items", []) or []
         rv = obj_rv(doc)
-        replace = self.synced.is_set()
         op = "update" if replace else "add"
         # Clear the flag BEFORE applying (the journal wire's ordering): an
         # apply that diverges DURING this relist re-marks the resource dirty
         # and the run loop relists again — clearing afterwards would swallow
         # that divergence and resume watching over a known-bad cache.
         self.dirty = False
+        self.relist_bytes += nbytes
         for item in items:
             self.conn._apply(self.kind, op, item)
         if replace:
             self.conn._prune_kind(self.kind, items)
             self.relists += 1
+            self.last_relist = {
+                "split": False, "bytes": [nbytes], "items": [len(items)],
+            }
         if rv is not None:
             self.rv = rv
         self.synced.set()
+
+    def _split_relist(self) -> bool:
+        """Partitioned pod REPLACE: LIST ``spec.nodeName!=`` (assigned)
+        then ``spec.nodeName=`` (unassigned), each applied and pruned
+        WITHIN its own partition (``prune_absent(pod_scope=...)``) — a
+        partition LIST is only authoritative about its own partition.
+
+        Assigned first: a pod bound during the horizon gap appears in the
+        assigned LIST and upserts to bound BEFORE the unassigned partition
+        is pruned, so it can never be transiently deleted.  The cursor
+        advances to the FIRST list's resourceVersion — events landing
+        between the two LISTs replay on reconnect, and replays are
+        idempotent; resuming from the second RV would skip them.
+
+        Returns False (caller falls back to the classic full relist) when
+        the server rejects the field selector — the selector demotion is
+        permanent for this reflector."""
+        try:
+            if self.conn.limiter is not None:
+                self.conn.limiter.acquire()
+            sel = f"{self.path}?fieldSelector=spec.nodeName"
+            self.dirty = False
+            doc_a, bytes_a = _get_sized(self.conn.base, sel + "%21%3D")  # !=
+            if self.conn.limiter is not None:
+                self.conn.limiter.acquire()
+            doc_u, bytes_u = _get_sized(self.conn.base, sel + "%3D")  # =
+        except urllib.error.HTTPError as e:
+            if e.code == 400:
+                logger.warning(
+                    "%s server rejects spec.nodeName field selectors; "
+                    "falling back to full relists", self.kind,
+                )
+                self.split_relists = False
+                return False
+            raise
+        rv = obj_rv(doc_a)
+        for doc, scope in ((doc_a, "assigned"), (doc_u, "unassigned")):
+            items = doc.get("items", []) or []
+            for item in items:
+                self.conn._apply(self.kind, "update", item)
+            self.conn._prune_kind(self.kind, items, pod_scope=scope)
+        self.relists += 1
+        self.relist_bytes += bytes_a + bytes_u
+        self.last_relist = {
+            "split": True, "bytes": [bytes_a, bytes_u],
+            "items": [len(doc_a.get("items") or []),
+                      len(doc_u.get("items") or [])],
+        }
+        if rv is not None:
+            self.rv = rv
+        self.synced.set()
+        return True
 
     # -- WATCH ---------------------------------------------------------------
 
@@ -239,15 +314,21 @@ class K8sApiConnector(ConnectorBase):
         else:  # unknown kind: cannot scope the damage
             self._dirty = True
 
-    def _prune_kind(self, kind: str, items: list) -> None:
+    def _prune_kind(self, kind: str, items: list,
+                    pod_scope: Optional[str] = None) -> None:
         """Replace semantics for ONE kind: everything cached but absent from
         the fresh LIST is a ghost.  Uses the cache's relist reconciler with
         only this kind's survivor set (None == kind untouched); the pod set
         keys by wire uid — the SAME identity rule ``parse_pod`` uses
-        (wire.pod_uid), or live pods would be pruned as ghosts."""
+        (wire.pod_uid), or live pods would be pruned as ghosts.
+        ``pod_scope`` narrows a pod prune to one spec.nodeName partition
+        (the split-relist path — a partition LIST must not prune the other
+        partition's pods)."""
         kw = {}
         if kind == "pod":
             kw["pod_uids"] = {pod_uid(p) for p in items}
+            if pod_scope is not None:
+                kw["pod_scope"] = pod_scope
         elif kind == "node":
             kw["node_names"] = {obj_name(n) for n in items}
         elif kind == "podgroup":

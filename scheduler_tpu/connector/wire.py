@@ -274,6 +274,41 @@ def encode_affinity(a: Optional[Affinity]) -> Optional[Dict]:
     }
 
 
+def _parse_pg_condition(c: Dict):
+    """One wire PodGroup condition.  Fidelity matters: the scheduler's OWN
+    status pushes echo back through the watch stream, and a lossy parse
+    (dropping message/transitionID) would make every close-time status diff
+    read "changed" and re-push — a self-sustaining event loop under
+    event-triggered cycles (docs/CHURN.md)."""
+    from scheduler_tpu.apis.objects import PodGroupCondition
+
+    ts = c.get("lastTransitionTime")
+    if isinstance(ts, (int, float)):
+        when = float(ts)
+    else:
+        when = _parse_k8s_time(ts) or 0.0
+    return PodGroupCondition(
+        type=str(c.get("type", "")),
+        status=str(c.get("status", "True")),
+        reason=str(c.get("reason", "")),
+        message=str(c.get("message", "")),
+        transition_id=str(c.get("transitionID", "")),
+        last_transition_time=when,
+    )
+
+
+def _parse_pg_status(pg: PodGroup, status: Dict) -> None:
+    """Status fields shared by both dialects (phase handled by callers —
+    the compact dialect carries it at top level)."""
+    for key in ("running", "succeeded", "failed"):
+        if status.get(key) is not None:
+            setattr(pg.status, key, int(status[key]))
+    if status.get("conditions"):
+        pg.status.conditions = [
+            _parse_pg_condition(c) for c in status["conditions"]
+        ]
+
+
 def parse_pod_group(g: Dict) -> PodGroup:
     if _is_k8s(g):
         meta, spec, status = g["metadata"], g.get("spec", {}), g.get("status", {})
@@ -295,6 +330,7 @@ def parse_pod_group(g: Dict) -> PodGroup:
             pg.creation_timestamp = ts
         if status.get("phase"):
             pg.status.phase = status["phase"]
+        _parse_pg_status(pg, status)
         if spec.get("priorityClassName"):
             pg.priority_class_name = spec["priorityClassName"]
         return pg
@@ -307,6 +343,7 @@ def parse_pod_group(g: Dict) -> PodGroup:
     )
     if g.get("phase"):
         pg.status.phase = g["phase"]
+    _parse_pg_status(pg, g)
     if g.get("priorityClassName"):
         pg.priority_class_name = g["priorityClassName"]
     return pg

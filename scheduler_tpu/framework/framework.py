@@ -31,10 +31,17 @@ def open_session(cache, tiers: List[Tier]) -> Session:
     snapshot = cache.snapshot()
     ssn.jobs = snapshot.jobs
     for job in ssn.jobs.values():
-        if job.pod_group is not None and job.pod_group.status.conditions:
+        # EVERY job's snapshot-time status (reference openSession,
+        # session.go:98-101) — the close-time JobUpdater diffs against this
+        # map, and a job missing from it is pushed unconditionally; the old
+        # conditions-only filter made every condition-less job pay a status
+        # RPC per cycle, which at event-triggered cycle rates is a steady
+        # RPC flood for unchanged statuses (docs/CHURN.md).
+        if job.pod_group is not None:
             ssn.pod_group_status[job.uid] = job.pod_group.status.clone()
     ssn.nodes = snapshot.nodes
     ssn.node_generation = getattr(snapshot, "node_generation", -1)
+    ssn.dirty_epoch = getattr(snapshot, "dirty_epoch", -1)
     ssn.queues = snapshot.queues
 
     for tier in tiers:

@@ -136,6 +136,9 @@ class Session:
         # The cache's node-spec generation captured AT SNAPSHOT TIME
         # (open_session); -1 = unknown (bare Session in tests).
         self.node_generation: int = -1
+        # The cache's dirty-set epoch captured AT SNAPSHOT TIME (same rule;
+        # docs/CHURN.md "Dirty-set plumbing"); -1 = unknown -> full diff.
+        self.dirty_epoch: int = -1
 
     # -- registration (Add*Fn) ----------------------------------------------
 

@@ -92,6 +92,22 @@ _ENV_KEYS = (
     "SCHEDULER_TPU_LP_TAU",
     "SCHEDULER_TPU_LP_TOL",
     "SCHEDULER_TPU_LP_LIMIT",
+    # Cycle pacing (utils/trigger.py, docs/CHURN.md).  Never read by the
+    # engine build itself, but registered — like SCHEDULER_TPU_WIRE — so a
+    # resident engine is pinned to the pacing regime it was diagnosed under:
+    # the event-vs-period parity contract says pacing never changes binds,
+    # and keying here means a violation can never hide behind a warm cache
+    # across a flag flip mid-process (tests flip these).
+    "SCHEDULER_TPU_TRIGGER",
+    "SCHEDULER_TPU_DEBOUNCE_MS",
+    "SCHEDULER_TPU_TRIGGER_MIN_MS",
+    "SCHEDULER_TPU_TRIGGER_MAX_MS",
+    # Dirty-set sparse refresh kill-switch (ops/fused.py _refresh_dynamic,
+    # docs/CHURN.md "Dirty-set plumbing"): selects which hit-path refresh
+    # runs against a resident engine — full-tensor diff vs dirty-row
+    # scatter.  Both are content-exact, but a resident diagnosed under one
+    # regime must not silently straddle a flip.
+    "SCHEDULER_TPU_DIRTY_DELTA",
 )
 
 _scope_counter = itertools.count(1)

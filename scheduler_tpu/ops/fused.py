@@ -144,6 +144,19 @@ def _queue_delta_enabled() -> bool:
 
     return env_bool("SCHEDULER_TPU_QUEUE_DELTA", True)
 
+
+def _dirty_delta_enabled() -> bool:
+    """Kill-switch for the dirty-set sparse refresh on the engine-cache hit
+    path (docs/CHURN.md "Dirty-set plumbing"): ``SCHEDULER_TPU_DIRTY_DELTA=0``
+    restores the full-tensor content diff.  Both paths are content-exact —
+    the dirty sets are a superset of real changes and every marked row is
+    still value-compared before it ships — so this is an A/B lever, not a
+    correctness knob.  Registered in ``engine_cache._ENV_KEYS``."""
+    from scheduler_tpu.utils.envflags import env_bool
+
+    return env_bool("SCHEDULER_TPU_DIRTY_DELTA", True)
+
+
 # Comparators the fused job-selection chain understands, keyed by plugin name.
 _KNOWN_JOB_ORDER = ("priority", "gang", "drf")
 
@@ -1387,6 +1400,12 @@ class FusedAllocator:
             "task_count": state.task_count,
         }
         self._dyn_owned = {"idle": False, "releasing": False, "task_count": False}
+        # Dirty-set refresh state (docs/CHURN.md): the cache epoch whose
+        # content the resident host copies mirror — the next hit asks the
+        # cache for exactly the node rows dirtied after it — plus the lazy
+        # name->engine-row index the sparse path scatters through.
+        self._refresh_epoch = getattr(ssn, "dirty_epoch", -1)
+        self._node_index: Optional[dict] = None
         self._host_queue_fair = (queue_deserved, queue_alloc)
         self._mega_qpack = None  # set by _prepare_mega in multi-queue mode
         # The XLA program's argument tuple is built LAZILY: when the mega
@@ -1942,7 +1961,17 @@ class FusedAllocator:
         fair-share rows) from the new session's ledger.  Returns False when
         the refresh cannot preserve the traced program — releasing capacity
         appearing/disappearing changes which arms fold away at trace time —
-        in which case the caller cold-rebuilds."""
+        in which case the caller cold-rebuilds.
+
+        Two node paths (docs/CHURN.md "Dirty-set plumbing"): when the cache
+        can name the nodes dirtied since this engine's last refresh epoch,
+        only those rows are gathered, content-compared and scattered (the
+        churn steady state: a handful of rows out of 10k+); otherwise —
+        kill-switch off, unknown epochs, dirty-map overflow, releasing
+        session, or a dirty set wide enough that the vectorized diff wins —
+        the pre-existing full-tensor diff runs.  Both are content-exact."""
+        from scheduler_tpu.utils import phases
+
         led = getattr(ssn.nodes, "ledger", None)
         if led is None:
             return False
@@ -1952,27 +1981,42 @@ class FusedAllocator:
         order = led.sorted_rows()
         if len(order) != len(self.node_names):
             return False  # key pins node count; paranoia against drift
-        idle = led.idle[order][:, :r]
-        releasing = led.releasing[order][:, :r]
-        task_count = led.task_count[order].astype(np.int32)
-        if bool(np.any(releasing)) != self.has_releasing:
-            return False
-        nb = self.n_bucket
         scale = self._scale
-        node_changed = self._refresh_buffer(
-            "idle", pad_rows(scale_columns(idle, scale), nb)
-        )
-        node_changed |= self._refresh_buffer(
-            "releasing", pad_rows(scale_columns(releasing, scale), nb)
-        )
-        node_changed |= self._refresh_buffer(
-            "task_count", pad_rows(task_count, nb)
-        )
-        # Keep the host snapshot serving post-build readers too.
-        self.st.nodes.idle = idle
-        self.st.nodes.releasing = releasing
-        self.st.nodes.used = led.used[order][:, :r]
-        self.st.nodes.task_count = task_count
+        evidence = {"mode": "full", "dirty_nodes": -1, "rows_scattered": -1}
+        dirty = self._dirty_node_set(ssn)
+        handled = False
+        node_changed = False
+        if dirty is not None:
+            evidence.update(
+                mode="sparse", dirty_nodes=len(dirty), rows_scattered=0
+            )
+            handled, node_changed = self._refresh_nodes_sparse(
+                led, dirty, r, evidence
+            )
+        if not handled:
+            evidence.update(mode="full", dirty_nodes=-1, rows_scattered=-1)
+            idle = led.idle[order][:, :r]
+            releasing = led.releasing[order][:, :r]
+            task_count = led.task_count[order].astype(np.int32)
+            if bool(np.any(releasing)) != self.has_releasing:
+                return False
+            nb = self.n_bucket
+            node_changed = self._refresh_buffer(
+                "idle", pad_rows(scale_columns(idle, scale), nb)
+            )
+            node_changed |= self._refresh_buffer(
+                "releasing", pad_rows(scale_columns(releasing, scale), nb)
+            )
+            node_changed |= self._refresh_buffer(
+                "task_count", pad_rows(task_count, nb)
+            )
+            # Keep the host snapshot serving post-build readers too.
+            self.st.nodes.idle = idle
+            self.st.nodes.releasing = releasing
+            self.st.nodes.used = led.used[order][:, :r]
+            self.st.nodes.task_count = task_count
+        phases.note("dirty", evidence)
+        self._refresh_epoch = getattr(ssn, "dirty_epoch", -1)
 
         queue_changed = False
         if self.queue_comparators or self.overused_gate:
@@ -1992,6 +2036,118 @@ class FusedAllocator:
                 queue_changed = True
         if node_changed or queue_changed:
             self._rewire_args(queue_changed)
+        return True
+
+    # Dirty sets wider than nodes/RATIO take the full vectorized diff: three
+    # whole-array compares beat that many per-row gathers.  Module-level so
+    # the parity suite can force either path on small fixtures.
+    SPARSE_DIRTY_RATIO = 8
+
+    def _dirty_node_set(self, ssn):
+        """Node names dirtied since this engine's last refresh, or ``None``
+        when the sparse path must not run: kill-switch off, a releasing
+        session (the all-zero invariant the sparse releasing check relies on
+        doesn't hold), unknown epochs (bare sessions, pre-dirty-set caches),
+        dirty-map overflow, or a dirty set wide enough that three vectorized
+        full-array compares beat per-row gathers."""
+        if not _dirty_delta_enabled() or self.has_releasing:
+            return None
+        if self._refresh_epoch < 0 or getattr(ssn, "dirty_epoch", -1) < 0:
+            return None
+        fn = getattr(getattr(ssn, "cache", None), "dirty_nodes_since", None)
+        if fn is None:
+            return None
+        dirty = fn(self._refresh_epoch)
+        if dirty is None or \
+                len(dirty) * self.SPARSE_DIRTY_RATIO > len(self.node_names):
+            return None
+        return dirty
+
+    def _refresh_nodes_sparse(self, led, dirty, r: int, evidence: dict):
+        """Refresh exactly the dirtied node rows.  Returns ``(handled,
+        node_changed)``; ``handled`` False means the caller must run the
+        full-tensor path (e.g. releasing capacity appeared — only the full
+        path's any() check may decide the rebuild)."""
+        if not dirty:
+            return True, False
+        index = self._node_index
+        if index is None:
+            index = self._node_index = {
+                name: i for i, name in enumerate(self.node_names)
+            }
+        eng_rows, led_rows = [], []
+        for name in sorted(dirty):  # deterministic scatter order
+            i = index.get(name)
+            row = led.row_of.get(name)
+            if i is None or row is None:
+                # A node added or removed around this snapshot: the node
+                # generation moved and the layout token with it, so the
+                # caller rebuilds this cycle or the next; a name the frozen
+                # ledger never saw contributes nothing to refresh.
+                continue
+            eng_rows.append(i)
+            led_rows.append(row)
+        if not eng_rows:
+            return True, False
+        eng = np.asarray(eng_rows, dtype=np.int64)
+        rows = np.asarray(led_rows, dtype=np.int64)
+        releasing = led.releasing[rows][:, :r]
+        if np.any(releasing):
+            return False, False  # releasing appeared: full path decides
+        scale = self._scale
+        idle = led.idle[rows][:, :r]
+        task_count = led.task_count[rows].astype(np.int32)
+        changed = self._refresh_rows(
+            "idle", eng, scale_columns(idle, scale), evidence
+        )
+        changed |= self._refresh_rows(
+            "releasing", eng, scale_columns(releasing, scale), evidence
+        )
+        changed |= self._refresh_rows("task_count", eng, task_count, evidence)
+        # Keep the host snapshot serving post-build readers in step (the
+        # full path rebuilds these arrays wholesale; row writes suffice
+        # here — engine row i IS sorted position i on both sides).
+        self.st.nodes.idle[eng] = idle
+        self.st.nodes.releasing[eng] = releasing
+        self.st.nodes.used[eng] = led.used[rows][:, :r]
+        self.st.nodes.task_count[eng] = task_count
+        return True, changed
+
+    def _refresh_rows(
+        self, name: str, eng_rows: np.ndarray, new_vals, evidence: dict
+    ) -> bool:
+        """Sparse twin of ``_refresh_buffer``: content-compare ONLY the
+        dirty rows and scatter the changed subset into the resident buffer.
+        The host copy updates in place, so it stays the authoritative
+        content mirror the next refresh (sparse or full) diffs against."""
+        host = self._host_dyn[name]
+        new_vals = np.asarray(new_vals, dtype=host.dtype)
+        cur = host[eng_rows]
+        diff = cur != new_vals
+        changed = np.nonzero(diff.any(axis=1) if new_vals.ndim == 2 else diff)[0]
+        if changed.shape[0] == 0:
+            return False
+        rows = eng_rows[changed]
+        host[rows] = new_vals[changed]
+        evidence["rows_scattered"] += int(rows.shape[0])
+        dev = self._dyn_dev[name]
+        if (self._mesh is None and self._dyn_owned[name]
+                and rows.shape[0] * 4 <= host.shape[0]):
+            # Same stable-compile-key padding rule as _refresh_buffer.
+            cap = bucket(rows.shape[0], minimum=8)
+            idx = np.concatenate(
+                [rows, np.full(cap - rows.shape[0], rows[-1], dtype=rows.dtype)]
+            )
+            scatter = _scatter_rows_donated if _donation_ok() else _scatter_rows
+            dev = scatter(dev, jnp.asarray(idx), jnp.asarray(host[idx]))
+        else:
+            # First change of a shared transfer-cache resident (the engine
+            # must take ownership before any donated scatter), a mesh
+            # engine, or wide churn: wholesale re-upload of the updated host
+            # copy at the resident placement.
+            dev = jax.device_put(host, self._dyn_sharding(name))
+        self._dyn_owned[name] = True
+        self._dyn_dev[name] = dev
         return True
 
     def _refresh_buffer(self, name: str, new_host: np.ndarray) -> bool:

@@ -35,10 +35,35 @@ class Scheduler:
         scheduler_conf: Optional[str] = None,
         schedule_period: float = 1.0,
         profile_dir: Optional[str] = None,
+        trigger=None,
+        record_cycles: bool = False,
     ) -> None:
         self.cache = cache
         self.scheduler_conf = scheduler_conf
         self.schedule_period = schedule_period
+        # Event-triggered pacing (docs/CHURN.md): SCHEDULER_TPU_TRIGGER=event
+        # blocks each cycle on the connector's watch-event trigger instead of
+        # the fixed tick; ``trigger`` injects a prebuilt CycleTrigger (tests,
+        # the churn bench), else run() builds one from the environment.  The
+        # default ``period`` path below is the pre-existing loop, untouched.
+        self.trigger = trigger
+        # Per-cycle evidence recording for measurement protocols (the churn
+        # bench): each run_once appends {s, t, events, phases, notes} to
+        # ``cycle_log``.  Off in production — phases stays passive.
+        self.record_cycles = record_cycles
+        self.cycle_log: List[dict] = []
+        self._loop_started: Optional[float] = None
+        self._last_events = 0  # events the current cycle consumed
+        # GC-freeze pacing: the period loop collects at the head of EVERY
+        # cycle (cycles are a schedule period apart); the event loop may fire
+        # cycles every few ms, where a full collect per cycle would dominate
+        # the latency budget — it rate-limits the freeze protocol instead.
+        self._gc_every_cycle = True
+        self._gc_min_interval = 1.0
+        self._last_gc = float("-inf")
+        # True while a cycle is executing — measurement rigs poll it (with
+        # the trigger's pending count) to detect a drained scheduler.
+        self.in_cycle = False
         # xprof trace directory (SURVEY.md §5: JAX profiler traces around the
         # session kernel).  Only the first PROFILE_CYCLES cycles are traced —
         # one compiling cycle plus steady-state samples — each into its own
@@ -58,15 +83,28 @@ class Scheduler:
         self.actions = [get_action(name) for name in self.conf.actions]
 
     def run(self, stop: Optional[threading.Event] = None) -> None:
-        """Start the cache and tick run_once every period until ``stop`` is set
-        (the reference's ``wait.Until(runOnce, period)``, scheduler.go:85)."""
+        """Start the cache and run cycles until ``stop`` is set.
+
+        ``SCHEDULER_TPU_TRIGGER=period`` (default) ticks run_once every
+        schedule period — the reference's ``wait.Until(runOnce, period)``
+        (scheduler.go:85), byte-for-byte the pre-existing loop.
+        ``SCHEDULER_TPU_TRIGGER=event`` blocks on the connector's cycle
+        trigger instead: watch events coalesce through a debounce window and
+        min/max-interval clamps (utils/trigger.py, docs/CHURN.md)."""
+        from scheduler_tpu.utils.trigger import trigger_mode_from_env
+
         stop = stop or threading.Event()
         self.cache.run()
         self._load_conf()
+        mode = "event" if self.trigger is not None else trigger_mode_from_env()
         logger.info(
-            "scheduler running: actions=%s period=%.3fs",
-            [a.name() for a in self.actions], self.schedule_period,
+            "scheduler running: actions=%s period=%.3fs trigger=%s",
+            [a.name() for a in self.actions], self.schedule_period, mode,
         )
+        self._loop_started = time.perf_counter()
+        if mode == "event":
+            self._run_event_loop(stop)
+            return
         while not stop.is_set():
             started = time.perf_counter()
             try:
@@ -75,6 +113,42 @@ class Scheduler:
                 logger.exception("scheduling cycle failed")
             elapsed = time.perf_counter() - started
             stop.wait(max(0.0, self.schedule_period - elapsed))
+
+    def _run_event_loop(self, stop: threading.Event) -> None:
+        """Event-triggered cycles: block on the trigger, consume the
+        coalesced event batch, run one cycle.  A wait that expires without
+        events (the max-interval clamp) still runs a full rescan cycle — the
+        quiet-cluster drift heal the periodic loop provided."""
+        from scheduler_tpu.utils.trigger import CycleTrigger
+
+        trigger = self.trigger
+        if trigger is None:
+            trigger = self.trigger = CycleTrigger.from_env(
+                default_max_interval=self.schedule_period
+            )
+        # Wire the trigger into the connector's _apply seam (both inbound
+        # protocols share it).  A cache without a connector client (tests,
+        # synthetic harnesses) still cycles at the max-interval fallback.
+        client = self.cache.client()
+        if client is not None and hasattr(client, "set_trigger"):
+            client.set_trigger(trigger)
+        else:
+            logger.warning(
+                "trigger=event without a connector client: cycles fall back "
+                "to the max-interval rescan cadence"
+            )
+        self._gc_every_cycle = False
+        while not stop.is_set():
+            consumed = trigger.wait(stop)
+            if stop.is_set():
+                return
+            self._last_events = consumed
+            try:
+                self.run_once()
+            except Exception:
+                logger.exception("scheduling cycle failed")
+            finally:
+                self._last_events = 0
 
     # GC protocol shared with harness/measure.py so the benchmark measures
     # the production cycle: collect at the HEAD of each cycle (inside the
@@ -123,9 +197,26 @@ class Scheduler:
 
     def _run_once_inner(self) -> None:
         freeze = self._gc_freeze_enabled()
+        if freeze and not self._gc_every_cycle:
+            # Event-triggered cycles can fire every few milliseconds; a full
+            # collect per cycle would dominate the latency budget, so the
+            # freeze protocol rate-limits itself to its period-loop cadence.
+            freeze = (
+                time.perf_counter() - self._last_gc >= self._gc_min_interval
+            )
+        recording = self.record_cycles
+        if recording:
+            from scheduler_tpu.utils import phases
+
+            phases.begin()
+        # BEFORE the GC block: measurement rigs poll (trigger drained AND
+        # not in_cycle), and a collect over a large cached heap could span
+        # their whole double-check window — the flag must cover it.
+        self.in_cycle = True
         if freeze:
             gc.collect()
             gc.freeze()
+            self._last_gc = time.perf_counter()
         try:
             start = time.perf_counter()
             ssn = open_session(self.cache, self.conf.tiers)
@@ -138,7 +229,23 @@ class Scheduler:
                     )
             finally:
                 close_session(ssn)
-            metrics.update_e2e_duration(time.perf_counter() - start)
+            elapsed = time.perf_counter() - start
+            metrics.update_e2e_duration(elapsed)
         finally:
+            self.in_cycle = False
             if freeze:
                 gc.unfreeze()
+            if recording:
+                from scheduler_tpu.utils import phases
+
+                notes = phases.take_notes()
+                rec = phases.end()
+                base = self._loop_started
+                self.cycle_log.append({
+                    "s": time.perf_counter() - start,
+                    "t": (start - base) if base is not None else 0.0,
+                    "events": self._last_events,
+                    "gc": freeze,
+                    "phases": rec,
+                    "notes": notes,
+                })

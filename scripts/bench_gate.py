@@ -14,6 +14,16 @@ a directory given as argv[1]):
   (exit 1), and two XL rounds with DIFFERENT topologies are not compared
   at all (the round-4 "different backend, not comparable" failure mode,
   machine-caught);
+* ``BENCH_CHURN_r*.json`` — the event-driven churn scenario
+  (``bench.py --churn``, docs/CHURN.md).  LOWER is better (the metric is
+  p99 cycle latency in ms), so this family gates through its own
+  comparator: the newest artifact's p99 more than 10% ABOVE the previous
+  round's fails (same shape — nodes/placed pods/target rate — required;
+  different shapes are not compared), and independently of any previous
+  round the artifact's engine-cache hit rate must not sit below the floor
+  the artifact itself records (``detail.hit_rate_floor``, stamped at
+  emission) — a collapse of the delta path is a regression even when the
+  latency survives it.  Missing churn fields = malformed (exit 1);
 * ``BENCH_LP_r*.json``  — the LP-relaxed allocator flagship
   (``SCHEDULER_TPU_ALLOCATOR=lp``, docs/LP_PLACEMENT.md).  LP artifacts
   must record ``detail.allocator == "lp"`` (else malformed, exit 1), and
@@ -54,12 +64,27 @@ TOLERANCE = 0.10
 # less than the artifact itself trusts.
 MIN_HEALTHY = 3
 
-_ROUND_RE = re.compile(r"BENCH(_MQ|_XL|_LP)?_r(\d+)\.json$")
+_ROUND_RE = re.compile(r"BENCH(_MQ|_XL|_LP|_CHURN)?_r(\d+)\.json$")
 
-# (family label, filename infix) — the artifact naming contract.
+# (family label, filename infix) — the artifact naming contract.  The churn
+# family is NOT listed here: its metric is latency (lower is better) with
+# its own comparator and malformedness rules, gated by gate_churn below.
 FAMILIES = (
     ("single-queue", ""), ("two-queue", "_MQ"), ("xl-multi-host", "_XL"),
     ("lp-allocator", "_LP"),
+)
+
+# Churn-family policy: the newest p99 may sit at most this fraction ABOVE
+# the previous round's before the gate fails (the latency mirror of the
+# 10% pods/s TOLERANCE above).
+CHURN_TOLERANCE = 0.10
+
+# detail keys every churn artifact must carry, with their types (int is
+# acceptable wherever float is — JSON round numbers decay).
+_CHURN_KEYS = (
+    ("p99_ms", (int, float)), ("hit_rate", (int, float)),
+    ("hit_rate_floor", (int, float)), ("rate_sustained", (int, float)),
+    ("cycles_measured", int),
 )
 
 # LP may bind up to this fraction fewer pods than greedy on the same shape
@@ -203,6 +228,97 @@ def gate_lp_vs_greedy(root: Path) -> int:
     return 2 if lp_binds < floor else 0
 
 
+def _churn_detail(path: Path):
+    """The churn artifact's detail block, or a (None, reason) pair when it
+    is malformed — missing churn fields mean the artifact cannot defend a
+    latency claim at all."""
+    doc = _unwrap(json.loads(path.read_text()))
+    detail = doc.get("detail", {})
+    if detail.get("family") != "churn":
+        return None, f"{path.name} does not record detail.family == 'churn'"
+    for key, typ in _CHURN_KEYS:
+        if not isinstance(detail.get(key), typ):
+            return None, (
+                f"{path.name} is missing churn field detail.{key} — "
+                "re-emit via bench.py --churn"
+            )
+    return detail, None
+
+
+def _churn_shape(detail: dict):
+    """The scenario two churn artifacts must share to be compared."""
+    return (
+        detail.get("nodes"), detail.get("placed_pods"),
+        detail.get("rate_target"),
+    )
+
+
+def gate_churn(root: Path) -> int:
+    """Gate the ``BENCH_CHURN_r*.json`` family (docs/CHURN.md): LOWER is
+    better, so the regression check inverts — newest p99 above
+    ``(1 + CHURN_TOLERANCE) x`` the previous round's fails (same scenario
+    shape required); and the newest artifact's engine-cache hit rate below
+    its OWN recorded floor fails regardless of history (the floor is
+    policy stamped at emission — a delta-path collapse must not hide
+    behind a still-acceptable p99).  Exit codes as main()."""
+    artifacts = find_artifacts(root, "_CHURN")
+    if not artifacts:
+        print("bench-gate[churn]: no BENCH_CHURN_r*.json; nothing to judge")
+        return 0
+    try:
+        new_detail, why = _churn_detail(artifacts[-1])
+    except json.JSONDecodeError as err:
+        print(f"bench-gate[churn]: malformed artifact "
+              f"{artifacts[-1].name}: {err}")
+        return 1
+    if new_detail is None:
+        print(f"bench-gate[churn]: {why}")
+        return 1
+    worst = 0
+    hit, floor = new_detail["hit_rate"], new_detail["hit_rate_floor"]
+    if hit < floor:
+        print(
+            f"bench-gate[churn]: {artifacts[-1].name} engine-cache hit rate "
+            f"{hit:.3f} below its own recorded floor {floor:.3f}: "
+            "HIT-RATE REGRESSION"
+        )
+        worst = 2
+    else:
+        print(
+            f"bench-gate[churn]: {artifacts[-1].name} hit rate {hit:.3f} "
+            f">= floor {floor:.3f}: ok"
+        )
+    if len(artifacts) < 2:
+        print(f"bench-gate[churn]: one artifact; no p99 round to compare")
+        return worst
+    try:
+        prev_detail, why = _churn_detail(artifacts[-2])
+    except json.JSONDecodeError as err:
+        print(f"bench-gate[churn]: malformed artifact "
+              f"{artifacts[-2].name}: {err}")
+        return 1
+    if prev_detail is None:
+        print(f"bench-gate[churn]: {why}")
+        return 1
+    if _churn_shape(prev_detail) != _churn_shape(new_detail):
+        print(
+            f"bench-gate[churn]: {artifacts[-2].name} "
+            f"{_churn_shape(prev_detail)} and {artifacts[-1].name} "
+            f"{_churn_shape(new_detail)} ran different scenario shapes; "
+            "not comparable (no verdict)"
+        )
+        return worst
+    prev_p99, new_p99 = prev_detail["p99_ms"], new_detail["p99_ms"]
+    ceiling = (1.0 + CHURN_TOLERANCE) * prev_p99
+    verdict = "REGRESSION" if new_p99 > ceiling else "ok"
+    print(
+        f"bench-gate[churn]: {artifacts[-2].name} p99 {prev_p99:,.1f}ms -> "
+        f"{artifacts[-1].name} {new_p99:,.1f}ms (ceiling {ceiling:,.1f}ms): "
+        f"{verdict}"
+    )
+    return max(worst, 2 if new_p99 > ceiling else 0)
+
+
 def gate_family(root: Path, label: str, infix: str) -> int:
     """Gate one artifact family; same exit-code contract as main()."""
     artifacts = find_artifacts(root, infix)
@@ -257,10 +373,10 @@ def gate_family(root: Path, label: str, infix: str) -> int:
 
 def main(argv) -> int:
     root = Path(argv[1]) if len(argv) > 1 else Path(__file__).resolve().parent.parent
-    # Gate every family, then the LP-vs-greedy quality check; report all
-    # verdicts, exit on the worst.
+    # Gate every family, then the LP-vs-greedy quality check and the churn
+    # latency family; report all verdicts, exit on the worst.
     worst = max(gate_family(root, label, infix) for label, infix in FAMILIES)
-    return max(worst, gate_lp_vs_greedy(root))
+    return max(worst, gate_lp_vs_greedy(root), gate_churn(root))
 
 
 if __name__ == "__main__":

@@ -1,8 +1,17 @@
 """Phase breakdown of one full-scale allocate cycle (host vs device vs apply).
 
 Usage: PYTHONPATH=/root/repo:$PYTHONPATH python scripts/profile_cycle.py \
-    [nodes] [pods] [queues] [--allocator {greedy,lp}]
+    [nodes] [pods] [queues] [--allocator {greedy,lp}] [--churn]
 (APPEND to PYTHONPATH — TPU hosts carry the axon backend's site dir in it.)
+
+``--churn`` profiles the event-driven serving cycle instead of the cold
+batch cycle (docs/CHURN.md): a mostly-placed cluster (``pods`` = placed
+pods on ``nodes`` hollow nodes), a resident warmed engine, then a sequence
+of seeded churn batches — each applied to the cache and followed by one
+timed cycle — printing the event-batch size, the dirty-set counts
+(nodes/jobs/queues since the previous cycle), the refresh mode and
+scattered-row count, and the engine-cache outcome per cycle alongside the
+phase split, plus the run's aggregate hit rate.
 
 ``--allocator lp`` profiles the LP-relaxed flavor (docs/LP_PLACEMENT.md):
 sets ``SCHEDULER_TPU_ALLOCATOR`` for the run and splits the device phase
@@ -118,8 +127,73 @@ def run(n_nodes: int, n_pods: int, label: str, n_queues: int = 1) -> None:
     print(f"  TOTAL               {t7 - t0:8.3f}s")
 
 
+def run_churn(n_nodes: int, n_placed: int, batch: int = 250,
+              cycles: int = 10) -> None:
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.harness.churn import (
+        CHURN_CONF, ChurnConfig, apply_history_to_cache, make_history,
+        seed_cache,
+    )
+    from scheduler_tpu.harness.measure import timed_cycle_phases, warm_engine
+
+    cfg = ChurnConfig(nodes=n_nodes, placed_pods=n_placed,
+                      pending_pods=32, rate=float(batch), duration_s=1.0,
+                      lifetime_s=3.0)
+    conf = parse_scheduler_conf(CHURN_CONF)
+    cache = seed_cache(cfg)
+    cache.run()
+    warm_engine(cache, conf)
+    # Cycle 0 places the seeded backlog (rebuild); then churn BATCH cycles
+    # (arrivals move the pending layout: rebuilds) each followed by TWO
+    # SETTLE cycles — the first still rebuilds (the batch cycle's own binds
+    # moved the pending set), the second is the engine-cache HIT path,
+    # delta-scattering exactly the rows the binds dirtied.
+    outcomes = []
+    print(f"[churn] nodes={n_nodes} placed={n_placed} "
+          f"batch~{batch} events/batch-cycle")
+    for i in range(cycles):
+        epoch = cache._dirty_epoch
+        applied = 0
+        kind = "backlog"
+        if i > 0:
+            kind = "batch" if i % 3 == 1 else "settle"
+        if kind == "batch":
+            applied = apply_history_to_cache(
+                cache, make_history(cfg, tag=f"p{i}")
+            )
+        elapsed, ph = timed_cycle_phases(cache, conf, ("allocate",))
+        notes = ph.get("notes", {})
+        dirty_counts = cache.dirty_counts_since(epoch)
+        status = notes.get("engine_cache", "?")
+        outcomes.append((kind, status))
+        dirty = notes.get("dirty", {})
+        print(f"  cycle {i} ({kind:7s}): {elapsed * 1000:8.1f}ms  "
+              f"events={applied:4d}  engine_cache={status:<8s} "
+              f"dirty(nodes={dirty_counts['nodes']},"
+              f"jobs={dirty_counts['jobs']},"
+              f"queues={dirty_counts['queues']})  "
+              f"refresh={dirty.get('mode', '-')}"
+              f"/rows={dirty.get('rows_scattered', -1)}")
+        keys = ("open", "engine_init", "dispatch", "device", "decode",
+                "apply", "close", "overlap_host")
+        split = "  ".join(
+            f"{k}={ph[k] * 1000:.1f}ms" for k in keys if k in ph
+        )
+        print(f"             {split}")
+    judged = [s for _, s in outcomes[1:] if s != "?"]
+    hits = sum(1 for s in judged if s == "hit")
+    rate = hits / len(judged) if judged else 0.0
+    print(f"  hit rate over churn cycles: {hits}/{len(judged)} ({rate:.2f})")
+
+
 if __name__ == "__main__":
     argv = list(sys.argv[1:])
+    if "--churn" in argv:
+        argv.remove("--churn")
+        n_nodes = int(argv[0]) if len(argv) > 0 else 1_000
+        n_placed = int(argv[1]) if len(argv) > 1 else 10_000
+        run_churn(n_nodes, n_placed)
+        sys.exit(0)
     if "--allocator" in argv:
         i = argv.index("--allocator")
         flavor = argv[i + 1] if i + 1 < len(argv) else ""

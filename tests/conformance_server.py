@@ -208,10 +208,36 @@ def _app(store: DocStore):
                     return {"events": []}
                 store.lock.wait(remaining)
 
-    def k8s_list_payload(kind: str, k8s_kind: str) -> dict:
+    def k8s_list_payload(kind: str, k8s_kind: str,
+                         selector: Optional[str] = None) -> dict:
+        """``selector`` is the decoded ``fieldSelector`` param.  This
+        strict surface supports exactly what a real apiserver indexes for
+        pods — ``spec.nodeName`` equality/inequality (empty value = the
+        unassigned partition) — and 400s anything else (signalled to the
+        caller by returning None)."""
+        match = None
+        if selector is not None:
+            field = "spec.nodeName"
+            if kind != "pod" or not selector.startswith(field):
+                return None
+            rest = selector[len(field):]
+            if rest.startswith("!="):
+                op, value = "!=", rest[2:]
+            elif rest.startswith("=="):
+                op, value = "=", rest[2:]
+            elif rest.startswith("="):
+                op, value = "=", rest[1:]
+            else:
+                return None
+
+            def match(doc):
+                node = str((doc.get("spec") or {}).get("nodeName", "") or "")
+                return (node == value) == (op == "=")
+
         with store.lock:
             items = [
-                doc for (k, _), doc in sorted(store.docs.items()) if k == kind
+                doc for (k, _), doc in sorted(store.docs.items())
+                if k == kind and (match is None or match(doc))
             ]
             # Deep-copy under the lock (same tearing hazard as /state).
             return json.loads(json.dumps({
@@ -375,7 +401,18 @@ def _app(store: DocStore):
                 start("200 OK", [("Content-Type", "application/json")])
                 return k8s_watch_stream(kind, k8s_kind, since, timeout,
                                         bookmarks)
-            return respond(start, 200, k8s_list_payload(kind, k8s_kind))
+            from urllib.parse import unquote
+
+            raw_sel = qs.get("fieldSelector")
+            payload = k8s_list_payload(
+                kind, k8s_kind, None if raw_sel is None else unquote(raw_sel)
+            )
+            if payload is None:
+                # Real apiservers 400 unsupported field selectors; NOT a
+                # client violation — a conformant client may probe and
+                # fall back to full relists.
+                return respond(start, 400, {"error": "bad fieldSelector"})
+            return respond(start, 200, payload)
         if method == "GET":
             route = k8s_object_key(path)
             if route is not None:
@@ -494,6 +531,11 @@ def _app(store: DocStore):
                 merged = pg.setdefault("status", {})
                 if "phase" in status:
                     merged["phase"] = status["phase"]
+                # Counts persist like the real subresource (the scheduler's
+                # diff-at-close must converge against the echo).
+                for fld in ("running", "succeeded", "failed"):
+                    if fld in status:
+                        merged[fld] = status[fld]
                 if "conditions" in status:
                     merged["conditions"] = _merge_conditions(
                         merged.get("conditions", []), status["conditions"]

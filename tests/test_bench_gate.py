@@ -237,3 +237,97 @@ def test_bench_xl_refuses_when_requested_mesh_degrades():
     doc = _json.loads(proc.stdout.strip().splitlines()[-1])
     assert "refused" in doc["error"] and "1024x1024" in doc["error"]
     assert doc["value"] == 0.0
+
+
+# -- churn family (docs/CHURN.md): lower-is-better p99 + self-recorded floor --
+
+
+def _churn_artifact(p99=40.0, hit_rate=0.6, floor=0.25, nodes=200,
+                    placed=2000, rate=2000.0, **extra) -> dict:
+    detail = {
+        "family": "churn", "seed": 0, "nodes": nodes, "placed_pods": placed,
+        "pending_pods": 32, "rate_target": rate, "rate_sustained": rate * 0.98,
+        "duration_s": 8.0, "cycles_measured": 120,
+        "p50_ms": p99 / 3.0, "p99_ms": p99, "max_ms": p99 * 1.5,
+        "hit_rate": hit_rate, "hit_rate_floor": floor,
+    }
+    detail.update(extra)
+    return {
+        "metric": "churn_p99_cycle_ms", "value": p99, "unit": "ms",
+        "vs_target": p99 / 100.0, "detail": detail,
+    }
+
+
+def test_churn_family_is_recognized_and_segregated(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _artifact(100.0))
+    _write(tmp_path, "BENCH_CHURN_r01.json", _churn_artifact())
+    assert [p.name for p in find_artifacts(tmp_path, "")] == ["BENCH_r01.json"]
+    assert [p.name for p in find_artifacts(tmp_path, "_CHURN")] == [
+        "BENCH_CHURN_r01.json"
+    ]
+
+
+def test_churn_single_artifact_above_floor_passes(tmp_path):
+    from scripts.bench_gate import gate_churn
+
+    _write(tmp_path, "BENCH_CHURN_r01.json", _churn_artifact())
+    assert gate_churn(tmp_path) == 0
+
+
+def test_churn_hit_rate_below_own_recorded_floor_fails(tmp_path):
+    from scripts.bench_gate import gate_churn
+
+    _write(tmp_path, "BENCH_CHURN_r01.json",
+           _churn_artifact(hit_rate=0.1, floor=0.25))
+    assert gate_churn(tmp_path) == 2
+    assert gate_main(["bench_gate", str(tmp_path)]) == 2
+
+
+def test_churn_p99_regression_beyond_tolerance_fails(tmp_path):
+    from scripts.bench_gate import gate_churn
+
+    _write(tmp_path, "BENCH_CHURN_r01.json", _churn_artifact(p99=40.0))
+    _write(tmp_path, "BENCH_CHURN_r02.json", _churn_artifact(p99=50.0))  # +25%
+    assert gate_churn(tmp_path) == 2
+
+
+def test_churn_p99_within_tolerance_passes(tmp_path):
+    from scripts.bench_gate import gate_churn
+
+    _write(tmp_path, "BENCH_CHURN_r01.json", _churn_artifact(p99=40.0))
+    _write(tmp_path, "BENCH_CHURN_r02.json", _churn_artifact(p99=42.0))  # +5%
+    assert gate_churn(tmp_path) == 0
+    assert gate_main(["bench_gate", str(tmp_path)]) == 0
+
+
+def test_churn_improvement_passes(tmp_path):
+    from scripts.bench_gate import gate_churn
+
+    _write(tmp_path, "BENCH_CHURN_r01.json", _churn_artifact(p99=40.0))
+    _write(tmp_path, "BENCH_CHURN_r02.json", _churn_artifact(p99=20.0))
+    assert gate_churn(tmp_path) == 0
+
+
+def test_churn_rounds_on_different_shapes_are_not_compared(tmp_path):
+    from scripts.bench_gate import gate_churn
+
+    _write(tmp_path, "BENCH_CHURN_r01.json", _churn_artifact(p99=40.0))
+    _write(tmp_path, "BENCH_CHURN_r02.json",
+           _churn_artifact(p99=400.0, rate=10_000.0))  # 5x rate: no verdict
+    assert gate_churn(tmp_path) == 0
+
+
+def test_churn_artifact_missing_fields_is_malformed(tmp_path):
+    from scripts.bench_gate import gate_churn
+
+    doc = _churn_artifact()
+    del doc["detail"]["hit_rate_floor"]
+    _write(tmp_path, "BENCH_CHURN_r01.json", doc)
+    assert gate_churn(tmp_path) == 1
+    assert gate_main(["bench_gate", str(tmp_path)]) == 1
+
+
+def test_churn_gate_with_no_artifacts_is_silent_pass(tmp_path):
+    from scripts.bench_gate import gate_churn
+
+    assert gate_churn(tmp_path) == 0

@@ -1,0 +1,315 @@
+"""Churn simulator + dirty-set plumbing (docs/CHURN.md).
+
+Three layers: the seeded history generator (deterministic, Poisson arrivals,
+lifetimes, bursts, lanes), the cache's dirty-set bookkeeping (the engine
+hit path's row oracle — superset semantics, epoch windows, bounded maps),
+and the end-to-end churn bench rig (``bench.py --churn``) as a short seeded
+soak, with the full-rate soak slow-marked for the churn CI job."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.harness.churn import (
+    ChurnConfig,
+    apply_history_to_cache,
+    make_history,
+    run_churn_bench,
+    seed_cluster,
+)
+from tests.fixtures import build_node, build_pod, build_pod_group, build_queue, make_vocab
+
+
+# -- history generator --------------------------------------------------------
+
+
+def test_history_is_a_pure_function_of_the_seed():
+    cfg = ChurnConfig(seed=42, rate=500.0, duration_s=2.0)
+    a = make_history(cfg)
+    b = make_history(cfg)
+    assert [(e.t, e.op, e.obj) for e in a] == [(e.t, e.op, e.obj) for e in b]
+    c = make_history(ChurnConfig(seed=43, rate=500.0, duration_s=2.0))
+    assert [(e.t, e.op, e.obj) for e in a] != [(e.t, e.op, e.obj) for e in c]
+
+
+def test_history_rate_lifetimes_and_lanes():
+    cfg = ChurnConfig(seed=1, rate=1000.0, duration_s=4.0, lifetime_s=1.0,
+                      burst_factor=1.0, lanes=8)
+    events = make_history(cfg)
+    assert all(0 <= e.t < cfg.duration_s for e in events)
+    assert [e.t for e in events] == sorted(e.t for e in events)
+    adds = [e for e in events if e.op == "add"]
+    dels = [e for e in events if e.op == "delete"]
+    # Poisson(rate * duration): 4000 expected arrivals, generous 4-sigma.
+    assert 3600 <= len(adds) <= 4400
+    # Mean lifetime 1s in a 4s window: most arrivals die inside it.
+    churn_dels = [e for e in dels if e.obj["name"].startswith("churn-")]
+    assert len(churn_dels) > len(adds) * 0.5
+    # Every arrival rides a lane PodGroup (no shadow-job churn).
+    assert {e.obj["group"] for e in adds} == {
+        f"lane-{k:02d}" for k in range(8)
+    }
+    # Placed-population death process emits bound-pod deletes too.
+    assert any(e.obj["name"].startswith("placed-") for e in dels)
+
+
+def test_bursts_raise_the_local_arrival_rate():
+    base = ChurnConfig(seed=5, rate=400.0, duration_s=4.0,
+                       burst_factor=1.0, lifetime_s=100.0)
+    bursty = ChurnConfig(seed=5, rate=400.0, duration_s=4.0,
+                         burst_every_s=2.0, burst_len_s=0.5,
+                         burst_factor=8.0, lifetime_s=100.0)
+    n_base = sum(e.op == "add" for e in make_history(base))
+    n_bursty = sum(e.op == "add" for e in make_history(bursty))
+    assert n_bursty > n_base * 1.5
+
+
+def test_seed_cluster_builds_the_mostly_placed_store():
+    from scheduler_tpu.connector.mock_server import MockState
+
+    state = MockState()
+    cfg = ChurnConfig(nodes=10, placed_pods=55, pending_pods=7,
+                      tasks_per_job=20, lanes=4)
+    seed_cluster(state, cfg)
+    assert len(state.objects["node"]) == 10
+    pods = state.objects["pod"]
+    placed = [p for p in pods.values() if p.get("nodeName")]
+    pending = [p for p in pods.values() if not p.get("nodeName")]
+    assert len(placed) == 55 and len(pending) == 7
+    assert all(p["phase"] == "Running" for p in placed)
+    # 3 placed gangs (20+20+15) + 4 churn lanes.
+    assert len(state.objects["podgroup"]) == 3 + 4
+
+
+def test_apply_history_to_cache_round_trips():
+    cache = SchedulerCache(async_io=False)
+    cache.add_queue(build_queue("default"))
+    for k in range(4):
+        cache.add_pod_group(build_pod_group(f"lane-{k:02d}", min_member=1))
+    cfg = ChurnConfig(seed=3, rate=200.0, duration_s=1.0, lanes=4,
+                      lifetime_s=100.0, placed_pods=0)
+    history = make_history(cfg)
+    n = apply_history_to_cache(cache, history)
+    assert n == len(history)
+    adds = sum(e.op == "add" for e in history)
+    dels = sum(e.op == "delete" for e in history)
+    with cache.mutex:
+        live = sum(len(j.tasks) for j in cache.jobs.values())
+    assert live == adds - dels
+
+
+# -- dirty-set plumbing (cache side) ------------------------------------------
+
+
+def _node_cache(n: int = 4):
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.add_queue(build_queue("default"))
+    for i in range(n):
+        cache.add_node(build_node(f"n{i}", {"cpu": 4000, "memory": 8 * 1024**3}))
+    return cache
+
+
+def test_dirty_nodes_since_tracks_mutation_epochs():
+    cache = _node_cache()
+    e0 = cache._dirty_epoch
+    assert cache.dirty_nodes_since(e0) == set()
+    cache.add_pod_group(build_pod_group("g", min_member=1))
+    cache.add_pod(build_pod(name="g-0", nodename="n1", phase="Running",
+                            req={"cpu": 1000, "memory": 1024**3},
+                            groupname="g"))
+    assert cache.dirty_nodes_since(e0) == {"n1"}
+    e1 = cache._dirty_epoch
+    cache.update_node(build_node("n3", {"cpu": 8000, "memory": 8 * 1024**3}))
+    assert cache.dirty_nodes_since(e1) == {"n3"}
+    assert cache.dirty_nodes_since(e0) == {"n1", "n3"}
+    # Unknown epochs answer None (full-diff fallback), never a guess.
+    assert cache.dirty_nodes_since(-1) is None
+    counts = cache.dirty_counts_since(e0)
+    assert counts["nodes"] == 2 and counts["jobs"] >= 1
+
+
+def test_dirty_map_overflow_advances_the_floor():
+    cache = _node_cache()
+    e0 = cache._dirty_epoch
+    cache._DIRTY_CAP = 3
+    for i in range(5):
+        cache.update_node(build_node(f"x{i}", {"cpu": 1000, "memory": 2**30}))
+    # The map overflowed and cleared: history before the floor is unknown.
+    assert cache.dirty_nodes_since(e0) is None
+    assert cache.dirty_counts_since(e0)["nodes"] == -1
+    # Post-floor epochs answer exactly again.
+    e1 = cache._dirty_epoch
+    cache.update_node(build_node("x0", {"cpu": 2000, "memory": 2**30}))
+    assert cache.dirty_nodes_since(e1) == {"x0"}
+
+
+def test_snapshot_carries_the_dirty_epoch():
+    cache = _node_cache()
+    snap = cache.snapshot()
+    assert snap.dirty_epoch == cache._dirty_epoch
+    cache.update_node(build_node("n0", {"cpu": 9000, "memory": 2**30}))
+    assert cache.snapshot().dirty_epoch > snap.dirty_epoch
+
+
+def test_bind_and_evict_paths_mark_nodes_dirty():
+    from scheduler_tpu.api.types import TaskStatus
+
+    cache = _node_cache()
+    cache.run()
+    cache.add_pod_group(build_pod_group("g", min_member=1))
+    cache.add_pod(build_pod(name="g-0", req={"cpu": 1000, "memory": 1024**3},
+                            groupname="g"))
+    e0 = cache._dirty_epoch
+    job = next(iter(cache.jobs.values()))
+    task = next(iter(job.tasks.values()))
+    cache.bind(task, "n2")
+    assert "n2" in cache.dirty_nodes_since(e0)
+    e1 = cache._dirty_epoch
+    with cache.mutex:
+        task2 = next(iter(job.tasks.values()))
+    job.update_task_status(task2, TaskStatus.RUNNING)
+    cache.evict(task2, "test")
+    assert "n2" in cache.dirty_nodes_since(e1)
+
+
+# -- sparse refresh parity + engagement (engine side) -------------------------
+
+
+@pytest.mark.parametrize("n_queues", [1, 2])
+def test_dirty_delta_refresh_matches_full_diff_bitwise(n_queues, monkeypatch):
+    """The dirty-row scatter path must be bind-for-bind and status-for-
+    status identical to the full-tensor diff across the engine-cache parity
+    trajectory (the same harness that pins hit-vs-cold parity).  The
+    width heuristic is forced open so the 4-node fixture actually takes
+    the sparse path instead of falling back to the full diff."""
+    from scheduler_tpu.ops.fused import FusedAllocator
+    from tests.test_engine_cache_parity import run_trajectory
+
+    monkeypatch.setattr(FusedAllocator, "SPARSE_DIRTY_RATIO", 0)
+    sparse = run_trajectory(n_queues, {
+        "SCHEDULER_TPU_ENGINE_CACHE": "1", "SCHEDULER_TPU_DIRTY_DELTA": "1",
+    })
+    full = run_trajectory(n_queues, {
+        "SCHEDULER_TPU_ENGINE_CACHE": "1", "SCHEDULER_TPU_DIRTY_DELTA": "0",
+    })
+    assert sparse == full
+
+
+def test_sparse_refresh_engages_and_scatters_only_churned_rows():
+    """Engagement proof: a steady hit cycle after bound-pod churn runs the
+    SPARSE refresh and scatters exactly the churned node's rows (evidence
+    via the phases note channel the bench reads)."""
+    from scheduler_tpu.api.types import TaskStatus
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.harness.measure import timed_cycle_phases, warm_engine
+
+    conf = parse_scheduler_conf("""
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+""")
+    # 32 nodes: wide enough that the sparse path's width heuristic admits
+    # a one-node dirty set (dirty * RATIO <= N).
+    cache = _node_cache(32)
+    cache.run()
+    # A stuck pending job pins a stable layout token (the hit path).
+    cache.add_pod_group(build_pod_group("stuck", min_member=1))
+    cache.add_pod(build_pod(name="stuck-0",
+                            req={"cpu": 64000, "memory": 256 * 1024**3},
+                            groupname="stuck"))
+    # Bound workload whose delete churns ONE node's dynamic state.
+    cache.add_pod_group(build_pod_group("run", min_member=1, phase="Running"))
+    cache.add_pod(build_pod(name="run-0", nodename="n2", phase="Running",
+                            req={"cpu": 1000, "memory": 1024**3},
+                            groupname="run"))
+    warm_engine(cache, conf)  # resident engine at epoch E0
+    # Churn: the bound pod dies — n2's idle changes, nothing else.
+    pod = build_pod(name="run-0", nodename="n2", phase="Running",
+                    req={"cpu": 1000, "memory": 1024**3}, groupname="run")
+    with cache.mutex:
+        uid = next(
+            t.pod.uid for j in cache.jobs.values()
+            for t in j.tasks.values() if t.name == "run-0"
+        )
+    pod.uid = uid
+    cache.delete_pod(pod)
+    _, phases = timed_cycle_phases(cache, conf, ("allocate",))
+    assert phases["notes"]["engine_cache"] == "hit"
+    dirty = phases["notes"]["dirty"]
+    assert dirty["mode"] == "sparse"
+    assert dirty["dirty_nodes"] >= 1
+    # idle + task_count rows for the one churned node.
+    assert 1 <= dirty["rows_scattered"] <= 3
+    with cache.mutex:
+        stuck = next(j for j in cache.jobs.values() if j.uid.endswith("stuck"))
+        assert stuck.status_count(TaskStatus.PENDING) == 1
+
+
+# -- the end-to-end churn rig -------------------------------------------------
+
+
+def _tiny_cfg(**kw) -> ChurnConfig:
+    # warm_s=0: the soak asserts completeness (rig survives, artifact body
+    # complete), never latency — paying the XLA warmup here would only
+    # stretch tier-1; the compiles land inside the measured drain instead.
+    base = dict(seed=11, nodes=16, placed_pods=120, pending_pods=8,
+                tasks_per_job=30, rate=120.0, duration_s=0.8, warm_s=0.0,
+                lifetime_s=3.0, lanes=4, max_interval_s=0.2)
+    base.update(kw)
+    return ChurnConfig(**base)
+
+
+def test_churn_bench_short_seeded_soak(monkeypatch):
+    """The CI churn job's seeded soak: the full rig — mock apiserver,
+    reflector ingestion, event-triggered scheduler — survives a short
+    replay and emits a complete artifact body."""
+    for flag in ("SCHEDULER_TPU_TRIGGER", "SCHEDULER_TPU_DEBOUNCE_MS",
+                 "SCHEDULER_TPU_TRIGGER_MIN_MS",
+                 "SCHEDULER_TPU_TRIGGER_MAX_MS"):
+        monkeypatch.delenv(flag, raising=False)
+    doc = run_churn_bench(_tiny_cfg(), hit_rate_floor=0.0)
+    d = doc["detail"]
+    assert doc["metric"] == "churn_p99_cycle_ms"
+    assert d["family"] == "churn"
+    assert d["cycles_measured"] > 0
+    assert d["p99_ms"] >= d["p50_ms"] > 0
+    assert d["rate_sustained"] > 0
+    assert d["replay"]["events"] > 50
+    assert d["trigger"]["events"] > 0 and d["trigger"]["cycles"] > 0
+    assert 0.0 <= d["hit_rate"] <= 1.0
+    assert sum(d["engine_cache"].values()) > 0
+    # Per-cycle evidence carries the event batch + engine-cache outcome.
+    assert all({"s", "events", "engine_cache", "dirty"} <= set(c)
+               for c in d["cycles"])
+    assert d["ingest"]["events_applied"] > d["replay"]["events"]
+
+
+@pytest.mark.slow
+def test_churn_bench_full_soak_sustains_rate_with_cache_hits(monkeypatch):
+    """The slow soak the churn CI job excludes from tier-1: a longer,
+    faster replay must sustain most of the target input rate, keep the
+    scheduler drained, and actually EXERCISE the engine-cache delta path
+    (hits > 0) under live churn."""
+    for flag in ("SCHEDULER_TPU_TRIGGER", "SCHEDULER_TPU_DEBOUNCE_MS",
+                 "SCHEDULER_TPU_TRIGGER_MIN_MS",
+                 "SCHEDULER_TPU_TRIGGER_MAX_MS"):
+        monkeypatch.delenv(flag, raising=False)
+    doc = run_churn_bench(
+        _tiny_cfg(seed=12, nodes=64, placed_pods=600, rate=600.0,
+                  duration_s=5.0, warm_s=1.5, lifetime_s=4.0),
+        hit_rate_floor=0.0,
+    )
+    d = doc["detail"]
+    assert d["cycles_measured"] >= 5
+    assert d["rate_sustained"] >= 0.5 * d["rate_target"]
+    assert d["engine_cache"].get("hit", 0) > 0
+    assert d["dirty"]["sparse_cycles"] > 0
+    assert np.isfinite(d["p99_ms"]) and d["p99_ms"] > 0

@@ -645,3 +645,206 @@ def test_connect_cache_env_selects_the_reflector(monkeypatch):
             [kind for kind, _, _ in LIST_RESOURCES]
     finally:
         conn.stop()
+
+
+# -- spec.nodeName field-selector LISTs + split relists (docs/INGEST.md) ------
+
+
+def test_mock_server_field_selector_partitions_pod_lists():
+    """The mock apiserver supports the spec.nodeName selector subset a
+    real apiserver indexes: equality (incl. the empty unassigned value)
+    and inequality; unknown selectors 400 like the real thing."""
+    import urllib.error
+    import urllib.request
+
+    server, state, base = _spawn_mock()
+    try:
+        _seed_cluster(base)
+        _post(base, "/objects", {"kind": "pod", "object": {
+            "name": "bound-0", "nodeName": "pn-1", "phase": "Running",
+            "containers": [{"cpu": 100, "memory": 2**20}]}})
+        unassigned = _get(base, "/api/v1/pods?fieldSelector=spec.nodeName%3D")
+        assert sorted(p["name"] for p in unassigned["items"]) == [
+            f"pp-{i}" for i in range(5)
+        ]
+        assigned = _get(base, "/api/v1/pods?fieldSelector=spec.nodeName%21%3D")
+        assert [p["name"] for p in assigned["items"]] == ["bound-0"]
+        one = _get(
+            base, "/api/v1/pods?fieldSelector=spec.nodeName%3Dpn-1"
+        )
+        assert [p["name"] for p in one["items"]] == ["bound-0"]
+        # Payload evidence recorded per LIST.
+        with state.lock:
+            sels = [e["selector"] for e in state.list_log if e["kind"] == "pod"]
+            assert "spec.nodeName=" in sels and "spec.nodeName!=" in sels
+            assert all(e["bytes"] > 0 for e in state.list_log)
+        try:
+            urllib.request.urlopen(
+                base + "/api/v1/pods?fieldSelector=status.phase%3DRunning",
+                timeout=5,
+            )
+            raise AssertionError("unsupported selector was accepted")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        # Non-pod kinds have no nodeName index, like the real server.
+        try:
+            urllib.request.urlopen(
+                base + "/api/v1/nodes?fieldSelector=spec.nodeName%3D",
+                timeout=5,
+            )
+            raise AssertionError("node selector was accepted")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        server.shutdown()
+
+
+def test_pod_410_recovery_relists_by_partition_not_full_cluster():
+    """The carried ROADMAP slice: a pod watch 410 recovers with TWO
+    partition LISTs (assigned via spec.nodeName!=, unassigned via
+    spec.nodeName=) instead of one full-cluster payload; ghosts die in
+    BOTH partitions, the unassigned payload is far below the full
+    inventory's, and the reflector records the byte evidence."""
+    server, state, base = _spawn_mock()
+    conn = None
+    try:
+        _post(base, "/objects", {"kind": "queue",
+                                 "object": {"name": "default", "weight": 1}})
+        _post(base, "/objects", {"kind": "podgroup", "object": {
+            "name": "pg", "queue": "default", "minMember": 1,
+            "phase": "Inqueue"}})
+        # A mostly-placed inventory: 40 bound pods, 3 pending.
+        for i in range(40):
+            _post(base, "/objects", {"kind": "pod", "object": {
+                "name": f"bound-{i:02d}", "group": "pg",
+                "nodeName": f"pn-{i % 4}", "phase": "Running",
+                "containers": [{"cpu": 100, "memory": 2**20}]}})
+        for i in range(3):
+            _post(base, "/objects", {"kind": "pod", "object": {
+                "name": f"pend-{i}", "group": "pg",
+                "containers": [{"cpu": 100, "memory": 2**20}]}})
+        cache, conn = client_mod.connect_cache(base, async_io=False,
+                                               wire="k8s")
+        for r in conn.reflectors:
+            r.watch_timeout = 1.0
+        cache.run()
+        conn.start()
+        assert conn.wait_for_cache_sync(15)
+        pod_reflector = conn._by_kind["pod"]
+        seed_bytes = pod_reflector.relist_bytes
+        assert not pod_reflector.last_relist  # initial seed is not a relist
+
+        # One ghost per partition, both deletes swallowed by compaction.
+        _post(base, "/inject",
+              {"op": "silent-delete", "kind": "pod",
+               "key": "default/bound-07"})
+        _post(base, "/inject",
+              {"op": "silent-delete", "kind": "pod", "key": "default/pend-1"})
+        _post(base, "/inject", {"op": "compact-history"})
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            names = _task_names(cache)
+            if "bound-07" not in names and "pend-1" not in names:
+                break
+            time.sleep(0.1)
+        names = _task_names(cache)
+        assert "bound-07" not in names, "assigned-partition ghost survived"
+        assert "pend-1" not in names, "unassigned-partition ghost survived"
+        assert len(names) == 41
+
+        assert pod_reflector.relists >= 1
+        last = pod_reflector.last_relist
+        assert last["split"] is True
+        assert len(last["bytes"]) == 2 and all(b > 0 for b in last["bytes"])
+        assert pod_reflector.relist_bytes > seed_bytes
+        # items evidence: [assigned, unassigned] partitions.
+        assert last["items"][0] == 39 and last["items"][1] == 2
+        # The unassigned partition (the churn-hot working set) costs a
+        # fraction of the full inventory payload.
+        assert last["bytes"][1] < seed_bytes / 4
+        with state.lock:
+            sels = [e["selector"] for e in state.list_log
+                    if e["kind"] == "pod" and e["selector"]]
+        assert "spec.nodeName!=" in sels and "spec.nodeName=" in sels
+    finally:
+        if conn is not None:
+            conn.stop()
+        server.shutdown()
+
+
+def test_split_relist_demotes_to_full_on_400(monkeypatch):
+    """A server without spec.nodeName indexing 400s the selector LIST; the
+    reflector must fall back to the classic full relist — permanently, not
+    probing every round — and still replace correctly."""
+    import urllib.error
+
+    from scheduler_tpu.connector import reflector as reflector_mod
+
+    cache, conn, r = _reflector("pod")
+    r.synced.set()  # pretend seeded: the next list_and_replace is a RELIST
+    calls = []
+
+    def fake_get_sized(base, path, timeout=30.0):
+        calls.append(path)
+        if "fieldSelector" in path:
+            raise urllib.error.HTTPError(path, 400, "bad selector", {}, None)
+        return {
+            "apiVersion": "v1", "kind": "PodList",
+            "metadata": {"resourceVersion": "7"},
+            "items": [_pod_doc("solo", 5)],
+        }, 123
+
+    monkeypatch.setattr(reflector_mod, "_get_sized", fake_get_sized)
+    r.list_and_replace()
+    assert r.split_relists is False
+    assert r.rv == 7 and r.relists == 1
+    assert r.last_relist == {"split": False, "bytes": [123], "items": [1]}
+    assert _task_names(cache) == ["solo"]
+    # Demotion is permanent: the next relist never retries the selector.
+    calls.clear()
+    r.list_and_replace()
+    assert not any("fieldSelector" in p for p in calls)
+
+
+def test_prune_absent_pod_scope_protects_the_other_partition():
+    """A partition LIST is only authoritative about its own partition:
+    pruning with pod_scope must never delete the other partition's pods."""
+    from scheduler_tpu.connector.wire import parse_pod
+
+    cache = SchedulerCache(async_io=False)
+    bound = parse_pod({"name": "b0", "nodeName": "n0", "phase": "Running",
+                       "uid": "b0", "group": "g",
+                       "containers": [{"cpu": 100}]}, "volcano")
+    pend = parse_pod({"name": "p0", "uid": "p0", "group": "g",
+                      "containers": [{"cpu": 100}]}, "volcano")
+    cache.add_pod_group(__import__(
+        "scheduler_tpu.apis.objects", fromlist=["PodGroup"]
+    ).PodGroup(name="g", namespace="default", min_member=1))
+    cache.add_pod(bound)
+    cache.add_pod(pend)
+    # An empty assigned survivor set scoped to "assigned" kills b0 only.
+    removed = cache.prune_absent(pod_uids=set(), pod_scope="assigned")
+    assert removed == 1
+    assert _task_names(cache) == ["p0"]
+    # A task whose bind is IN FLIGHT (BINDING) is exempt from scoped
+    # pruning: which partition the server files it under is unsettled, so
+    # neither partition LIST may judge it (the in-flight-bind race the
+    # split relist must not lose).
+    from scheduler_tpu.api.types import TaskStatus
+
+    with cache.mutex:
+        job = next(iter(cache.jobs.values()))
+        t = next(iter(job.tasks.values()))
+        job.update_task_status(t, TaskStatus.BINDING)
+        t.node_name = "n1"
+    for scope in ("assigned", "unassigned"):
+        assert cache.prune_absent(pod_uids=set(), pod_scope=scope) == 0
+    assert _task_names(cache) == ["p0"]
+    with cache.mutex:
+        job.update_task_status(t, TaskStatus.PENDING)
+        t.node_name = ""
+    # Settled again: an empty unassigned survivor set scoped "unassigned"
+    # kills p0 (and an UNSCOPED prune never special-cases status).
+    removed = cache.prune_absent(pod_uids=set(), pod_scope="unassigned")
+    assert removed == 1
+    assert _task_names(cache) == []

@@ -194,9 +194,9 @@ def test_reflector_list_and_relist_pay_the_bucket_watch_does_not(monkeypatch):
     from scheduler_tpu.connector.reflector import K8sApiConnector
 
     monkeypatch.setattr(
-        reflector_mod, "_get",
-        lambda base, path, timeout=30.0: {
-            "items": [], "metadata": {"resourceVersion": "4"}},
+        reflector_mod, "_get_sized",
+        lambda base, path, timeout=30.0: ({
+            "items": [], "metadata": {"resourceVersion": "4"}}, 48),
     )
     limiter = _CountingLimiter()
     conn = K8sApiConnector(

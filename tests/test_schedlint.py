@@ -574,3 +574,59 @@ def test_changed_mode_expands_to_reverse_dependencies():
     # A leaf module with no importers expands to itself only.
     leaf = cli._expand_reverse_deps(["bench.py"])
     assert leaf == {"bench.py"}
+
+
+def test_env_fixtures_cover_the_trigger_and_dirty_delta_knobs():
+    """The cycle-pacing flags (SCHEDULER_TPU_TRIGGER / _DEBOUNCE_MS /
+    _TRIGGER_MIN_MS / _TRIGGER_MAX_MS, utils/trigger.py) and the dirty-set
+    refresh kill-switch (SCHEDULER_TPU_DIRTY_DELTA, ops/fused.py) ride the
+    standard env machinery (docs/CHURN.md): raw reads trip raw-env
+    anywhere, the ops/ read must be registered in _ENV_KEYS, and the
+    envflags forms the real tree uses stay clean."""
+    out = findings("raw-env", py={
+        "scheduler_tpu/utils/trigger.py": """
+            import os
+            def trigger_mode_from_env():
+                mode = os.environ.get("SCHEDULER_TPU_TRIGGER", "period")
+                ms = os.getenv("SCHEDULER_TPU_DEBOUNCE_MS", "25")
+                return mode, ms
+        """,
+    })
+    assert len(out) == 2
+    assert "SCHEDULER_TPU_TRIGGER" in out[0].message
+    assert "SCHEDULER_TPU_DEBOUNCE_MS" in out[1].message
+    out = findings("raw-env", py={
+        "scheduler_tpu/utils/trigger.py": """
+            from scheduler_tpu.utils.envflags import env_float, env_str
+            def knobs():
+                mode = env_str("SCHEDULER_TPU_TRIGGER", "period",
+                               choices=("period", "event"))
+                return mode, env_float("SCHEDULER_TPU_DEBOUNCE_MS", 25.0)
+        """,
+    })
+    assert out == []
+    # The ops/-side dirty-delta read must be registered, like any engine
+    # program selector.
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+        "scheduler_tpu/ops/fused.py": """
+            from scheduler_tpu.utils.envflags import env_bool
+            def _dirty_delta_enabled():
+                return env_bool("SCHEDULER_TPU_DIRTY_DELTA", True)
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_DIRTY_DELTA" in out[0].message
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": """
+            _ENV_KEYS = (
+                "SCHEDULER_TPU_MEGA",
+                "SCHEDULER_TPU_DIRTY_DELTA",
+            )
+        """,
+        "scheduler_tpu/ops/fused.py": """
+            from scheduler_tpu.utils.envflags import env_bool
+            def _dirty_delta_enabled():
+                return env_bool("SCHEDULER_TPU_DIRTY_DELTA", True)
+        """,
+    })
+    assert out == []

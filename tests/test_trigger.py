@@ -1,0 +1,307 @@
+"""Event-triggered cycle semantics (utils/trigger.py, docs/CHURN.md):
+debounce coalescing, the max-interval quiet-cluster fallback, no-starvation
+under a sustained burst, the min-interval clamp — and the contract that
+PACING NEVER CHANGES BINDS: trigger=event is bind-for-bind identical to
+trigger=period on the same seeded journal."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+from scheduler_tpu.utils.trigger import CycleTrigger, trigger_mode_from_env
+
+
+def test_debounce_coalesces_a_burst_into_one_cycle():
+    trig = CycleTrigger(debounce=0.15, min_interval=0.0, max_interval=30.0)
+
+    def burst():
+        for _ in range(5):
+            trig.notify()
+            time.sleep(0.01)
+
+    t = threading.Thread(target=burst)
+    start = time.monotonic()
+    t.start()
+    consumed = trig.wait()
+    elapsed = time.monotonic() - start
+    t.join()
+    assert consumed == 5, "burst events must coalesce into ONE cycle"
+    assert elapsed < 5.0  # nowhere near the max-interval fallback
+    assert trig.pending() == 0
+
+
+def test_max_interval_fires_a_fallback_cycle_on_a_quiet_stream():
+    trig = CycleTrigger(debounce=0.01, min_interval=0.0, max_interval=0.2)
+    start = time.monotonic()
+    consumed = trig.wait()
+    elapsed = time.monotonic() - start
+    assert consumed == 0, "a quiet cluster still rescans (0-event cycle)"
+    assert 0.15 <= elapsed < 5.0
+
+
+def test_sustained_burst_cannot_starve_the_cycle():
+    """The debounce window is FIXED from the first observed event, not
+    sliding: a storm notifying faster than the debounce width must not
+    postpone the cycle indefinitely."""
+    trig = CycleTrigger(debounce=0.1, min_interval=0.0, max_interval=30.0)
+    stop = threading.Event()
+
+    def storm():
+        while not stop.is_set():
+            trig.notify()
+            time.sleep(0.005)
+
+    t = threading.Thread(target=storm, daemon=True)
+    t.start()
+    try:
+        start = time.monotonic()
+        consumed = trig.wait()
+        elapsed = time.monotonic() - start
+        assert consumed >= 1
+        assert elapsed < 5.0, "storm starved the cycle past any debounce"
+        # The tail of the storm batches into the NEXT cycle, not nowhere.
+        time.sleep(0.05)
+        assert trig.pending() > 0
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_min_interval_clamps_cycle_starts():
+    trig = CycleTrigger(debounce=0.0, min_interval=0.25, max_interval=30.0)
+    trig.notify()
+    t0 = time.monotonic()
+    assert trig.wait() == 1
+    trig.notify()
+    assert trig.wait() == 1
+    assert time.monotonic() - t0 >= 0.2, "min-interval floor was not applied"
+
+
+def test_aged_batch_pays_only_the_debounce_remainder():
+    """The debounce anchors at the batch's FIRST event: events that arrived
+    while the previous cycle ran have already aged through their window, so
+    the next wait() fires immediately instead of re-debouncing."""
+    trig = CycleTrigger(debounce=0.3, min_interval=0.0, max_interval=30.0)
+    trig.notify(3)
+    time.sleep(0.4)  # the batch ages past its window (a cycle was running)
+    start = time.monotonic()
+    assert trig.wait() == 3
+    assert time.monotonic() - start < 0.2, "aged batch paid a fresh debounce"
+    # A FRESH batch does pay it.
+    trig.notify()
+    start = time.monotonic()
+    assert trig.wait() == 1
+    assert time.monotonic() - start >= 0.25
+
+
+def test_counters_and_malformed_intervals():
+    import pytest
+
+    trig = CycleTrigger(debounce=0.0, min_interval=0.0, max_interval=5.0)
+    trig.notify(2)
+    trig.notify()
+    assert trig.pending() == 3
+    assert trig.wait() == 3
+    assert trig.total_events == 3 and trig.cycles == 1
+    trig.notify(0)  # no-op
+    assert trig.pending() == 0
+    with pytest.raises(ValueError):
+        CycleTrigger(debounce=-1.0)
+    with pytest.raises(ValueError):
+        CycleTrigger(max_interval=0.0)
+
+
+def test_trigger_knobs_from_env(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_TRIGGER", "event")
+    assert trigger_mode_from_env() == "event"
+    monkeypatch.setenv("SCHEDULER_TPU_TRIGGER", "bogus")
+    assert trigger_mode_from_env() == "period"  # warn + default
+    monkeypatch.delenv("SCHEDULER_TPU_TRIGGER")
+    assert trigger_mode_from_env() == "period"
+
+    monkeypatch.setenv("SCHEDULER_TPU_DEBOUNCE_MS", "40")
+    monkeypatch.setenv("SCHEDULER_TPU_TRIGGER_MIN_MS", "10")
+    monkeypatch.setenv("SCHEDULER_TPU_TRIGGER_MAX_MS", "2000")
+    trig = CycleTrigger.from_env(default_max_interval=1.0)
+    assert trig.debounce == 0.04
+    assert trig.min_interval == 0.01
+    assert trig.max_interval == 2.0
+    # Default max interval = the schedule period; the min clamp wins a
+    # conflicting max.
+    monkeypatch.delenv("SCHEDULER_TPU_TRIGGER_MAX_MS")
+    assert CycleTrigger.from_env(default_max_interval=3.0).max_interval == 3.0
+    monkeypatch.setenv("SCHEDULER_TPU_TRIGGER_MIN_MS", "5000")
+    monkeypatch.setenv("SCHEDULER_TPU_TRIGGER_MAX_MS", "1000")
+    clamped = CycleTrigger.from_env(default_max_interval=1.0)
+    assert clamped.max_interval >= clamped.min_interval
+
+
+def test_trigger_flags_registered_in_engine_cache_key():
+    from scheduler_tpu.ops.engine_cache import _ENV_KEYS
+
+    for flag in ("SCHEDULER_TPU_TRIGGER", "SCHEDULER_TPU_DEBOUNCE_MS",
+                 "SCHEDULER_TPU_TRIGGER_MIN_MS",
+                 "SCHEDULER_TPU_TRIGGER_MAX_MS",
+                 "SCHEDULER_TPU_DIRTY_DELTA"):
+        assert flag in _ENV_KEYS
+
+
+# -- the scheduler loop under event pacing ------------------------------------
+
+
+CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+"""
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def _spawn_mock():
+    from scheduler_tpu.connector.mock_server import serve
+
+    server, state = serve(0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, state, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def test_event_trigger_binds_a_new_pod_without_waiting_for_the_period(tmp_path):
+    """Functional e2e: with a 10-minute schedule period, an event-paced
+    scheduler must still bind a freshly-posted pod promptly — the cycle was
+    triggered by the pod's own watch event, nothing else could have run
+    one."""
+    from scheduler_tpu.connector.client import connect_cache
+    from scheduler_tpu.scheduler import Scheduler
+
+    conf = tmp_path / "conf.yaml"
+    conf.write_text(CONF)
+    server, state, base = _spawn_mock()
+    conn = None
+    stop = threading.Event()
+    try:
+        _post(base, "/objects", {"kind": "queue",
+                                 "object": {"name": "default", "weight": 1}})
+        _post(base, "/objects", {"kind": "node", "object": {
+            "name": "n0",
+            "allocatable": {"cpu": 8000, "memory": 16 * 2**30, "pods": 110},
+        }})
+        _post(base, "/objects", {"kind": "podgroup", "object": {
+            "name": "g", "queue": "default", "minMember": 1,
+            "phase": "Inqueue"}})
+        cache, conn = connect_cache(base, async_io=False, wire="journal")
+        cache.run()
+        conn.start()
+        assert conn.wait_for_cache_sync(15)
+        trigger = CycleTrigger(debounce=0.02, min_interval=0.0,
+                               max_interval=600.0)
+        sched = Scheduler(cache, str(conf), schedule_period=600.0,
+                          trigger=trigger)
+        t = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+        t.start()
+        time.sleep(0.3)  # the loop is parked in trigger.wait now
+        _post(base, "/objects", {"kind": "pod", "object": {
+            "name": "late-0", "group": "g",
+            "containers": [{"cpu": 500, "memory": 2**30}]}})
+        deadline = time.monotonic() + 90  # first cycle pays the XLA compile
+        binds = []
+        while time.monotonic() < deadline and not binds:
+            binds = _get(base, "/bind-log")["binds"]
+            time.sleep(0.2)
+        assert binds and binds[0]["pod"] == "default/late-0", (
+            "event-paced cycle never fired for the pod's watch event"
+        )
+        assert trigger.total_events > 0 and trigger.cycles > 0
+    finally:
+        stop.set()
+        if conn is not None:
+            conn.stop()
+        server.shutdown()
+
+
+def _drive_binds(tmp_path, mode: str) -> list:
+    """Run the scheduler over the SAME seeded churn journal under one
+    pacing mode and return the ordered bind log.  The history is fully
+    applied to the server before the scheduler starts, so both modes open
+    their first session on identical state — any bind divergence is then
+    the pacing's fault, which is exactly the contract under test."""
+    from scheduler_tpu.connector.client import connect_cache
+    from scheduler_tpu.harness.churn import ChurnConfig, make_history, seed_cluster
+    from scheduler_tpu.scheduler import Scheduler
+
+    # Same cluster shape as test_churn's soak cfg: the two suites then
+    # share the in-process XLA compile cache for the engine buckets.
+    cfg = ChurnConfig(seed=7, nodes=16, placed_pods=120, pending_pods=8,
+                      tasks_per_job=30, rate=100.0, duration_s=0.6,
+                      lifetime_s=2.0, lanes=4)
+    conf = tmp_path / f"conf-{mode}.yaml"
+    conf.write_text(CONF)
+    server, state, base = _spawn_mock()
+    conn = None
+    stop = threading.Event()
+    try:
+        seed_cluster(state, cfg)
+        for ev in make_history(cfg):
+            state.apply(ev.kind, ev.op, dict(ev.obj))
+        cache, conn = connect_cache(base, async_io=False, wire="journal")
+        cache.run()
+        conn.start()
+        assert conn.wait_for_cache_sync(15)
+        trigger = None
+        if mode == "event":
+            trigger = CycleTrigger(debounce=0.02, min_interval=0.0,
+                                   max_interval=0.2)
+        sched = Scheduler(cache, str(conf), schedule_period=0.2,
+                          trigger=trigger)
+        t = threading.Thread(target=sched.run, args=(stop,), daemon=True)
+        t.start()
+        # Converged == the bind log is stable across a generous window.
+        deadline = time.monotonic() + 120
+        last, stable_since = None, time.monotonic()
+        while time.monotonic() < deadline:
+            binds = _get(base, "/bind-log")["binds"]
+            if binds != last:
+                last, stable_since = binds, time.monotonic()
+            elif binds and time.monotonic() - stable_since > 1.5:
+                break
+            time.sleep(0.2)
+        stop.set()
+        t.join(timeout=30)
+        return _get(base, "/bind-log")["binds"]
+    finally:
+        stop.set()
+        if conn is not None:
+            conn.stop()
+        server.shutdown()
+
+
+def test_event_and_period_pacing_bind_identically_on_the_same_journal(
+    tmp_path, monkeypatch
+):
+    """The acceptance contract (docs/CHURN.md): pacing changes WHEN cycles
+    run, never WHAT they decide — bind-for-bind parity on the same seeded
+    churn history."""
+    monkeypatch.delenv("SCHEDULER_TPU_TRIGGER", raising=False)
+    period_binds = _drive_binds(tmp_path, "period")
+    event_binds = _drive_binds(tmp_path, "event")
+    assert period_binds, "period drive bound nothing; rig is broken"
+    assert event_binds == period_binds

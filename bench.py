@@ -277,6 +277,17 @@ def main() -> None:
                           "error": f"unstable binds: {[b for b, _, _ in runs]}"}))
         sys.exit(1)
 
+    # Signature-compression summary at TOP level (detail.sig) so the XL
+    # flagship round can report the compressed-vs-raw working-set size
+    # without digging per-cycle (ISSUE 11; ROADMAP "TPU-round debts"):
+    # the engaged cycle's block when compression ran, else the recorded
+    # refusal reason.
+    sig_notes = [ph.get("notes", {}).get("sig") for _, _, ph in runs]
+    sig_summary = next(
+        (s for s in sig_notes if s and s.get("engaged")),
+        next((s for s in sig_notes if s), {}),
+    )
+
     flags = _classify(runs, probes)
     healthy = [r for r, bad in zip(runs, flags) if not bad]
     if len(healthy) >= 3 or (smoke and healthy):
@@ -302,6 +313,7 @@ def main() -> None:
             # missing (not an XL artifact at all).
             "family": "XL" if xl else "flagship",
             "allocator": allocator,
+            "sig": sig_summary,
             "mesh": mesh_meta,
             "cycle_seconds": round(elapsed, 3),
             "regime": regime,
@@ -344,6 +356,13 @@ def main() -> None:
                     # and repair fallbacks — what bench_gate.py judges
                     # against the greedy artifact of the same shape.
                     "lp": ph.get("notes", {}).get("lp", {}),
+                    # Signature-compression evidence (docs/LP_PLACEMENT.md
+                    # "Signature classes"): classes vs tasks, the
+                    # compression factor and resident bytes saved — what
+                    # bench_gate sanity-checks (classes <= tasks, finite
+                    # factor) and the XL round reports as the
+                    # compressed-vs-raw working-set size.
+                    "sig": ph.get("notes", {}).get("sig", {}),
                 }
                 for (_, el, ph), bad in zip(runs, flags)
             ],

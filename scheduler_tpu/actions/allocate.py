@@ -372,11 +372,19 @@ class AllocateAction(Action):
         # own note channel so the bench can surface it per cycle
         # (detail.cycles[].lp) and bench_gate can judge it against greedy.
         lp_stats = stats.pop("lp", None)
+        # Signature-compression evidence (docs/LP_PLACEMENT.md "Signature
+        # classes"): class vs task counts, the compression factor and the
+        # resident bytes saved — its own channel so the bench records it
+        # per cycle (detail.cycles[].sig) and bench_gate can sanity-check
+        # the artifact's compression claims.
+        sig_stats = stats.pop("sig", None)
         phases.note("cohort", stats)
         if queue_chain is not None:
             phases.note("queue_chain", queue_chain)
         if lp_stats is not None:
             phases.note("lp", lp_stats)
+        if sig_stats is not None:
+            phases.note("sig", sig_stats)
         with phases.phase("decode"):
             items, node_batches, failures = engine.run_columnar()  # reuses codes
         with phases.phase("apply"):

@@ -135,6 +135,25 @@ def build_static_tensors_device(ssn, st: SnapshotTensors, n_bucket: int, t_bucke
     return mask, score
 
 
+def gather_signature_rows(static_mask_dev, static_score_dev,
+                          rep_rows: np.ndarray, s_bucket: int):
+    """Compress the device-built ``[T, N]`` static tensors down to their
+    ``[S_bucket, N]`` signature-class representatives (docs/LP_PLACEMENT.md
+    "Signature classes"): one on-device row gather per tensor, so the full
+    per-task matrices never cross the host boundary and are freed as soon
+    as the gather lands — the resident working set shrinks by the
+    signature factor.  ``rep_rows`` is ``sig_compress.derive_classes``'s
+    representative task row per class; sound because tasks in one class
+    share their static-signature id, hence their ``[N]`` rows.  Pad rows
+    repeat class 0 (never indexed: ``sig_of_task`` values are < S)."""
+    s = rep_rows.shape[0]
+    idx = np.concatenate(
+        [rep_rows, np.full(s_bucket - s, rep_rows[0], dtype=rep_rows.dtype)]
+    )
+    rep = jnp.asarray(idx)
+    return static_mask_dev[rep], static_score_dev[rep]
+
+
 def node_state_from_tensors(st: SnapshotTensors, policy: DevicePolicy, n_bucket: int) -> NodeState:
     """Padded, unit-scaled device NodeState from host snapshot tensors."""
     from scheduler_tpu.ops.transfer_cache import to_device

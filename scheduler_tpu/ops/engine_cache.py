@@ -92,6 +92,14 @@ _ENV_KEYS = (
     "SCHEDULER_TPU_LP_TAU",
     "SCHEDULER_TPU_LP_TOL",
     "SCHEDULER_TPU_LP_LIMIT",
+    # Signature-class compression (ops/sig_compress.py, docs/LP_PLACEMENT.md
+    # "Signature classes").  The resolved mode selects [T, N] vs [S, N]
+    # static staging, the sig_of_task indirection baked into the traced
+    # programs, and the LP admission math — a resident engine built under
+    # one mode must never serve another.  The class TABLE itself is
+    # layout-derived and pinned by the layout token (incl. the vocab
+    # fingerprint below), like the cohort tables.
+    "SCHEDULER_TPU_SIG_COMPRESS",
     # Cycle pacing (utils/trigger.py, docs/CHURN.md).  Never read by the
     # engine build itself, but registered — like SCHEDULER_TPU_WIRE — so a
     # resident engine is pinned to the pacing regime it was diagnosed under:
@@ -216,7 +224,17 @@ def layout_token(ssn, jobs) -> Optional[tuple]:
         )
     except Exception:  # bare stub jobs/queues (tests): uncacheable
         return None
-    return (tuple(sorted(per_job)), queues, ssn.node_generation)
+    # Vocab fingerprint: the signature-class and cohort tables hash SCALED
+    # request rows, and the scaling is the vocab's column mapping + min
+    # thresholds.  The shape key pins only the vocab SIZE — a same-width
+    # vocab whose columns remapped (or whose mins moved) would alias the
+    # resident signature tables without this content pin.
+    try:
+        vocab = next(iter(ssn.nodes.values())).vocab
+        vocab_fp = (vocab.names, hash(vocab.min_thresholds().tobytes()))
+    except Exception:
+        vocab_fp = None
+    return (tuple(sorted(per_job)), queues, ssn.node_generation, vocab_fp)
 
 
 class EngineCache:

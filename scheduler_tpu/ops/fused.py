@@ -54,6 +54,7 @@ from scheduler_tpu.ops.allocator import (
     build_static_tensors_device,
     collect_pending,
     gang_ready_active,
+    gather_signature_rows,
     node_state_from_tensors,
     score_weights,
 )
@@ -166,7 +167,8 @@ _KNOWN_JOB_ORDER = ("priority", "gang", "drf")
     static_argnames=(
         "comparators", "queue_comparators", "overused_gate", "use_static",
         "n_queues", "weights", "enforce_pod_count", "window", "batch_runs",
-        "sorted_jobs", "has_releasing", "step_kernel", "queue_delta", "mesh",
+        "sorted_jobs", "has_releasing", "step_kernel", "queue_delta",
+        "sig_compress", "mesh",
     ),
 )
 def fused_allocate(
@@ -208,6 +210,10 @@ def fused_allocate(
     # run-length batching
     run_len: jnp.ndarray,          # i32 [T] consecutive identical-request tasks
                                    #   starting here (within one job)
+    sig_of_task: jnp.ndarray,      # i32 [T] signature-class id per task
+                                   #   (ops/sig_compress.py; read only under
+                                   #   sig_compress — the [S, N] class static
+                                   #   tensors index through it)
     *,
     comparators: Tuple[str, ...],
     queue_comparators: Tuple[str, ...] = (),
@@ -222,6 +228,7 @@ def fused_allocate(
     has_releasing: bool = True,
     step_kernel: bool = False,
     queue_delta: bool = False,
+    sig_compress: bool = False,
     mesh=None,
 ):
     n = idle.shape[0]
@@ -634,6 +641,10 @@ def fused_allocate(
         )
         init_req = init_resreq[t_idx]
         req = resreq[t_idx]
+        # Signature-compressed static tensors (docs/LP_PLACEMENT.md
+        # "Signature classes"): the static row of a task is its CLASS's
+        # [S, N] row, reached through one extra tiny [T] gather.
+        s_idx = sig_of_task[t_idx] if (use_static and sig_compress) else t_idx
 
         if step_kernel:
             # The whole selection stage — epsilon fit, gates, static mask,
@@ -642,8 +653,8 @@ def fused_allocate(
             # block, the ledger scatters, and scalar bookkeeping.
             initq_c = jax.lax.dynamic_slice(initq_T, (0, t_idx), (r8, 1))
             req_c = jax.lax.dynamic_slice(req_T, (0, t_idx), (r8, 1))
-            smask_row = static_mask[t_idx][None, :] if use_static else smask_dummy
-            sscore_row = static_score[t_idx][None, :] if use_static else sscore_dummy
+            smask_row = static_mask[s_idx][None, :] if use_static else smask_dummy
+            sscore_row = static_score[s_idx][None, :] if use_static else sscore_dummy
             kern_qid = None
             if mesh is None:
                 best, best_score, kern_cap, kern_pods = step_select(
@@ -690,7 +701,7 @@ def fused_allocate(
             feasible = fit_idle & node_gate
         if not step_kernel:
             if use_static:
-                feasible = feasible & static_mask[t_idx]
+                feasible = feasible & static_mask[s_idx]
             if enforce_pod_count:
                 feasible = feasible & (node_state[:, 2 * r_dim] < pods_limit_f)
 
@@ -700,7 +711,7 @@ def fused_allocate(
                 # (build_static_tensors*), and dynamic_score is finite by
                 # construction, so `any_feasible` below can safely derive
                 # feasibility from the winner's masked score.
-                score = score + static_score[t_idx]
+                score = score + static_score[s_idx]
             masked_score = jnp.where(feasible, score, neg_inf)
             best = jnp.argmax(masked_score)
             # Feasibility of the winner == any feasibility: reuses the argmax
@@ -791,7 +802,7 @@ def fused_allocate(
                     )
                     s_js = dynamic_score(req, avail, alloc_b, *weights)
                     if use_static:
-                        s_js = s_js + static_score[t_idx, best]
+                        s_js = s_js + static_score[s_idx, best]
                     ok_s = (s_js > second) | ((s_js == second) & (best < second_idx))
                     ok_js = ok_js & (jnp.cumprod(ok_s.astype(jnp.int32)) > 0)
                 fit_count = jnp.max(jnp.where(ok_js & (js <= hi0), js, 1))
@@ -1370,6 +1381,89 @@ class FusedAllocator:
         # Pipeline-onto-releasing only exists while something is releasing;
         # otherwise half the fit work folds away at trace time.
         self.has_releasing = bool(np.any(st.nodes.releasing))
+
+        # --- signature-class compression (docs/LP_PLACEMENT.md) -------------
+        # SCHEDULER_TPU_SIG_COMPRESS: collapse the [T, N] static seam down
+        # to [S, N] signature classes (ops/sig_compress.py).  Derived AFTER
+        # the run-merge above, so the cohort run table is computed from the
+        # uncompressed tensors (run_dev bitwise-identical on/off), and
+        # BEFORE the argument staging / LP admission below, so both consume
+        # the class tensors.  The class key reuses the cohort task_sig
+        # derivation (megakernel.request_signature_ids) plus the mega
+        # path's static-signature ids — sessions whose static builders have
+        # no per-task signature cannot compress soundly and refuse.
+        from scheduler_tpu.ops import sig_compress as _sc
+
+        self.sig_mode = _sc.sig_compress_mode()
+        self.sig_compress = False
+        self.sig_reason = None
+        self.sig_classes = 0
+        self.sig_of_task = None      # np i32 [T] class id per flat task
+        self.class_count = None      # np i32 [S] multiplicity per class
+        self._sig_bucket = tb        # row bucket of the staged static tensors
+        self._req_sig_cache = None   # hoisted cohort signature (mega reuses)
+        self._lp_sig_host = None     # [S]-class LP operands (rows + count)
+        self._lp_sig_dev = None      # their staged device twins (lazy)
+        static_sids = None
+        if self.sig_mode != "off" and t_total > 0:
+            if self.use_static:
+                static_sids = self._static_signature_ids(ssn)
+            if self.use_static and static_sids is None:
+                self.sig_reason = (
+                    "unknown static builders (no per-task static signature)"
+                )
+            else:
+                from scheduler_tpu.ops.megakernel import request_signature_ids
+
+                req_s = np.asarray(
+                    scale_columns(st.tasks.resreq[:t_total], scale),
+                    dtype=np.float32,
+                )
+                init_s = np.asarray(
+                    scale_columns(st.tasks.init_resreq[:t_total], scale),
+                    dtype=np.float32,
+                )
+                inverse, uniq_rows = request_signature_ids(req_s, init_s)
+                self._req_sig_cache = (req_s, init_s, inverse, uniq_rows)
+                jidx = st.tasks.job_idx[:t_total]
+                sig_of_task, class_count, rep_rows = _sc.derive_classes(
+                    inverse, static_sids, queues_idx[jidx], priorities[jidx]
+                )
+                s_count = class_count.shape[0]
+                if self.sig_mode == "auto" and s_count >= t_total:
+                    # auto only pays the indirection when something dedupes;
+                    # "on" forces the degenerate S == T shape (parity tests).
+                    self.sig_reason = "no repeated signatures (S == T)"
+                else:
+                    self.sig_compress = True
+                    self.sig_classes = s_count
+                    self.sig_of_task = sig_of_task
+                    self.class_count = class_count
+                    sb = bucket(s_count)
+                    self._sig_bucket = sb
+                    if self.use_static:
+                        static_mask_dev, static_score_dev = (
+                            gather_signature_rows(
+                                static_mask_dev, static_score_dev,
+                                rep_rows, sb,
+                            )
+                        )
+                    # Per-class LP operands ([S, R] request rows + the f32
+                    # multiplicity vector that weights each class row's
+                    # mass), staged lazily by _dispatch_lp.  Pad classes
+                    # carry zero count: zero mass, zero load.
+                    init_c = np.zeros((sb, r), dtype=np.float32)
+                    init_c[:s_count] = init_s[rep_rows]
+                    req_c = np.zeros((sb, r), dtype=np.float32)
+                    req_c[:s_count] = req_s[rep_rows]
+                    count_c = np.zeros(sb, dtype=np.float32)
+                    count_c[:s_count] = class_count
+                    self._lp_sig_host = (init_c, req_c, count_c)
+        # Per-task class-id column for the device programs (pad tasks point
+        # at class 0 — never selected, the pop accounting masks them).
+        sig_host = np.zeros(tb, dtype=np.int32)
+        if self.sig_of_task is not None:
+            sig_host[:t_total] = self.sig_of_task
         queue_deserved = np.zeros((qb, r), dtype=np.float64)
         queue_alloc = np.zeros((qb, r), dtype=np.float64)
         if self.queue_comparators or self.overused_gate:
@@ -1420,7 +1514,7 @@ class FusedAllocator:
             state, node_gate, scale, tb, offsets, nums, deficits, gang_order,
             priorities, tiebreak, queues_idx, alloc_init, queue_rank,
             queue_has, queue_deserved, queue_alloc, total, run_dev,
-            static_mask_dev, static_score_dev,
+            static_mask_dev, static_score_dev, sig_host,
         )
 
         # Multi-chip: shard the node axis over the configured mesh (--mesh /
@@ -1439,8 +1533,14 @@ class FusedAllocator:
         if self.allocator == "lp":
             from scheduler_tpu.ops import lp_place
 
+            # Signature compression shrinks the iteration working set from
+            # [T, N] to [S, N] — the admission gate sizes what the program
+            # actually holds across iterations, so duplicate-heavy sessions
+            # past the per-task limit become LP-native instead of falling
+            # back (docs/LP_PLACEMENT.md "Signature classes").
             self.use_lp, self.lp_reason = lp_place.lp_supported(
-                self.flat_count, self.has_releasing, tb, nb, mesh
+                self.flat_count, self.has_releasing, self._sig_bucket, nb,
+                mesh,
             )
             # The LP program shards only when the staged args do (tiny
             # clusters whose node bucket cannot divide the mesh stay
@@ -1523,9 +1623,11 @@ class FusedAllocator:
                 n_sigs=1,  # sig count checked below after the table builds
                 comparators=self.comparators,
             )
-            static_sids = None
             if mega_ok and self.use_static:
-                static_sids = self._static_signature_ids(ssn)
+                # The sig-compression block above may have computed the
+                # static-signature ids already; derive them here otherwise.
+                if static_sids is None:
+                    static_sids = self._static_signature_ids(ssn)
                 mega_ok = static_sids is not None and _mk.mega_supported(
                     has_releasing=self.has_releasing,
                     use_static=True,
@@ -1620,17 +1722,20 @@ class FusedAllocator:
         t = self.flat_count
         if t == 0:
             return
-        req_s = np.asarray(
-            scale_columns(self.st.tasks.resreq[:t], scale), dtype=np.float32
-        )
-        init_s = np.asarray(
-            scale_columns(self.st.tasks.init_resreq[:t], scale), dtype=np.float32
-        )
-        from scheduler_tpu.api.job_info import unique_row_codes
-
-        inverse, uniq_rows = unique_row_codes(
-            np.concatenate([req_s, init_s], axis=1)
-        )
+        if self._req_sig_cache is not None:
+            # Hoisted by the sig-compression block: the SAME derivation
+            # (megakernel.request_signature_ids), computed once per build.
+            req_s, init_s, inverse, uniq_rows = self._req_sig_cache
+        else:
+            req_s = np.asarray(
+                scale_columns(self.st.tasks.resreq[:t], scale),
+                dtype=np.float32,
+            )
+            init_s = np.asarray(
+                scale_columns(self.st.tasks.init_resreq[:t], scale),
+                dtype=np.float32,
+            )
+            inverse, uniq_rows = _mk.request_signature_ids(req_s, init_s)
         s_count = uniq_rows.shape[0]
         if s_count > 4096:
             return  # request mix too wide for the per-signature table
@@ -1676,6 +1781,12 @@ class FusedAllocator:
             s_count = int(static_sids.max()) + 1 if static_sids.size else 1
             s_pad = max(8, -(-s_count // 8) * 8)
             _, first_rows = np.unique(static_sids, return_index=True)
+            if self.sig_compress and self.sig_of_task is not None:
+                # The staged static tensors are the [S, N] CLASS rows
+                # (ops/sig_compress.py): reach each static signature's row
+                # through its representative task's class id — sound, the
+                # class key includes the static-signature id.
+                first_rows = self.sig_of_task[first_rows].astype(np.int64)
             rep = jnp.asarray(first_rows.astype(np.int64))
             smask = (
                 jnp.zeros((s_pad, nb), jnp.float32)
@@ -1948,6 +2059,14 @@ class FusedAllocator:
         if self.allocator != allocator_flavor():
             # Same contract as queue_delta: the flavor selects which device
             # program this engine staged (docs/LP_PLACEMENT.md).
+            return False
+        from scheduler_tpu.ops.sig_compress import sig_compress_mode
+
+        if self.sig_mode != sig_compress_mode():
+            # The mode selects [T, N] vs [S, N] static staging and the LP
+            # program's class weighting; pinned by the cache key's env
+            # component in the cached flow — this re-check covers direct
+            # update() callers (parity tests).
             return False
         queue_names = sorted(
             ssn.queues, key=lambda q: (ssn.queues[q].creation_timestamp, q)
@@ -2371,7 +2490,7 @@ class FusedAllocator:
             (state, node_gate, scale, tb, offsets, nums, deficits, gang_order,
              priorities, tiebreak, queues_idx, alloc_init, queue_rank,
              queue_has, queue_deserved, queue_alloc, total, run_dev,
-             static_mask_dev, static_score_dev) = self._args_parts
+             static_mask_dev, static_score_dev, sig_host) = self._args_parts
             from scheduler_tpu.ops.transfer_cache import to_device
 
             st = self.st
@@ -2401,6 +2520,7 @@ class FusedAllocator:
                 to_device(queue_alloc, np.float32),
                 to_device(scale_columns(total[None, :], scale)[0], np.float32),
                 run_dev,
+                to_device(sig_host),
             )
             if self._mesh is not None:
                 from scheduler_tpu.ops.mesh import shard_fused_args
@@ -2495,6 +2615,7 @@ class FusedAllocator:
                 has_releasing=self.has_releasing,
                 step_kernel=self.step_kernel,
                 queue_delta=self.queue_delta,
+                sig_compress=self.sig_compress and self.use_static,
                 mesh=self._mesh,
             )
 
@@ -2516,18 +2637,36 @@ class FusedAllocator:
         self._dev_stats = None
         args = self.args
         shardcheck.check_dispatch(self._mesh, args)
+        lp_kw = dict(
+            iters=lp_place.lp_iters(),
+            tau=lp_place.lp_tau(),
+            tol=lp_place.lp_tol(),
+            weights=self.weights,
+            enforce_pod_count=self.enforce_pod_count,
+            use_static=self.use_static,
+            mesh=self._lp_mesh,
+        )
         with sanitize.guard():
-            marginals, feas, pref, lp_raw = lp_place.lp_relax(
-                args[0], args[3], args[2], args[4], args[5],
-                args[9], args[10], args[6], args[7], args[8],
-                iters=lp_place.lp_iters(),
-                tau=lp_place.lp_tau(),
-                tol=lp_place.lp_tol(),
-                weights=self.weights,
-                enforce_pod_count=self.enforce_pod_count,
-                use_static=self.use_static,
-                mesh=self._lp_mesh,
-            )
+            if self.sig_compress and self._lp_sig_host is not None:
+                # Signature-compressed relaxation (docs/LP_PLACEMENT.md
+                # "Signature classes"): iterate over the [S, N] class
+                # tensor — each class row carries class_count units of
+                # mass — instead of the [T, N] per-task tensor.  The
+                # staged static positions already hold the class rows, so
+                # the marginals come back [S, N] and slot straight into
+                # the repair's static seam with the sig_of_task gather.
+                init_c, req_c, count_c = self._lp_class_dev()
+                marginals, feas, pref, lp_raw = lp_place.lp_relax(
+                    args[0], args[3], args[2], args[4], args[5],
+                    args[9], args[10], args[6], init_c, req_c, count_c,
+                    **lp_kw,
+                )
+            else:
+                marginals, feas, pref, lp_raw = lp_place.lp_relax(
+                    args[0], args[3], args[2], args[4], args[5],
+                    args[9], args[10], args[6], args[7], args[8],
+                    **lp_kw,
+                )
             self._lp_dev = (pref, lp_raw)
             # The marginals/feasibility ride the static-tensor positions of
             # the staged argument tuple (FUSED_ARG_FAMILIES declares both as
@@ -2551,8 +2690,31 @@ class FusedAllocator:
                 has_releasing=False,
                 step_kernel=False,
                 queue_delta=self.queue_delta,
+                sig_compress=self.sig_compress,
                 mesh=self._mesh,
             )
+
+    def _lp_class_dev(self):
+        """The staged device twins of the [S]-class LP operands (request
+        rows + multiplicity), replicated on the mesh like the per-task
+        request tables they replace.  Staged once per build; the class
+        table is layout-derived, so a delta-refresh hit keeps them."""
+        if self._lp_sig_dev is None:
+            from scheduler_tpu.ops.transfer_cache import to_device
+
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as _P
+
+                sharding = NamedSharding(self._mesh, _P())
+                self._lp_sig_dev = tuple(
+                    to_device(a, np.float32, sharding=sharding)
+                    for a in self._lp_sig_host
+                )
+            else:
+                self._lp_sig_dev = tuple(
+                    to_device(a, np.float32) for a in self._lp_sig_host
+                )
+        return self._lp_sig_dev
 
     def readback(self) -> np.ndarray:
         """Blocking collect of the dispatched program's placement codes
@@ -2659,14 +2821,42 @@ class FusedAllocator:
                 lp.update(lp_place.lp_stats_dict(lp_raw))
                 if enc is not None:
                     t = self.flat_count
+                    if self.sig_compress and self.sig_of_task is not None:
+                        # Class-axis preference expands back to per-task
+                        # rows through the same sig_of_task gather the
+                        # repair used (docs/LP_PLACEMENT.md).
+                        pref_t = pref[self.sig_of_task]
+                    else:
+                        pref_t = pref[:t]
                     lp.update(lp_place.lp_quality(
-                        enc[:t], pref[:t],
+                        enc[:t], pref_t,
                         self.st.tasks.resreq[:t],
                         self.st.nodes.idle,
                         self.st.tasks.job_idx[:t],
                         self.st.nodes.allocatable,
                     ))
             out["lp"] = lp
+        if self.sig_mode != "off" and self.flat_count > 0:
+            # Signature-compression evidence (docs/LP_PLACEMENT.md
+            # "Signature classes"): class count vs task count, the
+            # compression factor, and the resident bytes the class tensors
+            # save against the uncompressed [T, N] working set — the
+            # bench's ``detail.cycles[].sig`` payload.
+            from scheduler_tpu.ops import sig_compress as _sc
+
+            if self.sig_compress:
+                per_elem = 16 if self.use_lp else (5 if self.use_static else 0)
+                saved = (
+                    max(self._t_bucket - self._sig_bucket, 0)
+                    * self.n_bucket * per_elem
+                )
+                sig = _sc.sig_stats(self.sig_classes, self.flat_count, saved)
+                sig["engaged"] = True
+            else:
+                sig = {"engaged": False}
+                if self.sig_reason:
+                    sig["reason"] = self.sig_reason
+            out["sig"] = sig
         raw = self._stats_raw
         if raw is not None:
             steps = int(raw[STATS.STEPS])

@@ -96,6 +96,20 @@ class SIG_REQ:
     INIT = 8   # span 8: init (gate) request rows
 
 
+class SIG_CLASS:
+    """Signature-compression class key columns (``ops/sig_compress.py``
+    ``derive_classes``, docs/LP_PLACEMENT.md "Signature classes"): the
+    [T, 4] i64 key matrix whose unique rows define the classes that
+    compress the [T, N] static seam down to [S, N].  REQ_SIG is the cohort
+    ``task_sig`` id (``ops/megakernel.request_signature_ids`` — shared
+    derivation, so the two signature notions cannot drift)."""
+
+    REQ_SIG = 0     # cohort request-signature id (request + init rows)
+    STATIC_SIG = 1  # per-task static-signature id (0 when no static rows)
+    QUEUE = 2       # queue index of the task's job
+    PRIORITY = 3    # PriorityClass value of the task's job
+
+
 class JOB_STATE:
     """XLA while-loop per-job carry columns (``ops/fused.py`` job_state,
     f32 [J, 3 + 8]) — the host-loop twin of ``JOB_SCRATCH`` rows 0..2/8..15."""
@@ -178,6 +192,9 @@ BUFFERS = {
     "ops/lp_place.py": {
         "lp_raw": ("LP_STATS", 0),
         "pack": ("LP_PACK", 0),
+    },
+    "ops/sig_compress.py": {
+        "key_cols": ("SIG_CLASS", 1),
     },
     "ops/pallas_kernels.py": {
         "ns_ref": ("STEP_NODE", 0),
@@ -367,6 +384,26 @@ SHARD_SITES = {
         "out": ("node_trailing_2d", "node_trailing_2d", "replicated",
                 "replicated"),
     },
+    # Signature-compressed LP iteration twins (ops/sig_compress.py,
+    # docs/LP_PLACEMENT.md "Signature classes"): same shape contract as the
+    # plain LP sites with the task axis collapsed to [S] classes, plus ONE
+    # extra replicated operand — the per-class multiplicity vector that
+    # weights each class row's mass in the capacity projection.  The
+    # [4, S] row-stat pack still all-gathers once per iteration.
+    "ops/lp_place.py::_lp_iterate_sig_1d": {
+        "in": ("node_major", "node_major", "node_major", "node_major",
+               "node_major", "node_trailing", "node_trailing",
+               "replicated", "replicated", "replicated", "replicated"),
+        "out": ("node_trailing", "node_trailing", "replicated", "replicated"),
+    },
+    "ops/lp_place.py::_lp_iterate_sig_2d": {
+        "in": ("node_major_2d", "node_major_2d", "node_major_2d",
+               "node_major_2d", "node_major_2d", "node_trailing_2d",
+               "node_trailing_2d", "replicated", "replicated", "replicated",
+               "replicated"),
+        "out": ("node_trailing_2d", "node_trailing_2d", "replicated",
+                "replicated"),
+    },
 }
 
 # Per-site collective budget in the COMPILED HLO, counted per loop step
@@ -409,6 +446,16 @@ COLLECTIVE_BUDGET = {
         "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
     },
     "ops/lp_place.py::_lp_iterate_2d": {
+        "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
+    },
+    # Signature-compressed twins: the class-tensor pack rides the SAME one
+    # all-gather per fixed-point iteration — compression shrinks the pack's
+    # row axis (T -> S), never the collective count
+    # (verified: shard_budget on both mesh shapes).
+    "ops/lp_place.py::_lp_iterate_sig_1d": {
+        "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
+    },
+    "ops/lp_place.py::_lp_iterate_sig_2d": {
         "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
     },
 }

@@ -114,8 +114,9 @@ def lp_tol() -> float:
 
 
 def lp_limit_bytes() -> int:
-    """Device-memory admission gate for the [T, N] working set (bytes,
-    PER SHARD).  The relaxation holds ~4 [T, N] f32 temporaries (logits,
+    """Device-memory admission gate for the iteration working set (bytes,
+    PER SHARD): [S, N] under signature compression, [T, N] otherwise.
+    The relaxation holds ~4 row-by-node f32 temporaries (logits,
     exponentials, marginals, feasibility/static rows)."""
     from scheduler_tpu.utils.envflags import env_int
 
@@ -123,26 +124,28 @@ def lp_limit_bytes() -> int:
 
 
 def lp_supported(
-    flat_count: int, has_releasing: bool, t_bucket: int, n_bucket: int, mesh
+    flat_count: int, has_releasing: bool, row_bucket: int, n_bucket: int, mesh
 ) -> Tuple[bool, Optional[str]]:
     """Admission gate for the LP flavor: ``(ok, reason-when-not)``.
 
     * Releasing capacity is not modeled by the relaxation (the pipeline
       arm has no fractional analogue), so those sessions keep greedy.
-    * The [T, N] working set must fit ``SCHEDULER_TPU_LP_LIMIT`` per
+    * The iteration working set must fit ``SCHEDULER_TPU_LP_LIMIT`` per
       shard — greedy has no such tensor and stays the scalable default
-      far past it.
+      far past it.  ``row_bucket`` is what the program actually holds:
+      the [T] task bucket uncompressed, the [S] class bucket under
+      signature compression (docs/LP_PLACEMENT.md "Signature classes").
     """
     if flat_count == 0:
         return False, "no pending tasks"
     if has_releasing:
         return False, "releasing capacity (pipelined placements) not modeled"
     shards = mesh.size if mesh is not None else 1
-    per_shard = 16 * t_bucket * max(n_bucket // shards, 1)
+    per_shard = 16 * row_bucket * max(n_bucket // shards, 1)
     limit = lp_limit_bytes()
     if per_shard > limit:
         return False, (
-            f"[T={t_bucket}, N={n_bucket}] working set "
+            f"[rows={row_bucket}, N={n_bucket}] working set "
             f"~{per_shard // (1024 * 1024)}MB/shard exceeds "
             f"SCHEDULER_TPU_LP_LIMIT={limit // (1024 * 1024)}MB"
         )
@@ -273,6 +276,12 @@ def lp_relax(
     mins: jnp.ndarray,          # f32 [R]     replicated
     init_resreq: jnp.ndarray,   # f32 [T, R]  replicated
     resreq: jnp.ndarray,        # f32 [T, R]  replicated
+    class_count=None,           # f32 [T]     replicated | None — signature-
+                                #   class multiplicity (ops/sig_compress.py):
+                                #   row t carries class_count[t] units of
+                                #   mass in the capacity projection; None =
+                                #   the uncompressed per-task iteration,
+                                #   bitwise pre-existing behavior
     *,
     iters: int,
     tau: float,
@@ -287,7 +296,15 @@ def lp_relax(
     open-state feasibility mask (both node-trailing on a mesh — they slot
     straight into the repair program's static-tensor positions), the
     per-pod preferred node (argmax of the relaxed solution, the
-    repair-fallback reference), and the i32 ``LP_STATS`` evidence row."""
+    repair-fallback reference), and the i32 ``LP_STATS`` evidence row.
+
+    With ``class_count`` the task axis is the SIGNATURE-CLASS axis
+    (docs/LP_PLACEMENT.md "Signature classes"): every operand row is one
+    class of ``class_count[s]`` identical tasks, the capacity projection
+    weights each row's load by its multiplicity, and the [S, N] marginals
+    expand back to per-task rows only at the repair replay's
+    ``sig_of_task`` gather.  Each marginal row stays a per-UNIT
+    distribution (mass 1), so the expansion is the identity row copy."""
     n = idle.shape[0]
     if not use_static:
         # Shape-normalized dummies: [1, N] shards cleanly on the trailing
@@ -309,6 +326,11 @@ def lp_relax(
         cap, req_aug = _capacity(
             idle, task_count, pods_limit, resreq, enforce_pod_count
         )
+        if class_count is not None:
+            # Multiplicity-weighted load: class s places class_count[s]
+            # units of its per-unit distribution, so its aggregate demand
+            # rides the projection matmul as one weighted row.
+            req_aug = req_aug * class_count[:, None]
 
         def merge_single(pack):
             # One block == the whole node axis: the streaming merge is the
@@ -335,7 +357,7 @@ def lp_relax(
     axes = _node_shard_axes(mesh)
 
     def shard_fn(idle_l, alloc_l, tc_l, plim_l, gate_l, smask_l, sscore_l,
-                 mins_r, initq_r, req_r):
+                 mins_r, initq_r, req_r, count_r=None):
         logits, feas = _logits_and_feasibility(
             idle_l, alloc_l, tc_l, plim_l, gate_l, smask_l, sscore_l,
             mins_r, initq_r, req_r, **build_kw,
@@ -343,6 +365,10 @@ def lp_relax(
         cap, req_aug = _capacity(
             idle_l, tc_l, plim_l, req_r, enforce_pod_count
         )
+        if count_r is not None:
+            # Signature-class variant: multiplicity-weighted row loads
+            # (see the single-chip branch above).
+            req_aug = req_aug * count_r[:, None]
         offset = _shard_linear_index(mesh) * n_local
 
         def merge_mesh(pack):
@@ -357,6 +383,16 @@ def lp_relax(
         )
         return x, feas, pref, lp_raw
 
+    if class_count is not None:
+        iterate = (
+            _lp_iterate_sig_2d if _is_multi_host(mesh) else _lp_iterate_sig_1d
+        )
+        return iterate(
+            shard_fn, mesh,
+            idle, allocatable, task_count, pods_limit, node_gate,
+            static_mask, static_score, mins, init_resreq, resreq,
+            class_count,
+        )
     iterate = _lp_iterate_2d if _is_multi_host(mesh) else _lp_iterate_1d
     return iterate(
         shard_fn, mesh,
@@ -405,6 +441,56 @@ def _lp_iterate_2d(shard_fn, mesh, *operands):
             _P((_RAXIS, _NAXIS)),
             _P(None, (_RAXIS, _NAXIS)), _P(None, (_RAXIS, _NAXIS)),
             _P(), _P(), _P(),
+        ),
+        out_specs=(
+            _P(None, (_RAXIS, _NAXIS)), _P(None, (_RAXIS, _NAXIS)),
+            _P(), _P(),
+        ),
+        check_vma=False,
+    )(*operands)
+
+
+# Signature-class twins (ops/sig_compress.py, docs/LP_PLACEMENT.md
+# "Signature classes"): same contract as the plain sites with the task
+# axis collapsed to [S] classes, plus ONE extra replicated operand — the
+# per-class multiplicity vector.  Distinct literal sites for the same
+# reason as above: the static sharding gate and the HLO budget check both
+# key on "module::def" with literal specs.
+
+def _lp_iterate_sig_1d(shard_fn, mesh, *operands):
+    from jax.sharding import PartitionSpec as _P
+
+    from scheduler_tpu.ops.sharded import NODE_AXIS as _NAXIS
+    from scheduler_tpu.ops.sharded import shard_map as _shard_map
+
+    return _shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            _P(_NAXIS), _P(_NAXIS), _P(_NAXIS), _P(_NAXIS), _P(_NAXIS),
+            _P(None, _NAXIS), _P(None, _NAXIS), _P(), _P(), _P(), _P(),
+        ),
+        out_specs=(_P(None, _NAXIS), _P(None, _NAXIS), _P(), _P()),
+        check_vma=False,
+    )(*operands)
+
+
+def _lp_iterate_sig_2d(shard_fn, mesh, *operands):
+    from jax.sharding import PartitionSpec as _P
+
+    from scheduler_tpu.ops.sharded import NODE_AXIS as _NAXIS
+    from scheduler_tpu.ops.sharded import REPLICA_AXIS as _RAXIS
+    from scheduler_tpu.ops.sharded import shard_map as _shard_map
+
+    return _shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            _P((_RAXIS, _NAXIS)), _P((_RAXIS, _NAXIS)),
+            _P((_RAXIS, _NAXIS)), _P((_RAXIS, _NAXIS)),
+            _P((_RAXIS, _NAXIS)),
+            _P(None, (_RAXIS, _NAXIS)), _P(None, (_RAXIS, _NAXIS)),
+            _P(), _P(), _P(), _P(),
         ),
         out_specs=(
             _P(None, (_RAXIS, _NAXIS)), _P(None, (_RAXIS, _NAXIS)),

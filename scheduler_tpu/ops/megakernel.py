@@ -957,6 +957,19 @@ def mega_allocate(
     return out.reshape(-1)[:t_cap], stats[0]
 
 
+def request_signature_ids(req_s: np.ndarray, init_s: np.ndarray):
+    """The cohort task-signature derivation (docs/COHORT.md): dense ids over
+    identical scaled (request, init-request) row pairs, plus the unique
+    rows themselves.  ONE definition shared by the mega kernel's
+    per-signature request table (``FusedAllocator._prepare_mega``) and the
+    signature-compression classes (``ops/sig_compress.py``,
+    docs/LP_PLACEMENT.md "Signature classes"), so the two signature
+    notions can never drift."""
+    from scheduler_tpu.api.job_info import unique_row_codes
+
+    return unique_row_codes(np.concatenate([req_s, init_s], axis=1))
+
+
 def pack_lane_i32(arr: np.ndarray, lanes: int) -> np.ndarray:
     out = np.zeros((1, lanes), dtype=np.int32)
     out[0, : arr.shape[0]] = arr

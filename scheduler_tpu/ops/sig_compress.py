@@ -1,0 +1,105 @@
+"""Signature-granular placement: compress the [T, N] seam by request classes.
+
+CvxCluster (PAPERS, arxiv 2605.01614) solves large granular allocation
+problems 100-1000x faster by collapsing identical demands into classes, and
+Gavel (arxiv 2008.09213) shows policy math over class matrices rather than
+per-task rows is the scalable formulation.  This module is that idea applied
+to the engine's static-tensor seam: the ``[T, N]`` static mask/score tensors
+(``ops/allocator.build_static_tensors_device``) and the LP relaxation's
+working set (``ops/lp_place.py``) dedupe down to ``[S, N]`` **signature
+classes**, where a class is one unique
+
+    (request-signature, static-signature, queue, priority)
+
+tuple (``SIG_CLASS`` column order, ``ops/layout.py``).  The request
+signature IS the cohort ``task_sig`` id — derived by the same
+``ops.megakernel.request_signature_ids`` call the mega kernel's
+per-signature table uses, so the two signature notions can never drift
+(docs/COHORT.md) — and the static signature is the mega path's per-task
+static id (``FusedAllocator._static_signature_ids``): tasks in one class
+share their request rows AND their static ``[N]`` mask/score rows by
+construction.
+
+What rides the class axis (docs/LP_PLACEMENT.md "Signature classes"):
+
+* the greedy engines' static lookup — ``static_mask[t_idx]`` becomes
+  ``static_mask[sig_of_task[t_idx]]`` over the ``[S, N]`` class tensors, so
+  EVERY flavor's resident score tensors shrink by the signature factor;
+* the LP relaxation — Sinkhorn iterates over the ``[S, N]`` class tensor
+  with multiplicity-weighted row mass (``class_count[s]`` units per class
+  row instead of 1), which lifts ``SCHEDULER_TPU_LP_LIMIT`` pressure at
+  100k+ pods; marginals expand back to per-task rows only at the greedy
+  repair replay (the same ``sig_of_task`` indirection), so capacity, gang
+  and queue semantics stay the existing ``fused_allocate`` while-loop's.
+
+Engaged via ``SCHEDULER_TPU_SIG_COMPRESS``: ``off`` (bitwise pre-existing
+behavior), ``on`` (force, even the degenerate S == T shape), ``auto``
+(default — engage only when some signature actually repeats, so all-unique
+sessions never pay the indirection).  Registered in
+``ops/engine_cache._ENV_KEYS``; the class table itself is layout-derived
+and pinned by the layout token (docs/ENGINE_CACHE.md).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from scheduler_tpu.ops.layout import SIG_CLASS
+
+
+def sig_compress_mode() -> str:
+    """``SCHEDULER_TPU_SIG_COMPRESS``: ``off`` | ``on`` | ``auto``."""
+    from scheduler_tpu.utils.envflags import env_str
+
+    return env_str("SCHEDULER_TPU_SIG_COMPRESS", "auto",
+                   choices=("off", "on", "auto"))
+
+
+def derive_classes(
+    req_sig: np.ndarray,                  # i64 [T] cohort request-signature id
+    static_sig: Optional[np.ndarray],     # i32 [T] static-signature id | None
+    queue_of_task: np.ndarray,            # i32 [T]
+    priority_of_task: np.ndarray,         # i32 [T]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Dense signature classes over the flat task axis.
+
+    Returns ``(sig_of_task, class_count, rep_rows)``:
+
+    * ``sig_of_task`` i32 [T] — class id per task (dense ``0..S-1``);
+    * ``class_count`` i32 [S] — tasks per class (the LP row multiplicity);
+    * ``rep_rows``    i64 [S] — one representative task row per class (its
+      FIRST task in flat order), the gather index that builds the ``[S, N]``
+      class tensors from the per-task ``[T, N]`` build.
+
+    The key matrix is literal ``SIG_CLASS`` column order so the class
+    definition is registry data, not convention.  ``static_sig`` is ``None``
+    for sessions without static tensors — the column is zero then (every
+    task trivially shares the dummy static rows).
+    """
+    from scheduler_tpu.api.job_info import unique_row_codes
+
+    t = req_sig.shape[0]
+    key_cols = np.zeros((t, 4), dtype=np.int64)
+    key_cols[:, SIG_CLASS.REQ_SIG] = req_sig
+    if static_sig is not None:
+        key_cols[:, SIG_CLASS.STATIC_SIG] = static_sig
+    key_cols[:, SIG_CLASS.QUEUE] = queue_of_task
+    key_cols[:, SIG_CLASS.PRIORITY] = priority_of_task
+    sig_of_task, _ = unique_row_codes(key_cols)
+    class_count = np.bincount(sig_of_task).astype(np.int32)
+    # First occurrence of each dense id, in id order (ids are 0..S-1).
+    _, rep_rows = np.unique(sig_of_task, return_index=True)
+    return sig_of_task.astype(np.int32), class_count, rep_rows.astype(np.int64)
+
+
+def sig_stats(classes: int, tasks: int, bytes_saved: int) -> dict:
+    """The evidence block (``FusedAllocator.run_stats()['sig']`` →
+    ``phases.note('sig')`` → bench ``detail.cycles[].sig``)."""
+    return {
+        "classes": int(classes),
+        "tasks": int(tasks),
+        "compression": round(tasks / max(classes, 1), 2),
+        "bytes_saved": int(bytes_saved),
+    }

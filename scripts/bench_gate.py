@@ -33,6 +33,11 @@ a directory given as argv[1]):
   than greedy beyond ``LP_BIND_TOLERANCE`` fails the gate — a relaxation
   is allowed to trade exactness for parallelism only inside the
   documented tolerance.  Different shapes are not compared (no verdict).
+  LP artifacts carrying signature-compression evidence
+  (``detail.cycles[].sig``, docs/LP_PLACEMENT.md "Signature classes")
+  must additionally record ``classes <= tasks`` and a finite positive
+  compression factor on every engaged cycle — a malformed evidence chain
+  is exit 1, not a measurement.
 
 Families gate independently (a regression in either fails the build); a
 family with fewer than two artifacts is simply not judged yet.  Regression
@@ -96,6 +101,40 @@ LP_BIND_TOLERANCE = 0.02
 
 # detail.mesh keys every XL artifact must carry, with their types.
 _MESH_KEYS = (("devices", int), ("processes", int), ("axes", dict))
+
+
+def sig_block_problem(detail: dict):
+    """Sanity-check the signature-compression evidence riding an artifact
+    (``detail.cycles[].sig``, docs/LP_PLACEMENT.md "Signature classes"):
+    an ENGAGED block must record ``classes <= tasks`` (a class is a
+    non-empty group of tasks) and a finite positive compression factor —
+    anything else is a malformed evidence chain, not a measurement.
+    Returns the reason string, or None when every block is sane (absent
+    blocks are fine: compression is optional and auto-gated)."""
+    import math
+
+    for i, cycle in enumerate(detail.get("cycles") or []):
+        sig = cycle.get("sig")
+        if not isinstance(sig, dict) or not sig.get("engaged"):
+            continue
+        classes, tasks = sig.get("classes"), sig.get("tasks")
+        comp = sig.get("compression")
+        if not isinstance(classes, int) or not isinstance(tasks, int):
+            return (f"cycle {i} sig block is missing integer "
+                    "classes/tasks counts")
+        if classes < 1:
+            return (f"cycle {i} sig block records classes={classes} on an "
+                    "engaged cycle — a signature class is a non-empty "
+                    "group of tasks")
+        if classes > tasks:
+            return (f"cycle {i} sig block records classes={classes} > "
+                    f"tasks={tasks} — a signature class is a non-empty "
+                    "group of tasks")
+        if (not isinstance(comp, (int, float)) or not math.isfinite(comp)
+                or comp <= 0):
+            return (f"cycle {i} sig block records a non-finite "
+                    f"compression factor {comp!r}")
+    return None
 
 
 def find_artifacts(root: Path, infix: str = ""):
@@ -192,6 +231,11 @@ def gate_lp_vs_greedy(root: Path) -> int:
             "detail.allocator == 'lp' — an LP artifact must be emitted "
             "under SCHEDULER_TPU_ALLOCATOR=lp (docs/LP_PLACEMENT.md)"
         )
+        return 1
+    sig_why = sig_block_problem(lp_detail)
+    if sig_why is not None:
+        print(f"bench-gate[lp-vs-greedy]: {lp_path.name} carries a "
+              f"malformed signature-compression block: {sig_why}")
         return 1
     if not greedy_arts:
         print("bench-gate[lp-vs-greedy]: no greedy BENCH_r*.json to compare "

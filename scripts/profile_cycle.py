@@ -19,7 +19,11 @@ into the relaxation iterations vs the repair replay vs the readback — the
 engine measures the split at its readback collect points, so no extra
 device syncs are inserted mid-cycle.  The LP quality block (iterations,
 convergence, binds, fragmentation, DRF distance, repair fallbacks) prints
-with the phases.
+with the phases.  The signature-compression block
+(``SCHEDULER_TPU_SIG_COMPRESS``, docs/LP_PLACEMENT.md "Signature
+classes") prints alongside: S classes vs T tasks, the compression factor,
+and the bytes the [S, N] class tensors save against the uncompressed
+[T, N] working set — or the recorded reason compression refused.
 
 ``queues`` > 1 profiles the MULTI-QUEUE cycle: proportion joins the plugin
 tiers (live share ordering + overused gate on device) and the pods spread
@@ -117,6 +121,18 @@ def run(n_nodes: int, n_pods: int, label: str, n_queues: int = 1) -> None:
         print(f"  lp                  {lp}")
         for k, v in sorted(engine.lp_phase.items()):
             print(f"  {k:<19} {v:8.3f}s")
+    # Signature-compression block (docs/LP_PLACEMENT.md "Signature
+    # classes"): S classes vs T tasks, the compression factor, and the
+    # resident bytes the [S, N] class tensors save against the
+    # uncompressed [T, N] working set (or why compression refused).
+    sig = stats.get("sig")
+    if sig:
+        if sig.get("engaged"):
+            print(f"  sig                 S={sig['classes']} "
+                  f"T={sig['tasks']} compression={sig['compression']}x "
+                  f"bytes_saved={sig['bytes_saved']:,}")
+        else:
+            print(f"  sig                 off ({sig.get('reason', 'n/a')})")
     print(f"  open_session        {t1 - t0:8.3f}s")
     print(f"  candidates          {t2 - t1:8.3f}s")
     print(f"  engine init         {t3 - t2:8.3f}s")

@@ -193,6 +193,35 @@ def _hlo_lp_iterate(mesh) -> str:
     return lowered.compile().as_text()
 
 
+def _hlo_lp_iterate_sig(mesh) -> str:
+    """Lower the SIGNATURE-COMPRESSED LP iteration twin
+    (``_lp_iterate_sig_*``, docs/LP_PLACEMENT.md "Signature classes"):
+    the task axis is the [S] class axis and the extra replicated operand
+    is the per-class multiplicity vector weighting each row's mass in the
+    capacity projection.  Same contract — ONE row-stat all-gather per
+    iteration; compression shrinks the pack's row axis, never the
+    collective count."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scheduler_tpu.ops.lp_place import lp_relax
+
+    p = _small_problem()
+    s = p["resreq"].shape[0]
+    lowered = lp_relax.lower(
+        jnp.asarray(p["idle"]), jnp.asarray(p["allocatable"]),
+        jnp.asarray(p["task_count"]), jnp.asarray(p["pods_limit"]),
+        jnp.asarray(np.ones(p["idle"].shape[0], bool)),
+        jnp.asarray(p["static_mask"]), jnp.asarray(p["static_score"]),
+        jnp.asarray(p["mins"]), jnp.asarray(p["init_resreq"]),
+        jnp.asarray(p["resreq"]),
+        jnp.asarray(np.full(s, 3.0, np.float32)),
+        iters=8, tau=0.5, tol=1e-3, weights=(0.0, 0.0, 1.0),
+        enforce_pod_count=True, use_static=False, mesh=mesh,
+    )
+    return lowered.compile().as_text()
+
+
 def _hlo_selector_mask(mesh) -> str:
     import jax.numpy as jnp
     import numpy as np
@@ -221,11 +250,13 @@ def lowerable_sites(mesh) -> dict:
             "ops/sharded.py::_place_scan_2d": _hlo_place_scan,
             "ops/sharded.py::_selector_mask_2d": _hlo_selector_mask,
             "ops/lp_place.py::_lp_iterate_2d": _hlo_lp_iterate,
+            "ops/lp_place.py::_lp_iterate_sig_2d": _hlo_lp_iterate_sig,
         }
     return {
         "ops/sharded.py::_place_scan_1d": _hlo_place_scan,
         "ops/sharded.py::_selector_mask_1d": _hlo_selector_mask,
         "ops/lp_place.py::_lp_iterate_1d": _hlo_lp_iterate,
+        "ops/lp_place.py::_lp_iterate_sig_1d": _hlo_lp_iterate_sig,
     }
 
 

@@ -105,6 +105,76 @@ def test_lp_gate_with_no_artifacts_is_silent_pass(tmp_path):
     assert gate_lp_vs_greedy(tmp_path) == 0  # no greedy artifact: no verdict
 
 
+def _with_sig(doc: dict, sig: dict) -> dict:
+    for cycle in doc["detail"]["cycles"]:
+        cycle["sig"] = sig
+    return doc
+
+
+def test_lp_sane_sig_block_passes(tmp_path):
+    """A well-formed engaged signature-compression block (classes <= tasks,
+    finite positive factor — docs/LP_PLACEMENT.md "Signature classes")
+    rides the LP artifact through the gate untouched."""
+    from scripts.bench_gate import gate_lp_vs_greedy
+
+    _write(tmp_path, "BENCH_r01.json", _artifact(100.0))
+    _write(tmp_path, "BENCH_LP_r01.json", _with_sig(
+        _lp_artifact(9_900),
+        {"engaged": True, "classes": 25, "tasks": 10_000,
+         "compression": 400.0, "bytes_saved": 123},
+    ))
+    assert gate_lp_vs_greedy(tmp_path) == 0
+
+
+def test_lp_sig_block_classes_over_tasks_is_malformed(tmp_path):
+    from scripts.bench_gate import gate_lp_vs_greedy
+
+    _write(tmp_path, "BENCH_r01.json", _artifact(100.0))
+    _write(tmp_path, "BENCH_LP_r01.json", _with_sig(
+        _lp_artifact(9_900),
+        {"engaged": True, "classes": 10_001, "tasks": 10_000,
+         "compression": 1.0, "bytes_saved": 0},
+    ))
+    assert gate_lp_vs_greedy(tmp_path) == 1
+
+
+def test_lp_sig_block_non_finite_compression_is_malformed(tmp_path):
+    from scripts.bench_gate import gate_lp_vs_greedy, sig_block_problem
+
+    _write(tmp_path, "BENCH_r01.json", _artifact(100.0))
+    # JSON has no Infinity literal; a null/absent/string factor is the
+    # wire form of "not a finite number".
+    _write(tmp_path, "BENCH_LP_r01.json", _with_sig(
+        _lp_artifact(9_900),
+        {"engaged": True, "classes": 25, "tasks": 10_000,
+         "compression": None, "bytes_saved": 0},
+    ))
+    assert gate_lp_vs_greedy(tmp_path) == 1
+    # The checker itself also rejects float infinities and zero/negative
+    # factors (a parsed artifact could carry them via Python callers).
+    bad = {"cycles": [{"sig": {"engaged": True, "classes": 2, "tasks": 10,
+                               "compression": float("inf")}}]}
+    assert sig_block_problem(bad) is not None
+    bad["cycles"][0]["sig"]["compression"] = 0.0
+    assert sig_block_problem(bad) is not None
+
+
+def test_lp_disengaged_or_absent_sig_blocks_are_fine(tmp_path):
+    """Compression is optional and auto-gated: an artifact whose cycles
+    carry no sig block, or a disengaged one with only a reason, is not
+    malformed."""
+    from scripts.bench_gate import gate_lp_vs_greedy
+
+    _write(tmp_path, "BENCH_r01.json", _artifact(100.0))
+    _write(tmp_path, "BENCH_LP_r01.json", _with_sig(
+        _lp_artifact(9_900),
+        {"engaged": False, "reason": "no repeated signatures (S == T)"},
+    ))
+    assert gate_lp_vs_greedy(tmp_path) == 0
+    _write(tmp_path, "BENCH_LP_r02.json", _lp_artifact(9_900))
+    assert gate_lp_vs_greedy(tmp_path) == 0
+
+
 def test_xl_family_is_recognized_and_segregated(tmp_path):
     """BENCH_XL_r*.json must land in the XL family only — never be counted
     as a single-queue artifact by the permissive-prefix glob."""

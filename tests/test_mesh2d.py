@@ -232,19 +232,22 @@ def test_budget_holds_on_the_2d_mesh_one_merged_all_gather():
     assert set(sites) == {
         site,
         "ops/sharded.py::_selector_mask_2d",
-        # LP-relaxed allocator iteration (round 9, docs/LP_PLACEMENT.md):
-        # same one-collective-per-step contract, checked below too.
+        # LP-relaxed allocator iteration (round 9, docs/LP_PLACEMENT.md)
+        # and its signature-compressed twin (round 11, "Signature
+        # classes"): same one-collective-per-step contract, checked below.
         "ops/lp_place.py::_lp_iterate_2d",
+        "ops/lp_place.py::_lp_iterate_sig_2d",
     }
     counts = count_collectives(sites[site](mesh))
     assert counts == {"all-gather": 1}
     assert check_counts(site, counts, layout.COLLECTIVE_BUDGET[site]) == []
-    lp_site = "ops/lp_place.py::_lp_iterate_2d"
-    lp_counts = count_collectives(sites[lp_site](mesh))
-    assert lp_counts == {"all-gather": 1}
-    assert check_counts(
-        lp_site, lp_counts, layout.COLLECTIVE_BUDGET[lp_site]
-    ) == []
+    for lp_site in ("ops/lp_place.py::_lp_iterate_2d",
+                    "ops/lp_place.py::_lp_iterate_sig_2d"):
+        lp_counts = count_collectives(sites[lp_site](mesh))
+        assert lp_counts == {"all-gather": 1}
+        assert check_counts(
+            lp_site, lp_counts, layout.COLLECTIVE_BUDGET[lp_site]
+        ) == []
 
 
 # -- full engine + production action on the 2-D mesh --------------------------

@@ -198,6 +198,46 @@ def test_env_fixtures_cover_the_allocator_flavor_and_lp_knobs():
     assert out == []
 
 
+def test_env_fixtures_cover_the_sig_compress_flag():
+    """SCHEDULER_TPU_SIG_COMPRESS (ops/sig_compress.py, docs/LP_PLACEMENT.md
+    "Signature classes") selects [T, N] vs [S, N] static staging — exactly
+    the program-selecting class _ENV_KEYS exists for: a raw read trips
+    raw-env, an unregistered envflags read under ops/ trips env-drift,
+    and the real registration keeps both passes clean."""
+    out = findings("raw-env", py={
+        "scheduler_tpu/ops/sig_compress.py": """
+            import os
+            def sig_compress_mode():
+                return os.environ.get("SCHEDULER_TPU_SIG_COMPRESS", "auto")
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_SIG_COMPRESS" in out[0].message
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+        "scheduler_tpu/ops/sig_compress.py": """
+            from scheduler_tpu.utils.envflags import env_str
+            def sig_compress_mode():
+                return env_str("SCHEDULER_TPU_SIG_COMPRESS", "auto",
+                               choices=("off", "on", "auto"))
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_SIG_COMPRESS" in out[0].message
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": """
+            _ENV_KEYS = (
+                "SCHEDULER_TPU_SIG_COMPRESS",
+            )
+        """,
+        "scheduler_tpu/ops/sig_compress.py": """
+            from scheduler_tpu.utils.envflags import env_str
+            def sig_compress_mode():
+                return env_str("SCHEDULER_TPU_SIG_COMPRESS", "auto",
+                               choices=("off", "on", "auto"))
+        """,
+    })
+    assert out == []
+
+
 def test_raw_env_allows_writes_and_envflags_reads():
     out = findings("raw-env", py={
         "scheduler_tpu/cli.py": """

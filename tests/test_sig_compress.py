@@ -1,0 +1,442 @@
+"""Signature-class compression of the [T, N] static seam
+(``ops/sig_compress.py``, docs/LP_PLACEMENT.md "Signature classes").
+
+The contract this suite pins:
+
+* **bitwise bind parity** — ``SCHEDULER_TPU_SIG_COMPRESS=on`` vs ``off``
+  produce identical placement codes on every engine flavor
+  ({greedy, lp} x {1, 2} queues x cohort on/off, plus the static-tensor
+  engines and both mesh shapes): compression is a representation change,
+  never a semantics change, because tasks in one class share their
+  request AND static rows by construction and the repair/pop replay runs
+  the existing ``fused_allocate`` while-loop either way;
+* **class derivation** — the class key is (cohort request-signature,
+  static-signature, queue, priority) in literal ``SIG_CLASS`` column
+  order, the request signature IS the cohort ``task_sig`` id
+  (``megakernel.request_signature_ids``, shared derivation), and the
+  degenerate all-unique S == T shape engages only under ``on`` (``auto``
+  refuses to pay the indirection for nothing);
+* **engagement evidence** — ``run_stats()['sig']`` carries
+  classes/tasks/compression/bytes-saved (the
+  ``phases.note('sig')`` -> bench ``detail.cycles[].sig`` chain), and a
+  refusal records its reason;
+* **cache safety** — ``SCHEDULER_TPU_SIG_COMPRESS`` sits in
+  ``engine_cache._ENV_KEYS`` and ``_delta_compatible`` re-checks it, so a
+  resident engine can never serve a stale mode; the layout token pins the
+  vocab content the signature hashing depends on;
+* **LP admission** — the [S, N] class working set is what the
+  ``SCHEDULER_TPU_LP_LIMIT`` gate sizes, so a duplicate-heavy session the
+  uncompressed path REFUSES becomes LP-native under compression (the
+  ISSUE 11 acceptance flip, pinned at container scale here).
+
+This file rides the CI mesh job (8 forced host devices).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.actions.allocate import collect_candidates
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import close_session, open_session
+from scheduler_tpu.ops.fused import FusedAllocator
+from tests.fixtures import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    make_vocab,
+)
+
+BINPACK_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+"""
+
+MULTIQ_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: proportion
+  - name: binpack
+"""
+
+STATIC_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: predicates
+  - name: nodeorder
+"""
+
+
+def _cluster(conf_str, queues=("default",), n_nodes=8, node_cpu=4000,
+             n_gangs=4, gang_size=5, req_cpu=900, unique_reqs=False,
+             selectors=False):
+    """Duplicate-heavy by default: every pod of every gang carries the same
+    request, so S << T.  ``unique_reqs`` gives every pod a distinct cpu
+    request (the S == T degenerate shape); ``selectors`` adds zone labels
+    + node selectors so predicates/nodeorder build real static tensors."""
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    for q in queues:
+        cache.add_queue(build_queue(q, weight=len(q)))
+    for i in range(n_nodes):
+        labels = {"zone": "za" if i % 2 else "zb"} if selectors else None
+        cache.add_node(build_node(
+            f"n{i:02d}",
+            {"cpu": node_cpu, "memory": 64 * 2**30, "pods": 20},
+            labels=labels,
+        ))
+    flat = 0
+    for g in range(n_gangs):
+        q = queues[g % len(queues)]
+        cache.add_pod_group(build_pod_group(
+            f"g{g}", min_member=gang_size, queue=q,
+        ))
+        for i in range(gang_size):
+            cpu = req_cpu + 10 * flat if unique_reqs else req_cpu
+            pod = build_pod(
+                name=f"g{g}-{i}",
+                req={"cpu": cpu, "memory": 2**30},
+                groupname=f"g{g}", priority=g % 2,
+            )
+            if selectors:
+                pod.node_selector = {"zone": "za" if g % 2 else "zb"}
+            cache.add_pod(pod)
+            flat += 1
+    conf = parse_scheduler_conf(conf_str)
+    return cache, conf
+
+
+def _engine(monkeypatch, ssn, sig="auto", flavor="greedy", **env):
+    monkeypatch.setenv("SCHEDULER_TPU_SIG_COMPRESS", sig)
+    monkeypatch.setenv("SCHEDULER_TPU_ALLOCATOR", flavor)
+    for k, v in env.items():
+        monkeypatch.setenv(k, str(v))
+    return FusedAllocator(ssn, collect_candidates(ssn))
+
+
+def _codes(monkeypatch, cache, conf, sig, flavor="greedy", **env):
+    ssn = open_session(cache, conf.tiers)
+    try:
+        eng = _engine(monkeypatch, ssn, sig=sig, flavor=flavor, **env)
+        return eng._execute().copy()[:eng.flat_count], eng.run_stats(), eng
+    finally:
+        close_session(ssn)
+
+
+# -- class derivation (host unit) ---------------------------------------------
+
+def test_derive_classes_dense_ids_counts_and_representatives():
+    from scheduler_tpu.ops.sig_compress import derive_classes
+
+    req_sig = np.asarray([0, 0, 1, 1, 0, 2], np.int64)
+    static_sig = np.asarray([0, 0, 0, 1, 0, 0], np.int32)
+    queue = np.asarray([0, 0, 0, 0, 1, 0], np.int32)
+    prio = np.zeros(6, np.int32)
+    sig_of_task, class_count, rep_rows = derive_classes(
+        req_sig, static_sig, queue, prio
+    )
+    s = class_count.shape[0]
+    # Dense 0..S-1 ids covering every task; multiplicities sum to T.
+    assert sig_of_task.shape == (6,) and sig_of_task.dtype == np.int32
+    assert set(sig_of_task) == set(range(s))
+    assert class_count.sum() == 6
+    # Tasks 0/1 share all four key columns; every other pair differs
+    # in at least one -> S == 5 with exactly one 2-task class.
+    assert s == 5
+    assert sorted(class_count) == [1, 1, 1, 1, 2]
+    # Each representative is its class's FIRST task in flat order and
+    # carries the class's key.
+    for cls in range(s):
+        members = np.flatnonzero(sig_of_task == cls)
+        assert rep_rows[cls] == members[0]
+        assert class_count[cls] == len(members)
+
+
+def test_derive_classes_none_static_and_all_unique():
+    from scheduler_tpu.ops.sig_compress import derive_classes
+
+    # static_sig=None (no static tensors): the column is zero, so classes
+    # collapse on the remaining three columns.
+    sig_of_task, class_count, _ = derive_classes(
+        np.asarray([0, 0, 0], np.int64), None,
+        np.zeros(3, np.int32), np.zeros(3, np.int32),
+    )
+    assert class_count.shape == (1,) and class_count[0] == 3
+    # All-unique request signatures: S == T.
+    sig_of_task, class_count, rep = derive_classes(
+        np.arange(4, dtype=np.int64), None,
+        np.zeros(4, np.int32), np.zeros(4, np.int32),
+    )
+    assert class_count.shape == (4,) and (class_count == 1).all()
+
+
+def test_shared_request_signature_derivation_with_cohort():
+    """The class key's request signature is the SAME derivation the mega
+    kernel's per-signature table uses — one definition, so the two
+    signature notions cannot drift (docs/COHORT.md)."""
+    from scheduler_tpu.api.job_info import unique_row_codes
+    from scheduler_tpu.ops.megakernel import request_signature_ids
+
+    rng = np.random.default_rng(7)
+    req = rng.uniform(0.5, 2.0, (10, 3)).astype(np.float32)
+    req[5:] = req[:5]  # duplicate half the rows
+    init = req.copy()
+    inverse, uniq = request_signature_ids(req, init)
+    inv_ref, uniq_ref = unique_row_codes(
+        np.concatenate([req, init], axis=1)
+    )
+    assert (inverse == inv_ref).all()
+    assert (uniq == uniq_ref).all()
+
+
+# -- engagement evidence ------------------------------------------------------
+
+def test_auto_engages_on_duplicate_heavy_and_reports_stats(monkeypatch):
+    cache, conf = _cluster(BINPACK_CONF)
+    codes, stats, eng = _codes(monkeypatch, cache, conf, "auto")
+    assert eng.sig_compress and eng.sig_mode == "auto"
+    sig = stats["sig"]
+    assert sig["engaged"] is True
+    assert sig["classes"] == eng.sig_classes
+    assert sig["tasks"] == eng.flat_count
+    assert sig["classes"] < sig["tasks"]
+    # 20 identical-request same-queue pods split only by priority -> 2
+    # classes, compression 10x (>= the ISSUE 11 acceptance floor of 4).
+    assert sig["compression"] >= 4
+    assert sig["compression"] == round(sig["tasks"] / sig["classes"], 2)
+    assert (codes >= 0).sum() == eng.flat_count
+
+
+def test_auto_refuses_all_unique_on_forces_it(monkeypatch):
+    cache, conf = _cluster(BINPACK_CONF, unique_reqs=True)
+    _, stats_auto, eng_auto = _codes(monkeypatch, cache, conf, "auto")
+    assert not eng_auto.sig_compress
+    assert stats_auto["sig"]["engaged"] is False
+    assert "S == T" in stats_auto["sig"]["reason"]
+    # "on" forces the degenerate shape — the parity fixture for the
+    # indirection itself — and the codes stay identical to off.
+    codes_on, stats_on, eng_on = _codes(monkeypatch, cache, conf, "on")
+    assert eng_on.sig_compress and eng_on.sig_classes == eng_on.flat_count
+    assert stats_on["sig"]["compression"] == 1.0
+    codes_off, stats_off, _ = _codes(monkeypatch, cache, conf, "off")
+    assert (codes_on == codes_off).all()
+    # off records NO sig block at all: bitwise pre-existing evidence too.
+    assert "sig" not in stats_off
+
+
+# -- bitwise bind parity ------------------------------------------------------
+
+@pytest.mark.parametrize("flavor", ["greedy", "lp"])
+@pytest.mark.parametrize("queues", [1, 2])
+@pytest.mark.parametrize("cohort", [1, 4])
+def test_parity_on_off_across_flavors_queues_cohort(
+    monkeypatch, flavor, queues, cohort
+):
+    """The acceptance matrix: {greedy, lp} x {1, 2} queues x cohort on/off,
+    duplicate-heavy shape, compress-on codes bitwise-identical to off."""
+    conf_str = MULTIQ_CONF if queues == 2 else BINPACK_CONF
+    qs = ("qa", "qbb") if queues == 2 else ("default",)
+    cache, conf = _cluster(conf_str, queues=qs, n_nodes=2,
+                           node_cpu=5 * 900 + 100)
+    env = {"SCHEDULER_TPU_COHORT": cohort}
+    codes_on, stats_on, eng_on = _codes(
+        monkeypatch, cache, conf, "on", flavor=flavor, **env
+    )
+    assert eng_on.sig_compress, "compression must engage on this shape"
+    if flavor == "lp":
+        assert eng_on.use_lp, eng_on.lp_reason
+    codes_off, _, eng_off = _codes(
+        monkeypatch, cache, conf, "off", flavor=flavor, **env
+    )
+    assert not eng_off.sig_compress
+    assert (codes_on == codes_off).all()
+    assert stats_on["sig"]["engaged"] is True
+
+
+def test_parity_with_static_tensors_and_selectors(monkeypatch):
+    """predicates/nodeorder build real [T, N] static tensors; under
+    compression the staged tensors are the [S, N] class rows (the class
+    key includes the static-signature id, so rows cannot alias) and
+    every placement still satisfies the per-task mask."""
+    import jax
+
+    from scheduler_tpu.ops.allocator import build_static_tensors_device
+
+    cache, conf = _cluster(STATIC_CONF, n_nodes=6, node_cpu=4000,
+                           n_gangs=3, gang_size=4, req_cpu=700,
+                           selectors=True)
+    codes_off, _, _ = _codes(monkeypatch, cache, conf, "off")
+    ssn = open_session(cache, conf.tiers)
+    try:
+        eng = _engine(monkeypatch, ssn, sig="on")
+        assert eng.use_static and eng.sig_compress
+        # Zone selectors split the static signature: more than one class
+        # even though half the gangs share queue+priority+request.
+        assert 1 < eng.sig_classes < eng.flat_count
+        codes_on = eng._execute().copy()[:eng.flat_count]
+        assert (codes_on == codes_off).all()
+        # Every placement satisfies the UNCOMPRESSED per-task mask.
+        t = eng.flat_count
+        mask_dev, _ = build_static_tensors_device(
+            ssn, eng.st, eng.n_bucket, eng._t_bucket
+        )
+        mask = np.asarray(jax.device_get(mask_dev))[:t]
+        placed = codes_on >= 0
+        assert placed.all()
+        assert mask[np.arange(t)[placed], codes_on[placed]].all()
+    finally:
+        close_session(ssn)
+
+
+def test_deterministic_across_rebuilds(monkeypatch):
+    cache, conf = _cluster(BINPACK_CONF, n_nodes=3, node_cpu=5 * 900 + 100)
+    a, _, _ = _codes(monkeypatch, cache, conf, "on")
+    b, _, _ = _codes(monkeypatch, cache, conf, "on")
+    assert (a == b).all()
+
+
+# -- engine-cache safety ------------------------------------------------------
+
+def test_engine_cache_rejects_stale_sig_mode(monkeypatch):
+    from scheduler_tpu.ops.engine_cache import _ENV_KEYS
+
+    assert "SCHEDULER_TPU_SIG_COMPRESS" in _ENV_KEYS
+    cache, conf = _cluster(BINPACK_CONF)
+    ssn = open_session(cache, conf.tiers)
+    try:
+        eng = _engine(monkeypatch, ssn, sig="on")
+        assert eng.sig_compress
+        # The mode selects [T, N] vs [S, N] staging: a resident engine
+        # built under one mode must refuse a delta refresh under another.
+        monkeypatch.setenv("SCHEDULER_TPU_SIG_COMPRESS", "off")
+        assert not eng._delta_compatible(ssn)
+        monkeypatch.setenv("SCHEDULER_TPU_SIG_COMPRESS", "on")
+        assert eng._delta_compatible(ssn)
+    finally:
+        close_session(ssn)
+
+
+def test_layout_token_pins_vocab_content(monkeypatch):
+    """The signature tables hash SCALED request rows — the layout token
+    must therefore fingerprint the vocab's column names and min
+    thresholds, not just its width, so residents can't alias across a
+    remapped vocab (docs/ENGINE_CACHE.md)."""
+    from scheduler_tpu.ops.engine_cache import layout_token
+
+    cache, conf = _cluster(BINPACK_CONF)
+    ssn = open_session(cache, conf.tiers)
+    try:
+        jobs = collect_candidates(ssn)
+        tok = layout_token(ssn, jobs)
+        assert tok is not None
+        vocab_fp = tok[-1]
+        assert vocab_fp is not None
+        names, mins_hash = vocab_fp
+        vocab = next(iter(ssn.nodes.values())).vocab
+        assert names == vocab.names
+        assert mins_hash == hash(vocab.min_thresholds().tobytes())
+    finally:
+        close_session(ssn)
+
+
+# -- LP admission: the working-set flip (ISSUE 11 acceptance) -----------------
+
+def test_lp_limit_flip_fallback_to_native(monkeypatch):
+    """Under a limit sized between the [S, N] and [T, N] working sets, the
+    uncompressed path REFUSES the LP flavor (memory-limit fallback to
+    greedy) while the compressed path runs it natively — compression
+    lifts SCHEDULER_TPU_LP_LIMIT pressure, which is the point."""
+    # 8 nodes -> nb 8; 20 tasks -> tb 32; duplicate-heavy S=2 -> sb 8.
+    # Working sets: off 16*32*8 = 4096 bytes, on 16*8*8 = 1024 bytes.
+    cache, conf = _cluster(BINPACK_CONF)
+    limit = {"SCHEDULER_TPU_LP_LIMIT": 2048}
+
+    ssn = open_session(cache, conf.tiers)
+    try:
+        eng_off = _engine(monkeypatch, ssn, sig="off", flavor="lp", **limit)
+        assert not eng_off.use_lp
+        assert "SCHEDULER_TPU_LP_LIMIT" in eng_off.lp_reason
+    finally:
+        close_session(ssn)
+
+    ssn = open_session(cache, conf.tiers)
+    try:
+        eng_on = _engine(monkeypatch, ssn, sig="on", flavor="lp", **limit)
+        assert eng_on.sig_compress
+        assert eng_on.use_lp, eng_on.lp_reason
+        codes = eng_on._execute().copy()
+        assert eng_on.run_stats()["engine"] == "lp"
+        assert (codes[:eng_on.flat_count] >= 0).sum() == eng_on.flat_count
+    finally:
+        close_session(ssn)
+
+
+def test_lp_class_iteration_matches_per_task_binds(monkeypatch):
+    """Tight capacity, multiplicity-weighted class mass: the compressed
+    relaxation's repaired binds equal the per-task relaxation's (parity
+    is already pinned bitwise above; this pins the QUALITY equivalence on
+    a shape where capacity, not mass, binds)."""
+    cache, conf = _cluster(BINPACK_CONF, n_nodes=2, node_cpu=5 * 900 + 100)
+    codes_on, stats_on, _ = _codes(monkeypatch, cache, conf, "on",
+                                   flavor="lp")
+    codes_off, stats_off, _ = _codes(monkeypatch, cache, conf, "off",
+                                     flavor="lp")
+    assert (codes_on >= 0).sum() == (codes_off >= 0).sum() == 10
+    assert stats_on["lp"]["binds"] == stats_off["lp"]["binds"]
+
+
+# -- mesh (rides the CI mesh job: 8 forced host devices) ----------------------
+
+@pytest.mark.parametrize("spec", ["8", "2x4"])
+@pytest.mark.parametrize("flavor", ["greedy", "lp"])
+def test_mesh_parity_on_off(monkeypatch, spec, flavor):
+    """Both mesh shapes, both flavors: compress-on codes bitwise-identical
+    to compress-off under the SAME topology (the lp flavor routes through
+    the _lp_iterate_sig_* twins — one row-stat all-gather per iteration,
+    ops/layout.py COLLECTIVE_BUDGET, proven by shard_budget.py)."""
+    import jax
+
+    from scheduler_tpu.ops import mesh as mesh_mod
+    from tests.conftest import USE_TPU
+
+    if len(jax.devices()) < 8:
+        if USE_TPU:
+            pytest.skip("needs 8 devices")
+        raise AssertionError("conftest must force 8 virtual devices")
+
+    monkeypatch.setenv("SCHEDULER_TPU_MESH", spec)
+    mesh_mod._cached_key = object()  # bust the memo
+    try:
+        cache, conf = _cluster(BINPACK_CONF, n_nodes=16)
+        codes_on, stats_on, eng_on = _codes(
+            monkeypatch, cache, conf, "on", flavor=flavor
+        )
+        assert eng_on.sig_compress
+        if flavor == "lp":
+            assert eng_on.use_lp, eng_on.lp_reason
+            assert eng_on._lp_mesh is not None
+        codes_off, _, _ = _codes(
+            monkeypatch, cache, conf, "off", flavor=flavor
+        )
+        assert (codes_on == codes_off).all()
+        assert stats_on["sig"]["engaged"] is True
+    finally:
+        monkeypatch.setenv("SCHEDULER_TPU_MESH", "1")
+        mesh_mod._cached_key = object()

@@ -1,7 +1,7 @@
 # Build/test entry points (reference: Makefile + hack/make-rules).
 PY ?= python
 
-.PHONY: all native test test-fast bench bench-smoke bench-xl bench-churn bench-flagship bench-gate lint verify wheel clean
+.PHONY: all native test test-fast bench bench-smoke bench-xl bench-churn bench-preempt bench-flagship bench-gate lint verify wheel clean
 
 all: native
 
@@ -35,6 +35,14 @@ bench-xl:
 bench-churn:
 	$(PY) bench.py --churn
 
+# Saturated-cluster preempt-storm scenario (docs/PREEMPT.md): SLA-tiered
+# priority storms over a full cluster of low-priority filler gangs through
+# the real watch wire; emits the BENCH_PREEMPT_r*.json artifact body
+# (time-to-preempt p50/p99, evictions/s, churn amplification; shape/rate
+# via SCHEDULER_TPU_PREEMPT_*, victim-hunt flavor via SCHEDULER_TPU_EVICT).
+bench-preempt:
+	$(PY) bench.py --preempt
+
 # ONE run that emits every standing TPU-round artifact debt — BENCH_r*.json,
 # the owed BENCH_MQ_r*.json (SCHEDULER_TPU_BENCH_QUEUES=2) and
 # BENCH_XL_r*.json — under a shared round number, then gates the result.
@@ -44,10 +52,10 @@ bench-flagship:
 	$(PY) scripts/bench_flagship.py
 
 # Perf regression gate: newest artifact of each family (BENCH / BENCH_MQ /
-# BENCH_XL / BENCH_LP / BENCH_CHURN) vs its previous round, healthy-regime
-# cycles only; exits non-zero past a >10% pods/s drop (or >10% churn-p99
-# RISE, or a churn hit rate below the artifact's own floor) or a
-# malformed/topology-less XL artifact.
+# BENCH_XL / BENCH_LP / BENCH_CHURN / BENCH_PREEMPT) vs its previous round,
+# healthy-regime cycles only; exits non-zero past a >10% pods/s drop (or
+# >10% churn/preempt-p99 RISE, or a churn hit rate below the artifact's own
+# floor) or a malformed/topology-less XL artifact.
 bench-gate:
 	$(PY) scripts/bench_gate.py
 

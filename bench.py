@@ -164,6 +164,56 @@ def churn_main(smoke: bool) -> None:
     print(json.dumps(doc))
 
 
+def preempt_main(smoke: bool) -> None:
+    """``--preempt``: the saturated-cluster preempt-storm scenario
+    (docs/PREEMPT.md, harness/preempt_storm.py).
+
+    SLA-tiered priority storms arrive over the real watch wire against a
+    cluster whose every node is full of low-priority filler gangs; the
+    scheduler runs ``allocate, preempt`` cycles and the artifact
+    (``BENCH_PREEMPT_r*.json``) carries time-to-preempt p50/p99 (arrival to
+    rebind), evictions/s, the churn amplification (evictions per bind),
+    per-tier latency splits and the per-cycle ``evict``/``victims``
+    evidence blocks — gated by ``scripts/bench_gate.py`` on p99 regression
+    and malformed evidence.  Shape and rate are env-scalable
+    (``SCHEDULER_TPU_PREEMPT_*``); the victim-hunt flavor is whatever
+    ``SCHEDULER_TPU_EVICT`` says and is recorded in the artifact."""
+    from scheduler_tpu.harness.preempt_storm import (
+        PreemptStormConfig, run_preempt_bench,
+    )
+    from scheduler_tpu.utils.envflags import env_float, env_int
+
+    cfg = PreemptStormConfig(
+        seed=env_int("SCHEDULER_TPU_PREEMPT_SEED", 0, minimum=0),
+        nodes=env_int("SCHEDULER_TPU_PREEMPT_NODES", 8 if smoke else 32,
+                      minimum=1),
+        fill_per_node=env_int("SCHEDULER_TPU_PREEMPT_FILL", 8, minimum=1),
+        storm_pods=env_int("SCHEDULER_TPU_PREEMPT_PODS",
+                           16 if smoke else 96, minimum=1),
+        rate=env_float("SCHEDULER_TPU_PREEMPT_RATE",
+                       30.0 if smoke else 60.0, minimum=1.0),
+        warm_pods=env_int("SCHEDULER_TPU_PREEMPT_WARM",
+                          4 if smoke else 12, minimum=0),
+    )
+    doc = run_preempt_bench(cfg)
+    doc["detail"]["backend"] = _backend()
+    if not doc["detail"]["cycles_measured"]:
+        doc["error"] = (
+            "the scheduler never drained the storm inside the window; the "
+            "artifact cannot claim a time-to-preempt distribution"
+        )
+        print(json.dumps(doc))
+        sys.exit(1)
+    if not doc["detail"]["bound"]:
+        doc["error"] = (
+            "no storm pod was ever rebound — the scenario measured nothing; "
+            "see the per-cycle evict evidence for why hunts found no victims"
+        )
+        print(json.dumps(doc))
+        sys.exit(1)
+    print(json.dumps(doc))
+
+
 def main() -> None:
     from scheduler_tpu.utils.envflags import env_int
     from scheduler_tpu.utils import sanitize
@@ -171,6 +221,9 @@ def main() -> None:
     smoke = "--smoke" in sys.argv
     if "--churn" in sys.argv:
         churn_main(smoke)
+        return
+    if "--preempt" in sys.argv:
+        preempt_main(smoke)
         return
     xl = "--xl" in sys.argv
     default_nodes = 100 if smoke else (100_000 if xl else 10_000)

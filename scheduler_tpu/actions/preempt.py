@@ -30,6 +30,7 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn) -> None:
+        from scheduler_tpu.ops import evict as evict_ops
         from scheduler_tpu.ops.victims import VictimGate
         from scheduler_tpu.utils.scheduler_helper import (
             build_preemptor_task_queue,
@@ -42,9 +43,15 @@ class PreemptAction(Action):
         # victim pre-gate (ops/victims.py): one masked reduction over the
         # running-task tensors admits exactly the nodes that can still yield
         # a victim; the per-node dispatch below stays exact and live.
+        # Under SCHEDULER_TPU_EVICT=device the eviction engine
+        # (ops/evict.py, docs/PREEMPT.md) replaces the per-node hunt with a
+        # batched victim plan the Statement replays — evictions and binds
+        # bitwise-identical to the host walk (tests/test_evict_parity.py);
+        # the pre-gate then stands down (the engine's masks subsume it).
         sweep = SweepCache(ssn)
+        engine = evict_ops.EvictEngine(ssn, "preempt")
         gate = VictimGate(ssn, "preempt")
-        if not gate.enabled:
+        if not gate.enabled or engine.active:
             gate = None
         builtin_order = task_order_builtin(ssn)
         use_priority = "priority" in enabled_task_order_chain(ssn)
@@ -80,6 +87,13 @@ class PreemptAction(Action):
                 gate.prime()
             else:
                 gate = None
+        if engine.active and preemptor_tasks:
+            # Same capture rule as the gate: the victim table must see the
+            # action's start state (prime can still deactivate the engine —
+            # scalar resources in play — in which case the host walk below
+            # runs ungated for this action; the pre-gate's superset masks
+            # were already declined above).
+            engine.prime()
 
         # Phase 1: preemption between jobs within a queue.
         for queue in queues.values():
@@ -119,18 +133,31 @@ class PreemptAction(Action):
                                 node.name, j
                             )
                         ),
+                        engine=engine,
+                        preemptor_job=preemptor_job,
+                        same_job=False,
                     ):
                         assigned = True
 
                     if ssn.job_pipelined(preemptor_job):
                         # Gate counts drop per ACCEPTED evict (a failed evict
                         # RPC restores the victim, which stays offerable).
+                        ops = list(stmt.operations)
                         stmt.commit(
                             on_evicted=None if gate is None else gate.note_evicted_task
                         )
+                        if engine.active:
+                            # Failed evict RPCs restored their victims at
+                            # the END of the node map; re-sync the captured
+                            # candidate order (ops/evict.py note_commit).
+                            engine.note_commit(ops)
                         break
 
                 if not ssn.job_pipelined(preemptor_job):
+                    if engine.active:
+                        # BEFORE discard: the rollback re-appends restored
+                        # victims at the end of their node maps.
+                        engine.note_discard(stmt)
                     stmt.discard()
                     continue
 
@@ -162,12 +189,21 @@ class PreemptAction(Action):
                         if gate is None
                         else lambda node, j=job: gate.admits_own_job(node.name, j)
                     ),
+                    engine=engine,
+                    preemptor_job=job,
+                    same_job=True,
                 )
+                ops = list(stmt.operations)
                 stmt.commit(
                     on_evicted=None if gate is None else gate.note_evicted_task
                 )
+                if engine.active:
+                    engine.note_commit(ops)
                 if not assigned:
                     break
+
+        evict_ops.note_evidence("preempt", engine.stats())
+        VictimGate.note_evidence("preempt", gate)
 
     def _preempt(
         self,
@@ -177,6 +213,9 @@ class PreemptAction(Action):
         task_filter: Optional[Callable[[TaskInfo], bool]],
         sweep=None,
         node_gate: Optional[Callable] = None,
+        engine=None,
+        preemptor_job=None,
+        same_job: bool = False,
     ) -> bool:
         """One preemptor's hunt for a node (reference preempt.go:180-260).
 
@@ -184,7 +223,11 @@ class PreemptAction(Action):
         ordering per task signature; ``node_gate`` skips nodes the ledger
         proved to hold no candidate Running tasks.  Both are exact filters —
         when either declines (None / dynamic task), the reference's per-task
-        sweep runs unchanged."""
+        sweep runs unchanged.  An ACTIVE ``engine`` (ops/evict.py,
+        SCHEDULER_TPU_EVICT=device) runs the whole hunt as a batched victim
+        plan instead; a task outside its modeled domain (scalar requests)
+        falls back to this host walk."""
+        from scheduler_tpu.ops.evict import FloorGuard, _FallbackHunt
         from scheduler_tpu.utils.sweep import full_sweep
 
         assigned = False
@@ -193,6 +236,20 @@ class PreemptAction(Action):
         if ordered is None:
             ordered = full_sweep(ssn, preemptor, ssn.predicate_fn)
 
+        if engine is not None and engine.active and preemptor_job is not None:
+            try:
+                return engine.hunt_preempt(
+                    stmt, preemptor, preemptor_job, ordered, sweep,
+                    pod_count_live, same_job,
+                )
+            except _FallbackHunt:
+                pass  # scalar request: the host walk below stays exact
+
+        # The live gang floor (docs/PREEMPT.md): one hunt's sufficiency
+        # prefix must never strand a cohort below min_member — the device
+        # plan's kept-mask applies the identical rule, which is what keeps
+        # the two flavors bitwise-identical.
+        guard = FloorGuard.for_session(ssn, "preempt")
         for node in ordered:
             if pod_count_live and not sweep.node_open(node):
                 continue
@@ -221,6 +278,11 @@ class PreemptAction(Action):
             resreq = preemptor.init_resreq.clone()
             while not victims_queue.empty():
                 preemptee = victims_queue.pop()
+                if guard is not None and not guard.take(preemptee):
+                    logger.debug(
+                        "skipping victim %s: gang floor", preemptee.uid
+                    )
+                    continue
                 logger.info("preempting task %s for %s", preemptee.uid, preemptor.uid)
                 stmt.evict(preemptee, "preempt")
                 preempted.add(preemptee.resreq)

@@ -28,6 +28,7 @@ class ReclaimAction(Action):
         return "reclaim"
 
     def execute(self, ssn) -> None:
+        from scheduler_tpu.ops import evict as evict_ops
         from scheduler_tpu.ops.victims import VictimGate
         from scheduler_tpu.utils.scheduler_helper import (
             build_preemptor_task_queue,
@@ -40,9 +41,15 @@ class ReclaimAction(Action):
         # victim pre-gate (ops/victims.py): one masked reduction over the
         # running-task tensors admits exactly the nodes that can still yield
         # a victim; the per-node dispatch below stays exact and live.
+        # Under SCHEDULER_TPU_EVICT=device the eviction engine
+        # (ops/evict.py, docs/PREEMPT.md) plans the whole hunt batched and
+        # this action merely replays it — evictions and pipelines
+        # bitwise-identical to the host walk (tests/test_evict_parity.py);
+        # the pre-gate stands down (the engine's masks subsume it).
         sweep = SweepCache(ssn)
+        engine = evict_ops.EvictEngine(ssn, "reclaim")
         gate = VictimGate(ssn, "reclaim")
-        if not gate.enabled:
+        if not gate.enabled or engine.active:
             gate = None
         builtin_order = task_order_builtin(ssn)
         use_priority = "priority" in enabled_task_order_chain(ssn)
@@ -77,6 +84,8 @@ class ReclaimAction(Action):
                 gate.prime()  # snapshot BEFORE any eviction mutates state
             else:
                 gate = None
+        if engine.active and preemptor_tasks:
+            engine.prime()  # same capture rule: the action's start state
 
         while not queues.empty():
             queue = queues.pop()
@@ -94,7 +103,6 @@ class ReclaimAction(Action):
                 continue
             task = tasks.pop()
 
-            assigned = False
             # Name-ordered like the reference (no scoring in reclaim,
             # reclaim.go:134-141); the cached set already applied the static
             # predicate, the live pod-count gate applies per candidate.
@@ -102,104 +110,202 @@ class ReclaimAction(Action):
             pod_count_live = ordered is not None
             if ordered is None:
                 ordered = get_node_list(ssn.nodes)
-            # ONE masked reduction per hunt (live proportion margins) —
-            # the per-node dispatch below only runs on admitted nodes, and
-            # the admitted set itself comes from one vectorized gather.
-            mask = gate.other_queue_mask(job.queue) if gate is not None else None
-            if mask is not None:
-                candidates = (
-                    ordered[i]
-                    for i in gate.admitted_positions(ordered, mask).tolist()
-                )
-            else:
-                candidates = iter(ordered)
-            for node in candidates:
-                if pod_count_live:
-                    if not sweep.node_open(node):
-                        continue
-                else:
-                    try:
-                        ssn.predicate_fn(task, node)
-                    except Exception:
-                        continue
-
-                resreq = task.init_resreq.clone()
-                reclaimed = ResourceVec.empty(resreq.vocab)
-
-                reclaimees = []
-                for candidate in node.tasks.values():
-                    if candidate.status != TaskStatus.RUNNING:
-                        continue
-                    owner = ssn.jobs.get(candidate.job)
-                    if owner is None:
-                        continue
-                    if owner.queue != job.queue:
-                        reclaimees.append(candidate.clone())
-
-                victims = ssn.reclaimable(task, reclaimees)
-                if not victims:
-                    logger.debug("no reclaim victims on node %s", node.name)
-                    continue
-
-                total = ResourceVec.empty(resreq.vocab)
-                for v in victims:
-                    total.add(v.resreq)
-                if total.less(resreq):
-                    logger.debug("not enough reclaimable resource on node %s", node.name)
-                    continue
-
-                # The sufficiency prefix is decided BEFORE evicting so the
-                # whole hunt commits as one bulk eviction (per-job status
-                # rows, one releasing-add per node, chunked RPCs) instead of
-                # ~0.5ms of bookkeeping per victim.  On the rare partial
-                # failure (a victim vanished from the cache mid-action), the
-                # remaining candidates top up one at a time — the exact
-                # semantics of the old per-victim loop.
-                chosen = []
-                planned = ResourceVec.empty(resreq.vocab)
-                for reclaimee in victims:
-                    chosen.append(reclaimee)
-                    planned.add(reclaimee.resreq)
-                    if resreq.less_equal(planned):
-                        break
-                for reclaimee in chosen:
-                    logger.info("reclaiming task %s for %s", reclaimee.uid, task.uid)
+            if engine.active:
                 try:
-                    evicted = ssn.evict_bulk(chosen, "reclaim")
+                    assigned = self._hunt_device(
+                        ssn, engine, task, job, ordered, sweep, pod_count_live
+                    )
+                except evict_ops._FallbackHunt:
+                    # Scalar request: outside the engine's modeled domain —
+                    # the unchanged host walk stays exact for this task.
+                    assigned = self._hunt_host(
+                        ssn, gate, task, job, ordered, sweep, pod_count_live
+                    )
+            else:
+                assigned = self._hunt_host(
+                    ssn, gate, task, job, ordered, sweep, pod_count_live
+                )
+
+            if assigned:
+                queues.push(queue)
+
+        evict_ops.note_evidence("reclaim", engine.stats())
+        VictimGate.note_evidence("reclaim", gate)
+
+    def _hunt_host(
+        self, ssn, gate, task, job, ordered, sweep, pod_count_live
+    ) -> bool:
+        """The reference per-node walk (reclaim.go:134-195), pre-gated by the
+        VictimGate's masked reduction and floor-guarded per hunt
+        (docs/PREEMPT.md "The live gang floor")."""
+        from scheduler_tpu.ops.evict import FloorGuard
+
+        guard = FloorGuard.for_session(ssn, "reclaim")
+        # ONE masked reduction per hunt (live proportion margins) —
+        # the per-node dispatch below only runs on admitted nodes, and
+        # the admitted set itself comes from one vectorized gather.
+        mask = gate.other_queue_mask(job.queue) if gate is not None else None
+        if mask is not None:
+            candidates = (
+                ordered[i]
+                for i in gate.admitted_positions(ordered, mask).tolist()
+            )
+        else:
+            candidates = iter(ordered)
+        for node in candidates:
+            if pod_count_live:
+                if not sweep.node_open(node):
+                    continue
+            else:
+                try:
+                    ssn.predicate_fn(task, node)
                 except Exception:
-                    logger.exception("bulk reclaim failed on node %s", node.name)
-                    evicted = []
-                for reclaimee in evicted:
+                    continue
+
+            resreq = task.init_resreq.clone()
+            reclaimed = ResourceVec.empty(resreq.vocab)
+
+            reclaimees = []
+            for candidate in node.tasks.values():
+                if candidate.status != TaskStatus.RUNNING:
+                    continue
+                owner = ssn.jobs.get(candidate.job)
+                if owner is None:
+                    continue
+                if owner.queue != job.queue:
+                    reclaimees.append(candidate.clone())
+
+            victims = ssn.reclaimable(task, reclaimees)
+            if not victims:
+                logger.debug("no reclaim victims on node %s", node.name)
+                continue
+
+            total = ResourceVec.empty(resreq.vocab)
+            for v in victims:
+                total.add(v.resreq)
+            if total.less(resreq):
+                logger.debug("not enough reclaimable resource on node %s", node.name)
+                continue
+
+            # The sufficiency prefix is decided BEFORE evicting so the
+            # whole hunt commits as one bulk eviction (per-job status
+            # rows, one releasing-add per node, chunked RPCs) instead of
+            # ~0.5ms of bookkeeping per victim.  On the rare partial
+            # failure (a victim vanished from the cache mid-action), the
+            # remaining candidates top up one at a time — the exact
+            # semantics of the old per-victim loop.  The gang floor
+            # (``guard``) skips — without evicting — any victim whose
+            # eviction would strand its cohort below min_member, mirroring
+            # the device plan's kept-mask bit for bit.
+            chosen = []
+            rest_start = len(victims)
+            planned = ResourceVec.empty(resreq.vocab)
+            for idx, reclaimee in enumerate(victims):
+                if guard is not None and not guard.take(reclaimee):
+                    logger.debug(
+                        "skipping victim %s: gang floor", reclaimee.uid
+                    )
+                    continue
+                chosen.append(reclaimee)
+                planned.add(reclaimee.resreq)
+                if resreq.less_equal(planned):
+                    rest_start = idx + 1
+                    break
+            for reclaimee in chosen:
+                logger.info("reclaiming task %s for %s", reclaimee.uid, task.uid)
+            try:
+                evicted = ssn.evict_bulk(chosen, "reclaim")
+            except Exception:
+                logger.exception("bulk reclaim failed on node %s", node.name)
+                evicted = []
+            for reclaimee in evicted:
+                if gate is not None:
+                    owner = ssn.jobs.get(reclaimee.job)
+                    if owner is not None:
+                        gate.note_eviction(node.name, owner)
+                reclaimed.add(reclaimee.resreq)
+            if len(evicted) < len(chosen):
+                for reclaimee in victims[rest_start:]:
+                    if resreq.less_equal(reclaimed):
+                        break
+                    if guard is not None and not guard.take(reclaimee):
+                        continue
+                    try:
+                        ssn.evict(reclaimee, "reclaim")
+                    except Exception:
+                        logger.exception("failed to reclaim %s", reclaimee.uid)
+                        continue
                     if gate is not None:
                         owner = ssn.jobs.get(reclaimee.job)
                         if owner is not None:
                             gate.note_eviction(node.name, owner)
                     reclaimed.add(reclaimee.resreq)
-                if len(evicted) < len(chosen):
-                    for reclaimee in victims[len(chosen):]:
-                        if resreq.less_equal(reclaimed):
-                            break
-                        try:
-                            ssn.evict(reclaimee, "reclaim")
-                        except Exception:
-                            logger.exception("failed to reclaim %s", reclaimee.uid)
-                            continue
-                        if gate is not None:
-                            owner = ssn.jobs.get(reclaimee.job)
-                            if owner is not None:
-                                gate.note_eviction(node.name, owner)
-                        reclaimed.add(reclaimee.resreq)
 
-                if task.init_resreq.less_equal(reclaimed):
+            if task.init_resreq.less_equal(reclaimed):
+                try:
+                    ssn.pipeline(task, node.name)
+                except Exception:
+                    logger.exception("failed to pipeline %s on %s", task.uid, node.name)
+                return True
+        return False
+
+    def _hunt_device(
+        self, ssn, engine, task, job, ordered, sweep, pod_count_live
+    ) -> bool:
+        """Replay the eviction engine's victim plans (ops/evict.py,
+        docs/PREEMPT.md): per planned node, one bulk eviction of the
+        sufficiency prefix, the partial-failure top-up from the remaining
+        kept victims, then the pipeline — the identical Statement-free
+        choreography as the host walk, driven by batched masks instead of
+        per-node dispatches.  Unsatisfied nodes loop back into the engine,
+        which re-plans on the live ledgers."""
+        import time
+
+        start = 0
+        while True:
+            found = engine.next_reclaim_node(
+                task, job, ordered, start, sweep, pod_count_live
+            )
+            if found is None:
+                return False
+            node, views, prefix, start = found
+            resreq = task.init_resreq.clone()
+            reclaimed = ResourceVec.empty(resreq.vocab)
+            chosen = views[:prefix]
+            for reclaimee in chosen:
+                logger.info(
+                    "reclaiming task %s for %s (device plan)",
+                    reclaimee.uid, task.uid,
+                )
+            t0 = time.perf_counter()
+            try:
+                evicted = ssn.evict_bulk(chosen, "reclaim")
+            except Exception:
+                logger.exception("bulk reclaim failed on node %s", node.name)
+                evicted = []
+            engine.note_evictions(len(evicted))
+            for reclaimee in evicted:
+                reclaimed.add(reclaimee.resreq)
+            if len(evicted) < len(chosen):
+                for reclaimee in views[prefix:]:
+                    if resreq.less_equal(reclaimed):
+                        break
                     try:
-                        ssn.pipeline(task, node.name)
+                        ssn.evict(reclaimee, "reclaim")
                     except Exception:
-                        logger.exception("failed to pipeline %s on %s", task.uid, node.name)
-                    assigned = True
-                    break
-
-            if assigned:
-                queues.push(queue)
+                        logger.exception("failed to reclaim %s", reclaimee.uid)
+                        continue
+                    engine.note_evictions(1)
+                    reclaimed.add(reclaimee.resreq)
+            engine.phase["replay"] += time.perf_counter() - t0
+            if task.init_resreq.less_equal(reclaimed):
+                try:
+                    ssn.pipeline(task, node.name)
+                except Exception:
+                    logger.exception(
+                        "failed to pipeline %s on %s", task.uid, node.name
+                    )
+                return True
 
 
 def new() -> ReclaimAction:

@@ -138,9 +138,16 @@ class MockState:
         self.fail: Dict[str, int] = {}  # op -> remaining injected failures
         self.bind_calls = 0
         self.evict_calls = 0
-        # Ordered record of every APPLIED bind (pod key, node) — the
-        # journal-vs-k8s parity tests compare these sequences bitwise.
+        # Ordered record of every APPLIED bind (pod key, node, monotonic
+        # receive time) — the journal-vs-k8s parity tests compare the
+        # key/node sequences bitwise; the preempt-storm bench
+        # (harness/preempt_storm.py) reads the ``t`` stamps for per-pod
+        # arrival-to-bind latency.
         self.bind_log: List[Dict] = []
+        # Ordered record of every APPLIED eviction (pod key, monotonic
+        # receive time) — the preempt-storm artifact's evictions/s and
+        # churn-amplification evidence.
+        self.evict_log: List[Dict] = []
         # Wire-shape accounting: how many mutations arrived as real k8s API
         # calls vs the legacy bespoke RPCs — lets tests assert WHICH dialect
         # actually crossed the wire, not just that state changed.
@@ -383,8 +390,13 @@ def make_handler(state: MockState):
                     self._json(obj)
                 return
             if url.path == "/bind-log":
+                # The wire surface stays the plain (pod, node) sequence the
+                # journal-vs-k8s and event-vs-period parity tests compare
+                # bitwise; the ``t`` receive stamps are in-process evidence
+                # for the preempt-storm harness only.
                 with state.lock:
-                    binds = list(state.bind_log)
+                    binds = [{"pod": b["pod"], "node": b["node"]}
+                             for b in state.bind_log]
                 self._json({"binds": binds})
                 return
             if url.path == "/state":
@@ -507,7 +519,10 @@ def make_handler(state: MockState):
                 # own bind come back as a pod update, like an informer.
                 state.apply("pod", "update", pod)
                 with state.lock:
-                    state.bind_log.append({"pod": key, "node": pair["node"]})
+                    state.bind_log.append({
+                        "pod": key, "node": pair["node"],
+                        "t": time.monotonic(),
+                    })
             if not bulk:
                 if failed:
                     self._json({"error": "bind failed"}, 500)
@@ -527,6 +542,8 @@ def make_handler(state: MockState):
                 pod = state.objects["pod"].get(key)
             if pod is not None:
                 state.apply("pod", "delete", pod)
+                with state.lock:
+                    state.evict_log.append({"pod": key, "t": time.monotonic()})
             self._json({"ok": True})
 
         def _do_allocate_volumes(self, node: str, claims) -> None:

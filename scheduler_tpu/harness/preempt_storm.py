@@ -1,0 +1,454 @@
+"""Preempt-storm scenario: priority storms and SLA-tiered deadline jobs
+over a SATURATED cluster, through the real wire (docs/PREEMPT.md).
+
+The churn scenario (harness/churn.py) measures serving traffic against a
+cluster with headroom; production's hard regime is the opposite — the
+cluster is FULL, and a high-priority arrival only schedules by evicting
+someone (ROADMAP: "what heavy traffic means when the cluster is full").
+This module generates that traffic and drives it end to end over the same
+rig as churn: a mock apiserver preloaded with a saturated cluster of
+low-priority filler gangs, SLA-tiered high-priority arrivals streamed over
+the watch wire, the production connector feeding the production cache, and
+the event-triggered scheduler running ``allocate, preempt`` cycles.
+
+The artifact (``BENCH_PREEMPT_r*.json``, gated by ``scripts/bench_gate.py``)
+measures the metric the scenario exists for — **time-to-preempt**: the
+wall-clock from a storm pod's arrival on the wire to its bind landing back
+at the apiserver, which prices the whole evict -> watch-echo -> capacity
+-free -> rebind pipeline.  Alongside: evictions/s over the measured window
+and the **churn amplification** (evictions per bind — how many running
+pods each placed arrival displaced), per-SLA-tier latency splits, and the
+per-cycle ``evict``/``victims`` evidence blocks proving which victim-hunt
+flavor ran (``SCHEDULER_TPU_EVICT``, ops/evict.py).
+
+Pieces, each usable alone (the churn module's layout):
+
+* ``make_storm(cfg)`` — a deterministic SLA-tiered arrival history from a
+  seed (exponential inter-arrivals, per-tier priorities and request sizes);
+* ``seed_saturated(state, cfg)`` — preloads a mock apiserver's store with
+  the full cluster: filler gangs of Running pods pinned node-round-robin,
+  consuming every node's CPU, with ``min_member`` floors high enough that
+  the gang floor (docs/PREEMPT.md "The live gang floor") is load-bearing;
+* ``seed_saturated_cache(cfg)`` — the same cluster straight into a
+  SchedulerCache (no wire), for ``profile_cycle --preempt`` and the parity
+  tests;
+* ``run_preempt_bench(cfg)`` — the full rig behind ``bench.py --preempt``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from scheduler_tpu.harness.churn import ChurnEvent, _wait_drained, _percentile
+
+MIB = 1024.0 * 1024.0
+GIB = 1024.0 * MIB
+
+# Scheduling conf for the storm rig: priority ordering + the
+# conformance/gang victim dispatch, preempt after allocate the way the
+# reference orders its cycle.  Deliberately NO drf victim fn: drf vetoes
+# any eviction that would push the preemptor's dominant share past the
+# victim's, which caps a sustained priority storm at share parity after a
+# handful of binds — the scenario exists to measure PRIORITY preemption
+# throughput against the gang floor, and the drf mask keeps its own
+# coverage in tests/test_evict_parity.py.  Victims still evict
+# cheapest-first (reverse builtin task order), so storms drain priority-0
+# filler before ever touching each other.
+PREEMPT_CONF = """
+actions: "allocate, preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: conformance
+  - name: gang
+  - name: binpack
+"""
+
+# SLA tiers: (name, pod priority, share of the storm).  Deadline jobs are
+# the gold tier — the artifact splits time-to-preempt per tier so an SLA
+# inversion (bronze beating gold) is visible in the numbers.
+SLA_TIERS: Tuple[Tuple[str, int, float], ...] = (
+    ("gold", 100, 0.2),
+    ("silver", 50, 0.3),
+    ("bronze", 10, 0.5),
+)
+
+
+@dataclass
+class PreemptStormConfig:
+    seed: int = 0
+    nodes: int = 32
+    fill_per_node: int = 8         # Running filler pods per node (saturation)
+    filler_gang: int = 8           # tasks per filler PodGroup
+    filler_min_member: int = 4     # gang floor: at most gang-min evictable
+    storm_pods: int = 96           # measured high-priority arrivals
+    rate: float = 60.0             # storm arrival rate, events/s
+    warm_pods: int = 12            # warmup arrivals (XLA compiles excluded)
+    node_cpu_milli: float = 8000.0
+    node_memory: float = 32.0 * GIB
+    drain_timeout_s: float = 300.0
+    max_interval_s: float = 0.25   # quiet-cluster rescan clamp
+    namespace: str = "default"
+    tiers: Tuple[Tuple[str, int, float], ...] = field(default=SLA_TIERS)
+
+    @property
+    def placed_pods(self) -> int:
+        return self.nodes * self.fill_per_node
+
+    @property
+    def duration_s(self) -> float:
+        return self.storm_pods / max(self.rate, 1e-9)
+
+
+def _filler_request(cfg: PreemptStormConfig) -> Dict[str, float]:
+    """Every filler pod takes an equal CPU slice, so ``fill_per_node`` of
+    them exactly saturate a node — arrivals MUST evict to place."""
+    return {
+        "cpu": cfg.node_cpu_milli / cfg.fill_per_node,
+        "memory": 256.0 * MIB,
+    }
+
+
+def _storm_request(cfg: PreemptStormConfig, i: int) -> Dict[str, float]:
+    """Storm requests sized in filler slices: mostly one victim suffices,
+    every 4th arrival needs two — multi-victim sufficiency prefixes stay
+    exercised."""
+    slices = 2 if i % 4 == 3 else 1
+    return {
+        "cpu": (cfg.node_cpu_milli / cfg.fill_per_node) * slices,
+        "memory": 128.0 * MIB,
+    }
+
+
+def _tier_of(cfg: PreemptStormConfig, u: float) -> Tuple[str, int]:
+    """Map a uniform draw to an SLA tier (name, priority)."""
+    acc = 0.0
+    for name, prio, share in cfg.tiers:
+        acc += share
+        if u <= acc:
+            return name, prio
+    name, prio, _ = cfg.tiers[-1]
+    return name, prio
+
+
+def make_storm(cfg: PreemptStormConfig, tag: str = "storm",
+               count: Optional[int] = None) -> List[ChurnEvent]:
+    """The seeded storm history: ``count`` (default ``cfg.storm_pods``)
+    SLA-tiered high-priority pod arrivals with exponential inter-arrivals at
+    ``cfg.rate``.  A pure function of (cfg, tag) — parity replays and the
+    warmup slice coexist in one server store via the tag namespace."""
+    rng = np.random.default_rng(cfg.seed if tag == "storm" else cfg.seed + 977)
+    n = cfg.storm_pods if count is None else count
+    events: List[ChurnEvent] = []
+    t = 0.0
+    for i in range(n):
+        t += float(rng.exponential(1.0 / max(cfg.rate, 1e-9)))
+        tier, prio = _tier_of(cfg, float(rng.uniform()))
+        name = f"{tag}-{i:05d}"
+        events.append(ChurnEvent(t, "pod", "add", {
+            "name": name, "namespace": cfg.namespace,
+            "uid": f"{cfg.namespace}/{name}",
+            "group": f"sla-{tier}",
+            "containers": [_storm_request(cfg, i)],
+            "phase": "Pending",
+            "priority": prio,
+            # Deadline jobs: the SLA deadline rides an annotation — the
+            # artifact's per-tier latency split is measured against it.
+            "annotations": {"scheduler-tpu/sla-tier": tier},
+        }))
+    return events
+
+
+def _seed_objects(cfg: PreemptStormConfig) -> Dict[str, Dict[str, dict]]:
+    """The saturated cluster as wire-shaped objects, shared by the server
+    seeding and the cache seeding so the two can never drift."""
+    ns = cfg.namespace
+    objects: Dict[str, Dict[str, dict]] = {
+        "queue": {}, "node": {}, "podgroup": {}, "pod": {},
+    }
+    objects["queue"]["default"] = {"name": "default", "weight": 1}
+    for i in range(cfg.nodes):
+        name = f"pn-{i:05d}"
+        objects["node"][name] = {
+            "name": name,
+            "allocatable": {
+                "cpu": cfg.node_cpu_milli,
+                "memory": cfg.node_memory,
+                "pods": 110,
+            },
+        }
+    # Filler gangs: Running pods pinned round-robin across the node set,
+    # exactly saturating every node's CPU.  min_member > 1 keeps the gang
+    # floor load-bearing — a hunt may take at most
+    # (gang - min_member) victims from one cohort.
+    total = cfg.placed_pods
+    n_gangs = max(1, -(-total // cfg.filler_gang))
+    idx = 0
+    for g in range(n_gangs):
+        size = min(cfg.filler_gang, total - g * cfg.filler_gang)
+        if size <= 0:
+            break
+        group = f"fill-{g:04d}"
+        objects["podgroup"][f"{ns}/{group}"] = {
+            "name": group, "namespace": ns, "queue": "default",
+            "minMember": min(cfg.filler_min_member, size), "phase": "Running",
+        }
+        for k in range(size):
+            name = f"{group}-{k:04d}"
+            objects["pod"][f"{ns}/{name}"] = {
+                "name": name, "namespace": ns, "uid": f"{ns}/{name}",
+                "group": group,
+                "containers": [_filler_request(cfg)],
+                "phase": "Running",
+                "nodeName": f"pn-{idx % cfg.nodes:05d}",
+                "priority": 0,
+            }
+            idx += 1
+    # SLA lanes: one min_member=1 PodGroup per tier — storm arrivals join
+    # their tier's lane (the churn-lane shape: arrivals under PodGroups,
+    # every member schedules independently).
+    for tier, _, _ in cfg.tiers:
+        lane = f"sla-{tier}"
+        objects["podgroup"][f"{ns}/{lane}"] = {
+            "name": lane, "namespace": ns, "queue": "default",
+            "minMember": 1, "phase": "Inqueue",
+        }
+    return objects
+
+
+def seed_saturated(state, cfg: PreemptStormConfig) -> None:
+    """Preload a ``mock_server.MockState`` store with the saturated cluster
+    (no journal events: the connector's initial LIST seeds it)."""
+    objects = _seed_objects(cfg)
+    with state.lock:
+        for kind, by_key in objects.items():
+            state.objects[kind].update(by_key)
+
+
+def seed_saturated_cache(cfg: PreemptStormConfig, vocab=None):
+    """The saturated cluster straight into a SchedulerCache (no wire) —
+    ``profile_cycle --preempt`` and the parity tests use this seam.  Goes
+    through the SAME wire parsers as the server path."""
+    from scheduler_tpu.cache.cache import SchedulerCache
+    from scheduler_tpu.connector.wire import (
+        parse_node, parse_pod, parse_pod_group, parse_queue,
+    )
+
+    objects = _seed_objects(cfg)
+    cache = SchedulerCache(vocab=vocab, async_io=False)
+    for q in objects["queue"].values():
+        cache.add_queue(parse_queue(q))
+    for n in objects["node"].values():
+        cache.add_node(parse_node(n))
+    for g in objects["podgroup"].values():
+        cache.add_pod_group(parse_pod_group(g))
+    for p in objects["pod"].values():
+        cache.add_pod(parse_pod(p, cache.scheduler_name))
+    return cache
+
+
+def _replay_storm(state, history: List[ChurnEvent]) -> Tuple[float, dict]:
+    """The churn replay loop with the start time returned, so per-pod
+    arrival instants (``t0 + ev.t``) live on the same monotonic clock as
+    the server's bind/evict stamps."""
+    from scheduler_tpu.harness.churn import replay
+
+    t0 = time.monotonic()
+    rep = replay(state, history)
+    return t0, rep
+
+
+def _cycle_rows(cycles: List[dict]) -> List[dict]:
+    """Per-cycle artifact rows: latency, event batch, and the evict/victims
+    evidence blocks (ops/evict.py stats -> phases.note)."""
+    return [
+        {
+            "s": round(c["s"], 4),
+            "t": round(c["t"], 3),
+            "events": c["events"],
+            "evict": c["notes"].get("evict", {}),
+            "victims": c["notes"].get("victims", {}),
+        }
+        for c in cycles[-500:]
+    ]
+
+
+def run_preempt_bench(cfg: PreemptStormConfig,
+                      wire: Optional[str] = None) -> dict:
+    """Run the preempt-storm scenario end to end and return the artifact
+    body (``BENCH_PREEMPT_r*.json``).  ``wire`` pins the inbound protocol
+    (None = ``SCHEDULER_TPU_WIRE``, default k8s); the victim-hunt flavor is
+    whatever ``SCHEDULER_TPU_EVICT`` says, and the artifact records it plus
+    the per-cycle engagement evidence."""
+    import tempfile
+
+    import scheduler_tpu.actions  # noqa: F401  registry side effects
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.connector.client import connect_cache
+    from scheduler_tpu.connector.mock_server import serve
+    from scheduler_tpu.ops.evict import evict_flavor
+    from scheduler_tpu.scheduler import Scheduler
+    from scheduler_tpu.utils.trigger import CycleTrigger
+
+    flavor = evict_flavor()
+    server, state = serve(0)
+    server_thread = threading.Thread(target=server.serve_forever, daemon=True)
+    server_thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    seed_saturated(state, cfg)
+
+    # Outbound dialect: batched legacy RPCs, the churn rig's choice and for
+    # the same reason — the scenario measures the scheduling pipeline, not
+    # urllib's one-connection-per-request transport.  The INBOUND wire is
+    # the protocol under test.
+    cache, connector = connect_cache(base, dialect="legacy", wire=wire)
+    stop = threading.Event()
+    sched_thread = None
+    conf_file = tempfile.NamedTemporaryFile(
+        "w", suffix=".yaml", prefix="preempt-conf-", delete=False
+    )
+    try:
+        conf_file.write(PREEMPT_CONF)
+        conf_file.close()
+        cache.run()
+        connector.start()
+        if not connector.wait_for_cache_sync(timeout=60):
+            raise RuntimeError("preempt rig: cache never synced")
+
+        trigger = CycleTrigger.from_env(default_max_interval=cfg.max_interval_s)
+        sched = Scheduler(
+            cache, scheduler_conf=conf_file.name,
+            schedule_period=cfg.max_interval_s,
+            trigger=trigger, record_cycles=True,
+        )
+        sched_thread = threading.Thread(
+            target=sched.run, args=(stop,), daemon=True
+        )
+        sched_thread.start()
+
+        # Warmup storm: pays the XLA compiles for the task buckets the
+        # measured window visits.  Warm arrivals preempt real filler — the
+        # saturated mass is sized so the warm dent (warm_pods victims of
+        # placed_pods) leaves the measured regime saturated; the artifact
+        # records both counts.
+        if cfg.warm_pods > 0:
+            _replay_storm(state, make_storm(
+                cfg, tag="warm", count=cfg.warm_pods
+            ))
+            if not _wait_drained(sched, trigger, timeout=cfg.drain_timeout_s):
+                raise RuntimeError(
+                    "preempt rig: scheduler never drained the warmup storm"
+                )
+
+        mark = len(sched.cycle_log)
+        with state.lock:
+            bind_mark = len(state.bind_log)
+            evict_mark = len(state.evict_log)
+
+        history = make_storm(cfg)
+        t0, rep = _replay_storm(state, history)
+        drained = _wait_drained(sched, trigger, timeout=cfg.drain_timeout_s)
+        stop.set()
+        sched_thread.join(timeout=60)
+        cycles = list(sched.cycle_log)[mark:]
+        with state.lock:
+            binds = list(state.bind_log)[bind_mark:]
+            evicts = list(state.evict_log)[evict_mark:]
+    finally:
+        stop.set()
+        # Teardown order matters (harness/churn.py): drain the cache's
+        # async IO against the LIVE server, then ingestion, then the server.
+        cache.stop()
+        try:
+            connector.stop()
+        except Exception:
+            pass
+        server.shutdown()
+        import os
+
+        try:
+            os.unlink(conf_file.name)
+        except OSError:
+            pass
+
+    # Time-to-preempt: arrival instant (replay start + event offset) to the
+    # FIRST bind of that pod landing back at the apiserver — the price of
+    # the whole evict -> watch echo -> capacity-free -> rebind pipeline.
+    arrival = {ev.obj["uid"]: t0 + ev.t for ev in history}
+    tier_of = {
+        ev.obj["uid"]: ev.obj["annotations"]["scheduler-tpu/sla-tier"]
+        for ev in history
+    }
+    first_bind: Dict[str, float] = {}
+    for b in binds:
+        if b["pod"] in arrival and b["pod"] not in first_bind:
+            first_bind[b["pod"]] = b["t"]
+    lat_ms = {
+        uid: (first_bind[uid] - t_arr) * 1000.0
+        for uid, t_arr in arrival.items() if uid in first_bind
+    }
+    lats = sorted(lat_ms.values())
+    per_tier: Dict[str, dict] = {}
+    for tier, _, _ in cfg.tiers:
+        tl = [v for uid, v in lat_ms.items() if tier_of[uid] == tier]
+        per_tier[tier] = {
+            "bound": len(tl),
+            "p50_ms": round(_percentile(tl, 50), 3),
+            "p99_ms": round(_percentile(tl, 99), 3),
+        }
+
+    window_s = max(rep["elapsed_s"], 1e-9)
+    engaged = sum(
+        1 for c in cycles
+        if any(
+            blk.get("engaged") for blk in (c["notes"].get("evict") or {}).values()
+        )
+    )
+    if not drained:
+        cycles = []  # a backlog cannot claim a latency distribution
+
+    detail = {
+        "family": "preempt",
+        "evict_flavor": flavor,
+        "seed": cfg.seed,
+        "nodes": cfg.nodes,
+        "placed_pods": cfg.placed_pods,
+        "filler_gang": cfg.filler_gang,
+        "filler_min_member": cfg.filler_min_member,
+        "storm_pods": cfg.storm_pods,
+        "warm_pods": cfg.warm_pods,
+        "rate_target": cfg.rate,
+        "rate_sustained": rep["rate"],
+        "replay": rep,
+        "duration_s": round(cfg.duration_s, 3),
+        "drained": drained,
+        "cycles_measured": len(cycles),
+        "bound": len(lats),
+        "unbound": cfg.storm_pods - len(lats),
+        "p50_preempt_ms": round(_percentile(lats, 50), 3),
+        "p99_preempt_ms": round(_percentile(lats, 99), 3),
+        "max_preempt_ms": round(max(lats), 3) if lats else 0.0,
+        "sla": per_tier,
+        "evictions": len(evicts),
+        "evictions_per_s": round(len(evicts) / window_s, 2),
+        "binds": len(binds),
+        # Churn amplification: running pods displaced per placed arrival —
+        # the saturation regime's cost multiplier.
+        "churn_amplification": round(len(evicts) / max(len(binds), 1), 4),
+        "engaged_cycles": engaged,
+        "cycles": _cycle_rows(cycles),
+    }
+    return {
+        "metric": "preempt_p99_ms",
+        "value": detail["p99_preempt_ms"],
+        "unit": "ms",
+        # Working target: a saturated-cluster arrival should displace its
+        # victim and land inside one second end to end.
+        "vs_target": round(detail["p99_preempt_ms"] / 1000.0, 4),
+        "detail": detail,
+    }

@@ -116,6 +116,15 @@ _ENV_KEYS = (
     # scatter.  Both are content-exact, but a resident diagnosed under one
     # regime must not silently straddle a flip.
     "SCHEDULER_TPU_DIRTY_DELTA",
+    # Victim-hunt flavor (ops/evict.py, docs/PREEMPT.md): host per-node walk
+    # vs the batched device eviction engine.  Never read by the allocate
+    # engine build itself, but registered — like SCHEDULER_TPU_WIRE — so a
+    # resident engine is pinned to the eviction regime it was diagnosed
+    # under: the host-vs-device parity contract says the flavor never
+    # changes evictions or binds, and keying here means a violation can
+    # never hide behind a warm cache across a flag flip (re-checked by
+    # _delta_compatible for direct update() callers).
+    "SCHEDULER_TPU_EVICT",
 )
 
 _scope_counter = itertools.count(1)

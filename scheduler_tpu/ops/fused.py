@@ -1037,6 +1037,14 @@ class FusedAllocator:
         from scheduler_tpu.ops.lp_place import allocator_flavor
 
         self.allocator = allocator_flavor()
+        # Victim-hunt flavor (ops/evict.py, docs/PREEMPT.md): never read by
+        # the allocate program itself, but pinned like SCHEDULER_TPU_WIRE —
+        # a resident engine must not straddle an eviction-regime flip, so
+        # the flavor sits in the engine-cache key and is re-checked by
+        # _delta_compatible for direct update() callers.
+        from scheduler_tpu.ops.evict import evict_flavor
+
+        self.evict_flavor = evict_flavor()
         self.use_lp = False
         self.lp_reason = None         # why lp fell back to greedy, if it did
         self._lp_dev = None           # in-flight (pref, lp_raw) device pair
@@ -2067,6 +2075,15 @@ class FusedAllocator:
             # program's class weighting; pinned by the cache key's env
             # component in the cached flow — this re-check covers direct
             # update() callers (parity tests).
+            return False
+        from scheduler_tpu.ops.evict import evict_flavor
+
+        if self.evict_flavor != evict_flavor():
+            # The eviction regime never changes this engine's program (the
+            # host-vs-device parity contract, docs/PREEMPT.md), but a
+            # violation of that contract must not hide behind a warm
+            # resident across a flag flip — same pinning rationale as the
+            # cache key's SCHEDULER_TPU_EVICT component.
             return False
         queue_names = sorted(
             ssn.queues, key=lambda q: (ssn.queues[q].creation_timestamp, q)

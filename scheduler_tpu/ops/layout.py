@@ -204,6 +204,11 @@ BUFFERS = {
         "all_cand": ("WINNER", 1),
         "all_packs": ("LP_PACK", 1),
     },
+    "ops/evict.py": {
+        "pick": ("EVICT_PICK", 0),
+        "all_picks": ("EVICT_PICK", 1),
+        "winner": ("EVICT_PICK", 0),
+    },
 }
 
 # Namespaces whose accesses get the guard-condition DATAFLOW check (VMEM
@@ -261,6 +266,18 @@ DOC_ROWS = {
         "UNUSED": "zeroed tail, reserved",
     },
 }
+
+
+class EVICT_PICK:
+    """Device eviction engine winner tuple (``ops/evict.py``
+    ``sharded_victim_pick``, docs/PREEMPT.md): one packed f32 candidate row
+    per chip — the victim-hunt sibling of ``WINNER``.  Each shard reduces
+    its node block to the earliest sweep-order position holding a
+    sufficient victim plan; the tuples all-gather once per hunt step and
+    the replicated argmin picks the global earliest node."""
+
+    POS = 0    # sweep-order position of the shard's best node (+inf: none)
+    NODE = 1   # that node's GLOBAL row index, as f32 (exact below 2^24)
 
 
 class STEP_NODE:
@@ -404,6 +421,21 @@ SHARD_SITES = {
         "out": ("node_trailing_2d", "node_trailing_2d", "replicated",
                 "replicated"),
     },
+    # Device eviction engine node pick (ops/evict.py, docs/PREEMPT.md):
+    # ONE node-major operand — the per-node sweep-order position, +inf
+    # where the node holds no sufficient victim plan — reduced per shard
+    # to an EVICT_PICK candidate tuple, all-gathered once, argmin'd
+    # replicated.  The per-victim mask/prefix math stays host-side (see
+    # the placement note in ops/evict.py); this site is the one device
+    # seam a hunt crosses, riding the winner-tuple pattern.
+    "ops/evict.py::_victim_pick_1d": {
+        "in": ("node_major",),
+        "out": ("replicated",),
+    },
+    "ops/evict.py::_victim_pick_2d": {
+        "in": ("node_major_2d",),
+        "out": ("replicated",),
+    },
 }
 
 # Per-site collective budget in the COMPILED HLO, counted per loop step
@@ -456,6 +488,15 @@ COLLECTIVE_BUDGET = {
         "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
     },
     "ops/lp_place.py::_lp_iterate_sig_2d": {
+        "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
+    },
+    # Victim-plan pick: exactly one EVICT_PICK-tuple all-gather per hunt
+    # step, zero all-reduces — the same contract as the placement scan's
+    # winner gather (verified: shard_budget on both mesh shapes).
+    "ops/evict.py::_victim_pick_1d": {
+        "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
+    },
+    "ops/evict.py::_victim_pick_2d": {
         "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
     },
 }

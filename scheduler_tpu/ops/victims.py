@@ -96,6 +96,10 @@ class VictimGate:
             "SCHEDULER_TPU_SWEEP", True
         )
         self._built = False
+        # Gated-vs-ungated coverage evidence: node visits the gate admitted
+        # vs collapsed, routed by the actions through ``note_evidence`` into
+        # bench ``detail.cycles[].victims`` (ISSUE 12 satellite).
+        self.counters: Dict[str, int] = {"admitted": 0, "skipped": 0}
         self._counts: Optional[np.ndarray] = None     # i64 [N, Q]
         self._min_req: Optional[np.ndarray] = None    # f64 [N, Q, R] elementwise min
         self._queues: list = []
@@ -318,11 +322,44 @@ class VictimGate:
         if job is not None and task.node_name:
             self.note_eviction(task.node_name, job)
 
+    def _count(self, admitted: bool) -> bool:
+        """Book one node-visit verdict into the evidence counters and pass
+        it through — every admission path funnels here so the bench block's
+        gated-vs-ungated coverage cannot drift from the real decisions."""
+        self.counters["admitted" if admitted else "skipped"] += 1
+        return admitted
+
+    def stats(self) -> dict:
+        """The ``detail.cycles[].victims`` evidence block for one action:
+        whether the gate ran, and its admit/skip verdict counts."""
+        return {
+            "enabled": True,
+            "kind": self.kind,
+            "built": self._built,
+            "admitted": self.counters["admitted"],
+            "skipped": self.counters["skipped"],
+        }
+
+    @staticmethod
+    def note_evidence(kind: str, gate: Optional["VictimGate"]) -> None:
+        """Merge one action's gate evidence into the cycle's ``victims``
+        note (preempt and reclaim both run per cycle; the bench block
+        carries both, keyed by kind — the evict note's pattern)."""
+        from scheduler_tpu.utils import phases
+
+        if not phases.active():
+            return
+        cur = dict(phases.take_notes().get("victims") or {})
+        cur[kind] = (
+            gate.stats() if gate is not None else {"enabled": False, "kind": kind}
+        )
+        phases.note("victims", cur)
+
     def mask_admits(self, mask: np.ndarray, node_name: str) -> bool:
         row = self._row_of.get(node_name)
         if row is None or row >= mask.shape[0]:
-            return True  # unknown node: never gate out
-        return bool(mask[row])
+            return self._count(True)  # unknown node: never gate out
+        return self._count(bool(mask[row]))
 
     def admitted_positions(self, ordered_nodes, mask: np.ndarray) -> np.ndarray:
         """Positions in ``ordered_nodes`` whose gate row passes ``mask`` —
@@ -343,7 +380,10 @@ class VictimGate:
         ok = np.where(
             (rows >= 0) & (rows < mask.shape[0]), mask[safe], True
         )  # unknown rows: never gate out
-        return np.nonzero(ok)[0]
+        out = np.nonzero(ok)[0]
+        self.counters["admitted"] += int(out.shape[0])
+        self.counters["skipped"] += int(rows.shape[0] - out.shape[0])
+        return out
 
     def admits_other_job(self, node_name: str, job) -> bool:
         """Preempt phase 1: the SAME queue's other jobs have an acceptable
@@ -352,13 +392,13 @@ class VictimGate:
             self._build()
         row = self._row_of.get(node_name)
         if row is None or self._counts is None or row >= self._counts.shape[0]:
-            return True
+            return self._count(True)
         qi = self._queue_idx.get(job.queue, -1)
         if qi < 0:
-            return False
+            return self._count(False)
         own = self._own_counts(job)
         own_here = int(own[row]) if own is not None else 0
-        return int(self._counts[row, qi]) - own_here > 0
+        return self._count(int(self._counts[row, qi]) - own_here > 0)
 
     def admits_own_job(self, node_name: str, job) -> bool:
         """Preempt phase 2: the job's own acceptable victims ran here."""
@@ -366,11 +406,11 @@ class VictimGate:
             self._build()
         row = self._row_of.get(node_name)
         if row is None:
-            return True
+            return self._count(True)
         own = self._own_counts(job)
         if own is None:
-            return True
-        return row < own.shape[0] and int(own[row]) > 0
+            return self._count(True)
+        return self._count(row < own.shape[0] and int(own[row]) > 0)
 
     def _own_counts(self, job) -> Optional[np.ndarray]:
         hit = self._own_cache.get(job.uid, False)

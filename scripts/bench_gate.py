@@ -24,6 +24,17 @@ a directory given as argv[1]):
   the artifact itself records (``detail.hit_rate_floor``, stamped at
   emission) — a collapse of the delta path is a regression even when the
   latency survives it.  Missing churn fields = malformed (exit 1);
+* ``BENCH_PREEMPT_r*.json`` — the saturated-cluster preempt-storm scenario
+  (``bench.py --preempt``, docs/PREEMPT.md).  LOWER is better (the metric
+  is time-to-preempt p99 in ms — storm-pod arrival to rebind), with the
+  churn family's comparability rules: the newest artifact's p99 more than
+  10% above the previous round's fails, same scenario shape
+  (nodes/placed pods/storm pods/target rate) required, different shapes
+  are not compared.  Missing evict fields (p50/p99 time-to-preempt,
+  evictions/s, churn amplification, flavor, engagement) = malformed
+  (exit 1), and an artifact claiming ``evict_flavor == "device"`` with
+  zero engaged cycles is malformed too — a host-walk measurement must not
+  file under the device flavor (the LP family's silent-fallback rule);
 * ``BENCH_LP_r*.json``  — the LP-relaxed allocator flagship
   (``SCHEDULER_TPU_ALLOCATOR=lp``, docs/LP_PLACEMENT.md).  LP artifacts
   must record ``detail.allocator == "lp"`` (else malformed, exit 1), and
@@ -69,7 +80,7 @@ TOLERANCE = 0.10
 # less than the artifact itself trusts.
 MIN_HEALTHY = 3
 
-_ROUND_RE = re.compile(r"BENCH(_MQ|_XL|_LP|_CHURN)?_r(\d+)\.json$")
+_ROUND_RE = re.compile(r"BENCH(_MQ|_XL|_LP|_CHURN|_PREEMPT)?_r(\d+)\.json$")
 
 # (family label, filename infix) — the artifact naming contract.  The churn
 # family is NOT listed here: its metric is latency (lower is better) with
@@ -90,6 +101,19 @@ _CHURN_KEYS = (
     ("p99_ms", (int, float)), ("hit_rate", (int, float)),
     ("hit_rate_floor", (int, float)), ("rate_sustained", (int, float)),
     ("cycles_measured", int),
+)
+
+# Preempt-family policy mirrors churn: lower-is-better time-to-preempt p99.
+PREEMPT_TOLERANCE = 0.10
+
+# detail keys every preempt artifact must carry, with their types — the
+# evict evidence chain (docs/PREEMPT.md); a missing field means the
+# artifact cannot defend a time-to-preempt claim.
+_PREEMPT_KEYS = (
+    ("p50_preempt_ms", (int, float)), ("p99_preempt_ms", (int, float)),
+    ("evictions_per_s", (int, float)), ("churn_amplification", (int, float)),
+    ("evict_flavor", str), ("engaged_cycles", int), ("cycles_measured", int),
+    ("bound", int),
 )
 
 # LP may bind up to this fraction fewer pods than greedy on the same shape
@@ -363,6 +387,96 @@ def gate_churn(root: Path) -> int:
     return max(worst, 2 if new_p99 > ceiling else 0)
 
 
+def _preempt_detail(path: Path):
+    """The preempt artifact's detail block, or (None, reason) when it is
+    malformed — a missing evict field means the artifact cannot defend a
+    time-to-preempt claim at all (docs/PREEMPT.md)."""
+    doc = _unwrap(json.loads(path.read_text()))
+    detail = doc.get("detail", {})
+    if detail.get("family") != "preempt":
+        return None, f"{path.name} does not record detail.family == 'preempt'"
+    for key, typ in _PREEMPT_KEYS:
+        if not isinstance(detail.get(key), typ):
+            return None, (
+                f"{path.name} is missing evict field detail.{key} — "
+                "re-emit via bench.py --preempt"
+            )
+    if detail["evict_flavor"] == "device" and detail["engaged_cycles"] == 0:
+        return None, (
+            f"{path.name} claims evict_flavor == 'device' but records zero "
+            "engaged cycles — a host-walk measurement must not file under "
+            "the device flavor (see detail.cycles[].evict for the recorded "
+            "fallback reasons)"
+        )
+    return detail, None
+
+
+def _preempt_shape(detail: dict):
+    """The scenario two preempt artifacts must share to be compared."""
+    return (
+        detail.get("nodes"), detail.get("placed_pods"),
+        detail.get("storm_pods"), detail.get("rate_target"),
+    )
+
+
+def gate_preempt(root: Path) -> int:
+    """Gate the ``BENCH_PREEMPT_r*.json`` family (docs/PREEMPT.md): LOWER
+    is better — the newest time-to-preempt p99 above
+    ``(1 + PREEMPT_TOLERANCE) x`` the previous round's fails, same scenario
+    shape required (the churn family's comparator).  Exit codes as
+    main()."""
+    artifacts = find_artifacts(root, "_PREEMPT")
+    if not artifacts:
+        print("bench-gate[preempt]: no BENCH_PREEMPT_r*.json; nothing to "
+              "judge")
+        return 0
+    try:
+        new_detail, why = _preempt_detail(artifacts[-1])
+    except json.JSONDecodeError as err:
+        print(f"bench-gate[preempt]: malformed artifact "
+              f"{artifacts[-1].name}: {err}")
+        return 1
+    if new_detail is None:
+        print(f"bench-gate[preempt]: {why}")
+        return 1
+    if len(artifacts) < 2:
+        print(
+            f"bench-gate[preempt]: {artifacts[-1].name} well-formed "
+            f"(flavor {new_detail['evict_flavor']}, p99 "
+            f"{new_detail['p99_preempt_ms']:,.1f}ms, "
+            f"{new_detail['engaged_cycles']} engaged cycle(s)); one "
+            "artifact, no p99 round to compare"
+        )
+        return 0
+    try:
+        prev_detail, why = _preempt_detail(artifacts[-2])
+    except json.JSONDecodeError as err:
+        print(f"bench-gate[preempt]: malformed artifact "
+              f"{artifacts[-2].name}: {err}")
+        return 1
+    if prev_detail is None:
+        print(f"bench-gate[preempt]: {why}")
+        return 1
+    if _preempt_shape(prev_detail) != _preempt_shape(new_detail):
+        print(
+            f"bench-gate[preempt]: {artifacts[-2].name} "
+            f"{_preempt_shape(prev_detail)} and {artifacts[-1].name} "
+            f"{_preempt_shape(new_detail)} ran different scenario shapes; "
+            "not comparable (no verdict)"
+        )
+        return 0
+    prev_p99 = prev_detail["p99_preempt_ms"]
+    new_p99 = new_detail["p99_preempt_ms"]
+    ceiling = (1.0 + PREEMPT_TOLERANCE) * prev_p99
+    verdict = "REGRESSION" if new_p99 > ceiling else "ok"
+    print(
+        f"bench-gate[preempt]: {artifacts[-2].name} p99 {prev_p99:,.1f}ms "
+        f"-> {artifacts[-1].name} {new_p99:,.1f}ms "
+        f"(ceiling {ceiling:,.1f}ms): {verdict}"
+    )
+    return 2 if new_p99 > ceiling else 0
+
+
 def gate_family(root: Path, label: str, infix: str) -> int:
     """Gate one artifact family; same exit-code contract as main()."""
     artifacts = find_artifacts(root, infix)
@@ -417,10 +531,13 @@ def gate_family(root: Path, label: str, infix: str) -> int:
 
 def main(argv) -> int:
     root = Path(argv[1]) if len(argv) > 1 else Path(__file__).resolve().parent.parent
-    # Gate every family, then the LP-vs-greedy quality check and the churn
-    # latency family; report all verdicts, exit on the worst.
+    # Gate every family, then the LP-vs-greedy quality check and the two
+    # latency families (churn, preempt); report all verdicts, exit on the
+    # worst.
     worst = max(gate_family(root, label, infix) for label, infix in FAMILIES)
-    return max(worst, gate_lp_vs_greedy(root), gate_churn(root))
+    return max(
+        worst, gate_lp_vs_greedy(root), gate_churn(root), gate_preempt(root)
+    )
 
 
 if __name__ == "__main__":

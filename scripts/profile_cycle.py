@@ -13,6 +13,16 @@ timed cycle — printing the event-batch size, the dirty-set counts
 scattered-row count, and the engine-cache outcome per cycle alongside the
 phase split, plus the run's aggregate hit rate.
 
+``--preempt`` profiles the saturated-cluster victim hunt instead
+(docs/PREEMPT.md): a cluster whose every node is full of low-priority
+filler gangs (``nodes`` hollow nodes x ``pods``-ish filler), a seeded
+SLA-tiered storm of pending high-priority pods, then timed
+``allocate, preempt`` cycles — printing the evict evidence block (flavor,
+engagement, hunt/plan/eviction counters) and the victim-hunt phase split
+(score/mask/plan/replay) next to the standard cycle phase split, plus the
+VictimGate's admit/skip coverage when the host flavor ran.  Flip
+``SCHEDULER_TPU_EVICT={host,device}`` to A/B the two hunt flavors.
+
 ``--allocator lp`` profiles the LP-relaxed flavor (docs/LP_PLACEMENT.md):
 sets ``SCHEDULER_TPU_ALLOCATOR`` for the run and splits the device phase
 into the relaxation iterations vs the repair replay vs the readback — the
@@ -202,8 +212,70 @@ def run_churn(n_nodes: int, n_placed: int, batch: int = 250,
     print(f"  hit rate over churn cycles: {hits}/{len(judged)} ({rate:.2f})")
 
 
+def run_preempt(n_nodes: int, fill_per_node: int, cycles: int = 3) -> None:
+    from scheduler_tpu.connector.wire import parse_pod
+    from scheduler_tpu.harness.measure import timed_cycle_phases, warm_engine
+    from scheduler_tpu.harness.preempt_storm import (
+        PREEMPT_CONF, PreemptStormConfig, make_storm, seed_saturated_cache,
+    )
+
+    cfg = PreemptStormConfig(
+        nodes=n_nodes, fill_per_node=fill_per_node,
+        storm_pods=max(8, n_nodes // 2),
+    )
+    conf = parse_scheduler_conf(PREEMPT_CONF)
+    cache = seed_saturated_cache(cfg)
+    cache.run()
+    warm_engine(cache, conf)
+    # The pending storm: SLA-tiered high-priority pods over the full
+    # cluster — every placement must evict.
+    for ev in make_storm(cfg):
+        cache.add_pod(parse_pod(ev.obj, cache.scheduler_name))
+    print(f"[preempt] nodes={cfg.nodes} placed={cfg.placed_pods} "
+          f"storm={cfg.storm_pods} gang={cfg.filler_gang}/"
+          f"min{cfg.filler_min_member}")
+    for i in range(cycles):
+        binds0 = len(cache.binder.binds)
+        elapsed, ph = timed_cycle_phases(cache, conf, ("allocate", "preempt"))
+        notes = ph.get("notes", {})
+        label = "compile" if i == 0 else "steady"
+        print(f"  cycle {i} ({label:7s}): {elapsed * 1000:8.1f}ms  "
+              f"binds+={len(cache.binder.binds) - binds0}")
+        for kind, blk in sorted((notes.get("evict") or {}).items()):
+            if blk.get("engaged"):
+                split = blk.get("phase", {})
+                print(f"    evict[{kind}]   flavor={blk['flavor']} "
+                      f"hunts={blk['hunts']} planned={blk['planned_nodes']} "
+                      f"evictions={blk['evictions']} "
+                      f"pipelined={blk['pipelined']} "
+                      f"picks={blk['device_picks']}")
+                print("    hunt split     " + "  ".join(
+                    f"{k}={split.get(k, 0.0) * 1000:.1f}ms"
+                    for k in ("score", "mask", "plan", "replay")
+                ))
+            else:
+                print(f"    evict[{kind}]   flavor={blk.get('flavor', '?')} "
+                      f"engaged=False ({blk.get('reason', 'n/a')})")
+        for kind, blk in sorted((notes.get("victims") or {}).items()):
+            if blk.get("enabled"):
+                print(f"    victims[{kind}] admitted={blk['admitted']} "
+                      f"skipped={blk['skipped']}")
+        keys = ("open", "engine_init", "dispatch", "device", "decode",
+                "apply", "close", "overlap_host")
+        split = "  ".join(
+            f"{k}={ph[k] * 1000:.1f}ms" for k in keys if k in ph
+        )
+        print(f"    cycle split    {split}")
+
+
 if __name__ == "__main__":
     argv = list(sys.argv[1:])
+    if "--preempt" in argv:
+        argv.remove("--preempt")
+        n_nodes = int(argv[0]) if len(argv) > 0 else 64
+        fill = int(argv[1]) if len(argv) > 1 else 8
+        run_preempt(n_nodes, fill)
+        sys.exit(0)
     if "--churn" in argv:
         argv.remove("--churn")
         n_nodes = int(argv[0]) if len(argv) > 0 else 1_000

@@ -222,6 +222,25 @@ def _hlo_lp_iterate_sig(mesh) -> str:
     return lowered.compile().as_text()
 
 
+def _hlo_victim_pick(mesh) -> str:
+    """Lower the eviction engine's victim-plan node pick
+    (``ops/evict.py`` ``sharded_victim_pick``, docs/PREEMPT.md): each shard
+    reduces its node block to an EVICT_PICK candidate tuple, the tuples
+    all-gather ONCE per hunt step, and the replicated argmin picks the
+    earliest sweep-order node holding a sufficient victim plan — the
+    winner-tuple contract (one all-gather, zero all-reduces) on both mesh
+    shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from scheduler_tpu.ops.evict import sharded_victim_pick
+
+    lowered = jax.jit(
+        lambda pos: sharded_victim_pick(pos, mesh=mesh)
+    ).lower(jnp.zeros(mesh.size * 2, jnp.float32))
+    return lowered.compile().as_text()
+
+
 def _hlo_selector_mask(mesh) -> str:
     import jax.numpy as jnp
     import numpy as np
@@ -251,12 +270,14 @@ def lowerable_sites(mesh) -> dict:
             "ops/sharded.py::_selector_mask_2d": _hlo_selector_mask,
             "ops/lp_place.py::_lp_iterate_2d": _hlo_lp_iterate,
             "ops/lp_place.py::_lp_iterate_sig_2d": _hlo_lp_iterate_sig,
+            "ops/evict.py::_victim_pick_2d": _hlo_victim_pick,
         }
     return {
         "ops/sharded.py::_place_scan_1d": _hlo_place_scan,
         "ops/sharded.py::_selector_mask_1d": _hlo_selector_mask,
         "ops/lp_place.py::_lp_iterate_1d": _hlo_lp_iterate,
         "ops/lp_place.py::_lp_iterate_sig_1d": _hlo_lp_iterate_sig,
+        "ops/evict.py::_victim_pick_1d": _hlo_victim_pick,
     }
 
 

@@ -401,3 +401,108 @@ def test_churn_gate_with_no_artifacts_is_silent_pass(tmp_path):
     from scripts.bench_gate import gate_churn
 
     assert gate_churn(tmp_path) == 0
+
+
+# -- preempt family (docs/PREEMPT.md): lower-is-better time-to-preempt p99 --
+
+
+def _preempt_artifact(p99=400.0, flavor="device", engaged=8, nodes=32,
+                      placed=256, storm=96, rate=60.0, **extra) -> dict:
+    detail = {
+        "family": "preempt", "evict_flavor": flavor, "seed": 0,
+        "nodes": nodes, "placed_pods": placed, "storm_pods": storm,
+        "warm_pods": 12, "rate_target": rate, "rate_sustained": rate * 0.95,
+        "duration_s": storm / rate, "drained": True, "cycles_measured": 40,
+        "bound": storm - 5, "unbound": 5,
+        "p50_preempt_ms": p99 / 3.0, "p99_preempt_ms": p99,
+        "max_preempt_ms": p99 * 1.2,
+        "evictions": 100, "evictions_per_s": 20.0, "binds": 91,
+        "churn_amplification": 1.1, "engaged_cycles": engaged,
+    }
+    detail.update(extra)
+    return {
+        "metric": "preempt_p99_ms", "value": p99, "unit": "ms",
+        "vs_target": p99 / 1000.0, "detail": detail,
+    }
+
+
+def test_preempt_family_is_recognized_and_segregated(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _artifact(100.0))
+    _write(tmp_path, "BENCH_PREEMPT_r01.json", _preempt_artifact())
+    assert [p.name for p in find_artifacts(tmp_path, "")] == ["BENCH_r01.json"]
+    assert [p.name for p in find_artifacts(tmp_path, "_PREEMPT")] == [
+        "BENCH_PREEMPT_r01.json"
+    ]
+
+
+def test_preempt_single_wellformed_artifact_passes(tmp_path):
+    from scripts.bench_gate import gate_preempt
+
+    _write(tmp_path, "BENCH_PREEMPT_r01.json", _preempt_artifact())
+    assert gate_preempt(tmp_path) == 0
+    assert gate_main(["bench_gate", str(tmp_path)]) == 0
+
+
+def test_preempt_p99_regression_beyond_tolerance_fails(tmp_path):
+    from scripts.bench_gate import gate_preempt
+
+    _write(tmp_path, "BENCH_PREEMPT_r01.json", _preempt_artifact(p99=400.0))
+    _write(tmp_path, "BENCH_PREEMPT_r02.json", _preempt_artifact(p99=480.0))
+    assert gate_preempt(tmp_path) == 2
+    assert gate_main(["bench_gate", str(tmp_path)]) == 2
+
+
+def test_preempt_p99_within_tolerance_passes(tmp_path):
+    from scripts.bench_gate import gate_preempt
+
+    _write(tmp_path, "BENCH_PREEMPT_r01.json", _preempt_artifact(p99=400.0))
+    _write(tmp_path, "BENCH_PREEMPT_r02.json", _preempt_artifact(p99=430.0))
+    assert gate_preempt(tmp_path) == 0
+
+
+def test_preempt_improvement_passes(tmp_path):
+    from scripts.bench_gate import gate_preempt
+
+    _write(tmp_path, "BENCH_PREEMPT_r01.json", _preempt_artifact(p99=400.0))
+    _write(tmp_path, "BENCH_PREEMPT_r02.json", _preempt_artifact(p99=250.0))
+    assert gate_preempt(tmp_path) == 0
+
+
+def test_preempt_rounds_on_different_shapes_are_not_compared(tmp_path):
+    from scripts.bench_gate import gate_preempt
+
+    _write(tmp_path, "BENCH_PREEMPT_r01.json",
+           _preempt_artifact(p99=400.0, nodes=32))
+    _write(tmp_path, "BENCH_PREEMPT_r02.json",
+           _preempt_artifact(p99=4000.0, nodes=64))
+    assert gate_preempt(tmp_path) == 0
+
+
+def test_preempt_artifact_missing_evict_fields_is_malformed(tmp_path):
+    from scripts.bench_gate import gate_preempt
+
+    doc = _preempt_artifact()
+    del doc["detail"]["churn_amplification"]
+    _write(tmp_path, "BENCH_PREEMPT_r01.json", doc)
+    assert gate_preempt(tmp_path) == 1
+    assert gate_main(["bench_gate", str(tmp_path)]) == 1
+
+
+def test_preempt_device_claim_without_engagement_is_malformed(tmp_path):
+    from scripts.bench_gate import gate_preempt
+
+    # A host-walk measurement must not file under the device flavor (the
+    # LP family's silent-fallback rule).
+    _write(tmp_path, "BENCH_PREEMPT_r01.json",
+           _preempt_artifact(flavor="device", engaged=0))
+    assert gate_preempt(tmp_path) == 1
+    # The host flavor legitimately records zero engaged cycles.
+    _write(tmp_path, "BENCH_PREEMPT_r01.json",
+           _preempt_artifact(flavor="host", engaged=0))
+    assert gate_preempt(tmp_path) == 0
+
+
+def test_preempt_gate_with_no_artifacts_is_silent_pass(tmp_path):
+    from scripts.bench_gate import gate_preempt
+
+    assert gate_preempt(tmp_path) == 0

@@ -237,12 +237,16 @@ def test_budget_holds_on_the_2d_mesh_one_merged_all_gather():
         # classes"): same one-collective-per-step contract, checked below.
         "ops/lp_place.py::_lp_iterate_2d",
         "ops/lp_place.py::_lp_iterate_sig_2d",
+        # Eviction-engine node pick (round 12, docs/PREEMPT.md): one
+        # EVICT_PICK tuple all-gather per hunt step, checked below.
+        "ops/evict.py::_victim_pick_2d",
     }
     counts = count_collectives(sites[site](mesh))
     assert counts == {"all-gather": 1}
     assert check_counts(site, counts, layout.COLLECTIVE_BUDGET[site]) == []
     for lp_site in ("ops/lp_place.py::_lp_iterate_2d",
-                    "ops/lp_place.py::_lp_iterate_sig_2d"):
+                    "ops/lp_place.py::_lp_iterate_sig_2d",
+                    "ops/evict.py::_victim_pick_2d"):
         lp_counts = count_collectives(sites[lp_site](mesh))
         assert lp_counts == {"all-gather": 1}
         assert check_counts(

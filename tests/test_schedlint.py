@@ -198,6 +198,48 @@ def test_env_fixtures_cover_the_allocator_flavor_and_lp_knobs():
     assert out == []
 
 
+def test_env_fixtures_cover_the_evict_flavor():
+    """SCHEDULER_TPU_EVICT (victim-hunt flavor, ops/evict.py,
+    docs/PREEMPT.md) rides the standard env machinery: a raw os.environ
+    read trips raw-env, an envflags read under ops/ without registration
+    trips env-drift (a resident allocate engine must be pinned to the
+    eviction regime it was diagnosed under), and the real tree's
+    registered shape keeps both passes clean."""
+    out = findings("raw-env", py={
+        "scheduler_tpu/ops/evict.py": """
+            import os
+            def evict_flavor():
+                return os.environ.get("SCHEDULER_TPU_EVICT", "host")
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_EVICT" in out[0].message
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+        "scheduler_tpu/ops/evict.py": """
+            from scheduler_tpu.utils.envflags import env_str
+            def evict_flavor():
+                return env_str("SCHEDULER_TPU_EVICT", "host",
+                               choices=("host", "device"))
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_EVICT" in out[0].message
+    # Registered (the real tree's shape): clean.
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": """
+            _ENV_KEYS = (
+                "SCHEDULER_TPU_EVICT",
+            )
+        """,
+        "scheduler_tpu/ops/evict.py": """
+            from scheduler_tpu.utils.envflags import env_str
+            def evict_flavor():
+                return env_str("SCHEDULER_TPU_EVICT", "host",
+                               choices=("host", "device"))
+        """,
+    })
+    assert out == []
+
+
 def test_env_fixtures_cover_the_sig_compress_flag():
     """SCHEDULER_TPU_SIG_COMPRESS (ops/sig_compress.py, docs/LP_PLACEMENT.md
     "Signature classes") selects [T, N] vs [S, N] static staging — exactly

@@ -349,6 +349,42 @@ def main() -> None:
         pool, regime = runs, "degraded"
     binds, elapsed, _ = sorted(pool, key=lambda r: r[1])[len(pool) // 2]
 
+    # Always-on flight-recorder overhead evidence (docs/OBSERVABILITY.md
+    # "Overhead contract"): the measured cycles above ran with the recorder
+    # at its default (on); one extra cycle with SCHEDULER_TPU_OBS=0 prices
+    # the always-on tax as detail.obs.overhead_frac.  The off cycle warms
+    # and measures entirely under the flipped flag (the flag sits in the
+    # engine-cache key, so it builds its own resident), making the A/B a
+    # steady-cycle vs steady-cycle comparison.  Skipped when the run was
+    # ALREADY recorder-off — there is nothing to price then.
+    import os as _os
+
+    from scheduler_tpu.utils import obs as _obs
+
+    obs_detail: dict = {
+        "enabled": _obs.enabled(),
+        "ring": len(_obs.ring_snapshot()),
+    }
+    if _obs.enabled():
+        # Save/restore, not a parse: the raw value (None vs string) must
+        # round-trip exactly — envflags owns parsing, not mutation.
+        prev_obs = _os.environ.get("SCHEDULER_TPU_OBS")  # schedlint: ignore[raw-env]
+        _os.environ["SCHEDULER_TPU_OBS"] = "0"
+        try:
+            _, off_elapsed, _ = one_cycle(
+                n_nodes, n_pods, tasks_per_job, n_queues
+            )
+        finally:
+            if prev_obs is None:
+                _os.environ.pop("SCHEDULER_TPU_OBS", None)
+            else:
+                _os.environ["SCHEDULER_TPU_OBS"] = prev_obs
+        obs_detail.update({
+            "on_cycle_s": round(elapsed, 3),
+            "off_cycle_s": round(off_elapsed, 3),
+            "overhead_frac": round((elapsed - off_elapsed) / off_elapsed, 4),
+        })
+
     pods_per_sec = binds / elapsed
     print(json.dumps({
         "metric": "pods_per_sec",
@@ -377,6 +413,10 @@ def main() -> None:
                 "violations": shardcheck.violations(),
             },
             "policy": POLICY,
+            # Flight-recorder state + always-on overhead A/B (docs/
+            # OBSERVABILITY.md): scripts/bench_gate.py sanity-checks the
+            # block's shape and surfaces an overhead_frac past the contract.
+            "obs": obs_detail,
             "cycles": [
                 {
                     "s": round(el, 3),

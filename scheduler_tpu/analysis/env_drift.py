@@ -33,7 +33,7 @@ ENV_PREFIX = "SCHEDULER_TPU_"
 # (JAX_PLATFORMS, XLA_FLAGS) — those are mutated via the documented
 # save/restore pattern, and envflags owns parsing, not mutation.
 EXTRA_FLAGS = ("PANIC_ON_ERROR",)
-ENVFLAG_FUNCS = {"env_bool", "env_int", "env_float", "env_str"}
+ENVFLAG_FUNCS = {"env_bool", "env_int", "env_float", "env_str", "env_path"}
 ENV_KEYS_MODULE = "ops/engine_cache.py"
 ENV_KEYS_NAME = "_ENV_KEYS"
 

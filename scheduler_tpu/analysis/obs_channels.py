@@ -1,0 +1,281 @@
+"""Pass ``obs-channel``: the observability channel registry
+(``utils/obs.py`` ``OBS_CHANNELS``) verified end to end.
+
+The flight recorder (docs/OBSERVABILITY.md) unifies every per-cycle evidence
+system behind ``phases.note(<channel>, ...)``; the registry declares each
+channel as literal data, layout.py-style.  Four checks close the loop:
+
+* every literal ``phases.note``/``obs.note`` channel in the tree is a
+  declared registry row (an undeclared channel is evidence that never made
+  it to the doc, the ring schema or the metrics surface — the round-4
+  failure class);
+* every declared row either names an exported ``metric`` — the name must
+  appear in the exposition renderers (``utils/obs.py`` outside the registry
+  literal itself, or ``utils/metrics.py``) — or carries a documented
+  ``exempt`` reason, never both, never neither;
+* a declared channel that NOTHING notes is a dead row (typo detector;
+  skipped when the analyzed subset contains no note calls at all, the
+  ``--changed`` under-approximation rule stats round-trip already uses);
+* the generated channel table in docs/OBSERVABILITY.md matches the registry
+  (rendered between ``layout:OBS_CHANNELS`` markers by the SAME renderer
+  ``scripts/gen_layout_doc.py`` writes with, so a generated doc can never
+  fail the gate).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from scheduler_tpu.analysis.core import (
+    Finding, PyModule, Repo, dotted, register,
+)
+from scheduler_tpu.analysis.row_layout import marker_lines
+
+RULE = "obs-channel"
+OBS_MODULE = "utils/obs.py"
+TABLE_NAME = "OBS_CHANNELS"
+OBS_DOC = "docs/OBSERVABILITY.md"
+TABLE_NS = "OBS_CHANNELS"
+# Modules whose string constants count as "the metric is exported": the
+# flight-recorder renderer and the reference-shaped collector module.
+EXPORTER_SUFFIXES = ("utils/obs.py", "utils/metrics.py")
+ROW_KEYS = {"channel", "source", "metric", "exempt", "desc"}
+
+
+def _module_at(repo: Repo, suffix: str) -> Optional[PyModule]:
+    for m in repo.modules:
+        if m.path == suffix or m.path.endswith("/" + suffix):
+            return m
+    return None
+
+
+def _registry_node(tree: ast.AST) -> Optional[ast.Assign]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == TABLE_NAME:
+                    return node
+    return None
+
+
+def _literal_row(elt: ast.AST) -> Optional[Dict[str, Optional[str]]]:
+    if not isinstance(elt, ast.Dict):
+        return None
+    row: Dict[str, Optional[str]] = {}
+    for k, v in zip(elt.keys, elt.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        if isinstance(v, ast.Constant) and (
+            v.value is None or isinstance(v.value, str)
+        ):
+            row[k.value] = v.value
+        elif isinstance(v, ast.BinOp):
+            # Implicitly-concatenated long strings parse as Constant; an
+            # explicit ``+`` does not — treat as non-literal.
+            return None
+        else:
+            return None
+    return row
+
+
+def channels_from_tree(tree: ast.AST) -> Optional[List[Dict[str, Optional[str]]]]:
+    """The registry rows AS DATA, or None when the literal is missing or
+    not fully literal (the gate then reports that instead of guessing)."""
+    node = _registry_node(tree)
+    if node is None or not isinstance(node.value, (ast.Tuple, ast.List)):
+        return None
+    rows = []
+    for elt in node.value.elts:
+        row = _literal_row(elt)
+        if row is None:
+            return None
+        rows.append(row)
+    return rows
+
+
+def channels_from_source(source: str) -> Optional[List[Dict[str, Optional[str]]]]:
+    return channels_from_tree(ast.parse(source))
+
+
+def _note_calls(mod: PyModule) -> List[Tuple[int, str]]:
+    """(line, channel) for every literal-channel note call — the
+    ``phases.note`` frontend and direct ``obs.note`` both count."""
+    out: List[Tuple[int, str]] = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        d = dotted(node.func)
+        if d is None or d.rsplit(".", 1)[-1] != "note":
+            continue
+        base = d.rsplit(".", 2)[-2] if "." in d else ""
+        if base not in ("phases", "obs"):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            out.append((node.lineno, arg.value))
+    return out
+
+
+def _exporter_strings(repo: Repo, obs_mod: Optional[PyModule]) -> Optional[Set[str]]:
+    """String constants of the exposition renderers.  For ``utils/obs.py``
+    the registry literal's own lines are EXCLUDED — a metric name that only
+    exists inside OBS_CHANNELS is declared, not exported."""
+    mods = [m for s in EXPORTER_SUFFIXES for m in [_module_at(repo, s)] if m]
+    if not mods:
+        return None
+    out: Set[str] = set()
+    for mod in mods:
+        skip: Tuple[int, int] = (-1, -1)
+        if obs_mod is not None and mod.path == obs_mod.path:
+            node = _registry_node(mod.tree)
+            if node is not None:
+                skip = (node.lineno, node.end_lineno or node.lineno)
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                if skip[0] <= n.lineno <= skip[1]:
+                    continue
+                out.add(n.value)
+    return out
+
+
+def render_channel_table(rows: List[Dict[str, Optional[str]]]) -> List[str]:
+    """The doc table (docs/OBSERVABILITY.md) — ONE renderer shared with
+    scripts/gen_layout_doc.py so doc and gate can never disagree."""
+    out = [
+        "| channel | source | exported metric | exemption | description |",
+        "|---|---|---|---|---|",
+    ]
+    for row in sorted(rows, key=lambda r: r.get("channel") or ""):
+        metric = row.get("metric")
+        exempt = row.get("exempt")
+        out.append(
+            "| `{}` | `{}` | {} | {} | {} |".format(
+                row.get("channel", "?"),
+                row.get("source", "?"),
+                f"`{metric}`" if metric else "—",
+                exempt or "—",
+                row.get("desc") or "—",
+            )
+        )
+    return out
+
+
+@register(RULE)
+def obs_channel(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    obs_mod = _module_at(repo, OBS_MODULE)
+    noted: List[Tuple[str, int, str]] = []
+    for mod in repo.modules:
+        if mod.path.startswith("tests/") or "/tests/" in mod.path:
+            continue  # fixture corpora embed note calls as data
+        for line, channel in _note_calls(mod):
+            noted.append((mod.path, line, channel))
+
+    if obs_mod is None:
+        if noted:
+            path, line, channel = noted[0]
+            out.append(Finding(
+                RULE, path, line,
+                f"phases.note('{channel}') but {OBS_MODULE} (the "
+                f"{TABLE_NAME} registry) is not in the analyzed set",
+            ))
+        return out
+
+    rows = channels_from_tree(obs_mod.tree)
+    if rows is None:
+        out.append(Finding(
+            RULE, obs_mod.path, 1,
+            f"cannot resolve {TABLE_NAME} as literal data: the channel "
+            "registry must stay a tuple of dicts with constant keys/values",
+        ))
+        return out
+
+    declared: Dict[str, Dict[str, Optional[str]]] = {}
+    for row in rows:
+        channel = row.get("channel")
+        if not channel:
+            out.append(Finding(
+                RULE, obs_mod.path, 1,
+                f"{TABLE_NAME} row without a 'channel' key: {row}",
+            ))
+            continue
+        if set(row) != ROW_KEYS:
+            out.append(Finding(
+                RULE, obs_mod.path, 1,
+                f"channel '{channel}': registry row keys {sorted(row)} != "
+                f"{sorted(ROW_KEYS)}",
+            ))
+        if channel in declared:
+            out.append(Finding(
+                RULE, obs_mod.path, 1,
+                f"channel '{channel}' declared twice",
+            ))
+        declared[channel] = row
+        metric, exempt = row.get("metric"), row.get("exempt")
+        if bool(metric) == bool(exempt):
+            out.append(Finding(
+                RULE, obs_mod.path, 1,
+                f"channel '{channel}' must name an exported metric XOR a "
+                "documented exemption reason",
+            ))
+
+    exported = _exporter_strings(repo, obs_mod)
+    if exported is not None:
+        for channel, row in sorted(declared.items()):
+            metric = row.get("metric")
+            # Substring containment: renderers may embed the family name in
+            # a longer exposition line ("# TYPE <name> counter").
+            if metric and not any(metric in s for s in exported):
+                out.append(Finding(
+                    RULE, obs_mod.path, 1,
+                    f"channel '{channel}': metric '{metric}' does not appear "
+                    "in any exposition renderer "
+                    f"({', '.join(EXPORTER_SUFFIXES)}) — declared but never "
+                    "exported",
+                ))
+
+    for path, line, channel in noted:
+        if channel not in declared:
+            out.append(Finding(
+                RULE, path, line,
+                f"note channel '{channel}' is not declared in "
+                f"{OBS_MODULE} {TABLE_NAME}: every per-cycle evidence "
+                "channel must be registered (metric or documented "
+                "exemption, and the generated doc table)",
+            ))
+    noted_channels = {c for _, _, c in noted}
+    if noted_channels:
+        for channel in sorted(set(declared) - noted_channels):
+            out.append(Finding(
+                RULE, obs_mod.path, 1,
+                f"channel '{channel}' is declared but nothing notes it "
+                "(dead registry row or typo)",
+            ))
+
+    # Generated doc table drift (the gen_layout_doc renderer contract).
+    doc = next(
+        (d for d in repo.docs if d.path == OBS_DOC), None
+    )
+    if doc is not None:
+        table = render_channel_table(rows)
+        begin, end = marker_lines(TABLE_NS)
+        lines = doc.text.splitlines()
+        try:
+            b = lines.index(begin)
+            e = lines.index(end, b)
+        except ValueError:
+            out.append(Finding(
+                RULE, doc.path, 1,
+                f"missing generated channel table for {TABLE_NS} (run "
+                "scripts/gen_layout_doc.py)",
+            ))
+        else:
+            got = [ln.strip() for ln in lines[b + 1: e] if ln.strip()]
+            if got != table:
+                out.append(Finding(
+                    RULE, doc.path, b + 1,
+                    f"{TABLE_NS} channel table is stale (run "
+                    "scripts/gen_layout_doc.py)",
+                ))
+    return out

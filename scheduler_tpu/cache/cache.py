@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Optional
 
@@ -38,6 +39,7 @@ from scheduler_tpu.apis.objects import (
 )
 from scheduler_tpu.cache.fakes import FakeBinder, FakeEvictor, FakeStatusUpdater, FakeVolumeBinder
 from scheduler_tpu.cache.interface import Binder, Cache, Evictor, StatusUpdater, VolumeBinder
+from scheduler_tpu.utils import obs
 
 logger = logging.getLogger("scheduler_tpu.cache")
 
@@ -113,6 +115,19 @@ class SchedulerCache(Cache):
         self._job_dirty_floor = 0
         self._queue_dirty_floor = 0
 
+        # Time-to-bind / pending-age clock (docs/OBSERVABILITY.md): when a
+        # pod is first seen UNBOUND, a monotonic stamp records the arrival
+        # (the queue label always comes from the live job at read time).
+        # Bind commits PEEK a window-tail sample per batch (the age becomes
+        # a time-to-bind sample in utils/obs.py; the seam stays O(window),
+        # never O(binds), and a failed bind RPC keeps the original clock);
+        # pod delete is the one cleanup point, and the scrape-time pending
+        # walk is status-filtered, so an entry that outlives its bind costs
+        # dict memory, never correctness.  Updates (delete+add with
+        # gc=False) deliberately keep the entry, so a watch echo never
+        # resets a pod's pending age.
+        self._pending_since: Dict[str, float] = {}
+
         self.binder = binder if binder is not None else FakeBinder()
         self.evictor = evictor if evictor is not None else FakeEvictor()
         self.status_updater = status_updater if status_updater is not None else FakeStatusUpdater()
@@ -141,6 +156,44 @@ class SchedulerCache(Cache):
 
     def client(self):
         return None
+
+    def obs_serving_snapshot(self) -> dict:
+        """Scrape-time serving state for the /metrics surface
+        (docs/OBSERVABILITY.md): per-queue pending depth and the ages of
+        currently-pending tasks.  One mutex hold per scrape — the walk is
+        O(jobs + pending), the same order as a scheduling cycle's own
+        snapshot, and runs on the HTTP thread, never in the cycle."""
+        now = time.monotonic()
+        depth: Dict[str, int] = {}
+        ages: Dict[str, list] = {}
+        pending_val = int(TaskStatus.PENDING)
+        with self.mutex:
+            for job in self.jobs.values():
+                store = job.store
+                if store.n == 0:
+                    continue
+                # Columnar, no view materialization: one status-column mask
+                # per job (tombstones carry status 0 and drop out).
+                mask = store.status[: store.n] == pending_val
+                count = int(mask.sum())
+                if not count:
+                    continue
+                depth[job.queue] = depth.get(job.queue, 0) + count
+                # Status-filtered: only ACTUALLY-pending tasks contribute an
+                # age — the arrival map may hold stale entries for tasks
+                # bound outside the sampling window (popped at delete).
+                # Sampled to obs.TTB_WINDOW per queue, like the bind seam:
+                # the mutex hold stays O(window), not O(pending), on a
+                # 100k-pending scrape.
+                bucket = ages.setdefault(job.queue, [])
+                room = obs.TTB_WINDOW - len(bucket)
+                if room <= 0:
+                    continue
+                for uid in store.uids[: store.n][mask][:room].tolist():
+                    since = self._pending_since.get(uid)
+                    if since is not None:
+                        bucket.append(max(0.0, now - since))
+        return {"queue_depth": depth, "pending_ages": ages}
 
     def _submit_io(self, fn, *args) -> None:
         if self._io_pool is not None:
@@ -250,6 +303,10 @@ class SchedulerCache(Cache):
         if pod.node_name:
             self._get_or_create_node(pod.node_name).add_task(task)
             self._mark_dirty("node", (pod.node_name,))
+            self._pending_since.pop(task.uid, None)
+        else:
+            # setdefault: an update echo must not reset the pending clock.
+            self._pending_since.setdefault(task.uid, time.monotonic())
 
     def update_pod(self, pod: PodSpec) -> None:
         with self.mutex:
@@ -271,6 +328,10 @@ class SchedulerCache(Cache):
             job_id = f"{pod.namespace}/{shadow_pod_group_name(pod)}"
         job = self.jobs.get(job_id)
         self._pod_cond_last.pop(pod.uid, None)
+        if gc:
+            # A real delete ends the pending clock; the update path
+            # (gc=False) keeps it so re-add preserves the arrival time.
+            self._pending_since.pop(pod.uid, None)
         if job is not None:
             self._mark_dirty("job", (job.uid,))
             row = job.store.row_of.get(pod.uid)
@@ -523,6 +584,39 @@ class SchedulerCache(Cache):
             raise KeyError(f"failed to find task {ti.uid} in job {ti.job}")
         return job, task
 
+    def _pending_age_peek(self, uid: str) -> Optional[float]:
+        """A task's pending age (seconds since first seen unbound) — a
+        time-to-bind sample at bind commit (utils/obs.py).  PEEK, not pop:
+        the entry must survive a failed bind RPC so the eventual successful
+        bind samples the FULL wait (a stale entry for a bound pod costs
+        dict memory until pod delete, never correctness — the scrape-time
+        pending walk is status-filtered).  None when the task was never
+        registered pending (pre-placed snapshots)."""
+        since = self._pending_since.get(uid)
+        if since is None:
+            return None
+        return max(0.0, time.monotonic() - since)
+
+    def _ttb_batch(self, queue: str, uids, count: Optional[int] = None) -> tuple:
+        """One ``(queue, count, ages)`` bind batch for obs.binds_committed:
+        ages are sampled from AT MOST the window tail of the batch (the
+        reservoir holds obs.TTB_WINDOW per queue, so earlier samples would
+        be dropped anyway) — the commit seam stays O(window), never
+        O(binds).  Entries are peeked, not popped (see _pending_age_peek);
+        pod delete is the one cleanup point.  ``count`` overrides the bind
+        count when ``uids`` is already the pre-sliced window tail (the
+        columnar path slices before materializing uid objects)."""
+        if count is None:
+            count = len(uids)
+        tail = uids[-obs.TTB_WINDOW:] if len(uids) > obs.TTB_WINDOW else uids
+        ages = []
+        now = time.monotonic()
+        for uid in tail:
+            since = self._pending_since.get(uid)
+            if since is not None:
+                ages.append(max(0.0, now - since))
+        return (queue, count, ages)
+
     def bind(self, ti: TaskInfo, hostname: str) -> None:
         """Update local state, then dispatch the bind asynchronously."""
         with self.mutex:
@@ -535,6 +629,10 @@ class SchedulerCache(Cache):
             node.add_task(task)
             self._mark_dirty("node", (hostname,))
             self._mark_dirty("job", (job.uid,))
+            age = self._pending_age_peek(task.uid)
+        obs.binds_committed(
+            [(job.queue, 1, [age] if age is not None else [])]
+        )
 
         self._submit_io(self._bind_one, task, hostname)
 
@@ -646,6 +744,15 @@ class SchedulerCache(Cache):
                     # used += row, releasing untouched.
                     agg = (row, None, row, count, 0)
                 self.nodes[hostname].bulk_add_tasks(node_tasks, agg=agg)
+            batches = [
+                self._ttb_batch(
+                    pairs[0][0].queue,
+                    [task.uid for _, task in pairs[-obs.TTB_WINDOW:]],
+                    count=len(pairs),
+                )
+                for pairs in by_job.values()
+            ] if obs.enabled() else []
+        obs.binds_committed(batches)
 
         def bind_chunk(chunk) -> None:
             from scheduler_tpu.cache.interface import BulkBindError
@@ -707,6 +814,11 @@ class SchedulerCache(Cache):
                 node.remove_task(task)
             task.node_name = ""
             job.update_task_status(task, TaskStatus.PENDING)
+            # Back to pending: the ORIGINAL arrival entry is still in
+            # _pending_since (bind commits peek, never pop), so the
+            # eventual successful bind samples the full wait; setdefault
+            # only covers a task that was never registered.
+            self._pending_since.setdefault(task.uid, time.monotonic())
             self._mark_dirty("node", (hostname,))
             self._mark_dirty("job", (job.uid,))
 
@@ -774,6 +886,17 @@ class SchedulerCache(Cache):
             ])
             for cjob, rows, names, _ids in resolved:
                 cjob.set_node_names_rows(rows, names)
+            if obs.enabled():
+                # O(window) per job, never O(rows): the columnar commit
+                # path must not regain a per-task Python loop.
+                obs.binds_committed([
+                    self._ttb_batch(
+                        cjob.queue,
+                        cjob.store.uids[rows[-obs.TTB_WINDOW:]].tolist(),
+                        count=len(rows),
+                    )
+                    for cjob, rows, _names, _ids in resolved
+                ])
             # Per-node batches via ONE stable integer argsort across the whole
             # batch; each group's name resolves from its first member.
             ids_all = (
@@ -948,6 +1071,7 @@ class SchedulerCache(Cache):
                         self._mark_dirty("node", (task.node_name,))
         if not found:
             return []
+        obs.evictions_committed(len(found))
         chunk = max(16, min(self._BIND_CHUNK, -(-len(found) // self._IO_WORKERS)))
         for start in range(0, len(found), chunk):
             self._submit_io(self._evict_rpc_batch(found[start:start + chunk], reason))
@@ -998,6 +1122,7 @@ class SchedulerCache(Cache):
                 if task.uid in node.tasks:
                     node.update_task(task)
                     self._mark_dirty("node", (task.node_name,))
+        obs.evictions_committed(1)
 
         def do_evict() -> None:
             try:

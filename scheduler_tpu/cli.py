@@ -48,9 +48,36 @@ class _MetricsHandler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 (http.server API)
         if self.path.startswith("/metrics"):
-            self._respond(metrics.render_prometheus().encode(), "text/plain; version=0.0.4")
+            # Reference-shaped collectors (utils/metrics.py) + the serving-era
+            # flight-recorder families (utils/obs.py: queue depth, time-to-
+            # bind quantiles, engine-cache hit rate, relist bytes — docs/
+            # OBSERVABILITY.md).
+            from scheduler_tpu.utils import obs
+
+            body = metrics.render_prometheus() + obs.render_prometheus(self.cache)
+            self._respond(body.encode(), "text/plain; version=0.0.4")
         elif self.path.startswith("/healthz"):
             self._respond(b"ok", "text/plain")
+        elif self.path.startswith("/debug/cycles"):
+            # The flight-recorder ring as JSON: the last SCHEDULER_TPU_OBS_RING
+            # cycles with phase splits, note channels and bind/event counts —
+            # what "kubectl describe my last 256 cycles" would be.
+            from scheduler_tpu.utils import obs
+
+            body = json.dumps({
+                "enabled": obs.enabled(),
+                "capacity": obs.ring_capacity(),
+                "cycles": obs.ring_snapshot(),
+            })
+            self._respond(body.encode(), "application/json")
+        elif self.path.startswith("/debug/trace"):
+            # Span-tracer status: configuration, files written, last export
+            # (utils/trace.py; load the cycle*.trace.json files in Perfetto).
+            from scheduler_tpu.utils import trace
+
+            self._respond(
+                json.dumps(trace.status()).encode(), "application/json"
+            )
         elif self.path.startswith("/debug/threads"):
             # pprof stand-in (main.go:24-25): dump every thread's stack.
             frames = sys._current_frames()

@@ -55,6 +55,7 @@ from scheduler_tpu.connector.wire import (
     pod_key,
     pod_uid,
 )
+from scheduler_tpu.utils import trace
 
 logger = logging.getLogger("scheduler_tpu.connector")
 
@@ -234,9 +235,10 @@ class HttpBinder(Binder):
         self.limiter = limiter
 
     def bind(self, pod, hostname: str) -> None:
-        _post(self.base, "/bind", {
-            "namespace": pod.namespace, "name": pod.name, "node": hostname,
-        }, limiter=self.limiter)
+        with trace.span("rpc:bind"):
+            _post(self.base, "/bind", {
+                "namespace": pod.namespace, "name": pod.name, "node": hostname,
+            }, limiter=self.limiter)
 
     def bind_bulk(self, pairs: list) -> None:
         payload = {"pairs": [
@@ -244,7 +246,8 @@ class HttpBinder(Binder):
             for pod, hostname in pairs
         ]}
         try:
-            _post(self.base, "/bind-bulk", payload, limiter=self.limiter)
+            with trace.span("rpc:bind_bulk", pairs=len(pairs)):
+                _post(self.base, "/bind-bulk", payload, limiter=self.limiter)
         except urllib.error.HTTPError as err:
             if err.code != 409:
                 raise  # transport/unknown failure: caller assumes nothing applied
@@ -266,9 +269,10 @@ class HttpEvictor(Evictor):
         self.limiter = limiter
 
     def evict(self, pod) -> None:
-        _post(self.base, "/evict",
-              {"namespace": pod.namespace, "name": pod.name},
-              limiter=self.limiter)
+        with trace.span("rpc:evict"):
+            _post(self.base, "/evict",
+                  {"namespace": pod.namespace, "name": pod.name},
+                  limiter=self.limiter)
 
 
 class HttpVolumeBinder(VolumeBinder):
@@ -376,17 +380,18 @@ class K8sBinder(Binder):
         self.limiter = limiter
 
     def bind(self, pod, hostname: str) -> None:
-        _post(
-            self.base,
-            f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding",
-            {
-                "apiVersion": "v1",
-                "kind": "Binding",
-                "metadata": {"name": pod.name, "namespace": pod.namespace},
-                "target": {"apiVersion": "v1", "kind": "Node", "name": hostname},
-            },
-            limiter=self.limiter,
-        )
+        with trace.span("rpc:bind"):
+            _post(
+                self.base,
+                f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding",
+                {
+                    "apiVersion": "v1",
+                    "kind": "Binding",
+                    "metadata": {"name": pod.name, "namespace": pod.namespace},
+                    "target": {"apiVersion": "v1", "kind": "Node", "name": hostname},
+                },
+                limiter=self.limiter,
+            )
 
     def bind_bulk(self, pairs: list) -> None:
         # The k8s API has no bulk bind; the reference fires one goroutine per
@@ -413,9 +418,10 @@ class K8sEvictor(Evictor):
         self.limiter = limiter
 
     def evict(self, pod) -> None:
-        _delete(self.base,
-                f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}",
-                limiter=self.limiter)
+        with trace.span("rpc:evict"):
+            _delete(self.base,
+                    f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}",
+                    limiter=self.limiter)
 
 
 class K8sVolumeBinder(VolumeBinder):

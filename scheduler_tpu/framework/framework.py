@@ -11,7 +11,7 @@ from scheduler_tpu.framework.arguments import Arguments
 from scheduler_tpu.framework.job_updater import JobUpdater
 from scheduler_tpu.framework.registry import get_plugin_builder
 from scheduler_tpu.framework.session import Session
-from scheduler_tpu.utils import metrics
+from scheduler_tpu.utils import metrics, trace
 
 logger = logging.getLogger("scheduler_tpu.framework")
 
@@ -28,7 +28,8 @@ def open_session(cache, tiers: List[Tier]) -> Session:
     """
     ssn = Session(cache, tiers)
 
-    snapshot = cache.snapshot()
+    with trace.span("snapshot"):
+        snapshot = cache.snapshot()
     ssn.jobs = snapshot.jobs
     for job in ssn.jobs.values():
         # EVERY job's snapshot-time status (reference openSession,
@@ -56,7 +57,8 @@ def open_session(cache, tiers: List[Tier]) -> Session:
 
     for plugin in ssn.plugins.values():
         start = time.monotonic()
-        plugin.on_session_open(ssn)
+        with trace.span(f"plugin:{plugin.name()}:OnSessionOpen"):
+            plugin.on_session_open(ssn)
         metrics.update_plugin_duration(plugin.name(), "OnSessionOpen", time.monotonic() - start)
 
     logger.debug(
@@ -69,7 +71,8 @@ def close_session(ssn: Session) -> None:
     """Plugin close hooks + job status push-back (framework.go:55-63)."""
     for plugin in ssn.plugins.values():
         start = time.monotonic()
-        plugin.on_session_close(ssn)
+        with trace.span(f"plugin:{plugin.name()}:OnSessionClose"):
+            plugin.on_session_close(ssn)
         metrics.update_plugin_duration(plugin.name(), "OnSessionClose", time.monotonic() - start)
 
     JobUpdater(ssn).update_all()

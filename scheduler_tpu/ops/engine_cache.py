@@ -125,6 +125,16 @@ _ENV_KEYS = (
     # never hide behind a warm cache across a flag flip (re-checked by
     # _delta_compatible for direct update() callers).
     "SCHEDULER_TPU_EVICT",
+    # Observability (utils/obs.py, utils/trace.py, docs/OBSERVABILITY.md).
+    # None of these change a traced program, but — the SHARDCHECK precedent
+    # — a resident engine must not straddle a diagnostics-regime flip
+    # mid-process: the OBS=0 bitwise-parity contract is pinned per regime,
+    # and a span-traced or device-profiled cycle should always start from a
+    # fresh, fully-observed build.
+    "SCHEDULER_TPU_OBS",
+    "SCHEDULER_TPU_OBS_RING",
+    "SCHEDULER_TPU_TRACE",
+    "SCHEDULER_TPU_PROFILE",
 )
 
 _scope_counter = itertools.count(1)

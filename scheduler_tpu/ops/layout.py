@@ -19,6 +19,13 @@ mechanically that
 * every stats row's name round-trips ``FusedAllocator.run_stats()`` →
   ``phases.note()`` → bench ``detail.cycles[]`` keys.
 
+The ``phases.note`` half of that last chain continues in
+``utils/obs.py``: every note CHANNEL is itself registered as literal data
+(``OBS_CHANNELS``, same idiom as this module) and gated end-to-end by the
+``obs-channel`` pass — kernel stats row → run_stats key → note channel →
+flight-recorder ring → /metrics family or documented exemption
+(docs/OBSERVABILITY.md).
+
 EVERYTHING in this module is a literal: the analysis pass (and the doc
 generator, ``scripts/gen_layout_doc.py``) re-reads this file as data via
 ``ast`` — no imports, no computed values in the declarations.  The

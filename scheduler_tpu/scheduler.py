@@ -196,6 +196,8 @@ class Scheduler:
             self._run_once_inner()
 
     def _run_once_inner(self) -> None:
+        from scheduler_tpu.utils import obs, trace
+
         freeze = self._gc_freeze_enabled()
         if freeze and not self._gc_every_cycle:
             # Event-triggered cycles can fire every few milliseconds; a full
@@ -205,10 +207,12 @@ class Scheduler:
                 time.perf_counter() - self._last_gc >= self._gc_min_interval
             )
         recording = self.record_cycles
-        if recording:
-            from scheduler_tpu.utils import phases
-
-            phases.begin()
+        # Always-on flight recorder (docs/OBSERVABILITY.md): EVERY cycle —
+        # production or bench — opens a record; the closed record lands in
+        # the bounded ring served at /debug/cycles.  SCHEDULER_TPU_OBS=0
+        # restores the passive pre-recorder loop bit for bit.
+        capture = recording or obs.enabled()
+        cycle_id = obs.begin() if capture else -1
         # BEFORE the GC block: measurement rigs poll (trigger drained AND
         # not in_cycle), and a collect over a large cached heap could span
         # their whole double-check window — the flag must cover it.
@@ -218,28 +222,47 @@ class Scheduler:
             gc.freeze()
             self._last_gc = time.perf_counter()
         try:
-            start = time.perf_counter()
-            ssn = open_session(self.cache, self.conf.tiers)
-            try:
-                for action in self.actions:
-                    action_start = time.perf_counter()
-                    action.execute(ssn)
-                    metrics.update_action_duration(
-                        action.name(), time.perf_counter() - action_start
-                    )
-            finally:
-                close_session(ssn)
-            elapsed = time.perf_counter() - start
-            metrics.update_e2e_duration(elapsed)
+            # Span tracing + sampled device profiles, both linked to this
+            # cycle id (SCHEDULER_TPU_TRACE / SCHEDULER_TPU_PROFILE —
+            # no-ops unless configured; utils/trace.py).
+            with trace.cycle(cycle_id), trace.maybe_profile(cycle_id):
+                start = time.perf_counter()
+                with trace.span("open_session"):
+                    ssn = open_session(self.cache, self.conf.tiers)
+                try:
+                    for action in self.actions:
+                        action_start = time.perf_counter()
+                        with trace.span(f"action:{action.name()}"):
+                            action.execute(ssn)
+                        metrics.update_action_duration(
+                            action.name(), time.perf_counter() - action_start
+                        )
+                finally:
+                    with trace.span("close_session"):
+                        close_session(ssn)
+                elapsed = time.perf_counter() - start
+                metrics.update_e2e_duration(elapsed)
         finally:
             self.in_cycle = False
             if freeze:
                 gc.unfreeze()
+            if capture:
+                notes = obs.take_notes()
+                extra = {
+                    "events": self._last_events,
+                    "gc": freeze,
+                }
+                # Ingest cost on the record: the reflectors' cumulative
+                # LIST/relist bytes as of this cycle (k8s wire only;
+                # docs/INGEST.md "Field-selector relists").
+                client = getattr(self.cache, "client", lambda: None)()
+                reflectors = getattr(client, "reflectors", None)
+                if reflectors:
+                    extra["relist_bytes"] = sum(
+                        r.relist_bytes for r in reflectors
+                    )
+                rec = obs.end(extra=extra)
             if recording:
-                from scheduler_tpu.utils import phases
-
-                notes = phases.take_notes()
-                rec = phases.end()
                 base = self._loop_started
                 self.cycle_log.append({
                     "s": time.perf_counter() - start,

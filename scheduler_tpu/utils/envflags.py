@@ -124,3 +124,15 @@ def env_str(name: str, default: str, choices: Optional[tuple] = None) -> str:
         _warn_once(name, raw, default)
         return default
     return v
+
+
+def env_path(name: str, default: str = "") -> str:
+    """Filesystem-path env flag (trace/profile output directories):
+    ``env_str`` lowercases its value for closed choice sets, which would
+    corrupt a case-sensitive path — this variant only strips whitespace.
+    There is nothing to validate at parse time (a bad path surfaces at the
+    first write, where the consumer degrades and logs), so no warn path."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip()

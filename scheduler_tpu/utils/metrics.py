@@ -16,6 +16,12 @@ NAMESPACE = "volcano"
 
 # Exponential buckets 5ms * 2^k, 10 buckets — metrics.go:41.
 _LATENCY_BUCKETS_MS = [5.0 * (2 ** k) for k in range(10)]
+# The microsecond-unit families (plugin/action latency) observe values in µs;
+# reusing the ms-magnitude bounds verbatim would park every realistic sample
+# (a 50ms action = 50000) in +Inf and make the cumulative le buckets this
+# module now exports meaningless for them — scale the same shape to µs
+# covering 5ms..2.56s.
+_LATENCY_BUCKETS_US = [b * 1000.0 for b in _LATENCY_BUCKETS_MS]
 
 _lock = threading.Lock()
 
@@ -68,10 +74,10 @@ e2e_latency = _Histogram(
     f"{NAMESPACE}_e2e_scheduling_latency_milliseconds", "E2E scheduling latency", _LATENCY_BUCKETS_MS
 )
 plugin_latency = _Histogram(
-    f"{NAMESPACE}_plugin_scheduling_latency_microseconds", "Plugin latency", _LATENCY_BUCKETS_MS
+    f"{NAMESPACE}_plugin_scheduling_latency_microseconds", "Plugin latency", _LATENCY_BUCKETS_US
 )
 action_latency = _Histogram(
-    f"{NAMESPACE}_action_scheduling_latency_microseconds", "Action latency", _LATENCY_BUCKETS_MS
+    f"{NAMESPACE}_action_scheduling_latency_microseconds", "Action latency", _LATENCY_BUCKETS_US
 )
 task_latency = _Histogram(
     f"{NAMESPACE}_task_scheduling_latency_milliseconds", "Task scheduling latency", _LATENCY_BUCKETS_MS
@@ -89,8 +95,14 @@ unschedule_task_count = _Gauge(
 unschedule_job_count = _Gauge(f"{NAMESPACE}_unschedule_job_count", "Unschedulable jobs")
 job_retry_counts = _Counter(f"{NAMESPACE}_job_retry_counts", "Job retries")
 
+# Label NAMES per metric family.  ``plugin_latency`` takes ("plugin",
+# "event") — the reference labels the callback kind ("OnSession"/
+# "OnSessionOpen"/...) as the VALUE of an ``event`` label
+# (metrics.go:46-52); the old pair ("plugin", "OnSession") had leaked a
+# label value into the name slot, producing exposition no strict parser
+# (or PromQL group-by) could use.
 _LABEL_NAMES = {
-    plugin_latency.name: ("plugin", "OnSession"),
+    plugin_latency.name: ("plugin", "event"),
     action_latency.name: ("action",),
     schedule_attempts.name: ("result",),
     unschedule_task_count.name: ("job_id",),
@@ -152,12 +164,34 @@ def register_job_retries(job_id: str) -> None:
     job_retry_counts.inc((job_id,))
 
 
-def _fmt_labels(metric_name: str, labels: Tuple) -> str:
-    if not labels:
-        return ""
+def escape_label_value(value: str) -> str:
+    """Escape a label value per the Prometheus text exposition format:
+    backslash, double-quote and newline must be escaped or the sample line
+    is unparseable (a plugin name containing ``"`` would corrupt every
+    scrape after it)."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _fmt_labels(metric_name: str, labels: Tuple, extra: Tuple = ()) -> str:
+    """Render ``{name="value",...}`` for a sample.  ``extra`` appends
+    pre-named pairs (the histogram ``le`` bucket label) after the metric's
+    declared label set."""
     names = _LABEL_NAMES.get(metric_name, tuple(f"label{i}" for i in range(len(labels))))
-    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, labels))
+    pairs = list(zip(names, labels)) + list(extra)
+    if not pairs:
+        return ""
+    inner = ",".join(
+        f'{n}="{escape_label_value(str(v))}"' for n, v in pairs
+    )
     return "{" + inner + "}"
+
+
+def _fmt_le(bound: float) -> str:
+    """``le`` bound rendering: integral bounds drop the trailing ``.0``
+    (the convention Prometheus clients use — ``le="5"``, not ``le="5.0"``)."""
+    return str(int(bound)) if float(bound).is_integer() else repr(float(bound))
 
 
 def render_prometheus() -> str:
@@ -169,6 +203,22 @@ def render_prometheus() -> str:
             out.append(f"# TYPE {h.name} histogram")
             for labels, total in h.totals.items():
                 lbl = _fmt_labels(h.name, labels)
+                # Cumulative ``le`` buckets: the stored per-bucket counts are
+                # NON-cumulative (observe() increments exactly one slot), so
+                # a running sum converts them; the mandatory ``+Inf`` bucket
+                # equals _count.  Without these lines histogram_quantile()
+                # was impossible against the daemon — _count/_sum alone
+                # cannot reconstruct a distribution.
+                row = h.counts[labels]
+                running = 0
+                for i, bound in enumerate(h.buckets):
+                    running += row[i]
+                    blbl = _fmt_labels(
+                        h.name, labels, (("le", _fmt_le(bound)),)
+                    )
+                    out.append(f"{h.name}_bucket{blbl} {running}")
+                inf_lbl = _fmt_labels(h.name, labels, (("le", "+Inf"),))
+                out.append(f"{h.name}_bucket{inf_lbl} {total}")
                 out.append(f"{h.name}_count{lbl} {total}")
                 out.append(f"{h.name}_sum{lbl} {h.sums[labels]}")
         for c in (schedule_attempts, preemption_attempts, job_retry_counts):

@@ -1,4 +1,5 @@
-"""Per-cycle phase accounting for measurement protocols.
+"""Per-cycle phase accounting for measurement protocols — the measurement
+FRONTEND of the always-on flight recorder (``utils/obs.py``).
 
 The round-4 bench artifact recorded 26k pods/s for a scheduler the judge
 re-measured at 138k: a degraded tunnel window inflated the device phase ~10x
@@ -6,45 +7,36 @@ and the artifact carried nothing that could tell "bad link" from
 "regression".  This recorder gives every measured cycle a host/device phase
 split so the artifact can defend itself (VERDICT r4 weak #1).
 
-Passive by default: ``phase()`` is a no-op context manager until a
-measurement protocol calls ``begin()``, so the production scheduler loop
-pays two ``None`` checks per action, nothing more.  Not thread-safe by
-design — measurement protocols are single-threaded by the one-core rule,
-and the lockset sanitizer (``SCHEDULER_TPU_TSAN=1``, ``utils/tsan.py``)
-turns that prose rule into a CHECKED one: every buffer mutation reports an
-access, so a second thread noting into a live cycle is a reported race
-instead of a silently corrupted artifact.
+Since round 14 the actual buffers live in ``utils/obs.py``: the scheduler
+loop records EVERY cycle into the bounded ring there (production included),
+and this module is the stable API measurement protocols and the engine's
+evidence channels call — ``begin``/``end`` return the same objects they
+always did, bit for bit.  A protocol that never calls ``begin()`` still
+records nothing unless the loop opened a cycle, and with
+``SCHEDULER_TPU_OBS=0`` the pre-recorder passive behavior is exactly
+restored.  Not thread-safe by design — cycles are single-threaded by the
+one-core rule, and the lockset sanitizer (``SCHEDULER_TPU_TSAN=1``,
+``utils/tsan.py``) turns that prose rule into a CHECKED one via the
+``phases.cycle_buffers`` field the recorder reports on every access.
 """
 
 from __future__ import annotations
 
-import time
-from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Dict
 
-from scheduler_tpu.utils import tsan
-
-_current: Optional[Dict[str, float]] = None
-_notes: Optional[Dict[str, object]] = None
-
-_TSAN_FIELD = "phases.cycle_buffers"
+from scheduler_tpu.utils import obs
 
 
 def begin() -> None:
     """Start collecting phases for one cycle."""
-    global _current, _notes
-    tsan.access(_TSAN_FIELD)
-    _current = {}
-    _notes = {}
+    obs.begin()
 
 
 def end() -> Dict[str, float]:
-    """Stop collecting; return {phase: seconds} accumulated since begin()."""
-    global _current, _notes
-    tsan.access(_TSAN_FIELD)
-    out, _current = _current, None
-    _notes = None
-    return out or {}
+    """Stop collecting; return {phase: seconds} accumulated since begin().
+    The closed record also lands in the flight-recorder ring
+    (``/debug/cycles``) unless ``SCHEDULER_TPU_OBS=0``."""
+    return obs.end()
 
 
 def take_notes() -> Dict[str, object]:
@@ -52,35 +44,25 @@ def take_notes() -> Dict[str, object]:
     hit/miss/rebuild outcome).  Read BEFORE ``end()`` — kept separate from the
     {phase: seconds} map so artifact consumers can keep rounding every phase
     value as a float."""
-    tsan.access(_TSAN_FIELD, write=False)
-    return dict(_notes) if _notes is not None else {}
+    return obs.take_notes()
 
 
 def active() -> bool:
-    return _current is not None
+    return obs.active()
 
 
 def add(name: str, secs: float) -> None:
-    if _current is not None:
-        tsan.access(_TSAN_FIELD)
-        _current[name] = _current.get(name, 0.0) + secs
+    obs.add(name, secs)
 
 
 def note(name: str, value) -> None:
     """Attach a non-time annotation to the cycle being measured (no-op when
-    no measurement protocol is active, like ``add``)."""
-    if _notes is not None:
-        tsan.access(_TSAN_FIELD)
-        _notes[name] = value
+    no cycle record is open, like ``add``).  Every literal channel name used
+    here must be declared in ``obs.OBS_CHANNELS`` — the ``obs-channel``
+    schedlint pass enforces it."""
+    obs.note(name, value)
 
 
-@contextmanager
-def phase(name: str):
-    if _current is None:
-        yield
-        return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        add(name, time.perf_counter() - t0)
+# Context manager timing one named block into the cycle record; also a trace
+# span when SCHEDULER_TPU_TRACE armed the cycle (utils/trace.py).
+phase = obs.phase

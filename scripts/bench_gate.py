@@ -50,6 +50,14 @@ a directory given as argv[1]):
   compression factor on every engaged cycle — a malformed evidence chain
   is exit 1, not a measurement.
 
+* **Flight-recorder evidence** (round 14, docs/OBSERVABILITY.md): a
+  ``detail.obs`` block claiming the recorder was on must price it
+  (on/off cycle seconds + a finite ``overhead_frac``) or the artifact is
+  malformed (exit 1); an overhead past the <1% contract is SURFACED as an
+  advisory line, never an exit — off-TPU A/B noise exceeds the band, and
+  the contract's authority is the hardware round.  Pre-round-14 artifacts
+  (no block) pass untouched.
+
 Families gate independently (a regression in either fails the build); a
 family with fewer than two artifacts is simply not judged yet.  Regression
 math uses HEALTHY cycles only — per-cycle ``link_degraded`` flags recorded
@@ -158,6 +166,50 @@ def sig_block_problem(detail: dict):
                 or comp <= 0):
             return (f"cycle {i} sig block records a non-finite "
                     f"compression factor {comp!r}")
+    return None
+
+
+def obs_block_problem(detail: dict):
+    """Sanity-check the flight-recorder evidence block (``detail.obs``,
+    docs/OBSERVABILITY.md "Overhead contract").  Absent block = a
+    pre-round-14 artifact, fine.  Present: ``enabled`` must be a bool and
+    an enabled block must price the always-on recorder — ``on_cycle_s`` /
+    ``off_cycle_s`` positive numbers and a finite ``overhead_frac`` — or
+    the artifact claims a contract it never measured.  Returns the reason
+    string, or None when the block is sane."""
+    import math
+
+    obs = detail.get("obs")
+    if obs is None:
+        return None
+    if not isinstance(obs, dict) or not isinstance(obs.get("enabled"), bool):
+        return "detail.obs is not a {enabled: bool, ...} block"
+    if not obs["enabled"]:
+        return None  # recorder-off runs have no tax to price
+    frac = obs.get("overhead_frac")
+    if not isinstance(frac, (int, float)) or not math.isfinite(frac):
+        return ("detail.obs.overhead_frac missing or non-finite on a "
+                "recorder-on artifact — the always-on overhead contract "
+                "was never measured")
+    for key in ("on_cycle_s", "off_cycle_s"):
+        v = obs.get(key)
+        if not isinstance(v, (int, float)) or v <= 0:
+            return f"detail.obs.{key} missing or non-positive"
+    return None
+
+
+def obs_overhead_note(detail: dict):
+    """Advisory (never an exit): the recorder tax an artifact recorded,
+    when it is past the <1% contract.  Container A/B noise routinely
+    exceeds the contract band, so the authority is the TPU round — the
+    gate SURFACES the number instead of judging on it."""
+    obs = detail.get("obs")
+    if isinstance(obs, dict) and isinstance(
+        obs.get("overhead_frac"), (int, float)
+    ) and obs["overhead_frac"] > 0.01:
+        return (f"recorder overhead_frac={obs['overhead_frac']:+.4f} is "
+                "past the <1% contract (advisory; noisy off-TPU — see "
+                "docs/OBSERVABILITY.md)")
     return None
 
 
@@ -497,6 +549,25 @@ def gate_family(root: Path, label: str, infix: str) -> int:
                     "anything; re-emit via bench.py --xl"
                 )
                 return 1
+    if artifacts:
+        # Flight-recorder evidence on the NEWEST artifact (older rounds
+        # predate the obs contract and carry no block).
+        try:
+            detail = _unwrap(
+                json.loads(artifacts[-1].read_text())
+            ).get("detail") or {}
+        except json.JSONDecodeError as err:
+            print(f"bench-gate[{label}]: malformed artifact "
+                  f"{artifacts[-1].name}: {err}")
+            return 1
+        obs_why = obs_block_problem(detail)
+        if obs_why is not None:
+            print(f"bench-gate[{label}]: malformed artifact "
+                  f"{artifacts[-1].name}: {obs_why}")
+            return 1
+        note = obs_overhead_note(detail)
+        if note is not None:
+            print(f"bench-gate[{label}]: {artifacts[-1].name}: {note}")
     if len(artifacts) < 2:
         print(f"bench-gate[{label}]: need two BENCH{infix}_r*.json under "
               f"{root}, found {len(artifacts)}; nothing to compare")

@@ -32,6 +32,9 @@ def main() -> int:
     ap.add_argument("--check", action="store_true")
     args = ap.parse_args()
 
+    from scheduler_tpu.analysis.obs_channels import (
+        OBS_DOC, TABLE_NS, channels_from_source, render_channel_table,
+    )
     from scheduler_tpu.analysis.row_layout import (
         marker_lines, parse_registry_source, render_table,
     )
@@ -56,6 +59,14 @@ def main() -> int:
             ("SHARDING", render_family_table(sreg)),
             ("SHARD_SITES", render_site_table(sreg)),
         ])
+    # Observability channel registry (utils/obs.py OBS_CHANNELS) — same
+    # renderer the obs-channel schedlint pass drift-checks with.
+    obs_src = ROOT / "scheduler_tpu" / "utils" / "obs.py"
+    channels = channels_from_source(obs_src.read_text())
+    if channels is not None:
+        plans.setdefault(OBS_DOC, []).append(
+            (TABLE_NS, render_channel_table(channels))
+        )
 
     for rel, tables in sorted(plans.items()):
         doc = ROOT / rel

@@ -43,6 +43,9 @@ chain is delta-maintained (docs/QUEUE_DELTA.md; flip
 
 Protocol matches the bench (harness/measure): a fresh cluster per measured
 cycle, engine tensors warmed without placing, GC frozen around the cycle.
+Since round 14 the phase split prints from the unified flight recorder
+(utils/obs.py, docs/OBSERVABILITY.md) — the same record bench.py and the
+production loop write — instead of private perf_counter bookkeeping.
 ``run_columnar`` reuses the codes from the explicit ``_execute`` (the
 program is pure), so the decode line is pure decode.  This host has one
 CPU core: run nothing else concurrently or every host phase inflates.
@@ -89,31 +92,35 @@ def run(n_nodes: int, n_pods: int, label: str, n_queues: int = 1) -> None:
 
     from scheduler_tpu.actions.allocate import collect_candidates, record_fused_failures
     from scheduler_tpu.ops.fused import FusedAllocator
+    from scheduler_tpu.utils import phases
 
+    # The phase split reads from the unified flight recorder (utils/obs.py,
+    # docs/OBSERVABILITY.md) — the SAME channel the bench and the production
+    # loop record through — instead of this script's former private
+    # perf_counter plumbing; the explicit marks below exist only because
+    # this protocol drives the engine internals by hand (run_columnar
+    # reuses the _execute codes, so its decode line is pure decode).
     gc.collect()
     gc.freeze()
+    phases.begin()
     try:
         t0 = time.perf_counter()
-        ssn = open_session(cluster.cache, conf.tiers)
-        t1 = time.perf_counter()
-
-        candidates = collect_candidates(ssn)
-        t2 = time.perf_counter()
-
-        engine = FusedAllocator(ssn, candidates)
-        t3 = time.perf_counter()
-
-        engine._execute()  # device program + blocking readback
-        t4 = time.perf_counter()
-        items, node_batches, failures = engine.run_columnar()  # reuses codes
-        t5 = time.perf_counter()
-
-        record_fused_failures(failures)
-        ssn.bulk_apply_columnar(items, node_batches, engine.commit_plan())
-        t6 = time.perf_counter()
-
-        close_session(ssn)
-        t7 = time.perf_counter()
+        with phases.phase("open_session"):
+            ssn = open_session(cluster.cache, conf.tiers)
+        with phases.phase("candidates"):
+            candidates = collect_candidates(ssn)
+        with phases.phase("engine_init"):
+            engine = FusedAllocator(ssn, candidates)
+        with phases.phase("device"):
+            engine._execute()  # device program + blocking readback
+        with phases.phase("decode"):
+            items, node_batches, failures = engine.run_columnar()
+        with phases.phase("apply"):
+            record_fused_failures(failures)
+            ssn.bulk_apply_columnar(items, node_batches, engine.commit_plan())
+        with phases.phase("close_session"):
+            close_session(ssn)
+        total = time.perf_counter() - t0
     finally:
         gc.unfreeze()
 
@@ -123,6 +130,7 @@ def run(n_nodes: int, n_pods: int, label: str, n_queues: int = 1) -> None:
           + ("" if engine.allocator == "greedy" or engine.use_lp
              else f" (lp fell back: {engine.lp_reason})"))
     stats = engine.run_stats()
+    rec = phases.end()
     qc = stats.get("queue_chain")
     if qc:
         print(f"  queue_chain         {qc}")
@@ -143,14 +151,11 @@ def run(n_nodes: int, n_pods: int, label: str, n_queues: int = 1) -> None:
                   f"bytes_saved={sig['bytes_saved']:,}")
         else:
             print(f"  sig                 off ({sig.get('reason', 'n/a')})")
-    print(f"  open_session        {t1 - t0:8.3f}s")
-    print(f"  candidates          {t2 - t1:8.3f}s")
-    print(f"  engine init         {t3 - t2:8.3f}s")
-    print(f"  device+readback     {t4 - t3:8.3f}s")
-    print(f"  decode              {t5 - t4:8.3f}s")
-    print(f"  apply               {t6 - t5:8.3f}s")
-    print(f"  close_session       {t7 - t6:8.3f}s")
-    print(f"  TOTAL               {t7 - t0:8.3f}s")
+    for key in ("open_session", "candidates", "engine_init", "device",
+                "decode", "apply", "close_session", "overlap_host"):
+        if key in rec:
+            print(f"  {key:<19} {rec[key]:8.3f}s")
+    print(f"  TOTAL               {total:8.3f}s")
 
 
 def run_churn(n_nodes: int, n_placed: int, batch: int = 250,

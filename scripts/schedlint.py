@@ -16,6 +16,10 @@ tree and exits non-zero on findings:
               collective budgets go through the ops/layout.py sharding
               registry (the compiled-HLO budget half is
               scripts/shard_budget.py; both run under ``make lint``)
+  obs-channel every phases.note evidence channel is declared in the
+              utils/obs.py OBS_CHANNELS registry with an exported metric
+              or a documented exemption, and the generated channel table
+              in docs/OBSERVABILITY.md is current
   hygiene     whitespace + unused imports (the former scripts/lint.py)
 
 Usage: python scripts/schedlint.py [--rules r1,r2] [--list-rules] [--json]
@@ -55,6 +59,8 @@ DOC_TARGETS = ("README.md", "docs/*.md")
 CHANGED_ANCHORS = (
     "scheduler_tpu/ops/engine_cache.py",
     "scheduler_tpu/ops/layout.py",
+    # obs-channel's registry: note-call findings elsewhere need the table.
+    "scheduler_tpu/utils/obs.py",
 )
 
 

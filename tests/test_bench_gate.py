@@ -506,3 +506,54 @@ def test_preempt_gate_with_no_artifacts_is_silent_pass(tmp_path):
     from scripts.bench_gate import gate_preempt
 
     assert gate_preempt(tmp_path) == 0
+
+
+# -- flight-recorder evidence (detail.obs, docs/OBSERVABILITY.md) -------------
+
+def _obs_artifact(value=100_000.0, obs=None):
+    doc = _artifact(value)
+    if obs is not None:
+        doc["detail"]["obs"] = obs
+    return doc
+
+
+def test_obs_block_absent_is_fine(tmp_path):
+    # Pre-round-14 artifacts carry no obs block; the gate judges them as
+    # before.
+    _write(tmp_path, "BENCH_r01.json", _obs_artifact())
+    _write(tmp_path, "BENCH_r02.json", _obs_artifact())
+    assert gate_family(tmp_path, "single-queue", "") == 0
+
+
+def test_obs_block_sane_passes_and_overhead_is_advisory(tmp_path, capsys):
+    _write(tmp_path, "BENCH_r01.json", _obs_artifact())
+    _write(tmp_path, "BENCH_r02.json", _obs_artifact(obs={
+        "enabled": True, "ring": 7, "on_cycle_s": 0.105,
+        "off_cycle_s": 0.100, "overhead_frac": 0.05,
+    }))
+    assert gate_family(tmp_path, "single-queue", "") == 0
+    out = capsys.readouterr().out
+    assert "advisory" in out and "overhead_frac" in out
+
+
+def test_obs_enabled_without_overhead_ab_is_malformed(tmp_path):
+    # A recorder-on artifact that never priced the always-on tax claims a
+    # contract it did not measure.
+    _write(tmp_path, "BENCH_r01.json", _obs_artifact())
+    _write(tmp_path, "BENCH_r02.json", _obs_artifact(obs={
+        "enabled": True, "ring": 7,
+    }))
+    assert gate_family(tmp_path, "single-queue", "") == 1
+
+
+def test_obs_disabled_block_needs_no_ab(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _obs_artifact())
+    _write(tmp_path, "BENCH_r02.json", _obs_artifact(obs={
+        "enabled": False, "ring": 0,
+    }))
+    assert gate_family(tmp_path, "single-queue", "") == 0
+
+
+def test_obs_block_wrong_shape_is_malformed(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _obs_artifact(obs=["not", "a", "dict"]))
+    assert gate_family(tmp_path, "single-queue", "") == 1

@@ -1,0 +1,248 @@
+"""/metrics scrape conformance: the daemon's FULL text exposition must parse
+under a strict Prometheus parser.
+
+Round 14 context: ``render_prometheus`` never emitted ``_bucket{le=...}``
+lines (``histogram_quantile()`` was impossible against the daemon), gave
+``plugin_latency`` a label pair whose second NAME was the reference's label
+VALUE (``OnSession``), and wrote label values unescaped.  The old loop test
+only asserted a non-empty body — this suite parses every line: HELP/TYPE
+pairing, histogram bucket monotonicity + ``+Inf`` == ``_count``, counter
+monotonicity across two scrapes, and label-value escaping round-trips.
+"""
+
+from __future__ import annotations
+
+import re
+import urllib.request
+
+import pytest
+
+from scheduler_tpu.utils import metrics, obs
+
+SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(-?[0-9.eE+\-]+|NaN|[+-]Inf)$"
+)
+
+
+def parse_labels(raw: str) -> dict:
+    """Strict label-block parser: ``{a="x",b="y"}`` with ``\\"``, ``\\\\``
+    and ``\\n`` escapes inside values."""
+    assert raw.startswith("{") and raw.endswith("}"), raw
+    body = raw[1:-1]
+    out = {}
+    i = 0
+    while i < len(body):
+        m = re.match(r'([a-zA-Z_][a-zA-Z0-9_]*)="', body[i:])
+        assert m, f"bad label block at {body[i:]!r}"
+        name = m.group(1)
+        i += m.end()
+        val = []
+        while True:
+            assert i < len(body), "unterminated label value"
+            c = body[i]
+            if c == "\\":
+                esc = body[i + 1]
+                assert esc in ('"', "\\", "n"), f"bad escape \\{esc}"
+                val.append({"n": "\n"}.get(esc, esc))
+                i += 2
+            elif c == '"':
+                i += 1
+                break
+            else:
+                val.append(c)
+                i += 1
+        out[name] = "".join(val)
+        if i < len(body):
+            assert body[i] == ",", f"expected ',' at {body[i:]!r}"
+            i += 1
+    return out
+
+
+def parse_exposition(text: str):
+    """Returns (samples, helps, types) where samples maps
+    (name, frozenset(labels.items())) -> float.  Asserts structural rules:
+    every sample's family carries HELP and TYPE, emitted before its samples
+    and exactly once."""
+    helps, types = {}, {}
+    samples = {}
+    seen_family_samples = set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            assert name not in helps, f"duplicate HELP for {name}"
+            assert name not in seen_family_samples, (
+                f"HELP for {name} after its samples"
+            )
+            helps[name] = line
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            name, mtype = parts[2], parts[3]
+            assert name not in types, f"duplicate TYPE for {name}"
+            assert mtype in ("counter", "gauge", "histogram", "summary")
+            types[name] = mtype
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line!r}"
+        m = SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, raw_labels, value = m.groups()
+        labels = parse_labels(raw_labels) if raw_labels else {}
+        family = re.sub(r"_(bucket|sum|count)$", "", name)
+        if family not in types:
+            family = name  # non-histogram family with a _count-ish suffix
+        assert family in types, f"sample {name} has no TYPE"
+        assert family in helps, f"sample {name} has no HELP"
+        seen_family_samples.add(family)
+        key = (name, frozenset(labels.items()))
+        assert key not in samples, f"duplicate sample {key}"
+        samples[key] = (float(value), labels)
+    return samples, helps, types
+
+
+def check_histograms(samples, types):
+    """Per histogram family and label set (le excluded): cumulative bucket
+    counts must be non-decreasing in ``le`` and the ``+Inf`` bucket must
+    equal ``_count``."""
+    hists = {name for name, t in types.items() if t == "histogram"}
+    for fam in hists:
+        series = {}
+        for (name, _), (value, labels) in samples.items():
+            if name != f"{fam}_bucket":
+                continue
+            rest = frozenset(
+                (k, v) for k, v in labels.items() if k != "le"
+            )
+            series.setdefault(rest, []).append((labels["le"], value))
+        for rest, rows in series.items():
+            def bound(le: str) -> float:
+                return float("inf") if le == "+Inf" else float(le)
+
+            rows.sort(key=lambda r: bound(r[0]))
+            assert rows[-1][0] == "+Inf", f"{fam}{dict(rest)}: no +Inf bucket"
+            counts = [v for _, v in rows]
+            assert counts == sorted(counts), (
+                f"{fam}{dict(rest)}: buckets not cumulative: {rows}"
+            )
+            count_key = (f"{fam}_count", rest)
+            assert count_key in samples, f"{fam}{dict(rest)}: no _count"
+            assert rows[-1][1] == samples[count_key][0], (
+                f"{fam}{dict(rest)}: +Inf != _count"
+            )
+            assert (f"{fam}_sum", rest) in samples
+
+
+def scrape(port: int) -> str:
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5
+    ).read().decode()
+
+
+@pytest.fixture()
+def daemon():
+    from scheduler_tpu import cli
+    from scheduler_tpu.cache import SchedulerCache
+    from tests.fixtures import make_vocab
+
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    server = cli.serve_metrics("127.0.0.1:0", cache)
+    try:
+        yield server.server_address[1]
+    finally:
+        server.shutdown()
+
+
+def _observe_everything():
+    metrics.update_e2e_duration(0.25)
+    metrics.update_plugin_duration("gang", "OnSessionOpen", 0.001)
+    metrics.update_action_duration("allocate", 0.1)
+    metrics.update_task_schedule_duration(0.002)
+    metrics.register_schedule_attempt("success")
+    metrics.update_preemption_victims_count(2)
+    metrics.register_preemption_attempts()
+    metrics.update_unschedule_task_count("default/j1", 3)
+    metrics.update_unschedule_job_count(1)
+    metrics.register_job_retries("default/j1")
+
+
+def test_full_daemon_exposition_is_strictly_parseable(daemon):
+    _observe_everything()
+    body = scrape(daemon)
+    samples, helps, types = parse_exposition(body)
+    check_histograms(samples, types)
+    # The serving-era families are on the surface too (docs/OBSERVABILITY.md).
+    assert any(n == "volcano_scheduler_cycles_total" for n, _ in samples)
+    assert types["volcano_e2e_scheduling_latency_milliseconds"] == "histogram"
+
+
+def test_healthz(daemon):
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{daemon}/healthz", timeout=5
+    ).read()
+    assert body == b"ok"
+
+
+def test_histogram_buckets_cumulative_and_match_observations():
+    h = metrics._Histogram("volcano_test_hist_ms", "t", [1.0, 2.0, 4.0])
+    h.observe(0.5)
+    h.observe(1.5)
+    h.observe(3.0)
+    h.observe(100.0)
+    out = []
+    row = h.counts[()]
+    running = 0
+    for i, b in enumerate(h.buckets):
+        running += row[i]
+        out.append(running)
+    assert out == [1, 2, 3]  # cumulative, not per-bucket
+    assert h.totals[()] == 4  # +Inf bucket value
+
+
+def test_counters_monotone_across_scrapes(daemon):
+    _observe_everything()
+    s1, _, t1 = parse_exposition(scrape(daemon))
+    _observe_everything()  # every counter moves between the scrapes
+    s2, _, t2 = parse_exposition(scrape(daemon))
+    counters = {n for n, t in t2.items() if t == "counter"}
+    checked = 0
+    for (name, lbls), (v2, _) in s2.items():
+        fam = re.sub(r"_(bucket|sum|count)$", "", name)
+        base = fam if fam in counters else name
+        if base not in counters:
+            continue
+        if (name, lbls) in s1:
+            assert v2 >= s1[(name, lbls)][0], f"counter {name} went backwards"
+            checked += 1
+    assert checked >= 3
+
+
+def test_plugin_latency_label_name_is_event():
+    metrics.update_plugin_duration("gang", "OnSessionOpen", 0.001)
+    body = metrics.render_prometheus()
+    line = next(
+        ln for ln in body.splitlines()
+        if ln.startswith("volcano_plugin_scheduling_latency_microseconds_count")
+    )
+    labels = parse_labels(line.split(" ")[0].split("_count", 1)[1])
+    assert set(labels) == {"plugin", "event"}
+    assert labels["event"].startswith("OnSession")
+
+
+def test_label_values_escaped_round_trip():
+    metrics.register_schedule_attempt('we"ird\\value\nx')
+    body = metrics.render_prometheus()
+    samples, _, _ = parse_exposition(body)
+    values = {
+        labels.get("result")
+        for (_name, _), (_v, labels) in samples.items()
+        if _name == metrics.schedule_attempts.name
+    }
+    assert 'we"ird\\value\nx' in values
+
+
+def test_obs_families_render_without_cache():
+    # The obs renderer must serve a cache-less embedder too.
+    body = obs.render_prometheus(None)
+    samples, helps, types = parse_exposition(body)
+    assert types["volcano_obs_ring_depth"] == "gauge"

@@ -1,0 +1,253 @@
+"""The always-on cycle flight recorder (utils/obs.py, docs/OBSERVABILITY.md):
+
+* the phases frontend keeps its exact pre-recorder semantics (passive until
+  begin(), end() returns the accumulated split, notes ride the side channel);
+* every scheduler cycle appends ONE bounded ring entry — production cycles
+  included — with phases, notes, trigger batch stats and bind counts;
+* ``SCHEDULER_TPU_OBS=0`` is bitwise pre-existing: the bind sequence over
+  the engine-cache mutation trajectory is identical on/off (the hard
+  acceptance contract);
+* the cache's bind seam feeds per-queue time-to-bind samples and the
+  serving aggregates the /metrics families render;
+* ``/debug/cycles`` serves the ring for a live daemon.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.scheduler import Scheduler
+from scheduler_tpu.utils import obs, phases
+from tests.fixtures import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    make_vocab,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_obs():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def small_cache(pods: int = 1) -> SchedulerCache:
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.add_queue(build_queue("default"))
+    cache.add_node(build_node("n0", {"cpu": 8000, "memory": 16 * 1024**3}))
+    cache.add_pod_group(build_pod_group("g", queue="default", min_member=1))
+    for i in range(pods):
+        cache.add_pod(build_pod(
+            name=f"g-{i}", req={"cpu": 100, "memory": 64 * 1024**2},
+            groupname="g"))
+    cache.run()
+    return cache
+
+
+# -- phases frontend semantics ------------------------------------------------
+
+def test_phases_passive_without_begin():
+    assert not phases.active()
+    phases.add("x", 1.0)
+    phases.note("engine_cache", "hit")
+    with phases.phase("y"):
+        pass
+    assert phases.take_notes() == {}
+    assert phases.end() == {}
+    assert obs.ring_snapshot() == []  # nothing recorded without a record
+
+
+def test_phases_roundtrip_and_ring_commit():
+    phases.begin()
+    assert phases.active()
+    phases.add("a", 0.25)
+    phases.add("a", 0.25)
+    with phases.phase("b"):
+        pass
+    phases.note("engine_cache", "hit")
+    notes = phases.take_notes()
+    rec = phases.end()
+    assert rec["a"] == 0.5 and "b" in rec
+    assert notes == {"engine_cache": "hit"}
+    assert not phases.active()
+    ring = obs.ring_snapshot()
+    assert len(ring) == 1
+    entry = ring[0]
+    assert entry["notes"]["engine_cache"] == "hit"
+    assert entry["phases"]["a"] == 0.5
+    assert entry["cycle"] == 1 and entry["s"] >= 0
+
+
+def test_obs_disabled_keeps_phases_but_not_ring(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_OBS", "0")
+    phases.begin()
+    phases.add("a", 1.0)
+    rec = phases.end()
+    assert rec == {"a": 1.0}  # the measurement protocol still works
+    assert obs.ring_snapshot() == []  # but nothing is retained
+
+
+def test_ring_is_bounded(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_OBS_RING", "8")
+    for _ in range(20):
+        phases.begin()
+        phases.end()
+    ring = obs.ring_snapshot()
+    assert len(ring) == 8
+    assert ring[-1]["cycle"] == 20  # newest kept, oldest dropped
+
+
+def test_ring_entries_are_json_safe():
+    import numpy as np
+
+    phases.begin()
+    phases.note("dirty", {"mode": "sparse",
+                          "rows_scattered": np.int64(12),
+                          "widths": np.asarray([1, 2])})
+    phases.end()
+    entry = obs.ring_snapshot()[0]
+    json.dumps(entry)  # must not raise
+    assert entry["notes"]["dirty"]["rows_scattered"] == 12
+
+
+# -- scheduler loop integration ----------------------------------------------
+
+def test_production_cycle_records_into_ring():
+    cache = small_cache()
+    sched = Scheduler(cache, schedule_period=0.01)  # record_cycles=False
+    sched.run_once()
+    ring = obs.ring_snapshot()
+    assert len(ring) == 1
+    entry = ring[0]
+    assert entry["notes"].get("engine_cache")  # evidence flowed
+    assert entry["binds"] == 1  # the bind commit was counted to this cycle
+    assert entry["gc"] in (True, False) and "events" in entry
+    assert dict(cache.binder.binds) == {"default/g-0": "n0"}
+
+
+def test_record_cycles_log_unchanged_alongside_ring():
+    cache = small_cache()
+    sched = Scheduler(cache, schedule_period=0.01, record_cycles=True)
+    sched.run_once()
+    assert len(sched.cycle_log) == 1
+    entry = sched.cycle_log[0]
+    assert set(entry) == {"s", "t", "events", "gc", "phases", "notes"}
+    assert entry["notes"].get("engine_cache")
+    assert len(obs.ring_snapshot()) == 1
+
+
+def test_obs_off_production_cycle_is_passive(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_OBS", "0")
+    cache = small_cache()
+    sched = Scheduler(cache, schedule_period=0.01)
+    sched.run_once()
+    assert obs.ring_snapshot() == []
+    assert dict(cache.binder.binds) == {"default/g-0": "n0"}
+
+
+# -- the hard contract: OBS=0 is bitwise pre-existing -------------------------
+
+@pytest.mark.slow
+def test_obs_off_bind_parity_on_engine_cache_trajectory():
+    """SCHEDULER_TPU_OBS=0 vs the always-on default over the engine-cache
+    mutation trajectory (tests/test_engine_cache_parity.py): binds and task
+    statuses must be bitwise identical per cycle — the recorder observes,
+    it never steers."""
+    from scheduler_tpu.ops import engine_cache
+    from tests.test_engine_cache_parity import MUTATIONS, run_trajectory
+
+    base_env = {
+        "SCHEDULER_TPU_DEVICE": "1",
+        "SCHEDULER_TPU_FUSED": "1",
+        "SCHEDULER_TPU_ENGINE_CACHE": "1",
+    }
+    engine_cache.clear()
+    on = run_trajectory(1, {**base_env, "SCHEDULER_TPU_OBS": "1"})
+    engine_cache.clear()
+    obs.reset()
+    off = run_trajectory(1, {**base_env, "SCHEDULER_TPU_OBS": "0"})
+    engine_cache.clear()
+
+    assert len(on) == len(off) == len(MUTATIONS)
+    for i, (got, want) in enumerate(zip(on, off)):
+        assert got[0] == want[0], f"cycle {i}: binds diverge under OBS flip"
+        assert got[1] == want[1], f"cycle {i}: statuses diverge under OBS flip"
+
+
+# -- commit-seam serving aggregates -------------------------------------------
+
+def test_bind_seam_feeds_time_to_bind_and_queue_counters():
+    cache = small_cache(pods=3)
+    sched = Scheduler(cache, schedule_period=0.01)
+    sched.run_once()
+    totals = obs.serving_totals()
+    assert totals["binds"] == 3
+    assert totals["binds_by_queue"] == {"default": 3}
+    ttb = totals["ttb"]["default"]
+    assert len(ttb) == 3 and all(age >= 0.0 for age in ttb)
+    assert totals["outcomes"]  # engine-cache outcome aggregated at commit
+
+
+def test_eviction_seam_counts():
+    from scheduler_tpu.api.types import TaskStatus
+
+    cache = small_cache()
+    Scheduler(cache, schedule_period=0.01).run_once()
+    running = [
+        t for job in cache.jobs.values() for t in job.tasks.values()
+        if t.status in (TaskStatus.BINDING, TaskStatus.RUNNING)
+    ]
+    assert running
+    cache.evict(running[0], "obs test")
+    assert obs.serving_totals()["evictions"] == 1
+
+
+def test_pending_snapshot_depth_and_ages():
+    cache = small_cache(pods=2)  # pending, never scheduled
+    snap = cache.obs_serving_snapshot()
+    assert snap["queue_depth"] == {"default": 2}
+    assert len(snap["pending_ages"]["default"]) == 2
+    assert all(a >= 0.0 for a in snap["pending_ages"]["default"])
+
+
+def test_metrics_surface_includes_serving_families():
+    cache = small_cache(pods=2)
+    Scheduler(cache, schedule_period=0.01).run_once()
+    body = obs.render_prometheus(cache)
+    assert 'volcano_binds_total{queue="default"} 2' in body
+    assert "volcano_scheduler_cycles_total 1" in body
+    assert 'volcano_time_to_bind_seconds{queue="default",quantile="0.5"}' in body
+    assert "volcano_engine_cache_outcomes_total" in body
+
+
+# -- the daemon surface -------------------------------------------------------
+
+def test_debug_cycles_serves_the_ring_for_a_live_daemon():
+    from scheduler_tpu import cli
+
+    cache = small_cache()
+    sched = Scheduler(cache, schedule_period=0.01)
+    sched.run_once()
+    sched.run_once()
+    server = cli.serve_metrics("127.0.0.1:0", cache)
+    try:
+        port = server.server_address[1]
+        doc = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/cycles", timeout=5))
+        assert doc["enabled"] is True
+        assert doc["capacity"] == obs.ring_capacity()
+        assert len(doc["cycles"]) == 2
+        for entry in doc["cycles"]:
+            assert {"cycle", "s", "phases", "notes", "events",
+                    "binds"} <= set(entry)
+    finally:
+        server.shutdown()

@@ -712,3 +712,219 @@ def test_env_fixtures_cover_the_trigger_and_dirty_delta_knobs():
         """,
     })
     assert out == []
+
+
+# -- obs-channel (the observability channel registry, round 14) ---------------
+
+OBS_STUB = """
+    OBS_CHANNELS = (
+        {
+            "channel": "engine_cache",
+            "source": "actions/allocate.py",
+            "metric": "volcano_engine_cache_outcomes_total",
+            "exempt": None,
+            "desc": "resident-engine outcome per cycle",
+        },
+        {
+            "channel": "cohort",
+            "source": "actions/allocate.py",
+            "metric": None,
+            "exempt": "device-step evidence, bench artifact only",
+            "desc": "cohort engagement",
+        },
+    )
+
+    def render_prometheus(cache=None):
+        return "# TYPE volcano_engine_cache_outcomes_total counter"
+"""
+
+NOTER_STUB = """
+    from scheduler_tpu.utils import phases
+
+    def record(stats, cohort):
+        phases.note("engine_cache", stats)
+        phases.note("cohort", cohort)
+"""
+
+
+def _obs_doc_table():
+    from scheduler_tpu.analysis.obs_channels import (
+        channels_from_source, render_channel_table,
+    )
+
+    rows = channels_from_source(textwrap.dedent(OBS_STUB))
+    begin = ("<!-- layout:OBS_CHANNELS:begin (generated by "
+             "scripts/gen_layout_doc.py; do not edit) -->")
+    return "\n".join(
+        ["# Observability", "", begin]
+        + render_channel_table(rows)
+        + ["<!-- layout:OBS_CHANNELS:end -->", ""]
+    )
+
+
+def test_obs_channel_trips_on_undeclared_note_channel():
+    """The acceptance fixture: a phases.note channel nobody declared in
+    OBS_CHANNELS is evidence that never reaches the doc table, the ring
+    schema or the metrics surface — a finding at the note call."""
+    out = findings("obs-channel", py={
+        "scheduler_tpu/utils/obs.py": OBS_STUB,
+        "scheduler_tpu/actions/allocate.py": NOTER_STUB + """
+    def rogue(x):
+        phases.note("undeclared_channel", x)
+""",
+    })
+    assert len(out) == 1
+    assert "undeclared_channel" in out[0].message
+    assert out[0].path.endswith("actions/allocate.py")
+
+
+def test_obs_channel_clean_on_declared_channels():
+    out = findings("obs-channel", py={
+        "scheduler_tpu/utils/obs.py": OBS_STUB,
+        "scheduler_tpu/actions/allocate.py": NOTER_STUB,
+    })
+    assert out == []
+
+
+def test_obs_channel_requires_metric_xor_exemption():
+    both_none = OBS_STUB.replace(
+        '"metric": "volcano_engine_cache_outcomes_total",',
+        '"metric": None,',
+    ).replace(
+        '"exempt": None,', '"exempt": None,', 1
+    )
+    out = findings("obs-channel", py={
+        "scheduler_tpu/utils/obs.py": both_none,
+        "scheduler_tpu/actions/allocate.py": NOTER_STUB,
+    })
+    assert any("metric XOR" in f.message for f in out)
+
+
+def test_obs_channel_metric_must_be_exported():
+    """A metric name that only exists inside the registry literal is
+    declared, not exported: the renderer strings are searched with the
+    OBS_CHANNELS assignment's own lines excluded."""
+    unexported = OBS_STUB.replace(
+        'return "# TYPE volcano_engine_cache_outcomes_total counter"',
+        'return ""',
+    )
+    out = findings("obs-channel", py={
+        "scheduler_tpu/utils/obs.py": unexported,
+        "scheduler_tpu/actions/allocate.py": NOTER_STUB,
+    })
+    assert len(out) == 1 and "never exported" in out[0].message
+
+
+def test_obs_channel_dead_registry_row():
+    out = findings("obs-channel", py={
+        "scheduler_tpu/utils/obs.py": OBS_STUB,
+        "scheduler_tpu/actions/allocate.py": """
+    from scheduler_tpu.utils import phases
+
+    def record(stats):
+        phases.note("engine_cache", stats)
+""",
+    })
+    assert len(out) == 1 and "'cohort'" in out[0].message
+    assert "dead registry row" in out[0].message
+
+
+def test_obs_channel_doc_table_drift():
+    """The acceptance fixture's second half: OBS doc-table drift fails the
+    gate; the table the shared renderer wrote passes it."""
+    out = findings(
+        "obs-channel",
+        py={
+            "scheduler_tpu/utils/obs.py": OBS_STUB,
+            "scheduler_tpu/actions/allocate.py": NOTER_STUB,
+        },
+        docs={"docs/OBSERVABILITY.md": _obs_doc_table()},
+    )
+    assert out == []
+    stale = _obs_doc_table().replace("resident-engine outcome", "stale text")
+    out = findings(
+        "obs-channel",
+        py={
+            "scheduler_tpu/utils/obs.py": OBS_STUB,
+            "scheduler_tpu/actions/allocate.py": NOTER_STUB,
+        },
+        docs={"docs/OBSERVABILITY.md": stale},
+    )
+    assert len(out) == 1 and "stale" in out[0].message
+    missing = "# Observability\n\nno markers here\n"
+    out = findings(
+        "obs-channel",
+        py={
+            "scheduler_tpu/utils/obs.py": OBS_STUB,
+            "scheduler_tpu/actions/allocate.py": NOTER_STUB,
+        },
+        docs={"docs/OBSERVABILITY.md": missing},
+    )
+    assert len(out) == 1 and "missing generated channel table" in out[0].message
+
+
+def test_obs_channel_reports_unresolvable_registry():
+    out = findings("obs-channel", py={
+        "scheduler_tpu/utils/obs.py": """
+    def make():
+        return ()
+
+    OBS_CHANNELS = make()
+""",
+        "scheduler_tpu/actions/allocate.py": NOTER_STUB,
+    })
+    assert len(out) == 1 and "literal data" in out[0].message
+
+
+def test_env_fixtures_cover_the_obs_flags():
+    """SCHEDULER_TPU_OBS / OBS_RING / TRACE / PROFILE (docs/OBSERVABILITY.md)
+    ride the standard env machinery: raw os.environ reads trip raw-env
+    (env_path is a recognized envflags reader — paths must not lowercase
+    through env_str), an unregistered ops/ read trips env-drift, and the
+    real registration keeps both passes clean."""
+    out = findings("raw-env", py={
+        "scheduler_tpu/utils/obs.py": """
+            import os
+            def enabled():
+                return os.environ.get("SCHEDULER_TPU_OBS", "1") != "0"
+            def ring_capacity():
+                return int(os.environ.get("SCHEDULER_TPU_OBS_RING", "256"))
+        """,
+    })
+    assert len(out) == 2
+    assert {"SCHEDULER_TPU_OBS" in f.message or "SCHEDULER_TPU_OBS_RING"
+            in f.message for f in out} == {True}
+    out = findings("raw-env", py={
+        "scheduler_tpu/utils/trace.py": """
+            from scheduler_tpu.utils.envflags import env_int, env_path
+            def trace_dir():
+                return env_path("SCHEDULER_TPU_TRACE", "")
+            def profile_dir():
+                return env_path("SCHEDULER_TPU_PROFILE", "")
+            def keep_files():
+                return env_int("SCHEDULER_TPU_TRACE_KEEP", 64, minimum=1)
+        """,
+    })
+    assert out == []
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+        "scheduler_tpu/ops/fused.py": """
+            from scheduler_tpu.utils.envflags import env_bool
+            def obs_enabled():
+                return env_bool("SCHEDULER_TPU_OBS", True)
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_OBS" in out[0].message
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": """
+            _ENV_KEYS = (
+                "SCHEDULER_TPU_OBS",
+            )
+        """,
+        "scheduler_tpu/ops/fused.py": """
+            from scheduler_tpu.utils.envflags import env_bool
+            def obs_enabled():
+                return env_bool("SCHEDULER_TPU_OBS", True)
+        """,
+    })
+    assert out == []

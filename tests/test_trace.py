@@ -1,0 +1,218 @@
+"""Span tracer (utils/trace.py, docs/OBSERVABILITY.md): Chrome trace-event
+export per cycle (the acceptance contract: the JSON validates as the Chrome
+trace-event format Perfetto loads), bounded trace directories, disarmed
+no-op spans, sampled jax.profiler linkage by cycle id, and the
+/debug/trace status surface."""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.scheduler import Scheduler
+from scheduler_tpu.utils import obs, trace
+from tests.fixtures import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    make_vocab,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    obs.reset()
+    trace.reset()
+    yield
+    obs.reset()
+    trace.reset()
+
+
+def small_cache(pods: int = 1) -> SchedulerCache:
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.add_queue(build_queue("default"))
+    cache.add_node(build_node("n0", {"cpu": 8000, "memory": 16 * 1024**3}))
+    cache.add_pod_group(build_pod_group("g", queue="default", min_member=1))
+    for i in range(pods):
+        cache.add_pod(build_pod(
+            name=f"g-{i}", req={"cpu": 100, "memory": 64 * 1024**2},
+            groupname="g"))
+    cache.run()
+    return cache
+
+
+def validate_chrome_trace(path) -> dict:
+    """The acceptance check: a dict with a traceEvents list whose duration
+    events carry name/cat/ph/ts/dur/pid/tid with the right types — the
+    schema chrome://tracing and Perfetto's JSON importer require."""
+    doc = json.load(open(path))
+    assert isinstance(doc, dict)
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    for ev in events:
+        assert isinstance(ev["name"], str)
+        assert ev["ph"] in ("X", "M")
+        assert isinstance(ev["pid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float))
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+            assert isinstance(ev["tid"], int)
+    return doc
+
+
+def test_cycle_trace_exports_valid_chrome_trace(tmp_path, monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_TRACE", str(tmp_path))
+    cache = small_cache()
+    Scheduler(cache, schedule_period=0.01).run_once()
+    files = sorted(tmp_path.glob("cycle*.trace.json"))
+    assert len(files) == 1
+    doc = validate_chrome_trace(files[0])
+    names = {ev["name"] for ev in doc["traceEvents"]}
+    # The span tree covers the cycle skeleton: session open/close, the
+    # snapshot, per-plugin callbacks, per-action spans, and the engine
+    # phase seam (dispatch/device ride phases.phase for free).
+    assert {"cycle", "snapshot", "open_session", "close_session",
+            "action:allocate", "dispatch", "device"} <= names
+    assert any(n.startswith("plugin:") and n.endswith("OnSessionOpen")
+               for n in names)
+    # The cycle span wraps the rest (ts ordering on the perf_counter clock).
+    cyc = next(e for e in doc["traceEvents"] if e["name"] == "cycle")
+    inner = [e for e in doc["traceEvents"]
+             if e["ph"] == "X" and e["name"] != "cycle"]
+    assert all(e["ts"] >= cyc["ts"] for e in inner)
+    # File id links to the flight-recorder ring entry.
+    assert doc["otherData"]["cycle"] == obs.ring_snapshot()[0]["cycle"]
+
+
+def test_trace_disabled_writes_nothing(tmp_path):
+    cache = small_cache()
+    Scheduler(cache, schedule_period=0.01).run_once()
+    assert list(tmp_path.iterdir()) == []
+    assert not trace.armed()
+    assert trace.status()["files_written"] == 0
+
+
+def test_span_is_noop_while_disarmed():
+    with trace.span("nothing"):
+        pass
+    assert trace.status()["buffered_events"] == 0
+
+
+@pytest.mark.slow
+def test_trace_dir_is_bounded(tmp_path, monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_TRACE", str(tmp_path))
+    monkeypatch.setenv("SCHEDULER_TPU_TRACE_KEEP", "2")
+    cache = small_cache()
+    sched = Scheduler(cache, schedule_period=0.01)
+    for _ in range(3):
+        sched.run_once()
+    files = sorted(tmp_path.glob("cycle*.trace.json"))
+    assert len(files) == 2  # only the newest KEEP files survive
+    assert [f.name for f in files] == ["cycle00000002.trace.json",
+                                       "cycle00000003.trace.json"]
+    assert trace.status()["files_written"] == 3
+
+
+@pytest.mark.slow
+def test_unwritable_trace_dir_degrades_without_breaking_the_cycle(
+    tmp_path, monkeypatch
+):
+    target = tmp_path / "blocked"
+    target.write_text("a file, not a directory")
+    monkeypatch.setenv("SCHEDULER_TPU_TRACE", str(target))
+    cache = small_cache()
+    Scheduler(cache, schedule_period=0.01).run_once()  # must not raise
+    assert dict(cache.binder.binds) == {"default/g-0": "n0"}
+    assert trace.status()["enabled"] is False  # export latched off
+
+
+def test_sampled_profile_links_by_cycle_id(tmp_path, monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_PROFILE", str(tmp_path))
+    monkeypatch.setenv("SCHEDULER_TPU_PROFILE_EVERY", "2")
+    cache = small_cache()
+    sched = Scheduler(cache, schedule_period=0.01)
+    for _ in range(2):
+        sched.run_once()
+    # Cycles 1..2; EVERY=2 samples the even cycle only.
+    dirs = sorted(p.name for p in tmp_path.iterdir() if p.is_dir())
+    assert dirs == ["cycle00000002"]
+    assert trace.status()["profile"]["taken"] == 1
+
+
+def test_debug_trace_endpoint(tmp_path, monkeypatch):
+    from scheduler_tpu import cli
+
+    monkeypatch.setenv("SCHEDULER_TPU_TRACE", str(tmp_path))
+    cache = small_cache()
+    Scheduler(cache, schedule_period=0.01).run_once()
+    server = cli.serve_metrics("127.0.0.1:0", cache)
+    try:
+        port = server.server_address[1]
+        doc = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/trace", timeout=5))
+        assert doc["enabled"] is True
+        assert doc["dir"] == str(tmp_path)
+        assert doc["files_written"] == 1
+        assert doc["last_export"]["events"] > 0
+        assert os.path.exists(doc["last_export"]["path"])
+    finally:
+        server.shutdown()
+
+
+@pytest.mark.slow
+def test_rpc_spans_ride_io_threads(tmp_path, monkeypatch):
+    """Bind RPCs against a mock apiserver emit rpc:* spans (from the cache
+    IO seam) while the cycle trace is armed — the span tree reaches the
+    connector layer, not just the session."""
+    import threading
+
+    from scheduler_tpu.connector import connect_cache
+    from scheduler_tpu.connector.mock_server import serve
+
+    monkeypatch.setenv("SCHEDULER_TPU_TRACE", str(tmp_path))
+    server, _state = serve(0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    conn = None
+    try:
+        def post(path, payload):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            urllib.request.urlopen(req, timeout=5).read()
+
+        post("/objects", {"kind": "queue",
+                          "object": {"name": "default", "weight": 1}})
+        post("/objects", {"kind": "node", "object": {
+            "name": "n0",
+            "allocatable": {"cpu": 4000, "memory": 2**30, "pods": 110}}})
+        post("/objects", {"kind": "podgroup", "object": {
+            "name": "g", "queue": "default", "minMember": 1,
+            "phase": "Inqueue"}})
+        post("/objects", {"kind": "pod", "object": {
+            "name": "p0", "group": "g",
+            "containers": [{"cpu": 100, "memory": 2**20}]}})
+
+        cache, conn = connect_cache(base, async_io=False, wire="journal")
+        cache.run()
+        conn.start()
+        assert conn.wait_for_cache_sync(10)
+        Scheduler(cache, schedule_period=0.01).run_once()
+    finally:
+        if conn is not None:
+            conn.stop()
+            cache.stop()
+        server.shutdown()
+    files = sorted(tmp_path.glob("cycle*.trace.json"))
+    assert files
+    names = set()
+    for f in files:
+        names |= {e["name"] for e in json.load(open(f))["traceEvents"]}
+    assert any(n.startswith("rpc:bind") for n in names)

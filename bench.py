@@ -214,6 +214,67 @@ def preempt_main(smoke: bool) -> None:
     print(json.dumps(doc))
 
 
+def tenant_main(smoke: bool) -> None:
+    """``--tenant``: the multi-tenant stacked device phase scenario
+    (docs/TENANT.md, harness/tenant.py).
+
+    K same-shape simulated cluster sessions run their allocate device
+    phases per cycle, sequentially and then stacked into ONE device step
+    (``ops/tenant.dispatch_stacked``); the artifact
+    (``BENCH_TENANT_r*.json``) carries aggregate pods/s both ways, the
+    per-tenant p99 completion distribution, the ``p99_isolation`` ratio
+    bounded by the artifact's own stamped ``isolation_bound``, and the
+    per-cycle ``detail.cycles[].tenant`` stacking evidence — gated by
+    ``scripts/bench_gate.py`` on aggregate pods/s regression (same
+    K/shape) and on the isolation bound.  Shape is env-scalable
+    (``SCHEDULER_TPU_TENANT_*``); ``SCHEDULER_TPU_TENANT_SCALE_K`` adds a
+    reduced-cycle probe at a second K (default 64, 0 disables) recorded
+    under ``detail.scale``."""
+    from scheduler_tpu.harness.tenant import TenantConfig, run_tenant_bench
+    from scheduler_tpu.utils.envflags import env_float, env_int
+
+    cfg = TenantConfig(
+        k=env_int("SCHEDULER_TPU_TENANT_K", 4 if smoke else 8, minimum=2),
+        nodes=env_int("SCHEDULER_TPU_TENANT_NODES", 16, minimum=1),
+        pods=env_int("SCHEDULER_TPU_TENANT_PODS", 24 if smoke else 48,
+                     minimum=1),
+        tasks_per_job=env_int("SCHEDULER_TPU_TENANT_GANG", 6, minimum=1),
+        cycles=env_int("SCHEDULER_TPU_TENANT_CYCLES", 5 if smoke else 30,
+                       minimum=1),
+        warm_cycles=1 if smoke else 2,
+        isolation_bound=env_float("SCHEDULER_TPU_TENANT_ISOLATION_BOUND",
+                                  3.0, minimum=1.0),
+    )
+    doc = run_tenant_bench(cfg)
+    doc["detail"]["backend"] = _backend()
+    if not doc["detail"]["stacked_lanes"]:
+        doc["error"] = (
+            "no cycle stacked any lanes — every tenant dispatched solo, so "
+            "the artifact measured the sequential loop twice; see "
+            "detail.cycles[].tenant for the recorded payload-key groups"
+        )
+        print(json.dumps(doc))
+        sys.exit(1)
+    scale_k = env_int("SCHEDULER_TPU_TENANT_SCALE_K", 0 if smoke else 64,
+                      minimum=0)
+    if scale_k and scale_k != cfg.k:
+        probe = run_tenant_bench(TenantConfig(
+            k=scale_k, nodes=cfg.nodes, pods=cfg.pods,
+            tasks_per_job=cfg.tasks_per_job,
+            cycles=max(3, cfg.cycles // 5), warm_cycles=1,
+            isolation_bound=cfg.isolation_bound,
+        ))
+        doc["detail"]["scale"] = {
+            "k": scale_k,
+            "agg_pods_per_sec": probe["detail"]["agg_pods_per_sec"],
+            "seq_pods_per_sec": probe["detail"]["seq_pods_per_sec"],
+            "speedup": probe["detail"]["speedup"],
+            "p99_ms": probe["detail"]["p99_ms"],
+            "p99_isolation": probe["detail"]["p99_isolation"],
+        }
+    print(json.dumps(doc))
+
+
 def main() -> None:
     from scheduler_tpu.utils.envflags import env_int
     from scheduler_tpu.utils import sanitize
@@ -224,6 +285,9 @@ def main() -> None:
         return
     if "--preempt" in sys.argv:
         preempt_main(smoke)
+        return
+    if "--tenant" in sys.argv:
+        tenant_main(smoke)
         return
     xl = "--xl" in sys.argv
     default_nodes = 100 if smoke else (100_000 if xl else 10_000)

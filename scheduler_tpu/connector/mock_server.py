@@ -296,6 +296,30 @@ def make_handler(state: MockState):
             bookmarks = q.get(
                 "allowWatchBookmarks", ["false"]
             )[0].lower() in ("true", "1")
+            # Sharded watch streams (the reflector's spec.nodeName
+            # partitions): filter events by POST-state match, the real
+            # apiserver's rule — a pod binding lands as an event on the
+            # stream it NEWLY matches, so the assigned shard ingests the
+            # bind and the shared cache upserts it out of the unassigned
+            # partition.
+            try:
+                selector = _parse_field_selector(
+                    q.get("fieldSelector", [None])[0]
+                )
+            except ValueError as err:
+                self._json({"error": str(err)}, 400)
+                return
+            if selector is not None and kind != "pod":
+                self._json(
+                    {"error": f"fieldSelector unsupported for {kind}"}, 400
+                )
+                return
+
+            def _shard_match(e: Dict) -> bool:
+                if selector is None:
+                    return True
+                op, value = selector
+                return (_pod_node_name(e["object"]) == value) == (op == "=")
             with state.lock:
                 expired = since < state.compacted_through
             if expired:
@@ -332,7 +356,7 @@ def make_handler(state: MockState):
                             )
                             batch = [
                                 e for e in state.events[idx:]
-                                if e["kind"] == kind
+                                if e["kind"] == kind and _shard_match(e)
                             ]
                             if batch:
                                 break

@@ -59,6 +59,26 @@ from scheduler_tpu.connector.wire import (
 
 logger = logging.getLogger("scheduler_tpu.connector.reflector")
 
+# The two spec.nodeName watch partitions (docs/TENANT.md "Sharded watch
+# ingestion"): the SAME field selectors the round-10 split relist uses,
+# URL-encoded ("!=" assigned / "=" unassigned).  Together they cover every
+# pod exactly once — the selector is a partition of the pod inventory.
+POD_WATCH_SHARDS = (
+    ("assigned", "spec.nodeName%21%3D"),
+    ("unassigned", "spec.nodeName%3D"),
+)
+
+
+def watch_shards() -> int:
+    """Pod watch-stream shard count (SCHEDULER_TPU_WATCH_SHARDS, registered
+    in engine_cache._ENV_KEYS): >= 2 splits the pod watch into the
+    spec.nodeName partitions, one reflector thread + resourceVersion cursor
+    each.  The selector vocabulary has exactly two partitions, so any value
+    past 2 still yields two shards."""
+    from scheduler_tpu.utils.envflags import env_int
+
+    return env_int("SCHEDULER_TPU_WATCH_SHARDS", 1, minimum=1)
+
 
 class WatchExpired(Exception):
     """The server compacted its watch history past our cursor (``410 Gone``,
@@ -72,7 +92,8 @@ class Reflector:
     that demotes the stream to a relist."""
 
     def __init__(self, conn: "K8sApiConnector", kind: str, path: str,
-                 watch_timeout: float = 5.0) -> None:
+                 watch_timeout: float = 5.0,
+                 shard: Optional[str] = None) -> None:
         self.conn = conn
         self.kind = kind
         self.path = path
@@ -90,11 +111,19 @@ class Reflector:
         # request-by-request breakdown.
         self.relist_bytes = 0
         self.last_relist: dict = {}
+        # Sharded pod watch (SCHEDULER_TPU_WATCH_SHARDS, docs/TENANT.md):
+        # this reflector owns ONE spec.nodeName partition — its LISTs and
+        # its watch stream carry the partition selector, its cursor is the
+        # partition's own resourceVersion, and a 410 on this shard relists
+        # and prunes ONLY this partition while the sibling keeps streaming.
+        self.shard = shard
+        self.selector = dict(POD_WATCH_SHARDS).get(shard) if shard else None
         # Pod relists partition by spec.nodeName field selector so a 410
         # recovery stops paying one full-cluster payload; a server that
         # 400s the selector (pre-selector conformance targets) demotes this
-        # reflector to classic full relists permanently.
-        self.split_relists = kind == "pod"
+        # reflector to classic full relists permanently.  A shard reflector
+        # is already partition-scoped — its plain LIST carries the selector.
+        self.split_relists = kind == "pod" and shard is None
 
     # -- LIST ----------------------------------------------------------------
 
@@ -115,7 +144,12 @@ class Reflector:
             # The full-inventory burst pays the shared QPS budget; the
             # watch stream below does not (client.connect_cache docstring).
             self.conn.limiter.acquire()
-        doc, nbytes = _get_sized(self.conn.base, self.path)
+        path = self.path
+        if self.selector is not None:
+            # Shard reflectors LIST their own partition only — seed AND
+            # replace — so the cursor below is the partition's own RV.
+            path = f"{path}?fieldSelector={self.selector}"
+        doc, nbytes = _get_sized(self.conn.base, path)
         items = doc.get("items", []) or []
         rv = obj_rv(doc)
         op = "update" if replace else "add"
@@ -128,10 +162,11 @@ class Reflector:
         for item in items:
             self.conn._apply(self.kind, op, item)
         if replace:
-            self.conn._prune_kind(self.kind, items)
+            self.conn._prune_kind(self.kind, items, pod_scope=self.shard)
             self.relists += 1
             self.last_relist = {
                 "split": False, "bytes": [nbytes], "items": [len(items)],
+                **({"shard": self.shard} if self.shard else {}),
             }
         if rv is not None:
             self.rv = rv
@@ -192,11 +227,17 @@ class Reflector:
     # -- WATCH ---------------------------------------------------------------
 
     def _watch_url(self) -> str:
-        return (
+        url = (
             f"{self.conn.base}{self.path}?watch=1&resourceVersion={self.rv}"
             f"&timeoutSeconds={max(1, int(self.watch_timeout))}"
             f"&allowWatchBookmarks=true"
         )
+        if self.selector is not None:
+            # The sharded stream: the server filters events to this
+            # spec.nodeName partition (post-state match — a pod binding
+            # lands as an event on the shard it newly matches).
+            url += f"&fieldSelector={self.selector}"
+        return url
 
     def watch_once(self) -> None:
         """One watch stream: connect at the cursor, apply chunked events
@@ -295,11 +336,26 @@ class K8sApiConnector(ConnectorBase):
                  limiter: Optional[TokenBucket] = None,
                  watch_timeout: float = 5.0) -> None:
         super().__init__(cache, base, limiter)
-        self.reflectors: List[Reflector] = [
-            Reflector(self, kind, path, watch_timeout=watch_timeout)
-            for kind, path, _ in LIST_RESOURCES
-        ]
-        self._by_kind = {r.kind: r for r in self.reflectors}
+        self.reflectors: List[Reflector] = []
+        for kind, path, _ in LIST_RESOURCES:
+            if kind == "pod" and watch_shards() >= 2:
+                # Sharded pod ingestion (docs/TENANT.md): one reflector
+                # thread + cursor per spec.nodeName partition, all feeding
+                # the same _apply seam.
+                self.reflectors.extend(
+                    Reflector(self, kind, path, watch_timeout=watch_timeout,
+                              shard=shard)
+                    for shard, _sel in POD_WATCH_SHARDS
+                )
+            else:
+                self.reflectors.append(
+                    Reflector(self, kind, path, watch_timeout=watch_timeout)
+                )
+        # kind -> primary reflector (the single instance when unsharded;
+        # the first shard otherwise — divergence routing fans out below).
+        self._by_kind = {}
+        for r in self.reflectors:
+            self._by_kind.setdefault(r.kind, r)
         self._threads: List[threading.Thread] = []
         self._boot: Optional[threading.Thread] = None
 
@@ -307,11 +363,15 @@ class K8sApiConnector(ConnectorBase):
 
     def _mark_dirty(self, kind: str) -> None:
         # Only the affected RESOURCE relists — per-kind stores are exactly
-        # what per-resource reflectors buy over the global journal.
-        r = self._by_kind.get(kind)
-        if r is not None:
-            r.dirty = True
-        else:  # unknown kind: cannot scope the damage
+        # what per-resource reflectors buy over the global journal.  A
+        # divergence cannot name its partition, so EVERY shard of the kind
+        # relists (each prunes only its own partition).
+        dirtied = False
+        for r in self.reflectors:
+            if r.kind == kind:
+                r.dirty = True
+                dirtied = True
+        if not dirtied:  # unknown kind: cannot scope the damage
             self._dirty = True
 
     def _prune_kind(self, kind: str, items: list,
@@ -377,9 +437,8 @@ class K8sApiConnector(ConnectorBase):
             return
         self.synced.set()
         for r in self.reflectors:
-            t = threading.Thread(
-                target=r.run, name=f"reflector-{r.kind}", daemon=True
-            )
+            name = f"reflector-{r.kind}" + (f"-{r.shard}" if r.shard else "")
+            t = threading.Thread(target=r.run, name=name, daemon=True)
             t.start()
             self._threads.append(t)
 

@@ -58,8 +58,14 @@ def make_synthetic_cluster(
     node_labels_fn=None,
     gang: bool = True,
     vocab: Optional[ResourceVocabulary] = None,
+    request_offset: int = 0,
 ) -> SyntheticCluster:
-    """Build a cache holding n_nodes hollow nodes and n_pods pending gang pods."""
+    """Build a cache holding n_nodes hollow nodes and n_pods pending gang pods.
+
+    ``request_offset`` rotates the deterministic request/priority pattern so
+    same-SHAPE clusters can carry distinct workloads — the multi-tenant rig
+    (harness/tenant.py) builds K such clusters whose ledger tensors stack
+    lane-for-lane while each lane's content stays its own."""
     if vocab is None:
         vocab = ResourceVocabulary(("nvidia.com/gpu",) if node_gpus else ())
     cache = SchedulerCache(vocab=vocab, async_io=False)
@@ -108,9 +114,9 @@ def make_synthetic_cluster(
             pod = PodSpec(
                 name=name,
                 namespace="default",
-                containers=[_mixed_request(pod_idx, node_gpus > 0)],
+                containers=[_mixed_request(request_offset + pod_idx, node_gpus > 0)],
                 phase="Pending",
-                priority=j % 10,
+                priority=(j + request_offset) % 10,
                 annotations={GROUP_NAME_ANNOTATION: group},
             )
             pod.creation_timestamp = ts_base + pod_idx * 1e-6

@@ -1,0 +1,250 @@
+"""Multi-tenant serving rig: K simulated cluster sessions, one device phase.
+
+The scenario the stacked dispatch exists for (docs/TENANT.md): a service
+process holds K independent cluster sessions — same ledger SHAPES, each its
+own workload — and runs their allocate device phases every cycle.  The solo
+loop pays K dispatch enqueues and K readback syncs per cycle; the stacked
+loop pays one of each (``ops/tenant.dispatch_stacked``), and per-tenant
+codes stay bitwise the solo cycle's (tests/test_tenant_parity.py).
+
+The rig builds K same-shape synthetic clusters whose workloads diverge via
+``make_synthetic_cluster(request_offset=...)``, opens a real session +
+FusedAllocator per tenant, then measures the SAME engines both ways:
+
+* sequential — tenant k's cycle latency is its completion time since cycle
+  start (a sequential service loop makes later tenants wait for earlier
+  ones; that queueing delay IS the isolation failure being measured);
+* stacked — one ``dispatch_stacked`` launch, then per-tenant readbacks;
+  every lane completes in the same device step, so per-tenant completion
+  stays flat in K.
+
+The artifact (``BENCH_TENANT_r*.json``, emitted by ``bench.py --tenant``)
+carries aggregate pods/s for both modes, the per-tenant p99 completion
+distribution, and ``p99_isolation`` = max over tenants of p99 divided by
+the median tenant's p99 — the headline fairness number
+``scripts/bench_gate.py`` bounds against the artifact's own stamped
+``isolation_bound``.  Every measured stacked cycle records the
+``dispatch_stacked`` evidence row through the OBS "tenant" channel
+(utils/obs.py OBS_CHANNELS), surfaced per cycle as
+``detail.cycles[].tenant``.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import List
+
+import numpy as np
+
+from scheduler_tpu.harness.synthetic import make_synthetic_cluster
+
+TENANT_CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+"""
+
+
+@dataclass(frozen=True)
+class TenantConfig:
+    k: int = 8                 # tenant sessions per dispatch
+    nodes: int = 16            # hollow nodes per simulated cluster
+    pods: int = 48             # pending pods per simulated cluster
+    tasks_per_job: int = 6
+    cycles: int = 30           # measured cycles per mode
+    warm_cycles: int = 2       # unmeasured compile/warm cycles per mode
+    isolation_bound: float = 3.0  # stamped into the artifact; the gate's bound
+
+
+def _percentile(values: List[float], q: float) -> float:
+    if not values:
+        return 0.0
+    return float(np.percentile(np.asarray(values, dtype=np.float64), q))
+
+
+class _Tenant:
+    """One simulated cluster session: cache + open session + fused engine."""
+
+    def __init__(self, idx: int, cfg: TenantConfig):
+        from scheduler_tpu.actions.allocate import collect_candidates
+        from scheduler_tpu.conf import parse_scheduler_conf
+        from scheduler_tpu.framework import open_session
+        from scheduler_tpu.ops.fused import FusedAllocator
+
+        self.idx = idx
+        # Same shape args for every tenant (the stacking precondition);
+        # request_offset rotates the workload so lanes differ in content.
+        cluster = make_synthetic_cluster(
+            cfg.nodes, cfg.pods, tasks_per_job=cfg.tasks_per_job,
+            request_offset=idx * 7,
+        )
+        self.cache = cluster.cache
+        self.ssn = open_session(
+            self.cache, parse_scheduler_conf(TENANT_CONF).tiers
+        )
+        self.engine = FusedAllocator(self.ssn, collect_candidates(self.ssn))
+        # The mega whole-cycle kernel has no batching rule (it would
+        # dispatch solo, docs/TENANT.md "What stacks") — the rig measures
+        # the stackable fused flavor.
+        self.engine.use_mega = False
+
+    def close(self) -> None:
+        from scheduler_tpu.framework import close_session
+
+        close_session(self.ssn)
+        self.cache.stop()
+
+
+def _placed(codes: np.ndarray) -> int:
+    """Tasks the device program placed this cycle (code >= 0 = node row)."""
+    return int((np.asarray(codes) >= 0).sum())
+
+
+def _measure_sequential(tenants, cycles: int):
+    """K solo dispatch+readback pairs per cycle; per-tenant completion is
+    measured from CYCLE start — the queueing delay later tenants pay in a
+    sequential service loop is the number under test."""
+    rows = []
+    per_tenant: List[List[float]] = [[] for _ in tenants]
+    for _ in range(cycles):
+        t0 = time.perf_counter()
+        placed = 0
+        per_ms = []
+        for i, ten in enumerate(tenants):
+            ten.engine.dispatch()
+            placed += _placed(ten.engine.readback())
+            done_ms = (time.perf_counter() - t0) * 1000.0
+            per_ms.append(round(done_ms, 3))
+            per_tenant[i].append(done_ms)
+        rows.append({
+            "s": round(time.perf_counter() - t0, 5),
+            "placed": placed,
+            "per_tenant_ms": per_ms,
+        })
+    return rows, per_tenant
+
+
+def _measure_stacked(tenants, cycles: int, stacked_cache):
+    """One dispatch_stacked launch per cycle, then per-tenant readbacks;
+    each cycle's evidence row rides the OBS "tenant" channel."""
+    from scheduler_tpu.ops.tenant import dispatch_stacked
+    from scheduler_tpu.utils import phases
+
+    rows = []
+    per_tenant: List[List[float]] = [[] for _ in tenants]
+    for _ in range(cycles):
+        phases.begin()
+        t0 = time.perf_counter()
+        dispatch_stacked([t.engine for t in tenants], cache=stacked_cache)
+        placed = 0
+        per_ms = []
+        for i, ten in enumerate(tenants):
+            placed += _placed(ten.engine.readback())
+            done_ms = (time.perf_counter() - t0) * 1000.0
+            per_ms.append(round(done_ms, 3))
+            per_tenant[i].append(done_ms)
+        elapsed = time.perf_counter() - t0
+        notes = phases.take_notes()
+        phases.end()
+        rows.append({
+            "s": round(elapsed, 5),
+            "placed": placed,
+            "per_tenant_ms": per_ms,
+            # The dispatch_stacked evidence row, read back through the OBS
+            # channel registry (utils/obs.py "tenant") rather than the
+            # return value — the bench proves the channel carries it.
+            "tenant": notes.get("tenant", {}),
+        })
+    return rows, per_tenant
+
+
+def _mode_stats(rows, per_tenant):
+    total_s = sum(r["s"] for r in rows)
+    total_placed = sum(r["placed"] for r in rows)
+    p99s = [round(_percentile(lat, 99.0), 3) for lat in per_tenant]
+    med = _percentile([float(p) for p in p99s], 50.0)
+    return {
+        "pods_per_sec": round(total_placed / total_s, 1) if total_s else 0.0,
+        "per_tenant_p99_ms": p99s,
+        "p99_ms": round(max(p99s), 3) if p99s else 0.0,
+        "p99_isolation": round(max(p99s) / med, 4) if med else 0.0,
+    }
+
+
+def run_tenant_bench(cfg: TenantConfig) -> dict:
+    """Run the K-tenant scenario; returns the BENCH_TENANT artifact body."""
+    from scheduler_tpu.ops.tenant import StackedEngineCache
+
+    tenants = [_Tenant(i, cfg) for i in range(cfg.k)]
+    stacked_cache = StackedEngineCache()
+    try:
+        # Warm both programs (solo jit and the lax.map lane jit) so neither
+        # measured mode pays the one-time compile.
+        _measure_sequential(tenants, cfg.warm_cycles)
+        _measure_stacked(tenants, cfg.warm_cycles, stacked_cache)
+
+        seq_rows, seq_lat = _measure_sequential(tenants, cfg.cycles)
+        stk_rows, stk_lat = _measure_stacked(tenants, cfg.cycles, stacked_cache)
+    finally:
+        for ten in tenants:
+            ten.close()
+
+    seq = _mode_stats(seq_rows, seq_lat)
+    stk = _mode_stats(stk_rows, stk_lat)
+    speedup = (
+        round(stk["pods_per_sec"] / seq["pods_per_sec"], 4)
+        if seq["pods_per_sec"] else 0.0
+    )
+    last_ev = stk_rows[-1]["tenant"] if stk_rows else {}
+    detail = {
+        "family": "tenant",
+        "k": cfg.k,
+        "nodes": cfg.nodes,
+        "pods": cfg.pods,
+        "tasks_per_job": cfg.tasks_per_job,
+        "cycles_measured": len(stk_rows),
+        # Aggregate throughput both ways; the gate regresses on the stacked
+        # number and reads the sequential one as the amortization baseline.
+        "agg_pods_per_sec": stk["pods_per_sec"],
+        "seq_pods_per_sec": seq["pods_per_sec"],
+        "speedup": speedup,
+        # Per-tenant p99 completion (ms) in stacked mode + the isolation
+        # ratio (max tenant p99 / median tenant p99) the gate bounds
+        # against the stamped isolation_bound.
+        "per_tenant_p99_ms": stk["per_tenant_p99_ms"],
+        "p99_ms": stk["p99_ms"],
+        "p99_isolation": stk["p99_isolation"],
+        "seq_p99_isolation": seq["p99_isolation"],
+        "isolation_bound": cfg.isolation_bound,
+        # Last cycle's stacked evidence at top level for a quick read; the
+        # full per-cycle chain is in cycles[].tenant.
+        "stacked_lanes": last_ev.get("stacked_lanes", 0),
+        "solo_lanes": last_ev.get("solo_lanes", 0),
+        "stacked_cache": {
+            "hits": stacked_cache.hits, "misses": stacked_cache.misses,
+        },
+        "cycles": stk_rows[-500:],
+        "seq_cycles": seq_rows[-500:],
+    }
+    return {
+        "metric": "tenant_agg_pods_per_sec",
+        "value": detail["agg_pods_per_sec"],
+        "unit": "pods/s",
+        # Target: every tenant completes in the same device step, so the
+        # p99 spread across tenants stays inside the stamped bound (<1
+        # passes).  The throughput SPEEDUP is detail.speedup and its
+        # authority is the TPU round — on a CPU container there is no
+        # dispatch-enqueue/readback RTT to amortize while lax.map still
+        # serializes the lanes, so speedup < 1 is the expected container
+        # reading (the obs overhead contract's "noisy off-TPU" rule).
+        "vs_target": (
+            round(stk["p99_isolation"] / cfg.isolation_bound, 4)
+            if cfg.isolation_bound else 0.0
+        ),
+        "detail": detail,
+    }

@@ -135,6 +135,17 @@ _ENV_KEYS = (
     "SCHEDULER_TPU_OBS_RING",
     "SCHEDULER_TPU_TRACE",
     "SCHEDULER_TPU_PROFILE",
+    # Multi-tenant service layer (ops/tenant.py, connector/reflector.py,
+    # docs/TENANT.md).  Neither flag changes a single session's traced
+    # program — stacked lanes ARE the solo graph, watch shards feed the
+    # same _apply seam — but, the WIRE precedent again, a resident
+    # per-session engine is pinned to the batching/ingestion regime it was
+    # diagnosed under: the K-stacked-vs-sequential and sharded-vs-single-
+    # stream parity contracts are per regime, and keying here means a
+    # violation can never hide behind a warm cache across a flag flip
+    # (re-checked by _delta_compatible for direct update() callers).
+    "SCHEDULER_TPU_TENANTS",
+    "SCHEDULER_TPU_WATCH_SHARDS",
 )
 
 _scope_counter = itertools.count(1)

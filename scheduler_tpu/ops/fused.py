@@ -1045,6 +1045,17 @@ class FusedAllocator:
         from scheduler_tpu.ops.evict import evict_flavor
 
         self.evict_flavor = evict_flavor()
+        # Service regime (ops/tenant.py + connector/reflector.py,
+        # docs/TENANT.md): batch width and watch-shard count never change
+        # this engine's program — stacked lanes ARE the solo graph, shards
+        # feed the same _apply seam — but the parity contracts are pinned
+        # per regime, so the pair sits in the engine-cache key
+        # (SCHEDULER_TPU_TENANTS / _WATCH_SHARDS) and is re-checked by
+        # _delta_compatible for direct update() callers.
+        from scheduler_tpu.connector.reflector import watch_shards
+        from scheduler_tpu.ops.tenant import tenant_count
+
+        self.service_regime = (tenant_count(), watch_shards())
         self.use_lp = False
         self.lp_reason = None         # why lp fell back to greedy, if it did
         self._lp_dev = None           # in-flight (pref, lp_raw) device pair
@@ -2085,6 +2096,15 @@ class FusedAllocator:
             # resident across a flag flip — same pinning rationale as the
             # cache key's SCHEDULER_TPU_EVICT component.
             return False
+        from scheduler_tpu.connector.reflector import watch_shards
+        from scheduler_tpu.ops.tenant import tenant_count
+
+        if self.service_regime != (tenant_count(), watch_shards()):
+            # Same pinning rationale as SCHEDULER_TPU_EVICT: the batching/
+            # ingestion regime never changes binds (docs/TENANT.md parity
+            # contracts), and a violation must not hide behind a warm
+            # resident across a flag flip.
+            return False
         queue_names = sorted(
             ssn.queues, key=lambda q: (ssn.queues[q].creation_timestamp, q)
         )
@@ -2710,6 +2730,85 @@ class FusedAllocator:
                 sig_compress=self.sig_compress,
                 mesh=self._mesh,
             )
+
+    def stack_payload(self):
+        """The engine's device arguments + static program parameters, packaged
+        for the multi-tenant stacked dispatch (``ops/tenant.py``,
+        docs/TENANT.md): lanes whose payload keys match run as ONE stacked
+        device program — ``lax.map`` of the very call ``dispatch()`` would
+        make, so each lane's codes are bitwise the solo cycle's.
+
+        Returns None when this engine cannot join a stack this cycle: a
+        launch already in flight (its codes are already paid for), or the
+        mega flavor (the whole-loop pallas kernel has no batching rule —
+        those lanes dispatch solo, same as a mega dispatch-time fallback).
+        """
+        if self._dev is not None or self.use_mega:
+            return None
+        from scheduler_tpu.ops import lp_place
+        from scheduler_tpu.utils import shardcheck
+
+        args = self.args
+        # Same staged-input check a solo dispatch runs — stacking must not
+        # become a shardcheck bypass.
+        shardcheck.check_dispatch(self._mesh, args)
+        statics = (
+            ("comparators", self.comparators),
+            ("queue_comparators", self.queue_comparators),
+            ("overused_gate", self.overused_gate),
+            ("use_static", self.use_static),
+            ("n_queues", len(self.queue_uids)),
+            ("weights", self.weights),
+            ("enforce_pod_count", self.enforce_pod_count),
+            ("window", self._window_size()),
+            ("batch_runs", self.batch_runs),
+            ("sorted_jobs", True),
+            ("has_releasing", self.has_releasing),
+            ("step_kernel", self.step_kernel),
+            ("queue_delta", self.queue_delta),
+            ("sig_compress", self.sig_compress and self.use_static),
+            ("mesh", self._mesh),
+        )
+        if not self.use_lp:
+            return {
+                "kind": "greedy", "operands": args, "n_args": len(args),
+                "statics": statics, "lp_statics": None,
+            }
+        # LP lanes mirror _dispatch_lp exactly: the relaxation statics plus
+        # the REPAIR replay's static overrides; sig-compressed lanes append
+        # the staged [S]-class triple as extra stacked operands.
+        lp_statics = (
+            ("iters", lp_place.lp_iters()),
+            ("tau", lp_place.lp_tau()),
+            ("tol", lp_place.lp_tol()),
+            ("weights", self.weights),
+            ("enforce_pod_count", self.enforce_pod_count),
+            ("use_static", self.use_static),
+            ("mesh", self._lp_mesh),
+        )
+        repair = dict(statics)
+        repair.update(
+            use_static=True, weights=(0.0, 0.0, 0.0), has_releasing=False,
+            step_kernel=False, sig_compress=self.sig_compress,
+        )
+        operands = args
+        if self.sig_compress and self._lp_sig_host is not None:
+            operands = args + tuple(self._lp_class_dev())
+        return {
+            "kind": "lp", "operands": operands, "n_args": len(args),
+            "statics": tuple(sorted(repair.items())), "lp_statics": lp_statics,
+        }
+
+    def attach_stacked(self, dev, lp_dev=None) -> None:
+        """Adopt one lane of a stacked launch as this engine's in-flight
+        device result: ``readback()`` then collects it exactly as it would a
+        solo ``dispatch()`` (the lane slice is still an async device value —
+        no host sync happens here).  ``lp_dev`` is the lane's (pref, lp_raw)
+        evidence pair for LP flavors."""
+        self._dev_stats = None
+        self._dev = dev
+        if lp_dev is not None:
+            self._lp_dev = lp_dev
 
     def _lp_class_dev(self):
         """The staged device twins of the [S]-class LP operands (request

@@ -327,6 +327,12 @@ SHARDING = {
     "node_trailing": (None, "nodes"),
     "node_major_2d": (("replica", "nodes"),),
     "node_trailing_2d": (None, ("replica", "nodes")),
+    # Multi-tenant cluster axis (docs/TENANT.md): the leading [K] lane axis
+    # is ALWAYS replicated — each device holds every tenant's shard — so the
+    # [K, N, …] tenant ledgers reuse ``node_trailing`` verbatim, and only the
+    # [K, T, N] static tensors need a deeper spec with the node axis third.
+    "lane_node_trailing": (None, None, "nodes"),
+    "lane_node_trailing_2d": (None, None, ("replica", "nodes")),
     "replicated": (),
 }
 
@@ -338,6 +344,7 @@ SHARDING = {
 SHARD_FAMILY_2D = {
     "node_major": "node_major_2d",
     "node_trailing": "node_trailing_2d",
+    "lane_node_trailing": "lane_node_trailing_2d",
     "replicated": "replicated",
 }
 
@@ -443,6 +450,30 @@ SHARD_SITES = {
         "in": ("node_major_2d",),
         "out": ("replicated",),
     },
+    # Multi-tenant K-lane placement scan (ops/sharded.py tenant_place_scan,
+    # docs/TENANT.md): K stacked tenant problems in one program.  The lane
+    # axis leads every tenant operand and is replicated everywhere; node
+    # ledgers ([K, N, …]) shard node_trailing, the [K, T, N] statics shard
+    # lane_node_trailing, task tables replicate.  Same three node-ledger
+    # carries as the single-tenant scan.
+    "ops/sharded.py::_tenant_scan_1d": {
+        "in": ("node_trailing", "node_trailing", "node_trailing",
+               "node_trailing", "node_trailing", "replicated", "replicated",
+               "replicated", "lane_node_trailing", "lane_node_trailing",
+               "replicated", "replicated"),
+        "out": ("node_trailing", "node_trailing", "node_trailing",
+                "replicated", "replicated", "replicated"),
+        "carry": ((0, 0), (1, 1), (2, 2)),
+    },
+    "ops/sharded.py::_tenant_scan_2d": {
+        "in": ("node_trailing_2d", "node_trailing_2d", "node_trailing_2d",
+               "node_trailing_2d", "node_trailing_2d", "replicated",
+               "replicated", "replicated", "lane_node_trailing_2d",
+               "lane_node_trailing_2d", "replicated", "replicated"),
+        "out": ("node_trailing_2d", "node_trailing_2d", "node_trailing_2d",
+                "replicated", "replicated", "replicated"),
+        "carry": ((0, 0), (1, 1), (2, 2)),
+    },
 }
 
 # Per-site collective budget in the COMPILED HLO, counted per loop step
@@ -506,6 +537,16 @@ COLLECTIVE_BUDGET = {
     "ops/evict.py::_victim_pick_2d": {
         "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
     },
+    # Tenant scan: the K lanes' candidate tuples pack into ONE [W, K] tensor
+    # riding ONE all-gather per step — batching tenants widens the payload,
+    # never the collective count (verified: shard_budget on both mesh
+    # shapes).  This is the tentpole's budget claim, pinned.
+    "ops/sharded.py::_tenant_scan_1d": {
+        "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
+    },
+    "ops/sharded.py::_tenant_scan_2d": {
+        "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
+    },
 }
 
 # Host-materialization guard: local names bound to registry-sharded device
@@ -556,6 +597,13 @@ SHARD_DOC_ROWS = {
     "node_trailing_2d": "2-D-mesh twin of node_trailing: trailing node "
                         "axis split over the combined (replica, nodes) "
                         "axes, leading axes replicated",
+    "lane_node_trailing": "[K, T, N] multi-tenant static tensors "
+                          "(docs/TENANT.md): leading cluster-lane and task "
+                          "axes replicated, trailing node axis split — the "
+                          "lane axis never shards",
+    "lane_node_trailing_2d": "2-D-mesh twin of lane_node_trailing: node "
+                             "axis split over the combined (replica, "
+                             "nodes) axes, lane/task axes replicated",
     "replicated": "job/queue/task tables, winner tuples, scalars: "
                   "identical on every chip (and every process)",
 }

@@ -399,3 +399,224 @@ def _selector_mask_2d(mesh, task_selector, node_labels):
         out_specs=P(None, (REPLICA_AXIS, NODE_AXIS)),
         check_vma=False,
     )(task_selector, node_labels)
+
+
+# -- multi-tenant cluster axis (docs/TENANT.md) -------------------------------
+#
+# SCHEDULER_TPU_TENANTS stacks K independent cluster sessions' ledgers along
+# a leading CLUSTER axis (lane k = tenant k) and runs them as one device
+# step.  The cluster axis never shards over the mesh — each device holds
+# every tenant's shard of the NODE axis — so the per-step comm contract is
+# unchanged: the K per-lane candidate tuples pack into ONE [W, K] tensor and
+# ride a single all-gather, exactly the budget the single-tenant scan pays
+# (COLLECTIVE_BUDGET: one all-gather, zero all-reduces, per step, for ANY K).
+
+
+def tenant_winner(lscore, global_idx, extra=(), axis=NODE_AXIS):
+    """K-lane two-level argmax: ``two_level_winner`` with a trailing cluster
+    axis.  Each shard packs one (score, global index, *extra) candidate PER
+    TENANT LANE into a [W, K] tensor; ONE all_gather moves all K lanes'
+    candidates, then each lane reduces replicated (argmax over shards takes
+    the FIRST max — ties to the lowest shard, and each shard's lowest-local-
+    row argmax makes that the lowest global index, the exact single-tenant
+    tie-break, per lane).  Returns the [W, K] winner pack."""
+    cand = jnp.stack([
+        lscore,
+        global_idx.astype(jnp.float32),
+        *extra,
+    ])                                           # [W, K]
+    all_cand = jax.lax.all_gather(cand, axis)    # [D, W, K]
+    shard_star = jnp.argmax(all_cand[:, WINNER.SCORE, :], axis=0)  # [K]
+    return jnp.take_along_axis(
+        all_cand, shard_star[None, None, :], axis=0
+    )[0]                                         # [W, K]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "weights", "enforce_pod_count")
+)
+def tenant_place_scan(
+    idle: jnp.ndarray,          # f32 [K, N, R]  sharded P(None, nodes)
+    releasing: jnp.ndarray,     # f32 [K, N, R]  sharded P(None, nodes)
+    task_count: jnp.ndarray,    # i32 [K, N]     sharded P(None, nodes)
+    allocatable: jnp.ndarray,   # f32 [K, N, R]  sharded P(None, nodes)
+    pods_limit: jnp.ndarray,    # i32 [K, N]     sharded P(None, nodes)
+    mins: jnp.ndarray,          # f32 [R]        replicated
+    init_resreq: jnp.ndarray,   # f32 [K, T, R]  replicated
+    resreq: jnp.ndarray,        # f32 [K, T, R]  replicated
+    static_mask: jnp.ndarray,   # bool [K, T, N] sharded P(None, None, nodes)
+    static_score: jnp.ndarray,  # f32 [K, T, N]  sharded P(None, None, nodes)
+    valid: jnp.ndarray,         # bool [K, T]    replicated
+    ready_deficit: jnp.ndarray,  # i32 [K]       replicated
+    *,
+    mesh: Mesh,
+    weights: Tuple[float, float, float],
+    enforce_pod_count: bool,
+):
+    """K stacked ``sharded_place_scan`` problems in ONE device program: lane
+    k must produce bitwise the same outputs as a solo scan over tenant k's
+    ledgers (pinned by tests/test_tenant_parity.py on both mesh shapes).
+
+    Returns (idle, releasing, task_count, chosen, pipelined, failed) — node
+    ledgers still [K, N(local), …] sharded, per-task outputs [K, T]
+    replicated."""
+    gather_axes = node_shard_axes(mesh)
+
+    def shard_fn(idle, releasing, task_count, allocatable, pods_limit, mins,
+                 init_resreq, resreq, static_mask, static_score, valid,
+                 ready_deficit):
+        k, n_local = idle.shape[0], idle.shape[1]
+        offset = shard_linear_index(mesh) * n_local
+        neg_inf = jnp.float32(-jnp.inf)
+        lanes = jnp.arange(k)
+
+        # The per-lane fit/score kernels are the single-tenant functions
+        # vmapped over the leading cluster axis — pure elementwise/reduce
+        # math, so batching adds no collectives and keeps each lane's
+        # reduction order (and therefore its bits) the solo scan's.
+        fit_lanes = jax.vmap(fit_mask, in_axes=(0, 0, None))
+        score_lanes = jax.vmap(
+            lambda req, idle, alloc: dynamic_score(req, idle, alloc, *weights)
+        )
+
+        def step(carry, xs):
+            idle, releasing, task_count, n_alloc, stopped = carry
+            init_req, req, smask, sscore, is_valid = xs
+
+            fit_idle = fit_lanes(init_req, idle, mins)       # [K, n_local]
+            fit_rel = fit_lanes(init_req, releasing, mins)
+            feasible = (fit_idle | fit_rel) & smask
+            if enforce_pod_count:
+                feasible = feasible & (task_count < pods_limit)
+
+            score = sscore + score_lanes(req, idle, allocatable)
+            masked_score = jnp.where(feasible, score, neg_inf)
+            lbest = jnp.argmax(masked_score, axis=1)         # [K]
+            lscore = jnp.take_along_axis(
+                masked_score, lbest[:, None], axis=1
+            )[:, 0]
+
+            fit_i = jnp.take_along_axis(fit_idle, lbest[:, None], axis=1)[:, 0]
+            fit_r = jnp.take_along_axis(fit_rel, lbest[:, None], axis=1)[:, 0]
+            # ONE candidate pack for ALL K lanes — the single per-step
+            # collective, same WINNER lane order as the solo scan.
+            win = tenant_winner(
+                lscore, lbest + offset,
+                extra=(fit_i.astype(jnp.float32), fit_r.astype(jnp.float32)),
+                axis=gather_axes,
+            )                                                # [W, K]
+            any_feasible = win[WINNER.SCORE] > neg_inf       # [K]
+            g_best = win[WINNER.INDEX].astype(jnp.int32)
+            fit_i_best = win[WINNER.FIT_IDLE] > 0
+            fit_r_best = win[WINNER.FIT_REL] > 0
+
+            active = (~stopped) & is_valid
+            placed = active & any_feasible
+            alloc_here = placed & fit_i_best
+            pipe_here = placed & ~fit_i_best & fit_r_best
+
+            # Each lane mutates only its own rows, and only on the owning
+            # shard; losing shards add a zero delta (the solo scan's rule,
+            # vectorized over lanes).
+            l_idx = g_best - offset
+            in_shard = (l_idx >= 0) & (l_idx < n_local)
+            row = jnp.clip(l_idx, 0, n_local - 1)            # [K]
+            delta = jnp.zeros_like(idle).at[lanes, row].set(req)
+            delta = delta * in_shard[:, None, None]
+            idle = idle - delta * alloc_here[:, None, None]
+            releasing = releasing - delta * pipe_here[:, None, None]
+            task_count = task_count + (
+                (jnp.arange(n_local)[None, :] == row[:, None])
+                & in_shard[:, None]
+                & (alloc_here | pipe_here)[:, None]
+            )
+
+            n_alloc = n_alloc + alloc_here
+            failed = active & ~any_feasible
+            became_ready = (alloc_here | pipe_here) & (n_alloc >= ready_deficit)
+            stopped = stopped | failed | became_ready
+
+            chosen = jnp.where(alloc_here | pipe_here, g_best, -1)
+            return (idle, releasing, task_count, n_alloc, stopped), (
+                chosen,
+                pipe_here,
+                failed,
+            )
+
+        init = (
+            idle,
+            releasing,
+            task_count,
+            jnp.zeros((k,), dtype=jnp.int32),
+            jnp.zeros((k,), dtype=bool),
+        )
+        # Scan over the shared task axis; operands stay lane-major [K, T, …]
+        # at the API so the swap is private to the loop.
+        xs = (
+            jnp.swapaxes(init_resreq, 0, 1),
+            jnp.swapaxes(resreq, 0, 1),
+            jnp.swapaxes(static_mask, 0, 1),
+            jnp.swapaxes(static_score, 0, 1),
+            jnp.swapaxes(valid, 0, 1),
+        )
+        (idle, releasing, task_count, _, _), (chosen, pipelined, failed) = (
+            jax.lax.scan(step, init, xs)
+        )
+        return (
+            idle, releasing, task_count,
+            jnp.swapaxes(chosen, 0, 1),
+            jnp.swapaxes(pipelined, 0, 1),
+            jnp.swapaxes(failed, 0, 1),
+        )
+
+    place = _tenant_scan_2d if is_multi_host(mesh) else _tenant_scan_1d
+    return place(
+        shard_fn, mesh,
+        idle, releasing, task_count, allocatable, pods_limit, mins,
+        init_resreq, resreq, static_mask, static_score, valid, ready_deficit,
+    )
+
+
+# Cluster-axis 1-D/2-D twins: same literal-site rule as the place scan — the
+# leading lane axis is replicated (None) on every operand, the node axis
+# shards exactly as the single-tenant families, and the three node-ledger
+# carries keep out-specs == in-specs for the donated engine-cache hit path.
+
+def _tenant_scan_1d(shard_fn, mesh, *operands):
+    return shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P(None, NODE_AXIS), P(None, NODE_AXIS), P(None, NODE_AXIS),
+            P(None, NODE_AXIS), P(None, NODE_AXIS), P(), P(), P(),
+            P(None, None, NODE_AXIS), P(None, None, NODE_AXIS), P(), P(),
+        ),
+        out_specs=(
+            P(None, NODE_AXIS), P(None, NODE_AXIS), P(None, NODE_AXIS),
+            P(), P(), P(),
+        ),
+        check_vma=False,
+    )(*operands)
+
+
+def _tenant_scan_2d(shard_fn, mesh, *operands):
+    return shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P(None, (REPLICA_AXIS, NODE_AXIS)),
+            P(None, (REPLICA_AXIS, NODE_AXIS)),
+            P(None, (REPLICA_AXIS, NODE_AXIS)),
+            P(None, (REPLICA_AXIS, NODE_AXIS)),
+            P(None, (REPLICA_AXIS, NODE_AXIS)), P(), P(), P(),
+            P(None, None, (REPLICA_AXIS, NODE_AXIS)),
+            P(None, None, (REPLICA_AXIS, NODE_AXIS)), P(), P(),
+        ),
+        out_specs=(
+            P(None, (REPLICA_AXIS, NODE_AXIS)),
+            P(None, (REPLICA_AXIS, NODE_AXIS)),
+            P(None, (REPLICA_AXIS, NODE_AXIS)),
+            P(), P(), P(),
+        ),
+        check_vma=False,
+    )(*operands)

@@ -1,0 +1,233 @@
+"""Multi-tenant stacked dispatch: K cluster sessions, ONE device step.
+
+The north-star is many clusters, not one big one — and every solo cluster
+session pays its own dispatch enqueue, its own readback sync, and (cold) its
+own compile.  This module is the cross-session twin of round 11's signature
+classes: identical STRUCTURE collapsed into one program.  Sessions whose
+engines stage the same argument shapes and the same static program
+parameters are lanes of one stacked tensor program —
+
+    ``jax.jit(lambda xs: jax.lax.map(lane, xs))``
+
+where ``lane`` is literally the call ``FusedAllocator.dispatch()`` would
+have made.  ``lax.map`` scans the lanes inside one XLA program, so each
+lane's computation IS the solo graph — per-tenant codes are bitwise the
+sequential cycle's (pinned by tests/test_tenant_parity.py), while the K
+dispatches, K readbacks and K compiles collapse into one of each.  Under a
+mesh the lane axis stays replicated (``ops/layout.py`` lane families) and
+the per-step winner all-gather count is unchanged (shard_budget lowers the
+``_tenant_scan_*`` twins on both shapes).
+
+The resident stacked engines live in :class:`StackedEngineCache`, keyed on
+exactly what the per-session engine cache keys on — operand shapes/dtypes +
+static program parameters — so identical-shape tenant sessions share one
+resident stacked program and a shape change can never cross-hit
+(docs/TENANT.md "Engine-cache keying").  Per-tenant state stays per-tenant:
+each session's OWN engine cache still applies its dirty-row scatter to its
+own staged ledgers before the lanes stack (docs/CHURN.md seam, per lane).
+
+``SCHEDULER_TPU_TENANTS`` (``tenant_count()``) is the service-layer knob:
+harness/tenant.py and the daemon's future multi-session loop size their
+dispatch batches with it.  It is registered in ``engine_cache._ENV_KEYS``
+so a resident per-session engine can never be reused across a change in
+the batching regime.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+
+def tenant_count() -> int:
+    """K, the multi-tenant batch width (0 = single-tenant service).  Read
+    per dispatch round — the registered engine-cache key makes resident
+    per-session engines honor a change."""
+    from scheduler_tpu.utils.envflags import env_int
+
+    return env_int("SCHEDULER_TPU_TENANTS", 0, minimum=0)
+
+
+def payload_key(payload: dict) -> tuple:
+    """Stacked-engine identity of one lane's payload: flavor + static
+    program parameters + per-lane operand shapes/dtypes.  Lanes with equal
+    keys run the SAME lane graph, so stacking them is exact; anything else
+    (a shape change, a flag change) keys a different resident program —
+    the no-cross-tenant-reuse rule."""
+    shapes = tuple(
+        (tuple(a.shape), str(a.dtype)) for a in payload["operands"]
+    )
+    return (
+        payload["kind"], payload["n_args"], payload["statics"],
+        payload["lp_statics"], shapes,
+    )
+
+
+def _build_stacked(payload: dict) -> Callable:
+    """The resident stacked callable for a payload key: ``lax.map`` of the
+    solo lane program over the stacked leading lane axis."""
+    from scheduler_tpu.ops.fused import fused_allocate
+
+    kind = payload["kind"]
+    n_args = payload["n_args"]
+    statics = dict(payload["statics"])
+    if kind == "greedy":
+
+        def lane(a):
+            return fused_allocate(*a, **statics)
+
+    else:
+        from scheduler_tpu.ops import lp_place
+
+        lp_kw = dict(payload["lp_statics"])
+        has_sig = len(payload["operands"]) > n_args
+
+        def lane(xs):
+            args = xs[:n_args]
+            # Mirrors FusedAllocator._dispatch_lp operand wiring exactly —
+            # relaxation, then the repair replay with the marginals riding
+            # the static-tensor positions.
+            if has_sig:
+                init_c, req_c, count_c = xs[n_args:]
+                marginals, feas, pref, lp_raw = lp_place.lp_relax(
+                    args[0], args[3], args[2], args[4], args[5],
+                    args[9], args[10], args[6], init_c, req_c, count_c,
+                    **lp_kw,
+                )
+            else:
+                marginals, feas, pref, lp_raw = lp_place.lp_relax(
+                    args[0], args[3], args[2], args[4], args[5],
+                    args[9], args[10], args[6], args[7], args[8],
+                    **lp_kw,
+                )
+            a = list(args)
+            a[9] = feas
+            a[10] = marginals
+            return fused_allocate(*a, **statics), pref, lp_raw
+
+    return jax.jit(lambda xs: jax.lax.map(lane, xs))
+
+
+class StackedEngineCache:
+    """Resident stacked device programs, LRU over payload keys.
+
+    The jitted callable per key is the resident engine: jax's own executable
+    cache under it keys on the stacked input shapes, so the SAME callable
+    serves any lane count K for that session shape — K is the leading axis
+    of the stacked operands, not part of this cache's key.  ``hits``/
+    ``misses`` are the reuse evidence the parity tests pin (same-shape
+    tenants MUST hit; a shape change MUST miss)."""
+
+    def __init__(self, cap: int = 8):
+        self.cap = max(1, cap)
+        self._entries: "OrderedDict[tuple, Callable]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, payload: dict) -> Callable:
+        key = payload_key(payload)
+        with self._lock:
+            fn = self._entries.get(key)
+            if fn is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return fn
+            self.misses += 1
+        fn = _build_stacked(payload)
+        with self._lock:
+            self._entries[key] = fn
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.cap:
+                self._entries.popitem(last=False)
+        return fn
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.hits = 0
+            self.misses = 0
+
+
+_cache = StackedEngineCache()
+
+
+def stacked_cache() -> StackedEngineCache:
+    """The process-wide resident stacked-engine cache (tests swap in their
+    own instance via the ``cache=`` parameter instead of mutating this)."""
+    return _cache
+
+
+def dispatch_stacked(
+    allocators: Sequence, cache: Optional[StackedEngineCache] = None
+) -> dict:
+    """Launch K tenant engines' device phases, stacking every group of
+    lanes with equal payload keys into ONE device program.
+
+    Each allocator afterwards holds an in-flight device result exactly as
+    if it had called ``dispatch()`` itself — callers collect per tenant
+    with the normal ``readback()``.  Lanes that cannot stack (mega flavor,
+    launch already in flight, or a payload key shared with no other lane)
+    dispatch solo, same semantics as today.  Returns the evidence row the
+    bench rig records per cycle (docs/TENANT.md "Evidence")."""
+    from scheduler_tpu.utils import sanitize
+
+    cache = cache if cache is not None else _cache
+    hits0, misses0 = cache.hits, cache.misses
+    groups: "Dict[tuple, List[Tuple[object, dict]]]" = {}
+    solo: List[object] = []
+    for eng in allocators:
+        payload = eng.stack_payload()
+        if payload is None:
+            solo.append(eng)
+        else:
+            groups.setdefault(payload_key(payload), []).append((eng, payload))
+
+    stacked_lanes = 0
+    stacked_groups = 0
+    for lanes in groups.values():
+        if len(lanes) < 2:
+            # A lone shape gains nothing from the lane axis — run the plain
+            # resident per-session engine.
+            solo.append(lanes[0][0])
+            continue
+        stacked_groups += 1
+        stacked_lanes += len(lanes)
+        first = lanes[0][1]
+        fn = cache.get(first)
+        stacked = tuple(
+            jnp.stack([p["operands"][i] for _, p in lanes])
+            for i in range(len(first["operands"]))
+        )
+        # Same transfer discipline as a solo dispatch: every stacked operand
+        # is already device-resident, so the launch must move no host bytes.
+        with sanitize.guard():
+            out = fn(stacked)
+        if first["kind"] == "greedy":
+            for k, (eng, _) in enumerate(lanes):
+                eng.attach_stacked(out[k])
+        else:
+            codes, pref, lp_raw = out
+            for k, (eng, _) in enumerate(lanes):
+                eng.attach_stacked(codes[k], lp_dev=(pref[k], lp_raw[k]))
+    for eng in solo:
+        eng.dispatch()
+    evidence = {
+        "k": len(allocators),
+        "groups": stacked_groups,
+        "stacked_lanes": stacked_lanes,
+        "solo_lanes": len(solo),
+        "cache_hits": cache.hits - hits0,
+        "cache_misses": cache.misses - misses0,
+    }
+    from scheduler_tpu.utils import phases
+
+    phases.note("tenant", evidence)
+    return evidence

@@ -112,6 +112,15 @@ OBS_CHANNELS = (
                   "cache commit seam as volcano_evictions_total",
         "desc": "device/host victim-hunt engagement, plans and phase split",
     },
+    {
+        "channel": "tenant",
+        "source": "ops/tenant.py",
+        "metric": None,
+        "exempt": "stacked-dispatch evidence; consumed by bench "
+                  "detail.cycles[].tenant and the BENCH_TENANT gate",
+        "desc": "multi-tenant stacked dispatch (lanes stacked vs solo, "
+                "resident stacked-engine hits/misses)",
+    },
 )
 
 _TSAN_FIELD = "phases.cycle_buffers"
@@ -427,9 +436,12 @@ def render_prometheus(cache=None) -> str:
     relist_rows: List[Tuple[str, float]] = []
     client = cache.client() if cache is not None else None
     for r in getattr(client, "reflectors", None) or ():
-        relist_rows.append(
-            ('{resource="%s"}' % esc(getattr(r, "kind", "?")),
-             getattr(r, "relist_bytes", 0)))
+        labels = 'resource="%s"' % esc(getattr(r, "kind", "?"))
+        if getattr(r, "shard", None):
+            # Sharded pod watches (docs/TENANT.md): one series per
+            # partition — two bare resource="pod" rows would collide.
+            labels += ',shard="%s"' % esc(r.shard)
+        relist_rows.append(("{%s}" % labels, getattr(r, "relist_bytes", 0)))
     fam("volcano_watch_relist_bytes_total", "counter",
         "Bytes paid to LIST/relist per watched resource", relist_rows)
 

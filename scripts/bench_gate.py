@@ -35,6 +35,18 @@ a directory given as argv[1]):
   (exit 1), and an artifact claiming ``evict_flavor == "device"`` with
   zero engaged cycles is malformed too — a host-walk measurement must not
   file under the device flavor (the LP family's silent-fallback rule);
+* ``BENCH_TENANT_r*.json`` — the multi-tenant stacked device phase scenario
+  (``bench.py --tenant``, docs/TENANT.md).  Two independent checks: the
+  newest artifact's aggregate pods/s more than 10% below the previous
+  round's fails (same K and scenario shape — k/nodes/pods/gang — required;
+  different shapes are not compared), and regardless of history the
+  artifact's per-tenant p99 isolation ratio (max tenant p99 / median
+  tenant p99) must not exceed the bound the artifact itself stamps at
+  emission (``detail.isolation_bound``) — one tenant starving the others
+  is a regression even when aggregate throughput survives it.  Missing
+  tenant fields, a per-tenant p99 list that does not cover every tenant,
+  or an artifact claiming the family with zero stacked lanes = malformed
+  (exit 1, the LP family's silent-fallback rule);
 * ``BENCH_LP_r*.json``  — the LP-relaxed allocator flagship
   (``SCHEDULER_TPU_ALLOCATOR=lp``, docs/LP_PLACEMENT.md).  LP artifacts
   must record ``detail.allocator == "lp"`` (else malformed, exit 1), and
@@ -88,7 +100,9 @@ TOLERANCE = 0.10
 # less than the artifact itself trusts.
 MIN_HEALTHY = 3
 
-_ROUND_RE = re.compile(r"BENCH(_MQ|_XL|_LP|_CHURN|_PREEMPT)?_r(\d+)\.json$")
+_ROUND_RE = re.compile(
+    r"BENCH(_MQ|_XL|_LP|_CHURN|_PREEMPT|_TENANT)?_r(\d+)\.json$"
+)
 
 # (family label, filename infix) — the artifact naming contract.  The churn
 # family is NOT listed here: its metric is latency (lower is better) with
@@ -122,6 +136,24 @@ _PREEMPT_KEYS = (
     ("evictions_per_s", (int, float)), ("churn_amplification", (int, float)),
     ("evict_flavor", str), ("engaged_cycles", int), ("cycles_measured", int),
     ("bound", int),
+)
+
+# Tenant-family policy: aggregate pods/s is higher-is-better (the flagship
+# TOLERANCE), and independently of history the artifact's per-tenant p99
+# isolation ratio must not exceed the bound the artifact itself stamps at
+# emission (detail.isolation_bound) — one tenant starving the others is a
+# regression even when aggregate throughput survives it.
+TENANT_TOLERANCE = 0.10
+
+# detail keys every tenant artifact must carry, with their types — the
+# multi-tenant evidence chain (docs/TENANT.md); a missing field means the
+# artifact cannot defend an isolation claim.
+_TENANT_KEYS = (
+    ("k", int), ("agg_pods_per_sec", (int, float)),
+    ("seq_pods_per_sec", (int, float)), ("speedup", (int, float)),
+    ("per_tenant_p99_ms", list), ("p99_isolation", (int, float)),
+    ("isolation_bound", (int, float)), ("cycles_measured", int),
+    ("stacked_lanes", int),
 )
 
 # LP may bind up to this fraction fewer pods than greedy on the same shape
@@ -529,6 +561,112 @@ def gate_preempt(root: Path) -> int:
     return 2 if new_p99 > ceiling else 0
 
 
+def _tenant_detail(path: Path):
+    """The tenant artifact's detail block, or (None, reason) when it is
+    malformed — a missing field means the artifact cannot defend an
+    aggregate-throughput or isolation claim (docs/TENANT.md)."""
+    doc = _unwrap(json.loads(path.read_text()))
+    detail = doc.get("detail", {})
+    if detail.get("family") != "tenant":
+        return None, f"{path.name} does not record detail.family == 'tenant'"
+    for key, typ in _TENANT_KEYS:
+        if not isinstance(detail.get(key), typ):
+            return None, (
+                f"{path.name} is missing tenant field detail.{key} — "
+                "re-emit via bench.py --tenant"
+            )
+    if len(detail["per_tenant_p99_ms"]) != detail["k"]:
+        return None, (
+            f"{path.name} records {len(detail['per_tenant_p99_ms'])} "
+            f"per-tenant p99 entries for k={detail['k']} — the isolation "
+            "claim must cover every tenant"
+        )
+    if detail["stacked_lanes"] == 0:
+        return None, (
+            f"{path.name} records zero stacked lanes — every tenant "
+            "dispatched solo, so a sequential measurement must not file "
+            "under the tenant family (see detail.cycles[].tenant for the "
+            "recorded payload-key groups)"
+        )
+    return detail, None
+
+
+def _tenant_shape(detail: dict):
+    """The scenario two tenant artifacts must share to be compared."""
+    return (
+        detail.get("k"), detail.get("nodes"), detail.get("pods"),
+        detail.get("tasks_per_job"),
+    )
+
+
+def gate_tenant(root: Path) -> int:
+    """Gate the ``BENCH_TENANT_r*.json`` family (docs/TENANT.md): the
+    newest artifact's per-tenant p99 isolation ratio above its OWN stamped
+    bound fails regardless of history (the churn hit-rate-floor rule), and
+    the newest aggregate pods/s more than ``TENANT_TOLERANCE`` below the
+    previous round's fails — same K and scenario shape required; different
+    shapes are not compared.  Exit codes as main()."""
+    artifacts = find_artifacts(root, "_TENANT")
+    if not artifacts:
+        print("bench-gate[tenant]: no BENCH_TENANT_r*.json; nothing to judge")
+        return 0
+    try:
+        new_detail, why = _tenant_detail(artifacts[-1])
+    except json.JSONDecodeError as err:
+        print(f"bench-gate[tenant]: malformed artifact "
+              f"{artifacts[-1].name}: {err}")
+        return 1
+    if new_detail is None:
+        print(f"bench-gate[tenant]: {why}")
+        return 1
+    worst = 0
+    iso, bound = new_detail["p99_isolation"], new_detail["isolation_bound"]
+    if iso > bound:
+        print(
+            f"bench-gate[tenant]: {artifacts[-1].name} p99 isolation "
+            f"{iso:.3f} above its own stamped bound {bound:.3f}: "
+            "ISOLATION REGRESSION"
+        )
+        worst = 2
+    else:
+        print(
+            f"bench-gate[tenant]: {artifacts[-1].name} p99 isolation "
+            f"{iso:.3f} <= bound {bound:.3f} "
+            f"(k={new_detail['k']}, {new_detail['stacked_lanes']} stacked "
+            "lane(s)): ok"
+        )
+    if len(artifacts) < 2:
+        print("bench-gate[tenant]: one artifact; no pods/s round to compare")
+        return worst
+    try:
+        prev_detail, why = _tenant_detail(artifacts[-2])
+    except json.JSONDecodeError as err:
+        print(f"bench-gate[tenant]: malformed artifact "
+              f"{artifacts[-2].name}: {err}")
+        return 1
+    if prev_detail is None:
+        print(f"bench-gate[tenant]: {why}")
+        return 1
+    if _tenant_shape(prev_detail) != _tenant_shape(new_detail):
+        print(
+            f"bench-gate[tenant]: {artifacts[-2].name} "
+            f"{_tenant_shape(prev_detail)} and {artifacts[-1].name} "
+            f"{_tenant_shape(new_detail)} ran different scenario shapes; "
+            "not comparable (no verdict)"
+        )
+        return worst
+    prev_pps = prev_detail["agg_pods_per_sec"]
+    new_pps = new_detail["agg_pods_per_sec"]
+    floor = (1.0 - TENANT_TOLERANCE) * prev_pps
+    verdict = "REGRESSION" if new_pps < floor else "ok"
+    print(
+        f"bench-gate[tenant]: {artifacts[-2].name} aggregate "
+        f"{prev_pps:,.1f} pods/s -> {artifacts[-1].name} "
+        f"{new_pps:,.1f} pods/s (floor {floor:,.1f}): {verdict}"
+    )
+    return max(worst, 2 if new_pps < floor else 0)
+
+
 def gate_family(root: Path, label: str, infix: str) -> int:
     """Gate one artifact family; same exit-code contract as main()."""
     artifacts = find_artifacts(root, infix)
@@ -607,7 +745,8 @@ def main(argv) -> int:
     # worst.
     worst = max(gate_family(root, label, infix) for label, infix in FAMILIES)
     return max(
-        worst, gate_lp_vs_greedy(root), gate_churn(root), gate_preempt(root)
+        worst, gate_lp_vs_greedy(root), gate_churn(root), gate_preempt(root),
+        gate_tenant(root),
     )
 
 

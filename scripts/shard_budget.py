@@ -222,6 +222,35 @@ def _hlo_lp_iterate_sig(mesh) -> str:
     return lowered.compile().as_text()
 
 
+def _hlo_tenant_scan(mesh) -> str:
+    """Lower the multi-tenant K-lane placement scan (``ops/sharded.py``
+    ``tenant_place_scan``, docs/TENANT.md) at K=4 lanes.  The K lanes'
+    candidate tuples pack into ONE [W, K] tensor riding ONE all-gather per
+    scan step — batching tenants widens the payload, never the collective
+    count, on both mesh shapes.  This is the tentpole budget claim the
+    registry pins."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scheduler_tpu.ops.sharded import tenant_place_scan
+
+    k = 4
+    p = _small_problem()
+    lane = {name: np.stack([v] * k) for name, v in p.items()
+            if name not in ("mins", "ready_deficit")}
+    lowered = tenant_place_scan.lower(
+        jnp.asarray(lane["idle"]), jnp.asarray(lane["releasing"]),
+        jnp.asarray(lane["task_count"]), jnp.asarray(lane["allocatable"]),
+        jnp.asarray(lane["pods_limit"]), jnp.asarray(p["mins"]),
+        jnp.asarray(lane["init_resreq"]), jnp.asarray(lane["resreq"]),
+        jnp.asarray(lane["static_mask"]), jnp.asarray(lane["static_score"]),
+        jnp.asarray(lane["valid"]),
+        jnp.asarray(np.full(k, 100, np.int32)),
+        mesh=mesh, weights=(1.0, 1.0, 0.0), enforce_pod_count=True,
+    )
+    return lowered.compile().as_text()
+
+
 def _hlo_victim_pick(mesh) -> str:
     """Lower the eviction engine's victim-plan node pick
     (``ops/evict.py`` ``sharded_victim_pick``, docs/PREEMPT.md): each shard
@@ -267,6 +296,7 @@ def lowerable_sites(mesh) -> dict:
     if is_multi_host(mesh):
         return {
             "ops/sharded.py::_place_scan_2d": _hlo_place_scan,
+            "ops/sharded.py::_tenant_scan_2d": _hlo_tenant_scan,
             "ops/sharded.py::_selector_mask_2d": _hlo_selector_mask,
             "ops/lp_place.py::_lp_iterate_2d": _hlo_lp_iterate,
             "ops/lp_place.py::_lp_iterate_sig_2d": _hlo_lp_iterate_sig,
@@ -274,6 +304,7 @@ def lowerable_sites(mesh) -> dict:
         }
     return {
         "ops/sharded.py::_place_scan_1d": _hlo_place_scan,
+        "ops/sharded.py::_tenant_scan_1d": _hlo_tenant_scan,
         "ops/sharded.py::_selector_mask_1d": _hlo_selector_mask,
         "ops/lp_place.py::_lp_iterate_1d": _hlo_lp_iterate,
         "ops/lp_place.py::_lp_iterate_sig_1d": _hlo_lp_iterate_sig,

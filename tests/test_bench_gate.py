@@ -557,3 +557,119 @@ def test_obs_disabled_block_needs_no_ab(tmp_path):
 def test_obs_block_wrong_shape_is_malformed(tmp_path):
     _write(tmp_path, "BENCH_r01.json", _obs_artifact(obs=["not", "a", "dict"]))
     assert gate_family(tmp_path, "single-queue", "") == 1
+
+
+# -- the tenant family (bench.py --tenant, docs/TENANT.md) --------------------
+
+def _tenant_artifact(pps=24000.0, isolation=1.05, bound=3.0, k=8,
+                     stacked=8, nodes=16, pods=48, per_tenant=None,
+                     **extra) -> dict:
+    detail = {
+        "family": "tenant", "k": k, "nodes": nodes, "pods": pods,
+        "tasks_per_job": 6, "cycles_measured": 30,
+        "agg_pods_per_sec": pps, "seq_pods_per_sec": pps * 4.0,
+        "speedup": 0.25,
+        "per_tenant_p99_ms": per_tenant if per_tenant is not None
+        else [30.0 + i * 0.1 for i in range(k)],
+        "p99_ms": 30.0 + (k - 1) * 0.1,
+        "p99_isolation": isolation, "seq_p99_isolation": 1.9,
+        "isolation_bound": bound, "stacked_lanes": stacked,
+        "solo_lanes": k - stacked,
+        "stacked_cache": {"hits": 31, "misses": 1},
+        "cycles": [], "seq_cycles": [],
+    }
+    detail.update(extra)
+    return {
+        "metric": "tenant_agg_pods_per_sec", "value": pps, "unit": "pods/s",
+        "vs_target": isolation / bound, "detail": detail,
+    }
+
+
+def test_tenant_family_is_recognized_and_segregated(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _artifact(100.0))
+    _write(tmp_path, "BENCH_TENANT_r01.json", _tenant_artifact())
+    assert [p.name for p in find_artifacts(tmp_path, "")] == ["BENCH_r01.json"]
+    assert [p.name for p in find_artifacts(tmp_path, "_TENANT")] == [
+        "BENCH_TENANT_r01.json"
+    ]
+
+
+def test_tenant_single_artifact_inside_bound_passes(tmp_path):
+    from scripts.bench_gate import gate_tenant
+
+    _write(tmp_path, "BENCH_TENANT_r01.json", _tenant_artifact())
+    assert gate_tenant(tmp_path) == 0
+    assert gate_main(["bench_gate", str(tmp_path)]) == 0
+
+
+def test_tenant_isolation_above_own_stamped_bound_fails(tmp_path):
+    from scripts.bench_gate import gate_tenant
+
+    # The bound is stamped at emission — one tenant starving the others is
+    # a regression regardless of any previous round.
+    _write(tmp_path, "BENCH_TENANT_r01.json",
+           _tenant_artifact(isolation=3.4, bound=3.0))
+    assert gate_tenant(tmp_path) == 2
+    assert gate_main(["bench_gate", str(tmp_path)]) == 2
+
+
+def test_tenant_pods_per_sec_regression_beyond_tolerance_fails(tmp_path):
+    from scripts.bench_gate import gate_tenant
+
+    _write(tmp_path, "BENCH_TENANT_r01.json", _tenant_artifact(pps=24000.0))
+    _write(tmp_path, "BENCH_TENANT_r02.json", _tenant_artifact(pps=20000.0))
+    assert gate_tenant(tmp_path) == 2
+
+
+def test_tenant_pods_per_sec_within_tolerance_passes(tmp_path):
+    from scripts.bench_gate import gate_tenant
+
+    _write(tmp_path, "BENCH_TENANT_r01.json", _tenant_artifact(pps=24000.0))
+    _write(tmp_path, "BENCH_TENANT_r02.json", _tenant_artifact(pps=22500.0))
+    assert gate_tenant(tmp_path) == 0
+
+
+def test_tenant_rounds_on_different_k_or_shape_are_not_compared(tmp_path):
+    from scripts.bench_gate import gate_tenant
+
+    # Different K is a different scenario — a K=64 round must not be
+    # judged against a K=8 round's aggregate.
+    _write(tmp_path, "BENCH_TENANT_r01.json",
+           _tenant_artifact(pps=24000.0, k=8))
+    _write(tmp_path, "BENCH_TENANT_r02.json",
+           _tenant_artifact(pps=2000.0, k=64, stacked=64))
+    assert gate_tenant(tmp_path) == 0
+
+
+def test_tenant_artifact_missing_fields_is_malformed(tmp_path):
+    from scripts.bench_gate import gate_tenant
+
+    doc = _tenant_artifact()
+    del doc["detail"]["p99_isolation"]
+    _write(tmp_path, "BENCH_TENANT_r01.json", doc)
+    assert gate_tenant(tmp_path) == 1
+    assert gate_main(["bench_gate", str(tmp_path)]) == 1
+
+
+def test_tenant_per_tenant_list_must_cover_every_tenant(tmp_path):
+    from scripts.bench_gate import gate_tenant
+
+    _write(tmp_path, "BENCH_TENANT_r01.json",
+           _tenant_artifact(k=8, per_tenant=[30.0, 30.1, 30.2]))
+    assert gate_tenant(tmp_path) == 1
+
+
+def test_tenant_zero_stacked_lanes_is_malformed(tmp_path):
+    from scripts.bench_gate import gate_tenant
+
+    # Every tenant dispatching solo means the artifact measured the
+    # sequential loop twice — it must not file under the tenant family
+    # (the LP family's silent-fallback rule).
+    _write(tmp_path, "BENCH_TENANT_r01.json", _tenant_artifact(stacked=0))
+    assert gate_tenant(tmp_path) == 1
+
+
+def test_tenant_gate_with_no_artifacts_is_silent_pass(tmp_path):
+    from scripts.bench_gate import gate_tenant
+
+    assert gate_tenant(tmp_path) == 0

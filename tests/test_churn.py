@@ -267,6 +267,7 @@ def _tiny_cfg(**kw) -> ChurnConfig:
     return ChurnConfig(**base)
 
 
+@pytest.mark.slow  # ~10s full-rig soak; CI churn job runs the slow set explicitly
 def test_churn_bench_short_seeded_soak(monkeypatch):
     """The CI churn job's seeded soak: the full rig — mock apiserver,
     reflector ingestion, event-triggered scheduler — survives a short

@@ -401,11 +401,13 @@ class TestOutboundDialects:
                 conn.stop()
             server.shutdown()
 
+    @pytest.mark.slow  # ~17s wire drive; the ingest CI job runs unfiltered
     def test_k8s_dialect_round_trip(self):
         counts = self._drive("k8s")
         assert counts["k8s"] >= 5, counts  # binds+delete+patches+events
         assert counts["legacy"] == 0, counts
 
+    @pytest.mark.slow  # ~16s wire drive; the ingest CI job runs unfiltered
     def test_legacy_dialect_round_trip(self):
         counts = self._drive("legacy")
         assert counts["legacy"] >= 3, counts

@@ -240,13 +240,17 @@ def test_budget_holds_on_the_2d_mesh_one_merged_all_gather():
         # Eviction-engine node pick (round 12, docs/PREEMPT.md): one
         # EVICT_PICK tuple all-gather per hunt step, checked below.
         "ops/evict.py::_victim_pick_2d",
+        # Multi-tenant stacked scan (round 16, docs/TENANT.md): the lane
+        # axis is replicated, so the per-step budget is unchanged.
+        "ops/sharded.py::_tenant_scan_2d",
     }
     counts = count_collectives(sites[site](mesh))
     assert counts == {"all-gather": 1}
     assert check_counts(site, counts, layout.COLLECTIVE_BUDGET[site]) == []
     for lp_site in ("ops/lp_place.py::_lp_iterate_2d",
                     "ops/lp_place.py::_lp_iterate_sig_2d",
-                    "ops/evict.py::_victim_pick_2d"):
+                    "ops/evict.py::_victim_pick_2d",
+                    "ops/sharded.py::_tenant_scan_2d"):
         lp_counts = count_collectives(sites[lp_site](mesh))
         assert lp_counts == {"all-gather": 1}
         assert check_counts(

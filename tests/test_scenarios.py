@@ -3,6 +3,8 @@ loop (the reference's e2e suite shape: real actions + plugins over a fake-backed
 cache; test/e2e/job.go, queue.go, predicates.go, nodeorder.go scenarios)."""
 
 
+import pytest
+
 from scheduler_tpu.cache import SchedulerCache
 from scheduler_tpu.harness import make_synthetic_cluster
 from scheduler_tpu.scheduler import Scheduler
@@ -88,6 +90,7 @@ tiers:
 
 # -- Scenario 4: over-subscribed two-queue reclaim under proportion -----------
 
+@pytest.mark.slow  # ~29s two-queue reclaim drive; CI "test" job runs the slow set explicitly
 def test_scenario4_two_queue_reclaim(tmp_path):
     vocab = make_vocab()
     cache = SchedulerCache(vocab=vocab, async_io=False)

@@ -89,6 +89,58 @@ def test_env_drift_reports_missing_registry():
     assert len(out) == 1 and "_ENV_KEYS" in out[0].message
 
 
+# -- tenant batching knobs (round 16, docs/TENANT.md) -------------------------
+
+TENANT_CACHE_STUB = """
+    _ENV_KEYS = (
+        "SCHEDULER_TPU_MEGA",
+        "SCHEDULER_TPU_TENANTS",
+        "SCHEDULER_TPU_WATCH_SHARDS",
+    )
+"""
+
+
+def test_env_drift_clean_on_registered_tenant_knobs():
+    """The multi-tenant batching knobs are program-selecting (a resident
+    engine must not survive a batching-regime flip), so their envflags
+    reads in ops/ are clean exactly because engine_cache registers them."""
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": TENANT_CACHE_STUB,
+        "scheduler_tpu/ops/tenant.py": """
+            from scheduler_tpu.utils.envflags import env_int
+            def tenant_count():
+                return env_int("SCHEDULER_TPU_TENANTS", 0)
+        """,
+    })
+    assert out == []
+
+
+def test_env_drift_trips_on_unregistered_tenant_knob():
+    """The same read WITHOUT the registration is the drift the pass exists
+    for: a batching-regime flip the resident-engine key cannot see."""
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+        "scheduler_tpu/ops/tenant.py": """
+            from scheduler_tpu.utils.envflags import env_int
+            def tenant_count():
+                return env_int("SCHEDULER_TPU_TENANTS", 0)
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_TENANTS" in out[0].message
+    assert out[0].path == "scheduler_tpu/ops/tenant.py"
+
+
+def test_raw_env_trips_on_tenant_knob_environ_read():
+    out = findings("raw-env", py={
+        "scheduler_tpu/ops/tenant.py": """
+            import os
+            def tenant_count():
+                return int(os.environ.get("SCHEDULER_TPU_TENANTS", "0"))
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_TENANTS" in out[0].message
+
+
 # -- raw-env ------------------------------------------------------------------
 
 def test_raw_env_trips_on_os_environ_read():

@@ -6,6 +6,7 @@ import threading
 import time
 import urllib.request
 
+import pytest
 
 from scheduler_tpu.cache import SchedulerCache
 from scheduler_tpu.options import ServerOption, parse_options
@@ -163,6 +164,7 @@ def test_leader_election_single_holder(tmp_path):
     tb.join(timeout=2)
 
 
+@pytest.mark.slow  # ~20s profiler-trace cycle; CI "test" job runs the slow set explicitly
 def test_profile_dir_writes_trace(tmp_path):
     """--profile-dir wraps each cycle in a JAX profiler trace (SURVEY §5's
     pprof analogue); the trace directory must be populated after a cycle."""

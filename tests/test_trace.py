@@ -133,6 +133,7 @@ def test_unwritable_trace_dir_degrades_without_breaking_the_cycle(
     assert trace.status()["enabled"] is False  # export latched off
 
 
+@pytest.mark.slow  # ~14s sampled-profiler loop; the observability CI job runs unfiltered
 def test_sampled_profile_links_by_cycle_id(tmp_path, monkeypatch):
     monkeypatch.setenv("SCHEDULER_TPU_PROFILE", str(tmp_path))
     monkeypatch.setenv("SCHEDULER_TPU_PROFILE_EVERY", "2")

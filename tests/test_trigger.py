@@ -11,6 +11,8 @@ import threading
 import time
 import urllib.request
 
+import pytest
+
 from scheduler_tpu.utils.trigger import CycleTrigger, trigger_mode_from_env
 
 
@@ -294,6 +296,7 @@ def _drive_binds(tmp_path, mode: str) -> list:
         server.shutdown()
 
 
+@pytest.mark.slow  # ~23s dual-replay parity; CI churn job runs the slow set explicitly
 def test_event_and_period_pacing_bind_identically_on_the_same_journal(
     tmp_path, monkeypatch
 ):

@@ -378,6 +378,13 @@ class AllocateAction(Action):
         # per cycle (detail.cycles[].sig) and bench_gate can sanity-check
         # the artifact's compression claims.
         sig_stats = stats.pop("sig", None)
+        # Queue-fair solve evidence (docs/QUEUE_DELTA.md "Class-ladder
+        # solve"): solve flavor, fixed iteration count, convergence step and
+        # — when the ladder engaged — rung count, class count and device
+        # lookups (or the admission reason when it declined).  Its own
+        # channel so the bench records it per cycle (detail.cycles[].qfair)
+        # and bench_gate can validate the evidence block on MQ artifacts.
+        qfair_stats = stats.pop("qfair", None)
         phases.note("cohort", stats)
         if queue_chain is not None:
             phases.note("queue_chain", queue_chain)
@@ -385,6 +392,8 @@ class AllocateAction(Action):
             phases.note("lp", lp_stats)
         if sig_stats is not None:
             phases.note("sig", sig_stats)
+        if qfair_stats is not None:
+            phases.note("qfair", qfair_stats)
         with phases.phase("decode"):
             items, node_batches, failures = engine.run_columnar()  # reuses codes
         with phases.phase("apply"):

@@ -456,8 +456,11 @@ def run_churn_bench(cfg: ChurnConfig, wire: Optional[str] = None,
         # with different warm fractions look like ingest-volume changes.
         trigger_mark = (trigger.cycles, trigger.total_events)
         applied_mark = connector.events_applied
+        # Keyed by instance, not kind: sharded pod ingestion (--watch-shards)
+        # runs several reflectors of the SAME kind, and a kind-keyed mark
+        # would subtract one shard's snapshot from every shard's counter.
         reflectors_mark = {
-            r.kind: (r.relists, r.relist_bytes)
+            id(r): (r.relists, r.relist_bytes)
             for r in getattr(connector, "reflectors", []) or []
         }
 
@@ -490,8 +493,15 @@ def run_churn_bench(cfg: ChurnConfig, wire: Optional[str] = None,
 
     stats = _cycle_stats(cycles)
     reflectors = getattr(connector, "reflectors", None)
+    from scheduler_tpu.connector.reflector import watch_shards
+
     ingest = {
         "wire": type(connector).__name__,
+        # Pod watch-stream shard count the run ingested under
+        # (SCHEDULER_TPU_WATCH_SHARDS / bench.py --churn --watch-shards):
+        # the ROADMAP reflector-bottleneck slice compares churn artifacts
+        # across this knob, so the artifact must say which regime it ran.
+        "watch_shards": watch_shards(),
         # Measured-window delta (see the mark-time snapshot above).
         "events_applied": connector.events_applied - applied_mark,
     }
@@ -499,11 +509,11 @@ def run_churn_bench(cfg: ChurnConfig, wire: Optional[str] = None,
         # Window deltas again: relist_bytes accumulates the initial seed
         # LISTs too, which are boot cost, not churn cost.
         ingest["relists"] = sum(
-            r.relists - reflectors_mark.get(r.kind, (0, 0))[0]
+            r.relists - reflectors_mark.get(id(r), (0, 0))[0]
             for r in reflectors
         )
         ingest["relist_bytes"] = sum(
-            r.relist_bytes - reflectors_mark.get(r.kind, (0, 0))[1]
+            r.relist_bytes - reflectors_mark.get(id(r), (0, 0))[1]
             for r in reflectors
         )
     detail = {

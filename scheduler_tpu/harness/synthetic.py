@@ -59,13 +59,22 @@ def make_synthetic_cluster(
     gang: bool = True,
     vocab: Optional[ResourceVocabulary] = None,
     request_offset: int = 0,
+    request_fn=None,
+    node_extra: Optional[Dict[str, float]] = None,
 ) -> SyntheticCluster:
     """Build a cache holding n_nodes hollow nodes and n_pods pending gang pods.
 
     ``request_offset`` rotates the deterministic request/priority pattern so
     same-SHAPE clusters can carry distinct workloads — the multi-tenant rig
     (harness/tenant.py) builds K such clusters whose ledger tensors stack
-    lane-for-lane while each lane's content stays its own."""
+    lane-for-lane while each lane's content stays its own.
+
+    ``request_fn(job_idx, task_idx)`` overrides the mixed-request pattern
+    with a caller-shaped request dict — the MQ bench uses it to make every
+    queue's pods request ONE uniform vector, the shape the qfair class
+    ladder admits (docs/QUEUE_DELTA.md "Class-ladder solve").  ``node_extra``
+    adds extra allocatable resources to every hollow node (the wide-vocab
+    scalars those requests name)."""
     if vocab is None:
         vocab = ResourceVocabulary(("nvidia.com/gpu",) if node_gpus else ())
     cache = SchedulerCache(vocab=vocab, async_io=False)
@@ -83,6 +92,8 @@ def make_synthetic_cluster(
         }
         if node_gpus:
             allocatable["nvidia.com/gpu"] = float(node_gpus)
+        if node_extra:
+            allocatable.update(node_extra)
         labels = node_labels_fn(i) if node_labels_fn else {}
         cache.add_node(NodeSpec(name=f"hn-{i:06d}", allocatable=allocatable, labels=labels))
 
@@ -114,7 +125,10 @@ def make_synthetic_cluster(
             pod = PodSpec(
                 name=name,
                 namespace="default",
-                containers=[_mixed_request(request_offset + pod_idx, node_gpus > 0)],
+                containers=[
+                    request_fn(j, t) if request_fn is not None
+                    else _mixed_request(request_offset + pod_idx, node_gpus > 0)
+                ],
                 phase="Pending",
                 priority=(j + request_offset) % 10,
                 annotations={GROUP_NAME_ANNOTATION: group},

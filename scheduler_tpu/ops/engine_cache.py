@@ -100,6 +100,16 @@ _ENV_KEYS = (
     # layout-derived and pinned by the layout token (incl. the vocab
     # fingerprint below), like the cohort tables.
     "SCHEDULER_TPU_SIG_COMPRESS",
+    # Queue-fair solve flavor + iteration count (ops/qfair.py,
+    # docs/QUEUE_DELTA.md "Class-ladder solve").  The flavor selects the
+    # host fixed-point loop vs the device waterfilling solve AND gates the
+    # class-ladder refresh baked into the traced step programs
+    # (qfair_ladder static); the iteration count is baked into the solve's
+    # fixed-trip lax.fori_loop — a resident engine built under one setting
+    # must never serve another (re-checked by _delta_compatible for direct
+    # update() callers).
+    "SCHEDULER_TPU_QFAIR",
+    "SCHEDULER_TPU_QFAIR_ITERS",
     # Cycle pacing (utils/trigger.py, docs/CHURN.md).  Never read by the
     # engine build itself, but registered — like SCHEDULER_TPU_WIRE — so a
     # resident engine is pinned to the pacing regime it was diagnosed under:

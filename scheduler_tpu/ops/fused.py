@@ -168,7 +168,7 @@ _KNOWN_JOB_ORDER = ("priority", "gang", "drf")
         "comparators", "queue_comparators", "overused_gate", "use_static",
         "n_queues", "weights", "enforce_pod_count", "window", "batch_runs",
         "sorted_jobs", "has_releasing", "step_kernel", "queue_delta",
-        "sig_compress", "mesh",
+        "sig_compress", "qfair_ladder", "mesh",
     ),
 )
 def fused_allocate(
@@ -214,6 +214,10 @@ def fused_allocate(
                                    #   (ops/sig_compress.py; read only under
                                    #   sig_compress — the [S, N] class static
                                    #   tensors index through it)
+    # qfair class ladder (docs/QUEUE_DELTA.md "Class-ladder solve"); [1, 1]
+    # dummies when qfair_ladder is False (the kernel never touches them then)
+    qfair_share: jnp.ndarray,      # f32 [Q, K] share at rung k placements
+    qfair_over: jnp.ndarray,       # bool [Q, K] overused at rung k placements
     *,
     comparators: Tuple[str, ...],
     queue_comparators: Tuple[str, ...] = (),
@@ -229,6 +233,7 @@ def fused_allocate(
     step_kernel: bool = False,
     queue_delta: bool = False,
     sig_compress: bool = False,
+    qfair_ladder: bool = False,
     mesh=None,
 ):
     n = idle.shape[0]
@@ -243,6 +248,14 @@ def fused_allocate(
     # at every queue pop.  Mirrors the mega kernel's scratch-row delta so
     # the two programs share one cost model and one kill-switch.
     use_queue_delta = queue_delta and track_queue_alloc
+    # Class-ladder refresh (docs/QUEUE_DELTA.md "Class-ladder solve"): when
+    # every queue holds a single request-signature class placed one copy at a
+    # time, a queue's share/overused trajectory is a function of its PLACEMENT
+    # COUNT alone — the host precomputed the whole [Q, K] ladder with the
+    # solve's arithmetic, and the per-pop refresh collapses from an O(R)
+    # chain recompute to two rung gathers.  The host only sets the flag when
+    # the engagement invariants hold (FusedAllocator._build_qfair_ladder).
+    use_ladder = qfair_ladder and use_queue_delta
     r_dim = resreq.shape[1]
 
     # Cursor-mode selection (single-queue + host-pre-sorted jobs): among
@@ -562,7 +575,7 @@ def fused_allocate(
         semantics are IDENTICAL to window=1 — this is pure unrolling; a
         micro-step whose job pool is exhausted is a masked no-op)."""
         (node_state, job_state, q_alloc, q_share, q_over, last_q, cur, out,
-         steps, cursor, n_dirty) = state
+         steps, cursor, n_dirty, q_count) = state
         idle = None if step_kernel else node_state[:, :r_dim]
 
         # Selection only runs when the previous pop ended (lax.cond, not
@@ -609,14 +622,24 @@ def fused_allocate(
             # instead).  Read-after-write from the live q_alloc keeps the
             # refreshed values bit-identical to a full recompute's.
             def _select_with_refresh():
-                a_row = q_alloc[last_q]
-                d_row = queue_deserved[last_q]
-                share_s, over_s = queue_share_overused(
-                    [d_row[r] for r in range(r_dim)],
-                    [a_row[r] for r in range(r_dim)],
-                    [mins[r] for r in range(r_dim)],
-                    r_dim,
-                )
+                if use_ladder:
+                    # Rung gather: the previous pop's queue sits at rung
+                    # q_count[last_q] of the precomputed ladder — the same
+                    # values a full chain recompute would produce, by the
+                    # ladder's exactness invariant (single class per queue,
+                    # unit placements), at O(1) per pop instead of O(R).
+                    rung = q_count[last_q]
+                    share_s = qfair_share[last_q, rung]
+                    over_s = qfair_over[last_q, rung]
+                else:
+                    a_row = q_alloc[last_q]
+                    d_row = queue_deserved[last_q]
+                    share_s, over_s = queue_share_overused(
+                        [d_row[r] for r in range(r_dim)],
+                        [a_row[r] for r in range(r_dim)],
+                        [mins[r] for r in range(r_dim)],
+                        r_dim,
+                    )
                 qs = q_share.at[last_q].set(share_s)
                 qo = q_over.at[last_q].set(over_s)
                 return select_job(job_state, q_alloc, qs, qo), qs, qo
@@ -889,7 +912,16 @@ def fused_allocate(
             # share/overused refresh is deferred to the next selection,
             # where it costs once per pop instead of once per step.
             q_idx = kern_qid if (step_kernel and mesh is not None) else job_queue[cur_safe]
-            q_alloc = q_alloc.at[q_idx].add(placed_copies * req)
+            if use_ladder:
+                # The ladder replaces the [Q, R] allocated ledger: the next
+                # refresh keys on the queue's placement COUNT, so the O(R)
+                # row add shrinks to one scalar counter bump (this is the
+                # per-step saving bench --mq measures).
+                q_count = q_count.at[q_idx].add(
+                    placed_copies.astype(jnp.int32)
+                )
+            else:
+                q_alloc = q_alloc.at[q_idx].add(placed_copies * req)
             if use_queue_delta:
                 last_q = q_idx
 
@@ -927,7 +959,7 @@ def fused_allocate(
                 cursor = cursor + jnp.where(cross_active, m - 1, 0)
 
         return (node_state, job_state, q_alloc, q_share, q_over, last_q, cur,
-                out, steps + 1, cursor, n_dirty)
+                out, steps + 1, cursor, n_dirty, q_count)
 
     def body(state):
         for _ in range(window):
@@ -935,7 +967,7 @@ def fused_allocate(
         return state
 
     def cond(state):
-        (_, job_state, _, _, _, _, cur, _, steps, cursor, n_dirty) = state
+        (_, job_state, _, _, _, _, cur, _, steps, cursor, n_dirty, _) = state
         if cursor_mode:
             # Scalar liveness: every eligible job is fresh (past the cursor),
             # dirty, or the one currently in-pop.
@@ -989,6 +1021,9 @@ def fused_allocate(
         jnp.zeros((), dtype=jnp.int32),
         jnp.zeros((), dtype=jnp.int32),  # cursor (first-visit position)
         jnp.zeros((), dtype=jnp.int32),  # dirty (re-eligible) job count
+        # Per-queue placement count: the ladder rung index (i32 stays exact
+        # where the f32 job_state counters would too; [Q] is tiny).
+        jnp.zeros(queue_rank.shape[0], dtype=jnp.int32),
     )
     final = jax.lax.while_loop(cond, body, init)
     return final[7][:t_cap]
@@ -1485,10 +1520,25 @@ class FusedAllocator:
             sig_host[:t_total] = self.sig_of_task
         queue_deserved = np.zeros((qb, r), dtype=np.float64)
         queue_alloc = np.zeros((qb, r), dtype=np.float64)
+        # --- qfair: solve evidence + class ladder (docs/QUEUE_DELTA.md
+        # "Class-ladder solve") -----------------------------------------------
+        from scheduler_tpu.ops import qfair as _qf
+
+        self.qfair_flavor = _qf.qfair_flavor()
+        self.qfair_ladder = False        # static flag the device program traces
+        self.qfair_reason = None         # why the ladder did not engage
+        self._qfair = {}                 # proportion's solve evidence block
+        self._ladder_host = None         # (share f32 [qb, K], over bool [qb, K])
+        self._ladder_ctx = None          # (req_rows, counts) for fair-row rebuilds
+        self._ladder_dev = None          # staged device twins (lazy)
         if self.queue_comparators or self.overused_gate:
             fair = ssn.device_queue_fair["proportion"](queue_names)
             queue_deserved[: len(queue_names)] = scale_columns(fair["deserved"], scale)
             queue_alloc[: len(queue_names)] = scale_columns(fair["allocated"], scale)
+            self._qfair = dict(fair.get("qfair", {}))
+            self._build_qfair_ladder(
+                policy, queue_deserved, queue_alloc, queues_idx, qb, r, scale
+            )
         self.enforce_pod_count = "pod_count" in ssn.device_dynamic_gates
 
         state = node_state_from_tensors(st, policy, nb)
@@ -1723,6 +1773,94 @@ class FusedAllocator:
         _, sids = np.unique(combined, return_inverse=True)  # densify
         return sids.astype(np.int32)
 
+    def _build_qfair_ladder(
+        self, policy, queue_deserved, queue_alloc, queues_idx, qb, r, scale
+    ) -> None:
+        """Admission + precompute for the class-ladder refresh (the qfair
+        engine half, docs/QUEUE_DELTA.md "Class-ladder solve").
+
+        The ladder is EXACT — not an approximation — precisely when every
+        queue's candidate tasks share ONE request-signature class and the
+        program places one copy per step: a queue's allocated row after k
+        placements is then the same f32 one-add-per-step fold the delta
+        chain would have run, as a pure function of k alone.  Each admission
+        check below guards one term of that invariant; a failed check
+        records the reason (``run_stats()['qfair']`` evidence) and keeps the
+        pre-existing delta chain."""
+        from scheduler_tpu.ops import qfair as _qf
+
+        t_total = self.flat_count
+        if not self.queue_delta:
+            self.qfair_reason = "queue delta chain disabled"
+            return
+        if self.qfair_flavor != "device":
+            self.qfair_reason = "SCHEDULER_TPU_QFAIR=host (kill-switch)"
+            return
+        if t_total == 0:
+            self.qfair_reason = "no pending tasks"
+            return
+        if self.has_releasing:
+            self.qfair_reason = "releasing capacity (pipeline arm)"
+            return
+        if self.batch_runs:
+            self.qfair_reason = "run batching (multi-copy placements)"
+            return
+        st = self.st
+        if self._req_sig_cache is not None:
+            # Hoisted by the sig-compression block: the SAME derivation
+            # (megakernel.request_signature_ids), computed once per build.
+            req_s, _, inverse, _ = self._req_sig_cache
+        else:
+            from scheduler_tpu.ops.megakernel import request_signature_ids
+
+            req_s = np.asarray(
+                scale_columns(st.tasks.resreq[:t_total], scale),
+                dtype=np.float32,
+            )
+            init_s = np.asarray(
+                scale_columns(st.tasks.init_resreq[:t_total], scale),
+                dtype=np.float32,
+            )
+            inverse, _ = request_signature_ids(req_s, init_s)
+        q_of_task = np.asarray(
+            queues_idx[st.tasks.job_idx[:t_total]], dtype=np.int64
+        )
+        ok, counts, _ = _qf.single_class_queues(inverse, q_of_task, qb)
+        if not ok:
+            self.qfair_reason = "mixed request classes within a queue"
+            return
+        k_n = int(counts.max(initial=0)) + 1
+        if k_n > _qf.LADDER_CAP:
+            self.qfair_reason = f"ladder depth {k_n} past cap {_qf.LADDER_CAP}"
+            return
+        req_rows = np.zeros((qb, r), dtype=np.float32)
+        # Any task of a queue represents its class (uniformity just
+        # checked); first-in-flat-order keeps the pick deterministic.
+        uq, first = np.unique(q_of_task, return_index=True)
+        req_rows[uq] = req_s[first]
+        mins_f32 = np.asarray(policy.scaled_mins(r), dtype=np.float32)
+        share, over = _qf.build_ladder(
+            np.asarray(queue_deserved, dtype=np.float32),
+            np.asarray(queue_alloc, dtype=np.float32),
+            req_rows, counts, mins_f32, r,
+        )
+        self.qfair_ladder = True
+        self._ladder_host = (share, over)
+        self._ladder_ctx = (req_rows, counts, mins_f32)
+
+    def _pack_mega_ladder(self):
+        """The ladder in the mega kernel's table layout: rung on sublanes
+        (padded to the 8-row tile), queue index on lanes, overused as f32
+        0/1 (the kernel's masked reduces are float)."""
+        l_share, l_over = self._ladder_host
+        q_n, k_n = l_share.shape
+        k_pad = -(-k_n // 8) * 8
+        qf_share = np.zeros((k_pad, 128), dtype=np.float32)
+        qf_share[:k_n, :q_n] = l_share.T
+        qf_over = np.zeros((k_pad, 128), dtype=np.float32)
+        qf_over[:k_n, :q_n] = l_over.T.astype(np.float32)
+        return qf_share, qf_over
+
     def _prepare_mega(self, policy, scale, state, node_gate, nb, tb, r,
                       offsets, nums, deficits, gang_order, priorities,
                       tiebreak, alloc_init, total, run_dev,
@@ -1839,13 +1977,28 @@ class FusedAllocator:
             jq_des[:r, :jb] = np.asarray(queue_deserved, dtype=np.float32)[jq].T
             jq_alloc0 = np.zeros((8, j_pad), dtype=np.float32)
             jq_alloc0[:r, :jb] = np.asarray(queue_alloc, dtype=np.float32)[jq].T
+            # Class-ladder tables for the kernel: rung on sublanes, queue
+            # INDEX on lanes (the index doubles as the rank the kernel
+            # reduces over), so the refresh is one dynamic sublane slice +
+            # a 128-lane masked reduce.  The lane layout caps engagement at
+            # 128 queues — past that the kernel keeps the delta chain,
+            # which is bitwise-identical anyway (docs/QUEUE_DELTA.md).
+            mega_ladder = (
+                self.qfair_ladder and queue_deserved.shape[0] <= 128
+            )
         else:
+            mega_ladder = False
             # Dummies: the kernel never reads these when multi_queue is False
             # (a separate trace), so keep them at the minimum tile width
             # instead of shipping dead [_, j_pad] VMEM inputs.
             jqueue = np.zeros((1, 128), dtype=np.int32)
             jq_des = np.zeros((8, 128), dtype=np.float32)
             jq_alloc0 = np.zeros((8, 128), dtype=np.float32)
+        if mega_ladder:
+            qf_share, qf_over = self._pack_mega_ladder()
+        else:
+            qf_share = np.zeros((8, 128), dtype=np.float32)
+            qf_over = np.zeros((8, 128), dtype=np.float32)
 
         ns0, rel_t = _mk.build_node_ledgers(
             state.idle, state.task_count, state.releasing, nb, r,
@@ -1903,6 +2056,8 @@ class FusedAllocator:
             to_device(jqueue),
             to_device(jq_des),
             to_device(jq_alloc0),
+            to_device(qf_share),
+            to_device(qf_over),
             to_device(misc),
         )
         mins_f32 = np.asarray(policy.scaled_mins(r), dtype=np.float32)
@@ -1938,6 +2093,7 @@ class FusedAllocator:
             queue_proportion="proportion" in self.queue_comparators,
             overused_gate=self.overused_gate,
             queue_delta=self.queue_delta,
+            qfair_ladder=mega_ladder,
             cohort=cohort_eff,
             t_cap=tb,
             mesh=mesh,
@@ -2079,6 +2235,15 @@ class FusedAllocator:
             # Same contract as queue_delta: the flavor selects which device
             # program this engine staged (docs/LP_PLACEMENT.md).
             return False
+        from scheduler_tpu.ops.qfair import qfair_flavor
+
+        if self.qfair_flavor != qfair_flavor():
+            # The flavor selects the solve AND whether the class ladder may
+            # be staged (docs/QUEUE_DELTA.md "Class-ladder solve"); pinned
+            # by the cache key's SCHEDULER_TPU_QFAIR component in the cached
+            # flow — this re-check covers direct update() callers (the
+            # stale-flavor rejection test in tests/test_qfair.py).
+            return False
         from scheduler_tpu.ops.sig_compress import sig_compress_mode
 
         if self.sig_mode != sig_compress_mode():
@@ -2182,6 +2347,9 @@ class FusedAllocator:
             # Allocated-at-open moves with the WHOLE cluster, not just this
             # engine's jobs — always recompute; the rows are [Q, R]-tiny.
             fair = builder(self.queue_uids)
+            # The refreshed solve's evidence replaces the build's — same
+            # seam run_stats publishes (docs/QUEUE_DELTA.md).
+            self._qfair = dict(fair.get("qfair", {}))
             qd_old, qa_old = self._host_queue_fair
             qd = np.zeros_like(qd_old)
             qa = np.zeros_like(qa_old)
@@ -2374,6 +2542,18 @@ class FusedAllocator:
         tc = self._dyn_dev["task_count"]
         r = int(self._scale.shape[0])
         qd, qa = self._host_queue_fair
+        if queue_changed and self.qfair_ladder:
+            # The ladder is a pure function of the fair rows (the class
+            # structure is pinned by the cache key): rebuild it from the
+            # refreshed rows with the same sequential fold the cold build
+            # ran, then restage wherever the stale twins sit below.
+            from scheduler_tpu.ops import qfair as _qf
+
+            req_rows, counts, mins_f32 = self._ladder_ctx
+            self._ladder_host = _qf.build_ladder(
+                qd.astype(np.float32), qa.astype(np.float32),
+                req_rows, counts, mins_f32, r,
+            )
         if self._args is not None:
             a = list(self._args)
             if self._mesh is not None:
@@ -2400,6 +2580,16 @@ class FusedAllocator:
                 else:
                     a[21] = to_device(qd, np.float32)
                     a[22] = to_device(qa, np.float32)
+                if self.qfair_ladder:
+                    qf_share, qf_over = self._ladder_host
+                    if self._mesh is not None:
+                        a[26] = to_device(qf_share, np.float32,
+                                          sharding=self._dyn_sharding_rep())
+                        a[27] = to_device(qf_over,
+                                          sharding=self._dyn_sharding_rep())
+                    else:
+                        a[26] = to_device(qf_share, np.float32)
+                        a[27] = to_device(qf_over)
             self._args = tuple(a)
         elif self._args_parts is not None:
             from scheduler_tpu.ops.placement import NodeState
@@ -2446,6 +2636,17 @@ class FusedAllocator:
                 else:
                     m[21] = to_device(jq_des)
                     m[22] = to_device(jq_alloc0)
+                if self._mega_kw.get("qfair_ladder"):
+                    # The ladder is a pure function of the fair-share rows
+                    # (and the static request classes) — rebuilt above, so
+                    # restage its mega packing alongside jq_des/jq_alloc0.
+                    qf_share, qf_over = self._pack_mega_ladder()
+                    if self._mesh is not None:
+                        m[23] = to_device(qf_share, sharding=rep)
+                        m[24] = to_device(qf_over, sharding=rep)
+                    else:
+                        m[23] = to_device(qf_share)
+                        m[24] = to_device(qf_over)
             self._mega_args = tuple(m)
 
     # -- capability probe ----------------------------------------------------
@@ -2559,6 +2760,16 @@ class FusedAllocator:
                 run_dev,
                 to_device(sig_host),
             )
+            # Trailing qfair ladder twins ([1, 1] dummies when the ladder
+            # did not engage — the traced program never touches them then).
+            if self._ladder_host is not None:
+                qf_share, qf_over = self._ladder_host
+            else:
+                qf_share = np.zeros((1, 1), dtype=np.float32)
+                qf_over = np.zeros((1, 1), dtype=bool)
+            args = args + (
+                to_device(qf_share, np.float32), to_device(qf_over),
+            )
             if self._mesh is not None:
                 from scheduler_tpu.ops.mesh import shard_fused_args
 
@@ -2653,6 +2864,7 @@ class FusedAllocator:
                 step_kernel=self.step_kernel,
                 queue_delta=self.queue_delta,
                 sig_compress=self.sig_compress and self.use_static,
+                qfair_ladder=self.qfair_ladder,
                 mesh=self._mesh,
             )
 
@@ -2728,6 +2940,7 @@ class FusedAllocator:
                 step_kernel=False,
                 queue_delta=self.queue_delta,
                 sig_compress=self.sig_compress,
+                qfair_ladder=self.qfair_ladder,
                 mesh=self._mesh,
             )
 
@@ -2767,6 +2980,7 @@ class FusedAllocator:
             ("step_kernel", self.step_kernel),
             ("queue_delta", self.queue_delta),
             ("sig_compress", self.sig_compress and self.use_static),
+            ("qfair_ladder", self.qfair_ladder),
             ("mesh", self._mesh),
         )
         if not self.use_lp:
@@ -2916,6 +3130,24 @@ class FusedAllocator:
                 "queues": len(self.queue_uids),
                 "mode": "delta" if self.queue_delta else "full",
             }
+            # qfair evidence (docs/QUEUE_DELTA.md "Class-ladder solve"):
+            # the proportion solve's block (flavor, solve wall, iterations,
+            # converged_at) plus this engine's ladder engagement — the
+            # bench's ``detail.cycles[].qfair`` payload scripts/bench_gate.py
+            # judges (engaged must carry iterations + converged_at;
+            # not-engaged must carry the reason).
+            qf = dict(self._qfair)
+            qf["engaged"] = bool(self.qfair_ladder)
+            if self.qfair_ladder:
+                share, _ = self._ladder_host
+                qf["rungs"] = int(share.shape[1])
+                qf["classes"] = len(self.queue_uids)
+                # Mega reports its counted rung gathers below; the XLA loop
+                # has no device counter — 0 means "engaged, uncounted".
+                qf.setdefault("ladder_lookups", 0)
+            elif self.qfair_reason:
+                qf["reason"] = self.qfair_reason
+            out["qfair"] = qf
         enc = self._encoded
         if enc is not None:
             t = self.flat_count
@@ -2993,6 +3225,10 @@ class FusedAllocator:
                 out["queue_chain"]["full_recomputes"] = int(
                     raw[STATS.QFULL_RECOMPUTES]
                 )
+                if self.qfair_ladder and "qfair" in out:
+                    out["qfair"]["ladder_lookups"] = int(
+                        raw[STATS.QFAIR_LOOKUPS]
+                    )
         return out
 
     def _execute(self) -> np.ndarray:

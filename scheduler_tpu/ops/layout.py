@@ -55,6 +55,7 @@ class JOB_SCRATCH:
     QUEUE_ALLOC = 16  # span 8: live allocated of the job's QUEUE, per lane
     SHARE = 24       # maintained share of the lane's queue (delta chain)
     OVERUSED = 25    # maintained overused flag of the lane's queue
+    QCOUNT = 26      # cumulative placements of the lane's queue (qfair ladder)
 
 
 class STATS:
@@ -67,7 +68,8 @@ class STATS:
     CHUNK_PLACED = 2      # placements made by chunks >= 1 (multi-node wins)
     QDELTA_UPDATES = 3    # queue-share delta updates applied (delta chain)
     QFULL_RECOMPUTES = 4  # full queue-chain recomputes (kill-switch path)
-    UNUSED = 5            # span 3: zeroed tail, reserved
+    QFAIR_LOOKUPS = 5     # class-ladder share/overused lookups (qfair ladder)
+    UNUSED = 6            # span 2: zeroed tail, reserved
 
 
 STATS_WIDTH = 8
@@ -82,6 +84,18 @@ class LP_PACK:
     SUM = 1      # per-pod local sum-exp at the local max
     ARGMAX = 2   # per-pod local best node, as a GLOBAL index (f32-exact)
     UPD = 3      # previous projection-update max, broadcast along the row
+
+
+class QFAIR_STATS:
+    """Queue-fair water-fill evidence row (``ops/qfair.py``, i32[2]):
+    returned by the fixed-iteration deserved solve, decoded host-side by
+    ``qfair.qfair_stats_dict`` into the plugin's evidence block and the
+    bench ``detail.cycles[].qfair`` chain (docs/QUEUE_DELTA.md
+    "Class-ladder solve")."""
+
+    ITERATIONS = 0    # water-fill rounds executed (always the fixed budget)
+    CONVERGED_AT = 1  # round the host loop would have broken on (-1: the
+                      # budget ran out — the plugin falls back to host)
 
 
 class LP_STATS:
@@ -148,7 +162,7 @@ class WINNER:
 SPANS = {
     "NODE_SCRATCH": {"IDLE": 8, "RELEASING": 8},
     "JOB_SCRATCH": {"DRF": 8, "QUEUE_ALLOC": 8},
-    "STATS": {"UNUSED": 3},
+    "STATS": {"UNUSED": 2},
     "SIG_REQ": {"REQ": 8, "INIT": 8},
     "JOB_STATE": {"DRF": 8},
 }
@@ -165,6 +179,7 @@ FLAVOR_FLAGS = (
     "multi_queue", "use_qdelta", "queue_proportion", "overused_gate",
     "has_releasing", "use_static", "batch_runs", "cross_batch",
     "score_bound", "enforce_pod_count", "step_kernel", "cursor_mode",
+    "qfair_ladder",
 )
 
 # Liveness: the flags that must ALL be true for a row to exist on a flavor's
@@ -178,6 +193,7 @@ LIVE_WHEN = {
         "QUEUE_ALLOC": ("multi_queue",),
         "SHARE": ("use_qdelta", "queue_proportion"),
         "OVERUSED": ("use_qdelta", "overused_gate"),
+        "QCOUNT": ("use_qdelta", "qfair_ladder"),
     },
 }
 
@@ -202,6 +218,9 @@ BUFFERS = {
     },
     "ops/sig_compress.py": {
         "key_cols": ("SIG_CLASS", 1),
+    },
+    "ops/qfair.py": {
+        "qf_raw": ("QFAIR_STATS", 0),
     },
     "ops/pallas_kernels.py": {
         "ns_ref": ("STEP_NODE", 0),
@@ -233,6 +252,7 @@ STATS_KEYS = {
     "CHUNK_PLACED": ("cohort", "chunk_placed"),
     "QDELTA_UPDATES": ("queue_chain", "delta_updates"),
     "QFULL_RECOMPUTES": ("queue_chain", "full_recomputes"),
+    "QFAIR_LOOKUPS": ("qfair", "ladder_lookups"),
 }
 
 # Generated documentation tables: {doc path: (namespaces...)} — rendered by
@@ -262,6 +282,8 @@ DOC_ROWS = {
         "SHARE": "maintained share of the lane's queue (delta path)",
         "OVERUSED": "maintained overused flag of the lane's queue "
                     "(delta path)",
+        "QCOUNT": "cumulative placements of the lane's queue (qfair "
+                  "class-ladder index; `qfair_ladder` sessions only)",
     },
     "STATS": {
         "STEPS": "loop steps taken",
@@ -270,6 +292,8 @@ DOC_ROWS = {
         "QDELTA_UPDATES": "queue-share delta updates applied (delta chain "
                           "engaged)",
         "QFULL_RECOMPUTES": "full queue-chain recomputes (kill-switch path)",
+        "QFAIR_LOOKUPS": "class-ladder share/overused lookups "
+                         "(docs/QUEUE_DELTA.md \"Class-ladder solve\")",
         "UNUSED": "zeroed tail, reserved",
     },
 }
@@ -474,6 +498,32 @@ SHARD_SITES = {
                 "replicated", "replicated", "replicated"),
         "carry": ((0, 0), (1, 1), (2, 2)),
     },
+    # Queue-fair deserved solve (ops/qfair.py, docs/QUEUE_DELTA.md
+    # "Class-ladder solve"): the [Q, R] water-fill operands and outputs are
+    # tiny and fully REPLICATED — every chip runs the identical fixed-
+    # iteration fold, so the solve adds zero ICI traffic.  The stacked
+    # twins run K fleets' solves as lax.map lanes of the same body
+    # (ops/tenant.py idiom), same replication contract.
+    "ops/qfair.py::_qfair_solve_1d": {
+        "in": ("replicated", "replicated", "replicated", "replicated",
+               "replicated", "replicated"),
+        "out": ("replicated", "replicated", "replicated"),
+    },
+    "ops/qfair.py::_qfair_solve_2d": {
+        "in": ("replicated", "replicated", "replicated", "replicated",
+               "replicated", "replicated"),
+        "out": ("replicated", "replicated", "replicated"),
+    },
+    "ops/qfair.py::_qfair_stacked_1d": {
+        "in": ("replicated", "replicated", "replicated", "replicated",
+               "replicated", "replicated"),
+        "out": ("replicated", "replicated", "replicated"),
+    },
+    "ops/qfair.py::_qfair_stacked_2d": {
+        "in": ("replicated", "replicated", "replicated", "replicated",
+               "replicated", "replicated"),
+        "out": ("replicated", "replicated", "replicated"),
+    },
 }
 
 # Per-site collective budget in the COMPILED HLO, counted per loop step
@@ -546,6 +596,22 @@ COLLECTIVE_BUDGET = {
     },
     "ops/sharded.py::_tenant_scan_2d": {
         "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
+    },
+    # Queue-fair solve twins: fully replicated [Q, R] operands, so the
+    # compiled program holds ZERO collectives on both mesh shapes — the
+    # one-all-gather-per-step placement budget is untouched by the solve
+    # (verified: shard_budget on both mesh shapes).
+    "ops/qfair.py::_qfair_solve_1d": {
+        "all-gather": 0, "all-reduce": 0, "collective-permute": 0,
+    },
+    "ops/qfair.py::_qfair_solve_2d": {
+        "all-gather": 0, "all-reduce": 0, "collective-permute": 0,
+    },
+    "ops/qfair.py::_qfair_stacked_1d": {
+        "all-gather": 0, "all-reduce": 0, "collective-permute": 0,
+    },
+    "ops/qfair.py::_qfair_stacked_2d": {
+        "all-gather": 0, "all-reduce": 0, "collective-permute": 0,
     },
 }
 

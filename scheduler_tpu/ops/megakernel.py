@@ -175,7 +175,7 @@ def mega_supported(
         "cross_batch", "batch_runs", "has_releasing", "use_static",
         "score_bound", "mins", "cpu_idx", "mem_idx",
         "multi_queue", "queue_proportion", "overused_gate", "queue_delta",
-        "cohort", "t_cap", "mesh", "interpret",
+        "qfair_ladder", "cohort", "t_cap", "mesh", "interpret",
     ),
 )
 def mega_allocate(
@@ -204,6 +204,11 @@ def mega_allocate(
                              #   in rank order, fused.py queue_rank = arange)
     jq_des: jnp.ndarray,     # f32 [8, J] deserved of the job's queue
     jq_alloc0: jnp.ndarray,  # f32 [8, J] queue allocated at open, per job
+    qf_share: jnp.ndarray,   # f32 [K_pad, 128] qfair ladder: share at rung k
+                             #   (queues on lanes; [8, 128] zeros when the
+                             #   ladder is off — never read then)
+    qf_over: jnp.ndarray,    # f32 [K_pad, 128] qfair ladder: overused at rung
+                             #   k as 0.0/1.0 (same layout)
     misc: jnp.ndarray,       # i32 [1, 8] SMEM: [n_real, ...]
     *,
     r_dim: int,
@@ -223,6 +228,7 @@ def mega_allocate(
     overused_gate: bool,
     interpret: bool,
     queue_delta: bool = True,
+    qfair_ladder: bool = False,
     cohort: int = 1,
     t_cap: int = 0,
     mesh=None,
@@ -254,7 +260,7 @@ def mega_allocate(
                tsig_ref, rlen_ref, joff_ref, jnum_ref, jdef_ref, jgang_ref,
                jprio_ref, jtb_ref, jdrf0_ref, dsafe_ref, dmask_ref,
                msig_ref, smask_ref, sscore_ref, jq_ref, jqd_ref, jqa0_ref,
-               misc_ref, out_ref, stats_ref, ns, js):
+               qfs_ref, qfo_ref, misc_ref, out_ref, stats_ref, ns, js):
         neg_inf = float("-inf")
         pos_inf = float("inf")
         lane_n = _lane_iota((1, n))
@@ -296,6 +302,13 @@ def mega_allocate(
                 js[JROW.SHARE : JROW.SHARE + 1, :] = share0
             if overused_gate:
                 js[JROW.OVERUSED : JROW.OVERUSED + 1, :] = over0.astype(jnp.float32)
+        if use_qdelta and qfair_ladder:
+            # Class-ladder rung counter (docs/QUEUE_DELTA.md "Class-ladder
+            # solve"): cumulative placements of the lane's queue — the f32
+            # twin of the XLA carry's q_count (exact below 2^24).
+            js[JROW.QCOUNT : JROW.QCOUNT + 1, :] = jnp.zeros(
+                (1, j_pad), jnp.float32
+            )
         out_ref[:, :] = jnp.full((t_sub, 128), UNPLACED, jnp.int32)
 
         n_real = misc_ref[0, 0]
@@ -328,7 +341,8 @@ def mega_allocate(
                                      jnp.int32(-_BIG_I32 - 1)))
 
         def body(state):
-            cur, cursor, n_dirty, steps, coh_steps, chunk_pl, qd_evt = state
+            (cur, cursor, n_dirty, steps, coh_steps, chunk_pl, qd_evt,
+             qf_evt) = state
 
             # ---- selection (branchless; matches fused.py cursor mode, or
             # its full queue+job chain in multi-queue mode) ----
@@ -482,6 +496,7 @@ def mega_allocate(
             coh_steps2 = coh_steps
             chunk_pl2 = chunk_pl
             qd_evt2 = qd_evt
+            qf_evt2 = qf_evt
 
             for c in range(cohort):
                 # ---- fit + score + masked argmax (rows unrolled) ----
@@ -721,12 +736,54 @@ def mega_allocate(
                     q_sel = read_i32(jq_v, lane_j, jb)
                     qwin_b = jq_v == q_sel
                     qwin = qwin_b.astype(jnp.float32)
-                    for r in range(r_dim):
-                        js[JROW.QUEUE_ALLOC + r : JROW.QUEUE_ALLOC + r + 1, :] = (
-                            js[JROW.QUEUE_ALLOC + r : JROW.QUEUE_ALLOC + r + 1, :]
-                            + (reqs[r] * drf_scale) * qwin
+                    if use_qdelta and qfair_ladder:
+                        # Class-ladder refresh (docs/QUEUE_DELTA.md
+                        # "Class-ladder solve"): with one request class per
+                        # queue and unit placements, the queue's post-update
+                        # share/overused sit at rung `count` of the
+                        # precomputed ladder — a scalar counter bump + one
+                        # dynamic sublane slice + two masked reduces replace
+                        # the O(R) ledger adds and the O(R) scalar chain
+                        # below.  Bit-identical by the ladder's exactness
+                        # invariant (host fold mirrors the same arithmetic).
+                        js[JROW.QCOUNT : JROW.QCOUNT + 1, :] = (
+                            js[JROW.QCOUNT : JROW.QCOUNT + 1, :]
+                            + drf_scale * qwin
                         )
-                    if use_qdelta:
+                        rung = read_f32(
+                            js[JROW.QCOUNT : JROW.QCOUNT + 1, :], lane_j, jb
+                        ).astype(jnp.int32)
+                        qf_srow = qfs_ref[pl.ds(rung, 1), :]
+                        qf_orow = qfo_ref[pl.ds(rung, 1), :]
+                        share_new = jnp.sum(
+                            jnp.where(lane_w == q_sel, qf_srow, 0.0)
+                        )
+                        over_new_f = jnp.sum(
+                            jnp.where(lane_w == q_sel, qf_orow, 0.0)
+                        )
+                        if queue_proportion:
+                            js[JROW.SHARE : JROW.SHARE + 1, :] = jnp.where(
+                                qwin_b, share_new,
+                                js[JROW.SHARE : JROW.SHARE + 1, :],
+                            )
+                        if overused_gate:
+                            js[JROW.OVERUSED : JROW.OVERUSED + 1, :] = jnp.where(
+                                qwin_b, over_new_f,
+                                js[JROW.OVERUSED : JROW.OVERUSED + 1, :],
+                            )
+                        # Evidence: rung gathers serving real placements
+                        # (the counter STATS.QFAIR_LOOKUPS publishes as
+                        # run_stats qfair.ladder_lookups).
+                        qf_evt2 = qf_evt2 + (
+                            act & (alloc_here | pipe_here)
+                        ).astype(jnp.int32)
+                    else:
+                        for r in range(r_dim):
+                            js[JROW.QUEUE_ALLOC + r : JROW.QUEUE_ALLOC + r + 1, :] = (
+                                js[JROW.QUEUE_ALLOC + r : JROW.QUEUE_ALLOC + r + 1, :]
+                                + (reqs[r] * drf_scale) * qwin
+                            )
+                    if use_qdelta and not qfair_ladder:
                         # Delta refresh of the maintained share/overused rows
                         # for EXACTLY the queue this placement touched (only
                         # the winning job's queue ledger moved — every other
@@ -861,10 +918,10 @@ def mega_allocate(
                     act = act_next
 
             return (cur_r, cursor_r, dirty_r, steps + 1, coh_steps2,
-                    chunk_pl2, qd_evt2)
+                    chunk_pl2, qd_evt2, qf_evt2)
 
         def cond(state):
-            cur, cursor, n_dirty, steps, _coh, _cpl, _qd = state
+            cur, cursor, n_dirty, steps, _coh, _cpl, _qd, _qf = state
             if multi_queue:
                 # No cursor liveness to consult: the body's selection step
                 # discovers exhaustion itself (chain -> HALT), costing at
@@ -879,7 +936,7 @@ def mega_allocate(
         final = jax.lax.while_loop(
             cond, body,
             (jnp.int32(-1), jnp.int32(0), jnp.int32(0), jnp.int32(0),
-             jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+             jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0)),
         )
         stats_ref[0, STATS.STEPS] = final[3]
         stats_ref[0, STATS.COHORT_STEPS] = final[4]
@@ -892,6 +949,10 @@ def mega_allocate(
             stats_ref[0, STATS.QFULL_RECOMPUTES] = final[3]
         else:
             stats_ref[0, STATS.QFULL_RECOMPUTES] = jnp.int32(0)
+        if use_qdelta and qfair_ladder:
+            stats_ref[0, STATS.QFAIR_LOOKUPS] = final[7]
+        else:
+            stats_ref[0, STATS.QFAIR_LOOKUPS] = jnp.int32(0)
         for i in range(STATS.UNUSED, STATS_WIDTH):
             stats_ref[0, i] = jnp.int32(0)
 
@@ -902,7 +963,7 @@ def mega_allocate(
             jax.ShapeDtypeStruct((1, STATS_WIDTH), jnp.int32),
         ),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(23)
+            pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(25)
         ] + [pl.BlockSpec(memory_space=pltpu.SMEM)],
         # Evidence counters are scalars — SMEM, like the step kernel's
         # scalar outputs (mosaic rejects scalar stores to VMEM refs).
@@ -928,7 +989,7 @@ def mega_allocate(
         ns0, alloc_t, rel0, gate, plim, sig_req, task_sig, run_len,
         job_off, job_num, job_deficit, job_gang, job_prio, job_tb,
         js_drf0, drf_safe, drf_mask, msig, smask, sscore,
-        jqueue, jq_des, jq_alloc0, misc,
+        jqueue, jq_des, jq_alloc0, qf_share, qf_over, misc,
     )
     if mesh is not None:
         # Mesh mode: the whole-loop kernel runs REPLICATED — every chip

@@ -1,0 +1,451 @@
+"""Device-side convex queue-share solve over (queue, signature) classes.
+
+The proportion plugin's deserved-share fixed point used to be a host-side
+Python ``while True`` water-fill over queues x resources at every session
+open (``plugins/proportion.py``), and every device flavor then *maintained*
+the resulting share/overused chain step by step (JOB_SCRATCH rows 24/25,
+the XLA carry's q_share/q_over) — per-step cost growing with vocab width R.
+This module recasts both halves as small device programs
+(docs/QUEUE_DELTA.md "Class-ladder solve"; CvxCluster, PAPERS
+arxiv 2605.01614 — granular allocation collapses when identical demands
+fold into classes):
+
+(a) **The deserved fixed point** runs as a fixed-iteration-count batched
+    water-fill (``qfair_solve``) under 64-bit jax — the ``lp_place.py``
+    Sinkhorn precedent: a fixed ``fori_loop`` round count keeps the output
+    bitwise deterministic, rounds after convergence are masked no-ops, and
+    ``converged_at`` is evidence, not control flow.  Every float fold that
+    is order-dependent on the host (the weight sum, the increased/decreased
+    accumulation) runs as a SEQUENTIAL per-queue fold in dict order, so the
+    result is bit-identical to the host loop — which stays in-tree as the
+    ``SCHEDULER_TPU_QFAIR=host`` kill-switch and parity oracle.
+
+(b) **The per-(queue, signature)-class share/overused ladder**
+    (``build_ladder``): when every queue's candidate tasks share ONE
+    request-signature class and placements are unit-sized, the queue's
+    allocated trajectory is a pure function of its cumulative placement
+    COUNT — so the whole share/overused chain is precomputable as a ladder
+    indexed by that count.  Rung k's allocated row is built by the same
+    sequential f32 adds the engines perform (``np.add.accumulate`` is
+    strictly sequential — the ``proportion.py`` reclaimable-chain
+    precedent), and each rung's share/overused values mirror
+    ``pallas_kernels.queue_share_overused`` arithmetic exactly, so a ladder
+    LOOKUP is bit-identical to the delta-maintained chain value it
+    replaces.  The mega kernel and the fused.py XLA loop then index the
+    ladder instead of delta-maintaining full-width chain rows per step
+    (~O(R) vector ops -> O(1) lookups; the engagement conditions and the
+    exactness invariant are documented in docs/QUEUE_DELTA.md).
+
+Multi-tenant cycles batch K fleets' solves into ONE dispatch
+(``qfair_solve_stacked`` — a ``lax.map`` lane per fleet, the
+``ops/tenant.py`` idiom).  On a mesh the solve runs through the literal
+1-D/2-D replicated twins below, declared in ``ops/layout.py``
+SHARD_SITES/COLLECTIVE_BUDGET with a ZERO-collective budget ([Q, R] is
+tiny and fully replicated), so the one-collective-per-step budget of the
+placement scan is untouched.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scheduler_tpu.ops.layout import QFAIR_STATS
+
+# Ladder depth admission cap (rungs per queue, VMEM-bound on the mega
+# kernel: two f32 [rungs, 128] tables).  Deeper queues keep the delta
+# chain — "when delta-maintenance still wins", docs/QUEUE_DELTA.md.
+LADDER_CAP = 1024
+
+
+# -- knobs (registered in engine_cache._ENV_KEYS: they select the traced
+#    program / the staged ladder tensors) -------------------------------------
+
+def qfair_flavor() -> str:
+    """``SCHEDULER_TPU_QFAIR``: ``device`` (default — this module's
+    fixed-iteration solve + class ladder) or ``host`` (the plugin's Python
+    water-fill and the delta-maintained chain, bitwise pre-existing
+    behavior — the kill-switch and parity oracle)."""
+    from scheduler_tpu.utils.envflags import env_str
+
+    return env_str("SCHEDULER_TPU_QFAIR", "device", choices=("device", "host"))
+
+
+def qfair_iters() -> int:
+    """``SCHEDULER_TPU_QFAIR_ITERS``: fixed water-fill round count (0 =
+    auto: Q + 4 — each productive round caps at least one queue or drains
+    the pool, so Q + 4 covers every convergent instance with margin).
+    Fixed count => bitwise-deterministic output; if the solve has not
+    converged within the budget the plugin falls back to the host loop
+    (recorded in the evidence block), so a too-small value degrades to
+    host cost, never to wrong shares."""
+    from scheduler_tpu.utils.envflags import env_int
+
+    return env_int("SCHEDULER_TPU_QFAIR_ITERS", 0, minimum=0, maximum=10_000)
+
+
+# -- the fixed-iteration water-fill ------------------------------------------
+
+def _solve_core(weights, request, total, req_hs, total_hs, mins, *, iters):
+    """One fleet's water-fill: f64 operands, fixed ``iters`` rounds.
+
+    Reproduces ``plugins/proportion.py`` round for round: the unmet-weight
+    sum and the increased/decreased accumulations fold SEQUENTIALLY in
+    queue order (the host's dict order), the request-cap test replicates
+    ``ResourceVec.less`` including its scalar-map-presence branch (the
+    ``has_scalars`` lanes), and the pool drain test is ``is_empty``'s
+    per-dim epsilon rule.  Rounds after the host loop would have broken
+    are masked no-ops.  Returns ``(deserved [Q, R], met [Q],
+    qf_raw i32[2])`` — ``qf_raw`` is the QFAIR_STATS evidence row
+    (``converged_at`` -1: the budget ran out before the fixed point)."""
+    q_n, r_n = request.shape
+    f = request.dtype
+
+    def round_body(_i, carry):
+        deserved, d_hs, met, remaining, rem_hs, done, rounds = carry
+        # Sequential unmet-weight fold in queue order (Python float sums
+        # are associativity-sensitive; a tree reduce would not be bitwise).
+        def w_body(qi, acc):
+            return acc + jnp.where(met[qi], f.type(0), weights[qi])
+
+        tw = jax.lax.fori_loop(0, q_n, w_body, f.type(0))
+        zero_w = tw == 0
+        active = (~done) & (~zero_w)
+        tw_safe = jnp.where(zero_w, f.type(1), tw)
+        # Runtime 0.0 that neither XLA nor LLVM may fold away (x - x is not
+        # simplifiable for floats under NaN semantics).  Used below to make
+        # the grant arithmetic FMA-immune — see the comment at the use site.
+        fzero = tw_safe - tw_safe
+
+        def q_body(qi, inner):
+            deserved, d_hs, met, inc, dec = inner
+            run = active & (~met[qi])
+            old = deserved[qi]
+            # The `+ fzero` is load-bearing: without it LLVM contracts
+            # `old + remaining*ratio` into an FMA inside the compiled loop
+            # body (single rounding), drifting ~1 ulp off the host loop's
+            # separately-rounded `remaining.multi(w/tw)` then `add`.
+            # Neither optimization_barrier nor a select blocks that (both
+            # lower to forms instcombine sees through).  Adding the opaque
+            # runtime zero is FMA-immune BY CONSTRUCTION: if the compiler
+            # contracts `prod + fzero` it computes fma(a, b, 0) — exactly
+            # the correctly-rounded product — and either way `grant` is
+            # produced by an add, so `old + grant` has no fadd(fmul)
+            # pattern left to contract.
+            grant = remaining * (weights[qi] / tw_safe) + fzero
+            new_d = old + grant
+            new_hs = d_hs[qi] | rem_hs
+            # ResourceVec.less(request, new_deserved): strict cpu/mem,
+            # then the scalar-map-presence branch.
+            strict = (request[qi, 0] < new_d[0]) & (request[qi, 1] < new_d[1])
+            scalar_ok = jnp.all(
+                jnp.where(request[qi, 2:] != 0, request[qi, 2:] < new_d[2:], True)
+            )
+            capped = jnp.where(req_hs[qi], scalar_ok, new_hs) & strict
+            cap_d = jnp.minimum(new_d, request[qi])
+            sel_d = jnp.where(capped, cap_d, new_d)
+            sel_hs = jnp.where(capped, jnp.any(cap_d[2:] != 0), new_hs)
+            fin_d = jnp.where(run, sel_d, old)
+            delta = fin_d - old
+            # Sequential increased/decreased folds (ResourceVec.diff +
+            # .add per queue, in queue order).
+            inc = inc + jnp.where(delta > 0, delta, f.type(0))
+            dec = dec + jnp.where(delta < 0, -delta, f.type(0))
+            return (
+                deserved.at[qi].set(fin_d),
+                d_hs.at[qi].set(jnp.where(run, sel_hs, d_hs[qi])),
+                met.at[qi].set(met[qi] | (run & capped)),
+                inc,
+                dec,
+            )
+
+        deserved, d_hs, met, inc, dec = jax.lax.fori_loop(
+            0, q_n, q_body,
+            (deserved, d_hs, met, jnp.zeros((r_n,), f), jnp.zeros((r_n,), f)),
+        )
+        rem2 = (remaining - inc) + dec
+        rem_hs2 = rem_hs | jnp.any(dec[2:] != 0)
+        empty = jnp.all(rem2 < mins)
+        remaining = jnp.where(active, rem2, remaining)
+        rem_hs = jnp.where(active, rem_hs2, rem_hs)
+        rounds = rounds + active.astype(jnp.int32)
+        done = done | zero_w | (active & empty)
+        return deserved, d_hs, met, remaining, rem_hs, done, rounds
+
+    init = (
+        jnp.zeros((q_n, r_n), f),
+        jnp.zeros((q_n,), bool),
+        jnp.zeros((q_n,), bool),
+        total,
+        total_hs,
+        jnp.asarray(False),
+        jnp.int32(0),
+    )
+    deserved, _d_hs, met, _rem, _rhs, done, rounds = jax.lax.fori_loop(
+        0, iters, round_body, init
+    )
+    qf_raw = jnp.zeros((2,), jnp.int32)
+    qf_raw = qf_raw.at[QFAIR_STATS.ITERATIONS].set(iters)
+    qf_raw = qf_raw.at[QFAIR_STATS.CONVERGED_AT].set(
+        jnp.where(done, rounds, -1)
+    )
+    return deserved, met, qf_raw
+
+
+@functools.partial(jax.jit, static_argnames=("iters", "mesh"))
+def qfair_solve(weights, request, total, req_hs, total_hs, mins, *,
+                iters: int, mesh=None):
+    """Solve one fleet's deserved fixed point (see ``_solve_core``).  On a
+    mesh the tiny replicated program runs through the literal 1-D/2-D
+    twins so the budget gate can lower and count it (zero collectives)."""
+    if mesh is None:
+        return _solve_core(
+            weights, request, total, req_hs, total_hs, mins, iters=iters
+        )
+    from scheduler_tpu.ops.sharded import is_multi_host
+
+    solve = _qfair_solve_2d if is_multi_host(mesh) else _qfair_solve_1d
+    return solve(
+        functools.partial(_solve_core, iters=iters), mesh,
+        weights, request, total, req_hs, total_hs, mins,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("iters", "mesh"))
+def qfair_solve_stacked(weights, request, total, req_hs, total_hs, mins, *,
+                        iters: int, mesh=None):
+    """K same-shape fleets' solves in ONE dispatch: each fleet rides a
+    ``lax.map`` lane of the SAME round body, so lane k's arithmetic —
+    and therefore its deserved tensor — is bitwise the solo solve's
+    (pinned by test).  The ``ops/tenant.py`` stacked-cycle idiom: batching
+    widens the payload, never the program count."""
+
+    def lane(args):
+        w_k, req_k, tot_k, rhs_k, ths_k = args
+        return _solve_core(w_k, req_k, tot_k, rhs_k, ths_k, mins, iters=iters)
+
+    if mesh is None:
+        return jax.lax.map(lane, (weights, request, total, req_hs, total_hs))
+    from scheduler_tpu.ops.sharded import is_multi_host
+
+    solve = (
+        _qfair_stacked_2d if is_multi_host(mesh) else _qfair_stacked_1d
+    )
+    return solve(lane, mesh, weights, request, total, req_hs, total_hs, mins)
+
+
+# The 1-D/2-D twins are DISTINCT literal shard_map sites on purpose (the
+# ops/sharded.py rule): schedlint's sharding pass extracts each P(...) and
+# checks it against its own SHARD_SITES entry, and scripts/shard_budget.py
+# lowers each and counts collectives in the compiled HLO against
+# COLLECTIVE_BUDGET — a computed spec would be invisible to both gates.
+# Everything replicates ([Q, R] is tiny), so the budget is ZERO collectives:
+# the solve adds no ICI traffic to the one-all-gather-per-step contract.
+
+def _qfair_solve_1d(solve_fn, mesh, *operands):
+    from jax.sharding import PartitionSpec as _P
+
+    from scheduler_tpu.ops.sharded import shard_map as _shard_map
+
+    return _shard_map(
+        solve_fn,
+        mesh=mesh,
+        in_specs=(_P(), _P(), _P(), _P(), _P(), _P()),
+        out_specs=(_P(), _P(), _P()),
+        check_vma=False,
+    )(*operands)
+
+
+def _qfair_solve_2d(solve_fn, mesh, *operands):
+    from jax.sharding import PartitionSpec as _P
+
+    from scheduler_tpu.ops.sharded import shard_map as _shard_map
+
+    return _shard_map(
+        solve_fn,
+        mesh=mesh,
+        in_specs=(_P(), _P(), _P(), _P(), _P(), _P()),
+        out_specs=(_P(), _P(), _P()),
+        check_vma=False,
+    )(*operands)
+
+
+def _qfair_stacked_1d(lane_fn, mesh, *operands):
+    from jax.sharding import PartitionSpec as _P
+
+    from scheduler_tpu.ops.sharded import shard_map as _shard_map
+
+    def body(w, req, tot, rhs, ths, _mins):
+        return jax.lax.map(lane_fn, (w, req, tot, rhs, ths))
+
+    return _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(_P(), _P(), _P(), _P(), _P(), _P()),
+        out_specs=(_P(), _P(), _P()),
+        check_vma=False,
+    )(*operands)
+
+
+def _qfair_stacked_2d(lane_fn, mesh, *operands):
+    from jax.sharding import PartitionSpec as _P
+
+    from scheduler_tpu.ops.sharded import shard_map as _shard_map
+
+    def body(w, req, tot, rhs, ths, _mins):
+        return jax.lax.map(lane_fn, (w, req, tot, rhs, ths))
+
+    return _shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(_P(), _P(), _P(), _P(), _P(), _P()),
+        out_specs=(_P(), _P(), _P()),
+        check_vma=False,
+    )(*operands)
+
+
+# -- host entry (plugins/proportion.py) ---------------------------------------
+
+def solve_deserved(
+    weights: np.ndarray,       # f64 [Q]   queue weights, dict order
+    request: np.ndarray,       # f64 [Q, R] per-queue aggregate request
+    total: np.ndarray,         # f64 [R]   cluster total (the pool)
+    req_has_scalars: np.ndarray,  # bool [Q] request scalar-map presence
+    total_has_scalars: bool,   # pool scalar-map presence
+    mins: np.ndarray,          # f64 [R]   vocabulary epsilon thresholds
+    mesh=None,
+) -> dict:
+    """Run the device water-fill under 64-bit jax and decode the evidence.
+
+    Returns ``{"deserved", "met", "iterations", "converged_at",
+    "converged"}``; ``converged`` False means the fixed round budget ran
+    out — the caller (the proportion plugin) falls back to the host loop
+    and records the reason, so a short budget degrades to host COST,
+    never to different shares."""
+    from jax.experimental import enable_x64
+
+    q_n = int(weights.shape[0])
+    iters = qfair_iters() or q_n + 4
+    with enable_x64():
+        dev = qfair_solve(
+            jnp.asarray(weights, jnp.float64),
+            jnp.asarray(request, jnp.float64),
+            jnp.asarray(total, jnp.float64),
+            jnp.asarray(req_has_scalars, bool),
+            jnp.asarray(bool(total_has_scalars)),
+            jnp.asarray(mins, jnp.float64),
+            iters=iters,
+            mesh=mesh,
+        )
+        deserved, met, qf_raw = (np.asarray(x) for x in dev)
+    stats = qfair_stats_dict(qf_raw)
+    return {
+        "deserved": deserved,
+        "met": met,
+        "converged": stats["converged_at"] >= 0,
+        **stats,
+    }
+
+
+def qfair_stats_dict(qf_raw: np.ndarray) -> dict:
+    """Decode the device evidence row (``converged_at`` is -1 when the
+    fixed round budget ran out before the fixed point — the plugin then
+    falls back to the host loop; a converged solve reports the round the
+    host loop would have broken on)."""
+    return {
+        "iterations": int(qf_raw[QFAIR_STATS.ITERATIONS]),
+        "converged_at": int(qf_raw[QFAIR_STATS.CONVERGED_AT]),
+    }
+
+
+def shares_host(deserved: np.ndarray, allocated: np.ndarray) -> np.ndarray:
+    """Vectorized ``_update_share``: per queue, max over the deserved
+    vector's resource names of ``share(allocated, deserved)`` — f64 IEEE
+    division, so each value is bitwise the host fold's.  cpu/mem always
+    participate (0-total convention: 0/0 -> 0, x/0 -> 1); scalar dims only
+    where deserved is nonzero (the ``resource_names`` exclusion)."""
+    d = deserved
+    a = allocated
+    ratio = np.where(
+        d != 0.0, a / np.where(d != 0.0, d, 1.0),
+        np.where(a != 0.0, 1.0, 0.0),
+    )
+    if d.shape[1] > 2:
+        ratio[:, 2:] = np.where(d[:, 2:] != 0.0, ratio[:, 2:], 0.0)
+    return np.maximum(ratio.max(axis=1, initial=0.0), 0.0)
+
+
+# -- the class ladder (fused.py / megakernel.py staging) ----------------------
+
+def single_class_queues(
+    sig_of_task: np.ndarray,    # i32/i64 [T] request-signature id per task
+    queue_of_task: np.ndarray,  # i32 [T]  queue index per task
+    q_n: int,
+) -> Tuple[bool, np.ndarray, Optional[np.ndarray]]:
+    """Ladder admission: ``(ok, counts, class_of_queue)``.  ``ok`` iff every
+    queue's candidate tasks share ONE request-signature class (a queue with
+    no tasks trivially qualifies — its rung 0 is the only reachable one);
+    ``counts`` is the per-queue candidate count (= reachable ladder depth),
+    ``class_of_queue`` the representative signature id per queue (-1:
+    empty queue)."""
+    counts = np.bincount(queue_of_task, minlength=q_n).astype(np.int64)
+    class_of = np.full((q_n,), -1, dtype=np.int64)
+    if sig_of_task.size:
+        # First task's class per queue, then a one-pass uniformity check.
+        order = np.argsort(queue_of_task, kind="stable")
+        qs = queue_of_task[order]
+        sig = sig_of_task[order]
+        first = np.unique(qs, return_index=True)[1]
+        class_of[qs[first]] = sig[first]
+        if not bool(np.all(sig == class_of[qs])):
+            return False, counts, None
+    return True, counts, class_of
+
+
+def build_ladder(
+    q_deserved: np.ndarray,   # f32 [Q, R] scaled deserved rows (engine units)
+    q_alloc0: np.ndarray,     # f32 [Q, R] scaled allocated-at-open rows
+    req_rows: np.ndarray,     # f32 [Q, R] scaled class request row per queue
+    counts: np.ndarray,       # i64 [Q]    per-queue candidate count
+    mins: np.ndarray,         # f32 [R]    scaled epsilon thresholds
+    r_dim: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Precompute the per-(queue, class) share/overused ladder.
+
+    Rung k of queue q is the chain value after k unit placements of the
+    queue's class request: the allocated row is built by a SEQUENTIAL f32
+    fold (``np.add.accumulate`` — bit-identical to the engines' one-add-
+    per-placement accumulation), and share/overused mirror
+    ``pallas_kernels.queue_share_overused`` f32 arithmetic dim by dim
+    (ascending order, identical where/division/max sequence).  Returns
+    ``(share [Q, K], overused [Q, K])`` with K = max(counts) + 1; rungs
+    past a queue's own count are unreachable by construction (the queue
+    runs out of candidates first)."""
+    q_n = q_deserved.shape[0]
+    k_n = int(counts.max()) + 1 if q_n else 1
+    steps = np.broadcast_to(
+        req_rows[:, None, :], (q_n, k_n - 1, req_rows.shape[1])
+    ) if k_n > 1 else np.zeros((q_n, 0, req_rows.shape[1]), np.float32)
+    chain = np.add.accumulate(
+        np.concatenate([q_alloc0[:, None, :], steps], axis=1,
+                       dtype=np.float32),
+        axis=1,
+    )
+    one = np.float32(1.0)
+    zero = np.float32(0.0)
+    share = None
+    over = None
+    for r in range(r_dim):
+        d = np.ascontiguousarray(q_deserved[:, r, None])
+        a = chain[:, :, r]
+        fr = np.where(d > zero, a / np.where(d > zero, d, one), zero)
+        if r < 2:  # cpu/memory dims (vocabulary order is fixed)
+            fr = np.where((d <= zero) & (a > zero), one, fr)
+        share = fr if share is None else np.maximum(share, fr)
+        le = (d - a) < mins[r]
+        over = le if over is None else over & le
+    return share.astype(np.float32, copy=False), over

@@ -231,3 +231,61 @@ def dispatch_stacked(
 
     phases.note("tenant", evidence)
     return evidence
+
+
+def solve_queue_fair_stacked(fleets: Sequence[dict], mesh=None) -> List[dict]:
+    """K same-shape fleets' deserved fixed points in ONE device dispatch.
+
+    The queue-fair analogue of ``dispatch_stacked``: each fleet's water-fill
+    (``ops/qfair.py`` — the proportion plugin's session-open solve) rides a
+    ``lax.map`` lane of the SAME fixed-iteration round body, so lane k's
+    deserved tensor is bitwise the solo ``qfair.solve_deserved`` call's
+    (pinned by tests/test_qfair.py) while the K dispatches and K readbacks
+    collapse into one of each.  ``fleets`` is a sequence of dicts with keys
+    ``weights`` (f64 [Q]), ``request`` (f64 [Q, R]), ``total`` (f64 [R]),
+    ``req_has_scalars`` (bool [Q]), ``total_has_scalars`` (bool) and
+    ``mins`` (f64 [R]); all lanes must share Q, R and the vocabulary
+    (``mins``) — the same-shape stacking precondition as the allocate
+    lanes.  Returns one decoded solve dict per fleet, shaped exactly like
+    ``qfair.solve_deserved``'s."""
+    import numpy as np
+
+    from jax.experimental import enable_x64
+
+    from scheduler_tpu.ops import qfair
+
+    if not fleets:
+        return []
+    q_n = int(fleets[0]["weights"].shape[0])
+    iters = qfair.qfair_iters() or q_n + 4
+    with enable_x64():
+        dev = qfair.qfair_solve_stacked(
+            jnp.asarray(
+                np.stack([f["weights"] for f in fleets]), jnp.float64
+            ),
+            jnp.asarray(
+                np.stack([f["request"] for f in fleets]), jnp.float64
+            ),
+            jnp.asarray(np.stack([f["total"] for f in fleets]), jnp.float64),
+            jnp.asarray(
+                np.stack([f["req_has_scalars"] for f in fleets]), bool
+            ),
+            jnp.asarray(
+                np.asarray([bool(f["total_has_scalars"]) for f in fleets]),
+                bool,
+            ),
+            jnp.asarray(fleets[0]["mins"], jnp.float64),
+            iters=iters,
+            mesh=mesh,
+        )
+        deserved, met, qf_raw = (np.asarray(x) for x in dev)
+    out = []
+    for k in range(len(fleets)):
+        stats = qfair.qfair_stats_dict(qf_raw[k])
+        out.append({
+            "deserved": deserved[k],
+            "met": met[k],
+            "converged": stats["converged_at"] >= 0,
+            **stats,
+        })
+    return out

@@ -44,6 +44,7 @@ class ProportionPlugin(Plugin):
         self.arguments = arguments
         self.total_resource: Optional[ResourceVec] = None
         self.queue_attrs: Dict[str, _QueueAttr] = {}
+        self._qfair_evidence: Dict[str, object] = {}
 
     def name(self) -> str:
         return "proportion"
@@ -55,6 +56,98 @@ class ProportionPlugin(Plugin):
             if s > res:
                 res = s
         attr.share = res
+
+    def _solve_device(self, vocab) -> Dict[str, object]:
+        """Run the deserved water-fill on device (``ops/qfair.py``) and
+        apply the solved rows/shares to the queue attrs.  Returns the
+        evidence block; ``flavor`` stays ``host`` when the kill-switch is
+        set or the fixed round budget ran out (the caller then runs the
+        host loop — degraded COST, identical shares either way)."""
+        import time as _time
+
+        from scheduler_tpu.ops import qfair as _qfair
+
+        flavor = _qfair.qfair_flavor()
+        if flavor != "device":
+            return {"flavor": "host"}
+        attrs = list(self.queue_attrs.values())
+        if not attrs:
+            return {"flavor": "device", "iterations": 0, "converged_at": 0,
+                    "solve_ms": 0.0}
+        from scheduler_tpu.ops.mesh import get_mesh
+
+        t0 = _time.perf_counter()
+        solved = _qfair.solve_deserved(
+            np.asarray([a.weight for a in attrs], dtype=np.float64),
+            np.stack([a.request.array.copy() for a in attrs]),
+            self.total_resource.array.copy(),
+            np.asarray([a.request.has_scalars for a in attrs], dtype=bool),
+            self.total_resource.has_scalars,
+            vocab.min_thresholds().astype(np.float64),
+            mesh=get_mesh(),
+        )
+        wall = (_time.perf_counter() - t0) * 1000.0
+        if not solved["converged"]:
+            logger.warning(
+                "qfair device solve did not converge in %d rounds; "
+                "falling back to the host water-fill",
+                solved["iterations"],
+            )
+            return {"flavor": "host", "fallback": "not converged",
+                    "iterations": solved["iterations"],
+                    "device_solve_ms": round(wall, 3)}
+        shares = _qfair.shares_host(
+            solved["deserved"],
+            np.stack([a.allocated.array.copy() for a in attrs]),
+        )
+        for i, attr in enumerate(attrs):
+            attr.deserved = ResourceVec(vocab, solved["deserved"][i].copy())
+            attr.share = float(shares[i])
+        return {
+            "flavor": "device",
+            "iterations": solved["iterations"],
+            "converged_at": solved["converged_at"],
+            "solve_ms": round(wall, 3),
+        }
+
+    def _solve_host(self, vocab) -> None:
+        """The reference water-filling loop (proportion.go:101-154) — the
+        ``SCHEDULER_TPU_QFAIR=host`` kill-switch and the parity oracle the
+        device solve is pinned against (tests/test_qfair.py)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        remaining = self.total_resource.clone()
+        meet: set = set()
+        while True:
+            total_weight = sum(
+                attr.weight for attr in self.queue_attrs.values() if attr.queue_id not in meet
+            )
+            if total_weight == 0:
+                break
+
+            increased = ResourceVec.empty(vocab)
+            decreased = ResourceVec.empty(vocab)
+            for attr in self.queue_attrs.values():
+                if attr.queue_id in meet:
+                    continue
+                old_deserved = attr.deserved.clone()
+                attr.deserved.add(remaining.clone().multi(attr.weight / total_weight))
+                if attr.request.less(attr.deserved):
+                    attr.deserved = res_min(attr.deserved, attr.request)
+                    meet.add(attr.queue_id)
+                self._update_share(attr)
+                inc, dec = attr.deserved.diff(old_deserved)
+                increased.add(inc)
+                decreased.add(dec)
+
+            remaining.sub(increased).add(decreased)
+            if remaining.is_empty():
+                break
+        self._qfair_evidence.setdefault("flavor", "host")
+        self._qfair_evidence["solve_ms"] = round(
+            (_time.perf_counter() - t0) * 1000.0, 3
+        )
 
     def on_session_open(self, ssn) -> None:
         if not ssn.jobs:
@@ -90,34 +183,15 @@ class ProportionPlugin(Plugin):
             if job.status_count(TaskStatus.PENDING):
                 attr.request.add_array(*job.status_sum((TaskStatus.PENDING,)))
 
-        # Water-filling (proportion.go:101-154).
-        remaining = self.total_resource.clone()
-        meet: set = set()
-        while True:
-            total_weight = sum(
-                attr.weight for attr in self.queue_attrs.values() if attr.queue_id not in meet
-            )
-            if total_weight == 0:
-                break
-
-            increased = ResourceVec.empty(vocab)
-            decreased = ResourceVec.empty(vocab)
-            for attr in self.queue_attrs.values():
-                if attr.queue_id in meet:
-                    continue
-                old_deserved = attr.deserved.clone()
-                attr.deserved.add(remaining.clone().multi(attr.weight / total_weight))
-                if attr.request.less(attr.deserved):
-                    attr.deserved = res_min(attr.deserved, attr.request)
-                    meet.add(attr.queue_id)
-                self._update_share(attr)
-                inc, dec = attr.deserved.diff(old_deserved)
-                increased.add(inc)
-                decreased.add(dec)
-
-            remaining.sub(increased).add(decreased)
-            if remaining.is_empty():
-                break
+        # Deserved fixed point: the device water-fill (ops/qfair.py — a
+        # fixed-iteration 64-bit solve, bitwise the host loop's output) or
+        # the host loop below (`SCHEDULER_TPU_QFAIR=host`, the kill-switch
+        # and parity oracle; also the fallback if the fixed round budget
+        # ran out).  The evidence block rides the device_queue_fair seam
+        # into FusedAllocator.run_stats()["qfair"].
+        self._qfair_evidence = self._solve_device(vocab)
+        if self._qfair_evidence.get("flavor") != "device":
+            self._solve_host(vocab)
 
         def queue_order_fn(l: QueueInfo, r: QueueInfo) -> int:
             ls = self.queue_attrs[l.uid].share
@@ -134,7 +208,10 @@ class ProportionPlugin(Plugin):
             Queues with no jobs this session have no attr; their rows stay zero
             and the kernel's share/overused math degenerates to share 0 /
             not-overused — but such queues also hold no eligible jobs, so they
-            are never selected.
+            are never selected.  The ``qfair`` key carries the water-fill
+            evidence block (flavor, solve wall, iterations) along the same
+            seam, so the engine's run_stats can publish it without a second
+            plugin round-trip.
             """
             q = len(queue_uids)
             r = vocab.size
@@ -146,7 +223,11 @@ class ProportionPlugin(Plugin):
                     continue
                 deserved[i] = attr.deserved.array
                 allocated[i] = attr.allocated.array
-            return {"deserved": deserved, "allocated": allocated}
+            return {
+                "deserved": deserved,
+                "allocated": allocated,
+                "qfair": dict(self._qfair_evidence),
+            }
 
         ssn.add_device_queue_fair(self.name(), device_queue_fair)
 
@@ -303,6 +384,7 @@ class ProportionPlugin(Plugin):
     def on_session_close(self, ssn) -> None:
         self.total_resource = None
         self.queue_attrs = {}
+        self._qfair_evidence = {}
 
 
 def new(arguments: Arguments) -> ProportionPlugin:

@@ -98,6 +98,15 @@ OBS_CHANNELS = (
         "desc": "signature-class compression (classes vs tasks, bytes saved)",
     },
     {
+        "channel": "qfair",
+        "source": "actions/allocate.py",
+        "metric": None,
+        "exempt": "queue-fair solve evidence; validated by bench_gate qfair "
+                  "block on MQ artifacts",
+        "desc": "queue-fair water-fill solve (flavor, iterations, "
+                "convergence) and class-ladder engagement",
+    },
+    {
         "channel": "victims",
         "source": "ops/victims.py",
         "metric": None,

@@ -4,9 +4,15 @@ Compares the two newest artifacts of each bench FAMILY in the repo root (or
 a directory given as argv[1]):
 
 * ``BENCH_r*.json``     — the single-queue 100k-pod flagship;
-* ``BENCH_MQ_r*.json``  — the two-queue 100k-pod flagship
-  (``SCHEDULER_TPU_BENCH_QUEUES=2``, first-class since the delta-maintained
-  queue chain, docs/QUEUE_DELTA.md);
+* ``BENCH_MQ_r*.json``  — the multi-queue flagship (``bench.py --mq``,
+  first-class since the delta-maintained queue chain, docs/QUEUE_DELTA.md;
+  wide-vocab since the class-ladder solve —
+  ``SCHEDULER_TPU_BENCH_VOCAB``).  MQ artifacts additionally carry the
+  queue-fair solve evidence (``detail.cycles[].qfair``, docs/QUEUE_DELTA.md
+  "Class-ladder solve"): an ENGAGED block must record the device solve's
+  ``iterations`` and ``converged_at``, a declined block must record
+  ``engaged: false`` plus its reason — anything else is a malformed
+  evidence chain (exit 1), the LP family's silent-fallback rule;
 * ``BENCH_XL_r*.json``  — the multi-host 1M-pod/100k-node flagship
   (``bench.py --xl``, docs/SHARDING.md "Multi-host").  XL artifacts MUST
   carry complete mesh topology metadata (``detail.mesh``: devices,
@@ -198,6 +204,51 @@ def sig_block_problem(detail: dict):
                 or comp <= 0):
             return (f"cycle {i} sig block records a non-finite "
                     f"compression factor {comp!r}")
+    return None
+
+
+def qfair_block_problem(detail: dict):
+    """Sanity-check the queue-fair solve evidence riding an MQ artifact
+    (``detail.cycles[].qfair``, docs/QUEUE_DELTA.md "Class-ladder solve").
+
+    An ENGAGED block must prove the fixed-iteration device solve actually
+    ran — integer ``iterations >= 1`` and ``0 <= converged_at <=
+    iterations`` — plus non-empty rung/class counts; a declined block must
+    say WHY (``engaged: false`` + a reason string).  Anything else is a
+    malformed evidence chain, not a measurement.  Returns the reason
+    string, or None when every block is sane (absent/empty blocks are
+    fine: single-queue cycles have no queue chain at all)."""
+    for i, cycle in enumerate(detail.get("cycles") or []):
+        qf = cycle.get("qfair")
+        if not qf:
+            continue  # no queue chain on this cycle
+        if not isinstance(qf, dict) or not isinstance(qf.get("engaged"), bool):
+            return (f"cycle {i} qfair block is not an "
+                    "{engaged: bool, ...} block")
+        if qf["engaged"]:
+            its = qf.get("iterations")
+            conv = qf.get("converged_at")
+            if not isinstance(its, int) or isinstance(its, bool) or its < 1:
+                return (f"cycle {i} qfair block claims an engaged ladder "
+                        "without the device solve's iteration count")
+            if (not isinstance(conv, int) or isinstance(conv, bool)
+                    or conv < 0 or conv > its):
+                return (f"cycle {i} qfair block records converged_at="
+                        f"{conv!r} outside [0, iterations={its}] — the "
+                        "fixed-iteration solve cannot defend its "
+                        "convergence claim")
+            for key in ("rungs", "classes"):
+                v = qf.get(key)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                    return (f"cycle {i} qfair block records {key}={v!r} on "
+                            "an engaged cycle — a ladder has at least one "
+                            "rung per class and one class per queue")
+        else:
+            reason = qf.get("reason")
+            if not isinstance(reason, str) or not reason:
+                return (f"cycle {i} qfair block declined the ladder "
+                        "without recording why (engaged: false needs a "
+                        "reason string)")
     return None
 
 
@@ -703,6 +754,14 @@ def gate_family(root: Path, label: str, infix: str) -> int:
             print(f"bench-gate[{label}]: malformed artifact "
                   f"{artifacts[-1].name}: {obs_why}")
             return 1
+        if infix == "_MQ":
+            # Queue-fair solve evidence on the newest MQ artifact (older
+            # rounds predate the class-ladder solve and carry no block).
+            qf_why = qfair_block_problem(detail)
+            if qf_why is not None:
+                print(f"bench-gate[{label}]: malformed artifact "
+                      f"{artifacts[-1].name}: {qf_why}")
+                return 1
         note = obs_overhead_note(detail)
         if note is not None:
             print(f"bench-gate[{label}]: {artifacts[-1].name}: {note}")

@@ -40,6 +40,12 @@ tiers (live share ordering + overused gate on device) and the pods spread
 over that many weighted queues — the two-queue flagship shape whose queue
 chain is delta-maintained (docs/QUEUE_DELTA.md; flip
 ``SCHEDULER_TPU_QUEUE_DELTA=0`` to profile the full-recompute chain A/B).
+The qfair block prints alongside (docs/QUEUE_DELTA.md "Class-ladder
+solve"): which flavor solved the deserved fixed point and its wall,
+iterations/convergence when the device solve ran, and the class ladder's
+engagement (rung/class counts, or the recorded decline reason) — flip
+``SCHEDULER_TPU_QFAIR={host,device}`` to A/B the host waterfill against
+the fixed-iteration device solve.
 
 Protocol matches the bench (harness/measure): a fresh cluster per measured
 cycle, engine tensors warmed without placing, GC frozen around the cycle.
@@ -134,6 +140,27 @@ def run(n_nodes: int, n_pods: int, label: str, n_queues: int = 1) -> None:
     qc = stats.get("queue_chain")
     if qc:
         print(f"  queue_chain         {qc}")
+    # Queue-fair solve block (docs/QUEUE_DELTA.md "Class-ladder solve"):
+    # the deserved fixed point's flavor + wall (host waterfill vs the
+    # fixed-iteration device solve, with iterations/convergence), then the
+    # class ladder's engagement — rung/class/lookup counts when it replaced
+    # the per-step delta chain, the recorded reason when it declined.
+    qf = stats.get("qfair")
+    if qf:
+        solve = (f"solve={qf.get('flavor', '?')}"
+                 f"/{qf.get('solve_ms', 0.0):.3f}ms")
+        if "iterations" in qf:
+            solve += (f" iters={qf['iterations']}"
+                      f" converged_at={qf.get('converged_at', '?')}")
+        if qf.get("fallback"):
+            solve += f" fallback={qf['fallback']!r}"
+        if qf.get("engaged"):
+            print(f"  qfair               {solve} ladder=on "
+                  f"rungs={qf['rungs']} classes={qf['classes']} "
+                  f"lookups={qf.get('ladder_lookups', 0)}")
+        else:
+            print(f"  qfair               {solve} ladder=off "
+                  f"({qf.get('reason', 'n/a')})")
     lp = stats.get("lp")
     if lp:
         print(f"  lp                  {lp}")

@@ -251,6 +251,60 @@ def _hlo_tenant_scan(mesh) -> str:
     return lowered.compile().as_text()
 
 
+def _hlo_qfair_solve(mesh) -> str:
+    """Lower the queue-fair deserved water-fill (``ops/qfair.py``
+    ``qfair_solve``, docs/QUEUE_DELTA.md "Class-ladder solve") at a small
+    [Q, R] shape, f64 under x64 — exactly how the proportion plugin calls
+    it.  The [Q, R] operands are tiny and fully replicated, so the declared
+    budget is ZERO collectives of every kind on both mesh shapes: the solve
+    adds no ICI traffic to the placement scan's one-all-gather-per-step
+    contract."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import enable_x64
+
+    from scheduler_tpu.ops.qfair import qfair_solve
+
+    rng = np.random.default_rng(0)
+    q, r = 3, 4
+    with enable_x64():
+        lowered = qfair_solve.lower(
+            jnp.asarray(rng.uniform(1, 4, q), jnp.float64),
+            jnp.asarray(rng.uniform(1, 8, (q, r)), jnp.float64),
+            jnp.asarray(rng.uniform(8, 16, r), jnp.float64),
+            jnp.asarray(np.zeros(q, bool)),
+            jnp.asarray(False),
+            jnp.asarray(np.full(r, 1e-2), jnp.float64),
+            iters=q + 4, mesh=mesh,
+        )
+        return lowered.compile().as_text()
+
+
+def _hlo_qfair_stacked(mesh) -> str:
+    """Lower the K-fleet stacked solve twin (``qfair_solve_stacked``, the
+    ``ops/tenant.py`` lane idiom) at K=4: batching fleets widens the lane
+    axis, never the collective count — still ZERO collectives."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import enable_x64
+
+    from scheduler_tpu.ops.qfair import qfair_solve_stacked
+
+    rng = np.random.default_rng(0)
+    k, q, r = 4, 3, 4
+    with enable_x64():
+        lowered = qfair_solve_stacked.lower(
+            jnp.asarray(rng.uniform(1, 4, (k, q)), jnp.float64),
+            jnp.asarray(rng.uniform(1, 8, (k, q, r)), jnp.float64),
+            jnp.asarray(rng.uniform(8, 16, (k, r)), jnp.float64),
+            jnp.asarray(np.zeros((k, q), bool)),
+            jnp.asarray(np.zeros(k, bool)),
+            jnp.asarray(np.full(r, 1e-2), jnp.float64),
+            iters=q + 4, mesh=mesh,
+        )
+        return lowered.compile().as_text()
+
+
 def _hlo_victim_pick(mesh) -> str:
     """Lower the eviction engine's victim-plan node pick
     (``ops/evict.py`` ``sharded_victim_pick``, docs/PREEMPT.md): each shard
@@ -301,6 +355,8 @@ def lowerable_sites(mesh) -> dict:
             "ops/lp_place.py::_lp_iterate_2d": _hlo_lp_iterate,
             "ops/lp_place.py::_lp_iterate_sig_2d": _hlo_lp_iterate_sig,
             "ops/evict.py::_victim_pick_2d": _hlo_victim_pick,
+            "ops/qfair.py::_qfair_solve_2d": _hlo_qfair_solve,
+            "ops/qfair.py::_qfair_stacked_2d": _hlo_qfair_stacked,
         }
     return {
         "ops/sharded.py::_place_scan_1d": _hlo_place_scan,
@@ -309,6 +365,8 @@ def lowerable_sites(mesh) -> dict:
         "ops/lp_place.py::_lp_iterate_1d": _hlo_lp_iterate,
         "ops/lp_place.py::_lp_iterate_sig_1d": _hlo_lp_iterate_sig,
         "ops/evict.py::_victim_pick_1d": _hlo_victim_pick,
+        "ops/qfair.py::_qfair_solve_1d": _hlo_qfair_solve,
+        "ops/qfair.py::_qfair_stacked_1d": _hlo_qfair_stacked,
     }
 
 

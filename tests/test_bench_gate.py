@@ -673,3 +673,102 @@ def test_tenant_gate_with_no_artifacts_is_silent_pass(tmp_path):
     from scripts.bench_gate import gate_tenant
 
     assert gate_tenant(tmp_path) == 0
+
+
+# -- qfair evidence on MQ artifacts (docs/QUEUE_DELTA.md "Class-ladder solve") --
+
+def _mq_artifact(qfair=None, value=100_000.0) -> dict:
+    doc = _artifact(value)
+    doc["detail"]["queues"] = 3
+    if qfair is not None:
+        for cycle in doc["detail"]["cycles"]:
+            cycle["qfair"] = qfair
+    return doc
+
+
+_ENGAGED_QFAIR = {
+    "flavor": "device", "iterations": 7, "converged_at": 1,
+    "solve_ms": 0.5, "engaged": True, "rungs": 68, "classes": 3,
+    "ladder_lookups": 200,
+}
+
+
+def test_mq_engaged_qfair_block_passes(tmp_path):
+    _write(tmp_path, "BENCH_MQ_r01.json", _mq_artifact(_ENGAGED_QFAIR))
+    _write(tmp_path, "BENCH_MQ_r02.json", _mq_artifact(_ENGAGED_QFAIR))
+    assert gate_family(tmp_path, "two-queue", "_MQ") == 0
+
+
+def test_mq_absent_qfair_blocks_are_fine(tmp_path):
+    # Pre-round-17 MQ artifacts carry no qfair block at all; single-queue
+    # cycles carry an empty one.  Neither is malformed.
+    _write(tmp_path, "BENCH_MQ_r01.json", _mq_artifact())
+    _write(tmp_path, "BENCH_MQ_r02.json", _mq_artifact({}))
+    assert gate_family(tmp_path, "two-queue", "_MQ") == 0
+
+
+def test_mq_engaged_without_iterations_is_malformed(tmp_path):
+    bad = dict(_ENGAGED_QFAIR)
+    del bad["iterations"]
+    _write(tmp_path, "BENCH_MQ_r01.json", _mq_artifact(bad))
+    assert gate_family(tmp_path, "two-queue", "_MQ") == 1
+    assert gate_main(["bench_gate", str(tmp_path)]) == 1
+
+
+def test_mq_engaged_without_converged_at_is_malformed(tmp_path):
+    bad = dict(_ENGAGED_QFAIR)
+    del bad["converged_at"]
+    _write(tmp_path, "BENCH_MQ_r01.json", _mq_artifact(bad))
+    assert gate_family(tmp_path, "two-queue", "_MQ") == 1
+
+
+def test_mq_converged_at_past_iterations_is_malformed(tmp_path):
+    # converged_at beyond the fixed trip count claims convergence the
+    # solve never observed.
+    bad = dict(_ENGAGED_QFAIR, converged_at=99)
+    _write(tmp_path, "BENCH_MQ_r01.json", _mq_artifact(bad))
+    assert gate_family(tmp_path, "two-queue", "_MQ") == 1
+
+
+def test_mq_engaged_with_empty_ladder_counts_is_malformed(tmp_path):
+    bad = dict(_ENGAGED_QFAIR, rungs=0)
+    _write(tmp_path, "BENCH_MQ_r01.json", _mq_artifact(bad))
+    assert gate_family(tmp_path, "two-queue", "_MQ") == 1
+
+
+def test_mq_declined_with_reason_passes(tmp_path):
+    _write(tmp_path, "BENCH_MQ_r01.json", _mq_artifact({
+        "flavor": "host", "solve_ms": 0.3, "engaged": False,
+        "reason": "SCHEDULER_TPU_QFAIR=host (kill-switch)",
+    }))
+    assert gate_family(tmp_path, "two-queue", "_MQ") == 0
+
+
+def test_mq_declined_without_reason_is_malformed(tmp_path):
+    _write(tmp_path, "BENCH_MQ_r01.json", _mq_artifact({
+        "flavor": "device", "engaged": False,
+    }))
+    assert gate_family(tmp_path, "two-queue", "_MQ") == 1
+
+
+def test_mq_qfair_block_wrong_shape_is_malformed(tmp_path):
+    from scripts.bench_gate import qfair_block_problem
+
+    _write(tmp_path, "BENCH_MQ_r01.json",
+           _mq_artifact({"iterations": 7}))  # no engaged bool at all
+    assert gate_family(tmp_path, "two-queue", "_MQ") == 1
+    # The checker itself also rejects bool-typed counters (JSON true is a
+    # Python bool, which is an int subclass).
+    bad = {"cycles": [{"qfair": dict(_ENGAGED_QFAIR, iterations=True)}]}
+    assert qfair_block_problem(bad) is not None
+
+
+def test_qfair_contract_is_scoped_to_the_mq_family(tmp_path):
+    # A malformed qfair block on a single-queue artifact does not trip the
+    # gate — the contract rides MQ artifacts only (other families carry
+    # empty blocks on their multi-queue debugging runs at most).
+    doc = _artifact(100_000.0)
+    for cycle in doc["detail"]["cycles"]:
+        cycle["qfair"] = {"engaged": True}  # no iterations: malformed shape
+    _write(tmp_path, "BENCH_r01.json", doc)
+    assert gate_family(tmp_path, "single-queue", "") == 0

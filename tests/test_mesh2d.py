@@ -243,6 +243,11 @@ def test_budget_holds_on_the_2d_mesh_one_merged_all_gather():
         # Multi-tenant stacked scan (round 16, docs/TENANT.md): the lane
         # axis is replicated, so the per-step budget is unchanged.
         "ops/sharded.py::_tenant_scan_2d",
+        # Queue-fair deserved solve + its K-fleet stacked twin (round 17,
+        # docs/QUEUE_DELTA.md "Class-ladder solve"): tiny [Q, R] operands,
+        # fully replicated — ZERO collectives, checked below.
+        "ops/qfair.py::_qfair_solve_2d",
+        "ops/qfair.py::_qfair_stacked_2d",
     }
     counts = count_collectives(sites[site](mesh))
     assert counts == {"all-gather": 1}
@@ -255,6 +260,13 @@ def test_budget_holds_on_the_2d_mesh_one_merged_all_gather():
         assert lp_counts == {"all-gather": 1}
         assert check_counts(
             lp_site, lp_counts, layout.COLLECTIVE_BUDGET[lp_site]
+        ) == []
+    for qf_site in ("ops/qfair.py::_qfair_solve_2d",
+                    "ops/qfair.py::_qfair_stacked_2d"):
+        qf_counts = count_collectives(sites[qf_site](mesh))
+        assert qf_counts == {}, qf_counts
+        assert check_counts(
+            qf_site, qf_counts, layout.COLLECTIVE_BUDGET[qf_site]
         ) == []
 
 

@@ -1,0 +1,386 @@
+"""Device-side convex queue-share solve parity (ops/qfair.py,
+docs/QUEUE_DELTA.md "Class-ladder solve").
+
+Three contracts, each pinned bitwise:
+
+1. **Solve parity** — the fixed-iteration device water-fill must reproduce
+   the host loop (``plugins/proportion.py _solve_host``, the
+   ``SCHEDULER_TPU_QFAIR=host`` kill-switch) bit for bit: per-queue
+   deserved f64 rows AND the derived shares, across queue counts, weight
+   skews and capped-request endgames (queues whose request is smaller than
+   their fair slice get capped + met — the ``ResourceVec.less`` branch).
+2. **Bind parity** — flipping the flavor must never change placements:
+   {greedy, lp} x {mega, XLA} x cohort chunks on/off trajectories, plus a
+   ladder-ENGAGED engine run (single-task uniform queues — the exactness
+   invariant's shape) where run_stats carries the evidence block
+   scripts/bench_gate.py judges.
+3. **Deployment twins** — the mesh twins (1-D 8-device, 2x4 two-axis) and
+   the K-fleet stacked lane (``ops/tenant.solve_queue_fair_stacked``) must
+   each match the solo single-device solve bitwise; the engine-cache key
+   registers both knobs and ``_delta_compatible`` rejects a stale flavor.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.actions.allocate import collect_candidates
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import close_session, open_session
+from scheduler_tpu.ops import qfair
+from scheduler_tpu.ops.fused import FusedAllocator
+from tests.fixtures import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    make_vocab,
+)
+from tests.test_cohort_parity import MULTIQ_CONF
+
+PROPORTION_CONF = (
+    'actions: "allocate"\ntiers:\n- plugins:\n  - name: proportion\n'
+)
+
+
+# -- 1. host-vs-device solve parity (the plugin seam) -------------------------
+
+def _fair_cluster(weights, *, capped=(), scalars=False):
+    """Q-queue cluster whose proportion fixed point exercises the requested
+    endgame: ``weights`` maps queue name -> weight; queues in ``capped``
+    request far less than their fair slice (met + capped on round 1);
+    ``scalars`` adds a scalar vocab dim to half the pods (the
+    ``has_scalars`` lanes of the request-cap test)."""
+    vocab = make_vocab("nvidia.com/gpu") if scalars else make_vocab()
+    cache = SchedulerCache(vocab=vocab, async_io=False)
+    cache.run()
+    for q, w in weights.items():
+        cache.add_queue(build_queue(q, weight=w))
+    for i in range(3):
+        alloc = {"cpu": 8000, "memory": 32 * 2**30, "pods": 110}
+        if scalars:
+            alloc["nvidia.com/gpu"] = 8
+        cache.add_node(build_node(f"n{i}", alloc))
+    for gi, q in enumerate(weights):
+        n_pods = 1 if q in capped else 6
+        cache.add_pod_group(build_pod_group(f"g{gi}", min_member=1, queue=q))
+        for i in range(n_pods):
+            req = {"cpu": 400 if q in capped else 2000, "memory": 2**30}
+            if scalars and i % 2:
+                req["nvidia.com/gpu"] = 1
+            cache.add_pod(build_pod(
+                name=f"g{gi}-{i}", req=req, groupname=f"g{gi}"))
+    return cache
+
+
+def _solve_snapshot(cache, monkeypatch, flavor):
+    """Open a session under the given solve flavor and capture the
+    proportion fixed point: per-queue deserved f64 rows, shares, and the
+    evidence block riding the device_queue_fair seam."""
+    monkeypatch.setenv("SCHEDULER_TPU_QFAIR", flavor)
+    ssn = open_session(cache, parse_scheduler_conf(PROPORTION_CONF).tiers)
+    try:
+        pp = ssn.plugins["proportion"]
+        snap = {
+            uid: (attr.deserved.array.copy(), attr.share,
+                  attr.deserved.has_scalars)
+            for uid, attr in pp.queue_attrs.items()
+        }
+        return snap, dict(pp._qfair_evidence)
+    finally:
+        close_session(ssn)
+
+
+@pytest.mark.parametrize("weights,capped,scalars", [
+    ({"qa": 1}, (), False),
+    ({"qa": 1, "qb": 1}, (), False),
+    ({"qa": 1, "qb": 3}, (), False),
+    ({"qa": 1, "qb": 9}, ("qa",), False),
+    ({"qa": 2, "qb": 3, "qc": 5}, (), False),
+    ({"qa": 1, "qb": 4, "qc": 2}, ("qb",), False),
+    ({"qa": 1, "qb": 3, "qc": 1}, ("qa", "qc"), False),
+    ({"qa": 1, "qb": 2}, (), True),
+    ({"qa": 3, "qb": 1, "qc": 1}, ("qb",), True),
+], ids=["1q", "2q-even", "2q-skew", "2q-capped", "3q-skew", "3q-capped",
+        "3q-two-capped", "2q-scalars", "3q-scalars-capped"])
+def test_solve_host_device_bitwise_parity(monkeypatch, weights, capped,
+                                          scalars):
+    """The device water-fill's deserved rows and shares are bitwise the
+    host loop's — f64 equality, not approx — and the device run records
+    its convergence evidence."""
+    cache = _fair_cluster(weights, capped=capped, scalars=scalars)
+    host, ev_host = _solve_snapshot(cache, monkeypatch, "host")
+    dev, ev_dev = _solve_snapshot(cache, monkeypatch, "device")
+    assert set(host) == set(dev) == set(weights)
+    for uid in weights:
+        np.testing.assert_array_equal(
+            host[uid][0], dev[uid][0], err_msg=f"deserved[{uid}]")
+        assert host[uid][1] == dev[uid][1], f"share[{uid}]"
+        assert host[uid][2] == dev[uid][2], f"has_scalars[{uid}]"
+    assert ev_host["flavor"] == "host"
+    assert ev_dev["flavor"] == "device"
+    # Fixed budget, convergence recorded as evidence: Q + 4 rounds, the
+    # fixed point reached within them (a capped queue converges earlier).
+    assert ev_dev["iterations"] == len(weights) + 4
+    assert 0 <= ev_dev["converged_at"] <= ev_dev["iterations"]
+
+
+def test_solve_short_budget_falls_back_to_host(monkeypatch):
+    """An unconverged fixed budget degrades to host COST, never to wrong
+    shares: the plugin falls back to the host loop and records why."""
+    # The capped queue returns surplus after round 1, so the fixed point
+    # needs a second redistribution round — out of a 1-round budget.
+    # ``scalars=True`` because ``ResourceVec.less`` disables capping on
+    # cpu/memory-only clusters (the nil-map quirk the parity cases above
+    # also pin) — without a scalar dim every instance converges in round 1.
+    cache = _fair_cluster(
+        {"qa": 1, "qb": 3, "qc": 2}, capped=("qa",), scalars=True)
+    ref, _ = _solve_snapshot(cache, monkeypatch, "host")
+    monkeypatch.setenv("SCHEDULER_TPU_QFAIR_ITERS", "1")
+    got, ev = _solve_snapshot(cache, monkeypatch, "device")
+    assert ev["flavor"] == "host" and ev["fallback"] == "not converged"
+    assert ev["iterations"] == 1
+    for uid in ref:
+        np.testing.assert_array_equal(ref[uid][0], got[uid][0])
+
+
+# -- 2. bind parity: flavor flips never change placements ---------------------
+
+def _bind_trajectory(env, monkeypatch, seed=11, n_queues=3, cycles=3):
+    """Short whole-action mutation trajectory (the test_queue_delta_parity
+    fuzz harness) under the given env: returns per-cycle (binds, statuses)."""
+    from scheduler_tpu.framework import get_action
+    from tests.test_queue_delta_parity import _fuzz_cluster, _mutate
+
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    rng = np.random.default_rng(seed)
+    cache = _fuzz_cluster(rng, n_queues)
+    conf = parse_scheduler_conf(MULTIQ_CONF)
+    out = []
+    for step in range(cycles):
+        _mutate(cache, rng, step)
+        ssn = open_session(cache, conf.tiers)
+        get_action("allocate").execute(ssn)
+        statuses = {
+            t.name: t.status.name
+            for job in ssn.jobs.values()
+            for t in job.tasks.values()
+        }
+        close_session(ssn)
+        out.append((dict(cache.binder.binds), statuses))
+    return out
+
+
+@pytest.mark.parametrize("allocator", ["greedy", "lp"])
+@pytest.mark.parametrize("mega", ["1", "0"], ids=["mega", "xla"])
+@pytest.mark.parametrize("chunks", ["1", "4"], ids=["solo", "cohort"])
+def test_bind_parity_across_flavors(monkeypatch, allocator, mega, chunks):
+    """{greedy, lp} x {mega, XLA} x cohort on/off: the same mutation
+    trajectory must produce identical binds and task statuses with the
+    device solve and the host kill-switch — the solve repartitions WHERE
+    the fixed point runs, never what it computes."""
+    base = {
+        "SCHEDULER_TPU_ALLOCATOR": allocator,
+        "SCHEDULER_TPU_MEGA": mega,
+        "SCHEDULER_TPU_COHORT": chunks,
+    }
+    dev = _bind_trajectory(
+        {**base, "SCHEDULER_TPU_QFAIR": "device"}, monkeypatch)
+    host = _bind_trajectory(
+        {**base, "SCHEDULER_TPU_QFAIR": "host"}, monkeypatch)
+    assert len(dev) == len(host) == 3
+    for i, (got, want) in enumerate(zip(dev, host)):
+        assert got[0] == want[0], f"cycle {i}: binds diverge"
+        assert got[1] == want[1], f"cycle {i}: task statuses diverge"
+
+
+def _ladder_cluster():
+    """The exactness invariant's shape: single-task jobs, one uniform
+    request class per queue — every queue's candidates share ONE signature
+    class and each step places one copy, so the class ladder engages."""
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    reqs = {"qa": 250, "qb": 500, "qc": 750}
+    for i, (q, _) in enumerate(reqs.items()):
+        cache.add_queue(build_queue(q, weight=i + 1))
+    for i in range(4):
+        cache.add_node(build_node(
+            f"n{i}", {"cpu": 8000, "memory": 32 * 2**30, "pods": 110}))
+    g = 0
+    for q, cpu in reqs.items():
+        for _ in range(8):
+            cache.add_pod_group(build_pod_group(
+                f"g{g}", min_member=1, queue=q))
+            cache.add_pod(build_pod(
+                name=f"g{g}-0", req={"cpu": cpu, "memory": 2**30},
+                groupname=f"g{g}"))
+            g += 1
+    return cache
+
+
+def _flavored_engine(cache, monkeypatch, flavor):
+    monkeypatch.setenv("SCHEDULER_TPU_QFAIR", flavor)
+    ssn = open_session(cache, parse_scheduler_conf(MULTIQ_CONF).tiers)
+    return ssn, FusedAllocator(ssn, collect_candidates(ssn))
+
+
+def test_ladder_engaged_codes_match_host_flavor(monkeypatch):
+    """On the engageable shape the device flavor stages the ladder (proved
+    by the engine flag + evidence block) and its placement codes are
+    bitwise the host-flavor delta-chain codes — mega AND XLA anchors."""
+    cache = _ladder_cluster()
+    ssn_d, eng_d = _flavored_engine(cache, monkeypatch, "device")
+    try:
+        assert eng_d.qfair_ladder, f"ladder declined: {eng_d.qfair_reason}"
+        assert eng_d.use_mega
+        mega_codes = eng_d._execute().copy()
+        stats = eng_d.run_stats()
+        qf = stats["qfair"]
+        assert qf["engaged"] is True and qf["flavor"] == "device"
+        assert qf["iterations"] >= 1 and qf["converged_at"] >= 0
+        assert qf["rungs"] >= 2 and qf["classes"] == 3
+        assert qf["ladder_lookups"] > 0, "mega never gathered a rung"
+        eng_d.use_mega = False
+        xla_codes = eng_d._execute().copy()
+    finally:
+        close_session(ssn_d)
+    ssn_h, eng_h = _flavored_engine(cache, monkeypatch, "host")
+    try:
+        assert not eng_h.qfair_ladder
+        host_codes = eng_h._execute().copy()
+        qf_h = eng_h.run_stats()["qfair"]
+        assert qf_h["engaged"] is False
+        assert qf_h["reason"] == "SCHEDULER_TPU_QFAIR=host (kill-switch)"
+    finally:
+        close_session(ssn_h)
+    np.testing.assert_array_equal(mega_codes, host_codes)
+    np.testing.assert_array_equal(xla_codes, host_codes)
+    assert int((mega_codes >= 0).sum()) > 0, "vacuous: nothing placed"
+
+
+def test_ladder_declines_on_gang_shape(monkeypatch):
+    """Multi-copy (gang) placements violate the one-copy-per-step
+    exactness precondition: the ladder must decline WITH the recorded
+    reason while binds ride the delta chain unchanged."""
+    from tests.test_cohort_parity import _spill_cluster
+
+    monkeypatch.setenv("SCHEDULER_TPU_QFAIR", "device")
+    ssn = _spill_cluster(MULTIQ_CONF, queues=("qa", "qb"), n_gangs=4)
+    try:
+        eng = FusedAllocator(ssn, collect_candidates(ssn))
+        assert not eng.qfair_ladder
+        assert "run batching" in eng.qfair_reason
+        qf = eng.run_stats()["qfair"]
+        assert qf["engaged"] is False and "run batching" in qf["reason"]
+    finally:
+        close_session(ssn)
+
+
+# -- 3. cache keying + stale-flavor rejection ---------------------------------
+
+def test_qfair_knobs_registered_in_engine_cache_key():
+    """Both knobs select the traced program (flavor gates the ladder
+    static, the iteration count is the solve's fixed trip count), so a
+    resident engine must be keyed on them."""
+    from scheduler_tpu.ops.engine_cache import _ENV_KEYS
+
+    assert "SCHEDULER_TPU_QFAIR" in _ENV_KEYS
+    assert "SCHEDULER_TPU_QFAIR_ITERS" in _ENV_KEYS
+
+
+def test_delta_compatible_rejects_stale_flavor(monkeypatch):
+    """A direct update() caller flipping the kill-switch must get a
+    rebuild, not a delta refresh of the stale-flavored program."""
+    cache = _ladder_cluster()
+    ssn, eng = _flavored_engine(cache, monkeypatch, "device")
+    try:
+        assert eng._delta_compatible(ssn)
+        monkeypatch.setenv("SCHEDULER_TPU_QFAIR", "host")
+        assert not eng._delta_compatible(ssn)
+        monkeypatch.setenv("SCHEDULER_TPU_QFAIR", "device")
+        assert eng._delta_compatible(ssn)
+    finally:
+        close_session(ssn)
+
+
+# -- 4. deployment twins: mesh shapes and the stacked lane --------------------
+
+def _rand_fleet(rng, q_n=3, r_n=4):
+    """One fleet's solve operands (f64, engine-order): generous pool so the
+    water-fill converges, one queue capped below its slice."""
+    weights = rng.uniform(1.0, 5.0, q_n)
+    request = rng.uniform(100.0, 4000.0, (q_n, r_n))
+    request[0] *= 0.05  # capped endgame: met on an early round
+    req_hs = np.zeros(q_n, dtype=bool)
+    req_hs[1:] = request[1:, 2:].sum(axis=1) > 0
+    total = rng.uniform(2000.0, 9000.0, r_n)
+    mins = np.full(r_n, 1e-2)
+    return {
+        "weights": weights, "request": request, "total": total,
+        "req_has_scalars": req_hs, "total_has_scalars": True, "mins": mins,
+    }
+
+
+def _solo(fleet, mesh=None):
+    return qfair.solve_deserved(
+        fleet["weights"], fleet["request"], fleet["total"],
+        fleet["req_has_scalars"], fleet["total_has_scalars"], fleet["mins"],
+        mesh=mesh,
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 5])
+def test_mesh_twins_match_single_device(seed):
+    """The replicated 1-D (8-device) and 2-D (2x4) shard_map twins must
+    return the single-device solve bitwise — they exist for the sharding
+    gates (zero-collective budget), never for different arithmetic."""
+    from tests.test_mesh2d import make_mesh_2d
+    from tests.test_sharded import make_mesh
+
+    fleet = _rand_fleet(np.random.default_rng(seed))
+    ref = _solo(fleet)
+    assert ref["converged"]
+    for mesh in (make_mesh(), make_mesh_2d()):
+        got = _solo(fleet, mesh=mesh)
+        np.testing.assert_array_equal(
+            ref["deserved"], got["deserved"],
+            err_msg=f"mesh {mesh.devices.shape}")
+        np.testing.assert_array_equal(ref["met"], got["met"])
+        assert got["converged_at"] == ref["converged_at"]
+
+
+@pytest.mark.parametrize("mesh_shape", [None, "1d"])
+def test_stacked_lanes_match_solo_solves(mesh_shape):
+    """K fleets through ``ops/tenant.solve_queue_fair_stacked`` (one
+    lax.map dispatch) return each fleet's solo solve bitwise — batching
+    widens the payload, never the arithmetic."""
+    from scheduler_tpu.ops.tenant import solve_queue_fair_stacked
+
+    rng = np.random.default_rng(42)
+    fleets = [_rand_fleet(rng) for _ in range(3)]
+    mesh = None
+    if mesh_shape == "1d":
+        from tests.test_sharded import make_mesh
+
+        mesh = make_mesh()
+    stacked = solve_queue_fair_stacked(fleets, mesh=mesh)
+    assert len(stacked) == 3
+    for k, fleet in enumerate(fleets):
+        solo = _solo(fleet)
+        np.testing.assert_array_equal(
+            solo["deserved"], stacked[k]["deserved"], err_msg=f"lane {k}")
+        np.testing.assert_array_equal(solo["met"], stacked[k]["met"])
+        assert stacked[k]["converged_at"] == solo["converged_at"]
+        assert stacked[k]["converged"]
+
+
+def test_solve_leaves_x64_disabled():
+    """The solve runs under a scoped enable_x64; the global default must
+    come back f32 (the engines' dtype contract)."""
+    fleet = _rand_fleet(np.random.default_rng(9))
+    _solo(fleet)
+    assert jax.numpy.asarray([1.5]).dtype == jax.numpy.float32

@@ -141,6 +141,94 @@ def test_raw_env_trips_on_tenant_knob_environ_read():
     assert len(out) == 1 and "SCHEDULER_TPU_TENANTS" in out[0].message
 
 
+# -- queue-fair solve knobs (round 17, docs/QUEUE_DELTA.md) -------------------
+
+QFAIR_CACHE_STUB = """
+    _ENV_KEYS = (
+        "SCHEDULER_TPU_MEGA",
+        "SCHEDULER_TPU_QFAIR",
+        "SCHEDULER_TPU_QFAIR_ITERS",
+    )
+"""
+
+
+def test_env_drift_clean_on_registered_qfair_knobs():
+    """The queue-fair knobs are program-selecting twice over: the flavor
+    gates the class-ladder static flag and the iteration count is the
+    traced solve's fixed trip count.  A resident engine must not survive a
+    flip of either, so their ops/ reads are clean exactly because
+    engine_cache registers them (the real tree does — docs/QUEUE_DELTA.md
+    "Class-ladder solve")."""
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": QFAIR_CACHE_STUB,
+        "scheduler_tpu/ops/qfair.py": """
+            from scheduler_tpu.utils.envflags import env_int, env_str
+            def qfair_flavor():
+                return env_str("SCHEDULER_TPU_QFAIR", "device")
+            def qfair_iters():
+                return env_int("SCHEDULER_TPU_QFAIR_ITERS", 0)
+        """,
+    })
+    assert out == []
+
+
+def test_env_drift_trips_on_unregistered_qfair_knob():
+    """The same flavor read WITHOUT the registration is a stale-engine bug:
+    flipping the host/device kill-switch would keep serving the resident
+    ladder-flavored program."""
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+        "scheduler_tpu/ops/qfair.py": """
+            from scheduler_tpu.utils.envflags import env_str
+            def qfair_flavor():
+                return env_str("SCHEDULER_TPU_QFAIR", "device")
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_QFAIR" in out[0].message
+    assert out[0].path == "scheduler_tpu/ops/qfair.py"
+
+
+def test_raw_env_trips_on_qfair_knob_environ_read():
+    out = findings("raw-env", py={
+        "scheduler_tpu/ops/qfair.py": """
+            import os
+            def qfair_flavor():
+                return os.environ.get("SCHEDULER_TPU_QFAIR", "device")
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_QFAIR" in out[0].message
+
+
+def test_raw_env_clean_on_bench_knob_envflags_reads():
+    """The bench-shape knobs (--mq vocab width, churn watch shards) are
+    ordinary prefixed flags read through envflags — the pattern bench.py
+    and connector/reflector.py use — so the pass stays quiet."""
+    out = findings("raw-env", py={
+        "scheduler_tpu/connector/reflector.py": """
+            from scheduler_tpu.utils.envflags import env_int
+            def watch_shards():
+                return max(1, env_int("SCHEDULER_TPU_WATCH_SHARDS", 1))
+        """,
+        "bench.py": """
+            from scheduler_tpu.utils.envflags import env_int
+            def vocab_width(smoke):
+                return env_int("SCHEDULER_TPU_BENCH_VOCAB", 4 if smoke else 16)
+        """,
+    })
+    assert out == []
+
+
+def test_raw_env_trips_on_bench_vocab_environ_read():
+    out = findings("raw-env", py={
+        "bench.py": """
+            import os
+            def vocab_width():
+                return int(os.getenv("SCHEDULER_TPU_BENCH_VOCAB", "16"))
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_BENCH_VOCAB" in out[0].message
+
+
 # -- raw-env ------------------------------------------------------------------
 
 def test_raw_env_trips_on_os_environ_read():

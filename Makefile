@@ -68,8 +68,9 @@ wheel:
 # schedlint: the repo-native static-analysis gate (docs/STATIC_ANALYSIS.md) —
 # engine-flag cache drift, host-sync leaks, donation safety, lock order,
 # doc artifact references, the scratch/stats row-layout registry, the
-# sharding-spec registry, and the generic hygiene lint (one CLI;
-# scripts/lint.py remains as a shim).  The compiled-HLO half of the
+# sharding-spec registry, the obs-channel registry, the v4 flavor-contract
+# registry (`flavors` + `jit-static`), and the generic hygiene lint (one
+# CLI; scripts/lint.py remains as a shim).  The compiled-HLO half of the
 # sharding gate (docs/SHARDING.md) AOT-lowers the sharded engine on a
 # simulated 4-device mesh and counts collectives against the declared
 # per-step budget — CPU-only, no hardware needed.

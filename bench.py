@@ -344,6 +344,7 @@ def mq_main(smoke: bool) -> None:
             ],
             "probes": probes,
             "backend": _backend(),
+            "retrace": _retrace_detail(),
         },
     }))
 
@@ -412,6 +413,7 @@ def churn_main(smoke: bool) -> None:
         else:
             _os.environ["SCHEDULER_TPU_WATCH_SHARDS"] = prev_shards
     doc["detail"]["backend"] = _backend()
+    doc["detail"]["retrace"] = _retrace_detail()
     if not doc["detail"]["cycles_measured"]:
         doc["error"] = (
             "no cycles measured inside the replay window; the artifact "
@@ -455,6 +457,7 @@ def preempt_main(smoke: bool) -> None:
     )
     doc = run_preempt_bench(cfg)
     doc["detail"]["backend"] = _backend()
+    doc["detail"]["retrace"] = _retrace_detail()
     if not doc["detail"]["cycles_measured"]:
         doc["error"] = (
             "the scheduler never drained the storm inside the window; the "
@@ -505,6 +508,7 @@ def tenant_main(smoke: bool) -> None:
     )
     doc = run_tenant_bench(cfg)
     doc["detail"]["backend"] = _backend()
+    doc["detail"]["retrace"] = _retrace_detail()
     if not doc["detail"]["stacked_lanes"]:
         doc["error"] = (
             "no cycle stacked any lanes — every tenant dispatched solo, so "
@@ -795,6 +799,7 @@ def main() -> None:
             ],
             "probes": probes,
             "backend": _backend(),
+            "retrace": _retrace_detail(),
         },
     }))
 
@@ -803,6 +808,16 @@ def _backend() -> str:
     import jax
 
     return str(jax.devices()[0])
+
+
+def _retrace_detail() -> dict:
+    """``detail.retrace`` for every artifact family: the compile-sentinel
+    verdict (docs/STATIC_ANALYSIS.md "The retrace half").  Shape-checked by
+    scripts/bench_gate.py; steady_compiles > 0 on a warm run is the silent
+    recompile regression the sentinel exists to surface."""
+    from scheduler_tpu.utils import retrace
+
+    return retrace.summary()
 
 
 if __name__ == "__main__":

@@ -394,6 +394,14 @@ class AllocateAction(Action):
             phases.note("sig", sig_stats)
         if qfair_stats is not None:
             phases.note("qfair", qfair_stats)
+        # Retrace-sentinel evidence (utils/retrace.py, docs/STATIC_ANALYSIS.md
+        # "The retrace half"): compiles observed under this cycle's
+        # dispatch/readback brackets — a hit cycle reporting steady > 0 is
+        # the silent perf regression the sentinel exists to surface.
+        from scheduler_tpu.utils import retrace
+
+        if retrace.enabled():
+            phases.note("retrace", retrace.take_cycle())
         with phases.phase("decode"):
             items, node_batches, failures = engine.run_columnar()  # reuses codes
         with phases.phase("apply"):

@@ -1,0 +1,552 @@
+"""Pass ``flavors``: the flavor-contract registry (``ops/layout.py``
+``FLAVORS``) cross-walked against code, tests and docs.
+
+Every engine flavor rides the same informal contract — engine-cache key
+membership, a ``_delta_compatible`` re-check, a parity oracle, an owning
+test module, a docs knob row, an OBS evidence channel, a bench family —
+and before v4 nothing verified it end to end: a new flag could ship with
+a test but no doc row, or a doc row but no cache-key registration, and
+only a prod incident would notice.  The registry declares the contract AS
+DATA (one row per ``SCHEDULER_TPU_*`` flag); this pass re-reads it and
+checks, per row:
+
+* schema: the 14 literal keys, a unique prefixed ``flag``, and four
+  claim-XOR-exemption pairs (``parity``/``test``/``obs``/``bench``) —
+  never both, never neither; ``doc`` has no exemption arm;
+* ``env_keys`` matches ``engine_cache._ENV_KEYS`` in BOTH directions;
+* ``delta`` symbols exist in ``FusedAllocator._delta_compatible``;
+* the owning test module exists and mentions the flag;
+* the doc anchor exists and spells the full flag name;
+* the ``obs`` channel is declared in ``utils/obs.py`` ``OBS_CHANNELS``;
+* the ``bench`` family name appears in bench.py or scripts/bench_gate.py;
+
+plus, over the whole analyzed subset:
+
+* every ``SCHEDULER_TPU_*`` read (envflags or raw) has a registry row;
+* every row's flag is read SOMEWHERE (dead-row/typo detector; skipped
+  when the analyzed subset contains no flag reads at all — the
+  ``--changed`` under-approximation rule the other registries use);
+* the generated knob table in docs/STATIC_ANALYSIS.md matches the
+  registry (rendered between ``layout:FLAVORS`` markers by the SAME
+  renderer scripts/gen_layout_doc.py writes with).
+
+Pass ``jit-static``: the runtime retrace sentinel's static companion.
+``utils/retrace.py`` catches steady-state recompiles at run time; this
+rule catches the classic cause at review time — a ``jax.jit`` static
+argument fed from a per-cycle or unhashable value, which retriggers
+tracing on every call (unhashables raise; fresh timestamps silently
+compile a new executable each cycle).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple, Union
+
+from scheduler_tpu.analysis.core import (
+    Finding, PyModule, Repo, const_ints, const_str, dotted, register,
+)
+from scheduler_tpu.analysis.env_drift import (
+    ENV_PREFIX, flag_reads, registered_keys,
+)
+from scheduler_tpu.analysis.obs_channels import channels_from_tree
+from scheduler_tpu.analysis.row_layout import marker_lines
+
+RULE = "flavors"
+JIT_RULE = "jit-static"
+FLAVORS_MODULE = "ops/layout.py"
+TABLE_NAME = "FLAVORS"
+FLAVORS_DOC = "docs/STATIC_ANALYSIS.md"
+TABLE_NS = "FLAVORS"
+OBS_MODULE = "utils/obs.py"
+FUSED_MODULE = "ops/fused.py"
+DELTA_METHOD = "_delta_compatible"
+BENCH_SUFFIXES = ("bench.py", "scripts/bench_gate.py")
+# The four claim-XOR-exemption pairs; ``doc`` deliberately has no
+# exemption arm — every flag gets a knob row somewhere.
+XOR_PAIRS = (
+    ("parity", "parity_exempt"),
+    ("test", "test_exempt"),
+    ("obs", "obs_exempt"),
+    ("bench", "bench_exempt"),
+)
+ROW_KEYS = {
+    "flag", "values", "default", "env_keys", "delta", "doc",
+    "parity", "parity_exempt", "test", "test_exempt",
+    "obs", "obs_exempt", "bench", "bench_exempt",
+}
+
+RowValue = Union[str, bool, None]
+
+
+def _module_at(repo: Repo, suffix: str) -> Optional[PyModule]:
+    for m in repo.modules:
+        if m.path == suffix or m.path.endswith("/" + suffix):
+            return m
+    return None
+
+
+def _registry_node(tree: ast.AST) -> Optional[ast.Assign]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == TABLE_NAME:
+                    return node
+    return None
+
+
+def _literal_row(elt: ast.AST) -> Optional[Dict[str, RowValue]]:
+    """Like the OBS_CHANNELS row parser, plus bool values — ``env_keys``
+    is a claim, not a string."""
+    if not isinstance(elt, ast.Dict):
+        return None
+    row: Dict[str, RowValue] = {}
+    for k, v in zip(elt.keys, elt.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        if isinstance(v, ast.Constant) and (
+            v.value is None or isinstance(v.value, (str, bool))
+        ):
+            row[k.value] = v.value
+        else:
+            # ast.BinOp (explicit ``+`` concatenation) and anything
+            # computed: not literal data, the gate reports it.
+            return None
+    return row
+
+
+def flavors_from_tree(tree: ast.AST) -> Optional[List[Dict[str, RowValue]]]:
+    """The registry rows AS DATA, or None when the literal is missing or
+    not fully literal (the gate then reports that instead of guessing)."""
+    node = _registry_node(tree)
+    if node is None or not isinstance(node.value, (ast.Tuple, ast.List)):
+        return None
+    rows = []
+    for elt in node.value.elts:
+        row = _literal_row(elt)
+        if row is None:
+            return None
+        rows.append(row)
+    return rows
+
+
+def flavors_from_source(source: str) -> Optional[List[Dict[str, RowValue]]]:
+    return flavors_from_tree(ast.parse(source))
+
+
+def _mentions(text: str, flag: str) -> bool:
+    """The FULL flag name, not a prefix of a longer one — a doc row for
+    SCHEDULER_TPU_TRIGGER_MIN_MS must not satisfy SCHEDULER_TPU_TRIGGER."""
+    return re.search(re.escape(flag) + r"(?![A-Z_])", text) is not None
+
+
+def _cell(row: Dict[str, RowValue], claim: str, code: bool = True) -> str:
+    val = row.get(claim)
+    if val:
+        return f"`{val}`" if code else str(val)
+    exempt = row.get(claim + "_exempt")
+    return f"exempt: {exempt}" if exempt else "—"
+
+
+def render_flavors_table(rows: List[Dict[str, RowValue]]) -> List[str]:
+    """The doc table (docs/STATIC_ANALYSIS.md) — ONE renderer shared with
+    scripts/gen_layout_doc.py so doc and gate can never disagree."""
+    out = [
+        "| flag | values | default | cache key | delta re-check "
+        "| parity oracle | owning test | doc anchor | obs channel "
+        "| bench family |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for row in sorted(rows, key=lambda r: str(r.get("flag") or "")):
+        delta = row.get("delta")
+        out.append(
+            "| `{}` | {} | {} | {} | {} | {} | {} | `{}` | {} | {} |".format(
+                row.get("flag", "?"),
+                row.get("values") or "—",
+                row.get("default") or "—",
+                "yes" if row.get("env_keys") else "—",
+                f"`{delta}`" if delta else "—",
+                _cell(row, "parity", code=False),
+                _cell(row, "test"),
+                row.get("doc", "?"),
+                _cell(row, "obs"),
+                _cell(row, "bench", code=False),
+            )
+        )
+    return out
+
+
+def _delta_symbols(fused: PyModule) -> Optional[Set[str]]:
+    """Every Name/Attribute symbol the ``_delta_compatible`` body touches
+    (None when the method is missing — the gate reports that)."""
+    for node in ast.walk(fused.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == DELTA_METHOD:
+            out: Set[str] = set()
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+                elif isinstance(n, ast.Attribute):
+                    out.add(n.attr)
+            return out
+    return None
+
+
+@register(RULE)
+def flavors(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    layout = _module_at(repo, FLAVORS_MODULE)
+
+    reads: List[Tuple[str, int, str]] = []
+    for mod in repo.modules:
+        if mod.path.startswith("tests/") or "/tests/" in mod.path:
+            continue  # fixture corpora embed flag reads as data
+        for line, flag, _ in flag_reads(mod):
+            if flag.startswith(ENV_PREFIX):
+                reads.append((mod.path, line, flag))
+
+    if layout is None:
+        if reads:
+            path, line, flag = reads[0]
+            out.append(Finding(
+                RULE, path, line,
+                f"{flag} is read but {FLAVORS_MODULE} (the {TABLE_NAME} "
+                "flavor-contract registry) is not in the analyzed set",
+            ))
+        return out
+
+    rows = flavors_from_tree(layout.tree)
+    if rows is None:
+        out.append(Finding(
+            RULE, layout.path, 1,
+            f"cannot resolve {TABLE_NAME} as literal data: the "
+            "flavor-contract registry must stay a tuple of dicts with "
+            "constant keys and str/bool/None values",
+        ))
+        return out
+
+    declared: Dict[str, Dict[str, RowValue]] = {}
+    for row in rows:
+        flag = row.get("flag")
+        if not isinstance(flag, str) or not flag:
+            out.append(Finding(
+                RULE, layout.path, 1,
+                f"{TABLE_NAME} row without a 'flag' key: {row}",
+            ))
+            continue
+        if not flag.startswith(ENV_PREFIX):
+            out.append(Finding(
+                RULE, layout.path, 1,
+                f"{TABLE_NAME} flag '{flag}' lacks the {ENV_PREFIX} prefix",
+            ))
+        if set(row) != ROW_KEYS:
+            missing = sorted(ROW_KEYS - set(row))
+            extra = sorted(set(row) - ROW_KEYS)
+            out.append(Finding(
+                RULE, layout.path, 1,
+                f"flag '{flag}': registry row schema drift "
+                f"(missing {missing}, unexpected {extra})",
+            ))
+        if flag in declared:
+            out.append(Finding(
+                RULE, layout.path, 1,
+                f"flag '{flag}' declared twice in {TABLE_NAME}",
+            ))
+        declared[flag] = row
+        for claim, exempt in XOR_PAIRS:
+            if bool(row.get(claim)) == bool(row.get(exempt)):
+                out.append(Finding(
+                    RULE, layout.path, 1,
+                    f"flag '{flag}': must claim a '{claim}' XOR document "
+                    f"a '{exempt}' reason",
+                ))
+        if not row.get("doc"):
+            out.append(Finding(
+                RULE, layout.path, 1,
+                f"flag '{flag}': 'doc' anchor is required — every flag "
+                "gets a knob row somewhere; there is no doc exemption",
+            ))
+
+    # -- env_keys claims vs engine_cache._ENV_KEYS, both directions --------
+    keys = registered_keys(repo)
+    if keys is not None:
+        for flag, row in sorted(declared.items()):
+            if row.get("env_keys") and flag not in keys:
+                out.append(Finding(
+                    RULE, layout.path, 1,
+                    f"flag '{flag}': row claims engine-cache key "
+                    "membership but the flag is not in "
+                    "engine_cache._ENV_KEYS",
+                ))
+        for flag in sorted(k for k in keys if k.startswith(ENV_PREFIX)):
+            row = declared.get(flag)
+            if row is not None and not row.get("env_keys"):
+                out.append(Finding(
+                    RULE, layout.path, 1,
+                    f"flag '{flag}' is in engine_cache._ENV_KEYS but its "
+                    f"{TABLE_NAME} row claims env_keys=False",
+                ))
+
+    # -- delta claims vs FusedAllocator._delta_compatible ------------------
+    fused = _module_at(repo, FUSED_MODULE)
+    if fused is not None:
+        symbols = _delta_symbols(fused)
+        for flag, row in sorted(declared.items()):
+            delta = row.get("delta")
+            if not delta:
+                continue
+            if symbols is None:
+                out.append(Finding(
+                    RULE, layout.path, 1,
+                    f"flag '{flag}': claims a {DELTA_METHOD} re-check but "
+                    f"{FUSED_MODULE} has no {DELTA_METHOD} method",
+                ))
+                break
+            if delta not in symbols:
+                out.append(Finding(
+                    RULE, layout.path, 1,
+                    f"flag '{flag}': claimed delta symbol '{delta}' does "
+                    f"not appear in FusedAllocator.{DELTA_METHOD}",
+                ))
+
+    # -- owning test module exists and mentions the flag --------------------
+    has_tests = any(
+        m.path.startswith("tests/") or "/tests/" in m.path
+        for m in repo.modules
+    )
+    if has_tests:
+        for flag, row in sorted(declared.items()):
+            test = row.get("test")
+            if not isinstance(test, str) or not test:
+                continue
+            mod = next((m for m in repo.modules if m.path == test), None)
+            if mod is None:
+                out.append(Finding(
+                    RULE, layout.path, 1,
+                    f"flag '{flag}': owning test module '{test}' is not in "
+                    "the analyzed tree",
+                ))
+            elif not _mentions(mod.text, flag):
+                out.append(Finding(
+                    RULE, layout.path, 1,
+                    f"flag '{flag}': owning test module '{test}' never "
+                    "mentions the flag",
+                ))
+
+    # -- doc anchor exists and spells the full flag name --------------------
+    if repo.docs:
+        docs_by_path = {d.path: d for d in repo.docs}
+        for flag, row in sorted(declared.items()):
+            doc_path = row.get("doc")
+            if not isinstance(doc_path, str) or not doc_path:
+                continue
+            doc = docs_by_path.get(doc_path)
+            if doc is None:
+                if not repo.exists(doc_path):
+                    out.append(Finding(
+                        RULE, layout.path, 1,
+                        f"flag '{flag}': doc anchor '{doc_path}' does not "
+                        "exist",
+                    ))
+            elif not _mentions(doc.text, flag):
+                out.append(Finding(
+                    RULE, layout.path, 1,
+                    f"flag '{flag}': doc anchor '{doc_path}' never spells "
+                    "the full flag name (a combined shorthand row does not "
+                    "count — operators grep for the exact key)",
+                ))
+
+    # -- obs claims vs the OBS_CHANNELS registry ----------------------------
+    obs_mod = _module_at(repo, OBS_MODULE)
+    if obs_mod is not None:
+        channel_rows = channels_from_tree(obs_mod.tree) or []
+        channels = {r.get("channel") for r in channel_rows}
+        for flag, row in sorted(declared.items()):
+            obs = row.get("obs")
+            if obs and obs not in channels:
+                out.append(Finding(
+                    RULE, layout.path, 1,
+                    f"flag '{flag}': claimed obs channel '{obs}' is not "
+                    f"declared in {OBS_MODULE} OBS_CHANNELS",
+                ))
+
+    # -- bench family names appear in the bench harness or its gate --------
+    bench_mods = [
+        m for s in BENCH_SUFFIXES for m in [_module_at(repo, s)] if m
+    ]
+    if bench_mods:
+        bench_text = "\n".join(m.text for m in bench_mods)
+        for flag, row in sorted(declared.items()):
+            family = row.get("bench")
+            if family and f'"{family}"' not in bench_text:
+                out.append(Finding(
+                    RULE, layout.path, 1,
+                    f"flag '{flag}': claimed bench family '{family}' does "
+                    "not appear in "
+                    f"{' or '.join(BENCH_SUFFIXES)}",
+                ))
+
+    # -- every read registered; every row read somewhere --------------------
+    for path, line, flag in reads:
+        if flag not in declared:
+            out.append(Finding(
+                RULE, path, line,
+                f"{flag} is read but has no {TABLE_NAME} row in "
+                f"{FLAVORS_MODULE}: every flavor flag must declare its "
+                "contract (cache key, parity, test, doc, obs, bench — "
+                "or documented exemptions)",
+            ))
+    read_flags = {flag for _, _, flag in reads}
+    if read_flags:
+        for flag in sorted(set(declared) - read_flags):
+            out.append(Finding(
+                RULE, layout.path, 1,
+                f"flag '{flag}' has a {TABLE_NAME} row but nothing reads "
+                "it (dead registry row or typo)",
+            ))
+
+    # -- generated doc table drift (the gen_layout_doc renderer contract) --
+    doc = next((d for d in repo.docs if d.path == FLAVORS_DOC), None)
+    if doc is not None:
+        table = render_flavors_table(rows)
+        begin, end = marker_lines(TABLE_NS)
+        lines = doc.text.splitlines()
+        try:
+            b = lines.index(begin)
+            e = lines.index(end, b)
+        except ValueError:
+            out.append(Finding(
+                RULE, doc.path, 1,
+                f"missing generated flavor table for {TABLE_NS} (run "
+                "scripts/gen_layout_doc.py)",
+            ))
+        else:
+            got = [ln.strip() for ln in lines[b + 1: e] if ln.strip()]
+            if got != table:
+                out.append(Finding(
+                    RULE, doc.path, b + 1,
+                    f"{TABLE_NS} flavor table is stale (run "
+                    "scripts/gen_layout_doc.py)",
+                ))
+    return out
+
+
+# -- jit-static: the retrace sentinel's review-time companion -----------------
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_CLOCK_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time", "time.time_ns", "time.monotonic_ns",
+    "time.perf_counter_ns",
+}
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp, ast.GeneratorExp)
+
+
+def _jit_static_spec(call: ast.Call) -> Optional[Tuple[Set[int], Set[str]]]:
+    """(static positions, static names) when ``call`` is a jax.jit — or a
+    partial(jax.jit, ...) — with static arguments, else None."""
+    fn = dotted(call.func)
+    if fn is None:
+        return None
+    target = fn
+    if fn.rsplit(".", 1)[-1] == "partial":
+        if not call.args:
+            return None
+        inner = dotted(call.args[0])
+        if inner not in _JIT_NAMES:
+            return None
+        target = inner
+    if target not in _JIT_NAMES:
+        return None
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums |= const_ints(kw.value)
+        elif kw.arg == "static_argnames":
+            one = const_str(kw.value)
+            if one:
+                names.add(one)
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                names |= {
+                    s for e in kw.value.elts
+                    for s in [const_str(e)] if s
+                }
+    if not nums and not names:
+        return None
+    return nums, names
+
+
+def _jitted_functions(mod: PyModule) -> Dict[str, Tuple[Set[int], Set[str]]]:
+    """Local names bound to a jit-with-static-args callable: plain
+    assignments AND decorated defs (a decorated def's own calls take the
+    def's signature; positions still line up because jit preserves them)."""
+    out: Dict[str, Tuple[Set[int], Set[str]]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            spec = _jit_static_spec(node.value)
+            if spec is None:
+                continue
+            for tgt in node.targets:
+                name = dotted(tgt)
+                if name:
+                    out[name] = spec
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if isinstance(deco, ast.Call):
+                    spec = _jit_static_spec(deco)
+                    if spec is not None:
+                        out[node.name] = spec
+    return out
+
+
+def _static_value_problem(node: ast.AST) -> Optional[str]:
+    if isinstance(node, _UNHASHABLE):
+        return (
+            "an unhashable literal — jit static args must be hashable; "
+            "this raises (or, via a hashable wrapper, retraces every call)"
+        )
+    if isinstance(node, ast.Call):
+        fn = dotted(node.func)
+        if fn in _CLOCK_CALLS:
+            return (
+                f"a fresh {fn}() value — a per-cycle static arg retraces "
+                "and recompiles on EVERY dispatch (the steady-state "
+                "recompile class SCHEDULER_TPU_RETRACE=guard trips at "
+                "run time)"
+            )
+    return None
+
+
+@register(JIT_RULE)
+def jit_static(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in repo.modules:
+        if mod.path.startswith("tests/") or "/tests/" in mod.path:
+            continue  # fixture corpora embed jit calls as data
+        jitted = _jitted_functions(mod)
+        if not jitted:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted(node.func)
+            if fn is None or fn not in jitted:
+                continue
+            nums, names = jitted[fn]
+            suspects: List[Tuple[ast.AST, str]] = []
+            for i, arg in enumerate(node.args):
+                if i in nums:
+                    suspects.append((arg, f"position {i}"))
+            for kw in node.keywords:
+                if kw.arg in names:
+                    suspects.append((kw.value, f"'{kw.arg}'"))
+            for value, where in suspects:
+                problem = _static_value_problem(value)
+                if problem:
+                    out.append(Finding(
+                        JIT_RULE, mod.path, node.lineno,
+                        f"static jit arg {where} of {fn}() is fed {problem}",
+                    ))
+    return out

@@ -3,6 +3,7 @@
 from scheduler_tpu.analysis import doc_refs  # noqa: F401
 from scheduler_tpu.analysis import donation  # noqa: F401
 from scheduler_tpu.analysis import env_drift  # noqa: F401
+from scheduler_tpu.analysis import flavors  # noqa: F401
 from scheduler_tpu.analysis import host_sync  # noqa: F401
 from scheduler_tpu.analysis import hygiene  # noqa: F401
 from scheduler_tpu.analysis import lock_order  # noqa: F401

@@ -156,6 +156,13 @@ _ENV_KEYS = (
     # (re-checked by _delta_compatible for direct update() callers).
     "SCHEDULER_TPU_TENANTS",
     "SCHEDULER_TPU_WATCH_SHARDS",
+    # Retrace sentinel (utils/retrace.py, docs/STATIC_ANALYSIS.md "The
+    # retrace half").  The sentinel never changes a traced program — it only
+    # counts compile events around dispatch/readback — but, the SHARDCHECK
+    # precedent, a resident engine must not straddle a diagnostics-regime
+    # flip mid-process: a guard-mode cycle should always start from a build
+    # whose hit path was watched from the first dispatch.
+    "SCHEDULER_TPU_RETRACE",
 )
 
 _scope_counter = itertools.count(1)
@@ -332,6 +339,10 @@ class EngineCache:
                 ssn, jobs, token, eager_dispatch=eager_dispatch
             )
         engine._cache_key = key
+        # The retrace sentinel (utils/retrace.py) brackets this engine's
+        # dispatch/readback launches with the outcome: only HIT cycles carry
+        # the zero-new-executables contract.
+        engine._cache_status = status
         with self._lock:
             tsan.access(self._tsan_counters)
             if status == "hit":

@@ -1050,6 +1050,11 @@ class FusedAllocator:
         self._stats_raw = None    # collected evidence of the last readback
         self._encoded = None      # decoded int32 codes of the last readback
         self._layout_token = None  # ops/engine_cache.py layout fingerprint
+        # Engine-cache outcome of the cycle serving this engine (engine_cache
+        # stamps "hit"/"rebuild"/"miss"): the retrace sentinel
+        # (utils/retrace.py) only holds HIT cycles to the zero-new-
+        # executables contract — a fresh build is expected to compile.
+        self._cache_status = "build"
         self._job_uids = None     # survives release(); _rebind restores jobs
         # Cohort evidence (docs/COHORT.md): host-side cohort table summary
         # (filled where the run merge is computed) + the resolved chunk count.
@@ -1724,6 +1729,14 @@ class FusedAllocator:
                                    mesh=mesh)
         if mesh is not None and not self.use_mega:
             _ = self.args  # sharded XLA sessions run eagerly-built args
+        if self.n_bucket <= 30000 and (self._mesh is None or self.use_mega):
+            # Pre-warm the readback narrowing jit for this engine's codes
+            # shape: a daemon's build cycle pays this compile in its own
+            # readback, but a cache-warmed engine (harness.warm_engine)
+            # would otherwise pay it inside the FIRST HIT cycle's retrace
+            # bracket (utils/retrace.py) — builds pay every compile, hits
+            # pay none.
+            _narrow16(jnp.zeros(self._t_bucket, jnp.int32))
 
     def _static_signature_ids(self, ssn) -> Optional[np.ndarray]:
         """Dense per-task STATIC-signature ids: tasks sharing (selector row,
@@ -2815,7 +2828,7 @@ class FusedAllocator:
         bookkeeping) before paying the blocking collect."""
         if self._dev is not None:
             return
-        from scheduler_tpu.utils import sanitize, shardcheck
+        from scheduler_tpu.utils import retrace, sanitize, shardcheck
 
         if self.use_lp:
             self._dispatch_lp()
@@ -2827,7 +2840,13 @@ class FusedAllocator:
             # (docs/DEVICE_ENGINE.md): every position checks as replicated.
             shardcheck.check_dispatch(self._mesh, self._mega_args, families=())
             try:
-                with sanitize.guard():
+                # The retrace sentinel brackets the launch alongside the
+                # transfer guard: a guard-mode trip (RetraceError) raised
+                # here is recognized by sanitize.is_violation below, so the
+                # mega -> XLA fallback RE-RAISES it instead of retracing
+                # again on the fallback path.
+                with sanitize.guard(), \
+                        retrace.watch(self._cache_status == "hit"):
                     self._dev, self._dev_stats = _mk.mega_allocate(
                         *self._mega_args, **self._mega_kw
                     )
@@ -2847,7 +2866,7 @@ class FusedAllocator:
         # guard: every program input must already be device-resident (the
         # engine stages via transfer_cache.to_device / device_put), so an
         # implicit host->device upload here is a staging bug, not traffic.
-        with sanitize.guard():
+        with sanitize.guard(), retrace.watch(self._cache_status == "hit"):
             self._dev = fused_allocate(
                 *self.args,
                 comparators=self.comparators,
@@ -2881,7 +2900,7 @@ class FusedAllocator:
         so the whole chain enqueues asynchronously; ``readback`` collects.
         """
         from scheduler_tpu.ops import lp_place
-        from scheduler_tpu.utils import sanitize, shardcheck
+        from scheduler_tpu.utils import retrace, sanitize, shardcheck
 
         self._dev_stats = None
         args = self.args
@@ -2895,7 +2914,7 @@ class FusedAllocator:
             use_static=self.use_static,
             mesh=self._lp_mesh,
         )
-        with sanitize.guard():
+        with sanitize.guard(), retrace.watch(self._cache_status == "hit"):
             if self.sig_compress and self._lp_sig_host is not None:
                 # Signature-compressed relaxation (docs/LP_PLACEMENT.md
                 # "Signature classes"): iterate over the [S, N] class
@@ -3053,14 +3072,19 @@ class FusedAllocator:
             self.dispatch()
         dev, self._dev = self._dev, None
         stats_dev, self._dev_stats = self._dev_stats, None
-        from scheduler_tpu.utils import sanitize, shardcheck
+        from scheduler_tpu.utils import retrace, sanitize, shardcheck
 
         # Placement codes and stats are per-task/per-counter values: they
         # must come back replicated, never node-sharded (out_specs drift).
         shardcheck.check_result(self._mesh, dev)
         shardcheck.check_result(self._mesh, stats_dev, where="readback.stats")
         try:
-            with sanitize.guard():
+            # Retrace bracket: a hit cycle's blocking collect must not
+            # compile either (a drifted donated buffer or host fallback
+            # would surface here); a guard trip re-raises through the mega
+            # fallback below because sanitize.is_violation knows it.
+            with sanitize.guard(), \
+                    retrace.watch(self._cache_status == "hit"):
                 if self.use_lp and self._lp_dev is not None:
                     # LP evidence first: the tiny (pref, lp_raw) fetch
                     # serializes on the relaxation program, so the wall

@@ -675,6 +675,955 @@ SHARD_DOC_ROWS = {
 }
 
 
+# -- flavor-contract registry (schedlint ``flavors`` pass; schedlint v4) ------
+#
+# Every engine flavor and knob is bound by the same informal contract —
+# env key in ``engine_cache._ENV_KEYS`` when the resident engine must be
+# pinned to it, a ``_delta_compatible`` re-check when direct update()
+# callers can race a flip, a host/kill-switch parity oracle, an owning
+# parity-test module, a docs knob-row anchor, an OBS evidence channel and a
+# bench family — and nothing machine-verified it end to end.  This table is
+# that contract AS DATA, one row per ``SCHEDULER_TPU_*`` flag; the
+# ``flavors`` pass (analysis/flavors.py, docs/STATIC_ANALYSIS.md) re-reads
+# it and cross-walks code, tests and docs:
+#
+# * ``flag``        — the env key (every read in the tree must have a row);
+# * ``values`` / ``default`` — the allowed values and resolved default
+#   (documentation columns of the generated table);
+# * ``env_keys``    — claimed ``engine_cache._ENV_KEYS`` membership,
+#   verified in BOTH directions;
+# * ``delta``       — the symbol ``FusedAllocator._delta_compatible``
+#   re-checks this flavor through (None: not re-checked), verified against
+#   the method body;
+# * ``parity`` XOR ``parity_exempt`` — the oracle the flavor is
+#   bit-compared against, or why none exists;
+# * ``test`` XOR ``test_exempt`` — the owning test module (must exist and
+#   mention the flag), or why a unit test does not apply;
+# * ``doc``         — the knob-row anchor (must exist and mention the flag);
+# * ``obs`` XOR ``obs_exempt`` — the OBS_CHANNELS evidence channel, or why
+#   the flavor leaves no per-cycle note;
+# * ``bench`` XOR ``bench_exempt`` — the bench/gate family that exercises
+#   the flavor (the name must appear in bench.py or scripts/bench_gate.py),
+#   or why no artifact family covers it.
+#
+# The generated knob table renders between ``layout:FLAVORS`` markers in
+# FLAVORS_DOC (scripts/gen_layout_doc.py; drift-checked by the pass).
+
+FLAVORS_DOC = "docs/STATIC_ANALYSIS.md"
+
+FLAVORS = (
+    {
+        "flag": "SCHEDULER_TPU_ALLOCATOR",
+        "values": "greedy|lp", "default": "greedy",
+        "env_keys": True, "delta": "allocator_flavor",
+        "parity": "greedy argmax engines (lp-vs-greedy quality gate)",
+        "parity_exempt": None,
+        "test": "tests/test_lp_place.py", "test_exempt": None,
+        "doc": "docs/LP_PLACEMENT.md",
+        "obs": "lp", "obs_exempt": None,
+        "bench": "lp-allocator", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_BENCH_GANG",
+        "values": "int>=1", "default": "100",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "runs, not unit tests",
+        "doc": "README.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "flagship", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_BENCH_NODES",
+        "values": "int>=1", "default": "10000 (100 smoke, 100k --xl)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "runs, not unit tests",
+        "doc": "README.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "flagship", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_BENCH_PODS",
+        "values": "int>=1", "default": "100000 (500 smoke, 1M --xl)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "runs, not unit tests",
+        "doc": "README.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "flagship", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_BENCH_QUEUES",
+        "values": "int>=1", "default": "1 (3 under --mq)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "runs, not unit tests",
+        "doc": "docs/QUEUE_DELTA.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "MQ", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_BENCH_VOCAB",
+        "values": "int>=1", "default": "16 (4 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "runs, not unit tests",
+        "doc": "docs/QUEUE_DELTA.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "flagship", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_BULK",
+        "values": "bool", "default": "1",
+        "env_keys": False, "delta": None,
+        "parity": "per-task session ops (bitwise commit parity)",
+        "parity_exempt": None,
+        "test": "tests/test_bulk.py", "test_exempt": None,
+        "doc": "README.md",
+        "obs": None,
+        "obs_exempt": "commit-path kill switch; no per-cycle evidence",
+        "bench": None,
+        "bench_exempt": "reference commit path; never a bench regime",
+    },
+    {
+        "flag": "SCHEDULER_TPU_BURST",
+        "values": "int>=1", "default": "ceil(QPS)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "client-side rate-limiter burst; no engine twin",
+        "test": "tests/test_rate_limit.py", "test_exempt": None,
+        "doc": "docs/INGEST.md",
+        "obs": None,
+        "obs_exempt": "ingestion throttle; no per-cycle evidence",
+        "bench": None,
+        "bench_exempt": "ingestion throttle; bench scenarios pace arrivals "
+                        "themselves",
+    },
+    {
+        "flag": "SCHEDULER_TPU_CHURN_DURATION",
+        "values": "float s", "default": "8.0 (1.5 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--churn runs, not unit tests",
+        "doc": "docs/CHURN.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "churn", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_CHURN_HIT_FLOOR",
+        "values": "float 0..1", "default": "0.25",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "gate threshold knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench gate threshold; exercised by bench.py "
+                       "--churn runs, not unit tests",
+        "doc": "docs/CHURN.md",
+        "obs": None,
+        "obs_exempt": "gate threshold; the hit rate itself rides the "
+                      "artifact",
+        "bench": "churn", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_CHURN_NODES",
+        "values": "int>=1", "default": "200 (32 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--churn runs, not unit tests",
+        "doc": "docs/CHURN.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "churn", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_CHURN_PODS",
+        "values": "int>=1", "default": "2000 (200 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--churn runs, not unit tests",
+        "doc": "docs/CHURN.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "churn", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_CHURN_RATE",
+        "values": "float events/s", "default": "2000 (150 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--churn runs, not unit tests",
+        "doc": "docs/CHURN.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "churn", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_CHURN_SEED",
+        "values": "int", "default": "0",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness seed; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness seed; exercised by bench.py --churn "
+                       "runs, not unit tests",
+        "doc": "docs/CHURN.md",
+        "obs": None, "obs_exempt": "harness seed; recorded on the artifact",
+        "bench": "churn", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_COHORT",
+        "values": "auto|int>=1", "default": "auto",
+        "env_keys": True, "delta": None,
+        "parity": "per-task placement parity (cohort chunks bit-identical)",
+        "parity_exempt": None,
+        "test": "tests/test_cohort_parity.py", "test_exempt": None,
+        "doc": "docs/COHORT.md",
+        "obs": "cohort", "obs_exempt": None,
+        "bench": "flagship", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_DEBOUNCE_MS",
+        "values": "float ms", "default": "25",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "pacing never changes binds (the event-vs-period "
+                         "oracle rides SCHEDULER_TPU_TRIGGER)",
+        "test": "tests/test_trigger.py", "test_exempt": None,
+        "doc": "docs/CHURN.md",
+        "obs": None,
+        "obs_exempt": "pacing knob; cadence is visible in cycle timings",
+        "bench": "churn", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_DEVICE",
+        "values": "bool", "default": "1",
+        "env_keys": False, "delta": None,
+        "parity": "pure host reference path (plugin-for-plugin)",
+        "parity_exempt": None,
+        "test": "tests/test_allocate.py", "test_exempt": None,
+        "doc": "README.md",
+        "obs": None,
+        "obs_exempt": "global kill switch; no per-cycle evidence of its own",
+        "bench": None,
+        "bench_exempt": "global kill switch; bench runs the device path",
+    },
+    {
+        "flag": "SCHEDULER_TPU_DIRTY_DELTA",
+        "values": "bool", "default": "1",
+        "env_keys": True, "delta": None,
+        "parity": "full-tensor diff refresh (content-exact)",
+        "parity_exempt": None,
+        "test": "tests/test_churn.py", "test_exempt": None,
+        "doc": "docs/ENGINE_CACHE.md",
+        "obs": "dirty", "obs_exempt": None,
+        "bench": "churn", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_ENGINE_CACHE",
+        "values": "bool", "default": "1",
+        "env_keys": False, "delta": None,
+        "parity": "cold rebuild every cycle (cache-off parity)",
+        "parity_exempt": None,
+        "test": "tests/test_engine_cache_parity.py", "test_exempt": None,
+        "doc": "docs/ENGINE_CACHE.md",
+        "obs": "engine_cache", "obs_exempt": None,
+        "bench": "flagship", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_ENGINE_CACHE_ENTRIES",
+        "values": "int>=1", "default": "2",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "LRU capacity; eviction is content-neutral",
+        "test": "tests/test_envflags.py", "test_exempt": None,
+        "doc": "docs/ENGINE_CACHE.md",
+        "obs": None,
+        "obs_exempt": "capacity knob; outcomes ride the engine_cache channel",
+        "bench": None,
+        "bench_exempt": "capacity knob; never a bench regime",
+    },
+    {
+        "flag": "SCHEDULER_TPU_EVICT",
+        "values": "host|device", "default": "host",
+        "env_keys": True, "delta": "evict_flavor",
+        "parity": "host per-node victim walk", "parity_exempt": None,
+        "test": "tests/test_evict_parity.py", "test_exempt": None,
+        "doc": "docs/PREEMPT.md",
+        "obs": "evict", "obs_exempt": None,
+        "bench": "preempt", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_FUSED",
+        "values": "bool", "default": "1",
+        "env_keys": False, "delta": None,
+        "parity": "per-pop lax.scan engine", "parity_exempt": None,
+        "test": "tests/test_fused.py", "test_exempt": None,
+        "doc": "README.md",
+        "obs": None,
+        "obs_exempt": "engine choice rides the cohort channel's engine field",
+        "bench": None,
+        "bench_exempt": "kill switch; bench runs the fused program",
+    },
+    {
+        "flag": "SCHEDULER_TPU_FUSED_STATIC_LIMIT",
+        "values": "int bytes", "default": "160 MiB",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "admission gate, not a program flavor; either side "
+                         "of the gate is a tested engine",
+        "test": "tests/test_envflags.py", "test_exempt": None,
+        "doc": "docs/DEVICE_ENGINE.md",
+        "obs": None,
+        "obs_exempt": "admission knob; engine choice rides the cohort "
+                      "channel's engine field",
+        "bench": None,
+        "bench_exempt": "admission knob; never a bench family of its own",
+    },
+    {
+        "flag": "SCHEDULER_TPU_GC_FREEZE",
+        "values": "bool", "default": "1",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "GC pause shaping; collection timing never changes "
+                         "binds",
+        "test": "tests/test_envflags.py", "test_exempt": None,
+        "doc": "README.md",
+        "obs": None,
+        "obs_exempt": "GC pauses surface in cycle wall times",
+        "bench": None,
+        "bench_exempt": "host GC regime; artifacts already record wall times",
+    },
+    {
+        "flag": "SCHEDULER_TPU_LP_ITERS",
+        "values": "int>=1", "default": "200",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "LP solve knob; flavor parity rides "
+                         "SCHEDULER_TPU_ALLOCATOR",
+        "test": "tests/test_lp_place.py", "test_exempt": None,
+        "doc": "docs/LP_PLACEMENT.md",
+        "obs": "lp", "obs_exempt": None,
+        "bench": "lp-allocator", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_LP_LIMIT",
+        "values": "int bytes", "default": "256 MiB",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "LP admission gate; flavor parity rides "
+                         "SCHEDULER_TPU_ALLOCATOR",
+        "test": "tests/test_lp_place.py", "test_exempt": None,
+        "doc": "docs/LP_PLACEMENT.md",
+        "obs": "lp", "obs_exempt": None,
+        "bench": "lp-allocator", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_LP_TAU",
+        "values": "float>0", "default": "0.25",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "LP solve knob; flavor parity rides "
+                         "SCHEDULER_TPU_ALLOCATOR",
+        "test": "tests/test_lp_place.py", "test_exempt": None,
+        "doc": "docs/LP_PLACEMENT.md",
+        "obs": "lp", "obs_exempt": None,
+        "bench": "lp-allocator", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_LP_TOL",
+        "values": "float>0", "default": "1e-3",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "LP solve knob; flavor parity rides "
+                         "SCHEDULER_TPU_ALLOCATOR",
+        "test": "tests/test_lp_place.py", "test_exempt": None,
+        "doc": "docs/LP_PLACEMENT.md",
+        "obs": "lp", "obs_exempt": None,
+        "bench": "lp-allocator", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_MEGA",
+        "values": "bool", "default": "1",
+        "env_keys": True, "delta": None,
+        "parity": "XLA fused step loop (mega-vs-xla parity suites)",
+        "parity_exempt": None,
+        "test": "tests/test_megakernel.py", "test_exempt": None,
+        "doc": "README.md",
+        "obs": None,
+        "obs_exempt": "engine choice rides the cohort channel's engine field",
+        "bench": "flagship", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_MESH",
+        "values": "auto|N|RxC", "default": "1",
+        "env_keys": True, "delta": "get_mesh",
+        "parity": "single-device engine (mesh parity suites)",
+        "parity_exempt": None,
+        "test": "tests/test_mesh2d.py", "test_exempt": None,
+        "doc": "docs/SHARDING.md",
+        "obs": None,
+        "obs_exempt": "topology rides XL artifacts (detail.topology)",
+        "bench": "XL", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_NATIVE",
+        "values": "bool", "default": "1",
+        "env_keys": False, "delta": None,
+        "parity": "pure-python commit ledgers (bitwise)",
+        "parity_exempt": None,
+        "test": "tests/test_native.py", "test_exempt": None,
+        "doc": "README.md",
+        "obs": None,
+        "obs_exempt": "host commit kernels; no per-cycle evidence",
+        "bench": None,
+        "bench_exempt": "kill switch; bench runs whatever is built",
+    },
+    {
+        "flag": "SCHEDULER_TPU_OBS",
+        "values": "bool", "default": "1",
+        "env_keys": True, "delta": None,
+        "parity": "OBS=0 bitwise-parity contract (recorder off)",
+        "parity_exempt": None,
+        "test": "tests/test_obs.py", "test_exempt": None,
+        "doc": "docs/OBSERVABILITY.md",
+        "obs": None,
+        "obs_exempt": "the recorder switch itself",
+        "bench": "flagship", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_OBS_RING",
+        "values": "int 8..65536", "default": "256",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "ring capacity; never changes binds",
+        "test": "tests/test_obs.py", "test_exempt": None,
+        "doc": "docs/OBSERVABILITY.md",
+        "obs": None,
+        "obs_exempt": "capacity knob for the ring itself",
+        "bench": None,
+        "bench_exempt": "capacity knob; never a bench regime",
+    },
+    {
+        "flag": "SCHEDULER_TPU_PALLAS",
+        "values": "bool", "default": "1",
+        "env_keys": True, "delta": None,
+        "parity": "XLA twins of every pallas kernel",
+        "parity_exempt": None,
+        "test": "tests/test_envflags.py", "test_exempt": None,
+        "doc": "README.md",
+        "obs": None,
+        "obs_exempt": "engine choice rides the cohort channel's engine field",
+        "bench": "flagship", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_PREEMPT_FILL",
+        "values": "int>=1", "default": "8",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--preempt runs, not unit tests",
+        "doc": "docs/PREEMPT.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "preempt", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_PREEMPT_NODES",
+        "values": "int>=1", "default": "32 (8 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--preempt runs, not unit tests",
+        "doc": "docs/PREEMPT.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "preempt", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_PREEMPT_PODS",
+        "values": "int>=1", "default": "96 (16 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--preempt runs, not unit tests",
+        "doc": "docs/PREEMPT.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "preempt", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_PREEMPT_RATE",
+        "values": "float arrivals/s", "default": "60 (30 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--preempt runs, not unit tests",
+        "doc": "docs/PREEMPT.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "preempt", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_PREEMPT_SEED",
+        "values": "int", "default": "0",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness seed; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness seed; exercised by bench.py --preempt "
+                       "runs, not unit tests",
+        "doc": "docs/PREEMPT.md",
+        "obs": None, "obs_exempt": "harness seed; recorded on the artifact",
+        "bench": "preempt", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_PREEMPT_WARM",
+        "values": "int>=0", "default": "12 (4 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--preempt runs, not unit tests",
+        "doc": "docs/PREEMPT.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "preempt", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_PROFILE",
+        "values": "path", "default": "off (empty)",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "diagnostics export; no engine twin",
+        "test": "tests/test_trace.py", "test_exempt": None,
+        "doc": "docs/OBSERVABILITY.md",
+        "obs": None,
+        "obs_exempt": "the device profiler writes its own artifacts",
+        "bench": None,
+        "bench_exempt": "diagnostics export; never a bench regime",
+    },
+    {
+        "flag": "SCHEDULER_TPU_PROFILE_EVERY",
+        "values": "int>=1", "default": "100",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "profiler sampling period; no engine twin",
+        "test": "tests/test_trace.py", "test_exempt": None,
+        "doc": "docs/OBSERVABILITY.md",
+        "obs": None,
+        "obs_exempt": "sampling knob for the profiler itself",
+        "bench": None,
+        "bench_exempt": "diagnostics knob; never a bench regime",
+    },
+    {
+        "flag": "SCHEDULER_TPU_QFAIR",
+        "values": "device|host", "default": "device",
+        "env_keys": True, "delta": "qfair_flavor",
+        "parity": "host fixed-point water-fill solve",
+        "parity_exempt": None,
+        "test": "tests/test_qfair.py", "test_exempt": None,
+        "doc": "docs/QUEUE_DELTA.md",
+        "obs": "qfair", "obs_exempt": None,
+        "bench": "MQ", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_QFAIR_ITERS",
+        "values": "int (0 = auto)", "default": "0",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "solve knob; flavor parity rides "
+                         "SCHEDULER_TPU_QFAIR",
+        "test": "tests/test_qfair.py", "test_exempt": None,
+        "doc": "docs/QUEUE_DELTA.md",
+        "obs": "qfair", "obs_exempt": None,
+        "bench": "MQ", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_QPS",
+        "values": "float (0 = off)", "default": "0",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "client-side rate limit; no engine twin",
+        "test": "tests/test_rate_limit.py", "test_exempt": None,
+        "doc": "docs/INGEST.md",
+        "obs": None,
+        "obs_exempt": "ingestion throttle; no per-cycle evidence",
+        "bench": None,
+        "bench_exempt": "ingestion throttle; bench scenarios pace arrivals "
+                        "themselves",
+    },
+    {
+        "flag": "SCHEDULER_TPU_QUEUE_DELTA",
+        "values": "bool", "default": "1",
+        "env_keys": True, "delta": "_queue_delta_enabled",
+        "parity": "full queue-chain recompute", "parity_exempt": None,
+        "test": "tests/test_queue_delta_parity.py", "test_exempt": None,
+        "doc": "docs/QUEUE_DELTA.md",
+        "obs": "queue_chain", "obs_exempt": None,
+        "bench": "MQ", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_RETRACE",
+        "values": "off|warn|guard", "default": "off",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "compile sentinel observes launches; warn/guard "
+                         "never change binds",
+        "test": "tests/test_retrace.py", "test_exempt": None,
+        "doc": "docs/STATIC_ANALYSIS.md",
+        "obs": "retrace", "obs_exempt": None,
+        "bench": "flagship", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_SANITIZE",
+        "values": "bool", "default": "0",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "transfer-guard/debug-NaN sanitizer; observes only",
+        "test": "tests/test_sanitize.py", "test_exempt": None,
+        "doc": "docs/STATIC_ANALYSIS.md",
+        "obs": None,
+        "obs_exempt": "diagnostic regime; detail.sanitize marks artifacts",
+        "bench": None,
+        "bench_exempt": "diagnostic regime; detail.sanitize keeps sanitized "
+                        "artifacts out of perf claims",
+    },
+    {
+        "flag": "SCHEDULER_TPU_SHARDCHECK",
+        "values": "bool", "default": "0",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "reads live shardings at dispatch/readback only; "
+                         "never changes the program",
+        "test": "tests/test_mesh2d.py", "test_exempt": None,
+        "doc": "docs/SHARDING.md",
+        "obs": None,
+        "obs_exempt": "diagnostic regime; violations raise, they don't note",
+        "bench": None,
+        "bench_exempt": "diagnostic regime; never a perf artifact",
+    },
+    {
+        "flag": "SCHEDULER_TPU_SIG_COMPRESS",
+        "values": "off|on|auto", "default": "auto",
+        "env_keys": True, "delta": "sig_compress_mode",
+        "parity": "uncompressed [T, N] static staging",
+        "parity_exempt": None,
+        "test": "tests/test_sig_compress.py", "test_exempt": None,
+        "doc": "docs/LP_PLACEMENT.md",
+        "obs": "sig", "obs_exempt": None,
+        "bench": "lp-allocator", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_STEP_KERNEL",
+        "values": "bool", "default": "1",
+        "env_keys": True, "delta": None,
+        "parity": "XLA step path (step-kernel parity)",
+        "parity_exempt": None,
+        "test": "tests/test_megakernel.py", "test_exempt": None,
+        "doc": "README.md",
+        "obs": None,
+        "obs_exempt": "engine choice rides the cohort channel's engine field",
+        "bench": "flagship", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_STRICT_ORDER",
+        "values": "auto|always|never|bool", "default": "auto",
+        "env_keys": False, "delta": None,
+        "parity": "reference interleaved host loop (allocate.go order)",
+        "parity_exempt": None,
+        "test": "tests/test_allocate.py", "test_exempt": None,
+        "doc": "README.md",
+        "obs": None,
+        "obs_exempt": "ordering routing; binds are the observable",
+        "bench": None,
+        "bench_exempt": "ordering fidelity knob; never a perf regime",
+    },
+    {
+        "flag": "SCHEDULER_TPU_SWEEP",
+        "values": "bool", "default": "1",
+        "env_keys": False, "delta": None,
+        "parity": "reference per-task sweeps", "parity_exempt": None,
+        "test": "tests/test_sweep.py", "test_exempt": None,
+        "doc": "README.md",
+        "obs": None,
+        "obs_exempt": "sweep memoization; victim evidence rides the victims "
+                      "channel",
+        "bench": None,
+        "bench_exempt": "kill switch; bench runs the memoized sweeps",
+    },
+    {
+        "flag": "SCHEDULER_TPU_TENANTS",
+        "values": "int (0 = solo)", "default": "0",
+        "env_keys": True, "delta": "tenant_count",
+        "parity": "K sequential solo cycles (stacked-dispatch parity)",
+        "parity_exempt": None,
+        "test": "tests/test_tenant_parity.py", "test_exempt": None,
+        "doc": "docs/TENANT.md",
+        "obs": "tenant", "obs_exempt": None,
+        "bench": "tenant", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_TENANT_CYCLES",
+        "values": "int>=1", "default": "30 (5 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--tenant runs, not unit tests",
+        "doc": "docs/TENANT.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "tenant", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_TENANT_GANG",
+        "values": "int>=1", "default": "6",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--tenant runs, not unit tests",
+        "doc": "docs/TENANT.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "tenant", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_TENANT_ISOLATION_BOUND",
+        "values": "float>=1", "default": "3.0",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "gate threshold knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench gate threshold; exercised by bench.py "
+                       "--tenant runs, not unit tests",
+        "doc": "docs/TENANT.md",
+        "obs": None,
+        "obs_exempt": "gate threshold; the isolation ratio rides the "
+                      "artifact",
+        "bench": "tenant", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_TENANT_K",
+        "values": "int>=1", "default": "8 (4 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--tenant runs, not unit tests",
+        "doc": "docs/TENANT.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "tenant", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_TENANT_NODES",
+        "values": "int>=1", "default": "16",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--tenant runs, not unit tests",
+        "doc": "docs/TENANT.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "tenant", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_TENANT_PODS",
+        "values": "int>=1", "default": "48 (24 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--tenant runs, not unit tests",
+        "doc": "docs/TENANT.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "tenant", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_TENANT_SCALE_K",
+        "values": "int (0 = skip)", "default": "64 (0 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--tenant runs, not unit tests",
+        "doc": "docs/TENANT.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "tenant", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_TRACE",
+        "values": "path", "default": "off (empty)",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "span export; no engine twin",
+        "test": "tests/test_trace.py", "test_exempt": None,
+        "doc": "docs/OBSERVABILITY.md",
+        "obs": None,
+        "obs_exempt": "the span tracer writes its own artifacts",
+        "bench": None,
+        "bench_exempt": "diagnostics export; never a bench regime",
+    },
+    {
+        "flag": "SCHEDULER_TPU_TRACE_KEEP",
+        "values": "int>=1", "default": "64",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "trace retention; no engine twin",
+        "test": "tests/test_trace.py", "test_exempt": None,
+        "doc": "docs/OBSERVABILITY.md",
+        "obs": None,
+        "obs_exempt": "retention knob for the tracer itself",
+        "bench": None,
+        "bench_exempt": "diagnostics knob; never a bench regime",
+    },
+    {
+        "flag": "SCHEDULER_TPU_TRIGGER",
+        "values": "period|event", "default": "period",
+        "env_keys": True, "delta": None,
+        "parity": "event-vs-period bind parity", "parity_exempt": None,
+        "test": "tests/test_trigger.py", "test_exempt": None,
+        "doc": "docs/CHURN.md",
+        "obs": None,
+        "obs_exempt": "pacing regime; cadence is visible in cycle timings",
+        "bench": "churn", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_TRIGGER_MAX_MS",
+        "values": "float ms", "default": "schedule period",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "pacing ceiling; pacing never changes binds",
+        "test": "tests/test_trigger.py", "test_exempt": None,
+        "doc": "docs/CHURN.md",
+        "obs": None,
+        "obs_exempt": "pacing knob; cadence is visible in cycle timings",
+        "bench": "churn", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_TRIGGER_MIN_MS",
+        "values": "float ms", "default": "0",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "pacing floor; pacing never changes binds",
+        "test": "tests/test_trigger.py", "test_exempt": None,
+        "doc": "docs/CHURN.md",
+        "obs": None,
+        "obs_exempt": "pacing knob; cadence is visible in cycle timings",
+        "bench": "churn", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_TSAN",
+        "values": "bool", "default": "0",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "lockset checker; observes accesses only",
+        "test": "tests/test_tsan.py", "test_exempt": None,
+        "doc": "docs/STATIC_ANALYSIS.md",
+        "obs": None,
+        "obs_exempt": "diagnostic regime; races raise, they don't note",
+        "bench": None,
+        "bench_exempt": "diagnostic regime; never a perf artifact",
+    },
+    {
+        "flag": "SCHEDULER_TPU_VICTIM_GATE",
+        "values": "bool", "default": "1",
+        "env_keys": False, "delta": None,
+        "parity": "ungated per-task victim scan", "parity_exempt": None,
+        "test": "tests/test_sweep.py", "test_exempt": None,
+        "doc": "README.md",
+        "obs": "victims", "obs_exempt": None,
+        "bench": "preempt", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_WATCH_SHARDS",
+        "values": "int>=1", "default": "1",
+        "env_keys": True, "delta": "watch_shards",
+        "parity": "single-shard watch stream (sharded-ingest parity)",
+        "parity_exempt": None,
+        "test": "tests/test_tenant_parity.py", "test_exempt": None,
+        "doc": "docs/INGEST.md",
+        "obs": None,
+        "obs_exempt": "shard events ride ingest counters, not a note "
+                      "channel",
+        "bench": "tenant", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_WINDOW",
+        "values": "int>=1", "default": "8",
+        "env_keys": False, "delta": None,
+        "parity": "chunked-vs-whole dispatch parity (window widths)",
+        "parity_exempt": None,
+        "test": "tests/test_fused_chunked.py", "test_exempt": None,
+        "doc": "docs/STATIC_ANALYSIS.md",
+        "obs": None,
+        "obs_exempt": "batching width; placements are window-invariant",
+        "bench": None,
+        "bench_exempt": "batching width; never a bench regime of its own",
+    },
+    {
+        "flag": "SCHEDULER_TPU_WIRE",
+        "values": "journal|k8s", "default": "k8s",
+        "env_keys": True, "delta": None,
+        "parity": "journal/k8s bind-identity conformance",
+        "parity_exempt": None,
+        "test": "tests/test_ingest.py", "test_exempt": None,
+        "doc": "docs/INGEST.md",
+        "obs": None,
+        "obs_exempt": "wire identity pinned by the engine-cache key; ingest "
+                      "evidence rides churn artifacts",
+        "bench": "churn", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_XFER_CACHE_MB",
+        "values": "int MiB", "default": "256",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "host->device staging cache; content-addressed, "
+                         "content-exact",
+        "test": "tests/test_transfer_cache.py", "test_exempt": None,
+        "doc": "docs/STATIC_ANALYSIS.md",
+        "obs": None,
+        "obs_exempt": "staging cache; upload counts ride cycle timings",
+        "bench": None,
+        "bench_exempt": "capacity knob; never a bench regime",
+    },
+)
+
+
 # -- derived helpers (runtime convenience; NOT parsed by the pass) ------------
 
 def node_scratch_rows(has_releasing: bool) -> int:

@@ -122,6 +122,15 @@ OBS_CHANNELS = (
         "desc": "device/host victim-hunt engagement, plans and phase split",
     },
     {
+        "channel": "retrace",
+        "source": "actions/allocate.py",
+        "metric": None,
+        "exempt": "compile-sentinel evidence (utils/retrace.py); consumed "
+                  "by bench detail.retrace and the bench_gate shape check",
+        "desc": "XLA compiles observed under the retrace sentinel per cycle "
+                "(engine-cache hit cycles must stay at zero)",
+    },
+    {
         "channel": "tenant",
         "source": "ops/tenant.py",
         "metric": None,

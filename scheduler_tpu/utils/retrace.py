@@ -1,0 +1,159 @@
+"""``SCHEDULER_TPU_RETRACE={off,warn,guard}``: the jit retrace sentinel.
+
+The steady-state perf claims rest on an invariant nothing at runtime
+checked: an engine-cache **hit** cycle dispatches a resident executable and
+must compile ZERO new ones (docs/ENGINE_CACHE.md "Why hits never
+recompile").  A drifted static argument — a per-cycle timestamp, a python
+container rebuilt every cycle — silently turns the ~10ms hit path into a
+multi-second retrace, and the cycle still *works*, so only the latency
+distribution notices.  This module is the runtime half of the schedlint v4
+flavor contract (docs/STATIC_ANALYSIS.md "The retrace half"); the static
+half is the ``jit-static`` pass flagging unhashable/per-cycle static args.
+
+Mechanism: a ``jax.monitoring`` event listener counts
+``/jax/compilation_cache/compile_requests_use_cache`` events — one per
+executable actually compiled, zero on an executable-cache hit (probed on
+the CPU and TPU backends).  ``watch(hit=...)`` brackets each device-phase
+launch (``FusedAllocator.dispatch``/``readback``); compiles observed inside
+a bracket whose engine came from an engine-cache hit are *steady-state*
+compiles:
+
+* ``warn``  — count them (``summary()``/``take_cycle()``) and log once;
+* ``guard`` — raise ``RetraceError``.  ``sanitize.is_violation`` recognizes
+  it, so the mega -> XLA fallback seams RE-RAISE instead of swallowing the
+  trip as a backend failure and retracing *again* on the fallback path.
+
+Zero cost when off: ``watch()`` is a null context and the listener is never
+installed.  Evidence rides ``phases.note("retrace")`` (OBS_CHANNELS) and
+bench ``detail.retrace {mode, steady_compiles, total_compiles}``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from contextlib import contextmanager
+
+logger = logging.getLogger("scheduler_tpu.utils.retrace")
+
+# The per-executable-compile monitoring event (zero on jit cache hits).
+_COMPILE_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+
+_lock = threading.Lock()
+_installed = False
+_compile_events = 0   # process-lifetime compile count (listener)
+_total_compiles = 0   # compiles observed inside ANY watch() bracket
+_steady_compiles = 0  # compiles observed inside a HIT-cycle bracket
+_cycle_compiles = 0   # drained per cycle by take_cycle()
+_cycle_steady = 0
+_warned = False
+
+
+class RetraceError(RuntimeError):
+    """A steady-state (engine-cache hit) cycle compiled a new executable."""
+
+
+def mode() -> str:
+    from scheduler_tpu.utils.envflags import env_str
+
+    return env_str("SCHEDULER_TPU_RETRACE", "off",
+                   choices=("off", "warn", "guard"))
+
+
+def enabled() -> bool:
+    return mode() != "off"
+
+
+def _on_event(event: str, **kwargs) -> None:
+    global _compile_events
+    if event == _COMPILE_EVENT:
+        with _lock:
+            _compile_events += 1
+
+
+def _install() -> None:
+    """Register the monitoring listener once (idempotent; there is no
+    unregister API, so the counter simply keeps counting — brackets only
+    ever look at deltas)."""
+    global _installed
+    if _installed:
+        return
+    import jax
+
+    jax.monitoring.register_event_listener(_on_event)
+    _installed = True
+
+
+@contextmanager
+def watch(hit: bool):
+    """Bracket one device-phase launch.  ``hit`` says whether the engine
+    behind it came from an engine-cache hit — only those cycles carry the
+    zero-compile contract; miss/rebuild cycles are *expected* to compile."""
+    if not enabled():
+        yield
+        return
+    global _total_compiles, _steady_compiles, _cycle_compiles, _cycle_steady
+    global _warned
+    _install()
+    with _lock:
+        before = _compile_events
+    yield
+    with _lock:
+        delta = _compile_events - before
+        _total_compiles += delta
+        _cycle_compiles += delta
+        if hit and delta:
+            _steady_compiles += delta
+            _cycle_steady += delta
+    if hit and delta:
+        if mode() == "guard":
+            raise RetraceError(
+                f"engine-cache hit cycle compiled {delta} new "
+                "executable(s) — the resident engine retraced "
+                "(SCHEDULER_TPU_RETRACE=guard; see "
+                "docs/STATIC_ANALYSIS.md 'The retrace half')"
+            )
+        if not _warned:
+            _warned = True
+            logger.warning(
+                "SCHEDULER_TPU_RETRACE=warn: engine-cache hit cycle "
+                "compiled %d new executable(s) — steady-state retrace; "
+                "counting (bench detail.retrace)", delta,
+            )
+
+
+def summary() -> dict:
+    """The bench ``detail.retrace`` block (process-lifetime counters)."""
+    with _lock:
+        return {
+            "mode": mode(),
+            "steady_compiles": _steady_compiles,
+            "total_compiles": _total_compiles,
+        }
+
+
+def take_cycle() -> dict:
+    """Drain the per-cycle counters (the ``phases.note('retrace')``
+    payload): compiles observed under this cycle's brackets."""
+    global _cycle_compiles, _cycle_steady
+    with _lock:
+        out = {
+            "mode": mode(),
+            "compiles": _cycle_compiles,
+            "steady": _cycle_steady,
+        }
+        _cycle_compiles = 0
+        _cycle_steady = 0
+    return out
+
+
+def reset() -> None:
+    """Zero the aggregates (tests; the listener stays installed)."""
+    global _total_compiles, _steady_compiles, _cycle_compiles, _cycle_steady
+    global _warned
+    with _lock:
+        _total_compiles = 0
+        _steady_compiles = 0
+        _cycle_compiles = 0
+        _cycle_steady = 0
+        _warned = False

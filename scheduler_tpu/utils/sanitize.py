@@ -73,9 +73,14 @@ def is_violation(err: BaseException) -> bool:
     (mega -> XLA) must RE-RAISE these instead of swallowing them as backend
     failures — a sanitizer that degrades to a slower-but-working path has
     found a bug and then hidden it."""
-    from scheduler_tpu.utils import tsan
+    from scheduler_tpu.utils import retrace, tsan
 
     if tsan.enabled() and isinstance(err, tsan.TsanRaceError):
+        return True
+    # Steady-state retrace trips (utils/retrace.py): the compile sentinel
+    # has its own mode flag, so recognition does not require SANITIZE=1 —
+    # same standing as the tsan half above.
+    if retrace.enabled() and isinstance(err, retrace.RetraceError):
         return True
     if not enabled():
         return False

@@ -296,6 +296,48 @@ def obs_overhead_note(detail: dict):
     return None
 
 
+def retrace_block_problem(detail: dict):
+    """Sanity-check the compile-sentinel evidence block (``detail.retrace``,
+    docs/STATIC_ANALYSIS.md "The retrace half").  Absent block = a
+    pre-retrace-era artifact, fine.  Present: ``mode`` must be one of the
+    flag's values and the compile counters non-negative ints, with
+    ``steady_compiles <= total_compiles`` — steady-state compiles are a
+    subset of all compiles by construction.  Returns the reason string, or
+    None when the block is sane."""
+    rt = detail.get("retrace")
+    if rt is None:
+        return None
+    if not isinstance(rt, dict) or rt.get("mode") not in (
+        "off", "warn", "guard"
+    ):
+        return "detail.retrace is not a {mode: off|warn|guard, ...} block"
+    for key in ("steady_compiles", "total_compiles"):
+        v = rt.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            return f"detail.retrace.{key} missing or not a non-negative int"
+    if rt["steady_compiles"] > rt["total_compiles"]:
+        return ("detail.retrace.steady_compiles exceeds total_compiles — "
+                "the sentinel cannot have seen more hit-cycle compiles "
+                "than compiles")
+    return None
+
+
+def retrace_note(detail: dict):
+    """Advisory (never an exit): a sentinel-armed artifact that observed
+    compiles inside engine-cache HIT cycles.  The hit path's contract is
+    zero new executables (docs/ENGINE_CACHE.md); the gate SURFACES the
+    count — the hard stop is SCHEDULER_TPU_RETRACE=guard at run time."""
+    rt = detail.get("retrace")
+    if isinstance(rt, dict) and rt.get("mode") in ("warn", "guard") and \
+            isinstance(rt.get("steady_compiles"), int) and \
+            rt["steady_compiles"] > 0:
+        return (f"retrace sentinel saw steady_compiles="
+                f"{rt['steady_compiles']} inside engine-cache hit cycles "
+                "(advisory; hits must compile zero new executables — see "
+                "docs/STATIC_ANALYSIS.md \"The retrace half\")")
+    return None
+
+
 def find_artifacts(root: Path, infix: str = ""):
     """One family's ``BENCH{infix}_r*.json`` sorted by round number (not
     mtime: artifacts are checked in, and a fresh clone flattens
@@ -762,9 +804,17 @@ def gate_family(root: Path, label: str, infix: str) -> int:
                 print(f"bench-gate[{label}]: malformed artifact "
                       f"{artifacts[-1].name}: {qf_why}")
                 return 1
+        rt_why = retrace_block_problem(detail)
+        if rt_why is not None:
+            print(f"bench-gate[{label}]: malformed artifact "
+                  f"{artifacts[-1].name}: {rt_why}")
+            return 1
         note = obs_overhead_note(detail)
         if note is not None:
             print(f"bench-gate[{label}]: {artifacts[-1].name}: {note}")
+        rt_note = retrace_note(detail)
+        if rt_note is not None:
+            print(f"bench-gate[{label}]: {artifacts[-1].name}: {rt_note}")
     if len(artifacts) < 2:
         print(f"bench-gate[{label}]: need two BENCH{infix}_r*.json under "
               f"{root}, found {len(artifacts)}; nothing to compare")

@@ -32,6 +32,10 @@ def main() -> int:
     ap.add_argument("--check", action="store_true")
     args = ap.parse_args()
 
+    from scheduler_tpu.analysis.flavors import (
+        FLAVORS_DOC, flavors_from_source, render_flavors_table,
+    )
+    from scheduler_tpu.analysis.flavors import TABLE_NS as FLAVORS_NS
     from scheduler_tpu.analysis.obs_channels import (
         OBS_DOC, TABLE_NS, channels_from_source, render_channel_table,
     )
@@ -66,6 +70,13 @@ def main() -> int:
     if channels is not None:
         plans.setdefault(OBS_DOC, []).append(
             (TABLE_NS, render_channel_table(channels))
+        )
+    # Flavor-contract registry (layout.py FLAVORS) — same renderer the
+    # flavors schedlint pass drift-checks with.
+    flavor_rows = flavors_from_source(source)
+    if flavor_rows is not None:
+        plans.setdefault(FLAVORS_DOC, []).append(
+            (FLAVORS_NS, render_flavors_table(flavor_rows))
         )
 
     for rel, tables in sorted(plans.items()):

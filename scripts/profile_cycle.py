@@ -225,13 +225,24 @@ def run_churn(n_nodes: int, n_placed: int, batch: int = 250,
         status = notes.get("engine_cache", "?")
         outcomes.append((kind, status))
         dirty = notes.get("dirty", {})
+        # Compile-sentinel evidence next to the cache status it judges:
+        # a hit cycle showing steady compiles is the regression
+        # SCHEDULER_TPU_RETRACE exists to surface (docs/STATIC_ANALYSIS.md).
+        rt = notes.get("retrace")
+        rt_txt = (
+            f"  retrace={rt.get('mode', '?')}"
+            f"(compiles={rt.get('compiles', -1)},"
+            f"steady={rt.get('steady', -1)})"
+            if isinstance(rt, dict) else ""
+        )
         print(f"  cycle {i} ({kind:7s}): {elapsed * 1000:8.1f}ms  "
               f"events={applied:4d}  engine_cache={status:<8s} "
               f"dirty(nodes={dirty_counts['nodes']},"
               f"jobs={dirty_counts['jobs']},"
               f"queues={dirty_counts['queues']})  "
               f"refresh={dirty.get('mode', '-')}"
-              f"/rows={dirty.get('rows_scattered', -1)}")
+              f"/rows={dirty.get('rows_scattered', -1)}"
+              f"{rt_txt}")
         keys = ("open", "engine_init", "dispatch", "device", "decode",
                 "apply", "close", "overlap_host")
         split = "  ".join(

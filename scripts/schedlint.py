@@ -20,6 +20,16 @@ tree and exits non-zero on findings:
               utils/obs.py OBS_CHANNELS registry with an exported metric
               or a documented exemption, and the generated channel table
               in docs/OBSERVABILITY.md is current
+  flavors     every SCHEDULER_TPU_* flag has an ops/layout.py FLAVORS
+              row declaring its full contract (engine-cache key,
+              _delta_compatible re-check, parity oracle, owning test,
+              doc anchor, obs channel, bench family — or documented
+              exemptions), each claim verified against the tree, and
+              the generated knob table in docs/STATIC_ANALYSIS.md is
+              current
+  jit-static  jax.jit static args are never fed per-cycle or unhashable
+              values (the review-time companion of the
+              SCHEDULER_TPU_RETRACE runtime sentinel)
   hygiene     whitespace + unused imports (the former scripts/lint.py)
 
 Usage: python scripts/schedlint.py [--rules r1,r2] [--list-rules] [--json]
@@ -61,6 +71,10 @@ CHANGED_ANCHORS = (
     "scheduler_tpu/ops/layout.py",
     # obs-channel's registry: note-call findings elsewhere need the table.
     "scheduler_tpu/utils/obs.py",
+    # flavors' cross-walk surfaces: _delta_compatible, bench families.
+    "scheduler_tpu/ops/fused.py",
+    "bench.py",
+    "scripts/bench_gate.py",
 )
 
 

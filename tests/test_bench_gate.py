@@ -772,3 +772,51 @@ def test_qfair_contract_is_scoped_to_the_mq_family(tmp_path):
         cycle["qfair"] = {"engaged": True}  # no iterations: malformed shape
     _write(tmp_path, "BENCH_r01.json", doc)
     assert gate_family(tmp_path, "single-queue", "") == 0
+
+
+# -- the retrace compile-sentinel block (v4, docs/STATIC_ANALYSIS.md) ---------
+
+def test_retrace_block_absent_is_fine(tmp_path):
+    # Pre-sentinel-era artifacts carry no detail.retrace; the gate must not
+    # retroactively fail them.
+    _write(tmp_path, "BENCH_r01.json", _artifact(100_000.0))
+    assert gate_family(tmp_path, "single-queue", "") == 0
+
+
+def test_retrace_block_well_formed_passes(tmp_path):
+    doc = _artifact(100_000.0)
+    doc["detail"]["retrace"] = {
+        "mode": "warn", "steady_compiles": 0, "total_compiles": 3,
+    }
+    _write(tmp_path, "BENCH_r01.json", doc)
+    assert gate_family(tmp_path, "single-queue", "") == 0
+
+
+def test_retrace_block_wrong_shape_is_malformed(tmp_path):
+    from scripts.bench_gate import retrace_block_problem
+
+    doc = _artifact(100_000.0)
+    doc["detail"]["retrace"] = {"mode": "loud"}  # not a sentinel mode
+    _write(tmp_path, "BENCH_r01.json", doc)
+    assert gate_family(tmp_path, "single-queue", "") == 1
+    # steady > total is impossible by construction; bool-typed counters are
+    # the JSON-true trap the other evidence checkers also reject.
+    assert retrace_block_problem({"retrace": {
+        "mode": "warn", "steady_compiles": 4, "total_compiles": 3,
+    }}) is not None
+    assert retrace_block_problem({"retrace": {
+        "mode": "warn", "steady_compiles": True, "total_compiles": 3,
+    }}) is not None
+
+
+def test_retrace_steady_compiles_is_advisory_not_exit(tmp_path, capsys):
+    # A sentinel-armed artifact that SAW hit-cycle compiles still gates 0:
+    # the hard stop is SCHEDULER_TPU_RETRACE=guard at run time; the gate
+    # surfaces the count.
+    doc = _artifact(100_000.0)
+    doc["detail"]["retrace"] = {
+        "mode": "guard", "steady_compiles": 2, "total_compiles": 9,
+    }
+    _write(tmp_path, "BENCH_r01.json", doc)
+    assert gate_family(tmp_path, "single-queue", "") == 0
+    assert "steady_compiles=2" in capsys.readouterr().out

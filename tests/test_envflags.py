@@ -105,3 +105,49 @@ def test_engine_cache_cap_survives_malformed_env(monkeypatch):
 
     monkeypatch.setenv("SCHEDULER_TPU_ENGINE_CACHE_ENTRIES", "many")
     assert _cap() == 2
+
+
+def test_pallas_gate_wiring(monkeypatch):
+    """SCHEDULER_TPU_PALLAS is the global Pallas kill switch and
+    SCHEDULER_TPU_STEP_KERNEL rides on top of it (flavor contract:
+    ops/layout.py FLAVORS)."""
+    from scheduler_tpu.ops.pallas_kernels import (
+        pallas_enabled, step_kernel_enabled,
+    )
+
+    monkeypatch.delenv("SCHEDULER_TPU_PALLAS", raising=False)
+    assert pallas_enabled() is True
+    monkeypatch.setenv("SCHEDULER_TPU_PALLAS", "0")
+    assert pallas_enabled() is False
+    assert step_kernel_enabled() is False  # the step kernel IS a pallas kernel
+    monkeypatch.setenv("SCHEDULER_TPU_PALLAS", "totally")
+    assert pallas_enabled() is True  # malformed -> warn-once default
+
+
+def test_gc_freeze_gate_wiring(monkeypatch):
+    """SCHEDULER_TPU_GC_FREEZE=0 opts out of the collect-then-freeze
+    protocol; default on (docs: README.md operational flags)."""
+    from scheduler_tpu.scheduler import Scheduler
+
+    monkeypatch.delenv("SCHEDULER_TPU_GC_FREEZE", raising=False)
+    assert Scheduler._gc_freeze_enabled() is True
+    monkeypatch.setenv("SCHEDULER_TPU_GC_FREEZE", "0")
+    assert Scheduler._gc_freeze_enabled() is False
+    monkeypatch.setenv("SCHEDULER_TPU_GC_FREEZE", "frozen")
+    assert Scheduler._gc_freeze_enabled() is True  # malformed -> default
+
+
+def test_fused_static_limit_survives_malformed_env(monkeypatch):
+    """SCHEDULER_TPU_FUSED_STATIC_LIMIT is the [T, N] static-tensor
+    admission budget in bytes; a typo must degrade to the 160 MiB default
+    instead of crashing the admission check."""
+    from scheduler_tpu.utils.envflags import env_int
+
+    monkeypatch.setenv("SCHEDULER_TPU_FUSED_STATIC_LIMIT", "many-mib")
+    assert env_int(
+        "SCHEDULER_TPU_FUSED_STATIC_LIMIT", 160 * 1024 * 1024
+    ) == 160 * 1024 * 1024
+    monkeypatch.setenv("SCHEDULER_TPU_FUSED_STATIC_LIMIT", "1024")
+    assert env_int(
+        "SCHEDULER_TPU_FUSED_STATIC_LIMIT", 160 * 1024 * 1024
+    ) == 1024

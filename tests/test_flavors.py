@@ -1,0 +1,569 @@
+"""Fixture corpus for the ``flavors`` flavor-contract pass and its
+``jit-static`` companion (analysis/flavors.py; docs/STATIC_ANALYSIS.md
+"schedlint v4").
+
+Every sub-check gets its seeded violation AND its clean twin: an
+unregistered flag read, a dead registry row, schema/XOR drift, cache-key
+claims out of sync with ``engine_cache._ENV_KEYS`` in both directions, a
+claimed ``_delta_compatible`` symbol that is not in the method, a missing
+or silent owning test module, a doc anchor that does not spell the full
+flag name, an OBS-channel claim the ``OBS_CHANNELS`` registry does not
+back, a bench family the harness never names, a non-literal registry,
+generated-table drift — and, for ``jit-static``, static jit args fed
+unhashable literals or fresh clock values.  The committed tree itself is
+the final fixture: both passes must be clean on it.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+from scheduler_tpu.analysis import Repo, run_passes
+from scheduler_tpu.analysis.flavors import (
+    flavors_from_source, render_flavors_table,
+)
+from scheduler_tpu.analysis.row_layout import marker_lines
+
+
+def findings(rule, py=None, docs=None, existing=()):
+    repo = Repo.from_sources(
+        py={k: textwrap.dedent(v) for k, v in (py or {}).items()},
+        docs={k: textwrap.dedent(v) for k, v in (docs or {}).items()},
+        existing=existing,
+    )
+    return [f for f in run_passes(repo, [rule])]
+
+
+def row_src(flag, **over):
+    """One registry row as source, all contract arms exempted unless
+    overridden — so each test seeds exactly the arm it exercises."""
+    base = dict(
+        flag=flag, values="{0,1}", default="1",
+        env_keys=False, delta=None, doc="docs/KNOB.md",
+        parity=None, parity_exempt="fixture: no oracle",
+        test=None, test_exempt="fixture: parity covers it",
+        obs=None, obs_exempt="fixture: bench-only evidence",
+        bench=None, bench_exempt="fixture: not benched",
+    )
+    base.update(over)
+    items = ", ".join(f"{k!r}: {v!r}" for k, v in base.items())
+    return "{" + items + "}"
+
+
+def layout_src(*rows):
+    return "FLAVORS = (\n" + "".join(f"    {r},\n" for r in rows) + ")\n"
+
+
+READER = """
+    from scheduler_tpu.utils.envflags import env_bool
+    def gate():
+        return env_bool("SCHEDULER_TPU_MEGA", True)
+"""
+
+ENGINE_CACHE_STUB = """
+    _ENV_KEYS = (
+        "SCHEDULER_TPU_MEGA",
+    )
+"""
+
+
+# -- registry resolution ------------------------------------------------------
+
+def test_unregistered_flag_read_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA")),
+        "scheduler_tpu/ops/fast.py": """
+            from scheduler_tpu.utils.envflags import env_bool
+            def gate():
+                return env_bool("SCHEDULER_TPU_MEGA", True)
+            def rogue():
+                return env_bool("SCHEDULER_TPU_TURBO", True)
+        """,
+    })
+    assert len(out) == 1
+    assert "SCHEDULER_TPU_TURBO" in out[0].message
+    assert "no FLAVORS row" in out[0].message
+    assert out[0].path == "scheduler_tpu/ops/fast.py"
+
+
+def test_registered_read_is_clean():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA")),
+        "scheduler_tpu/ops/fast.py": READER,
+    })
+    assert out == []
+
+
+def test_reads_without_registry_module_trip():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/fast.py": READER,
+    })
+    assert len(out) == 1
+    assert "flavor-contract registry" in out[0].message
+
+
+def test_dead_registry_row_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA"),
+            row_src("SCHEDULER_TPU_GHOST")),
+        "scheduler_tpu/ops/fast.py": READER,
+    })
+    assert len(out) == 1
+    assert "SCHEDULER_TPU_GHOST" in out[0].message
+    assert "nothing reads it" in out[0].message
+
+
+def test_dead_row_check_skipped_when_no_reads_analyzed():
+    # The --changed under-approximation rule: a subset with zero flag
+    # reads cannot prove a row dead.
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA")),
+    })
+    assert out == []
+
+
+def test_non_literal_registry_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": """
+            PREFIX = "SCHEDULER_TPU_"
+            FLAVORS = (
+                {"flag": PREFIX + "MEGA"},
+            )
+        """,
+    })
+    assert len(out) == 1
+    assert "literal data" in out[0].message
+
+
+# -- row schema ---------------------------------------------------------------
+
+def test_schema_drift_trips():
+    bad = row_src("SCHEDULER_TPU_MEGA").replace(
+        "'values': '{0,1}', ", "")
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(bad),
+    })
+    assert any("schema drift" in f.message and "values" in f.message
+               for f in out)
+
+
+def test_duplicate_flag_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA"),
+            row_src("SCHEDULER_TPU_MEGA")),
+    })
+    assert any("declared twice" in f.message for f in out)
+
+
+def test_unprefixed_flag_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(row_src("TPU_MEGA")),
+    })
+    assert any("lacks the SCHEDULER_TPU_ prefix" in f.message for f in out)
+
+
+def test_claim_and_exemption_both_set_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA",
+                    parity="bitwise", parity_exempt="also exempt?")),
+    })
+    assert len(out) == 1
+    assert "'parity' XOR" in out[0].message
+
+
+def test_claim_and_exemption_neither_set_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA", obs_exempt=None)),
+    })
+    assert len(out) == 1
+    assert "'obs' XOR" in out[0].message
+
+
+def test_doc_anchor_is_mandatory():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA", doc=None)),
+    })
+    assert len(out) == 1
+    assert "no doc exemption" in out[0].message
+
+
+# -- env_keys vs engine_cache._ENV_KEYS ---------------------------------------
+
+def test_env_keys_claim_without_registration_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_TURBO", env_keys=True)),
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+    })
+    assert len(out) == 1
+    assert "not in" in out[0].message
+    assert "_ENV_KEYS" in out[0].message
+
+
+def test_registration_without_env_keys_claim_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA", env_keys=False)),
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+    })
+    assert len(out) == 1
+    assert "claims env_keys=False" in out[0].message
+
+
+def test_env_keys_claim_matching_registration_is_clean():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA", env_keys=True)),
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+    })
+    assert out == []
+
+
+# -- delta claims vs FusedAllocator._delta_compatible -------------------------
+
+FUSED_STUB = """
+    class FusedAllocator:
+        def _delta_compatible(self, other):
+            return self._score_weights == other._score_weights
+"""
+
+
+def test_delta_symbol_missing_from_method_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA", delta="_mega_pack")),
+        "scheduler_tpu/ops/fused.py": FUSED_STUB,
+    })
+    assert len(out) == 1
+    assert "_mega_pack" in out[0].message
+    assert "_delta_compatible" in out[0].message
+
+
+def test_delta_symbol_present_is_clean():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA", delta="_score_weights")),
+        "scheduler_tpu/ops/fused.py": FUSED_STUB,
+    })
+    assert out == []
+
+
+def test_delta_claim_without_the_method_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA", delta="_score_weights")),
+        "scheduler_tpu/ops/fused.py": """
+            class FusedAllocator:
+                pass
+        """,
+    })
+    assert len(out) == 1
+    assert "has no _delta_compatible method" in out[0].message
+
+
+# -- owning test module -------------------------------------------------------
+
+def test_missing_owning_test_module_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA",
+                    test="tests/test_mega.py", test_exempt=None)),
+        "tests/test_other.py": "# SCHEDULER_TPU_OTHER things\n",
+    })
+    assert len(out) == 1
+    assert "not in the analyzed tree" in out[0].message
+
+
+def test_owning_test_module_not_mentioning_flag_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA",
+                    test="tests/test_mega.py", test_exempt=None)),
+        "tests/test_mega.py": "def test_nothing():\n    pass\n",
+    })
+    assert len(out) == 1
+    assert "never mentions the flag" in out[0].message
+
+
+def test_owning_test_module_mentioning_flag_is_clean():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA",
+                    test="tests/test_mega.py", test_exempt=None)),
+        "tests/test_mega.py": """
+            def test_mega(monkeypatch):
+                monkeypatch.setenv("SCHEDULER_TPU_MEGA", "0")
+        """,
+    })
+    assert out == []
+
+
+def test_test_exemption_honored_without_tests_in_corpus():
+    # No tests/ module analyzed at all: the check self-skips (the
+    # --changed subset rule), exempt or not.
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA", test="tests/test_mega.py",
+                    test_exempt=None)),
+    })
+    assert out == []
+
+
+# -- doc anchor ---------------------------------------------------------------
+
+def test_doc_anchor_nonexistent_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA", doc="docs/GONE.md")),
+    }, docs={"docs/OTHER.md": "unrelated\n"})
+    assert len(out) == 1
+    assert "does not exist" in out[0].message
+
+
+def test_doc_anchor_combined_shorthand_does_not_count():
+    # The anchor mentions a LONGER flag; the full-name rule must not let
+    # the prefix satisfy SCHEDULER_TPU_MEGA.
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA", doc="docs/KNOB.md")),
+    }, docs={"docs/KNOB.md": "| `SCHEDULER_TPU_MEGA_LIMIT` | 1 |\n"})
+    assert len(out) == 1
+    assert "never spells the full flag name" in out[0].message
+
+
+def test_doc_anchor_spelling_the_flag_is_clean():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA", doc="docs/KNOB.md")),
+    }, docs={"docs/KNOB.md": "| `SCHEDULER_TPU_MEGA` | 1 | mega |\n"})
+    assert out == []
+
+
+def test_doc_anchor_existing_outside_doc_targets_is_clean():
+    # The anchor is a real committed file not in the analyzed doc set:
+    # existence satisfies the check (mention is unverifiable).
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA", doc="docs/KNOB.md")),
+    }, docs={"docs/OTHER.md": "unrelated\n"}, existing=["docs/KNOB.md"])
+    assert out == []
+
+
+# -- obs channel --------------------------------------------------------------
+
+OBS_STUB = """
+    OBS_CHANNELS = (
+        {
+            "channel": "mega",
+            "source": "ops/fast.py",
+            "metric": None,
+            "exempt": "fixture",
+            "desc": "mega evidence",
+        },
+    )
+"""
+
+
+def test_obs_channel_not_declared_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA", obs="dirty", obs_exempt=None)),
+        "scheduler_tpu/utils/obs.py": OBS_STUB,
+    })
+    assert len(out) == 1
+    assert "'dirty'" in out[0].message
+    assert "OBS_CHANNELS" in out[0].message
+
+
+def test_obs_channel_declared_is_clean():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA", obs="mega", obs_exempt=None)),
+        "scheduler_tpu/utils/obs.py": OBS_STUB,
+    })
+    assert out == []
+
+
+def test_obs_exemption_honored_with_obs_module_present():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA")),
+        "scheduler_tpu/utils/obs.py": OBS_STUB,
+    })
+    assert out == []
+
+
+# -- bench family -------------------------------------------------------------
+
+def test_bench_family_unknown_to_harness_trips():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA",
+                    bench="BENCH_NOPE", bench_exempt=None)),
+        "bench.py": 'FAMILY = "BENCH_MEGA"\n',
+    })
+    assert len(out) == 1
+    assert "BENCH_NOPE" in out[0].message
+
+
+def test_bench_family_named_by_harness_is_clean():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA",
+                    bench="BENCH_MEGA", bench_exempt=None)),
+        "bench.py": 'FAMILY = "BENCH_MEGA"\n',
+    })
+    assert out == []
+
+
+def test_bench_family_named_by_the_gate_counts_too():
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout_src(
+            row_src("SCHEDULER_TPU_MEGA",
+                    bench="BENCH_MEGA", bench_exempt=None)),
+        "scripts/bench_gate.py": 'if family == "BENCH_MEGA":\n    pass\n',
+    })
+    assert out == []
+
+
+# -- generated doc table ------------------------------------------------------
+
+def _doc_with_table(layout, stale=False):
+    rows = flavors_from_source(textwrap.dedent(layout))
+    table = render_flavors_table(rows)
+    if stale:
+        table = table[:-1]  # drop the last row: drift
+    begin, end = marker_lines("FLAVORS")
+    return "# knobs\n\n" + begin + "\n" + "\n".join(table) + "\n" + end + "\n"
+
+
+def test_flavors_table_drift_trips():
+    layout = layout_src(row_src("SCHEDULER_TPU_MEGA"),
+                        row_src("SCHEDULER_TPU_COHORT"))
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout,
+    }, docs={"docs/STATIC_ANALYSIS.md": _doc_with_table(layout, stale=True)},
+        existing=["docs/KNOB.md"])
+    assert len(out) == 1
+    assert "stale" in out[0].message
+    assert out[0].path == "docs/STATIC_ANALYSIS.md"
+
+
+def test_flavors_table_markers_missing_trips():
+    layout = layout_src(row_src("SCHEDULER_TPU_MEGA"))
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout,
+    }, docs={"docs/STATIC_ANALYSIS.md": "# knobs, no table\n"},
+        existing=["docs/KNOB.md"])
+    assert len(out) == 1
+    assert "missing generated flavor table" in out[0].message
+
+
+def test_flavors_table_in_sync_is_clean():
+    layout = layout_src(row_src("SCHEDULER_TPU_MEGA"),
+                        row_src("SCHEDULER_TPU_COHORT"))
+    out = findings("flavors", py={
+        "scheduler_tpu/ops/layout.py": layout,
+    }, docs={"docs/STATIC_ANALYSIS.md": _doc_with_table(layout)},
+        existing=["docs/KNOB.md"])
+    assert out == []
+
+
+# -- jit-static ---------------------------------------------------------------
+
+def test_jit_static_unhashable_literal_trips():
+    out = findings("jit-static", py={
+        "scheduler_tpu/ops/fast.py": """
+            import jax
+            scale = jax.jit(lambda x, k: x, static_argnums=(1,))
+            def run(x):
+                return scale(x, [1, 2])
+        """,
+    })
+    assert len(out) == 1
+    assert "unhashable literal" in out[0].message
+
+
+def test_jit_static_clock_value_trips():
+    out = findings("jit-static", py={
+        "scheduler_tpu/ops/fast.py": """
+            import time
+            import jax
+            scale = jax.jit(lambda x, now: x, static_argnames="now")
+            def run(x):
+                return scale(x, now=time.time())
+        """,
+    })
+    assert len(out) == 1
+    assert "time.time" in out[0].message
+    assert "SCHEDULER_TPU_RETRACE" in out[0].message
+
+
+def test_jit_static_decorated_def_variant_trips():
+    out = findings("jit-static", py={
+        "scheduler_tpu/ops/fast.py": """
+            from functools import partial
+            import jax
+            @partial(jax.jit, static_argnums=1)
+            def scale(x, k):
+                return x * k
+            def run(x):
+                return scale(x, {"k": 3})
+        """,
+    })
+    assert len(out) == 1
+    assert "position 1" in out[0].message
+
+
+def test_jit_static_hashable_static_arg_is_clean():
+    out = findings("jit-static", py={
+        "scheduler_tpu/ops/fast.py": """
+            from functools import partial
+            import jax
+            @partial(jax.jit, static_argnums=1)
+            def scale(x, k):
+                return x * k
+            def run(x):
+                return scale(x, 4)
+        """,
+    })
+    assert out == []
+
+
+def test_jit_static_skips_tests_corpora():
+    out = findings("jit-static", py={
+        "tests/test_fixture.py": """
+            import jax
+            scale = jax.jit(lambda x, k: x, static_argnums=(1,))
+            def run(x):
+                return scale(x, [1, 2])
+        """,
+    })
+    assert out == []
+
+
+# -- the committed tree -------------------------------------------------------
+
+def test_committed_tree_is_flavor_clean():
+    """The acceptance gate as a test: the real FLAVORS registry, the real
+    code/tests/docs, zero findings from both v4 passes."""
+    import importlib.util
+    from pathlib import Path
+
+    cli_path = (Path(__file__).resolve().parent.parent / "scripts"
+                / "schedlint.py")
+    spec = importlib.util.spec_from_file_location("schedlint_cli_fl", cli_path)
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+
+    repo = Repo.from_root(Path(cli.ROOT), cli.PY_TARGETS, cli.DOC_TARGETS)
+    out = run_passes(repo, ["flavors", "jit-static"])
+    assert out == [], "\n".join(str(f) for f in out)

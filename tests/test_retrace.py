@@ -1,0 +1,142 @@
+"""The jit retrace/recompile sentinel (``SCHEDULER_TPU_RETRACE``,
+utils/retrace.py; docs/STATIC_ANALYSIS.md "The retrace half").
+
+The acceptance matrix from the v4 issue: the forced static-arg-churn
+fixture MUST trip under ``guard``; an engine-cache-hit-shaped cycle over a
+resident executable must report zero steady compiles; ``warn`` counts
+where ``guard`` raises; a guard trip is a sanitizer violation (so the
+mega->XLA fallback seams in ops/fused.py re-raise instead of swallowing
+it); and the flag participates in ``engine_cache._ENV_KEYS``.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from scheduler_tpu.ops import engine_cache
+from scheduler_tpu.utils import envflags, retrace, sanitize
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sentinel():
+    envflags._warned.clear()
+    retrace.reset()
+    yield
+    retrace.reset()
+
+
+def _make_scale():
+    """A fresh jitted callable per test: a fresh jit cache, so compile
+    events are attributable to THIS test's calls."""
+
+    @partial(jax.jit, static_argnums=1)
+    def scale(x, k):
+        return x * k
+
+    return scale
+
+
+def test_off_mode_is_null(monkeypatch):
+    monkeypatch.delenv("SCHEDULER_TPU_RETRACE", raising=False)
+    assert retrace.mode() == "off"
+    assert not retrace.enabled()
+    scale = _make_scale()
+    with retrace.watch(True):
+        scale(jnp.arange(4.0), 7)  # compiles, but nobody is watching
+    assert retrace.summary() == {
+        "mode": "off", "steady_compiles": 0, "total_compiles": 0,
+    }
+
+
+def test_guard_must_trip_on_forced_static_arg_churn(monkeypatch):
+    """The seeded violation: a hit-cycle bracket whose launch feeds a
+    FRESH static value retraces — guard raises at the launch."""
+    monkeypatch.setenv("SCHEDULER_TPU_RETRACE", "guard")
+    scale = _make_scale()
+    x = jnp.arange(4.0)
+    with retrace.watch(False):
+        scale(x, 2)  # build cycle: compiling is its job
+    with pytest.raises(retrace.RetraceError):
+        with retrace.watch(True):
+            scale(x, 3)  # static-arg churn inside a "hit" cycle
+    assert retrace.summary()["steady_compiles"] >= 1
+
+
+def test_hit_cycle_over_resident_executable_is_clean(monkeypatch):
+    """The contract side: same static args -> the resident executable is
+    reused, zero compiles inside the hit bracket, guard stays silent."""
+    monkeypatch.setenv("SCHEDULER_TPU_RETRACE", "guard")
+    scale = _make_scale()
+    x = jnp.arange(4.0)
+    with retrace.watch(False):
+        scale(x, 2)
+    with retrace.watch(True):
+        out = scale(x, 2)
+    assert out[1] == 2.0
+    s = retrace.summary()
+    assert s["mode"] == "guard"
+    assert s["steady_compiles"] == 0
+    assert s["total_compiles"] >= 1  # the build bracket saw the compile
+
+
+def test_warn_counts_where_guard_raises(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_RETRACE", "warn")
+    scale = _make_scale()
+    x = jnp.arange(4.0)
+    with retrace.watch(False):
+        scale(x, 2)
+    with retrace.watch(True):
+        scale(x, 5)  # churn: counted, never raised under warn
+    s = retrace.summary()
+    assert s["mode"] == "warn"
+    assert s["steady_compiles"] >= 1
+    cycle = retrace.take_cycle()
+    assert cycle["mode"] == "warn"
+    assert cycle["steady"] >= 1
+    assert cycle["compiles"] >= cycle["steady"]
+    # take_cycle drains: the next cycle's note starts from zero.
+    assert retrace.take_cycle() == {"mode": "warn", "compiles": 0,
+                                    "steady": 0}
+
+
+def test_guard_trip_is_a_sanitizer_violation(monkeypatch):
+    """The fused.py fallback seams consult ``sanitize.is_violation``
+    before downgrading a mega failure to the XLA engine — a retrace trip
+    must RE-RAISE through them, same contract as a transfer-guard trip."""
+    monkeypatch.setenv("SCHEDULER_TPU_RETRACE", "guard")
+    scale = _make_scale()
+    x = jnp.arange(4.0)
+    with retrace.watch(False):
+        scale(x, 2)
+    caught = None
+    try:
+        with retrace.watch(True):
+            scale(x, 9)
+    except retrace.RetraceError as err:
+        caught = err
+    assert caught is not None
+    assert sanitize.is_violation(caught)
+
+
+def test_is_violation_requires_the_sentinel_enabled(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_RETRACE", "guard")
+    assert sanitize.is_violation(retrace.RetraceError("trip"))
+    envflags._warned.clear()
+    monkeypatch.setenv("SCHEDULER_TPU_RETRACE", "off")
+    assert not sanitize.is_violation(retrace.RetraceError("trip"))
+    assert not sanitize.is_violation(ValueError("not a trip"))
+
+
+def test_retrace_flag_is_in_the_engine_cache_key():
+    """A resident engine must not straddle a diagnostics-regime flip: a
+    guard-mode cycle always starts from a build whose hit path was watched
+    from the first dispatch."""
+    assert "SCHEDULER_TPU_RETRACE" in engine_cache._ENV_KEYS
+
+
+def test_malformed_mode_degrades_to_off(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_RETRACE", "panic")
+    assert retrace.mode() == "off"  # envflags warn-once-and-default
+    assert not retrace.enabled()

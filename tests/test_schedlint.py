@@ -229,6 +229,57 @@ def test_raw_env_trips_on_bench_vocab_environ_read():
     assert len(out) == 1 and "SCHEDULER_TPU_BENCH_VOCAB" in out[0].message
 
 
+# -- the retrace sentinel flag (v4, docs/STATIC_ANALYSIS.md) ------------------
+
+RETRACE_CACHE_STUB = """
+    _ENV_KEYS = (
+        "SCHEDULER_TPU_MEGA",
+        "SCHEDULER_TPU_RETRACE",
+    )
+"""
+
+
+def test_env_drift_clean_on_registered_retrace_mode():
+    """The sentinel mode is program-adjacent (a resident engine must not
+    straddle a guard/off flip: guard's contract is that the hit path was
+    watched from the first dispatch), so utils/retrace.py's read pattern
+    is clean in ops/ exactly because engine_cache registers the flag."""
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": RETRACE_CACHE_STUB,
+        "scheduler_tpu/ops/sentinel.py": """
+            from scheduler_tpu.utils.envflags import env_str
+            def mode():
+                return env_str("SCHEDULER_TPU_RETRACE", "off",
+                               choices=("off", "warn", "guard"))
+        """,
+    })
+    assert out == []
+
+
+def test_env_drift_trips_on_unregistered_retrace_mode():
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+        "scheduler_tpu/ops/sentinel.py": """
+            from scheduler_tpu.utils.envflags import env_str
+            def mode():
+                return env_str("SCHEDULER_TPU_RETRACE", "off")
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_RETRACE" in out[0].message
+    assert out[0].path == "scheduler_tpu/ops/sentinel.py"
+
+
+def test_raw_env_trips_on_retrace_environ_read():
+    out = findings("raw-env", py={
+        "scheduler_tpu/utils/retrace.py": """
+            import os
+            def mode():
+                return os.environ.get("SCHEDULER_TPU_RETRACE", "off")
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_RETRACE" in out[0].message
+
+
 # -- raw-env ------------------------------------------------------------------
 
 def test_raw_env_trips_on_os_environ_read():

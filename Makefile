@@ -1,7 +1,7 @@
 # Build/test entry points (reference: Makefile + hack/make-rules).
 PY ?= python
 
-.PHONY: all native test test-fast bench bench-smoke bench-xl bench-churn bench-preempt bench-flagship bench-gate lint verify wheel clean
+.PHONY: all native test test-fast bench bench-smoke bench-xl bench-churn bench-preempt bench-backfill bench-flagship bench-gate lint verify wheel clean
 
 all: native
 
@@ -43,6 +43,15 @@ bench-churn:
 bench-preempt:
 	$(PY) bench.py --preempt
 
+# Pod-count-saturated BestEffort wave scenario (docs/BACKFILL.md): an
+# oversized empty-request wave over nodes with only a few free pod slots
+# each; emits the BENCH_BF_r*.json artifact body (backfill pods/s over the
+# steady tail re-sweeps, the predicate_calls_host vs device_classes
+# sweep-ops ledger, and — under SCHEDULER_TPU_BACKFILL=device — the in-run
+# host A/B with bind-digest refusal; shape via SCHEDULER_TPU_BF_*).
+bench-backfill:
+	$(PY) bench.py --backfill
+
 # ONE run that emits every standing TPU-round artifact debt — BENCH_r*.json,
 # the owed BENCH_MQ_r*.json (SCHEDULER_TPU_BENCH_QUEUES=2) and
 # BENCH_XL_r*.json — under a shared round number, then gates the result.
@@ -52,10 +61,11 @@ bench-flagship:
 	$(PY) scripts/bench_flagship.py
 
 # Perf regression gate: newest artifact of each family (BENCH / BENCH_MQ /
-# BENCH_XL / BENCH_LP / BENCH_CHURN / BENCH_PREEMPT) vs its previous round,
-# healthy-regime cycles only; exits non-zero past a >10% pods/s drop (or
-# >10% churn/preempt-p99 RISE, or a churn hit rate below the artifact's own
-# floor) or a malformed/topology-less XL artifact.
+# BENCH_XL / BENCH_LP / BENCH_CHURN / BENCH_PREEMPT / BENCH_BF) vs its
+# previous round, healthy-regime cycles only; exits non-zero past a >10%
+# pods/s drop (or >10% churn/preempt-p99 RISE, or a churn hit rate below
+# the artifact's own floor), a malformed/topology-less XL artifact, or a
+# device-claim backfill artifact without engagement + bind-parity evidence.
 bench-gate:
 	$(PY) scripts/bench_gate.py
 

@@ -475,6 +475,106 @@ def preempt_main(smoke: bool) -> None:
     print(json.dumps(doc))
 
 
+def backfill_main(smoke: bool) -> None:
+    """``--backfill``: the pod-count-saturated BestEffort wave scenario
+    (docs/BACKFILL.md, harness/backfill_wave.py).
+
+    An oversized BestEffort wave lands on a cluster whose nodes hold only a
+    few free pod slots each; the scheduler runs ``backfill`` cycles and the
+    artifact (``BENCH_BF_r*.json``) carries backfill pods/s measured over
+    the steady tail re-sweeps (the regime where the flavors diverge), the
+    sweep-ops ledger (``predicate_calls_host`` vs ``device_classes``), the
+    per-cycle ``backfill`` evidence blocks (engagement + decline reasons)
+    and — when the device engine ran — an in-run A/B rerun under the
+    ``SCHEDULER_TPU_BACKFILL=host`` kill-switch that REFUSES to report a
+    speedup unless the bind digests are identical.  Shape is env-scalable
+    (``SCHEDULER_TPU_BF_*``); gated by ``scripts/bench_gate.py``."""
+    import os as _os
+
+    from scheduler_tpu.harness.backfill_wave import (
+        BackfillWaveConfig, run_backfill_bench,
+    )
+    from scheduler_tpu.ops.backfill import backfill_flavor
+    from scheduler_tpu.utils.envflags import env_int
+
+    cfg = BackfillWaveConfig(
+        seed=env_int("SCHEDULER_TPU_BF_SEED", 0, minimum=0),
+        nodes=env_int("SCHEDULER_TPU_BF_NODES", 16 if smoke else 2048,
+                      minimum=1),
+        wave_pods=env_int("SCHEDULER_TPU_BF_PODS", 40 if smoke else 20000,
+                          minimum=1),
+        fill_per_node=env_int("SCHEDULER_TPU_BF_FILL", 2 if smoke else 14,
+                              minimum=0),
+        measure_cycles=1 if smoke else 2,
+    )
+    flavor = backfill_flavor()
+    doc = run_backfill_bench(cfg)
+    doc["detail"]["backend"] = _backend()
+    doc["detail"]["retrace"] = _retrace_detail()
+    if not doc["detail"]["converged"]:
+        doc["error"] = (
+            "the scheduler never reached the steady tail regime inside the "
+            "window; the artifact cannot claim a backfill throughput"
+        )
+        print(json.dumps(doc))
+        sys.exit(1)
+    # A device-flavor artifact must have RUN the device engine: a silent
+    # host fallback (dynamic predicates, an unmodeled plugin) would file
+    # host-sweep numbers under a device claim.  The recorded decline
+    # reasons say why; the kill-switch run below is the legitimate host
+    # baseline and never trips this.
+    if flavor == "device" and not doc["detail"]["engaged_cycles"]:
+        doc["error"] = (
+            "--backfill refused: SCHEDULER_TPU_BACKFILL=device but no "
+            "measured cycle engaged the device engine (reasons: "
+            f"{doc['detail']['decline_reasons']}); a device artifact must "
+            "run the solve it claims"
+        )
+        print(json.dumps(doc))
+        sys.exit(1)
+    if flavor == "device":
+        # In-run A/B under the kill-switch: a FRESH rig (same seed, same
+        # wave) swept by the host path.  Save/restore the raw value, not a
+        # parse.  Placements are the contract — a throughput win with
+        # different binds is a refusal, not a result.
+        prev_bf = _os.environ.get("SCHEDULER_TPU_BACKFILL")  # schedlint: ignore[raw-env]
+        _os.environ["SCHEDULER_TPU_BACKFILL"] = "host"
+        try:
+            host_doc = run_backfill_bench(cfg)
+        finally:
+            if prev_bf is None:
+                _os.environ.pop("SCHEDULER_TPU_BACKFILL", None)
+            else:
+                _os.environ["SCHEDULER_TPU_BACKFILL"] = prev_bf
+        if (
+            host_doc["detail"]["binds_digest"]
+            != doc["detail"]["binds_digest"]
+            or host_doc["detail"]["binds"] != doc["detail"]["binds"]
+        ):
+            doc["error"] = (
+                "--backfill refused: binds diverged under the "
+                "SCHEDULER_TPU_BACKFILL=host kill-switch (device "
+                f"{doc['detail']['binds']} pods digest "
+                f"{doc['detail']['binds_digest'][:12]} vs host "
+                f"{host_doc['detail']['binds']} pods digest "
+                f"{host_doc['detail']['binds_digest'][:12]}); the engine "
+                "must change the work, never the placements"
+            )
+            print(json.dumps(doc))
+            sys.exit(1)
+        host_rate = host_doc["detail"]["backfill_pods_per_s"]
+        doc["detail"]["ab"] = {
+            "host_binds": host_doc["detail"]["binds"],
+            "binds_match": True,
+            "device_pods_per_s": doc["value"],
+            "host_pods_per_s": host_rate,
+            "speedup": round(doc["value"] / max(host_rate, 1e-9), 2),
+            "host_sweep_ops": host_doc["detail"]["sweep_ops"],
+            "host_regime": host_doc["detail"]["regime"],
+        }
+    print(json.dumps(doc))
+
+
 def tenant_main(smoke: bool) -> None:
     """``--tenant``: the multi-tenant stacked device phase scenario
     (docs/TENANT.md, harness/tenant.py).
@@ -550,6 +650,9 @@ def main() -> None:
         return
     if "--tenant" in sys.argv:
         tenant_main(smoke)
+        return
+    if "--backfill" in sys.argv:
+        backfill_main(smoke)
         return
     if "--mq" in sys.argv:
         mq_main(smoke)

@@ -1,6 +1,14 @@
 """Backfill: place zero-request (BestEffort) tasks wherever predicates pass
 (reference ``actions/backfill/backfill.go``).
 
+Two flavors (docs/BACKFILL.md): ``SCHEDULER_TPU_BACKFILL=host`` (default)
+runs the reference per-task sweep below, with the cohort fast-start;
+``device`` consults ``ops/backfill.py`` — the batched class engine — and
+falls back here (with a recorded decline reason in the ``backfill``
+evidence channel) whenever the session leaves the engine's modeled domain.
+The host path is the kill-switch and the parity oracle
+(tests/test_backfill_parity.py).
+
 Cohort fast-start (round 6, docs/COHORT.md): BestEffort pods overwhelmingly
 share one predicate signature (selector, tolerations, affinity spec), and the
 reference's per-task sweep re-scans the same failing node prefix for every
@@ -26,6 +34,8 @@ from scheduler_tpu.api.types import TaskStatus
 from scheduler_tpu.api.unschedule_info import FitErrors
 from scheduler_tpu.apis.objects import PodGroupPhase
 from scheduler_tpu.framework.interface import Action
+from scheduler_tpu.ops import backfill as backfill_ops
+from scheduler_tpu.ops.lp_place import allocator_flavor
 from scheduler_tpu.utils import phases
 from scheduler_tpu.utils.scheduler_helper import get_node_list
 from scheduler_tpu.utils.sweep import static_predicate_sig
@@ -34,6 +44,10 @@ logger = logging.getLogger("scheduler_tpu.actions.backfill")
 
 
 class BackfillAction(Action):
+    # Sweep-ops ledger for the evidence block: host predicate invocations
+    # this _execute (the quantity the device engine's class mask deletes).
+    _pred_calls = 0
+
     def name(self) -> str:
         return "backfill"
 
@@ -53,6 +67,7 @@ class BackfillAction(Action):
         first_bind_fail = None
         for idx in range(start, len(nodes) if end is None else end):
             node = nodes[idx]
+            self._pred_calls += 1
             try:
                 ssn.predicate_fn(task, node)
             except Exception as err:
@@ -78,16 +93,24 @@ class BackfillAction(Action):
         # LP relaxation's bin-pack objective is vacuous — there is no
         # resource mass to assign fractionally, and every predicate-passing
         # node ties.  SCHEDULER_TPU_ALLOCATOR=lp therefore deliberately
-        # keeps backfill on the reference host sweep (a first-passing-node
-        # scan IS the integral optimum here); the flavor is consulted so
-        # the decision is explicit and logged, not accidental.
-        from scheduler_tpu.ops.lp_place import allocator_flavor
+        # keeps backfill on its own flavors (a first-passing-node scan IS
+        # the integral optimum here); the decision rides the backfill
+        # evidence block (``lp_noop``) instead of a bare debug log, so the
+        # no-op is visible wherever decline reasons are.
+        engine = backfill_ops.BackfillEngine(ssn)
+        engine.lp_noop = allocator_flavor() == "lp"
+        if engine.active:
+            engine.run()
+            backfill_ops.note_evidence(engine.stats())
+            return
+        stats = engine.stats()
+        self._pred_calls = 0
+        host = self._execute_host(ssn)
+        host["predicate_calls_host"] = self._pred_calls
+        stats.update(host)
+        backfill_ops.note_evidence(stats)
 
-        if allocator_flavor() == "lp":
-            logger.debug(
-                "backfill: SCHEDULER_TPU_ALLOCATOR=lp has no effect on "
-                "zero-request tasks; keeping the host sweep"
-            )
+    def _execute_host(self, ssn) -> dict:
         nodes = None  # materialized on the first BestEffort task, not per cycle
         # Cohort fast-start applies only when every registered predicate is
         # signature-static (sound prefix skipping needs it).  Per task,
@@ -96,6 +119,7 @@ class BackfillAction(Action):
         # host-port / inter-pod-affinity pods, which opt out individually.
         cohorts_sound = set(ssn.predicate_fns) <= set(ssn.static_predicate_fns)
         start_at: dict = {}  # predicate signature -> proven-failing prefix end
+        counters = {"tasks": 0, "host_binds": 0, "unplaceable": 0}
         for job in list(ssn.jobs.values()):
             if job.pod_group is not None and job.pod_group.status.phase == PodGroupPhase.PENDING:
                 continue
@@ -106,6 +130,7 @@ class BackfillAction(Action):
             for task in list(job.task_status_index.get(TaskStatus.PENDING, {}).values()):
                 if not task.init_resreq.is_empty():
                     continue  # only BestEffort tasks backfill
+                counters["tasks"] += 1
                 if nodes is None:
                     nodes = get_node_list(ssn.nodes)
                 key = static_predicate_sig(task) if cohorts_sound else None
@@ -125,11 +150,15 @@ class BackfillAction(Action):
                     )
                 if won is None:
                     job.nodes_fit_errors[task.uid] = fe
-                elif key is not None:
+                    counters["unplaceable"] += 1
+                    continue
+                counters["host_binds"] += 1
+                if key is not None:
                     # Cache only the prefix that provably fails for the
                     # signature: everything before the first bind failure
                     # (those nodes passed predicates and must be retried).
                     start_at[key] = won if bind_fail is None else min(won, bind_fail)
+        return counters
 
 
 def new() -> BackfillAction:

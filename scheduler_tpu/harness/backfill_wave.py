@@ -1,0 +1,384 @@
+"""Backfill-wave scenario: a BestEffort pod wave over a pod-count-saturated
+cluster, through the real wire (docs/BACKFILL.md).
+
+The preempt storm (harness/preempt_storm.py) prices evictions on a
+CPU-saturated cluster; the backfill regime is its zero-resource mirror —
+BestEffort pods carry an EMPTY resource request, so the only capacities in
+play are the static predicates and each node's pod-count room.  Production's
+hard case is the oversized wave: far more BestEffort filler than the cluster
+has pod slots, so after the placeable head binds, every later cycle re-sweeps
+the unplaceable tail.  The host sweep pays O(tail x nodes) exception-driven
+predicate calls per cycle for that tail; the device engine
+(``SCHEDULER_TPU_BACKFILL=device``, ops/backfill.py) folds it into per-class
+masks and a batched water-fill — this scenario makes that gap measurable.
+
+The artifact (``BENCH_BF_r*.json``, gated by ``scripts/bench_gate.py``)
+measures **backfill pods/s**: BestEffort tasks processed per second of cycle
+time, taken over steady-state cycles (tail-only re-sweeps, no binds — the
+regime where the flavors diverge) when the wave oversubscribes the cluster,
+else over the bind cycle.  Alongside: the sweep-ops ledger
+(``predicate_calls_host`` vs ``device_classes``), the per-cycle ``backfill``
+evidence blocks proving which flavor ran (engagement + decline reasons), and
+a bind digest for the in-run host A/B comparison (``bench.py --backfill``
+REFUSES to report a speedup when the digests diverge).
+
+Pieces, each usable alone (the preempt-storm layout):
+
+* ``seed_wave(state, cfg)`` — preloads a mock apiserver's store with the
+  saturated cluster AND the BestEffort wave (the connector's initial LIST
+  delivers both; the bench measures engine throughput, not wire latency);
+* ``seed_wave_cache(cfg)`` — the same objects straight into a
+  SchedulerCache (no wire), for ``profile_cycle --backfill`` and tests;
+* ``run_backfill_bench(cfg)`` — the full rig behind ``bench.py --backfill``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List
+
+from scheduler_tpu.harness.churn import _percentile
+
+GIB = 1024.0 * 1024.0 * 1024.0
+
+# Scheduling conf for the wave rig: backfill only, predicates enabled — the
+# wave is ALL BestEffort, so allocate would walk the job list and skip every
+# task (actions/allocate.py leaves empty requests to backfill).  Predicates
+# supply the node_selector mask AND the pod-count gate (ops/predicates.py);
+# without the plugin the host sweep enforces nothing and the scenario
+# collapses to a trivial first-node fill.
+BACKFILL_CONF = """
+actions: "backfill"
+tiers:
+- plugins:
+  - name: predicates
+"""
+
+# Node zones: labels partition the cluster, zone-pinned wave pods carry a
+# matching node_selector — the class mask is non-trivial (one signature
+# class per selector flavor x queue) without inflating the class count past
+# what a real BestEffort filler fleet looks like.
+ZONES = ("za", "zb", "zc", "zd")
+
+
+@dataclass
+class BackfillWaveConfig:
+    seed: int = 0
+    nodes: int = 2048
+    wave_pods: int = 20000         # BestEffort arrivals (the measured wave)
+    fill_per_node: int = 14        # Running pods per node pre-wave
+    pods_limit: int = 22           # node pod capacity: room = limit - fill
+    selector_every: int = 3        # every k-th wave pod is zone-pinned
+    measure_cycles: int = 2        # steady-state tail re-sweeps to sample
+    drain_timeout_s: float = 900.0
+    max_interval_s: float = 0.25   # quiet-cluster rescan clamp
+    namespace: str = "default"
+
+    @property
+    def room_per_node(self) -> int:
+        return max(self.pods_limit - self.fill_per_node, 0)
+
+    @property
+    def capacity(self) -> int:
+        """Pod-count slots the wave can fill (selectors may strand some)."""
+        return self.nodes * self.room_per_node
+
+
+def _seed_objects(cfg: BackfillWaveConfig) -> Dict[str, Dict[str, dict]]:
+    """The saturated cluster plus the wave as wire-shaped objects, shared by
+    the server seeding and the cache seeding so the two can never drift."""
+    import numpy as np
+
+    ns = cfg.namespace
+    objects: Dict[str, Dict[str, dict]] = {
+        "queue": {}, "node": {}, "podgroup": {}, "pod": {},
+    }
+    objects["queue"]["default"] = {"name": "default", "weight": 1}
+    for i in range(cfg.nodes):
+        name = f"bn-{i:05d}"
+        objects["node"][name] = {
+            "name": name,
+            "labels": {"zone": ZONES[i % len(ZONES)]},
+            "allocatable": {
+                "cpu": 8000.0,
+                "memory": 32.0 * GIB,
+                "pods": cfg.pods_limit,
+            },
+        }
+    # Pre-wave occupancy: Running pods pinned round-robin, eating
+    # ``fill_per_node`` of every node's pod count.  They carry a real CPU
+    # request — backfill ignores them either way; what matters is
+    # ``len(node.tasks)`` against the pod limit (the monotone room gate).
+    group = "occupied"
+    objects["podgroup"][f"{ns}/{group}"] = {
+        "name": group, "namespace": ns, "queue": "default",
+        "minMember": 1, "phase": "Running",
+    }
+    total = cfg.nodes * cfg.fill_per_node
+    for k in range(total):
+        name = f"{group}-{k:06d}"
+        objects["pod"][f"{ns}/{name}"] = {
+            "name": name, "namespace": ns, "uid": f"{ns}/{name}",
+            "group": group,
+            "containers": [{"cpu": 100.0, "memory": 0.25 * GIB}],
+            "phase": "Running",
+            "nodeName": f"bn-{k % cfg.nodes:05d}",
+        }
+    # The BestEffort wave: EMPTY containers -> empty resource request, the
+    # population actions/backfill.py owns.  Zone pins rotate through a
+    # seeded permutation so consecutive wave pods interleave signature
+    # classes — the device engine's run segmentation earns its keep.
+    lane = "wave"
+    objects["podgroup"][f"{ns}/{lane}"] = {
+        "name": lane, "namespace": ns, "queue": "default",
+        "minMember": 1, "phase": "Inqueue",
+    }
+    rng = np.random.default_rng(cfg.seed)
+    zone_of = rng.integers(0, len(ZONES), size=cfg.wave_pods)
+    for p in range(cfg.wave_pods):
+        name = f"wave-{p:06d}"
+        pod = {
+            "name": name, "namespace": ns, "uid": f"{ns}/{name}",
+            "group": lane,
+            "containers": [],
+            "phase": "Pending",
+        }
+        if cfg.selector_every > 0 and p % cfg.selector_every == 0:
+            pod["nodeSelector"] = {"zone": ZONES[int(zone_of[p])]}
+        objects["pod"][f"{ns}/{name}"] = pod
+    return objects
+
+
+def seed_wave(state, cfg: BackfillWaveConfig) -> None:
+    """Preload a ``mock_server.MockState`` store with the saturated cluster
+    and the wave (no journal events: the connector's initial LIST seeds it —
+    the scenario measures cycle compute, not watch throughput)."""
+    objects = _seed_objects(cfg)
+    with state.lock:
+        for kind, by_key in objects.items():
+            state.objects[kind].update(by_key)
+
+
+def seed_wave_cache(cfg: BackfillWaveConfig, vocab=None):
+    """The same objects straight into a SchedulerCache (no wire) —
+    ``profile_cycle --backfill`` and tests use this seam.  Goes through the
+    SAME wire parsers as the server path."""
+    from scheduler_tpu.cache.cache import SchedulerCache
+    from scheduler_tpu.connector.wire import (
+        parse_node, parse_pod, parse_pod_group, parse_queue,
+    )
+
+    objects = _seed_objects(cfg)
+    cache = SchedulerCache(vocab=vocab, async_io=False)
+    for q in objects["queue"].values():
+        cache.add_queue(parse_queue(q))
+    for n in objects["node"].values():
+        cache.add_node(parse_node(n))
+    for g in objects["podgroup"].values():
+        cache.add_pod_group(parse_pod_group(g))
+    for p in objects["pod"].values():
+        cache.add_pod(parse_pod(p, cache.scheduler_name))
+    return cache
+
+
+def _bind_digest(binds: List[dict]) -> str:
+    """Order-free digest of the (pod -> node) outcome — the A/B refusal
+    compares digests instead of shipping 20k pairs in the artifact."""
+    import hashlib
+
+    lines = sorted(f"{b['pod']}={b['node']}" for b in binds)
+    return hashlib.sha256("\n".join(lines).encode()).hexdigest()
+
+
+def _cycle_rows(cycles: List[dict]) -> List[dict]:
+    """Per-cycle artifact rows: latency, event batch, and the backfill
+    evidence block (ops/backfill.py stats -> phases.note)."""
+    return [
+        {
+            "s": round(c["s"], 4),
+            "t": round(c["t"], 3),
+            "events": c["events"],
+            "backfill": c["notes"].get("backfill", {}),
+        }
+        for c in cycles[-200:]
+    ]
+
+
+def _note(c: dict) -> dict:
+    return c["notes"].get("backfill") or {}
+
+
+def _binds_in(c: dict) -> int:
+    n = _note(c)
+    return int(n.get("device_binds", 0)) + int(n.get("host_binds", 0))
+
+
+def run_backfill_bench(cfg: BackfillWaveConfig) -> dict:
+    """Run the backfill-wave scenario end to end and return the artifact
+    body (``BENCH_BF_r*.json``).  The engine flavor is whatever
+    ``SCHEDULER_TPU_BACKFILL`` says; the artifact records it plus the
+    per-cycle engagement evidence and the bind digest ``bench.py``'s in-run
+    A/B compares across flavors."""
+    import tempfile
+
+    import scheduler_tpu.actions  # noqa: F401  registry side effects
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.connector.client import connect_cache
+    from scheduler_tpu.connector.mock_server import serve
+    from scheduler_tpu.ops.backfill import backfill_flavor
+    from scheduler_tpu.scheduler import Scheduler
+    from scheduler_tpu.utils.trigger import CycleTrigger
+
+    flavor = backfill_flavor()
+    server, state = serve(0)
+    server_thread = threading.Thread(target=server.serve_forever, daemon=True)
+    server_thread.start()
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    seed_wave(state, cfg)
+
+    # Outbound dialect: batched legacy RPCs (the churn rig's choice) — a
+    # placeable head of thousands of binds per cycle would otherwise price
+    # urllib's one-connection-per-request transport, not the engine.
+    cache, connector = connect_cache(base, dialect="legacy")
+    stop = threading.Event()
+    sched_thread = None
+    conf_file = tempfile.NamedTemporaryFile(
+        "w", suffix=".yaml", prefix="backfill-conf-", delete=False
+    )
+    try:
+        conf_file.write(BACKFILL_CONF)
+        conf_file.close()
+        cache.run()
+        connector.start()
+        if not connector.wait_for_cache_sync(timeout=120):
+            raise RuntimeError("backfill rig: cache never synced")
+
+        trigger = CycleTrigger.from_env(default_max_interval=cfg.max_interval_s)
+        sched = Scheduler(
+            cache, scheduler_conf=conf_file.name,
+            schedule_period=cfg.max_interval_s,
+            trigger=trigger, record_cycles=True,
+        )
+        sched_thread = threading.Thread(
+            target=sched.run, args=(stop,), daemon=True
+        )
+        sched_thread.start()
+
+        # Convergence protocol: the initial LIST hands cycle 1 the whole
+        # wave; the placeable head binds (echoed back as watch events that
+        # trigger follow-up cycles), then the rescan clamp re-sweeps the
+        # unplaceable tail forever.  Steady state = ``measure_cycles``
+        # consecutive backfill cycles with zero binds and zero events after
+        # the last cycle that bound anything — the tail-only regime the
+        # pods/s metric samples.
+        deadline = time.monotonic() + cfg.drain_timeout_s
+        converged = False
+        while time.monotonic() < deadline:
+            log = list(sched.cycle_log)
+            swept = [c for c in log if _note(c)]
+            tail = []
+            for c in swept:
+                if _binds_in(c) or c["events"]:
+                    tail = []
+                else:
+                    tail.append(c)
+            if any(_binds_in(c) for c in swept) and (
+                len(tail) >= cfg.measure_cycles
+            ):
+                converged = True
+                break
+            time.sleep(0.2)
+        stop.set()
+        sched_thread.join(timeout=120)
+        cycles = [c for c in sched.cycle_log if _note(c)]
+        with state.lock:
+            binds = [dict(b) for b in state.bind_log]
+    finally:
+        stop.set()
+        # Teardown order matters (harness/churn.py): drain the cache's
+        # async IO against the LIVE server, then ingestion, then the server.
+        cache.stop()
+        try:
+            connector.stop()
+        except Exception:
+            pass
+        server.shutdown()
+        import os
+
+        try:
+            os.unlink(conf_file.name)
+        except OSError:
+            pass
+
+    # The bind cycle (first engaged sweep over the full wave) vs the steady
+    # tail re-sweeps.  pods/s is measured where the flavors diverge: the
+    # steady tail when the wave oversubscribed the cluster, else the bind
+    # cycle (smoke shapes place everything — nothing is left to re-sweep).
+    bind_cycles = [c for c in cycles if _binds_in(c)]
+    steady: List[dict] = []
+    for c in cycles:
+        if _binds_in(c) or c["events"]:
+            steady = []
+        elif int(_note(c).get("tasks", 0)) > 0:
+            steady.append(c)
+    steady = steady[: cfg.measure_cycles]
+    if steady:
+        rates = [int(_note(c)["tasks"]) / max(c["s"], 1e-9) for c in steady]
+        regime = "steady-tail"
+    elif bind_cycles:
+        c = bind_cycles[0]
+        rates = [int(_note(c).get("tasks", 0)) / max(c["s"], 1e-9)]
+        regime = "bind-cycle"
+    else:
+        rates = [0.0]
+        regime = "none"
+    pods_per_s = _percentile(rates, 50)
+
+    first = _note(bind_cycles[0]) if bind_cycles else {}
+    engaged = sum(1 for c in cycles if _note(c).get("engaged"))
+    declined = sorted({
+        str(_note(c).get("reason"))
+        for c in cycles
+        if _note(c) and not _note(c).get("engaged") and _note(c).get("reason")
+    })
+
+    detail = {
+        "family": "backfill",
+        "backfill_flavor": flavor,
+        "seed": cfg.seed,
+        "nodes": cfg.nodes,
+        "wave_pods": cfg.wave_pods,
+        "fill_per_node": cfg.fill_per_node,
+        "pods_limit": cfg.pods_limit,
+        "room": cfg.capacity,
+        "converged": converged,
+        "regime": regime,
+        "cycles_measured": len(steady) if steady else len(rates),
+        "binds": len(binds),
+        "unplaced": cfg.wave_pods - len(binds),
+        "binds_digest": _bind_digest(binds),
+        "backfill_pods_per_s": round(pods_per_s, 2),
+        "sweep_ops": {
+            # The ledger pair the tentpole exists for: host predicate calls
+            # on the bind cycle vs the class count the device solved over.
+            "predicate_calls_host": int(first.get("predicate_calls_host", 0)),
+            "device_classes": int(first.get("device_classes", 0)),
+        },
+        "engaged_cycles": engaged,
+        "decline_reasons": declined,
+        "cycles": _cycle_rows(
+            [c for c in ([] if not bind_cycles else [bind_cycles[0]]) ]
+            + steady
+        ) if (bind_cycles or steady) else [],
+    }
+    return {
+        "metric": "backfill_pods_per_s",
+        "value": detail["backfill_pods_per_s"],
+        "unit": "pods/s",
+        # Working target: a steady tail re-sweep should process the whole
+        # BestEffort population at >= 10k pods/s on the reference shape.
+        "vs_target": round(detail["backfill_pods_per_s"] / 10000.0, 4),
+        "detail": detail,
+    }

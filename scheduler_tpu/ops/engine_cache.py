@@ -135,6 +135,15 @@ _ENV_KEYS = (
     # never hide behind a warm cache across a flag flip (re-checked by
     # _delta_compatible for direct update() callers).
     "SCHEDULER_TPU_EVICT",
+    # Backfill flavor (ops/backfill.py, docs/BACKFILL.md): host per-task
+    # sweep vs the batched class engine.  The SCHEDULER_TPU_EVICT precedent
+    # verbatim: never read by the allocate engine build itself, but a
+    # resident engine is pinned to the backfill regime it was diagnosed
+    # under — the host-vs-device parity contract says the flavor never
+    # changes binds, and keying here means a violation can never hide
+    # behind a warm cache across a flag flip (re-checked by
+    # _delta_compatible for direct update() callers).
+    "SCHEDULER_TPU_BACKFILL",
     # Observability (utils/obs.py, utils/trace.py, docs/OBSERVABILITY.md).
     # None of these change a traced program, but — the SHARDCHECK precedent
     # — a resident engine must not straddle a diagnostics-regime flip

@@ -1085,6 +1085,14 @@ class FusedAllocator:
         from scheduler_tpu.ops.evict import evict_flavor
 
         self.evict_flavor = evict_flavor()
+        # Backfill flavor (ops/backfill.py, docs/BACKFILL.md): same
+        # contract as the eviction flavor above — never read by the
+        # allocate program, pinned so a resident engine cannot straddle a
+        # backfill-regime flip (engine-cache key + the _delta_compatible
+        # re-check for direct update() callers).
+        from scheduler_tpu.ops.backfill import backfill_flavor
+
+        self.backfill_flavor = backfill_flavor()
         # Service regime (ops/tenant.py + connector/reflector.py,
         # docs/TENANT.md): batch width and watch-shard count never change
         # this engine's program — stacked lanes ARE the solo graph, shards
@@ -2273,6 +2281,15 @@ class FusedAllocator:
             # violation of that contract must not hide behind a warm
             # resident across a flag flip — same pinning rationale as the
             # cache key's SCHEDULER_TPU_EVICT component.
+            return False
+        from scheduler_tpu.ops.backfill import backfill_flavor
+
+        if self.backfill_flavor != backfill_flavor():
+            # The backfill regime never changes this engine's program (the
+            # host-vs-device parity contract, docs/BACKFILL.md), but a
+            # violation must not hide behind a warm resident across a flag
+            # flip — same pinning rationale as the cache key's
+            # SCHEDULER_TPU_BACKFILL component.
             return False
         from scheduler_tpu.connector.reflector import watch_shards
         from scheduler_tpu.ops.tenant import tenant_count

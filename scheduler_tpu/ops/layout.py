@@ -474,6 +474,20 @@ SHARD_SITES = {
         "in": ("node_major_2d",),
         "out": ("replicated",),
     },
+    # Device backfill fill (ops/backfill.py, docs/BACKFILL.md): the
+    # masked-capacity water-fill over a segment's runs.  Class-mask rows
+    # [R, N] shard node-trailing, pod room [N] node-major, run counts [R]
+    # replicate; per run step each shard cumsums its local masked room and
+    # the per-shard TOTALS cross once as an all-gather — takes come back
+    # node-trailing, filled counts replicated.
+    "ops/backfill.py::_bf_fill_1d": {
+        "in": ("node_trailing", "node_major", "replicated"),
+        "out": ("node_trailing", "replicated"),
+    },
+    "ops/backfill.py::_bf_fill_2d": {
+        "in": ("node_trailing_2d", "node_major_2d", "replicated"),
+        "out": ("node_trailing_2d", "replicated"),
+    },
     # Multi-tenant K-lane placement scan (ops/sharded.py tenant_place_scan,
     # docs/TENANT.md): K stacked tenant problems in one program.  The lane
     # axis leads every tenant operand and is replicated everywhere; node
@@ -585,6 +599,16 @@ COLLECTIVE_BUDGET = {
         "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
     },
     "ops/evict.py::_victim_pick_2d": {
+        "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
+    },
+    # Backfill fill: exactly one per-shard-totals all-gather per run step
+    # of the scan, zero all-reduces — the masked-capacity prefix needs each
+    # shard's total room and nothing else crosses the mesh (verified:
+    # shard_budget on both mesh shapes).
+    "ops/backfill.py::_bf_fill_1d": {
+        "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
+    },
+    "ops/backfill.py::_bf_fill_2d": {
         "all-gather": 1, "all-reduce": 0, "collective-permute": 0,
     },
     # Tenant scan: the K lanes' candidate tuples pack into ONE [W, K] tensor
@@ -724,6 +748,17 @@ FLAVORS = (
         "bench": "lp-allocator", "bench_exempt": None,
     },
     {
+        "flag": "SCHEDULER_TPU_BACKFILL",
+        "values": "host|device", "default": "host",
+        "env_keys": True, "delta": "backfill_flavor",
+        "parity": "host per-task sweep with cohort fast-start",
+        "parity_exempt": None,
+        "test": "tests/test_backfill_parity.py", "test_exempt": None,
+        "doc": "docs/BACKFILL.md",
+        "obs": "backfill", "obs_exempt": None,
+        "bench": "backfill", "bench_exempt": None,
+    },
+    {
         "flag": "SCHEDULER_TPU_BENCH_GANG",
         "values": "int>=1", "default": "100",
         "env_keys": False, "delta": None,
@@ -787,6 +822,58 @@ FLAVORS = (
         "doc": "docs/QUEUE_DELTA.md",
         "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
         "bench": "flagship", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_BF_FILL",
+        "values": "int>=0", "default": "14 (2 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--backfill runs, not unit tests",
+        "doc": "docs/BACKFILL.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "backfill", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_BF_NODES",
+        "values": "int>=1", "default": "2048 (16 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--backfill runs, not unit tests",
+        "doc": "docs/BACKFILL.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "backfill", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_BF_PODS",
+        "values": "int>=1", "default": "20000 (40 smoke)",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness shape knob; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness shape knob; exercised by bench.py "
+                       "--backfill runs, not unit tests",
+        "doc": "docs/BACKFILL.md",
+        "obs": None, "obs_exempt": "harness knob; shape rides the artifact",
+        "bench": "backfill", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_BF_SEED",
+        "values": "int", "default": "0",
+        "env_keys": False, "delta": None,
+        "parity": None,
+        "parity_exempt": "bench harness seed; no engine twin",
+        "test": None,
+        "test_exempt": "bench harness seed; exercised by bench.py "
+                       "--backfill runs, not unit tests",
+        "doc": "docs/BACKFILL.md",
+        "obs": None, "obs_exempt": "harness seed; recorded on the artifact",
+        "bench": "backfill", "bench_exempt": None,
     },
     {
         "flag": "SCHEDULER_TPU_BULK",

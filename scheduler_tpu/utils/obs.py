@@ -122,6 +122,16 @@ OBS_CHANNELS = (
         "desc": "device/host victim-hunt engagement, plans and phase split",
     },
     {
+        "channel": "backfill",
+        "source": "ops/backfill.py",
+        "metric": None,
+        "exempt": "engine evidence per flavor (sweep-ops ledger, decline "
+                  "reasons); consumed by bench detail.cycles[].backfill "
+                  "and the BENCH_BF gate",
+        "desc": "device/host backfill engagement, class/run counts and "
+                "mask/solve/replay phase split",
+    },
+    {
         "channel": "retrace",
         "source": "actions/allocate.py",
         "metric": None,

@@ -53,6 +53,16 @@ a directory given as argv[1]):
   tenant fields, a per-tenant p99 list that does not cover every tenant,
   or an artifact claiming the family with zero stacked lanes = malformed
   (exit 1, the LP family's silent-fallback rule);
+* ``BENCH_BF_r*.json`` — the pod-count-saturated BestEffort wave scenario
+  (``bench.py --backfill``, docs/BACKFILL.md).  HIGHER is better (the
+  metric is backfill pods/s over the steady tail re-sweeps), with the
+  flagship comparator: the newest artifact more than 10% below the
+  previous round's fails, same scenario shape AND flavor required.
+  Malformedness (exit 1, the LP family's silent-fallback rule): missing
+  backfill fields; a ``backfill_flavor == "device"`` claim with zero
+  engaged cycles; or a device claim without the in-run host A/B block
+  proving ``binds_match`` — a throughput number whose placements were
+  never proven identical to the host sweep is not a measurement;
 * ``BENCH_LP_r*.json``  — the LP-relaxed allocator flagship
   (``SCHEDULER_TPU_ALLOCATOR=lp``, docs/LP_PLACEMENT.md).  LP artifacts
   must record ``detail.allocator == "lp"`` (else malformed, exit 1), and
@@ -107,7 +117,7 @@ TOLERANCE = 0.10
 MIN_HEALTHY = 3
 
 _ROUND_RE = re.compile(
-    r"BENCH(_MQ|_XL|_LP|_CHURN|_PREEMPT|_TENANT)?_r(\d+)\.json$"
+    r"BENCH(_MQ|_XL|_LP|_CHURN|_PREEMPT|_TENANT|_BF)?_r(\d+)\.json$"
 )
 
 # (family label, filename infix) — the artifact naming contract.  The churn
@@ -160,6 +170,24 @@ _TENANT_KEYS = (
     ("per_tenant_p99_ms", list), ("p99_isolation", (int, float)),
     ("isolation_bound", (int, float)), ("cycles_measured", int),
     ("stacked_lanes", int),
+)
+
+# Backfill-family policy: backfill pods/s is higher-is-better (the flagship
+# TOLERANCE).  A device-flavor artifact must carry BOTH engagement evidence
+# (zero engaged cycles = a host sweep filed under the device claim) and the
+# in-run host A/B block with matching bind digests (a throughput claim
+# without the placement-identity proof is not a measurement) — either gap
+# is malformed, exit 1 (docs/BACKFILL.md).
+BF_TOLERANCE = 0.10
+
+# detail keys every backfill artifact must carry, with their types — the
+# backfill evidence chain (docs/BACKFILL.md); a missing field means the
+# artifact cannot defend a throughput claim.
+_BF_KEYS = (
+    ("backfill_pods_per_s", (int, float)), ("backfill_flavor", str),
+    ("engaged_cycles", int), ("cycles_measured", int), ("binds", int),
+    ("binds_digest", str), ("converged", bool), ("sweep_ops", dict),
+    ("regime", str),
 )
 
 # LP may bind up to this fraction fewer pods than greedy on the same shape
@@ -760,6 +788,106 @@ def gate_tenant(root: Path) -> int:
     return max(worst, 2 if new_pps < floor else 0)
 
 
+def _bf_detail(path: Path):
+    """The backfill artifact's detail block, or (None, reason) when it is
+    malformed — a device claim needs engagement evidence AND the bind-parity
+    A/B block, not just a number (docs/BACKFILL.md)."""
+    doc = _unwrap(json.loads(path.read_text()))
+    detail = doc.get("detail", {})
+    if detail.get("family") != "backfill":
+        return None, f"{path.name} does not record detail.family == 'backfill'"
+    for key, typ in _BF_KEYS:
+        if not isinstance(detail.get(key), typ):
+            return None, (
+                f"{path.name} is missing backfill field detail.{key} — "
+                "re-emit via bench.py --backfill"
+            )
+    if detail["backfill_flavor"] == "device":
+        if detail["engaged_cycles"] == 0:
+            return None, (
+                f"{path.name} claims backfill_flavor == 'device' but records "
+                "zero engaged cycles — a host-sweep measurement must not "
+                "file under the device flavor (see detail.decline_reasons "
+                "and detail.cycles[].backfill for why the engine declined)"
+            )
+        ab = detail.get("ab")
+        if not isinstance(ab, dict) or ab.get("binds_match") is not True:
+            return None, (
+                f"{path.name} claims backfill_flavor == 'device' without an "
+                "in-run host A/B block proving binds_match — a device "
+                "throughput claim needs the placement-identity proof "
+                "(bench.py --backfill emits it under detail.ab)"
+            )
+    return detail, None
+
+
+def _bf_shape(detail: dict):
+    """The scenario (and flavor) two backfill artifacts must share to be
+    compared — a host round and a device round measure different engines."""
+    return (
+        detail.get("backfill_flavor"), detail.get("nodes"),
+        detail.get("wave_pods"), detail.get("fill_per_node"),
+        detail.get("pods_limit"),
+    )
+
+
+def gate_backfill(root: Path) -> int:
+    """Gate the ``BENCH_BF_r*.json`` family (docs/BACKFILL.md): HIGHER is
+    better — the newest backfill pods/s more than ``BF_TOLERANCE`` below
+    the previous round's fails, same scenario shape AND flavor required;
+    different shapes are not compared.  Exit codes as main()."""
+    artifacts = find_artifacts(root, "_BF")
+    if not artifacts:
+        print("bench-gate[backfill]: no BENCH_BF_r*.json; nothing to judge")
+        return 0
+    try:
+        new_detail, why = _bf_detail(artifacts[-1])
+    except json.JSONDecodeError as err:
+        print(f"bench-gate[backfill]: malformed artifact "
+              f"{artifacts[-1].name}: {err}")
+        return 1
+    if new_detail is None:
+        print(f"bench-gate[backfill]: {why}")
+        return 1
+    if len(artifacts) < 2:
+        print(
+            f"bench-gate[backfill]: {artifacts[-1].name} well-formed "
+            f"(flavor {new_detail['backfill_flavor']}, "
+            f"{new_detail['backfill_pods_per_s']:,.1f} pods/s over the "
+            f"{new_detail['regime']} regime, "
+            f"{new_detail['engaged_cycles']} engaged cycle(s)); one "
+            "artifact, no round to compare"
+        )
+        return 0
+    try:
+        prev_detail, why = _bf_detail(artifacts[-2])
+    except json.JSONDecodeError as err:
+        print(f"bench-gate[backfill]: malformed artifact "
+              f"{artifacts[-2].name}: {err}")
+        return 1
+    if prev_detail is None:
+        print(f"bench-gate[backfill]: {why}")
+        return 1
+    if _bf_shape(prev_detail) != _bf_shape(new_detail):
+        print(
+            f"bench-gate[backfill]: {artifacts[-2].name} "
+            f"{_bf_shape(prev_detail)} and {artifacts[-1].name} "
+            f"{_bf_shape(new_detail)} ran different scenario shapes; "
+            "not comparable (no verdict)"
+        )
+        return 0
+    prev_pps = prev_detail["backfill_pods_per_s"]
+    new_pps = new_detail["backfill_pods_per_s"]
+    floor = (1.0 - BF_TOLERANCE) * prev_pps
+    verdict = "REGRESSION" if new_pps < floor else "ok"
+    print(
+        f"bench-gate[backfill]: {artifacts[-2].name} "
+        f"{prev_pps:,.1f} pods/s -> {artifacts[-1].name} "
+        f"{new_pps:,.1f} pods/s (floor {floor:,.1f}): {verdict}"
+    )
+    return 2 if new_pps < floor else 0
+
+
 def gate_family(root: Path, label: str, infix: str) -> int:
     """Gate one artifact family; same exit-code contract as main()."""
     artifacts = find_artifacts(root, infix)
@@ -855,7 +983,7 @@ def main(argv) -> int:
     worst = max(gate_family(root, label, infix) for label, infix in FAMILIES)
     return max(
         worst, gate_lp_vs_greedy(root), gate_churn(root), gate_preempt(root),
-        gate_tenant(root),
+        gate_tenant(root), gate_backfill(root),
     )
 
 

@@ -23,6 +23,15 @@ engagement, hunt/plan/eviction counters) and the victim-hunt phase split
 VictimGate's admit/skip coverage when the host flavor ran.  Flip
 ``SCHEDULER_TPU_EVICT={host,device}`` to A/B the two hunt flavors.
 
+``--backfill`` profiles the pod-count-saturated BestEffort wave instead
+(docs/BACKFILL.md): a cluster whose nodes hold only a few free pod slots
+(``nodes`` hollow nodes at ``fill`` occupied pods each), an oversized
+BestEffort wave, then timed ``backfill`` cycles — printing the backfill
+evidence block (flavor, engagement or the decline reason, class/run
+counts, the sweep-ops ledger) and the engine's mask/solve/replay phase
+split next to the standard cycle phase split.  Flip
+``SCHEDULER_TPU_BACKFILL={host,device}`` to A/B the two sweep flavors.
+
 ``--allocator lp`` profiles the LP-relaxed flavor (docs/LP_PLACEMENT.md):
 sets ``SCHEDULER_TPU_ALLOCATOR`` for the run and splits the device phase
 into the relaxation iterations vs the repair replay vs the readback — the
@@ -311,8 +320,54 @@ def run_preempt(n_nodes: int, fill_per_node: int, cycles: int = 3) -> None:
         print(f"    cycle split    {split}")
 
 
+def run_backfill(n_nodes: int, fill_per_node: int, cycles: int = 3) -> None:
+    from scheduler_tpu.harness.backfill_wave import (
+        BACKFILL_CONF, BackfillWaveConfig, seed_wave_cache,
+    )
+    from scheduler_tpu.harness.measure import timed_cycle_phases
+
+    cfg = BackfillWaveConfig(
+        nodes=n_nodes, fill_per_node=fill_per_node,
+        wave_pods=max(16, n_nodes * 10),
+    )
+    conf = parse_scheduler_conf(BACKFILL_CONF)
+    cache = seed_wave_cache(cfg)
+    cache.run()
+    print(f"[backfill] nodes={cfg.nodes} wave={cfg.wave_pods} "
+          f"fill={cfg.fill_per_node}/{cfg.pods_limit} room={cfg.capacity}")
+    for i in range(cycles):
+        binds0 = len(cache.binder.binds)
+        elapsed, ph = timed_cycle_phases(cache, conf, ("backfill",))
+        blk = ph.get("notes", {}).get("backfill") or {}
+        label = "compile" if i == 0 else "steady"
+        print(f"  cycle {i} ({label:7s}): {elapsed * 1000:8.1f}ms  "
+              f"binds+={len(cache.binder.binds) - binds0}")
+        if blk.get("engaged"):
+            split = blk.get("phase", {})
+            print(f"    backfill       flavor={blk['flavor']} "
+                  f"tasks={blk['tasks']} classes={blk['classes']} "
+                  f"segments={blk['segments']} runs={blk['runs']} "
+                  f"binds={blk['device_binds']}+{blk['host_binds']}host "
+                  f"unplaceable={blk['unplaceable']}")
+            print("    sweep split    " + "  ".join(
+                f"{k}={split.get(k, 0.0) * 1000:.1f}ms"
+                for k in ("mask", "solve", "replay")
+            ) + f"  predicate_calls_host={blk['predicate_calls_host']}")
+        elif blk:
+            print(f"    backfill       flavor={blk.get('flavor', '?')} "
+                  f"engaged=False ({blk.get('reason', 'n/a')}) "
+                  f"tasks={blk.get('tasks', '?')} "
+                  f"predicate_calls_host={blk.get('predicate_calls_host', 0)}")
+
+
 if __name__ == "__main__":
     argv = list(sys.argv[1:])
+    if "--backfill" in argv:
+        argv.remove("--backfill")
+        n_nodes = int(argv[0]) if len(argv) > 0 else 64
+        fill = int(argv[1]) if len(argv) > 1 else 14
+        run_backfill(n_nodes, fill)
+        sys.exit(0)
     if "--preempt" in argv:
         argv.remove("--preempt")
         n_nodes = int(argv[0]) if len(argv) > 0 else 64

@@ -324,6 +324,31 @@ def _hlo_victim_pick(mesh) -> str:
     return lowered.compile().as_text()
 
 
+def _hlo_backfill_fill(mesh) -> str:
+    """Lower the backfill engine's water-fill scan
+    (``ops/backfill.py`` ``sharded_backfill_fill``, docs/BACKFILL.md):
+    each shard cumsums its masked node-room block locally, the per-shard
+    totals all-gather ONCE per run step, and the replica-major offset
+    turns local cumsums into the global first-passing-node fill — one
+    all-gather, zero all-reduces, on both mesh shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from scheduler_tpu.ops.backfill import sharded_backfill_fill
+
+    n = mesh.size * 2
+    lowered = jax.jit(
+        lambda rows, room, counts: sharded_backfill_fill(
+            rows, room, counts, mesh=mesh
+        )
+    ).lower(
+        jnp.zeros((8, n), bool),
+        jnp.zeros(n, jnp.int32),
+        jnp.zeros(8, jnp.int32),
+    )
+    return lowered.compile().as_text()
+
+
 def _hlo_selector_mask(mesh) -> str:
     import jax.numpy as jnp
     import numpy as np
@@ -355,6 +380,7 @@ def lowerable_sites(mesh) -> dict:
             "ops/lp_place.py::_lp_iterate_2d": _hlo_lp_iterate,
             "ops/lp_place.py::_lp_iterate_sig_2d": _hlo_lp_iterate_sig,
             "ops/evict.py::_victim_pick_2d": _hlo_victim_pick,
+            "ops/backfill.py::_bf_fill_2d": _hlo_backfill_fill,
             "ops/qfair.py::_qfair_solve_2d": _hlo_qfair_solve,
             "ops/qfair.py::_qfair_stacked_2d": _hlo_qfair_stacked,
         }
@@ -365,6 +391,7 @@ def lowerable_sites(mesh) -> dict:
         "ops/lp_place.py::_lp_iterate_1d": _hlo_lp_iterate,
         "ops/lp_place.py::_lp_iterate_sig_1d": _hlo_lp_iterate_sig,
         "ops/evict.py::_victim_pick_1d": _hlo_victim_pick,
+        "ops/backfill.py::_bf_fill_1d": _hlo_backfill_fill,
         "ops/qfair.py::_qfair_solve_1d": _hlo_qfair_solve,
         "ops/qfair.py::_qfair_stacked_1d": _hlo_qfair_stacked,
     }

@@ -1,0 +1,354 @@
+"""Host-vs-device backfill-engine parity (ops/backfill.py, docs/BACKFILL.md).
+
+The contract: ``SCHEDULER_TPU_BACKFILL=device`` must produce BITWISE-identical
+BestEffort placements, task statuses and per-task ``FitErrors`` strings to
+the host per-task sweep (actions/backfill.py — the kill-switch oracle),
+across {cohort fast-start engaged / scattered signatures} x {1, 2} queues x
+{static-only, dynamic-predicate opt-out, mixed} populations x mesh shapes.
+A mutation-trajectory fuzz leg rides the ``test_fuzz_parity.py`` pattern,
+and the host-oracle regression section pins the cohort fast-start soundness
+the device engine replays: the fallback's complete per-node ``FitErrors``
+record and the ``min(won, bind_fail)`` cache boundary (a node that passed
+predicates but failed the bind must be retried by the next same-signature
+task)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import scheduler_tpu.actions  # noqa: F401  registry side effects
+import scheduler_tpu.plugins  # noqa: F401
+from scheduler_tpu.cache import SchedulerCache
+from scheduler_tpu.conf import parse_scheduler_conf
+from scheduler_tpu.framework import close_session, get_action, open_session
+from tests.fixtures import (
+    build_node,
+    build_pod,
+    build_pod_group,
+    build_queue,
+    make_vocab,
+)
+
+BACKFILL_CONF = """
+actions: "backfill"
+tiers:
+- plugins:
+  - name: predicates
+"""
+
+FLAVORS = ("host", "device")
+
+ZONES = ("za", "zb")
+
+
+def run_cycle(cache, flavor, env=()):
+    """One backfill cycle under a sweep flavor.  Returns the end-of-session
+    task (status, node) pairs and ``FitErrors`` strings — both name-keyed,
+    uids are a process-global counter — plus the binder's binds."""
+    overrides = {"SCHEDULER_TPU_BACKFILL": flavor, **dict(env)}
+    old = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        conf = parse_scheduler_conf(BACKFILL_CONF)
+        ssn = open_session(cache, conf.tiers)
+        get_action("backfill").execute(ssn)
+        statuses = {
+            t.name: (t.status.name, t.node_name)
+            for job in ssn.jobs.values()
+            for t in job.tasks.values()
+        }
+        fes = {
+            t.name: job.nodes_fit_errors[t.uid].error()
+            for job in ssn.jobs.values()
+            for t in job.tasks.values()
+            if t.uid in job.nodes_fit_errors
+        }
+        close_session(ssn)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return statuses, fes, dict(cache.binder.binds)
+
+
+def wave_cluster(seed, n_queues=1, mode="static", shared_sigs=True):
+    """A deterministic pod-count-tight cluster plus a BestEffort wave.
+
+    ``mode`` shapes the predicate population: ``static`` pods carry only
+    signature-static predicates (node selectors), ``dynamic`` pods all opt
+    out via host ports (``static_predicate_sig`` returns None — the device
+    engine must host-sweep them inline), ``mixed`` interleaves the two so
+    device runs break at every opt-out.  ``shared_sigs=False`` scatters
+    selectors across per-node ``host`` labels so the cohort fast-start
+    cache rarely gets a second same-signature task — the off leg of the
+    fast-start matrix."""
+    rng = np.random.default_rng(seed)
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    queues = [f"q{i}" for i in range(n_queues)]
+    for i, q in enumerate(queues):
+        cache.add_queue(build_queue(q, weight=i + 1))
+
+    n_nodes = int(rng.integers(5, 9))
+    pods_limit = int(rng.integers(3, 6))
+    names = []
+    for i in range(n_nodes):
+        name = f"n{i:02d}"
+        names.append(name)
+        cache.add_node(build_node(
+            name, {"cpu": 4000, "memory": 8 * 1024**3},
+            labels={"zone": ZONES[i % len(ZONES)], "host": name},
+            pods=pods_limit,
+        ))
+
+    # Pre-wave occupancy: Running pods eating a random share of each node's
+    # pod slots — the pod-count gate (the only live predicate during
+    # backfill) starts tight and varies per node.
+    cache.add_pod_group(build_pod_group(
+        "occ", queue=queues[0], min_member=1, phase="Running"
+    ))
+    k = 0
+    for name in names:
+        for _ in range(int(rng.integers(0, pods_limit))):
+            cache.add_pod(build_pod(
+                name=f"occ-{k}", req={"cpu": 100, "memory": 64 * 1024**2},
+                groupname="occ", nodename=name, phase="Running",
+            ))
+            k += 1
+
+    # The BestEffort wave, one Inqueue lane per queue.  Sized past the free
+    # slot count often enough that the unplaceable tail (and its
+    # reconstructed FitErrors) is part of every matrix leg.
+    for qi, q in enumerate(queues):
+        lane = f"wave-{q}"
+        cache.add_pod_group(build_pod_group(lane, queue=q, min_member=1))
+        for p in range(int(rng.integers(6, 12))):
+            if shared_sigs:
+                sel = {"zone": ZONES[p % 3 % len(ZONES)]} if p % 3 else None
+            else:
+                sel = {"host": names[int(rng.integers(0, n_nodes))]}
+            pod = build_pod(name=f"{lane}-{p}", groupname=lane, selector=sel)
+            if mode == "dynamic" or (mode == "mixed" and p % 2 == 0):
+                pod.host_ports = [30000 + p]  # scan-dynamic: sig -> None
+            cache.add_pod(pod)
+
+    # Non-BestEffort distractor: a real request keeps it out of backfill's
+    # population entirely (allocate owns it, and allocate is not in the
+    # conf) — both flavors must leave it PENDING and unswept.
+    cache.add_pod_group(build_pod_group("real", queue=queues[0], min_member=1))
+    cache.add_pod(build_pod(
+        name="real-0", req={"cpu": 500, "memory": 128 * 1024**2},
+        groupname="real",
+    ))
+    return cache
+
+
+@pytest.mark.parametrize("seed", [7, 42])
+@pytest.mark.parametrize("n_queues", [1, 2])
+@pytest.mark.parametrize("mode", ["static", "dynamic", "mixed"])
+@pytest.mark.parametrize("shared_sigs", [True, False])
+def test_backfill_parity(seed, n_queues, mode, shared_sigs):
+    results = {}
+    for flavor in FLAVORS:
+        cache = wave_cluster(seed, n_queues, mode, shared_sigs)
+        results[flavor] = run_cycle(cache, flavor)
+    assert results["host"] == results["device"]
+    statuses = results["device"][0]
+    assert statuses["real-0"] == ("PENDING", "")
+
+
+# -- mesh shapes ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize("spec", ["8", "2x4"])
+def test_backfill_parity_on_mesh(spec):
+    """The device flavor under an active 1-D / 2-D mesh (the water-fill
+    per-shard-totals all-gather seam live) must still match the meshless
+    host sweep bitwise."""
+    if len(__import__("jax").devices()) < 8:
+        pytest.skip("needs 8 devices")
+    host = None
+    for flavor, env in (
+        ("host", ()),
+        ("device", (("SCHEDULER_TPU_MESH", spec),)),
+    ):
+        cache = wave_cluster(99, n_queues=2, mode="mixed")
+        out = run_cycle(cache, flavor, env)
+        if host is None:
+            host = out
+        else:
+            assert host == out, f"mesh {spec} diverged"
+
+
+@pytest.mark.slow  # forced-device lowering per shape; the CI mesh job runs
+# this file unfiltered, so both shapes stay gated on every push while tier-1
+# keeps the (fast) full-pipeline mesh parity above.
+@pytest.mark.parametrize("spec", ["8", "2x4"])
+def test_sharded_fill_matches_host_solve(spec, monkeypatch):
+    """``device_fill`` (pad + bucket + the sharded scan) is bitwise the
+    numpy water-fill on both mesh shapes, across ragged run/node shapes,
+    all-False rows and zero rooms."""
+    monkeypatch.setenv("SCHEDULER_TPU_MESH", spec)
+    from scheduler_tpu.ops.backfill import _solve_runs, device_fill
+    from scheduler_tpu.ops.mesh import get_mesh
+
+    if len(__import__("jax").devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = get_mesh()
+    assert mesh is not None
+    rng = np.random.default_rng(0)
+    for r_n, n in ((1, 1), (3, 13), (8, 16), (11, 40)):
+        rows = rng.uniform(size=(r_n, n)) > 0.4
+        rows[0] = False  # an all-False run places nothing
+        room = rng.integers(0, 5, size=n)
+        counts = rng.integers(0, 12, size=r_n)
+        takes_h, placed_h = _solve_runs(rows, room, counts)
+        takes_d, placed_d = device_fill(rows, room, counts, mesh)
+        np.testing.assert_array_equal(takes_d, takes_h)
+        np.testing.assert_array_equal(placed_d, placed_h)
+
+
+# -- the host oracle's cohort fast-start (the soundness the device engine
+# -- replays; ISSUE: previously comment-only) ----------------------------------
+
+
+def _tight_cluster(limits, occupied):
+    """Nodes ``n0..`` with per-node pod limits and pre-occupied slot counts;
+    one same-signature BestEffort lane rides on top."""
+    cache = SchedulerCache(vocab=make_vocab(), async_io=False)
+    cache.run()
+    cache.add_queue(build_queue("default"))
+    cache.add_pod_group(build_pod_group("occ", min_member=1, phase="Running"))
+    k = 0
+    for i, (limit, occ) in enumerate(zip(limits, occupied)):
+        name = f"n{i}"
+        cache.add_node(build_node(
+            name, {"cpu": 4000, "memory": 8 * 1024**3}, pods=limit,
+        ))
+        for _ in range(occ):
+            cache.add_pod(build_pod(
+                name=f"occ-{k}", req={"cpu": 100, "memory": 64 * 1024**2},
+                groupname="occ", nodename=name, phase="Running",
+            ))
+            k += 1
+    cache.add_pod_group(build_pod_group("bf", min_member=1))
+    return cache
+
+
+def _run_with_failing_bind(flavor, fail_node, n_pods=2):
+    """One cycle with ``ssn.allocate`` failing ONCE on ``fail_node`` — the
+    transient-bind-failure scenario the ``min(won, bind_fail)`` cache
+    boundary exists for."""
+    cache = _tight_cluster(limits=(5, 5, 5), occupied=(0, 0, 0))
+    for p in range(n_pods):
+        cache.add_pod(build_pod(name=f"bf-{p}", groupname="bf"))
+    old = os.environ.get("SCHEDULER_TPU_BACKFILL")  # schedlint: ignore[raw-env]
+    os.environ["SCHEDULER_TPU_BACKFILL"] = flavor
+    try:
+        conf = parse_scheduler_conf(BACKFILL_CONF)
+        ssn = open_session(cache, conf.tiers)
+        orig_allocate = ssn.allocate
+        tripped = []
+
+        def allocate(task, node_name):
+            if node_name == fail_node and not tripped:
+                tripped.append(task.name)
+                raise RuntimeError("injected transient bind failure")
+            return orig_allocate(task, node_name)
+
+        ssn.allocate = allocate
+        get_action("backfill").execute(ssn)
+        statuses = {
+            t.name: (t.status.name, t.node_name)
+            for job in ssn.jobs.values()
+            for t in job.tasks.values()
+        }
+        close_session(ssn)
+    finally:
+        if old is None:
+            os.environ.pop("SCHEDULER_TPU_BACKFILL", None)
+        else:
+            os.environ["SCHEDULER_TPU_BACKFILL"] = old
+    return statuses, tripped
+
+
+@pytest.mark.parametrize("flavor", FLAVORS)
+def test_bind_failure_boundary_retries_failed_node(flavor):
+    """bf-0 passes predicates on n0 but the bind fails transiently, so it
+    lands on n1; the fast-start cache must NOT skip n0 for bf-1 (the cached
+    prefix end is ``min(won, bind_fail)`` = the failed index, not the
+    winning one) — bf-1 retries n0 and binds there.  The device engine's
+    resume-after-bind-failure replay reconstructs the same boundary."""
+    statuses, tripped = _run_with_failing_bind(flavor, "n0")
+    assert tripped == ["bf-0"]
+    assert statuses["bf-0"] == ("BINDING", "n1")
+    assert statuses["bf-1"] == ("BINDING", "n0")
+
+
+def test_bind_failure_boundary_parity_is_bitwise():
+    out = {f: _run_with_failing_bind(f, "n0") for f in FLAVORS}
+    assert out["host"] == out["device"]
+
+
+@pytest.mark.parametrize("flavor", FLAVORS)
+def test_fast_start_fallback_records_complete_fit_errors(flavor):
+    """bf-0 skips nothing, fails n0 (full), wins n1 (one slot) — the cache
+    records prefix end 1.  bf-1 fast-starts at n1, finds nothing in the
+    suffix (n1 now full, n2 full), and the TOTAL fallback must re-sweep the
+    skipped prefix into the SAME ``FitErrors`` so the per-node record stays
+    complete: all three nodes, not two."""
+    cache = _tight_cluster(limits=(1, 1, 1), occupied=(1, 0, 1))
+    for p in range(2):
+        cache.add_pod(build_pod(name=f"bf-{p}", groupname="bf"))
+    statuses, fes, _ = run_cycle(cache, flavor)
+    assert statuses["bf-0"] == ("BINDING", "n1")
+    assert statuses["bf-1"][0] == "PENDING"
+    assert "3 node(s) pod number exceeded" in fes["bf-1"]
+
+
+# -- mutation-trajectory fuzz (the test_fuzz_parity.py pattern) ---------------
+
+
+def _mutate(cache, cycle: int) -> None:
+    """Deterministic churn between cycles, keyed on stable task NAMES (uids
+    are a process-global counter and differ per flavor build): evict a
+    rotating slice of the placed population, then add fresh wave pods —
+    selector-rotated and every third one scan-dynamic."""
+    for job in sorted(cache.jobs.values(), key=lambda j: j.name):
+        placed = sorted(
+            (t for t in job.tasks.values()
+             if t.node_name and t.status.name in ("BOUND", "RUNNING")),
+            key=lambda t: t.name,
+        )
+        for i, task in enumerate(placed):
+            if (i + cycle) % 4 == 0:
+                cache.evict(task, "fuzz churn")
+    for p in range(3):
+        sel = {"zone": ZONES[(cycle + p) % len(ZONES)]} if p % 2 else None
+        pod = build_pod(
+            name=f"mut{cycle}-{p}", groupname="wave-q0", selector=sel,
+        )
+        if p % 3 == 0:
+            pod.host_ports = [31000 + cycle * 10 + p]
+        cache.add_pod(pod)
+
+
+@pytest.mark.parametrize("seed", [11, 22])
+def test_mutation_trajectory_parity(seed):
+    """Four backfill cycles over a churning 2-queue cluster: the two
+    flavors must agree on every placement, every status and every FitErrors
+    string at EVERY cycle."""
+    results = {}
+    for flavor in FLAVORS:
+        cache = wave_cluster(seed, n_queues=2, mode="mixed")
+        traj = []
+        for cycle in range(4):
+            traj.append(run_cycle(cache, flavor))
+            _mutate(cache, cycle)
+        results[flavor] = traj
+    assert results["host"] == results["device"]

@@ -508,6 +508,139 @@ def test_preempt_gate_with_no_artifacts_is_silent_pass(tmp_path):
     assert gate_preempt(tmp_path) == 0
 
 
+# -- backfill family (docs/BACKFILL.md): higher-is-better backfill pods/s ----
+
+
+def _bf_artifact(pods_per_s=60_000.0, flavor="device", engaged=2,
+                 nodes=2048, wave=20_000, fill=14, limit=22, ab="default",
+                 **extra) -> dict:
+    if ab == "default":
+        ab = None if flavor == "host" else {
+            "host_binds": 4096, "binds_match": True,
+            "device_pods_per_s": pods_per_s,
+            "host_pods_per_s": pods_per_s / 8.0, "speedup": 8.0,
+            "host_sweep_ops": {"predicate_calls_host": 7_405_568},
+            "host_regime": "steady-tail",
+        }
+    detail = {
+        "family": "backfill", "backfill_flavor": flavor, "seed": 0,
+        "nodes": nodes, "wave_pods": wave, "fill_per_node": fill,
+        "pods_limit": limit, "backfill_pods_per_s": pods_per_s,
+        "engaged_cycles": engaged, "cycles_measured": 3, "binds": 4096,
+        "binds_digest": "d41d8cd9", "converged": True,
+        "sweep_ops": {"predicate_calls_host": 0, "device_classes": 12},
+        "regime": "steady-tail", "decline_reasons": [],
+    }
+    if ab is not None:
+        detail["ab"] = ab
+    detail.update(extra)
+    return {
+        "metric": "backfill_pods_per_s", "value": pods_per_s,
+        "unit": "pods/s", "vs_target": pods_per_s / 10_000.0,
+        "detail": detail,
+    }
+
+
+def test_bf_family_is_recognized_and_segregated(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _artifact(100.0))
+    _write(tmp_path, "BENCH_BF_r01.json", _bf_artifact())
+    assert [p.name for p in find_artifacts(tmp_path, "")] == ["BENCH_r01.json"]
+    assert [p.name for p in find_artifacts(tmp_path, "_BF")] == [
+        "BENCH_BF_r01.json"
+    ]
+
+
+def test_bf_single_wellformed_artifact_passes(tmp_path):
+    from scripts.bench_gate import gate_backfill
+
+    _write(tmp_path, "BENCH_BF_r01.json", _bf_artifact())
+    assert gate_backfill(tmp_path) == 0
+    assert gate_main(["bench_gate", str(tmp_path)]) == 0
+
+
+def test_bf_pods_per_s_regression_beyond_tolerance_fails(tmp_path):
+    from scripts.bench_gate import gate_backfill
+
+    _write(tmp_path, "BENCH_BF_r01.json", _bf_artifact(pods_per_s=60_000.0))
+    _write(tmp_path, "BENCH_BF_r02.json", _bf_artifact(pods_per_s=50_000.0))
+    assert gate_backfill(tmp_path) == 2
+    assert gate_main(["bench_gate", str(tmp_path)]) == 2
+
+
+def test_bf_pods_per_s_within_tolerance_passes(tmp_path):
+    from scripts.bench_gate import gate_backfill
+
+    _write(tmp_path, "BENCH_BF_r01.json", _bf_artifact(pods_per_s=60_000.0))
+    _write(tmp_path, "BENCH_BF_r02.json", _bf_artifact(pods_per_s=55_000.0))
+    assert gate_backfill(tmp_path) == 0
+
+
+def test_bf_improvement_passes(tmp_path):
+    from scripts.bench_gate import gate_backfill
+
+    _write(tmp_path, "BENCH_BF_r01.json", _bf_artifact(pods_per_s=60_000.0))
+    _write(tmp_path, "BENCH_BF_r02.json", _bf_artifact(pods_per_s=90_000.0))
+    assert gate_backfill(tmp_path) == 0
+
+
+def test_bf_rounds_on_different_shapes_are_not_compared(tmp_path):
+    from scripts.bench_gate import gate_backfill
+
+    # Host and device rounds measure different engines; shape changes
+    # reset the baseline too.
+    _write(tmp_path, "BENCH_BF_r01.json",
+           _bf_artifact(pods_per_s=60_000.0, flavor="device"))
+    _write(tmp_path, "BENCH_BF_r02.json",
+           _bf_artifact(pods_per_s=600.0, flavor="host", engaged=0))
+    assert gate_backfill(tmp_path) == 0
+    _write(tmp_path, "BENCH_BF_r03.json",
+           _bf_artifact(pods_per_s=600.0, nodes=4096))
+    assert gate_backfill(tmp_path) == 0
+
+
+def test_bf_artifact_missing_fields_is_malformed(tmp_path):
+    from scripts.bench_gate import gate_backfill
+
+    doc = _bf_artifact()
+    del doc["detail"]["binds_digest"]
+    _write(tmp_path, "BENCH_BF_r01.json", doc)
+    assert gate_backfill(tmp_path) == 1
+    assert gate_main(["bench_gate", str(tmp_path)]) == 1
+
+
+def test_bf_device_claim_without_engagement_is_malformed(tmp_path):
+    from scripts.bench_gate import gate_backfill
+
+    # A host-sweep measurement must not file under the device flavor (the
+    # preempt family's silent-fallback rule).
+    _write(tmp_path, "BENCH_BF_r01.json",
+           _bf_artifact(flavor="device", engaged=0))
+    assert gate_backfill(tmp_path) == 1
+    # The host flavor legitimately records zero engaged cycles.
+    _write(tmp_path, "BENCH_BF_r01.json",
+           _bf_artifact(flavor="host", engaged=0))
+    assert gate_backfill(tmp_path) == 0
+
+
+def test_bf_device_claim_without_bind_parity_ab_is_malformed(tmp_path):
+    from scripts.bench_gate import gate_backfill
+
+    # A device throughput claim needs the in-run host A/B placement-identity
+    # proof, not just a number.
+    _write(tmp_path, "BENCH_BF_r01.json", _bf_artifact(ab=None))
+    assert gate_backfill(tmp_path) == 1
+    doc = _bf_artifact()
+    doc["detail"]["ab"]["binds_match"] = False
+    _write(tmp_path, "BENCH_BF_r01.json", doc)
+    assert gate_backfill(tmp_path) == 1
+
+
+def test_bf_gate_with_no_artifacts_is_silent_pass(tmp_path):
+    from scripts.bench_gate import gate_backfill
+
+    assert gate_backfill(tmp_path) == 0
+
+
 # -- flight-recorder evidence (detail.obs, docs/OBSERVABILITY.md) -------------
 
 def _obs_artifact(value=100_000.0, obs=None):

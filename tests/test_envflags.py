@@ -137,6 +137,20 @@ def test_gc_freeze_gate_wiring(monkeypatch):
     assert Scheduler._gc_freeze_enabled() is True  # malformed -> default
 
 
+def test_backfill_flavor_wiring(monkeypatch):
+    """SCHEDULER_TPU_BACKFILL selects the BestEffort sweep flavor — host
+    per-task oracle vs the batched device class engine (flavor contract:
+    ops/layout.py FLAVORS, docs/BACKFILL.md)."""
+    from scheduler_tpu.ops.backfill import backfill_flavor
+
+    monkeypatch.delenv("SCHEDULER_TPU_BACKFILL", raising=False)
+    assert backfill_flavor() == "host"
+    monkeypatch.setenv("SCHEDULER_TPU_BACKFILL", "device")
+    assert backfill_flavor() == "device"
+    monkeypatch.setenv("SCHEDULER_TPU_BACKFILL", "gpu")
+    assert backfill_flavor() == "host"  # malformed -> warn-once default
+
+
 def test_fused_static_limit_survives_malformed_env(monkeypatch):
     """SCHEDULER_TPU_FUSED_STATIC_LIMIT is the [T, N] static-tensor
     admission budget in bytes; a typo must degrade to the 160 MiB default

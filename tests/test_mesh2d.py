@@ -248,6 +248,9 @@ def test_budget_holds_on_the_2d_mesh_one_merged_all_gather():
         # fully replicated — ZERO collectives, checked below.
         "ops/qfair.py::_qfair_solve_2d",
         "ops/qfair.py::_qfair_stacked_2d",
+        # Backfill water-fill scan (round 19, docs/BACKFILL.md): one
+        # per-shard-totals all-gather per run step, checked below.
+        "ops/backfill.py::_bf_fill_2d",
     }
     counts = count_collectives(sites[site](mesh))
     assert counts == {"all-gather": 1}
@@ -255,7 +258,8 @@ def test_budget_holds_on_the_2d_mesh_one_merged_all_gather():
     for lp_site in ("ops/lp_place.py::_lp_iterate_2d",
                     "ops/lp_place.py::_lp_iterate_sig_2d",
                     "ops/evict.py::_victim_pick_2d",
-                    "ops/sharded.py::_tenant_scan_2d"):
+                    "ops/sharded.py::_tenant_scan_2d",
+                    "ops/backfill.py::_bf_fill_2d"):
         lp_counts = count_collectives(sites[lp_site](mesh))
         assert lp_counts == {"all-gather": 1}
         assert check_counts(

@@ -431,6 +431,48 @@ def test_env_fixtures_cover_the_evict_flavor():
     assert out == []
 
 
+def test_env_fixtures_cover_the_backfill_flavor():
+    """SCHEDULER_TPU_BACKFILL (BestEffort sweep flavor, ops/backfill.py,
+    docs/BACKFILL.md) rides the standard env machinery: a raw os.environ
+    read trips raw-env, an envflags read under ops/ without registration
+    trips env-drift (a resident allocate engine must be pinned to the
+    backfill regime it was diagnosed under), and the real tree's
+    registered shape keeps both passes clean."""
+    out = findings("raw-env", py={
+        "scheduler_tpu/ops/backfill.py": """
+            import os
+            def backfill_flavor():
+                return os.environ.get("SCHEDULER_TPU_BACKFILL", "host")
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_BACKFILL" in out[0].message
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+        "scheduler_tpu/ops/backfill.py": """
+            from scheduler_tpu.utils.envflags import env_str
+            def backfill_flavor():
+                return env_str("SCHEDULER_TPU_BACKFILL", "host",
+                               choices=("host", "device"))
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_BACKFILL" in out[0].message
+    # Registered (the real tree's shape): clean.
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": """
+            _ENV_KEYS = (
+                "SCHEDULER_TPU_BACKFILL",
+            )
+        """,
+        "scheduler_tpu/ops/backfill.py": """
+            from scheduler_tpu.utils.envflags import env_str
+            def backfill_flavor():
+                return env_str("SCHEDULER_TPU_BACKFILL", "host",
+                               choices=("host", "device"))
+        """,
+    })
+    assert out == []
+
+
 def test_env_fixtures_cover_the_sig_compress_flag():
     """SCHEDULER_TPU_SIG_COMPRESS (ops/sig_compress.py, docs/LP_PLACEMENT.md
     "Signature classes") selects [T, N] vs [S, N] static staging — exactly

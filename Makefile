@@ -79,15 +79,20 @@ wheel:
 # engine-flag cache drift, host-sync leaks, donation safety, lock order,
 # doc artifact references, the scratch/stats row-layout registry, the
 # sharding-spec registry, the obs-channel registry, the v4 flavor-contract
-# registry (`flavors` + `jit-static`), and the generic hygiene lint (one
-# CLI; scripts/lint.py remains as a shim).  The compiled-HLO half of the
-# sharding gate (docs/SHARDING.md) AOT-lowers the sharded engine on a
-# simulated 4-device mesh and counts collectives against the declared
-# per-step budget — CPU-only, no hardware needed.
+# registry (`flavors` + `jit-static`), the v5 program-budget dtype
+# contracts (`precision`), and the generic hygiene lint (one CLI;
+# scripts/lint.py remains as a shim).  The compiled-HLO halves AOT-lower
+# the engine on a simulated mesh, CPU-only, no hardware needed: the
+# sharding gate (docs/SHARDING.md) counts collectives against the declared
+# per-step budget, and the program-budget gate (docs/STATIC_ANALYSIS.md
+# "schedlint v5") holds memory_analysis()/cost_analysis() + the dtype
+# story of every PROGRAM_BUDGETS site against its declared ceilings.
 lint:
 	$(PY) scripts/schedlint.py
 	$(PY) scripts/shard_budget.py
 	$(PY) scripts/shard_budget.py --mesh 2x4
+	$(PY) scripts/program_budget.py
+	$(PY) scripts/program_budget.py --mesh 2x4
 
 # Lint gate (reference `make verify`: gofmt/golint/compile slots): byte-compile
 # everything, schedlint + the AST hygiene lint, then the wheel build +

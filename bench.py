@@ -345,6 +345,8 @@ def mq_main(smoke: bool) -> None:
             "probes": probes,
             "backend": _backend(),
             "retrace": _retrace_detail(),
+            "memory": _memory_detail(),
+            "determinism": _determinism_detail(),
         },
     }))
 
@@ -414,6 +416,8 @@ def churn_main(smoke: bool) -> None:
             _os.environ["SCHEDULER_TPU_WATCH_SHARDS"] = prev_shards
     doc["detail"]["backend"] = _backend()
     doc["detail"]["retrace"] = _retrace_detail()
+    doc["detail"]["memory"] = _memory_detail()
+    doc["detail"]["determinism"] = _determinism_detail()
     if not doc["detail"]["cycles_measured"]:
         doc["error"] = (
             "no cycles measured inside the replay window; the artifact "
@@ -458,6 +462,8 @@ def preempt_main(smoke: bool) -> None:
     doc = run_preempt_bench(cfg)
     doc["detail"]["backend"] = _backend()
     doc["detail"]["retrace"] = _retrace_detail()
+    doc["detail"]["memory"] = _memory_detail()
+    doc["detail"]["determinism"] = _determinism_detail()
     if not doc["detail"]["cycles_measured"]:
         doc["error"] = (
             "the scheduler never drained the storm inside the window; the "
@@ -511,6 +517,8 @@ def backfill_main(smoke: bool) -> None:
     doc = run_backfill_bench(cfg)
     doc["detail"]["backend"] = _backend()
     doc["detail"]["retrace"] = _retrace_detail()
+    doc["detail"]["memory"] = _memory_detail()
+    doc["detail"]["determinism"] = _determinism_detail()
     if not doc["detail"]["converged"]:
         doc["error"] = (
             "the scheduler never reached the steady tail regime inside the "
@@ -609,6 +617,8 @@ def tenant_main(smoke: bool) -> None:
     doc = run_tenant_bench(cfg)
     doc["detail"]["backend"] = _backend()
     doc["detail"]["retrace"] = _retrace_detail()
+    doc["detail"]["memory"] = _memory_detail()
+    doc["detail"]["determinism"] = _determinism_detail()
     if not doc["detail"]["stacked_lanes"]:
         doc["error"] = (
             "no cycle stacked any lanes — every tenant dispatched solo, so "
@@ -903,6 +913,8 @@ def main() -> None:
             "probes": probes,
             "backend": _backend(),
             "retrace": _retrace_detail(),
+            "memory": _memory_detail(),
+            "determinism": _determinism_detail(),
         },
     }))
 
@@ -921,6 +933,33 @@ def _retrace_detail() -> dict:
     from scheduler_tpu.utils import retrace
 
     return retrace.summary()
+
+
+def _memory_detail() -> dict:
+    """``detail.memory`` for every artifact family: the active engine's
+    compiled memory/FLOP block (``FusedAllocator.memory_detail`` — AOT
+    ``memory_analysis()``/``cost_analysis()`` of the program that actually
+    ran, at the run's REAL shapes).  The registry-side ceilings at the
+    reference shapes live in ops/layout.py PROGRAM_BUDGETS and are gated
+    by scripts/program_budget.py; this block is the measured runtime twin
+    scripts/bench_gate.py shape-checks and watches for same-shape
+    temp-bytes growth across rounds."""
+    from scheduler_tpu.ops import fused
+
+    detail = fused.last_memory_detail()
+    if detail is None:
+        return {"available": False, "reason": "no device engine dispatched"}
+    return detail
+
+
+def _determinism_detail() -> dict:
+    """``detail.determinism`` for every artifact family: the digest-
+    sentinel verdict (docs/STATIC_ANALYSIS.md "The determinism sentinel").
+    Shape-checked by scripts/bench_gate.py; mismatches > 0 means a dual
+    replay disagreed — the run's numbers cannot be trusted as replayable."""
+    from scheduler_tpu.utils import determinism
+
+    return determinism.summary()
 
 
 if __name__ == "__main__":

@@ -402,6 +402,13 @@ class AllocateAction(Action):
 
         if retrace.enabled():
             phases.note("retrace", retrace.take_cycle())
+        # Determinism-sentinel evidence (utils/determinism.py,
+        # docs/STATIC_ANALYSIS.md "The determinism sentinel"): digests and
+        # dual replays observed at this cycle's readback.
+        from scheduler_tpu.utils import determinism
+
+        if determinism.enabled():
+            phases.note("determinism", determinism.take_cycle())
         with phases.phase("decode"):
             items, node_batches, failures = engine.run_columnar()  # reuses codes
         with phases.phase("apply"):

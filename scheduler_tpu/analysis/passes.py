@@ -8,5 +8,6 @@ from scheduler_tpu.analysis import host_sync  # noqa: F401
 from scheduler_tpu.analysis import hygiene  # noqa: F401
 from scheduler_tpu.analysis import lock_order  # noqa: F401
 from scheduler_tpu.analysis import obs_channels  # noqa: F401
+from scheduler_tpu.analysis import precision  # noqa: F401
 from scheduler_tpu.analysis import row_layout  # noqa: F401
 from scheduler_tpu.analysis import sharding  # noqa: F401

@@ -1,0 +1,400 @@
+"""Pass ``precision``: the program-budget registry's dtype contracts
+(``ops/layout.py`` ``PROGRAM_BUDGETS`` / ``X64_SCOPED_BLOCKS``) verified
+statically over ops/ (schedlint v5; docs/STATIC_ANALYSIS.md).
+
+Every parity oracle in the tree rests on precision invariants — the qfair
+water-fill is bitwise against the host loop ONLY in f64 under a scoped
+``enable_x64`` block, everything else is f32-only, and an unscoped x64
+flip would silently retrace every resident engine into a different
+program.  This pass turns that convention into a gate:
+
+* every ``with enable_x64():`` block under ops/ must sit inside a
+  function DECLARED in ``X64_SCOPED_BLOCKS`` (an undeclared block is an
+  unscoped-leak candidate the registry never admitted);
+* every ``jnp.float64`` (and jnp double/complex128) construct under ops/
+  must be lexically inside a declared scoped function — host-side
+  ``np.float64`` is not a device construct and stays free;
+* ``jax.config.update("jax_enable_x64", …)`` under ops/ is an unscoped
+  leak wherever it appears: it flips the WHOLE process, not a block;
+* registry integrity: every row carries exactly the budget schema, its
+  ``shape`` names a ``PROGRAM_SHAPES`` entry, every ``SHARD_SITES`` key
+  appears in exactly one of ``PROGRAM_BUDGETS`` / ``PROGRAM_COVERED``,
+  every module owning an ``x64-scoped`` row is declared in
+  ``X64_SCOPED_BLOCKS``, and every declared scoped block names a function
+  that exists;
+* the generated budget table in ``PROGRAM_DOC`` matches the registry
+  (rendered between ``layout:PROGRAM_BUDGETS`` markers by the SAME
+  renderer ``scripts/gen_layout_doc.py`` writes with).
+
+The compiled-HLO halves of the contract — no f64 tensor in an f32 site's
+optimized program, no silent demotion of an x64-scoped solve — need a
+lowering and live in ``scripts/program_budget.py``, which re-reads the
+same registry.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from scheduler_tpu.analysis.core import (
+    Finding, PyModule, Repo, dotted, parent_map, register,
+)
+from scheduler_tpu.analysis.row_layout import marker_lines
+
+RULE = "precision"
+LAYOUT_MODULE = "ops/layout.py"
+TABLE_NAME = "PROGRAM_BUDGETS"
+TABLE_NS = "PROGRAM_BUDGETS"
+ROW_KEYS = {
+    "shape", "gate", "dtype", "arg_bytes", "out_bytes", "temp_bytes",
+    "flops",
+}
+GATES = {"cpu", "accel"}
+DTYPES = {"f32", "x64-scoped"}
+# jnp attributes that build 64-bit device values.
+_WIDE_ATTRS = {"float64", "complex128", "int64", "uint64"}
+
+
+class ProgramRegistry:
+    """The program-budget literals AS DATA (all four tables), or the
+    reason they could not be parsed."""
+
+    def __init__(self) -> None:
+        self.budgets: Dict[str, dict] = {}
+        self.shapes: Dict[str, str] = {}
+        self.covered: Dict[str, str] = {}
+        self.x64_blocks: List[Tuple[str, str]] = []
+        self.doc_path: Optional[str] = None
+        self.errors: List[str] = []
+
+
+def _assign_value(tree: ast.AST, name: str) -> Optional[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    return node.value
+    return None
+
+
+def _const_dict(node: ast.AST) -> Optional[Dict[str, object]]:
+    """A dict literal with constant string keys and constant scalar
+    values (str/int/None) — the registry-row production."""
+    if not isinstance(node, ast.Dict):
+        return None
+    out: Dict[str, object] = {}
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            return None
+        if not (isinstance(v, ast.Constant)
+                and (v.value is None or isinstance(v.value, (str, int)))):
+            return None
+        out[k.value] = v.value
+    return out
+
+
+def parse_program_registry(source: str) -> ProgramRegistry:
+    """All four program-budget literals from layout.py source; parse
+    failures land in ``errors`` (the gate reports them instead of
+    guessing)."""
+    reg = ProgramRegistry()
+    tree = ast.parse(source)
+
+    budgets = _assign_value(tree, TABLE_NAME)
+    if not isinstance(budgets, ast.Dict):
+        reg.errors.append(f"{TABLE_NAME} is not a literal dict")
+    else:
+        for k, v in zip(budgets.keys, budgets.values):
+            key = k.value if (
+                isinstance(k, ast.Constant) and isinstance(k.value, str)
+            ) else None
+            row = _const_dict(v)
+            if key is None or row is None:
+                reg.errors.append(
+                    f"{TABLE_NAME} row is not fully literal "
+                    f"(constant string keys, constant scalar values)"
+                )
+                continue
+            reg.budgets[key] = row
+
+    for name, sink in (("PROGRAM_SHAPES", reg.shapes),
+                       ("PROGRAM_COVERED", reg.covered)):
+        node = _assign_value(tree, name)
+        if not isinstance(node, ast.Dict):
+            reg.errors.append(f"{name} is not a literal dict")
+            continue
+        for k, v in zip(node.keys, node.values):
+            if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                sink[k.value] = v.value
+            else:
+                reg.errors.append(f"{name} entry is not string-literal")
+
+    blocks = _assign_value(tree, "X64_SCOPED_BLOCKS")
+    if not isinstance(blocks, (ast.Tuple, ast.List)):
+        reg.errors.append("X64_SCOPED_BLOCKS is not a literal tuple")
+    else:
+        for elt in blocks.elts:
+            if (isinstance(elt, ast.Tuple) and len(elt.elts) == 2
+                    and all(isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                            for e in elt.elts)):
+                reg.x64_blocks.append(
+                    (elt.elts[0].value, elt.elts[1].value)  # type: ignore
+                )
+            else:
+                reg.errors.append(
+                    "X64_SCOPED_BLOCKS entry is not a (module, function) "
+                    "string pair"
+                )
+
+    doc = _assign_value(tree, "PROGRAM_DOC")
+    if isinstance(doc, ast.Constant) and isinstance(doc.value, str):
+        reg.doc_path = doc.value
+    return reg
+
+
+def _shard_site_keys(tree: ast.AST) -> Set[str]:
+    node = _assign_value(tree, "SHARD_SITES")
+    out: Set[str] = set()
+    if isinstance(node, ast.Dict):
+        for k in node.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out.add(k.value)
+    return out
+
+
+def render_program_table(reg: ProgramRegistry) -> List[str]:
+    """The doc table (PROGRAM_DOC) — ONE renderer shared with
+    scripts/gen_layout_doc.py so doc and gate can never disagree."""
+    out = [
+        "| site | shape | gate | dtype | arg bytes | out bytes "
+        "| temp bytes | flops |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for site in sorted(reg.budgets):
+        row = reg.budgets[site]
+
+        def num(key: str) -> str:
+            v = row.get(key)
+            return f"{v:,}" if isinstance(v, int) else "?"
+
+        out.append(
+            "| `{}` | {} | {} | `{}` | {} | {} | {} | {} |".format(
+                site, row.get("shape", "?"), row.get("gate", "?"),
+                row.get("dtype", "?"), num("arg_bytes"), num("out_bytes"),
+                num("temp_bytes"), num("flops"),
+            )
+        )
+    return out
+
+
+def _scoped_functions(mod_path: str,
+                      blocks: List[Tuple[str, str]]) -> Set[str]:
+    return {fn for mod, fn in blocks
+            if mod_path == mod or mod_path.endswith("/" + mod)}
+
+
+def _enclosing_function(node: ast.AST,
+                        parents: Dict[ast.AST, ast.AST]) -> Optional[str]:
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur.name
+        cur = parents.get(cur)
+    return None
+
+
+def _is_enable_x64_with(node: ast.With) -> bool:
+    for item in node.items:
+        d = dotted(item.context_expr)
+        if d is None and isinstance(item.context_expr, ast.Call):
+            d = dotted(item.context_expr.func)
+        if d and d.rsplit(".", 1)[-1] == "enable_x64":
+            return True
+    return False
+
+
+def _walk_ops_module(mod: PyModule, scoped: Set[str]) -> List[Finding]:
+    out: List[Finding] = []
+    parents = parent_map(mod.tree)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.With) and _is_enable_x64_with(node):
+            fn = _enclosing_function(node, parents)
+            if fn not in scoped:
+                out.append(Finding(
+                    RULE, mod.path, node.lineno,
+                    f"enable_x64 block in "
+                    f"{fn or '<module scope>'} is not declared in "
+                    f"ops/layout.py X64_SCOPED_BLOCKS — undeclared scoped-"
+                    "x64 region (docs/STATIC_ANALYSIS.md 'schedlint v5')",
+                ))
+        elif isinstance(node, ast.Attribute) and node.attr in _WIDE_ATTRS:
+            d = dotted(node)
+            if d is None or not d.startswith("jnp."):
+                continue  # np.float64 et al: host-side, not a device dtype
+            fn = _enclosing_function(node, parents)
+            if fn not in scoped:
+                out.append(Finding(
+                    RULE, mod.path, node.lineno,
+                    f"{d} outside a declared scoped-x64 block "
+                    f"(ops/layout.py X64_SCOPED_BLOCKS): 64-bit device "
+                    "constructs are contract-bound to declared blocks",
+                ))
+        elif isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is None or d.rsplit(".", 2)[-2:] != ["config", "update"]:
+                continue
+            if (node.args and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == "jax_enable_x64"):
+                out.append(Finding(
+                    RULE, mod.path, node.lineno,
+                    "jax.config.update('jax_enable_x64', …) flips x64 for "
+                    "the WHOLE process — use the scoped enable_x64 context "
+                    "in a declared X64_SCOPED_BLOCKS function instead",
+                ))
+    return out
+
+
+def _function_names(mod: PyModule) -> Set[str]:
+    return {n.name for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+@register(RULE)
+def precision(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    layout = repo.module(LAYOUT_MODULE)
+    ops_mods = [m for m in repo.modules
+                if ("/ops/" in m.path or m.path.startswith("ops/"))
+                and not m.path.startswith("tests/")
+                and "/tests/" not in m.path]
+
+    if layout is None:
+        # The registry is out of the analyzed subset (a --changed run that
+        # touched neither layout nor ops): nothing to hold ops/ against.
+        return out
+
+    reg = parse_program_registry(layout.text)
+    for err in reg.errors:
+        out.append(Finding(
+            RULE, layout.path, 1,
+            f"program-budget registry must stay literal data: {err}",
+        ))
+    if reg.errors:
+        return out
+
+    # -- registry integrity ---------------------------------------------------
+    x64_modules: Set[str] = set()
+    for site, row in sorted(reg.budgets.items()):
+        if set(row) != ROW_KEYS:
+            out.append(Finding(
+                RULE, layout.path, 1,
+                f"site '{site}': budget row keys {sorted(row)} != "
+                f"{sorted(ROW_KEYS)}",
+            ))
+            continue
+        if row["shape"] not in reg.shapes:
+            out.append(Finding(
+                RULE, layout.path, 1,
+                f"site '{site}': shape {row['shape']!r} is not a "
+                "PROGRAM_SHAPES entry — budgets are meaningless without a "
+                "named reference shape",
+            ))
+        if row["gate"] not in GATES:
+            out.append(Finding(
+                RULE, layout.path, 1,
+                f"site '{site}': gate {row['gate']!r} not in "
+                f"{sorted(GATES)}",
+            ))
+        if row["dtype"] not in DTYPES:
+            out.append(Finding(
+                RULE, layout.path, 1,
+                f"site '{site}': dtype {row['dtype']!r} not in "
+                f"{sorted(DTYPES)}",
+            ))
+        elif row["dtype"] == "x64-scoped":
+            x64_modules.add(site.split("::", 1)[0])
+        for key in ("arg_bytes", "out_bytes", "temp_bytes", "flops"):
+            if not (isinstance(row[key], int) and row[key] > 0):
+                out.append(Finding(
+                    RULE, layout.path, 1,
+                    f"site '{site}': {key} must be a positive int ceiling",
+                ))
+
+    shard_sites = _shard_site_keys(layout.tree)
+    for site in sorted(shard_sites):
+        in_b, in_c = site in reg.budgets, site in reg.covered
+        if in_b and in_c:
+            out.append(Finding(
+                RULE, layout.path, 1,
+                f"shard site '{site}' is both budgeted and "
+                "PROGRAM_COVERED — pick one accounting",
+            ))
+        elif not in_b and not in_c:
+            out.append(Finding(
+                RULE, layout.path, 1,
+                f"shard site '{site}' has neither a PROGRAM_BUDGETS row "
+                "nor a PROGRAM_COVERED deferral — unbudgeted device "
+                "program",
+            ))
+    for site, covered_by in sorted(reg.covered.items()):
+        if covered_by not in reg.budgets:
+            out.append(Finding(
+                RULE, layout.path, 1,
+                f"PROGRAM_COVERED['{site}'] -> {covered_by!r} has no "
+                "PROGRAM_BUDGETS row",
+            ))
+
+    declared_modules = {mod for mod, _fn in reg.x64_blocks}
+    for mod_path in sorted(x64_modules - declared_modules):
+        out.append(Finding(
+            RULE, layout.path, 1,
+            f"module '{mod_path}' owns an x64-scoped budget row but "
+            "declares no X64_SCOPED_BLOCKS entry — the scoped block that "
+            "stages the solve must be named",
+        ))
+
+    # -- ops/ dtype-contract walk --------------------------------------------
+    for mod in ops_mods:
+        scoped = _scoped_functions(mod.path, reg.x64_blocks)
+        out.extend(_walk_ops_module(mod, scoped))
+
+    # Declared scoped blocks must exist (typo detector), when the module is
+    # in the analyzed subset.
+    for mod_path, fn in reg.x64_blocks:
+        mod = repo.module(mod_path)
+        if mod is not None and fn not in _function_names(mod):
+            out.append(Finding(
+                RULE, layout.path, 1,
+                f"X64_SCOPED_BLOCKS declares {mod_path}::{fn} but no such "
+                "function exists",
+            ))
+
+    # -- generated doc table drift -------------------------------------------
+    if reg.doc_path:
+        doc = next((d for d in repo.docs if d.path == reg.doc_path), None)
+        if doc is not None:
+            table = render_program_table(reg)
+            begin, end = marker_lines(TABLE_NS)
+            lines = doc.text.splitlines()
+            try:
+                b = lines.index(begin)
+                e = lines.index(end, b)
+            except ValueError:
+                out.append(Finding(
+                    RULE, doc.path, 1,
+                    f"missing generated program-budget table for "
+                    f"{TABLE_NS} (run scripts/gen_layout_doc.py)",
+                ))
+            else:
+                got = [ln.strip() for ln in lines[b + 1: e] if ln.strip()]
+                if got != table:
+                    out.append(Finding(
+                        RULE, doc.path, b + 1,
+                        f"{TABLE_NS} budget table is stale (run "
+                        "scripts/gen_layout_doc.py)",
+                    ))
+    return out

@@ -172,6 +172,13 @@ _ENV_KEYS = (
     # flip mid-process: a guard-mode cycle should always start from a build
     # whose hit path was watched from the first dispatch.
     "SCHEDULER_TPU_RETRACE",
+    # Determinism sentinel (utils/determinism.py, docs/STATIC_ANALYSIS.md
+    # "The determinism sentinel").  Same standing as RETRACE above: digest/
+    # dual mode never changes a traced program — it hashes readbacks and
+    # replays the resident executable — but a dual-mode cycle must start
+    # from a build whose readbacks were digested from the first dispatch,
+    # so a resident engine never straddles the diagnostics-regime flip.
+    "SCHEDULER_TPU_DETERMINISM",
 )
 
 _scope_counter = itertools.count(1)

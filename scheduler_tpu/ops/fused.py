@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -1029,6 +1030,21 @@ def fused_allocate(
     return final[7][:t_cap]
 
 
+# The engine behind the most recent dispatch in this process (weakref — the
+# accessor must never extend an engine's lifetime past its session).
+# bench.py reads it through last_memory_detail() to stamp detail.memory on
+# the artifact without threading the engine handle through every family.
+_LAST_ENGINE = None
+
+
+def last_memory_detail() -> "dict | None":
+    """The compiled memory/FLOP block of the most recently dispatched
+    engine (``FusedAllocator.memory_detail``), or None when no device
+    engine has dispatched in this process (host-only paths)."""
+    eng = _LAST_ENGINE() if _LAST_ENGINE is not None else None
+    return eng.memory_detail() if eng is not None else None
+
+
 class FusedAllocator:
     """Host shim: session -> tensors -> one fused_allocate call -> decoded rows.
 
@@ -1049,6 +1065,7 @@ class FusedAllocator:
         self._dev_stats = None    # in-flight cohort/step evidence (mega only)
         self._stats_raw = None    # collected evidence of the last readback
         self._encoded = None      # decoded int32 codes of the last readback
+        self._memory_detail = None  # cached memory_detail() block (per build)
         self._layout_token = None  # ops/engine_cache.py layout fingerprint
         # Engine-cache outcome of the cycle serving this engine (engine_cache
         # stamps "hit"/"rebuild"/"miss"): the retrace sentinel
@@ -2164,6 +2181,7 @@ class FusedAllocator:
             self._stats_raw = None
             self._lp_dev = None
             self._lp_stats_host = None
+            self._memory_detail = None  # shapes may change under a delta hit
             self.lp_phase = {}
             if eager_dispatch:
                 self.dispatch()
@@ -2845,6 +2863,8 @@ class FusedAllocator:
         bookkeeping) before paying the blocking collect."""
         if self._dev is not None:
             return
+        global _LAST_ENGINE
+        _LAST_ENGINE = weakref.ref(self)
         from scheduler_tpu.utils import retrace, sanitize, shardcheck
 
         if self.use_lp:
@@ -2884,25 +2904,47 @@ class FusedAllocator:
         # engine stages via transfer_cache.to_device / device_put), so an
         # implicit host->device upload here is a staging bug, not traffic.
         with sanitize.guard(), retrace.watch(self._cache_status == "hit"):
-            self._dev = fused_allocate(
-                *self.args,
-                comparators=self.comparators,
-                queue_comparators=self.queue_comparators,
-                overused_gate=self.overused_gate,
-                use_static=self.use_static,
-                n_queues=len(self.queue_uids),
-                weights=self.weights,
-                enforce_pod_count=self.enforce_pod_count,
-                window=self._window_size(),
-                batch_runs=self.batch_runs,
-                sorted_jobs=True,
-                has_releasing=self.has_releasing,
-                step_kernel=self.step_kernel,
-                queue_delta=self.queue_delta,
-                sig_compress=self.sig_compress and self.use_static,
-                qfair_ladder=self.qfair_ladder,
-                mesh=self._mesh,
-            )
+            self._dev = fused_allocate(*self.args, **self._allocate_kw())
+
+    def _allocate_kw(self) -> dict:
+        """The XLA while-loop program's static parameters — the SINGLE
+        source both ``dispatch()`` and ``memory_detail()`` call/lower with,
+        so the recorded compiled-memory block can never describe a
+        different program than the one that launched."""
+        return dict(
+            comparators=self.comparators,
+            queue_comparators=self.queue_comparators,
+            overused_gate=self.overused_gate,
+            use_static=self.use_static,
+            n_queues=len(self.queue_uids),
+            weights=self.weights,
+            enforce_pod_count=self.enforce_pod_count,
+            window=self._window_size(),
+            batch_runs=self.batch_runs,
+            sorted_jobs=True,
+            has_releasing=self.has_releasing,
+            step_kernel=self.step_kernel,
+            queue_delta=self.queue_delta,
+            sig_compress=self.sig_compress and self.use_static,
+            qfair_ladder=self.qfair_ladder,
+            mesh=self._mesh,
+        )
+
+    def _lp_kw(self) -> dict:
+        """The LP relaxation's static parameters — shared by
+        ``_dispatch_lp()`` and ``memory_detail()`` (same contract as
+        ``_allocate_kw``)."""
+        from scheduler_tpu.ops import lp_place
+
+        return dict(
+            iters=lp_place.lp_iters(),
+            tau=lp_place.lp_tau(),
+            tol=lp_place.lp_tol(),
+            weights=self.weights,
+            enforce_pod_count=self.enforce_pod_count,
+            use_static=self.use_static,
+            mesh=self._lp_mesh,
+        )
 
     def _dispatch_lp(self) -> None:
         """Launch the LP flavor's device chain WITHOUT blocking: the
@@ -2922,15 +2964,7 @@ class FusedAllocator:
         self._dev_stats = None
         args = self.args
         shardcheck.check_dispatch(self._mesh, args)
-        lp_kw = dict(
-            iters=lp_place.lp_iters(),
-            tau=lp_place.lp_tau(),
-            tol=lp_place.lp_tol(),
-            weights=self.weights,
-            enforce_pod_count=self.enforce_pod_count,
-            use_static=self.use_static,
-            mesh=self._lp_mesh,
-        )
+        lp_kw = self._lp_kw()
         with sanitize.guard(), retrace.watch(self._cache_status == "hit"):
             if self.sig_compress and self._lp_sig_host is not None:
                 # Signature-compressed relaxation (docs/LP_PLACEMENT.md
@@ -3055,6 +3089,8 @@ class FusedAllocator:
         solo ``dispatch()`` (the lane slice is still an async device value —
         no host sync happens here).  ``lp_dev`` is the lane's (pref, lp_raw)
         evidence pair for LP flavors."""
+        global _LAST_ENGINE
+        _LAST_ENGINE = weakref.ref(self)
         self._dev_stats = None
         self._dev = dev
         if lp_dev is not None:
@@ -3143,7 +3179,127 @@ class FusedAllocator:
             self.use_mega = False
             return self.readback()
         self._encoded = encoded
+        self._determinism_check(encoded)
         return encoded
+
+    def _determinism_check(self, encoded) -> None:
+        """``SCHEDULER_TPU_DETERMINISM`` hook (utils/determinism.py), run
+        once per readback AFTER the cycle's collected state is final.
+        ``digest``: sha256 the readback buffers (codes + stats + LP
+        evidence).  ``dual``: re-dispatch the SAME resident executable on
+        the SAME staged operands — fused_allocate arguments are never
+        donated, so the staged tuple is intact — and compare digests; a
+        mismatch raises DeterminismError (sanitize.is_violation recognizes
+        it, so fallback seams re-raise).  The replay collects into locals
+        only: the cycle's ``_encoded``/``_stats_raw``/``_lp_stats_host``
+        are never touched."""
+        from scheduler_tpu.utils import determinism
+
+        if not determinism.enabled():
+            return
+        lp = self._lp_stats_host if self.use_lp else None
+        first = determinism.digest_arrays(
+            encoded, self._stats_raw, *(lp if lp is not None else ())
+        )
+        second = None
+        if determinism.dual():
+            # readback() popped the in-flight slots, so this launches the
+            # resident executable again on the unchanged staged arguments.
+            self.dispatch()
+            dev2, self._dev = self._dev, None
+            stats2, self._dev_stats = self._dev_stats, None
+            lp2 = None
+            if self.use_lp and self._lp_dev is not None:
+                pref2, raw2 = self._lp_dev
+                self._lp_dev = None
+                lp2 = (
+                    jax.device_get(pref2).astype(np.int32),
+                    jax.device_get(raw2),
+                )
+            enc2 = self._readback(dev2)
+            stats2 = jax.device_get(stats2) if stats2 is not None else None
+            second = determinism.digest_arrays(
+                enc2, stats2, *(lp2 if lp2 is not None else ())
+            )
+        determinism.observe(first, second)
+
+    def memory_detail(self) -> dict:
+        """The active device program's compiled memory/FLOP block — bench
+        ``detail.memory`` (scripts/bench_gate.py validates the shape; the
+        registry-side ceilings live in ops/layout.py PROGRAM_BUDGETS and
+        are enforced by scripts/program_budget.py at reference shapes).
+        AOT-lowers the PRIMARY program of this engine's flavor from the
+        REAL staged device arguments via the same ``_allocate_kw`` /
+        ``_lp_kw`` statics ``dispatch()`` uses, compiles, and reports
+        ``memory_analysis()``/``cost_analysis()``.  Lazy and cached per
+        build (AOT compile is not free); called OUTSIDE the retrace
+        brackets — the AOT compile is deliberate, not a steady-state
+        retrace.  The mega flavor reports unavailable: the pallas
+        whole-loop kernel exposes no XLA memory analysis (its VMEM story
+        is the accel-gated PROGRAM_BUDGETS row)."""
+        if self._memory_detail is not None:
+            return self._memory_detail
+        engine = (
+            "lp" if self.use_lp
+            else "mega" if self.use_mega
+            else ("step_kernel" if self.step_kernel else "xla")
+        )
+        if self.use_mega:
+            self._memory_detail = {
+                "engine": engine,
+                "available": False,
+                "reason": "pallas mega kernel exposes no XLA memory_analysis",
+            }
+            return self._memory_detail
+        try:
+            if self.use_lp:
+                from scheduler_tpu.ops import lp_place
+
+                args = self.args
+                kw = self._lp_kw()
+                if self.sig_compress and self._lp_sig_host is not None:
+                    init_c, req_c, count_c = self._lp_class_dev()
+                    lowered = lp_place.lp_relax.lower(
+                        args[0], args[3], args[2], args[4], args[5],
+                        args[9], args[10], args[6], init_c, req_c, count_c,
+                        **kw,
+                    )
+                else:
+                    lowered = lp_place.lp_relax.lower(
+                        args[0], args[3], args[2], args[4], args[5],
+                        args[9], args[10], args[6], args[7], args[8],
+                        **kw,
+                    )
+                program = "lp_relax"
+            else:
+                lowered = fused_allocate.lower(
+                    *self.args, **self._allocate_kw()
+                )
+                program = "fused_allocate"
+            compiled = lowered.compile()
+            mem = compiled.memory_analysis()
+            detail = {
+                "engine": engine,
+                "available": True,
+                "program": program,
+                "argument_bytes": int(mem.argument_size_in_bytes),
+                "output_bytes": int(mem.output_size_in_bytes),
+                "temp_bytes": int(mem.temp_size_in_bytes),
+                "generated_code_bytes": int(mem.generated_code_size_in_bytes),
+            }
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            flops = ca.get("flops") if isinstance(ca, dict) else None
+            detail["flops"] = int(flops) if flops is not None else None
+        except Exception as err:  # pragma: no cover - backend-specific
+            detail = {
+                "engine": engine,
+                "available": False,
+                "reason": f"{type(err).__name__}: {err}",
+            }
+        self._memory_detail = detail
+        return detail
 
     def run_stats(self) -> dict:
         """Cohort/step evidence of the last executed device program — the

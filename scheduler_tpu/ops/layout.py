@@ -699,6 +699,223 @@ SHARD_DOC_ROWS = {
 }
 
 
+# -- program-budget registry (schedlint v5; docs/STATIC_ANALYSIS.md) ----------
+#
+# The layout idiom one level DOWN: where SHARD_SITES pins the specs and
+# COLLECTIVE_BUDGET pins the compiled collective pattern, PROGRAM_BUDGETS
+# pins the compiled RESOURCE pattern — per dispatch/shard site, at the
+# named reference shape, ceilings for the AOT-compiled program's
+#
+# * ``arg_bytes`` / ``out_bytes`` / ``temp_bytes`` — the three
+#   ``compiled.memory_analysis()`` footprint axes (temp is the working
+#   set: a silent [T, N] materialization where [S, N] class rows should
+#   flow, or a GSPMD-inferred full-replica buffer, lands here first);
+# * ``flops`` — the ``cost_analysis`` FLOP bound (loop bodies appear once
+#   in the compiled module, so the bound is per step);
+# * ``dtype`` — the site's dtype contract: ``"f32"`` (the compiled HLO may
+#   hold NO f64 tensor — an unexpected convert is an unscoped x64 leak or
+#   a python-float promotion) or ``"x64-scoped"`` (the program MUST be
+#   f64 — the qfair water-fill's bitwise host parity dies silently if it
+#   is ever demoted);
+# * ``shape`` — the PROGRAM_SHAPES key naming the reference shape the
+#   ceilings hold at (budgets are meaningless without one);
+# * ``gate`` — ``"cpu"``: lowered and checked by
+#   ``scripts/program_budget.py`` in CI on the simulated mesh;
+#   ``"accel"``: TPU-only program (the pallas mega kernel), checked when a
+#   hardware round runs the script on a real chip.
+#
+# Ceilings sit at ~2-3x the measured value (``program_budget.py
+# --measure`` prints calibration rows): slack enough to survive an XLA
+# upgrade's fusion drift, tight enough that one extra row-by-node
+# temporary at the reference shape (4x+) cannot hide.  The generated table
+# renders between ``layout:PROGRAM_BUDGETS`` markers in PROGRAM_DOC
+# (scripts/gen_layout_doc.py; drift-checked by the ``precision`` pass).
+
+PROGRAM_DOC = "docs/STATIC_ANALYSIS.md"
+
+PROGRAM_SHAPES = {
+    "mesh-small": "shard_budget's reference problem (N=8 nodes x T=4 "
+                  "tasks x R=3, K=4 tenant lanes) on the 8-device "
+                  "simulated mesh — per-shard bytes, so both mesh shapes "
+                  "share one ceiling",
+    "solo-small": "the same N=8 x T=4 x R=3 problem staged mesh-free "
+                  "through the solo engine entry points (J=2 jobs, Q=1 "
+                  "queue, window=4)",
+    "qfair-small": "the queue-fair water-fill at Q=3 queues x R=4 "
+                   "resources (K=4 stacked fleets), f64 operands under "
+                   "scoped x64",
+    "pick-small": "the eviction/backfill reductions at N=16 positions "
+                  "(2 per simulated device) / 8 backfill run rows",
+    "mega-flagship": "the replicated whole-loop mega kernel at flagship "
+                     "staging; ceilings are the VMEM envelope a hardware "
+                     "round calibrates (ROADMAP 'TPU-round debts')",
+}
+
+PROGRAM_BUDGETS = {
+    # Sharded placement scan twins: the while-body's per-shard working set.
+    "ops/sharded.py::_place_scan_1d": {
+        "shape": "mesh-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 1024, "out_bytes": 512, "temp_bytes": 4096,
+        "flops": 1000,
+    },
+    "ops/sharded.py::_place_scan_2d": {
+        "shape": "mesh-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 1024, "out_bytes": 512, "temp_bytes": 4096,
+        "flops": 1000,
+    },
+    "ops/sharded.py::_selector_mask_1d": {
+        "shape": "mesh-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 512, "out_bytes": 512, "temp_bytes": 512,
+        "flops": 500,
+    },
+    "ops/sharded.py::_selector_mask_2d": {
+        "shape": "mesh-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 512, "out_bytes": 512, "temp_bytes": 512,
+        "flops": 500,
+    },
+    # Tenant K-lane scan twins: K=4 lanes widen the payload ~4x over
+    # _place_scan — the ceilings pin that batching never goes superlinear.
+    "ops/sharded.py::_tenant_scan_1d": {
+        "shape": "mesh-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 2048, "out_bytes": 1024, "temp_bytes": 8192,
+        "flops": 4000,
+    },
+    "ops/sharded.py::_tenant_scan_2d": {
+        "shape": "mesh-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 2048, "out_bytes": 1024, "temp_bytes": 8192,
+        "flops": 4000,
+    },
+    # LP iteration twins: the fixed-point body over the per-shard node
+    # block.  The signature-compressed twin adds only the [S] multiplicity
+    # vector — compression must never GROW the working set.
+    "ops/lp_place.py::_lp_iterate_1d": {
+        "shape": "mesh-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 1024, "out_bytes": 512, "temp_bytes": 4096,
+        "flops": 2000,
+    },
+    "ops/lp_place.py::_lp_iterate_2d": {
+        "shape": "mesh-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 1024, "out_bytes": 512, "temp_bytes": 4096,
+        "flops": 2000,
+    },
+    "ops/lp_place.py::_lp_iterate_sig_1d": {
+        "shape": "mesh-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 1024, "out_bytes": 512, "temp_bytes": 4096,
+        "flops": 2000,
+    },
+    "ops/lp_place.py::_lp_iterate_sig_2d": {
+        "shape": "mesh-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 1024, "out_bytes": 512, "temp_bytes": 4096,
+        "flops": 2000,
+    },
+    # Eviction winner-tuple pick + backfill water-fill twins: tiny
+    # reductions — the ceilings pin them tiny.
+    "ops/evict.py::_victim_pick_1d": {
+        "shape": "pick-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 512, "out_bytes": 512, "temp_bytes": 1024,
+        "flops": 500,
+    },
+    "ops/evict.py::_victim_pick_2d": {
+        "shape": "pick-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 512, "out_bytes": 512, "temp_bytes": 1024,
+        "flops": 500,
+    },
+    "ops/backfill.py::_bf_fill_1d": {
+        "shape": "pick-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 512, "out_bytes": 512, "temp_bytes": 2048,
+        "flops": 500,
+    },
+    "ops/backfill.py::_bf_fill_2d": {
+        "shape": "pick-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 512, "out_bytes": 512, "temp_bytes": 2048,
+        "flops": 500,
+    },
+    # Queue-fair solve twins + solo entries: the ONLY x64-scoped programs
+    # in the tree — f64 is the contract, not a leak (the water-fill is
+    # bitwise-pinned against the host loop in f64).
+    "ops/qfair.py::_qfair_solve_1d": {
+        "shape": "qfair-small", "gate": "cpu", "dtype": "x64-scoped",
+        "arg_bytes": 1024, "out_bytes": 512, "temp_bytes": 4096,
+        "flops": 1000,
+    },
+    "ops/qfair.py::_qfair_solve_2d": {
+        "shape": "qfair-small", "gate": "cpu", "dtype": "x64-scoped",
+        "arg_bytes": 1024, "out_bytes": 512, "temp_bytes": 4096,
+        "flops": 1000,
+    },
+    "ops/qfair.py::_qfair_stacked_1d": {
+        "shape": "qfair-small", "gate": "cpu", "dtype": "x64-scoped",
+        "arg_bytes": 2048, "out_bytes": 1024, "temp_bytes": 8192,
+        "flops": 1000,
+    },
+    "ops/qfair.py::_qfair_stacked_2d": {
+        "shape": "qfair-small", "gate": "cpu", "dtype": "x64-scoped",
+        "arg_bytes": 2048, "out_bytes": 1024, "temp_bytes": 8192,
+        "flops": 1000,
+    },
+    "ops/qfair.py::qfair_solve": {
+        "shape": "qfair-small", "gate": "cpu", "dtype": "x64-scoped",
+        "arg_bytes": 1024, "out_bytes": 512, "temp_bytes": 4096,
+        "flops": 1000,
+    },
+    "ops/qfair.py::qfair_solve_stacked": {
+        "shape": "qfair-small", "gate": "cpu", "dtype": "x64-scoped",
+        "arg_bytes": 2048, "out_bytes": 1024, "temp_bytes": 8192,
+        "flops": 1000,
+    },
+    # Solo engine entry points (mesh=None).  The LP rows reuse the shard
+    # twins' operands minus the shard_map wrapper, so a solo-vs-twin gap
+    # is pure sharding overhead.  Eviction/backfill have no mesh-free
+    # device program (their host flavors are numpy) — their device entry
+    # points ARE the _victim_pick_* / _bf_fill_* rows above.
+    "ops/fused.py::fused_allocate": {
+        "shape": "solo-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 2048, "out_bytes": 512, "temp_bytes": 16384,
+        "flops": 8000,
+    },
+    "ops/lp_place.py::lp_relax": {
+        "shape": "mesh-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 1024, "out_bytes": 1024, "temp_bytes": 4096,
+        "flops": 5000,
+    },
+    "ops/lp_place.py::lp_relax_sig": {
+        "shape": "mesh-small", "gate": "cpu", "dtype": "f32",
+        "arg_bytes": 1024, "out_bytes": 1024, "temp_bytes": 4096,
+        "flops": 5000,
+    },
+    # The whole-loop pallas kernel: replicated operands, VMEM-resident
+    # working set — not lowerable off-accelerator, so the first hardware
+    # round calibrates these (the ROADMAP's open VMEM-cap question is
+    # exactly this row at 100k real nodes).
+    "ops/megakernel.py::mega_allocate": {
+        "shape": "mega-flagship", "gate": "accel", "dtype": "f32",
+        "arg_bytes": 67_108_864,      # 64 MiB staged operand envelope
+        "out_bytes": 4_194_304,       # 4 MiB codes + stats
+        "temp_bytes": 100_663_296,    # 96 MiB VMEM working-set envelope
+        "flops": 1_000_000_000,
+    },
+}
+
+# Registered shard sites with no standalone budget row: compiled only
+# INSIDE the named enclosing budgeted program (never dispatched alone), so
+# their footprint is accounted there.  ``program_budget.py`` verifies every
+# SHARD_SITES key appears in exactly one of the two tables.
+PROGRAM_COVERED = {
+    "ops/fused.py::step_select": "ops/sharded.py::_place_scan_1d",
+    "ops/fused.py::step_select_2d": "ops/sharded.py::_place_scan_2d",
+}
+
+# The declared scoped-x64 blocks: the ONLY functions under ops/ that may
+# open ``with enable_x64():`` (and the only ones that may build
+# ``jnp.float64`` values — lexically inside that block).  The ``precision``
+# pass (analysis/precision.py) walks ops/ against this list; host-side
+# ``np.float64`` is not a device construct and stays free.
+X64_SCOPED_BLOCKS = (
+    ("ops/qfair.py", "solve_deserved"),
+    ("ops/tenant.py", "solve_queue_fair_stacked"),
+)
+
+
 # -- flavor-contract registry (schedlint ``flavors`` pass; schedlint v4) ------
 #
 # Every engine flavor and knob is bound by the same informal contract —
@@ -1005,6 +1222,18 @@ FLAVORS = (
         "obs": None,
         "obs_exempt": "pacing knob; cadence is visible in cycle timings",
         "bench": "churn", "bench_exempt": None,
+    },
+    {
+        "flag": "SCHEDULER_TPU_DETERMINISM",
+        "values": "off|digest|dual", "default": "off",
+        "env_keys": True, "delta": None,
+        "parity": None,
+        "parity_exempt": "runtime twin of the precision pass; digest/dual "
+                         "observe readbacks and never change binds",
+        "test": "tests/test_determinism.py", "test_exempt": None,
+        "doc": "docs/STATIC_ANALYSIS.md",
+        "obs": "determinism", "obs_exempt": None,
+        "bench": "flagship", "bench_exempt": None,
     },
     {
         "flag": "SCHEDULER_TPU_DEVICE",

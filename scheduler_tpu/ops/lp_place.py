@@ -123,6 +123,17 @@ def lp_limit_bytes() -> int:
     return env_int("SCHEDULER_TPU_LP_LIMIT", 256 * 1024 * 1024, minimum=1)
 
 
+def lp_working_set_bytes(row_bucket: int, n_bucket: int, shards: int) -> int:
+    """The admission gate's per-shard working-set model: ~4 row-by-node f32
+    temporaries (logits, exponentials, marginals, feasibility/static rows),
+    16 bytes per (row, node-slice) cell.  This is the ONLY place the byte
+    model lives — ``lp_supported`` gates on it and
+    ``scripts/program_budget.py`` cross-checks it against the AOT-lowered
+    relaxation's measured ``memory_analysis()`` temp bytes, so the 256MB
+    gate and compiled reality cannot drift apart silently."""
+    return 16 * row_bucket * max(n_bucket // max(shards, 1), 1)
+
+
 def lp_supported(
     flat_count: int, has_releasing: bool, row_bucket: int, n_bucket: int, mesh
 ) -> Tuple[bool, Optional[str]]:
@@ -141,7 +152,7 @@ def lp_supported(
     if has_releasing:
         return False, "releasing capacity (pipelined placements) not modeled"
     shards = mesh.size if mesh is not None else 1
-    per_shard = 16 * row_bucket * max(n_bucket // shards, 1)
+    per_shard = lp_working_set_bytes(row_bucket, n_bucket, shards)
     limit = lp_limit_bytes()
     if per_shard > limit:
         return False, (

@@ -1,0 +1,156 @@
+"""``SCHEDULER_TPU_DETERMINISM={off,digest,dual}``: the run-to-run
+determinism sentinel.
+
+The precision contracts (ops/layout.py ``PROGRAM_BUDGETS`` dtype column,
+the ``precision`` schedlint pass, scripts/program_budget.py) prove at
+review/lowering time that each compiled program keeps the dtypes it
+declared.  What no static pass can prove is that the *same* compiled
+program fed the *same* operands produces the *same* bytes — the property
+the engine-cache replay story and every parity oracle in the tree quietly
+assume.  Nondeterministic accumulation order (atomics-based scatter
+reductions, autotuned reduction layouts on an accelerator backend) breaks
+it silently: placements still *work*, but replays diverge and A/B deltas
+stop meaning anything.  This module is the runtime half of that contract
+(docs/STATIC_ANALYSIS.md "The determinism sentinel"):
+
+* ``digest`` — after every device-phase readback, hash the cycle's
+  readback buffers (sha256 over raw bytes + shape/dtype headers) and count
+  cycles; evidence rides ``phases.note("determinism")`` (OBS_CHANNELS) and
+  bench ``detail.determinism``.
+* ``dual``   — additionally re-dispatch the SAME resident executable on
+  the SAME staged operands once per cycle and compare digests; a mismatch
+  raises ``DeterminismError``.  ``sanitize.is_violation`` recognizes it,
+  so the mega -> XLA fallback seams RE-RAISE instead of swallowing the
+  trip and "fixing" nondeterminism by switching engines.
+
+Dual mode is diagnostic — it doubles the device phase; bench records the
+mode in ``detail.determinism`` so a dual-mode artifact can never
+masquerade as a perf number.  Zero cost when off: the hook in
+``FusedAllocator.readback`` returns before touching any buffer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+
+logger = logging.getLogger("scheduler_tpu.utils.determinism")
+
+MODES = ("off", "digest", "dual")
+
+_lock = threading.Lock()
+_cycles = 0        # cycles digested (process lifetime)
+_redispatches = 0  # dual-mode replays performed
+_mismatches = 0    # digest disagreements observed (pre-raise count)
+_cycle_events = 0  # drained per cycle by take_cycle()
+_cycle_redispatches = 0
+_last_digest = None  # type: str | None
+_warned = False
+
+
+class DeterminismError(RuntimeError):
+    """The same executable on the same operands produced different bytes."""
+
+
+def mode() -> str:
+    from scheduler_tpu.utils.envflags import env_str
+
+    return env_str("SCHEDULER_TPU_DETERMINISM", "off", choices=MODES)
+
+
+def enabled() -> bool:
+    return mode() != "off"
+
+
+def dual() -> bool:
+    return mode() == "dual"
+
+
+def digest_arrays(*arrays) -> str:
+    """sha256 over the concatenated raw bytes of host arrays, each prefixed
+    with a ``shape|dtype`` header so layout changes can't alias byte-equal
+    payloads.  ``None`` entries are skipped (optional evidence tensors)."""
+    import numpy as np
+
+    h = hashlib.sha256()
+    for arr in arrays:
+        if arr is None:
+            continue
+        a = np.asarray(arr)
+        h.update(f"{a.shape}|{a.dtype}|".encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
+def observe(first: str, second: "str | None" = None) -> None:
+    """Record one cycle's digest(s).  ``second`` is the dual-mode replay
+    digest; a mismatch raises ``DeterminismError`` (after counting it, so
+    ``summary()`` still reports the trip when a caller swallows the
+    exception)."""
+    global _cycles, _redispatches, _mismatches, _last_digest
+    global _cycle_events, _cycle_redispatches, _warned
+    with _lock:
+        _cycles += 1
+        _cycle_events += 1
+        _last_digest = first
+        if second is not None:
+            _redispatches += 1
+            _cycle_redispatches += 1
+            if second != first:
+                _mismatches += 1
+    if second is not None and second != first:
+        raise DeterminismError(
+            "dual-dispatch digest mismatch: the same executable on the "
+            f"same operands produced {first[:12]}… then {second[:12]}… "
+            "(SCHEDULER_TPU_DETERMINISM=dual; see docs/STATIC_ANALYSIS.md "
+            "'The determinism sentinel')"
+        )
+    if not _warned and mode() == "digest":
+        _warned = True
+        logger.info(
+            "SCHEDULER_TPU_DETERMINISM=digest: hashing device-phase "
+            "readbacks (bench detail.determinism)"
+        )
+
+
+def summary() -> dict:
+    """The bench ``detail.determinism`` block (process-lifetime counters)."""
+    with _lock:
+        return {
+            "mode": mode(),
+            "cycles": _cycles,
+            "redispatches": _redispatches,
+            "mismatches": _mismatches,
+            "last_digest": _last_digest,
+        }
+
+
+def take_cycle() -> dict:
+    """Drain the per-cycle counters (the ``phases.note('determinism')``
+    payload)."""
+    global _cycle_events, _cycle_redispatches
+    with _lock:
+        out = {
+            "mode": mode(),
+            "digests": _cycle_events,
+            "redispatches": _cycle_redispatches,
+            "last_digest": _last_digest,
+        }
+        _cycle_events = 0
+        _cycle_redispatches = 0
+    return out
+
+
+def reset() -> None:
+    """Zero the aggregates (tests)."""
+    global _cycles, _redispatches, _mismatches, _last_digest
+    global _cycle_events, _cycle_redispatches, _warned
+    with _lock:
+        _cycles = 0
+        _redispatches = 0
+        _mismatches = 0
+        _last_digest = None
+        _cycle_events = 0
+        _cycle_redispatches = 0
+        _warned = False

@@ -141,6 +141,17 @@ OBS_CHANNELS = (
                 "(engine-cache hit cycles must stay at zero)",
     },
     {
+        "channel": "determinism",
+        "source": "actions/allocate.py",
+        "metric": None,
+        "exempt": "digest-sentinel evidence (utils/determinism.py); "
+                  "consumed by bench detail.determinism and the bench_gate "
+                  "shape check",
+        "desc": "readback digests and dual-dispatch replays observed under "
+                "the determinism sentinel per cycle (dual replays must "
+                "never disagree)",
+    },
+    {
         "channel": "tenant",
         "source": "ops/tenant.py",
         "metric": None,

@@ -73,7 +73,7 @@ def is_violation(err: BaseException) -> bool:
     (mega -> XLA) must RE-RAISE these instead of swallowing them as backend
     failures — a sanitizer that degrades to a slower-but-working path has
     found a bug and then hidden it."""
-    from scheduler_tpu.utils import retrace, tsan
+    from scheduler_tpu.utils import determinism, retrace, tsan
 
     if tsan.enabled() and isinstance(err, tsan.TsanRaceError):
         return True
@@ -81,6 +81,11 @@ def is_violation(err: BaseException) -> bool:
     # has its own mode flag, so recognition does not require SANITIZE=1 —
     # same standing as the tsan half above.
     if retrace.enabled() and isinstance(err, retrace.RetraceError):
+        return True
+    # Dual-dispatch digest mismatches (utils/determinism.py): a fallback
+    # that switches engines after a trip would "fix" nondeterminism by
+    # hiding it — re-raise, same standing as the retrace half above.
+    if determinism.enabled() and isinstance(err, determinism.DeterminismError):
         return True
     if not enabled():
         return False

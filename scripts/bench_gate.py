@@ -366,6 +366,111 @@ def retrace_note(detail: dict):
     return None
 
 
+def memory_block_problem(detail: dict):
+    """Sanity-check the compiled-memory evidence block (``detail.memory``,
+    docs/STATIC_ANALYSIS.md "schedlint v5" — the runtime twin of the
+    ops/layout.py PROGRAM_BUDGETS registry gated by
+    scripts/program_budget.py).  Absent block = a pre-v5 artifact, fine.
+    Present: ``available`` must be a bool; an available block must name the
+    lowered ``program`` and carry non-negative int byte counters; an
+    unavailable block must say why (mega kernels and host-only runs have a
+    reason, never a silent hole).  Returns the reason string, or None when
+    the block is sane."""
+    mem = detail.get("memory")
+    if mem is None:
+        return None
+    if not isinstance(mem, dict) or not isinstance(mem.get("available"), bool):
+        return "detail.memory is not an {available: bool, ...} block"
+    if not mem["available"]:
+        if not mem.get("reason"):
+            return "detail.memory unavailable without a reason"
+        return None
+    if mem.get("program") not in ("fused_allocate", "lp_relax"):
+        return ("detail.memory.program is not a known device program "
+                "(fused_allocate|lp_relax)")
+    for key in ("argument_bytes", "output_bytes", "temp_bytes",
+                "generated_code_bytes"):
+        v = mem.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            return f"detail.memory.{key} missing or not a non-negative int"
+    flops = mem.get("flops")
+    if flops is not None and (
+        not isinstance(flops, int) or isinstance(flops, bool) or flops < 0
+    ):
+        return "detail.memory.flops present but not a non-negative int"
+    return None
+
+
+def memory_note(prev_detail: dict, detail: dict):
+    """Advisory (never an exit): same-shape rounds whose compiled temp
+    bytes grew more than 10% — a layout/fusion regression in the ACTIVE
+    program that the reference-shape ceilings in PROGRAM_BUDGETS may be
+    too coarse to catch.  "Same shape" is judged by the program name and
+    the argument bytes (argument size is a pure function of the staged
+    shapes); rounds that changed shape or engine are not comparable."""
+    prev = (prev_detail or {}).get("memory")
+    mem = detail.get("memory")
+    if not (isinstance(prev, dict) and isinstance(mem, dict)):
+        return None
+    if not (prev.get("available") and mem.get("available")):
+        return None
+    if prev.get("program") != mem.get("program") or \
+            prev.get("argument_bytes") != mem.get("argument_bytes"):
+        return None  # different program or shapes: not comparable
+    pt, nt = prev.get("temp_bytes"), mem.get("temp_bytes")
+    if not (isinstance(pt, int) and isinstance(nt, int)) or pt <= 0:
+        return None
+    if nt > 1.10 * pt:
+        return (f"compiled temp bytes grew {pt:,} -> {nt:,} "
+                f"(+{100.0 * (nt - pt) / pt:.0f}%) on same-shape "
+                f"{mem['program']} rounds (advisory; >10% — see "
+                "docs/STATIC_ANALYSIS.md \"schedlint v5\")")
+    return None
+
+
+def determinism_block_problem(detail: dict):
+    """Sanity-check the digest-sentinel evidence block
+    (``detail.determinism``, docs/STATIC_ANALYSIS.md "The determinism
+    sentinel").  Absent block = a pre-sentinel artifact, fine.  Present:
+    ``mode`` must be one of the flag's values and the counters
+    non-negative ints with ``redispatches <= cycles`` and
+    ``mismatches <= redispatches`` — a mismatch needs a replay and a
+    replay needs a cycle.  Returns the reason string, or None."""
+    det = detail.get("determinism")
+    if det is None:
+        return None
+    if not isinstance(det, dict) or det.get("mode") not in (
+        "off", "digest", "dual"
+    ):
+        return "detail.determinism is not a {mode: off|digest|dual, ...} block"
+    for key in ("cycles", "redispatches", "mismatches"):
+        v = det.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            return f"detail.determinism.{key} missing or not a non-negative int"
+    if det["redispatches"] > det["cycles"]:
+        return ("detail.determinism.redispatches exceeds cycles — the "
+                "sentinel replays at most once per digested cycle")
+    if det["mismatches"] > det["redispatches"]:
+        return ("detail.determinism.mismatches exceeds redispatches — a "
+                "mismatch is only observable on a dual replay")
+    return None
+
+
+def determinism_note(detail: dict):
+    """Advisory (never an exit): a dual-mode artifact that observed digest
+    mismatches.  The run-to-run contract is bitwise replay
+    (docs/STATIC_ANALYSIS.md "The determinism sentinel"); the gate
+    SURFACES the count — the hard stop is the DeterminismError raised at
+    run time."""
+    det = detail.get("determinism")
+    if isinstance(det, dict) and det.get("mode") == "dual" and \
+            isinstance(det.get("mismatches"), int) and det["mismatches"] > 0:
+        return (f"determinism sentinel saw {det['mismatches']} dual-replay "
+                "digest mismatch(es) — the artifact's numbers are not "
+                "replayable (advisory; the run itself raises)")
+    return None
+
+
 def find_artifacts(root: Path, infix: str = ""):
     """One family's ``BENCH{infix}_r*.json`` sorted by round number (not
     mtime: artifacts are checked in, and a fresh clone flattens
@@ -937,17 +1042,41 @@ def gate_family(root: Path, label: str, infix: str) -> int:
             print(f"bench-gate[{label}]: malformed artifact "
                   f"{artifacts[-1].name}: {rt_why}")
             return 1
+        mem_why = memory_block_problem(detail)
+        if mem_why is not None:
+            print(f"bench-gate[{label}]: malformed artifact "
+                  f"{artifacts[-1].name}: {mem_why}")
+            return 1
+        det_why = determinism_block_problem(detail)
+        if det_why is not None:
+            print(f"bench-gate[{label}]: malformed artifact "
+                  f"{artifacts[-1].name}: {det_why}")
+            return 1
         note = obs_overhead_note(detail)
         if note is not None:
             print(f"bench-gate[{label}]: {artifacts[-1].name}: {note}")
         rt_note = retrace_note(detail)
         if rt_note is not None:
             print(f"bench-gate[{label}]: {artifacts[-1].name}: {rt_note}")
+        det_note = determinism_note(detail)
+        if det_note is not None:
+            print(f"bench-gate[{label}]: {artifacts[-1].name}: {det_note}")
     if len(artifacts) < 2:
         print(f"bench-gate[{label}]: need two BENCH{infix}_r*.json under "
               f"{root}, found {len(artifacts)}; nothing to compare")
         return 0
     prev_path, new_path = artifacts[-2], artifacts[-1]
+    # Same-shape compiled temp-bytes growth between the compared rounds
+    # (advisory): detail still holds the newest round's block from above.
+    try:
+        prev_detail = _unwrap(
+            json.loads(prev_path.read_text())
+        ).get("detail") or {}
+    except json.JSONDecodeError:
+        prev_detail = {}
+    mem_note = memory_note(prev_detail, detail)
+    if mem_note is not None:
+        print(f"bench-gate[{label}]: {new_path.name}: {mem_note}")
     if infix == "_XL" and mesh_identity(prev_path) != mesh_identity(new_path):
         print(
             f"bench-gate[{label}]: {prev_path.name} and {new_path.name} ran "

@@ -39,6 +39,10 @@ def main() -> int:
     from scheduler_tpu.analysis.obs_channels import (
         OBS_DOC, TABLE_NS, channels_from_source, render_channel_table,
     )
+    from scheduler_tpu.analysis.precision import (
+        parse_program_registry, render_program_table,
+    )
+    from scheduler_tpu.analysis.precision import TABLE_NS as PROGRAM_NS
     from scheduler_tpu.analysis.row_layout import (
         marker_lines, parse_registry_source, render_table,
     )
@@ -70,6 +74,13 @@ def main() -> int:
     if channels is not None:
         plans.setdefault(OBS_DOC, []).append(
             (TABLE_NS, render_channel_table(channels))
+        )
+    # Program-budget registry (layout.py PROGRAM_BUDGETS) — same renderer
+    # the precision schedlint pass drift-checks with.
+    preg = parse_program_registry(source)
+    if preg.doc_path and not preg.errors:
+        plans.setdefault(preg.doc_path, []).append(
+            (PROGRAM_NS, render_program_table(preg))
         )
     # Flavor-contract registry (layout.py FLAVORS) — same renderer the
     # flavors schedlint pass drift-checks with.

@@ -192,6 +192,23 @@ def run(n_nodes: int, n_pods: int, label: str, n_queues: int = 1) -> None:
         if key in rec:
             print(f"  {key:<19} {rec[key]:8.3f}s")
     print(f"  TOTAL               {total:8.3f}s")
+    # Compiled memory/FLOP block of the program the timed cycle actually
+    # ran (FusedAllocator.memory_detail — the same AOT numbers
+    # scripts/program_budget.py gates at reference shapes and bench.py
+    # stamps as detail.memory), next to the phase split so a perf read
+    # always comes with its working-set context.
+    mem = engine.memory_detail()
+    if mem.get("available"):
+        flops = mem.get("flops")
+        print(f"  memory[{mem['program']}]  "
+              f"arg={mem['argument_bytes']:,}B "
+              f"out={mem['output_bytes']:,}B "
+              f"temp={mem['temp_bytes']:,}B "
+              f"code={mem['generated_code_bytes']:,}B "
+              + (f"flops={flops:,}" if flops is not None else "flops=n/a"))
+    else:
+        print(f"  memory              unavailable "
+              f"({mem.get('reason', 'n/a')})")
 
 
 def run_churn(n_nodes: int, n_placed: int, batch: int = 250,

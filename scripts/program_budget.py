@@ -1,0 +1,383 @@
+"""Compiled-program resource-budget check (schedlint v5, the memory half).
+
+The program-budget registry (``scheduler_tpu/ops/layout.py``
+``PROGRAM_BUDGETS``) declares, per registered dispatch/shard site and at a
+NAMED reference shape, ceilings for the compiled program's argument /
+output / temp bytes and its ``cost_analysis`` FLOP bound, plus the site's
+dtype contract (f32-only vs scoped-x64).  ``shard_budget.py`` proves the
+compiled COLLECTIVE pattern; this script proves the compiled RESOURCE
+pattern over the very same AOT lowerings: it compiles every budgeted site
+on the simulated mesh (both shapes in CI) plus the solo mesh-free entry
+points, reads ``compiled.memory_analysis()`` / ``cost_analysis()``, and
+fails when any measurement exceeds its declared ceiling — catching silent
+working-set regressions (an accidental [T, N] materialization where [S, N]
+class rows should flow, a GSPMD-inferred full-replica buffer) the same way
+shard_budget catches accidental collectives.
+
+Two extra contracts ride the same lowerings:
+
+* **dtype** — a site declared ``f32`` must compile to HLO with no ``f64``
+  tensors at all (an unexpected ``convert`` into f64 is how an unscoped
+  x64 leak or a python-float promotion shows up in compiled code); a site
+  declared ``x64-scoped`` must actually BE f64 (catching a silent demotion
+  of the qfair water-fill, whose bitwise host parity depends on it).
+* **LP admission cross-check** — ``ops/lp_place.py lp_working_set_bytes``
+  (the byte model behind the ``SCHEDULER_TPU_LP_LIMIT`` 256MB gate) is
+  checked against the measured temp bytes of the relaxation lowered at a
+  shape where the [T, N] working set dominates, so the hand-written
+  formula and compiled reality cannot drift.
+
+Run by ``make lint`` and the CI simulated-mesh job at both mesh shapes.
+``--measure`` prints registry-literal rows from the live measurements
+(the calibration aid for bumping ceilings after an intentional change).
+
+Usage: python scripts/program_budget.py [--devices N] [--mesh 1d|RxC]
+                                        [--verbose] [--measure]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+sys.path.insert(0, str(ROOT / "scripts"))
+
+import shard_budget  # noqa: E402  (same directory; the collectives half)
+
+# Headroom guidance for --measure output: ceilings print at ~2x measured,
+# rounded up — generous enough to survive an XLA/jax upgrade's constant
+# folding drift, tight enough that a new [T, N] temporary (4x at the
+# reference shape) cannot hide under it.
+_HEADROOM = 2.0
+
+# The admission model claims ~4 row-by-node f32 temporaries and must stay
+# an UPPER bound on the compiled working set (measured today: ~0.3x the
+# model — XLA fuses several of the modeled rows).  Slack 1.0 IS the
+# contract: the moment the compiled relaxation outgrows the formula, the
+# SCHEDULER_TPU_LP_LIMIT gate is admitting programs it cannot vouch for.
+LP_ADMISSION_SLACK = 1.0
+
+
+def _memory(compiled) -> dict:
+    ma = compiled.memory_analysis()
+    return {
+        "arg_bytes": int(ma.argument_size_in_bytes),
+        "out_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "code_bytes": int(ma.generated_code_size_in_bytes),
+    }
+
+
+def _flops(compiled):
+    """``cost_analysis`` flops, or None when the backend reports none
+    (the check is then skipped — jax returns a list of per-module dicts
+    on some versions, a bare dict on others)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return None
+    flops = ca.get("flops")
+    if flops is None or flops <= 0:
+        return None
+    return int(flops)
+
+
+# -- solo (mesh-free) entry points -------------------------------------------
+
+def _solo_engine_problem() -> dict:
+    """``fused_allocate``'s full argument tuple at the solo reference shape
+    (``solo-small``): shard_budget's small problem (N=8, T=4, R=3) staged
+    through the mesh-free engine entry with J=2 jobs on Q=1 queue.  Keys
+    are in POSITIONAL ORDER — the lowering splats ``values()``."""
+    import numpy as np
+
+    p = shard_budget._small_problem()
+    n, r = p["idle"].shape
+    t = p["resreq"].shape[0]
+    j, q = 2, 1
+    return dict(
+        idle=p["idle"],
+        releasing=p["releasing"],
+        task_count=p["task_count"],
+        allocatable=p["allocatable"],
+        pods_limit=p["pods_limit"],
+        node_gate=np.ones(n, bool),
+        mins=p["mins"],
+        init_resreq=p["init_resreq"],
+        resreq=p["resreq"],
+        static_mask=np.ones((1, 1), bool),
+        static_score=np.zeros((1, 1), np.float32),
+        job_task_offset=np.asarray([0, 2], np.int32),
+        job_task_num=np.asarray([2, 2], np.int32),
+        job_deficit=np.zeros(j, np.int32),
+        job_gang_order=np.zeros(j, np.int32),
+        job_priority=np.zeros(j, np.int32),
+        job_tiebreak=np.arange(j, dtype=np.int32),
+        job_queue=np.zeros(j, np.int32),
+        job_alloc_init=np.zeros((j, r), np.float32),
+        queue_rank=np.zeros(q, np.int32),
+        queue_has_jobs=np.ones(q, bool),
+        queue_deserved=np.zeros((q, r), np.float32),
+        queue_alloc_init=np.zeros((q, r), np.float32),
+        drf_total=np.full(r, 64.0, np.float32),
+        run_len=np.ones(t, np.int32),
+        sig_of_task=np.zeros(t, np.int32),
+        qfair_share=np.zeros((1, 1), np.float32),
+        qfair_over=np.zeros((1, 1), bool),
+    )
+
+
+def _compile_fused_allocate(mesh):
+    """Lower the solo XLA while-loop engine (``ops/fused.py``
+    ``fused_allocate``) exactly as a single-host greedy dispatch stages it
+    (window=4, the priority/gang/drf chain).  ``mesh`` is ignored — the
+    solo rows hold at both CI shapes by construction."""
+    import jax.numpy as jnp
+
+    from scheduler_tpu.ops.fused import fused_allocate
+
+    p = _solo_engine_problem()
+    lowered = fused_allocate.lower(
+        *[jnp.asarray(v) for v in p.values()],
+        comparators=("priority", "gang", "drf"),
+        queue_comparators=(),
+        overused_gate=False,
+        use_static=False,
+        n_queues=1,
+        weights=(1.0, 1.0, 0.0),
+        enforce_pod_count=True,
+        window=4,
+        batch_runs=False,
+        sorted_jobs=True,
+        has_releasing=True,
+        step_kernel=False,
+        queue_delta=False,
+        sig_compress=False,
+        qfair_ladder=False,
+        mesh=None,
+    )
+    return lowered.compile()
+
+
+# The solo (mesh-free) entry points.  The LP and qfair rows reuse
+# shard_budget's compile fns with mesh=None — the SAME operands their
+# shard twins lower, minus the shard_map wrapper, so a solo-vs-twin budget
+# gap is pure sharding overhead.  Eviction and backfill have no mesh-free
+# device program (the host flavors are numpy) — their device entry points
+# are exactly the _victim_pick_* / _bf_fill_* twin rows.
+SOLO_SITES = {
+    "ops/fused.py::fused_allocate": _compile_fused_allocate,
+    "ops/lp_place.py::lp_relax":
+        lambda mesh: shard_budget._compile_lp_iterate(None),
+    "ops/lp_place.py::lp_relax_sig":
+        lambda mesh: shard_budget._compile_lp_iterate_sig(None),
+    "ops/qfair.py::qfair_solve":
+        lambda mesh: shard_budget._compile_qfair_solve(None),
+    "ops/qfair.py::qfair_solve_stacked":
+        lambda mesh: shard_budget._compile_qfair_stacked(None),
+}
+
+
+def budgeted_sites(mesh) -> dict:
+    """Every site this run lowers: the current mesh shape's shard twins
+    plus the mesh-independent solo entry points."""
+    sites = dict(shard_budget.lowerable_sites(mesh))
+    sites.update(SOLO_SITES)
+    return sites
+
+
+def _twin_key(site: str) -> str:
+    if site.endswith("_1d"):
+        return site[:-3] + "_2d"
+    if site.endswith("_2d"):
+        return site[:-3] + "_1d"
+    return site
+
+
+# -- checks ------------------------------------------------------------------
+
+_BYTE_KEYS = ("arg_bytes", "out_bytes", "temp_bytes")
+
+
+def check_program(site: str, row: dict, mem: dict, flops, hlo_text: str) -> list:
+    """Budget + dtype findings for one lowered site against its registry
+    row.  ``flops`` None skips the FLOP bound (backend reported none)."""
+    out = []
+    for key in _BYTE_KEYS:
+        if mem[key] > row[key]:
+            out.append(
+                f"{site}: {key}={mem[key]:,} exceeds the declared ceiling "
+                f"{row[key]:,} at shape {row['shape']!r} "
+                f"(ops/layout.py PROGRAM_BUDGETS)"
+            )
+    if flops is not None and flops > row["flops"]:
+        out.append(
+            f"{site}: flops={flops:,} exceeds the declared ceiling "
+            f"{row['flops']:,} at shape {row['shape']!r} "
+            f"(ops/layout.py PROGRAM_BUDGETS)"
+        )
+    has_f64 = " f64[" in hlo_text or "(f64[" in hlo_text
+    if row["dtype"] == "f32" and has_f64:
+        out.append(
+            f"{site}: compiled HLO contains f64 tensors but the site's "
+            f"dtype contract is 'f32' — an unexpected convert/x64 leak "
+            f"(ops/layout.py PROGRAM_BUDGETS; docs/STATIC_ANALYSIS.md)"
+        )
+    if row["dtype"] == "x64-scoped" and not has_f64:
+        out.append(
+            f"{site}: dtype contract is 'x64-scoped' but the compiled HLO "
+            f"holds no f64 tensors — the solve was silently demoted and "
+            f"its bitwise host parity is void (ops/layout.py PROGRAM_BUDGETS)"
+        )
+    return out
+
+
+def _lp_crosscheck(verbose: bool) -> list:
+    """Lower the LP relaxation at a shape where the [rows, N] working set
+    dominates and hold ``lp_working_set_bytes`` (the SCHEDULER_TPU_LP_LIMIT
+    admission model) against the measured temp bytes."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scheduler_tpu.ops.lp_place import lp_relax, lp_working_set_bytes
+
+    t, n, r = 256, 1024, 3
+    rng = np.random.default_rng(0)
+    lowered = lp_relax.lower(
+        jnp.asarray(rng.uniform(1, 8, (n, r)).astype(np.float32)),
+        jnp.asarray(rng.uniform(1, 8, (n, r)).astype(np.float32)),
+        jnp.asarray(np.zeros(n, np.int32)),
+        jnp.asarray(np.full(n, 16, np.int32)),
+        jnp.asarray(np.ones(n, bool)),
+        jnp.asarray(np.ones((1, 1), bool)),
+        jnp.asarray(np.zeros((1, 1), np.float32)),
+        jnp.asarray(np.full(r, 1e-2, np.float32)),
+        jnp.asarray(rng.uniform(0.5, 2, (t, r)).astype(np.float32)),
+        jnp.asarray(rng.uniform(0.5, 2, (t, r)).astype(np.float32)),
+        iters=8, tau=0.5, tol=1e-3, weights=(0.0, 0.0, 1.0),
+        enforce_pod_count=True, use_static=False, mesh=None,
+    )
+    measured = _memory(lowered.compile())["temp_bytes"]
+    modeled = lp_working_set_bytes(t, n, shards=1)
+    if verbose:
+        print(
+            f"lp-admission cross-check: rows={t} N={n} modeled={modeled:,} "
+            f"measured_temp={measured:,} slack={LP_ADMISSION_SLACK}x"
+        )
+    if measured > LP_ADMISSION_SLACK * modeled:
+        return [
+            f"lp-admission: measured temp bytes {measured:,} at "
+            f"[rows={t}, N={n}] exceed {LP_ADMISSION_SLACK}x the "
+            f"lp_working_set_bytes model ({modeled:,}) — the "
+            f"SCHEDULER_TPU_LP_LIMIT gate no longer reflects the compiled "
+            f"working set (ops/lp_place.py)"
+        ]
+    return []
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--devices", type=int, default=shard_budget.DEFAULT_DEVICES)
+    ap.add_argument(
+        "--mesh", default="1d",
+        help="mesh shape: '1d' (default) or 'RxC' for the 2-D multi-host "
+             "twins (overrides --devices with R*C)",
+    )
+    ap.add_argument("--verbose", action="store_true")
+    ap.add_argument(
+        "--measure", action="store_true",
+        help="print registry-literal rows at ~2x measured (calibration aid)",
+    )
+    args = ap.parse_args()
+
+    parsed = shard_budget._parse_mesh_arg(args.mesh)
+    n_devices = parsed[0] * parsed[1] if parsed else args.devices
+    shard_budget.force_host_devices(n_devices)
+
+    from scheduler_tpu.ops import layout
+
+    mesh = shard_budget._mesh(args.devices, args.mesh)
+    sites = budgeted_sites(mesh)
+    failures = []
+    checked = 0
+    for site, compile_fn in sorted(sites.items()):
+        row = layout.PROGRAM_BUDGETS.get(site)
+        if row is None and not args.measure:
+            failures.append(
+                f"{site}: lowerable site has no PROGRAM_BUDGETS row "
+                f"(ops/layout.py)"
+            )
+            continue
+        compiled = compile_fn(mesh)
+        mem = _memory(compiled)
+        flops = _flops(compiled)
+        checked += 1
+        if args.measure:
+            ceil = lambda v: int(-(-v * _HEADROOM // 1024) * 1024)
+            print(f'    "{site}": {{')
+            print(f'        "shape": "{row["shape"] if row else "?"}",')
+            print(f'        "gate": "cpu",')
+            for key in _BYTE_KEYS:
+                print(f'        "{key}": {ceil(max(mem[key], 512))},')
+            print(f'        "flops": '
+                  f'{int(-(-(flops or 1) * _HEADROOM // 1000) * 1000)},')
+            print(f'        "dtype": '
+                  f'"{row["dtype"] if row else "f32"}",  # measured: {mem}'
+                  f' flops={flops}')
+            print('    },')
+            continue
+        if args.verbose:
+            print(f"{site}: {mem} flops={flops} budget={row}")
+        failures.extend(
+            check_program(site, row, mem, flops, compiled.as_text())
+        )
+
+    if not args.measure:
+        # Registry-coverage cross-checks: a cpu-gated row nothing lowers is
+        # dead (a renamed site silently losing its gate); a registered
+        # shard site with neither a budget row nor a covered-by deferral is
+        # an unbudgeted device program.
+        known = set(sites)
+        known |= {_twin_key(s) for s in shard_budget.lowerable_sites(mesh)}
+        for site, row in sorted(layout.PROGRAM_BUDGETS.items()):
+            if row["gate"] == "cpu" and site not in known:
+                failures.append(
+                    f"{site}: PROGRAM_BUDGETS row is cpu-gated but no "
+                    f"lowering exists for it (scripts/program_budget.py)"
+                )
+        for site in sorted(layout.SHARD_SITES):
+            if (site not in layout.PROGRAM_BUDGETS
+                    and site not in layout.PROGRAM_COVERED):
+                failures.append(
+                    f"{site}: registered shard site has neither a "
+                    f"PROGRAM_BUDGETS row nor a PROGRAM_COVERED deferral "
+                    f"(ops/layout.py)"
+                )
+        for site, covered_by in sorted(layout.PROGRAM_COVERED.items()):
+            if covered_by not in layout.PROGRAM_BUDGETS:
+                failures.append(
+                    f"{site}: PROGRAM_COVERED points at {covered_by!r}, "
+                    f"which has no PROGRAM_BUDGETS row (ops/layout.py)"
+                )
+        failures.extend(_lp_crosscheck(args.verbose))
+
+    for msg in failures:
+        print(msg)
+    print(
+        f"program_budget: {checked} site(s) lowered on a "
+        f"{mesh.size}-device simulated "
+        f"{'x'.join(str(s) for s in mesh.devices.shape)} mesh, "
+        f"{len(failures)} finding(s)"
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,15 @@ tree and exits non-zero on findings:
   jit-static  jax.jit static args are never fed per-cycle or unhashable
               values (the review-time companion of the
               SCHEDULER_TPU_RETRACE runtime sentinel)
+  precision   ops/ dtype contracts go through the ops/layout.py
+              PROGRAM_BUDGETS registry: enable_x64 blocks and jnp 64-bit
+              constructs only inside declared X64_SCOPED_BLOCKS
+              functions, no process-wide jax_enable_x64 flips, registry
+              schema/coverage integrity, and the generated budget table
+              in docs/STATIC_ANALYSIS.md is current (the compiled-HLO
+              half — byte/FLOP ceilings, f64-leak and silent-demotion
+              checks — is scripts/program_budget.py; both run under
+              ``make lint``; the runtime twin is SCHEDULER_TPU_DETERMINISM)
   hygiene     whitespace + unused imports (the former scripts/lint.py)
 
 Usage: python scripts/schedlint.py [--rules r1,r2] [--list-rules] [--json]

@@ -155,7 +155,7 @@ def _mesh(n: int, shape: str = "1d"):
     return Mesh(np.array(devices[:n]), (NODE_AXIS,))
 
 
-def _hlo_place_scan(mesh) -> str:
+def _compile_place_scan(mesh):
     import jax.numpy as jnp
 
     from scheduler_tpu.ops.sharded import sharded_place_scan
@@ -165,10 +165,10 @@ def _hlo_place_scan(mesh) -> str:
         *[jnp.asarray(v) for v in p.values()],
         mesh=mesh, weights=(1.0, 1.0, 0.0), enforce_pod_count=True,
     )
-    return lowered.compile().as_text()
+    return lowered.compile()
 
 
-def _hlo_lp_iterate(mesh) -> str:
+def _compile_lp_iterate(mesh):
     """Lower the LP-relaxed allocator's fixed-point iteration
     (``ops/lp_place.py``, docs/LP_PLACEMENT.md).  The fori body's
     collectives appear once in the compiled text, so the count IS the
@@ -190,10 +190,10 @@ def _hlo_lp_iterate(mesh) -> str:
         iters=8, tau=0.5, tol=1e-3, weights=(0.0, 0.0, 1.0),
         enforce_pod_count=True, use_static=False, mesh=mesh,
     )
-    return lowered.compile().as_text()
+    return lowered.compile()
 
 
-def _hlo_lp_iterate_sig(mesh) -> str:
+def _compile_lp_iterate_sig(mesh):
     """Lower the SIGNATURE-COMPRESSED LP iteration twin
     (``_lp_iterate_sig_*``, docs/LP_PLACEMENT.md "Signature classes"):
     the task axis is the [S] class axis and the extra replicated operand
@@ -219,10 +219,10 @@ def _hlo_lp_iterate_sig(mesh) -> str:
         iters=8, tau=0.5, tol=1e-3, weights=(0.0, 0.0, 1.0),
         enforce_pod_count=True, use_static=False, mesh=mesh,
     )
-    return lowered.compile().as_text()
+    return lowered.compile()
 
 
-def _hlo_tenant_scan(mesh) -> str:
+def _compile_tenant_scan(mesh):
     """Lower the multi-tenant K-lane placement scan (``ops/sharded.py``
     ``tenant_place_scan``, docs/TENANT.md) at K=4 lanes.  The K lanes'
     candidate tuples pack into ONE [W, K] tensor riding ONE all-gather per
@@ -248,10 +248,10 @@ def _hlo_tenant_scan(mesh) -> str:
         jnp.asarray(np.full(k, 100, np.int32)),
         mesh=mesh, weights=(1.0, 1.0, 0.0), enforce_pod_count=True,
     )
-    return lowered.compile().as_text()
+    return lowered.compile()
 
 
-def _hlo_qfair_solve(mesh) -> str:
+def _compile_qfair_solve(mesh):
     """Lower the queue-fair deserved water-fill (``ops/qfair.py``
     ``qfair_solve``, docs/QUEUE_DELTA.md "Class-ladder solve") at a small
     [Q, R] shape, f64 under x64 — exactly how the proportion plugin calls
@@ -277,10 +277,10 @@ def _hlo_qfair_solve(mesh) -> str:
             jnp.asarray(np.full(r, 1e-2), jnp.float64),
             iters=q + 4, mesh=mesh,
         )
-        return lowered.compile().as_text()
+        return lowered.compile()
 
 
-def _hlo_qfair_stacked(mesh) -> str:
+def _compile_qfair_stacked(mesh):
     """Lower the K-fleet stacked solve twin (``qfair_solve_stacked``, the
     ``ops/tenant.py`` lane idiom) at K=4: batching fleets widens the lane
     axis, never the collective count — still ZERO collectives."""
@@ -302,10 +302,10 @@ def _hlo_qfair_stacked(mesh) -> str:
             jnp.asarray(np.full(r, 1e-2), jnp.float64),
             iters=q + 4, mesh=mesh,
         )
-        return lowered.compile().as_text()
+        return lowered.compile()
 
 
-def _hlo_victim_pick(mesh) -> str:
+def _compile_victim_pick(mesh):
     """Lower the eviction engine's victim-plan node pick
     (``ops/evict.py`` ``sharded_victim_pick``, docs/PREEMPT.md): each shard
     reduces its node block to an EVICT_PICK candidate tuple, the tuples
@@ -321,10 +321,10 @@ def _hlo_victim_pick(mesh) -> str:
     lowered = jax.jit(
         lambda pos: sharded_victim_pick(pos, mesh=mesh)
     ).lower(jnp.zeros(mesh.size * 2, jnp.float32))
-    return lowered.compile().as_text()
+    return lowered.compile()
 
 
-def _hlo_backfill_fill(mesh) -> str:
+def _compile_backfill_fill(mesh):
     """Lower the backfill engine's water-fill scan
     (``ops/backfill.py`` ``sharded_backfill_fill``, docs/BACKFILL.md):
     each shard cumsums its masked node-room block locally, the per-shard
@@ -346,10 +346,10 @@ def _hlo_backfill_fill(mesh) -> str:
         jnp.zeros(n, jnp.int32),
         jnp.zeros(8, jnp.int32),
     )
-    return lowered.compile().as_text()
+    return lowered.compile()
 
 
-def _hlo_selector_mask(mesh) -> str:
+def _compile_selector_mask(mesh):
     import jax.numpy as jnp
     import numpy as np
 
@@ -361,7 +361,7 @@ def _hlo_selector_mask(mesh) -> str:
     lowered = sharded_selector_mask.lower(
         jnp.asarray(sel), jnp.asarray(labels), mesh=mesh
     )
-    return lowered.compile().as_text()
+    return lowered.compile()
 
 
 # Sites this script can lower standalone (the in-engine sites —
@@ -374,26 +374,26 @@ def lowerable_sites(mesh) -> dict:
 
     if is_multi_host(mesh):
         return {
-            "ops/sharded.py::_place_scan_2d": _hlo_place_scan,
-            "ops/sharded.py::_tenant_scan_2d": _hlo_tenant_scan,
-            "ops/sharded.py::_selector_mask_2d": _hlo_selector_mask,
-            "ops/lp_place.py::_lp_iterate_2d": _hlo_lp_iterate,
-            "ops/lp_place.py::_lp_iterate_sig_2d": _hlo_lp_iterate_sig,
-            "ops/evict.py::_victim_pick_2d": _hlo_victim_pick,
-            "ops/backfill.py::_bf_fill_2d": _hlo_backfill_fill,
-            "ops/qfair.py::_qfair_solve_2d": _hlo_qfair_solve,
-            "ops/qfair.py::_qfair_stacked_2d": _hlo_qfair_stacked,
+            "ops/sharded.py::_place_scan_2d": _compile_place_scan,
+            "ops/sharded.py::_tenant_scan_2d": _compile_tenant_scan,
+            "ops/sharded.py::_selector_mask_2d": _compile_selector_mask,
+            "ops/lp_place.py::_lp_iterate_2d": _compile_lp_iterate,
+            "ops/lp_place.py::_lp_iterate_sig_2d": _compile_lp_iterate_sig,
+            "ops/evict.py::_victim_pick_2d": _compile_victim_pick,
+            "ops/backfill.py::_bf_fill_2d": _compile_backfill_fill,
+            "ops/qfair.py::_qfair_solve_2d": _compile_qfair_solve,
+            "ops/qfair.py::_qfair_stacked_2d": _compile_qfair_stacked,
         }
     return {
-        "ops/sharded.py::_place_scan_1d": _hlo_place_scan,
-        "ops/sharded.py::_tenant_scan_1d": _hlo_tenant_scan,
-        "ops/sharded.py::_selector_mask_1d": _hlo_selector_mask,
-        "ops/lp_place.py::_lp_iterate_1d": _hlo_lp_iterate,
-        "ops/lp_place.py::_lp_iterate_sig_1d": _hlo_lp_iterate_sig,
-        "ops/evict.py::_victim_pick_1d": _hlo_victim_pick,
-        "ops/backfill.py::_bf_fill_1d": _hlo_backfill_fill,
-        "ops/qfair.py::_qfair_solve_1d": _hlo_qfair_solve,
-        "ops/qfair.py::_qfair_stacked_1d": _hlo_qfair_stacked,
+        "ops/sharded.py::_place_scan_1d": _compile_place_scan,
+        "ops/sharded.py::_tenant_scan_1d": _compile_tenant_scan,
+        "ops/sharded.py::_selector_mask_1d": _compile_selector_mask,
+        "ops/lp_place.py::_lp_iterate_1d": _compile_lp_iterate,
+        "ops/lp_place.py::_lp_iterate_sig_1d": _compile_lp_iterate_sig,
+        "ops/evict.py::_victim_pick_1d": _compile_victim_pick,
+        "ops/backfill.py::_bf_fill_1d": _compile_backfill_fill,
+        "ops/qfair.py::_qfair_solve_1d": _compile_qfair_solve,
+        "ops/qfair.py::_qfair_stacked_1d": _compile_qfair_stacked,
     }
 
 
@@ -429,7 +429,7 @@ def main() -> int:
         if budget is None:
             failures.append(f"{site}: lowerable site has no budget entry")
             continue
-        counts = count_collectives(lower(mesh))
+        counts = count_collectives(lower(mesh).as_text())
         checked += 1
         if args.verbose:
             print(f"{site}: collectives={counts} budget={budget}")

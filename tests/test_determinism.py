@@ -1,0 +1,170 @@
+"""The run-to-run determinism sentinel (``SCHEDULER_TPU_DETERMINISM``,
+utils/determinism.py; docs/STATIC_ANALYSIS.md "The determinism sentinel").
+
+The acceptance matrix from the v5 issue: the sentinel MUST trip under
+``dual`` on a seeded nondeterministic kernel (a replay whose bytes
+differ), a full engine cycle under ``dual`` must be digest-clean, a trip
+is a sanitizer violation (so the mega->XLA fallback seams in ops/fused.py
+re-raise instead of "fixing" nondeterminism by switching engines), and
+the flag participates in ``engine_cache._ENV_KEYS``.
+"""
+
+import numpy as np
+import pytest
+
+from scheduler_tpu.ops import engine_cache
+from scheduler_tpu.utils import determinism, envflags, sanitize
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sentinel():
+    envflags._warned.clear()
+    determinism.reset()
+    yield
+    determinism.reset()
+
+
+def test_off_mode_is_null(monkeypatch):
+    monkeypatch.delenv("SCHEDULER_TPU_DETERMINISM", raising=False)
+    assert determinism.mode() == "off"
+    assert not determinism.enabled()
+    assert not determinism.dual()
+    assert determinism.summary() == {
+        "mode": "off", "cycles": 0, "redispatches": 0, "mismatches": 0,
+        "last_digest": None,
+    }
+
+
+def test_digest_is_stable_and_layout_sensitive():
+    a = np.arange(12, dtype=np.float32)
+    assert determinism.digest_arrays(a) == determinism.digest_arrays(a.copy())
+    # Same bytes, different shape: the shape/dtype header must split them.
+    assert determinism.digest_arrays(a) != \
+        determinism.digest_arrays(a.reshape(3, 4))
+    assert determinism.digest_arrays(a) != \
+        determinism.digest_arrays(a.astype(np.int32))
+    # None entries (optional evidence tensors) are skipped, not hashed.
+    assert determinism.digest_arrays(a, None) == determinism.digest_arrays(a)
+
+
+def test_dual_must_trip_on_seeded_nondeterministic_kernel(monkeypatch):
+    """The seeded violation: a 'kernel' whose replay produces different
+    bytes (a fresh draw per call — the distilled shape of an
+    accumulation-order race).  dual MUST raise."""
+    monkeypatch.setenv("SCHEDULER_TPU_DETERMINISM", "dual")
+    rng = np.random.default_rng(7)
+
+    def nondeterministic_kernel():
+        return rng.standard_normal(8)  # new bytes every dispatch
+
+    first = determinism.digest_arrays(nondeterministic_kernel())
+    second = determinism.digest_arrays(nondeterministic_kernel())
+    assert first != second
+    with pytest.raises(determinism.DeterminismError):
+        determinism.observe(first, second)
+    s = determinism.summary()
+    assert s["mismatches"] == 1  # counted BEFORE the raise
+    assert s["redispatches"] == 1
+
+
+def test_digest_mode_counts_without_replays(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_DETERMINISM", "digest")
+    assert determinism.enabled() and not determinism.dual()
+    d = determinism.digest_arrays(np.ones(4))
+    determinism.observe(d)
+    determinism.observe(d)
+    s = determinism.summary()
+    assert s["cycles"] == 2
+    assert s["redispatches"] == 0
+    assert s["mismatches"] == 0
+    assert s["last_digest"] == d
+    cycle = determinism.take_cycle()
+    assert cycle["digests"] == 2 and cycle["redispatches"] == 0
+    # take_cycle drains: the next cycle's note starts from zero.
+    assert determinism.take_cycle()["digests"] == 0
+
+
+def test_matching_dual_replay_is_clean(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_DETERMINISM", "dual")
+    d = determinism.digest_arrays(np.arange(6))
+    determinism.observe(d, d)
+    s = determinism.summary()
+    assert s["cycles"] == 1 and s["redispatches"] == 1
+    assert s["mismatches"] == 0
+
+
+def test_trip_is_a_sanitizer_violation(monkeypatch):
+    """The fused.py fallback seams consult ``sanitize.is_violation``
+    before downgrading a failure to another engine — a digest mismatch
+    must RE-RAISE through them (an engine switch would hide the
+    nondeterminism it just proved)."""
+    monkeypatch.setenv("SCHEDULER_TPU_DETERMINISM", "dual")
+    caught = None
+    try:
+        determinism.observe(
+            determinism.digest_arrays(np.zeros(3)),
+            determinism.digest_arrays(np.ones(3)),
+        )
+    except determinism.DeterminismError as err:
+        caught = err
+    assert caught is not None
+    assert sanitize.is_violation(caught)
+
+
+def test_is_violation_requires_the_sentinel_enabled(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_DETERMINISM", "dual")
+    assert sanitize.is_violation(determinism.DeterminismError("trip"))
+    envflags._warned.clear()
+    monkeypatch.setenv("SCHEDULER_TPU_DETERMINISM", "off")
+    assert not sanitize.is_violation(determinism.DeterminismError("trip"))
+    assert not sanitize.is_violation(ValueError("not a trip"))
+
+
+def test_determinism_flag_is_in_the_engine_cache_key():
+    """A resident engine must not straddle a diagnostics-regime flip: a
+    dual-mode cycle always starts from a build whose readbacks were
+    digested from the first dispatch."""
+    assert "SCHEDULER_TPU_DETERMINISM" in engine_cache._ENV_KEYS
+
+
+def test_malformed_mode_degrades_to_off(monkeypatch):
+    monkeypatch.setenv("SCHEDULER_TPU_DETERMINISM", "paranoid")
+    assert determinism.mode() == "off"  # envflags warn-once-and-default
+    assert not determinism.enabled()
+
+
+@pytest.mark.slow
+def test_full_engine_cycle_is_digest_clean_under_dual(monkeypatch):
+    """The acceptance smoke: a flagship-shaped allocate cycle under
+    ``dual`` — every device-phase readback is replayed against the
+    resident executable and the digests must agree (zero mismatches), with
+    the per-cycle evidence drained through phases.note('determinism') and
+    the process summary carrying the replays bench stamps as
+    detail.determinism."""
+    import scheduler_tpu.actions  # noqa: F401  registry side effects
+    import scheduler_tpu.plugins  # noqa: F401
+    from scheduler_tpu.conf import parse_scheduler_conf
+    from scheduler_tpu.harness import make_synthetic_cluster
+    from scheduler_tpu.harness.measure import steady_cycle
+
+    monkeypatch.setenv("SCHEDULER_TPU_DETERMINISM", "dual")
+    conf = parse_scheduler_conf(
+        """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: drf
+  - name: binpack
+"""
+    )
+    cluster = make_synthetic_cluster(16, 64, tasks_per_job=8)
+    steady_cycle(cluster.cache, conf, ("allocate",))
+    assert len(cluster.cache.binder.binds) == 64
+    s = determinism.summary()
+    assert s["mode"] == "dual"
+    assert s["cycles"] >= 1
+    assert s["redispatches"] >= 1
+    assert s["mismatches"] == 0
+    assert s["last_digest"] is not None

@@ -252,7 +252,7 @@ def test_budget_holds_on_the_2d_mesh_one_merged_all_gather():
         # per-shard-totals all-gather per run step, checked below.
         "ops/backfill.py::_bf_fill_2d",
     }
-    counts = count_collectives(sites[site](mesh))
+    counts = count_collectives(sites[site](mesh).as_text())
     assert counts == {"all-gather": 1}
     assert check_counts(site, counts, layout.COLLECTIVE_BUDGET[site]) == []
     for lp_site in ("ops/lp_place.py::_lp_iterate_2d",
@@ -260,14 +260,14 @@ def test_budget_holds_on_the_2d_mesh_one_merged_all_gather():
                     "ops/evict.py::_victim_pick_2d",
                     "ops/sharded.py::_tenant_scan_2d",
                     "ops/backfill.py::_bf_fill_2d"):
-        lp_counts = count_collectives(sites[lp_site](mesh))
+        lp_counts = count_collectives(sites[lp_site](mesh).as_text())
         assert lp_counts == {"all-gather": 1}
         assert check_counts(
             lp_site, lp_counts, layout.COLLECTIVE_BUDGET[lp_site]
         ) == []
     for qf_site in ("ops/qfair.py::_qfair_solve_2d",
                     "ops/qfair.py::_qfair_stacked_2d"):
-        qf_counts = count_collectives(sites[qf_site](mesh))
+        qf_counts = count_collectives(sites[qf_site](mesh).as_text())
         assert qf_counts == {}, qf_counts
         assert check_counts(
             qf_site, qf_counts, layout.COLLECTIVE_BUDGET[qf_site]

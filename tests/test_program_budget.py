@@ -1,0 +1,178 @@
+"""The compiled-program resource contracts (``scripts/program_budget.py``;
+ops/layout.py ``PROGRAM_BUDGETS``; docs/STATIC_ANALYSIS.md "schedlint v5").
+
+The acceptance matrix from the v5 issue: the committed registry passes on
+the real tree (every budgeted site lowers under its byte/FLOP ceilings
+with its declared dtype story), a seeded over-budget program — a forced
+[T, N] materialization held against an [S, N] site's row — MUST fail
+``check_program``, the dtype checks catch both the f64 leak and the
+silent demotion, and the LP admission model stays an upper bound on the
+compiled working set (the ``lp_supported`` cross-check)."""
+
+import numpy as np
+import pytest
+
+from scheduler_tpu.ops import layout
+
+
+def _mesh8():
+    import jax
+    from jax.sharding import Mesh
+
+    from scheduler_tpu.ops.sharded import NODE_AXIS
+    from tests.conftest import USE_TPU
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        if USE_TPU:
+            pytest.skip(f"needs 8 devices, have {len(devices)}")
+        raise AssertionError(
+            f"forced host device count regressed (got {len(devices)})"
+        )
+    return Mesh(np.array(devices[:8]), (NODE_AXIS,))
+
+
+def test_registry_schema_and_coverage():
+    """Registry integrity without any lowering: every shard site is
+    budgeted or explicitly covered, every covered site points at a real
+    row, every row names a declared reference shape."""
+    sites = set(layout.SHARD_SITES)
+    budgeted = set(layout.PROGRAM_BUDGETS)
+    covered = dict(layout.PROGRAM_COVERED)
+    for site in sites:
+        assert (site in budgeted) != (site in covered), (
+            f"{site} must be in exactly one of PROGRAM_BUDGETS / "
+            "PROGRAM_COVERED"
+        )
+    for site, by in covered.items():
+        assert by in budgeted, f"PROGRAM_COVERED[{site!r}] -> missing row"
+    for site, row in layout.PROGRAM_BUDGETS.items():
+        assert row["shape"] in layout.PROGRAM_SHAPES, site
+        assert row["gate"] in ("cpu", "accel"), site
+        assert row["dtype"] in ("f32", "x64-scoped"), site
+    # Every declared scoped block is a real function (the precision pass
+    # re-proves this statically; here against the live modules).
+    import importlib
+
+    for mod_path, fn in layout.X64_SCOPED_BLOCKS:
+        mod = importlib.import_module(
+            "scheduler_tpu." + mod_path[:-3].replace("/", ".")
+        )
+        assert callable(getattr(mod, fn, None)), f"{mod_path}::{fn}"
+
+
+def test_budgeted_sites_cover_every_cpu_gated_row():
+    """Every cpu-gated registry row has a compile recipe at its mesh shape
+    (or is the twin of the other shape) — no row can silently rot."""
+    from scripts.program_budget import SOLO_SITES, _twin_key, budgeted_sites
+
+    mesh = _mesh8()
+    known = set(budgeted_sites(mesh)) | set(SOLO_SITES)
+    for site, row in layout.PROGRAM_BUDGETS.items():
+        if row["gate"] != "cpu":
+            continue
+        assert site in known or _twin_key(site) in known, site
+
+
+def test_real_sig_site_lowers_within_its_budget():
+    """The clean twin of the over-budget fixture below: the REAL
+    signature-compressed relaxation at the reference shape stays under
+    its declared ceilings."""
+    from scripts import shard_budget
+    from scripts.program_budget import _flops, _memory, check_program
+
+    site = "ops/lp_place.py::lp_relax_sig"
+    compiled = shard_budget._compile_lp_iterate_sig(None)
+    row = layout.PROGRAM_BUDGETS[site]
+    bad = check_program(
+        site, row, _memory(compiled), _flops(compiled), compiled.as_text()
+    )
+    assert bad == []
+
+
+def test_forced_full_rank_materialization_fails_the_sig_budget():
+    """The seeded over-budget program: lower the relaxation over the FULL
+    [T, N] per-task tensor (t=256, n=1024 — the shape the admission gate
+    models) and hold it against the [S, N] signature-compressed site's
+    row.  The whole point of signature compression is that the class
+    tensor working set is orders of magnitude under the per-task one, so
+    this MUST exceed the declared temp ceiling."""
+    import jax.numpy as jnp
+
+    from scheduler_tpu.ops.lp_place import lp_relax
+    from scripts.program_budget import _flops, _memory, check_program
+
+    t, n, r = 256, 1024, 3
+    rng = np.random.default_rng(0)
+    compiled = lp_relax.lower(
+        jnp.asarray(rng.uniform(1, 8, (n, r)).astype(np.float32)),
+        jnp.asarray(rng.uniform(1, 8, (n, r)).astype(np.float32)),
+        jnp.asarray(np.zeros(n, np.int32)),
+        jnp.asarray(np.full(n, 16, np.int32)),
+        jnp.asarray(np.ones(n, bool)),
+        jnp.asarray(np.ones((1, 1), bool)),
+        jnp.asarray(np.zeros((1, 1), np.float32)),
+        jnp.asarray(np.full(r, 1e-2, np.float32)),
+        jnp.asarray(rng.uniform(0.5, 2, (t, r)).astype(np.float32)),
+        jnp.asarray(rng.uniform(0.5, 2, (t, r)).astype(np.float32)),
+        iters=8, tau=0.5, tol=1e-3, weights=(0.0, 0.0, 1.0),
+        enforce_pod_count=True, use_static=False, mesh=None,
+    ).compile()
+    row = layout.PROGRAM_BUDGETS["ops/lp_place.py::lp_relax_sig"]
+    bad = check_program(
+        "seeded-[T,N]-at-[S,N]", row, _memory(compiled), _flops(compiled),
+        compiled.as_text(),
+    )
+    assert any("temp_bytes" in b and "exceeds the declared ceiling" in b
+               for b in bad)
+
+
+def test_dtype_contract_catches_leak_and_silent_demotion():
+    """check_program's dtype half, driven with synthetic HLO: an f64
+    tensor under an 'f32' contract is a leak; an 'x64-scoped' program
+    whose optimized HLO holds NO f64 was silently demoted (its bitwise
+    host parity is void)."""
+    from scripts.program_budget import check_program
+
+    mem = {"arg_bytes": 1, "out_bytes": 1, "temp_bytes": 1, "code_bytes": 0}
+    f32_row = {"shape": "s", "gate": "cpu", "dtype": "f32",
+               "arg_bytes": 10, "out_bytes": 10, "temp_bytes": 10,
+               "flops": 10}
+    x64_row = dict(f32_row, dtype="x64-scoped")
+    leak = check_program("site", f32_row, mem, None,
+                         "  %w = f64[4]{0} convert(f32[4]{0} %x)")
+    assert len(leak) == 1 and "x64 leak" in leak[0]
+    demoted = check_program("site", x64_row, mem, None,
+                            "  %w = f32[4]{0} add(f32[4]{0} %x, %y)")
+    assert len(demoted) == 1 and "silently demoted" in demoted[0]
+    clean = check_program("site", x64_row, mem, None,
+                          "  %w = f64[4]{0} convert(f32[4]{0} %x)")
+    assert clean == []
+
+
+def test_lp_admission_model_is_an_upper_bound():
+    """The lp_supported cross-check: ``lp_working_set_bytes`` (the 256MB
+    admission gate's model, ops/lp_place.py) must stay >= the compiled
+    relaxation's measured temp bytes — if the model ever under-counts,
+    admission lets in a program the device can't hold."""
+    from scripts.program_budget import _lp_crosscheck
+
+    assert _lp_crosscheck(verbose=False) == []
+
+
+@pytest.mark.slow
+def test_committed_tree_passes_the_full_gate_on_the_1d_mesh():
+    """The acceptance run: every budgeted site lowers at the 8-device 1-D
+    mesh shape under its ceilings (CI runs both this and --mesh 2x4)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(root / "scripts" / "program_budget.py"),
+         "--devices", "8"],
+        capture_output=True, text=True, timeout=600, cwd=root,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout

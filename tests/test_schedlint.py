@@ -1161,3 +1161,212 @@ def test_env_fixtures_cover_the_obs_flags():
         """,
     })
     assert out == []
+
+
+# -- determinism flag fixtures (schedlint v5) ---------------------------------
+
+DETERMINISM_CACHE_STUB = """
+    _ENV_KEYS = (
+        "SCHEDULER_TPU_MEGA",
+        "SCHEDULER_TPU_DETERMINISM",
+    )
+"""
+
+
+def test_env_drift_clean_on_registered_determinism_mode():
+    """Same contract as the retrace sentinel: the digest mode is
+    program-adjacent (a dual-mode cycle starts from a build whose
+    readbacks were digested from the first dispatch), so a read in ops/
+    is clean exactly because engine_cache registers the flag."""
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": DETERMINISM_CACHE_STUB,
+        "scheduler_tpu/ops/sentinel.py": """
+            from scheduler_tpu.utils.envflags import env_str
+            def mode():
+                return env_str("SCHEDULER_TPU_DETERMINISM", "off",
+                               choices=("off", "digest", "dual"))
+        """,
+    })
+    assert out == []
+
+
+def test_env_drift_trips_on_unregistered_determinism_mode():
+    out = findings("env-drift", py={
+        "scheduler_tpu/ops/engine_cache.py": ENGINE_CACHE_STUB,
+        "scheduler_tpu/ops/sentinel.py": """
+            from scheduler_tpu.utils.envflags import env_str
+            def mode():
+                return env_str("SCHEDULER_TPU_DETERMINISM", "off")
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_DETERMINISM" in out[0].message
+    assert out[0].path == "scheduler_tpu/ops/sentinel.py"
+
+
+def test_raw_env_trips_on_determinism_environ_read():
+    out = findings("raw-env", py={
+        "scheduler_tpu/utils/determinism.py": """
+            import os
+            def mode():
+                return os.environ.get("SCHEDULER_TPU_DETERMINISM", "off")
+        """,
+    })
+    assert len(out) == 1 and "SCHEDULER_TPU_DETERMINISM" in out[0].message
+
+
+# -- precision (schedlint v5) -------------------------------------------------
+
+PRECISION_LAYOUT_STUB = """
+    PROGRAM_DOC = "docs/PROGRAMS.md"
+    PROGRAM_SHAPES = {
+        "mesh-small": "8 nodes x 4 tasks x 3 resources",
+    }
+    SHARD_SITES = {
+        "ops/solver.py::_scan": ("rows",),
+    }
+    PROGRAM_BUDGETS = {
+        "ops/solver.py::_scan": {
+            "shape": "mesh-small", "gate": "cpu", "dtype": "f32",
+            "arg_bytes": 1024, "out_bytes": 512, "temp_bytes": 4096,
+            "flops": 1000,
+        },
+        "ops/qsolve.py::solve": {
+            "shape": "mesh-small", "gate": "cpu", "dtype": "x64-scoped",
+            "arg_bytes": 1024, "out_bytes": 512, "temp_bytes": 4096,
+            "flops": 1000,
+        },
+    }
+    PROGRAM_COVERED = {}
+    X64_SCOPED_BLOCKS = (
+        ("ops/qsolve.py", "solve_host"),
+    )
+"""
+
+CLEAN_QSOLVE = """
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import enable_x64
+
+    def solve_host(shares):
+        with enable_x64():
+            wide = jnp.asarray(shares, dtype=jnp.float64)
+        return np.float64(1.0), wide  # host np.float64 is always free
+"""
+
+
+def test_precision_clean_on_declared_scoped_block():
+    out = findings("precision", py={
+        "scheduler_tpu/ops/layout.py": PRECISION_LAYOUT_STUB,
+        "scheduler_tpu/ops/qsolve.py": CLEAN_QSOLVE,
+        "scheduler_tpu/ops/solver.py": """
+            import jax.numpy as jnp
+            def _scan(x):
+                return jnp.asarray(x, dtype=jnp.float32)
+        """,
+    })
+    assert out == []
+
+
+def test_precision_trips_on_f64_outside_declared_block():
+    """The dtype-contract violation: a jnp 64-bit construct in a function
+    the registry never declared (its clean twin is the fixture above)."""
+    out = findings("precision", py={
+        "scheduler_tpu/ops/layout.py": PRECISION_LAYOUT_STUB,
+        "scheduler_tpu/ops/qsolve.py": CLEAN_QSOLVE,
+        "scheduler_tpu/ops/solver.py": """
+            import jax.numpy as jnp
+            def _scan(x):
+                return jnp.asarray(x, dtype=jnp.float64)
+        """,
+    })
+    assert len(out) == 1
+    assert "jnp.float64" in out[0].message
+    assert out[0].path == "scheduler_tpu/ops/solver.py"
+
+
+def test_precision_trips_on_undeclared_enable_x64_block():
+    out = findings("precision", py={
+        "scheduler_tpu/ops/layout.py": PRECISION_LAYOUT_STUB,
+        "scheduler_tpu/ops/qsolve.py": CLEAN_QSOLVE,
+        "scheduler_tpu/ops/solver.py": """
+            from jax.experimental import enable_x64
+            def _scan(x):
+                with enable_x64():
+                    return x
+        """,
+    })
+    assert len(out) == 1
+    assert "X64_SCOPED_BLOCKS" in out[0].message
+
+
+def test_precision_trips_on_process_wide_x64_flip():
+    out = findings("precision", py={
+        "scheduler_tpu/ops/layout.py": PRECISION_LAYOUT_STUB,
+        "scheduler_tpu/ops/qsolve.py": CLEAN_QSOLVE,
+        "scheduler_tpu/ops/solver.py": """
+            import jax
+            jax.config.update("jax_enable_x64", True)
+            def _scan(x):
+                return x
+        """,
+    })
+    assert len(out) == 1
+    assert "WHOLE process" in out[0].message
+
+
+def test_precision_trips_on_unbudgeted_shard_site():
+    """The undeclared-site fixture: a SHARD_SITES key with neither a
+    PROGRAM_BUDGETS row nor a PROGRAM_COVERED deferral."""
+    stub = PRECISION_LAYOUT_STUB.replace(
+        '"ops/solver.py::_scan": ("rows",),',
+        '"ops/solver.py::_scan": ("rows",),\n'
+        '        "ops/solver.py::_mask": ("rows",),',
+    )
+    out = findings("precision", py={
+        "scheduler_tpu/ops/layout.py": stub,
+        "scheduler_tpu/ops/qsolve.py": CLEAN_QSOLVE,
+    })
+    assert len(out) == 1
+    assert "_mask" in out[0].message and "unbudgeted" in out[0].message
+
+
+def test_precision_trips_on_x64_row_without_declared_block():
+    stub = PRECISION_LAYOUT_STUB.replace(
+        '        ("ops/qsolve.py", "solve_host"),\n', ""
+    )
+    out = findings("precision", py={
+        "scheduler_tpu/ops/layout.py": stub,
+        "scheduler_tpu/ops/qsolve.py": """
+            def solve():
+                return 1
+        """,
+    })
+    assert any("x64-scoped budget row" in f.message for f in out)
+
+
+def test_precision_trips_on_declared_block_typo():
+    out = findings("precision", py={
+        "scheduler_tpu/ops/layout.py": PRECISION_LAYOUT_STUB,
+        "scheduler_tpu/ops/qsolve.py": """
+            def some_other_name():
+                return 1
+        """,
+    })
+    assert any("no such\nfunction exists" in f.message
+               or "no such function exists" in f.message for f in out)
+
+
+def test_precision_doc_table_drift():
+    out = findings("precision", py={
+        "scheduler_tpu/ops/layout.py": PRECISION_LAYOUT_STUB,
+        "scheduler_tpu/ops/qsolve.py": CLEAN_QSOLVE,
+    }, docs={
+        "docs/PROGRAMS.md": """
+            # Programs
+            <!-- layout:PROGRAM_BUDGETS:begin (generated by scripts/gen_layout_doc.py; do not edit) -->
+            | stale | table |
+            <!-- layout:PROGRAM_BUDGETS:end -->
+        """,
+    })
+    assert len(out) == 1
+    assert "stale" in out[0].message

@@ -448,7 +448,7 @@ def test_budget_passes_on_the_real_scan_and_counts_one_all_gather():
 
     mesh = _mesh4()
     site = "ops/sharded.py::_place_scan_1d"
-    counts = count_collectives(lowerable_sites(mesh)[site](mesh))
+    counts = count_collectives(lowerable_sites(mesh)[site](mesh).as_text())
     assert counts == {"all-gather": 1}
     assert check_counts(site, counts, layout.COLLECTIVE_BUDGET[site]) == []
 
